@@ -1,0 +1,282 @@
+"""Version scheme tests: curated ordering vectors per scheme (mirroring the
+reference's per-scheme Go lib test suites) plus the key-encoding property:
+for any two versions whose keys are both exact, byte order of the packed keys
+must equal comparator order — the zero-diff foundation of the TPU kernel."""
+
+import itertools
+import random
+
+import pytest
+
+from trivy_tpu import versioning
+from trivy_tpu.versioning import Constraints, SCHEMES
+from trivy_tpu.versioning.base import ParseError
+
+# Each list is in strictly ascending order; adjacent "==" entries are tuples.
+ORDERED = {
+    "deb": [
+        ("0:1.0", "1.0", "1.0-0"), "1.0-1", "1.0-1+b1", "1.0.1-1",
+        "1.2~rc1-1", "1.2-1", "1.2-1.1", "1.2.1-1", "1.10-1",
+        "1.a-1", "2.0-1", "2.0a-1", "2.0ab-1", "2.0+x-1", "1:0.1",
+        "1:1.0~alpha1", "1:1.0", "2:0.5",
+    ],
+    "rpm": [
+        "1.a", "1.0", "1.0.1", ("1.0.1-1", "1.0.1-01"), "1.0.1-2", "1.0.2",
+        "1.2~rc1", "1.2~rc2", "1.2", "1.2^20200101", "1.2.0.1", "1.2.1",
+        "1.10", "2.0", "0:2.1", "1:0.5", "1:1.0", "2:0.1",
+    ],
+    "apk": [
+        "1.0_alpha", "1.0_alpha2", "1.0_beta", "1.0_pre", "1.0_rc1",
+        "1.0", "1.0-r0", "1.0-r1", "1.0_p1", "1.0.0",
+        "1.0.1", "1.0.1a", "1.0.1b", "1.0.2", "1.0.10", "1.00.0",
+        "1.1", "2.0",
+    ],
+    "generic": [
+        "0.0.1", "0.1.0", ("1", "1.0", "1.0.0", "v1.0.0"), "1.0.1",
+        "1.2.0-alpha", "1.2.0-alpha.1", "1.2.0-beta", "1.2.0-rc.1",
+        "1.2.0", "1.2.3", "1.10.0", "2.0.0",
+    ],
+    "npm": [
+        "1.0.0-alpha", "1.0.0-alpha.1", "1.0.0-alpha.beta", "1.0.0-beta",
+        "1.0.0-beta.2", "1.0.0-beta.11", "1.0.0-rc.1", "1.0.0",
+        ("1.2.0", "v1.2.0", "=1.2.0"), "1.2.3", "1.10.0", "2.0.0",
+    ],
+    "pep440": [
+        "0.9", "1.0.dev1", "1.0.dev2", "1.0a1.dev1", "1.0a1", "1.0a2",
+        "1.0b1", "1.0rc1", ("1.0", "1.0.0"), "1.0+local", "1.0.post1",
+        "1.0.1", "1.1", ("1.2", "1.2.0"), "2!0.1",
+    ],
+    "maven": [
+        "1-alpha", ("1-alpha-1", "1.0-a1", "1.0alpha1"), "1-beta",
+        "1-milestone", ("1-rc", "1-cr"), "1-snapshot",
+        ("1", "1.0", "1.0.0", "1-ga", "1.0-final"), "1-sp", "1-abc",
+        "1-1", "1.0.1", ("1.1", "1.1.ga"), "1.2", "1.10", "2.0",
+    ],
+    "rubygems": [
+        "0.9", "1.0.a", "1.0.b2", ("1.0", "1.0.0"), "1.0.1",
+        "1.1.b", "1.1.beta", "1.1", "1.2", "1.10", "2.0",
+    ],
+    "bitnami": [
+        "0.9.0", ("1.0.0", "1.0.0-0"), "1.0.0-1", "1.0.0-2", "1.0.1",
+        "1.2.0", "1.10.0", "2.0.0",
+    ],
+}
+
+
+def _flatten(entries):
+    out = []
+    for e in entries:
+        out.append((e, e) if isinstance(e, str) else (e[0], e))
+    return out
+
+
+@pytest.mark.parametrize("scheme_name", sorted(ORDERED))
+def test_ordering(scheme_name):
+    scheme = SCHEMES[scheme_name]
+    entries = ORDERED[scheme_name]
+    # equality groups
+    for e in entries:
+        if not isinstance(e, str):
+            for a, b in itertools.combinations(e, 2):
+                assert scheme.compare(a, b) == 0, f"{a} != {b} ({scheme_name})"
+    # strict ascending between groups (use first representative)
+    reps = [e if isinstance(e, str) else e[0] for e in entries]
+    for i, a in enumerate(reps):
+        for b in reps[i + 1:]:
+            assert scheme.compare(a, b) < 0, f"{a} !< {b} ({scheme_name})"
+            assert scheme.compare(b, a) > 0, f"{b} !> {a} ({scheme_name})"
+
+
+@pytest.mark.parametrize("scheme_name", sorted(ORDERED))
+def test_key_order_matches_compare(scheme_name):
+    """The packed-key property: exact keys must order exactly like compare."""
+    scheme = SCHEMES[scheme_name]
+    versions = []
+    for e in ORDERED[scheme_name]:
+        versions.extend([e] if isinstance(e, str) else list(e))
+    keyed = []
+    for v in versions:
+        key, exact = scheme.key(v)
+        keyed.append((v, key, exact))
+    checked = skipped = 0
+    for (va, ka, ea), (vb, kb, eb) in itertools.combinations(keyed, 2):
+        if not (ea and eb):
+            skipped += 1
+            continue
+        d = scheme.compare(va, vb)
+        kd = (ka > kb) - (ka < kb)
+        assert kd == d, f"key order mismatch {va} vs {vb} ({scheme_name}): cmp={d} key={kd}"
+        checked += 1
+    # the encoding must be exact for the vast majority of real versions
+    # (rubygems deliberately sends all pre-release gems to the host path,
+    # and the curated list over-represents those)
+    assert checked > 0
+    if scheme_name != "rubygems":
+        assert skipped <= checked, f"too many inexact keys in {scheme_name}"
+
+
+def _random_versions(scheme_name, rng, n=120):
+    """Generate plausible random versions per scheme."""
+    out = []
+    for _ in range(n):
+        nums = [str(rng.randint(0, 30)) for _ in range(rng.randint(1, 4))]
+        v = ".".join(nums)
+        if scheme_name == "deb":
+            if rng.random() < 0.3:
+                v = f"{rng.randint(0, 3)}:{v}"
+            if rng.random() < 0.4:
+                v += f"-{rng.randint(0, 20)}"
+            if rng.random() < 0.2:
+                v += rng.choice(["~rc1", "~beta2", "+b1", "ubuntu3"])
+        elif scheme_name == "rpm":
+            if rng.random() < 0.3:
+                v = f"{rng.randint(0, 3)}:{v}"
+            if rng.random() < 0.5:
+                v += f"-{rng.randint(1, 30)}.el{rng.randint(7, 9)}"
+            if rng.random() < 0.15:
+                v += rng.choice(["~rc1", "^git20200101"])
+        elif scheme_name == "apk":
+            if rng.random() < 0.25:
+                v += rng.choice(["a", "b", "c"])
+            if rng.random() < 0.3:
+                v += rng.choice(["_alpha", "_beta2", "_rc1", "_p1", "_git2"])
+            if rng.random() < 0.4:
+                v += f"-r{rng.randint(0, 12)}"
+        elif scheme_name in ("generic", "npm"):
+            v = ".".join(nums[:3]) if scheme_name == "npm" else v
+            if rng.random() < 0.3:
+                v += rng.choice(["-alpha", "-alpha.1", "-beta.2", "-rc.1", "-1"])
+        elif scheme_name == "pep440":
+            if rng.random() < 0.3:
+                v += rng.choice(["a1", "b2", "rc3", ".post1", ".dev2"])
+        elif scheme_name == "maven":
+            if rng.random() < 0.4:
+                v += rng.choice(
+                    ["-alpha-1", "-beta2", "-rc1", "-SNAPSHOT", "-sp1", "-1", ".Final"]
+                )
+        elif scheme_name == "rubygems":
+            if rng.random() < 0.25:
+                v += rng.choice([".a", ".beta2", ".rc1"])
+        elif scheme_name == "bitnami":
+            if rng.random() < 0.5:
+                v += f"-{rng.randint(0, 9)}"
+        out.append(v)
+    return out
+
+
+@pytest.mark.parametrize("scheme_name", sorted(ORDERED))
+def test_key_property_random(scheme_name):
+    rng = random.Random(12345)
+    scheme = SCHEMES[scheme_name]
+    keyed = []
+    for v in _random_versions(scheme_name, rng):
+        try:
+            key, exact = scheme.key(v)
+            scheme.parse(v)
+        except ParseError:
+            continue
+        keyed.append((v, key, exact))
+    assert len(keyed) > 50
+    pairs = checked = 0
+    for (va, ka, ea), (vb, kb, eb) in itertools.combinations(keyed, 2):
+        pairs += 1
+        if not (ea and eb):
+            continue
+        d = scheme.compare(va, vb)
+        kd = (ka > kb) - (ka < kb)
+        assert kd == d, f"{scheme_name}: {va} vs {vb}: cmp={d} key={kd}"
+        checked += 1
+    assert checked > pairs // 2
+
+
+class TestConstraints:
+    def check(self, eco, expr, version):
+        return versioning.parse_constraints(eco, expr).check_str(version)
+
+    def test_basic_ranges(self):
+        assert self.check("go", ">=1.0.0, <1.2.0", "1.1.0")
+        assert not self.check("go", ">=1.0.0, <1.2.0", "1.2.0")
+        assert self.check("go", "<1.2.0 || >=2.0.0, <2.1.0", "2.0.5")
+        assert not self.check("go", "<1.2.0 || >=2.0.0", "1.5.0")
+
+    def test_npm_semantics(self):
+        assert self.check("npm", "^1.2.3", "1.9.0")
+        assert not self.check("npm", "^1.2.3", "2.0.0")
+        assert self.check("npm", "~1.2.3", "1.2.9")
+        assert not self.check("npm", "~1.2.3", "1.3.0")
+        assert self.check("npm", "1.2.x", "1.2.7")
+        assert not self.check("npm", "1.2.x", "1.3.0")
+        assert self.check("npm", "1.2.3 - 2.0.0", "1.5.0")
+        assert self.check("npm", "*", "0.0.1")
+        # pre-release rule
+        assert not self.check("npm", ">=1.0.0", "2.0.0-alpha")
+        assert self.check("npm", ">=2.0.0-0", "2.0.0-alpha")
+        assert self.check("npm", ">=2.0.0-alpha, <2.0.0", "2.0.0-beta")
+
+    def test_caret_zero_major(self):
+        assert self.check("npm", "^0.2.3", "0.2.9")
+        assert not self.check("npm", "^0.2.3", "0.3.0")
+        assert self.check("npm", "^0.0.3", "0.0.3")
+        assert not self.check("npm", "^0.0.3", "0.0.4")
+
+    def test_rubygems_pessimistic(self):
+        assert self.check("rubygems", "~> 2.2", "2.8.0")
+        assert not self.check("rubygems", "~> 2.2", "3.0.0")
+        assert self.check("rubygems", "~> 2.2.1", "2.2.9")
+        assert not self.check("rubygems", "~> 2.2.1", "2.3.0")
+
+    def test_pep440(self):
+        assert self.check("pip", ">=1.0, <2.0", "1.5")
+        assert not self.check("pip", ">=1.0, <2.0", "2.0")
+        assert self.check("pip", "<2.0", "2.0.dev1")
+        assert self.check("pip", "!=1.5", "1.6")
+        assert not self.check("pip", "!=1.5", "1.5.0")
+
+    def test_maven(self):
+        assert self.check("maven", ">=1.0.0, <2.0.0", "1.5")
+        assert not self.check("maven", ">=1.0.0, <2.0.0", "2.0.0.RELEASE")
+        assert self.check("maven", "<2.13.4.1", "2.13.4")
+
+    def test_intervals_cover_check(self):
+        """intervals() must be a superset of check() (kernel safety)."""
+        rng = random.Random(7)
+        cases = [
+            ("go", ">=1.0.0, <1.2.0 || >2.0.0"),
+            ("npm", "^1.2.3 || ~0.4.0"),
+            ("npm", ">=1.0.0 <1.5.0"),
+            ("pip", ">=1.0, <2.0, !=1.5"),
+            ("rubygems", "~> 2.2"),
+            ("maven", ">=1.0, <2.0"),
+        ]
+        for eco, expr in cases:
+            c = versioning.parse_constraints(eco, expr)
+            ivs = c.intervals()
+            scheme = c.scheme
+            for _ in range(200):
+                nums = [str(rng.randint(0, 3)) for _ in range(3)]
+                v = ".".join(nums)
+                if rng.random() < 0.2:
+                    v += "-alpha"
+                try:
+                    pv = scheme.parse(v)
+                except ParseError:
+                    continue
+                in_iv = any(iv.contains(pv, scheme) for iv in ivs)
+                if c.check(pv):
+                    assert in_iv, f"{eco} {expr} {v}: check=True but not in intervals"
+
+
+class TestIsVulnerable:
+    def test_fixed_range(self):
+        assert versioning.is_vulnerable("npm", "4.0.0", [">=4.0.0, <4.0.1"], [], [])
+        assert not versioning.is_vulnerable("npm", "4.0.1", [">=4.0.0, <4.0.1"], [], [])
+
+    def test_patched_subtraction(self):
+        assert versioning.is_vulnerable("go", "1.1.0", ["<2.0.0"], [">=1.2.0"], [])
+        assert not versioning.is_vulnerable("go", "1.5.0", ["<2.0.0"], [">=1.2.0"], [])
+
+    def test_empty_means_vulnerable(self):
+        assert versioning.is_vulnerable("go", "1.0.0", [""], [], [])
+
+    def test_unparseable_version(self):
+        assert not versioning.is_vulnerable("go", "not-a-version", ["<2.0.0"], [], [])

@@ -1,0 +1,21 @@
+"""trivy_tpu — a TPU-native security-scanning framework.
+
+A ground-up re-design of the capabilities of aquasecurity/trivy
+(reference: /root/reference, pure Go) for TPU hardware:
+
+- The artifact-analysis engine (fanal), parsers, reporting, RPC and CLI are
+  idiomatic host Python (reference layer map: SURVEY.md §1).
+- The two hot loops — the (package x advisory) vulnerability match
+  (reference pkg/detector/ospkg/detect.go:66, pkg/detector/library/driver.go:115)
+  and the secret-rule engine (reference pkg/fanal/secret/scanner.go:377) — are
+  batched JAX/XLA kernels. The advisory DB is compiled once into dense
+  name-hash + version-interval-rank tensors resident in HBM
+  (trivy_tpu.tensorize), shardable over a jax.sharding.Mesh.
+
+Zero-diff guarantee: the device kernel is a provably superset prefilter
+(exact where version encodings are exact, conservative where flagged), and a
+host rescreen using the exact comparators (trivy_tpu.versioning) confirms
+every candidate, so match sets are byte-identical to the CPU oracle.
+"""
+
+__version__ = "0.1.0"

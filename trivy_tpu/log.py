@@ -1,0 +1,94 @@
+"""Structured logging with prefixes and colors.
+
+Re-expression of the reference slog setup (pkg/log/logger.go:14-35,
+handler.go colored tty handler, context.go prefixes) on Python logging.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_COLORS = {
+    logging.DEBUG: "\x1b[35m",  # magenta
+    logging.INFO: "\x1b[34m",  # blue
+    logging.WARNING: "\x1b[33m",  # yellow
+    logging.ERROR: "\x1b[31m",  # red
+}
+_RESET = "\x1b[0m"
+_PREFIX_COLOR = "\x1b[36m"  # cyan, like the reference's prefix rendering
+
+
+class _Formatter(logging.Formatter):
+    def __init__(self, color: bool):
+        super().__init__()
+        self.color = color
+
+    def format(self, record: logging.LogRecord) -> str:
+        ts = self.formatTime(record, "%Y-%m-%dT%H:%M:%SZ")
+        level = record.levelname
+        prefix = getattr(record, "prefix", "")
+        msg = record.getMessage()
+        kvs = getattr(record, "kvs", None)
+        kv_str = "".join(f"\t{k}={v}" for k, v in (kvs or {}).items())
+        if self.color:
+            c = _COLORS.get(record.levelno, "")
+            level = f"{c}{level}{_RESET}"
+            if prefix:
+                prefix = f"{_PREFIX_COLOR}[{prefix}]{_RESET} "
+        elif prefix:
+            prefix = f"[{prefix}] "
+        return f"{ts}\t{level}\t{prefix}{msg}{kv_str}"
+
+
+class Logger:
+    """Thin wrapper adding the reference's prefix + key/value style."""
+
+    def __init__(self, name: str = "trivy_tpu", prefix: str = ""):
+        self._log = logging.getLogger(name)
+        self._prefix = prefix
+
+    def with_prefix(self, prefix: str) -> "Logger":
+        return Logger(self._log.name, prefix)
+
+    def _emit(self, level: int, msg: str, kwargs: dict) -> None:
+        self._log.log(level, msg, extra={"prefix": self._prefix, "kvs": kwargs})
+
+    def debug(self, msg: str, **kw) -> None:
+        self._emit(logging.DEBUG, msg, kw)
+
+    def info(self, msg: str, **kw) -> None:
+        self._emit(logging.INFO, msg, kw)
+
+    def warn(self, msg: str, **kw) -> None:
+        self._emit(logging.WARNING, msg, kw)
+
+    warning = warn
+
+    def error(self, msg: str, **kw) -> None:
+        self._emit(logging.ERROR, msg, kw)
+
+
+_initialized = False
+
+
+def init(debug: bool = False, quiet: bool = False) -> None:
+    global _initialized
+    root = logging.getLogger("trivy_tpu")
+    root.handlers.clear()
+    handler = logging.StreamHandler(sys.stderr)
+    color = sys.stderr.isatty() and os.environ.get("NO_COLOR") is None
+    handler.setFormatter(_Formatter(color))
+    root.addHandler(handler)
+    if quiet:
+        root.setLevel(logging.CRITICAL + 1)
+    else:
+        root.setLevel(logging.DEBUG if debug else logging.INFO)
+    _initialized = True
+
+
+def logger(prefix: str = "") -> Logger:
+    if not _initialized:
+        init()
+    return Logger(prefix=prefix)

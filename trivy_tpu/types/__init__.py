@@ -1,0 +1,96 @@
+"""Core report/artifact data model.
+
+Mirrors the *shape* of the reference data model so reports are
+interchangeable, re-expressed as Python dataclasses:
+- report model: reference pkg/types/report.go:14 (Report), :109 (Result)
+- artifact model: reference pkg/fanal/types/artifact.go (BlobInfo, Package,
+  Application, OS)
+- scan options: reference pkg/types/scan.go:115-126
+"""
+
+from trivy_tpu.types.artifact import (
+    OS,
+    Application,
+    ArtifactDetail,
+    ArtifactInfo,
+    BlobInfo,
+    CustomResource,
+    Layer,
+    License,
+    LicenseFile,
+    LicenseFinding,
+    Misconfiguration,
+    Package,
+    PackageInfo,
+    Repository,
+    Secret,
+    SecretFinding,
+)
+from trivy_tpu.types.enums import (
+    ArtifactType,
+    Compression,
+    LangType,
+    OSType,
+    ResultClass,
+    Scanner,
+    Severity,
+    Status,
+    TargetType,
+)
+from trivy_tpu.types.report import (
+    CauseMetadata,
+    Code,
+    DataSource,
+    DetectedLicense,
+    DetectedMisconfiguration,
+    DetectedSecret,
+    DetectedVulnerability,
+    Line,
+    Metadata,
+    Report,
+    Result,
+    VulnerabilityInfo,
+)
+from trivy_tpu.types.scan import ScanOptions, ScanTarget
+
+__all__ = [
+    "OS",
+    "Application",
+    "ArtifactDetail",
+    "ArtifactInfo",
+    "ArtifactType",
+    "BlobInfo",
+    "CauseMetadata",
+    "Code",
+    "Compression",
+    "CustomResource",
+    "DataSource",
+    "DetectedLicense",
+    "DetectedMisconfiguration",
+    "DetectedSecret",
+    "DetectedVulnerability",
+    "LangType",
+    "Layer",
+    "License",
+    "LicenseFile",
+    "LicenseFinding",
+    "Line",
+    "Metadata",
+    "Misconfiguration",
+    "OSType",
+    "Package",
+    "PackageInfo",
+    "Report",
+    "Repository",
+    "Result",
+    "ResultClass",
+    "ScanOptions",
+    "ScanTarget",
+    "Scanner",
+    "Secret",
+    "SecretFinding",
+    "Severity",
+    "Status",
+    "TargetType",
+    "VulnerabilityInfo",
+]

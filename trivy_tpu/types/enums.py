@@ -1,0 +1,182 @@
+"""Enumerations for the data model.
+
+References: pkg/types/scan.go:31-50 (Scanners), pkg/fanal/types const enums
+(analyzer/const.go:9-148, artifact.go OSType/LangType), dbTypes severity.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Severity(enum.IntEnum):
+    """Ordered severity (reference trivy-db types: Unknown..Critical)."""
+
+    UNKNOWN = 0
+    LOW = 1
+    MEDIUM = 2
+    HIGH = 3
+    CRITICAL = 4
+
+    def __str__(self) -> str:  # renders like the reference report JSON
+        return self.name
+
+    @classmethod
+    def parse(cls, s: str) -> "Severity":
+        try:
+            return cls[s.strip().upper()]
+        except KeyError:
+            return cls.UNKNOWN
+
+
+class Scanner(str, enum.Enum):
+    """Which scanner classes run (reference pkg/types/scan.go:31-50)."""
+
+    VULN = "vuln"
+    MISCONFIG = "misconfig"
+    SECRET = "secret"
+    LICENSE = "license"
+    NONE = "none"
+
+
+class ResultClass(str, enum.Enum):
+    """Result.Class (reference pkg/types/report.go ClassOSPkg etc.)."""
+
+    OS_PKGS = "os-pkgs"
+    LANG_PKGS = "lang-pkgs"
+    CONFIG = "config"
+    SECRET = "secret"
+    LICENSE = "license"
+    LICENSE_FILE = "license-file"
+    CUSTOM = "custom"
+
+
+class ArtifactType(str, enum.Enum):
+    """reference pkg/fanal/types artifact types."""
+
+    CONTAINER_IMAGE = "container_image"
+    FILESYSTEM = "filesystem"
+    REPOSITORY = "repository"
+    CYCLONEDX = "cyclonedx"
+    SPDX = "spdx"
+    VM = "vm"
+
+
+class TargetType(str, enum.Enum):
+    """CLI target kinds (reference pkg/commands/artifact/run.go TargetKind)."""
+
+    IMAGE = "image"
+    FILESYSTEM = "fs"
+    ROOTFS = "rootfs"
+    REPOSITORY = "repo"
+    SBOM = "sbom"
+    VM = "vm"
+
+
+class Compression(str, enum.Enum):
+    NONE = "none"
+    GZIP = "gzip"
+
+
+class OSType(str, enum.Enum):
+    """OS families (reference pkg/fanal/types/os.go / detector map
+    pkg/detector/ospkg/detect.go:32-51)."""
+
+    ALPINE = "alpine"
+    ALMA = "alma"
+    AMAZON = "amazon"
+    AZURE = "azurelinux"
+    CBL_MARINER = "cbl-mariner"
+    CENTOS = "centos"
+    CHAINGUARD = "chainguard"
+    DEBIAN = "debian"
+    ECHO = "echo"
+    FEDORA = "fedora"
+    MINIMOS = "minimos"
+    OPENSUSE = "opensuse"
+    OPENSUSE_LEAP = "opensuse-leap"
+    OPENSUSE_TUMBLEWEED = "opensuse-tumbleweed"
+    ORACLE = "oracle"
+    PHOTON = "photon"
+    REDHAT = "redhat"
+    ROCKY = "rocky"
+    SLEM = "suse linux enterprise micro"
+    SLES = "suse linux enterprise server"
+    UBUNTU = "ubuntu"
+    WOLFI = "wolfi"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+class LangType(str, enum.Enum):
+    """Language package types (reference pkg/fanal/types LangType, selection
+    in pkg/detector/library/driver.go:25-97)."""
+
+    BUNDLER = "bundler"
+    GEMSPEC = "gemspec"
+    CARGO = "cargo"
+    RUST_BINARY = "rustbinary"
+    COMPOSER = "composer"
+    COMPOSER_VENDOR = "composer-vendor"
+    GO_BINARY = "gobinary"
+    GO_MODULE = "gomod"
+    JAR = "jar"
+    POM = "pom"
+    GRADLE = "gradle-lockfile"
+    SBT = "sbt-lockfile"
+    NPM = "npm"
+    YARN = "yarn"
+    PNPM = "pnpm"
+    BUN = "bun"
+    NODE_PKG = "node-pkg"
+    JAVASCRIPT = "javascript"
+    NUGET = "nuget"
+    DOTNET_CORE = "dotnet-core"
+    PACKAGES_PROPS = "packages-props"
+    PIPENV = "pipenv"
+    POETRY = "poetry"
+    UV = "uv"
+    PIP = "pip"
+    PYTHON_PKG = "python-pkg"
+    PUB = "pub"
+    HEX = "hex"
+    CONAN = "conan"
+    SWIFT = "swift"
+    COCOAPODS = "cocoapods"
+    CONDA_PKG = "conda-pkg"
+    CONDA_ENV = "conda-environment"
+    BITNAMI = "bitnami"
+    K8S_UPSTREAM = "kubernetes"
+    JULIA = "julia"
+    WORDPRESS = "wordpress"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+class Status(enum.IntEnum):
+    """Vulnerability status (reference trivy-db types.Status)."""
+
+    UNKNOWN = 0
+    NOT_AFFECTED = 1
+    AFFECTED = 2
+    FIXED = 3
+    UNDER_INVESTIGATION = 4
+    WILL_NOT_FIX = 5
+    FIX_DEFERRED = 6
+    END_OF_LIFE = 7
+
+    @property
+    def label(self) -> str:
+        return self.name.lower()
+
+    def __str__(self) -> str:
+        return self.label
+
+    @classmethod
+    def parse(cls, s: str) -> "Status":
+        try:
+            return cls[s.strip().upper()]
+        except KeyError:
+            return cls.UNKNOWN

@@ -1,0 +1,39 @@
+"""Scan options and targets (reference pkg/types/scan.go:115-126,
+pkg/fanal/types ScanTarget)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from trivy_tpu.types.artifact import Application, OS, Package
+from trivy_tpu.types.enums import Scanner, Severity
+
+
+@dataclass
+class ScanOptions:
+    pkg_types: list[str] = field(default_factory=lambda: ["os", "library"])
+    pkg_relationships: list[str] = field(default_factory=list)
+    scanners: list[Scanner] = field(
+        default_factory=lambda: [Scanner.VULN, Scanner.SECRET]
+    )
+    severities: list[Severity] = field(default_factory=list)
+    include_dev_deps: bool = False
+    detection_priority: str = "precise"  # "precise" | "comprehensive"
+    license_full: bool = False
+    license_categories: dict[str, list[str]] = field(default_factory=dict)
+    distro: str = ""
+
+    def has_scanner(self, s: Scanner) -> bool:
+        return s in self.scanners
+
+
+@dataclass
+class ScanTarget:
+    """Squashed artifact ready for detection
+    (reference pkg/fanal/types ScanTarget / pkg/scanner/local/scan.go:115)."""
+
+    name: str = ""
+    os: OS = field(default_factory=OS)
+    repository: object | None = None
+    packages: list[Package] = field(default_factory=list)
+    applications: list[Application] = field(default_factory=list)
