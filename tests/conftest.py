@@ -1,11 +1,20 @@
 """Test config: force an 8-device virtual CPU mesh so sharding paths are
-exercised without TPU hardware (see repo README / driver contract)."""
+exercised without TPU hardware (see repo README / driver contract).
+
+NB: this environment pre-imports jax via sitecustomize (TPU tunnel), so
+plain env vars are too late — the jax *config* must be updated before the
+backend initializes (it is lazy), which import-time code here guarantees.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

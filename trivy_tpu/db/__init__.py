@@ -1,0 +1,15 @@
+"""Advisory database: model, store, and lifecycle.
+
+Re-expression of trivy-db (reference pkg/db/db.go + the trivy-db module's
+BoltDB bucket layout) as a host-side store that compiles to device tensors:
+- buckets keyed `ecosystem::source/pkgName -> []Advisory` for languages and
+  `"<os> <ver>"/pkgName -> []Advisory` for OS distros (usage:
+  reference pkg/detector/library/driver.go:115-142,
+  pkg/detector/ospkg/debian/debian.go:71)
+- a `vulnerability` bucket: vuln_id -> metadata (severity, CVSS, title...)
+"""
+
+from trivy_tpu.db.model import Advisory, DataSourceInfo, VulnerabilityMeta
+from trivy_tpu.db.store import AdvisoryDB
+
+__all__ = ["Advisory", "AdvisoryDB", "DataSourceInfo", "VulnerabilityMeta"]

@@ -1,0 +1,148 @@
+"""Advisory DB store.
+
+Bucket layout mirrors trivy-db's BoltDB:
+- OS buckets: "<family> <release>" (e.g. "alpine 3.10", "debian 11")
+- language buckets: "<ecosystem>::<source>" (e.g. "npm::GitHub Security
+  Advisory Npm"); lookups use the "<ecosystem>::" *prefix* across all
+  sources (reference pkg/detector/library/driver.go:115-124)
+- metadata: vuln_id -> VulnerabilityMeta
+
+Persistence is a directory of JSON files (one per bucket family) with a
+metadata.json manifest — the moral equivalent of the reference's
+`trivy.db` + `metadata.json` pair (reference pkg/db/db.go:97-140). A
+SQLite backend can be layered later without changing this API.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+from dataclasses import dataclass, field
+
+from trivy_tpu.db.model import Advisory, VulnerabilityMeta
+
+SCHEMA_VERSION = 2
+
+
+@dataclass
+class Metadata:
+    version: int = SCHEMA_VERSION
+    next_update: str = ""
+    updated_at: str = ""
+    downloaded_at: str = ""
+
+    def to_json(self) -> dict:
+        return {
+            "Version": self.version,
+            "NextUpdate": self.next_update,
+            "UpdatedAt": self.updated_at,
+            "DownloadedAt": self.downloaded_at,
+        }
+
+
+@dataclass
+class AdvisoryDB:
+    """In-memory advisory database with JSON(.gz) persistence."""
+
+    buckets: dict[str, dict[str, list[Advisory]]] = field(default_factory=dict)
+    metadata_bucket: dict[str, VulnerabilityMeta] = field(default_factory=dict)
+    meta: Metadata = field(default_factory=Metadata)
+
+    # ------------------------------------------------------------ write
+
+    def put_advisory(self, bucket: str, pkg_name: str, adv: Advisory) -> None:
+        self.buckets.setdefault(bucket, {}).setdefault(pkg_name, []).append(adv)
+
+    def put_meta(self, meta: VulnerabilityMeta) -> None:
+        self.metadata_bucket[meta.id] = meta
+
+    # ------------------------------------------------------------ read
+
+    def get_advisories(self, bucket: str, pkg_name: str) -> list[Advisory]:
+        """Exact-bucket lookup (OS path)."""
+        return self.buckets.get(bucket, {}).get(pkg_name, [])
+
+    def get_advisories_prefix(self, prefix: str, pkg_name: str) -> list[Advisory]:
+        """Prefix lookup across data sources (language path,
+        reference driver.go:115-124)."""
+        out: list[Advisory] = []
+        for bucket, pkgs in self.buckets.items():
+            if bucket.startswith(prefix):
+                out.extend(pkgs.get(pkg_name, []))
+        return out
+
+    def get_meta(self, vuln_id: str) -> VulnerabilityMeta | None:
+        return self.metadata_bucket.get(vuln_id)
+
+    def bucket_names(self) -> list[str]:
+        return sorted(self.buckets)
+
+    def stats(self) -> dict:
+        n_adv = sum(
+            len(advs) for pkgs in self.buckets.values() for advs in pkgs.values()
+        )
+        n_names = sum(len(pkgs) for pkgs in self.buckets.values())
+        return {
+            "buckets": len(self.buckets),
+            "names": n_names,
+            "advisories": n_adv,
+            "metadata": len(self.metadata_bucket),
+        }
+
+    # ------------------------------------------------------------ io
+
+    def save(self, path: str, compress: bool = True) -> None:
+        os.makedirs(path, exist_ok=True)
+        blob = {
+            "buckets": {
+                bucket: {
+                    name: [a.to_json() for a in advs]
+                    for name, advs in pkgs.items()
+                }
+                for bucket, pkgs in self.buckets.items()
+            },
+            "vulnerability": {
+                vid: m.to_json() for vid, m in self.metadata_bucket.items()
+            },
+        }
+        data = json.dumps(blob, separators=(",", ":")).encode()
+        fname = os.path.join(path, "trivy_tpu.db.json")
+        if compress:
+            with gzip.open(fname + ".gz", "wb") as f:
+                f.write(data)
+        else:
+            with open(fname, "wb") as f:
+                f.write(data)
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(self.meta.to_json(), f)
+
+    @classmethod
+    def load(cls, path: str) -> "AdvisoryDB":
+        db = cls()
+        fname = os.path.join(path, "trivy_tpu.db.json")
+        if os.path.exists(fname + ".gz"):
+            with gzip.open(fname + ".gz", "rb") as f:
+                blob = json.loads(f.read())
+        elif os.path.exists(fname):
+            with open(fname, "rb") as f:
+                blob = json.loads(f.read())
+        else:
+            raise FileNotFoundError(f"no advisory DB at {path}")
+        for bucket, pkgs in blob.get("buckets", {}).items():
+            for name, advs in pkgs.items():
+                for a in advs:
+                    db.put_advisory(bucket, name, Advisory.from_json(a))
+        for vid, m in blob.get("vulnerability", {}).items():
+            db.put_meta(VulnerabilityMeta.from_json(vid, m))
+        mpath = os.path.join(path, "metadata.json")
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                md = json.load(f)
+            db.meta = Metadata(
+                version=md.get("Version", SCHEMA_VERSION),
+                next_update=md.get("NextUpdate", ""),
+                updated_at=md.get("UpdatedAt", ""),
+                downloaded_at=md.get("DownloadedAt", ""),
+            )
+        return db

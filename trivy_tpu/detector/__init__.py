@@ -1,0 +1,3 @@
+from trivy_tpu.detector.engine import MatchEngine, PkgQuery
+
+__all__ = ["MatchEngine", "PkgQuery"]

@@ -1,0 +1,148 @@
+"""Match engine: the TPU-offloaded replacement for the reference's
+per-package detection loops, plus the pure-host oracle used as the
+zero-diff reference.
+
+Pipeline per batch (SURVEY.md north star):
+  host encode (hash + rank) -> device kernel (join + containment) ->
+  host compress -> exact rescreen of candidates -> matches.
+
+The oracle path runs the exact check over every advisory for each name via
+dict lookup — semantically identical to the reference's
+bucket-get-then-compare loop. `MatchEngine.detect` must return exactly the
+oracle's answer for every input (property-tested in tests/test_match.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from trivy_tpu.db.store import AdvisoryDB
+from trivy_tpu.detector.exact import advisory_matches
+from trivy_tpu.log import logger
+from trivy_tpu.tensorize.compile import CompiledDB, compile_db, space_of_bucket
+from trivy_tpu.utils.hashing import join_key
+
+_log = logger("engine")
+
+
+@dataclass(frozen=True)
+class PkgQuery:
+    """One (match-space, name, version) detection query.
+
+    space: "eco::" for language packages, "<family> <release>" for OS.
+    scheme_name: version scheme for the space."""
+
+    space: str
+    name: str
+    version: str
+    scheme_name: str
+
+
+@dataclass
+class MatchResult:
+    query: PkgQuery
+    adv_indices: list[int]  # indices into CompiledDB.advisories
+
+
+class MatchEngine:
+    """Holds the advisory DB in compiled tensor form (and on device) and
+    answers batched detection queries."""
+
+    def __init__(
+        self,
+        db: AdvisoryDB,
+        window: int = 128,
+        mesh=None,
+        use_device: bool = True,
+    ):
+        self.db = db
+        self.cdb: CompiledDB = compile_db(db, window=window)
+        self.mesh = mesh
+        self.use_device = use_device
+        self._ddb = None
+        self._sdb = None
+        self.rescreen_stats = {"candidates": 0, "confirmed": 0}
+        if use_device:
+            from trivy_tpu.ops import match as m
+
+            if mesh is not None:
+                self._sdb = m.ShardedDB.from_compiled(self.cdb, mesh)
+            else:
+                self._ddb = m.DeviceDB.from_compiled(self.cdb)
+
+    # ------------------------------------------------------------ helpers
+
+    def _bucket_scheme(self, bucket: str) -> tuple[str, str] | None:
+        return space_of_bucket(bucket)
+
+    def _eco_of_space(self, space: str) -> str | None:
+        return space[:-2] if space.endswith("::") else None
+
+    # ------------------------------------------------------------ oracle
+
+    def oracle_detect(self, queries: list[PkgQuery]) -> list[MatchResult]:
+        """Pure-host exact detection over the uncompiled DB (the reference
+        loop shape: bucket get per name, compare per advisory)."""
+        # name -> advisory indices, from the compiled flat list so indices
+        # are comparable across paths
+        index: dict[tuple[str, str], list[int]] = {}
+        for i, (bucket, name, _adv) in enumerate(self.cdb.advisories):
+            resolved = space_of_bucket(bucket)
+            if resolved is None:
+                continue
+            index.setdefault((resolved[0], name), []).append(i)
+        out = []
+        for q in queries:
+            hits = []
+            for i in index.get((q.space, q.name), []):
+                _bucket, _name, adv = self.cdb.advisories[i]
+                if advisory_matches(adv, q.version, q.scheme_name,
+                                    self._eco_of_space(q.space)):
+                    hits.append(i)
+            out.append(MatchResult(q, sorted(hits)))
+        return out
+
+    # ------------------------------------------------------------ device
+
+    def detect(self, queries: list[PkgQuery]) -> list[MatchResult]:
+        """Kernel + host rescreen. Identical output to oracle_detect."""
+        if not queries:
+            return []
+        if not self.use_device:
+            return self.oracle_detect(queries)
+        from trivy_tpu.ops import match as m
+
+        batch = self.cdb.encode_packages(
+            [(q.space, q.name, q.version, q.scheme_name) for q in queries]
+        )
+        if self._sdb is not None:
+            hits = m.match_batch_sharded(self._sdb, batch)
+        else:
+            hits = m.match_batch(self._ddb, batch)
+        candidates = m.collect_candidates(hits)
+
+        out = []
+        n_cand = n_conf = 0
+        for q, cand in zip(queries, candidates):
+            # host-fallback names (hot rows evicted from the tensors)
+            fb = self.cdb.host_fallback.get((q.space, q.name))
+            if fb:
+                cand = sorted(set(cand) | set(fb))
+            eco = self._eco_of_space(q.space)
+            hits_q = []
+            for i in cand:
+                bucket, name, adv = self.cdb.advisories[i]
+                # hash collisions: verify the name/space actually match
+                if name != q.name:
+                    continue
+                resolved = space_of_bucket(bucket)
+                if resolved is None or resolved[0] != q.space:
+                    continue
+                n_cand += 1
+                if advisory_matches(adv, q.version, q.scheme_name, eco):
+                    hits_q.append(i)
+                    n_conf += 1
+            out.append(MatchResult(q, sorted(hits_q)))
+        self.rescreen_stats["candidates"] += n_cand
+        self.rescreen_stats["confirmed"] += n_conf
+        return out

@@ -1,0 +1,78 @@
+"""Exact per-advisory satisfaction checks — the single source of truth used
+by BOTH the CPU oracle and the post-kernel host rescreen, so the two paths
+cannot diverge.
+
+Semantics mirror the reference:
+- range-style (language) advisories: pkg matches vulnerable ranges and not
+  patched/unaffected (reference pkg/detector/library/compare/compare.go:22-56)
+- OS advisories: affected <= installed < fixed; no fixed version = always
+  (reference pkg/detector/ospkg/alpine/alpine.go:123-156 et al.)
+"""
+
+from __future__ import annotations
+
+from trivy_tpu import versioning
+from trivy_tpu.db.model import Advisory
+from trivy_tpu.log import logger
+from trivy_tpu.versioning.base import ParseError
+
+_log = logger("detect")
+
+
+def advisory_matches(
+    adv: Advisory, version: str, scheme_name: str, eco: str | None
+) -> bool:
+    scheme = versioning.get_scheme(scheme_name)
+    if adv.is_range_style:
+        for v in list(adv.vulnerable_versions) + list(adv.patched_versions):
+            if v == "":
+                return True
+        npm_mode = scheme.name == "npm"
+        try:
+            ver = scheme.parse(version)
+        except ParseError:
+            return False
+        matched = True
+        if adv.vulnerable_versions:
+            try:
+                c = versioning.Constraints(
+                    scheme, " || ".join(adv.vulnerable_versions), npm_mode
+                )
+                matched = c.check(ver)
+            except ParseError as e:
+                _log.warn("constraint error", err=str(e))
+                return False
+            if not matched:
+                return False
+        secure = list(adv.patched_versions) + list(adv.unaffected_versions)
+        if not secure:
+            return matched
+        try:
+            c = versioning.Constraints(scheme, " || ".join(secure), npm_mode)
+            return not c.check(ver)
+        except ParseError as e:
+            _log.warn("constraint error", err=str(e))
+            return False
+
+    # OS-style advisory
+    try:
+        ver = scheme.parse(version)
+    except ParseError as e:
+        _log.debug("failed to parse installed version", version=version, err=str(e))
+        return False
+    if adv.affected_version:
+        try:
+            affected = scheme.parse(adv.affected_version)
+        except ParseError:
+            return False
+        if scheme.compare_parsed(affected, ver) > 0:
+            return False
+    if not adv.fixed_version:
+        return True  # unfixed vulnerability
+    try:
+        fixed = scheme.parse(adv.fixed_version)
+    except ParseError as e:
+        _log.debug("failed to parse fixed version",
+                   version=adv.fixed_version, err=str(e))
+        return False
+    return scheme.compare_parsed(ver, fixed) < 0

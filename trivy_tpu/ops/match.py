@@ -1,0 +1,218 @@
+"""Batched name-hash join + version-interval containment kernel.
+
+The TPU replacement for the reference's per-package bucket-get loop
+(reference pkg/detector/ospkg/detect.go:66, pkg/detector/library/
+driver.go:115-142): one jitted kernel evaluates a whole artifact batch
+against the resident advisory tensors.
+
+Algorithm (all int32/uint32, XLA-friendly, no dynamic shapes):
+  1. vectorized binary search of each package's h1 in the sorted row_h1
+     (jnp.searchsorted lowers to an O(log N) while loop on TPU)
+  2. gather a fixed window of `W` consecutive rows per package
+  3. hit = (h1,h2 equal) AND (lo_rank <= pkg_rank <= hi_rank
+                              OR row NEEDS_HOST OR pkg NEEDS_HOST)
+  4. emit the advisory id per hit (-1 otherwise); the host compresses and
+     rescreens candidates with the exact comparators.
+
+Sharding: the DB rows are the big tensor, so they shard over the "db" mesh
+axis (each shard carries a W-row halo from its right neighbour so windows
+never straddle a boundary); packages shard over "data". Every device
+computes its (data, db) block independently — a pure map, no collectives
+needed until the host-side gather, exactly the layout SURVEY.md §2.10
+prescribes for ICI-friendly scaling.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from trivy_tpu.tensorize.compile import CompiledDB, PackageBatch
+
+FLAG_NEEDS_HOST = 1
+
+
+@dataclass
+class DeviceDB:
+    """Advisory row tensors resident on device (HBM)."""
+
+    h1: jax.Array  # uint32[N]
+    h2: jax.Array  # uint32[N]
+    lo: jax.Array  # int32[N]
+    hi: jax.Array  # int32[N]
+    flags: jax.Array  # int32[N]
+    adv: jax.Array  # int32[N]
+    n_rows: int
+    window: int
+
+    @classmethod
+    def from_compiled(cls, cdb: CompiledDB, device=None) -> "DeviceDB":
+        put = functools.partial(jax.device_put, device=device)
+        return cls(
+            h1=put(cdb.row_h1),
+            h2=put(cdb.row_h2),
+            lo=put(cdb.row_lo),
+            hi=put(cdb.row_hi),
+            flags=put(cdb.row_flags),
+            adv=put(cdb.row_adv),
+            n_rows=cdb.n_rows,
+            window=cdb.window,
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def _match_kernel(
+    row_h1, row_h2, row_lo, row_hi, row_flags, row_adv,
+    pkg_h1, pkg_h2, pkg_rank, pkg_flags, *, window: int
+):
+    """-> int32[B, window]: advisory id per hit, -1 elsewhere."""
+    n = row_h1.shape[0]
+    start = jnp.searchsorted(row_h1, pkg_h1, side="left").astype(jnp.int32)
+    offs = start[:, None] + jnp.arange(window, dtype=jnp.int32)[None, :]
+    in_bounds = offs < n
+    idx = jnp.minimum(offs, n - 1)
+    rh1 = row_h1[idx]
+    rh2 = row_h2[idx]
+    rlo = row_lo[idx]
+    rhi = row_hi[idx]
+    rfl = row_flags[idx]
+    radv = row_adv[idx]
+    name_eq = in_bounds & (rh1 == pkg_h1[:, None]) & (rh2 == pkg_h2[:, None])
+    rank = pkg_rank[:, None]
+    in_iv = (rlo <= rank) & (rank <= rhi)
+    host = ((rfl & FLAG_NEEDS_HOST) != 0) | ((pkg_flags[:, None] & FLAG_NEEDS_HOST) != 0)
+    hit = name_eq & (in_iv | host)
+    return jnp.where(hit, radv, jnp.int32(-1))
+
+
+def match_batch(ddb: DeviceDB, batch: PackageBatch) -> np.ndarray:
+    """Single-device match -> int32[B, W] advisory ids (-1 = no hit)."""
+    if ddb.n_rows == 0 or len(batch.h1) == 0:
+        return np.full((len(batch.h1), ddb.window), -1, dtype=np.int32)
+    out = _match_kernel(
+        ddb.h1, ddb.h2, ddb.lo, ddb.hi, ddb.flags, ddb.adv,
+        jnp.asarray(batch.h1), jnp.asarray(batch.h2),
+        jnp.asarray(batch.rank), jnp.asarray(batch.flags),
+        window=ddb.window,
+    )
+    return np.asarray(out)
+
+
+# --------------------------------------------------------------- sharded
+
+
+@dataclass
+class ShardedDB:
+    """DB rows split into `n_db` halo-padded shards, laid out [n_db, S]
+    and sharded over the mesh "db" axis."""
+
+    h1: jax.Array  # uint32[D, S]
+    h2: jax.Array
+    lo: jax.Array
+    hi: jax.Array
+    flags: jax.Array
+    adv: jax.Array
+    mesh: Mesh
+    window: int
+    shard_len: int
+
+    @classmethod
+    def from_compiled(cls, cdb: CompiledDB, mesh: Mesh) -> "ShardedDB":
+        n_db = mesh.shape["db"]
+        w = cdb.window
+        n = cdb.n_rows
+        shard_len = -(-max(n, 1) // n_db) + w  # ceil + halo
+        def shard(arr, fill):
+            out = np.full((n_db, shard_len), fill, dtype=arr.dtype)
+            base = -(-max(n, 1) // n_db)
+            for d in range(n_db):
+                lo_i = d * base
+                hi_i = min(lo_i + shard_len, n)
+                if lo_i < n:
+                    out[d, : hi_i - lo_i] = arr[lo_i:hi_i]
+            return out
+        # pad rows with h1=0xffffffff so searchsorted lands before padding
+        # and name_eq fails on it (no real hash is all-ones with h2 ones too)
+        pad_h1 = np.uint32(0xFFFFFFFF)
+        sharded = cls(
+            h1=None, h2=None, lo=None, hi=None, flags=None, adv=None,
+            mesh=mesh, window=w, shard_len=shard_len,
+        )
+        spec = NamedSharding(mesh, P("db", None))
+        sharded.h1 = jax.device_put(shard(cdb.row_h1, pad_h1), spec)
+        sharded.h2 = jax.device_put(shard(cdb.row_h2, pad_h1), spec)
+        sharded.lo = jax.device_put(shard(cdb.row_lo, 0), spec)
+        sharded.hi = jax.device_put(shard(cdb.row_hi, -1), spec)
+        sharded.flags = jax.device_put(shard(cdb.row_flags, 0), spec)
+        sharded.adv = jax.device_put(shard(cdb.row_adv, -1), spec)
+        return sharded
+
+
+@functools.partial(jax.jit, static_argnames=("window", "mesh"))
+def _sharded_match(
+    row_h1, row_h2, row_lo, row_hi, row_flags, row_adv,
+    pkg_h1, pkg_h2, pkg_rank, pkg_flags, *, window: int, mesh: Mesh
+):
+    """DB sharded over "db", packages sharded over "data".
+    -> int32[n_db, B, W] stacked per-shard hits (host dedupes the halo)."""
+
+    def local(rh1, rh2, rlo, rhi, rfl, radv, ph1, ph2, prank, pflags):
+        out = _match_kernel(
+            rh1[0], rh2[0], rlo[0], rhi[0], rfl[0], radv[0],
+            ph1, ph2, prank, pflags, window=window,
+        )
+        return out[None]  # [1, b_local, W]
+
+    from jax import shard_map
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P("db", None), P("db", None), P("db", None),
+            P("db", None), P("db", None), P("db", None),
+            P("data"), P("data"), P("data"), P("data"),
+        ),
+        out_specs=P("db", "data", None),
+    )(
+        row_h1, row_h2, row_lo, row_hi, row_flags, row_adv,
+        pkg_h1, pkg_h2, pkg_rank, pkg_flags,
+    )
+
+
+def match_batch_sharded(sdb: ShardedDB, batch: PackageBatch) -> np.ndarray:
+    """Sharded match -> int32[B, n_db * W] advisory ids (-1 = no hit).
+    The batch is padded up to a multiple of the "data" axis size."""
+    n_data = sdb.mesh.shape["data"]
+    b = len(batch.h1)
+    if b == 0:
+        return np.full((0, sdb.mesh.shape["db"] * sdb.window), -1, np.int32)
+    pad = (-b) % n_data
+    def padded(a, fill):
+        return np.concatenate([a, np.full(pad, fill, a.dtype)]) if pad else a
+    spec = NamedSharding(sdb.mesh, P("data"))
+    ph1 = jax.device_put(padded(batch.h1, np.uint32(0xFFFFFFFF)), spec)
+    ph2 = jax.device_put(padded(batch.h2, np.uint32(0xFFFFFFFF)), spec)
+    prank = jax.device_put(padded(batch.rank, np.int32(0)), spec)
+    pflags = jax.device_put(padded(batch.flags, np.int32(0)), spec)
+    out = _sharded_match(
+        sdb.h1, sdb.h2, sdb.lo, sdb.hi, sdb.flags, sdb.adv,
+        ph1, ph2, prank, pflags, window=sdb.window, mesh=sdb.mesh,
+    )
+    out = np.asarray(out)  # [n_db, B+pad, W]
+    out = np.moveaxis(out, 0, 1).reshape(out.shape[1], -1)  # [B+pad, n_db*W]
+    return out[:b]
+
+
+def collect_candidates(hits: np.ndarray) -> list[list[int]]:
+    """[B, K] advisory-id matrix -> per-package sorted unique id lists."""
+    out: list[list[int]] = []
+    for row in hits:
+        ids = row[row >= 0]
+        out.append(sorted(set(int(x) for x in ids)))
+    return out

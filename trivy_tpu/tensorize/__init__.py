@@ -1,0 +1,3 @@
+from trivy_tpu.tensorize.compile import CompiledDB, PackageBatch, compile_db
+
+__all__ = ["CompiledDB", "PackageBatch", "compile_db"]

@@ -1,0 +1,337 @@
+"""DB tensorization: advisory buckets -> dense join + interval tensors.
+
+This is the heart of the TPU design (SURVEY.md §7 step 2). Host-side, once
+per DB load:
+
+1. Every advisory is compiled to a union of version intervals over its
+   scheme's total order (constraint algebra from trivy_tpu.versioning).
+2. All interval boundary versions are encoded to fixed-width byte keys and
+   sorted per scheme -> boundary table B_s. Interval bounds become *scaled
+   ranks*: a version v ranks s = 2*searchsorted(B, key(v)) + (key(v) in B),
+   so `lo_rank <= s <= hi_rank` is an exact containment test using nothing
+   but int32 compares — all the device ever does.
+3. Rows are sorted by (h1, h2) of the (match-space, package-name) join key;
+   the kernel binary-searches h1 and gathers a fixed window.
+
+Anything that cannot be encoded exactly (unparseable/overflow versions,
+un-intervalable constraints) gets FLAG_NEEDS_HOST: the kernel emits such
+rows as candidates whenever the name matches, and the host rescreen applies
+the exact comparators — zero-diff by construction.
+
+Names with more than `window` rows are evicted to a host-side fallback map
+(tested: rare; e.g. "linux" in Debian).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from trivy_tpu import versioning
+from trivy_tpu.db.model import Advisory
+from trivy_tpu.db.store import AdvisoryDB
+from trivy_tpu.log import logger
+from trivy_tpu.utils.hashing import join_key
+from trivy_tpu.versioning import Constraints
+from trivy_tpu.versioning.base import KEY_BYTES, ParseError
+
+FLAG_NEEDS_HOST = 1
+
+INT32_MAX = np.int32(2**31 - 1)
+
+_log = logger("tensorize")
+
+
+def _rank_of(bounds: np.ndarray | None, key: bytes) -> int:
+    """Scaled rank of an encoded key in a sorted S-dtype boundary table.
+    NB: numpy S-dtype strips trailing NULs, so equality must compare the
+    stripped forms (ordering via searchsorted is unaffected: shorter
+    strings compare as NUL-padded)."""
+    if bounds is None or len(bounds) == 0:
+        return 0
+    i = int(np.searchsorted(bounds, np.bytes_(key), side="left"))
+    eq = i < len(bounds) and bytes(bounds[i]) == key.rstrip(b"\x00")
+    return 2 * i + (1 if eq else 0)
+
+
+def space_of_bucket(bucket: str) -> tuple[str, str] | None:
+    """bucket -> (space key, scheme name), or None if not matchable.
+
+    Language buckets "eco::source" all share the space "eco::" (prefix
+    lookup semantics, reference pkg/detector/library/driver.go:115-124).
+    OS buckets "<family> <release>" are their own space."""
+    if "::" in bucket:
+        eco = bucket.split("::", 1)[0]
+        name = versioning.ECOSYSTEM_SCHEME.get(eco)
+        return (f"{eco}::", name) if name else None
+    family = bucket.rsplit(" ", 1)[0] if " " in bucket else bucket
+    name = versioning.OS_SCHEME.get(family)
+    return (bucket, name) if name else None
+
+
+@dataclass
+class _Row:
+    h1: int
+    h2: int
+    lo_key: bytes | None  # None = unbounded
+    lo_incl: bool
+    hi_key: bytes | None
+    hi_incl: bool
+    scheme: str
+    flags: int
+    adv_idx: int
+
+
+@dataclass
+class PackageBatch:
+    """Device-ready encoding of a batch of (space, name, version) queries."""
+
+    h1: np.ndarray  # uint32[B]
+    h2: np.ndarray  # uint32[B]
+    rank: np.ndarray  # int32[B]
+    flags: np.ndarray  # int32[B]
+    queries: list  # original (space, name, version, scheme_name)
+
+
+@dataclass
+class CompiledDB:
+    # row tensors, sorted by (h1, h2)
+    row_h1: np.ndarray  # uint32[N]
+    row_h2: np.ndarray  # uint32[N]
+    row_lo: np.ndarray  # int32[N] scaled rank
+    row_hi: np.ndarray  # int32[N]
+    row_flags: np.ndarray  # int32[N]
+    row_adv: np.ndarray  # int32[N] -> index into advisories
+    # per-scheme sorted boundary keys (S-dtype byte strings)
+    boundaries: dict[str, np.ndarray]
+    # flat advisory list: (bucket, pkg_name, Advisory)
+    advisories: list[tuple[str, str, Advisory]]
+    # names too hot for the window: (space, name) -> list[adv_idx]
+    host_fallback: dict[tuple[str, str], list[int]]
+    window: int
+    stats: dict = field(default_factory=dict)
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.row_h1)
+
+    def rank_of_key(self, scheme_name: str, key: bytes) -> int:
+        """Scaled rank of an encoded version key within a scheme's boundary
+        table (see module docstring)."""
+        return _rank_of(self.boundaries.get(scheme_name), key)
+
+    def encode_packages(self, queries: list) -> PackageBatch:
+        """queries: [(space, name, version, scheme_name)] -> PackageBatch."""
+        n = len(queries)
+        h1 = np.zeros(n, dtype=np.uint32)
+        h2 = np.zeros(n, dtype=np.uint32)
+        rank = np.zeros(n, dtype=np.int32)
+        flags = np.zeros(n, dtype=np.int32)
+        for i, (space, name, version, scheme_name) in enumerate(queries):
+            a, b = join_key(space, name)
+            h1[i], h2[i] = a, b
+            scheme = versioning.get_scheme(scheme_name)
+            key, exact = scheme.key(version)
+            rank[i] = self.rank_of_key(scheme_name, key)
+            if not exact:
+                flags[i] |= FLAG_NEEDS_HOST
+        return PackageBatch(h1, h2, rank, flags, queries)
+
+
+def _advisory_intervals(
+    adv: Advisory, scheme_name: str, eco: str | None
+) -> list[tuple] | None:
+    """-> [(lo_str|None, lo_incl, hi_str|None, hi_incl)] or None for
+    needs-host (unparseable / always-candidate)."""
+    scheme = versioning.get_scheme(scheme_name)
+    if adv.is_range_style:
+        # empty string in vulnerable/patched => always vulnerable
+        # (reference compare.go:23-27)
+        for v in list(adv.vulnerable_versions) + list(adv.patched_versions):
+            if v == "":
+                return [(None, True, None, True)]
+        npm_mode = scheme.name == "npm"
+        try:
+            if adv.vulnerable_versions:
+                vuln = Constraints(
+                    scheme, " || ".join(adv.vulnerable_versions), npm_mode
+                ).intervals()
+            else:
+                vuln = [versioning.Interval()]
+            secure_exprs = list(adv.patched_versions) + list(adv.unaffected_versions)
+            if secure_exprs:
+                secure = Constraints(
+                    scheme, " || ".join(secure_exprs), npm_mode
+                ).intervals()
+                vuln = _subtract(vuln, secure, scheme)
+        except ParseError:
+            return None
+        return [(_vs(iv.lo), iv.lo_incl, _vs(iv.hi), iv.hi_incl) for iv in vuln]
+    # OS style: [affected, fixed) — no fixed version => unbounded above
+    lo = adv.affected_version or None
+    hi = adv.fixed_version or None
+    return [(lo, True, hi, False)]
+
+
+def _vs(parsed) -> str | None:
+    if parsed is None:
+        return None
+    raw = getattr(parsed, "raw", None)
+    return raw if raw is not None else str(parsed)
+
+
+def _subtract(vuln: list, secure: list, scheme) -> list:
+    """Union-of-intervals subtraction: vuln minus secure."""
+    from trivy_tpu.versioning.constraints import Interval
+
+    out = list(vuln)
+    for s in secure:
+        nxt = []
+        for v in out:
+            # part of v below s
+            if s.lo is not None:
+                below = Interval(v.lo, v.lo_incl, s.lo, not s.lo_incl)
+                lo_ok = v.lo is None or scheme.compare_parsed(v.lo, s.lo) < 0 or (
+                    scheme.compare_parsed(v.lo, s.lo) == 0
+                    and v.lo_incl
+                    and not s.lo_incl
+                )
+                if lo_ok and not below.is_empty(scheme):
+                    nxt.append(below)
+            # part of v above s
+            if s.hi is not None:
+                above = Interval(s.hi, not s.hi_incl, v.hi, v.hi_incl)
+                hi_ok = v.hi is None or scheme.compare_parsed(v.hi, s.hi) > 0 or (
+                    scheme.compare_parsed(v.hi, s.hi) == 0
+                    and v.hi_incl
+                    and not s.hi_incl
+                )
+                if hi_ok and not above.is_empty(scheme):
+                    nxt.append(above)
+        out = nxt
+        if not out:
+            break
+    return out
+
+
+def compile_db(db: AdvisoryDB, window: int = 128) -> CompiledDB:
+    advisories: list[tuple[str, str, Advisory]] = []
+    raw_rows: list[dict] = []
+    boundary_keys: dict[str, set] = {}
+    n_host_rows = 0
+
+    for bucket, pkgs in db.buckets.items():
+        resolved = space_of_bucket(bucket)
+        if resolved is None:
+            _log.debug("bucket not matchable, skipping", bucket=bucket)
+            continue
+        space, scheme_name = resolved
+        scheme = versioning.get_scheme(scheme_name)
+        eco = bucket.split("::", 1)[0] if "::" in bucket else None
+        for name, advs in pkgs.items():
+            h1, h2 = join_key(space, name)
+            for adv in advs:
+                adv_idx = len(advisories)
+                advisories.append((bucket, name, adv))
+                ivs = _advisory_intervals(adv, scheme_name, eco)
+                if ivs is None:
+                    raw_rows.append(dict(
+                        h1=h1, h2=h2, space=space, name=name,
+                        lo_key=None, hi_key=None, lo_incl=True, hi_incl=True,
+                        scheme=scheme_name, flags=FLAG_NEEDS_HOST, adv=adv_idx,
+                    ))
+                    n_host_rows += 1
+                    continue
+                for lo_str, lo_incl, hi_str, hi_incl in ivs:
+                    flags = 0
+                    lo_key = hi_key = None
+                    if lo_str is not None:
+                        lo_key, exact = scheme.key(lo_str)
+                        if not exact:
+                            flags |= FLAG_NEEDS_HOST
+                    if hi_str is not None:
+                        hi_key, exact = scheme.key(hi_str)
+                        if not exact:
+                            flags |= FLAG_NEEDS_HOST
+                    if flags & FLAG_NEEDS_HOST:
+                        n_host_rows += 1
+                        lo_key = hi_key = None
+                    else:
+                        ks = boundary_keys.setdefault(scheme_name, set())
+                        if lo_key is not None:
+                            ks.add(lo_key)
+                        if hi_key is not None:
+                            ks.add(hi_key)
+                    raw_rows.append(dict(
+                        h1=h1, h2=h2, space=space, name=name,
+                        lo_key=lo_key, hi_key=hi_key,
+                        lo_incl=lo_incl, hi_incl=hi_incl,
+                        scheme=scheme_name, flags=flags, adv=adv_idx,
+                    ))
+
+    # boundary tables
+    boundaries = {
+        s: np.sort(np.array(sorted(keys), dtype=f"S{KEY_BYTES}"))
+        for s, keys in boundary_keys.items()
+    }
+
+    def rank_of(scheme_name: str, key: bytes) -> int:
+        return _rank_of(boundaries.get(scheme_name), key)
+
+    # evict names with too many rows to the host fallback
+    from collections import Counter, defaultdict
+
+    # count per h1 alone: the kernel's window starts at the first h1 match,
+    # so h1-colliding names share one window and must be evicted together
+    counts = Counter(r["h1"] for r in raw_rows)
+    host_fallback: dict[tuple[str, str], list[int]] = defaultdict(list)
+    kept: list[dict] = []
+    for r in raw_rows:
+        if counts[r["h1"]] > window:
+            host_fallback[(r["space"], r["name"])].append(r["adv"])
+            continue
+        kept.append(r)
+    # dedupe fallback advisory ids (multi-interval advisories)
+    host_fallback = {
+        k: sorted(set(v)) for k, v in host_fallback.items()
+    }
+
+    kept.sort(key=lambda r: (r["h1"], r["h2"]))
+    n = len(kept)
+    row_h1 = np.zeros(n, dtype=np.uint32)
+    row_h2 = np.zeros(n, dtype=np.uint32)
+    row_lo = np.zeros(n, dtype=np.int32)
+    row_hi = np.zeros(n, dtype=np.int32)
+    row_flags = np.zeros(n, dtype=np.int32)
+    row_adv = np.zeros(n, dtype=np.int32)
+    for i, r in enumerate(kept):
+        row_h1[i], row_h2[i] = r["h1"], r["h2"]
+        row_flags[i], row_adv[i] = r["flags"], r["adv"]
+        if r["flags"] & FLAG_NEEDS_HOST:
+            row_lo[i], row_hi[i] = 0, INT32_MAX
+            continue
+        if r["lo_key"] is None:
+            row_lo[i] = 0
+        else:
+            a = rank_of(r["scheme"], r["lo_key"])
+            row_lo[i] = a if r["lo_incl"] else a + 1
+        if r["hi_key"] is None:
+            row_hi[i] = INT32_MAX
+        else:
+            b = rank_of(r["scheme"], r["hi_key"])
+            row_hi[i] = b if r["hi_incl"] else b - 1
+    stats = {
+        "rows": n,
+        "advisories": len(advisories),
+        "host_rows": n_host_rows,
+        "fallback_names": len(host_fallback),
+        "boundary_keys": {s: len(b) for s, b in boundaries.items()},
+    }
+    _log.info("compiled advisory DB", **stats)
+    return CompiledDB(
+        row_h1=row_h1, row_h2=row_h2, row_lo=row_lo, row_hi=row_hi,
+        row_flags=row_flags, row_adv=row_adv,
+        boundaries=boundaries, advisories=advisories,
+        host_fallback=dict(host_fallback), window=window, stats=stats,
+    )

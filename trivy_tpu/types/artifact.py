@@ -259,16 +259,22 @@ class OS(JSONMixin):
         return bool(self.family)
 
     def merge(self, other: "OS") -> "OS":
-        """Later (upper-layer) detection wins, but keep extended flags
-        (reference pkg/fanal/types/os.go Merge semantics)."""
-        if not other.detected:
+        """Layer-merge semantics (reference pkg/fanal/types/artifact.go:38-68):
+        earlier detection wins (fill-empty only), EXCEPT a detected
+        redhat/debian family is fully replaced — OLE ships
+        /etc/redhat-release and Ubuntu ships Debian files, so the more
+        specific later file must override. Extended (ESM) is sticky."""
+        if not other.detected and not other.name:
             return self
-        out = OS(family=other.family or self.family, name=other.name or self.name)
-        out.extended = self.extended or other.extended
-        # OS-release in upper layers may hold a more specific variant
-        if self.family and other.family and self.family != other.family:
-            out.family = other.family
-        return out
+        if self.family in ("redhat", "debian"):
+            return OS(family=other.family, name=other.name,
+                      eosl=other.eosl, extended=other.extended)
+        return OS(
+            family=self.family or other.family,
+            name=self.name or other.name,
+            eosl=self.eosl,
+            extended=self.extended or other.extended,
+        )
 
     def to_dict(self) -> dict:
         out: dict[str, Any] = {"Family": self.family, "Name": self.name}

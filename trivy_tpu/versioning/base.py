@@ -17,7 +17,10 @@ match kernel emits it as an always-candidate for exact host rescreen
 
 from __future__ import annotations
 
-KEY_GROUPS = 14  # tokens per key
+# 24 groups comfortably covers real distro versions (e.g. debian
+# "1.1.1k-1+deb11u2" = 18 tokens); keys are host-side only — the device
+# sees int32 ranks — so width costs nothing on TPU
+KEY_GROUPS = 24  # tokens per key
 GROUP_BYTES = 8  # 1 tag byte + 7 payload bytes
 KEY_BYTES = KEY_GROUPS * GROUP_BYTES
 

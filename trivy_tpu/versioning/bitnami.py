@@ -20,11 +20,12 @@ TAG_NUM = 0x30
 
 
 class BitnamiVersion:
-    __slots__ = ("nums", "rev")
+    __slots__ = ("nums", "rev", "raw")
 
-    def __init__(self, nums: tuple, rev: int):
+    def __init__(self, nums: tuple, rev: int, raw: str = ""):
         self.nums = nums
         self.rev = rev
+        self.raw = raw
 
     def num(self, i: int) -> int:
         return self.nums[i] if i < len(self.nums) else 0
@@ -34,11 +35,12 @@ class BitnamiScheme(Scheme):
     name = "bitnami"
 
     def parse(self, s: str) -> BitnamiVersion:
-        m = _RX.match(s.strip())
+        s = s.strip()
+        m = _RX.match(s)
         if not m:
             raise ParseError(f"invalid bitnami version {s!r}")
         nums = tuple(int(x) for x in m.group("nums").split("."))
-        return BitnamiVersion(nums, int(m.group("rev") or 0))
+        return BitnamiVersion(nums, int(m.group("rev") or 0), s)
 
     def compare_parsed(self, a: BitnamiVersion, b: BitnamiVersion) -> int:
         for i in range(max(len(a.nums), len(b.nums))):

@@ -22,10 +22,10 @@ from dataclasses import dataclass
 
 from trivy_tpu.versioning.base import ParseError, Scheme
 
-_OPS = ("==", ">=", "<=", "!=", "~>", "=", ">", "<", "~", "^")
+_OPS = ("~=", "==", ">=", "<=", "!=", "~>", "=", ">", "<", "~", "^")
 
 _COMP_RX = re.compile(
-    r"\s*(?P<op>==|>=|<=|!=|~>|=|>|<|~|\^)?\s*(?P<ver>[^\s,|]+)"
+    r"\s*(?P<op>~=|==|>=|<=|!=|~>|=|>|<|~|\^)?\s*(?P<ver>[^\s,|]+)"
 )
 
 
@@ -112,6 +112,10 @@ class Constraints:
     # -------------------------------------------------- parsing
 
     def _parse_group(self, expr: str) -> list[Comparator]:
+        if expr == "*" and not self.npm_mode:
+            # the reference's generic comparer rejects a bare '*' constraint
+            # (aquasecurity/go-version errors -> not vulnerable)
+            raise ParseError("invalid constraint '*'")
         if not expr or expr == "*":
             return [Comparator("", "*", [Interval()], None)]
         # npm hyphen range: "1.2.3 - 2.0.0"
@@ -170,16 +174,26 @@ class Constraints:
         """Lowest concrete version matching a possibly-partial/wildcard one."""
         return self._mk(self._nums_of(s))
 
+    def _block_floor(self, nums: list[int]) -> object:
+        """Smallest version carrying the given release prefix. For PEP 440
+        that includes pre-releases ("1.5.dev0" < "1.5a1" < "1.5"), matching
+        the reference's prefix-match semantics for '==1.5.*'."""
+        if self.scheme.name == "pep440" and nums:
+            return self.scheme.parse(
+                ".".join(str(n) for n in nums) + ".dev0"
+            )
+        return self._mk(nums)
+
     def _bump(self, nums: list[int]) -> object | None:
         """Smallest version above the wildcard block: bump last given seg."""
         if not nums:
             return None  # "*": unbounded
-        return self._mk(nums[:-1] + [nums[-1] + 1])
+        return self._block_floor(nums[:-1] + [nums[-1] + 1])
 
     def _wildcard_interval(self, s: str) -> Interval:
         nums = self._nums_of(s)
         hi = self._bump(nums)
-        return Interval(self._mk(nums), True, hi, False)
+        return Interval(self._block_floor(nums), True, hi, False)
 
     def _pre_core(self, ver_str: str):
         v = None
@@ -199,6 +213,8 @@ class Constraints:
 
         if op in ("", "=", "=="):
             if ver_str in ("*", "x", "X"):
+                if not self.npm_mode and self.scheme.name != "pep440":
+                    raise ParseError("invalid constraint '*'")
                 return Comparator(op, ver_str, [Interval()], None)
             if wildcard:
                 return Comparator(op, ver_str, [self._wildcard_interval(ver_str)], None)
@@ -235,6 +251,10 @@ class Constraints:
                 return Comparator(op, ver_str, [Interval(None, True, iv.hi, False)], None)
             return Comparator(op, ver_str,
                               [Interval(None, True, scheme.parse(ver_str), True)], pre_core)
+        if op == "~=":
+            # PEP 440 compatible release: ~=2.2 -> >=2.2,<3.0;
+            # ~=1.4.5 -> >=1.4.5,<1.5.0 (bump second-to-last)
+            return self._tilde("~>", ver_str, pre_core)
         if op in ("~", "~>"):
             return self._tilde(op, ver_str, pre_core)
         if op == "^":

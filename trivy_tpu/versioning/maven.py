@@ -181,17 +181,25 @@ _SIMPLE = re.compile(r"^v?(?P<nums>\d+(\.\d+)*)(?P<rest>[.\-a-z0-9]*)$", re.I)
 _CHAIN_EL = re.compile(r"[0-9]+|[a-z]+", re.I)
 
 
+class MavenVersion:
+    __slots__ = ("cv", "raw")
+
+    def __init__(self, cv, raw: str):
+        self.cv = cv
+        self.raw = raw
+
+
 class MavenScheme(Scheme):
     name = "maven"
 
-    def parse(self, s: str):
+    def parse(self, s: str) -> MavenVersion:
         s = s.strip()
         if not s:
             raise ParseError("empty maven version")
-        return parse_cv(s)
+        return MavenVersion(parse_cv(s), s)
 
-    def compare_parsed(self, a, b) -> int:
-        return _cmp_items(a, b)
+    def compare_parsed(self, a: MavenVersion, b: MavenVersion) -> int:
+        return _cmp_items(a.cv, b.cv)
 
     def tokens(self, s: str):
         s0 = s.strip().lower()

@@ -34,24 +34,6 @@ class GemVersion:
     def is_prerelease(self) -> bool:
         return any(isinstance(s, str) for s in self.segments)
 
-    def release(self) -> "GemVersion":
-        """Segments up to the first string segment (Gem::Version#release)."""
-        out = []
-        for s in self.segments:
-            if isinstance(s, str):
-                break
-            out.append(s)
-        return GemVersion(tuple(out), self.raw)
-
-    def bump(self) -> "GemVersion":
-        """Gem::Version#bump: drop trailing segment of release, +1 last."""
-        segs = [s for s in self.release().segments]
-        if len(segs) > 1:
-            segs.pop()
-        segs[-1] += 1
-        return GemVersion(tuple(segs), self.raw)
-
-
 def _canonical(segments: list) -> tuple:
     # trailing zero segments never affect comparison
     while segments and segments[-1] == 0:
