@@ -1,0 +1,248 @@
+"""End-to-end SBOM scan: fixture DB + CycloneDX/SPDX files -> CLI -> JSON
+report, golden-compared (the reference's integration-test strategy,
+SURVEY.md §4, applied to the §3.5 sbom path)."""
+
+import json
+import os
+
+import pytest
+
+from trivy_tpu.cli.main import main
+from trivy_tpu.db import Advisory, AdvisoryDB, VulnerabilityMeta
+from trivy_tpu.db.model import DataSourceInfo
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+UPDATE = os.environ.get("UPDATE_GOLDEN") == "1"
+
+CDX_DOC = {
+    "bomFormat": "CycloneDX",
+    "specVersion": "1.5",
+    "metadata": {
+        "component": {
+            "bom-ref": "root",
+            "type": "container",
+            "name": "test-image:1.0",
+            "properties": [
+                {"name": "aquasecurity:trivy:ImageID", "value": "sha256:abc123"},
+                {"name": "aquasecurity:trivy:RepoTag", "value": "test-image:1.0"},
+            ],
+        }
+    },
+    "components": [
+        {
+            "bom-ref": "os",
+            "type": "operating-system",
+            "name": "alpine",
+            "version": "3.16.0",
+        },
+        {
+            "bom-ref": "pkg-musl",
+            "type": "library",
+            "name": "musl",
+            "version": "1.2.3-r0",
+            "purl": "pkg:apk/alpine/musl@1.2.3-r0?distro=3.16.0",
+        },
+        {
+            "bom-ref": "pkg-busybox",
+            "type": "library",
+            "name": "busybox",
+            "version": "1.35.0-r15",
+            "purl": "pkg:apk/alpine/busybox@1.35.0-r15?distro=3.16.0",
+        },
+        {
+            "bom-ref": "app-lock",
+            "type": "application",
+            "name": "app/package-lock.json",
+            "properties": [
+                {"name": "aquasecurity:trivy:Type", "value": "npm"},
+                {"name": "aquasecurity:trivy:FilePath", "value": "app/package-lock.json"},
+            ],
+        },
+        {
+            "bom-ref": "pkg-lodash",
+            "type": "library",
+            "name": "lodash",
+            "version": "4.17.4",
+            "purl": "pkg:npm/lodash@4.17.4",
+        },
+        {
+            "bom-ref": "pkg-requests",
+            "type": "library",
+            "name": "requests",
+            "version": "2.19.0",
+            "purl": "pkg:pypi/requests@2.19.0",
+        },
+    ],
+    "dependencies": [
+        {"ref": "root", "dependsOn": ["os", "app-lock"]},
+        {"ref": "app-lock", "dependsOn": ["pkg-lodash"]},
+    ],
+}
+
+
+def _fixture_db() -> AdvisoryDB:
+    db = AdvisoryDB()
+    ds = DataSourceInfo(id="alpine", name="Alpine Secdb",
+                        url="https://secdb.alpinelinux.org/")
+    db.put_advisory("alpine 3.16", "musl", Advisory(
+        vulnerability_id="CVE-2024-0001", fixed_version="1.2.4-r0",
+        data_source=ds,
+    ))
+    db.put_advisory("alpine 3.16", "busybox", Advisory(
+        vulnerability_id="CVE-2022-30065", fixed_version="1.35.0-r17",
+        data_source=ds,
+    ))
+    db.put_advisory("alpine 3.16", "busybox", Advisory(
+        vulnerability_id="CVE-2000-0000", fixed_version="1.0.0-r0",
+        data_source=ds,  # already fixed: must NOT match
+    ))
+    ghsa = DataSourceInfo(id="ghsa", name="GitHub Security Advisory npm",
+                          url="https://github.com/advisories")
+    db.put_advisory("npm::GitHub Security Advisory Npm", "lodash", Advisory(
+        vulnerability_id="CVE-2019-10744",
+        vulnerable_versions=["<4.17.12"], patched_versions=[">=4.17.12"],
+        data_source=ghsa,
+    ))
+    db.put_advisory("pip::GitHub Security Advisory Pip", "requests", Advisory(
+        vulnerability_id="CVE-2018-18074",
+        vulnerable_versions=["<=2.19.1"], patched_versions=[">=2.20.0"],
+        data_source=DataSourceInfo(id="ghsa", name="GitHub Security Advisory Pip",
+                                   url="https://github.com/advisories"),
+    ))
+    db.put_meta(VulnerabilityMeta(
+        id="CVE-2019-10744", title="Prototype Pollution in lodash",
+        description="Versions of lodash lower than 4.17.12 are vulnerable to "
+        "Prototype Pollution.",
+        severity="CRITICAL",
+        cwe_ids=["CWE-1321"],
+        references=["https://github.com/lodash/lodash/pull/4336"],
+    ))
+    db.put_meta(VulnerabilityMeta(
+        id="CVE-2022-30065", title="busybox: A use-after-free in Busybox",
+        severity="HIGH", vendor_severity={"nvd": 3, "alpine": 2},
+    ))
+    db.put_meta(VulnerabilityMeta(
+        id="CVE-2018-18074", title="Insufficiently Protected Credentials",
+        severity="HIGH",
+    ))
+    return db
+
+
+@pytest.fixture()
+def env(tmp_path, monkeypatch):
+    db = _fixture_db()
+    db_path = tmp_path / "db"
+    db.save(str(db_path))
+    sbom_path = tmp_path / "bom.json"
+    sbom_path.write_text(json.dumps(CDX_DOC))
+    monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2024-01-01T00:00:00+00:00")
+    monkeypatch.setenv("TRIVY_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    # reset the process-level engine cache between tests
+    from trivy_tpu.cli import run as run_mod
+
+    run_mod._ENGINE_CACHE.clear()
+    return {"db": str(db_path), "sbom": str(sbom_path), "tmp": tmp_path}
+
+
+def _golden_check(name: str, text: str):
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    path = os.path.join(GOLDEN_DIR, name)
+    if UPDATE or not os.path.exists(path):
+        with open(path, "w") as f:
+            f.write(text)
+        if not UPDATE:
+            pytest.skip(f"golden file {name} created; re-run to compare")
+    with open(path) as f:
+        assert text == f.read(), f"golden mismatch: {name} (UPDATE_GOLDEN=1 to refresh)"
+
+
+def test_sbom_scan_json_golden(env, capsys):
+    rc = main([
+        "sbom", env["sbom"], "--format", "json",
+        "--db-path", env["db"], "--cache-dir", str(env["tmp"] / "cache"),
+        "--quiet",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    # structural assertions independent of golden
+    assert doc["ArtifactName"] == "test-image:1.0"
+    assert doc["Metadata"]["OS"] == {"Family": "alpine", "Name": "3.16.0"}
+    classes = {r["Class"]: r for r in doc["Results"]}
+    os_vulns = {v["VulnerabilityID"] for v in classes["os-pkgs"]["Vulnerabilities"]}
+    assert os_vulns == {"CVE-2024-0001", "CVE-2022-30065"}
+    lang = [r for r in doc["Results"] if r["Class"] == "lang-pkgs"]
+    by_target = {r["Target"]: r for r in lang}
+    assert "app/package-lock.json" in by_target
+    lodash = by_target["app/package-lock.json"]["Vulnerabilities"][0]
+    assert lodash["VulnerabilityID"] == "CVE-2019-10744"
+    assert lodash["Severity"] == "CRITICAL"
+    assert lodash["FixedVersion"] == ">=4.17.12"
+    # orphan python pkg aggregates under "Python"
+    assert "Python" in by_target
+    _golden_check("sbom_cdx.json.golden", out)
+
+
+def test_sbom_scan_table(env, capsys):
+    rc = main([
+        "sbom", env["sbom"], "--format", "table",
+        "--db-path", env["db"], "--cache-dir", str(env["tmp"] / "cache"),
+        "--quiet",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "CVE-2019-10744" in out
+    assert "lodash" in out
+    assert "Total: 2" in out  # os-pkgs result
+
+
+def test_sbom_severity_filter_and_exit_code(env, capsys):
+    rc = main([
+        "sbom", env["sbom"], "--format", "json",
+        "--db-path", env["db"], "--cache-dir", str(env["tmp"] / "cache"),
+        "--severity", "CRITICAL", "--exit-code", "5", "--quiet",
+    ])
+    assert rc == 5
+    doc = json.loads(capsys.readouterr().out)
+    all_sevs = {
+        v["Severity"]
+        for r in doc["Results"]
+        for v in r.get("Vulnerabilities", [])
+    }
+    assert all_sevs <= {"CRITICAL"}
+
+
+def test_sbom_no_tpu_parity(env, capsys):
+    """--no-tpu (host oracle) must produce the identical report."""
+    rc = main([
+        "sbom", env["sbom"], "--format", "json",
+        "--db-path", env["db"], "--cache-dir", str(env["tmp"] / "cache"),
+        "--quiet",
+    ])
+    assert rc == 0
+    with_tpu = capsys.readouterr().out
+    from trivy_tpu.cli import run as run_mod
+
+    run_mod._ENGINE_CACHE.clear()
+    rc = main([
+        "sbom", env["sbom"], "--format", "json", "--no-tpu",
+        "--db-path", env["db"], "--cache-dir", str(env["tmp"] / "cache"),
+        "--quiet",
+    ])
+    assert rc == 0
+    without_tpu = capsys.readouterr().out
+    assert with_tpu == without_tpu
+
+
+def test_convert_roundtrip(env, tmp_path, capsys):
+    report_path = str(tmp_path / "report.json")
+    rc = main([
+        "sbom", env["sbom"], "--format", "json", "--output", report_path,
+        "--db-path", env["db"], "--cache-dir", str(env["tmp"] / "cache"),
+        "--quiet",
+    ])
+    assert rc == 0
+    rc = main(["convert", "--format", "table", report_path, "--quiet"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "CVE-2019-10744" in out

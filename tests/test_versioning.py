@@ -189,6 +189,21 @@ def test_key_property_random(scheme_name):
     assert checked > pairs // 2
 
 
+def _contains(iv_tuple, pv, scheme):
+    """Containment for _advisory_intervals' (lo, lo_incl, hi, hi_incl)
+    string-boundary tuples."""
+    lo, lo_incl, hi, hi_incl = iv_tuple
+    if lo is not None:
+        d = scheme.compare_parsed(pv, scheme.parse(lo))
+        if d < 0 or (d == 0 and not lo_incl):
+            return False
+    if hi is not None:
+        d = scheme.compare_parsed(pv, scheme.parse(hi))
+        if d > 0 or (d == 0 and not hi_incl):
+            return False
+    return True
+
+
 class TestConstraints:
     def check(self, eco, expr, version):
         return versioning.parse_constraints(eco, expr).check_str(version)
@@ -236,6 +251,101 @@ class TestConstraints:
         assert self.check("maven", ">=1.0.0, <2.0.0", "1.5")
         assert not self.check("maven", ">=1.0.0, <2.0.0", "2.0.0.RELEASE")
         assert self.check("maven", "<2.13.4.1", "2.13.4")
+
+    def test_intervals_exact_for_release_versions(self):
+        """For non-pre-release versions, intervals() must EQUAL check()
+        (the kernel skips the host rescreen on exact hits)."""
+        rng = random.Random(99)
+        cases = [
+            ("go", ">=1.0.0, <1.2.0 || >2.0.0"),
+            ("go", "<2.0.0"),
+            ("npm", "^1.2.3 || ~0.4.0"),
+            ("npm", ">=1.0.0 <1.5.0, !=1.2.3"),
+            ("pip", ">=1.0, <2.0, !=1.5"),
+            ("pip", "~=1.4.2"),
+            ("rubygems", "~> 2.2"),
+            ("maven", ">=1.0, <2.0"),
+            ("nuget", ">=3.0.1, <3.1.0"),
+        ]
+        for eco, expr in cases:
+            c = versioning.parse_constraints(eco, expr)
+            ivs = c.intervals()
+            scheme = c.scheme
+            for _ in range(300):
+                v = ".".join(str(rng.randint(0, 4)) for _ in range(3))
+                pv = scheme.parse(v)
+                in_iv = any(iv.contains(pv, scheme) for iv in ivs)
+                assert in_iv == c.check(pv), f"{eco} {expr} {v}"
+
+    def test_advisory_interval_subtraction_exact(self):
+        """Compiled advisory intervals (vulnerable minus patched) must
+        equal the exact per-advisory check for release versions."""
+        from trivy_tpu.db.model import Advisory
+        from trivy_tpu.detector.exact import AdvisoryChecker
+        from trivy_tpu.tensorize.compile import _advisory_intervals
+
+        rng = random.Random(5)
+        advisories = [
+            Advisory(vulnerable_versions=["<2.0.0"], patched_versions=[">=3.0.0"]),
+            Advisory(vulnerable_versions=["<3.0.0"], patched_versions=[">=1.5.0"]),
+            Advisory(vulnerable_versions=[">=1.0.0, <4.0.0"],
+                     unaffected_versions=[">=2.0.0, <2.5.0"]),
+            Advisory(vulnerable_versions=["<4.0.0 || >=6.0.0"],
+                     patched_versions=[">=3.0.0, <5.0.0"]),
+        ]
+        scheme = versioning.get_scheme("generic")
+        for adv in advisories:
+            ivs, extra = _advisory_intervals(adv, "generic", "go")
+            assert extra == 0
+            checker = AdvisoryChecker(adv, "generic")
+            for _ in range(400):
+                v = ".".join(str(rng.randint(0, 7)) for _ in range(3))
+                pv = scheme.parse(v)
+                in_iv = any(
+                    _contains(iv, pv, scheme) for iv in ivs
+                )
+                assert in_iv == checker.check_parsed(pv), (adv, v)
+
+    def test_npm_prerelease_secure_subtraction_flagged(self):
+        """npm advisory with secure ranges: the compiled intervals must stay
+        the UNSUBTRACTED vulnerable hull with a rescreen flag — subtracting
+        would lose pre-release versions the npm rule still matches."""
+        from trivy_tpu.db.model import Advisory
+        from trivy_tpu.detector.exact import AdvisoryChecker
+        from trivy_tpu.tensorize.compile import FLAG_RESCREEN, _advisory_intervals
+
+        adv = Advisory(
+            vulnerable_versions=["<2.0.0-beta.3"],
+            patched_versions=[">=1.9.5"],
+        )
+        checker = AdvisoryChecker(adv, "npm")
+        assert checker.check("2.0.0-alpha.5")  # npm rule: not "patched"
+        ivs, extra = _advisory_intervals(adv, "npm", "npm")
+        assert extra == FLAG_RESCREEN
+        scheme = versioning.get_scheme("npm")
+        pv = scheme.parse("2.0.0-alpha.5")
+        assert any(_contains(iv, pv, scheme) for iv in ivs)
+
+    def test_npm_prerelease_secure_end_to_end(self):
+        """The device path must find the pre-release npm match the oracle
+        finds (regression: interval subtraction lost it)."""
+        from trivy_tpu.db import Advisory, AdvisoryDB
+        from trivy_tpu.detector.engine import MatchEngine, PkgQuery
+
+        db = AdvisoryDB()
+        db.put_advisory("npm::g", "widget", Advisory(
+            vulnerability_id="CVE-X",
+            vulnerable_versions=["<2.0.0-beta.3"],
+            patched_versions=[">=1.9.5"],
+        ))
+        engine = MatchEngine(db, window=8)
+        q = [PkgQuery("npm::", "widget", "2.0.0-alpha.5", "npm"),
+             PkgQuery("npm::", "widget", "1.9.6", "npm"),
+             PkgQuery("npm::", "widget", "1.0.0", "npm")]
+        oracle = engine.oracle_detect(q)
+        device = engine.detect(q)
+        assert [r.adv_indices for r in oracle] == [[0], [], [0]]
+        assert [r.adv_indices for r in device] == [[0], [], [0]]
 
     def test_intervals_cover_check(self):
         """intervals() must be a superset of check() (kernel safety)."""

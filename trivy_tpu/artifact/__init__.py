@@ -1,0 +1,3 @@
+from trivy_tpu.artifact.base import Artifact, ArtifactReference
+
+__all__ = ["Artifact", "ArtifactReference"]

@@ -1,0 +1,8 @@
+from trivy_tpu.cache.cache import (
+    ArtifactCache,
+    FSCache,
+    MemoryCache,
+    cache_key,
+)
+
+__all__ = ["ArtifactCache", "FSCache", "MemoryCache", "cache_key"]

@@ -1,0 +1,149 @@
+"""Analysis-result cache.
+
+Interfaces mirror the reference (pkg/cache/cache.go:16-43):
+- ArtifactCache (write): put_artifact / put_blob / missing_blobs
+- LocalArtifactCache (read): get_artifact / get_blob
+Backends: in-memory and filesystem JSON (the reference's BoltDB fs cache,
+pkg/cache/fs.go, re-expressed as one JSON file per key). The cache IS the
+checkpoint/resume mechanism: blob keys are content+analyzer-version hashes,
+so re-scans skip unchanged layers (reference pkg/cache/key.go:19-69).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import asdict
+
+from trivy_tpu.types.artifact import ArtifactInfo, BlobInfo
+
+
+def cache_key(
+    base: str,
+    analyzer_versions: dict[str, int] | None = None,
+    hook_versions: dict[str, int] | None = None,
+    skip_files: list[str] | None = None,
+    skip_dirs: list[str] | None = None,
+    patterns: list[str] | None = None,
+    policy: list[str] | None = None,
+) -> str:
+    """Derive a cache key from a base ID + everything that can change the
+    analysis result (reference pkg/cache/key.go:19-69)."""
+    h = hashlib.sha256()
+    payload = {
+        "artifact": base,
+        "analyzerVersions": analyzer_versions or {},
+        "hookVersions": hook_versions or {},
+        "skipFiles": skip_files or [],
+        "skipDirs": skip_dirs or [],
+        "patterns": patterns or [],
+        "policy": policy or [],
+    }
+    h.update(json.dumps(payload, sort_keys=True).encode())
+    return "sha256:" + h.hexdigest()
+
+
+class MemoryCache:
+    """reference pkg/cache/memory.go"""
+
+    def __init__(self):
+        self._artifacts: dict[str, dict] = {}
+        self._blobs: dict[str, dict] = {}
+
+    # write (ArtifactCache)
+    def put_artifact(self, artifact_id: str, info: ArtifactInfo | dict) -> None:
+        self._artifacts[artifact_id] = _as_dict(info)
+
+    def put_blob(self, blob_id: str, blob: BlobInfo | dict) -> None:
+        self._blobs[blob_id] = _as_dict(blob)
+
+    def missing_blobs(self, artifact_id: str, blob_ids: list[str]):
+        missing_artifact = artifact_id not in self._artifacts
+        missing = [b for b in blob_ids if b not in self._blobs]
+        return missing_artifact, missing
+
+    # read (LocalArtifactCache)
+    def get_artifact(self, artifact_id: str) -> dict:
+        return self._artifacts.get(artifact_id, {})
+
+    def get_blob(self, blob_id: str) -> dict:
+        return self._blobs.get(blob_id, {})
+
+    def delete_blobs(self, blob_ids: list[str]) -> None:
+        for b in blob_ids:
+            self._blobs.pop(b, None)
+
+    def clear(self) -> None:
+        self._artifacts.clear()
+        self._blobs.clear()
+
+    def close(self) -> None:
+        pass
+
+
+class FSCache(MemoryCache):
+    """Filesystem-backed cache under <root>/fanal (one JSON per key),
+    mirroring the role of the reference's BoltDB file cache."""
+
+    def __init__(self, root: str):
+        super().__init__()
+        self.root = os.path.join(root, "fanal")
+        os.makedirs(os.path.join(self.root, "artifact"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, "blob"), exist_ok=True)
+
+    def _path(self, bucket: str, key: str) -> str:
+        safe = key.replace("/", "_").replace(":", "_")
+        return os.path.join(self.root, bucket, safe + ".json")
+
+    def put_artifact(self, artifact_id: str, info) -> None:
+        with open(self._path("artifact", artifact_id), "w") as f:
+            json.dump(_as_dict(info), f)
+
+    def put_blob(self, blob_id: str, blob) -> None:
+        with open(self._path("blob", blob_id), "w") as f:
+            json.dump(_as_dict(blob), f)
+
+    def missing_blobs(self, artifact_id: str, blob_ids: list[str]):
+        missing_artifact = not os.path.exists(self._path("artifact", artifact_id))
+        missing = [
+            b for b in blob_ids if not os.path.exists(self._path("blob", b))
+        ]
+        return missing_artifact, missing
+
+    def get_artifact(self, artifact_id: str) -> dict:
+        return self._read("artifact", artifact_id)
+
+    def get_blob(self, blob_id: str) -> dict:
+        return self._read("blob", blob_id)
+
+    def _read(self, bucket: str, key: str) -> dict:
+        p = self._path(bucket, key)
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def delete_blobs(self, blob_ids: list[str]) -> None:
+        for b in blob_ids:
+            p = self._path("blob", b)
+            if os.path.exists(p):
+                os.unlink(p)
+
+    def clear(self) -> None:
+        import shutil
+
+        shutil.rmtree(self.root, ignore_errors=True)
+        os.makedirs(os.path.join(self.root, "artifact"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, "blob"), exist_ok=True)
+
+
+ArtifactCache = MemoryCache  # interface alias
+
+
+def _as_dict(obj) -> dict:
+    if isinstance(obj, dict):
+        return obj
+    # dataclass blobs serialize structurally (not report-JSON): keep all
+    # fields so the applier round-trips exactly
+    return asdict(obj)

@@ -1,0 +1,167 @@
+"""trivy-tpu CLI (reference cmd/trivy + pkg/commands/app.go re-expressed
+with argparse; same subcommand surface, TPU engine underneath)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import trivy_tpu
+from trivy_tpu import log
+
+
+def _add_global_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--debug", action="store_true", help="debug logging")
+    p.add_argument("--quiet", "-q", action="store_true", help="suppress logs")
+    p.add_argument(
+        "--cache-dir",
+        default=os.environ.get(
+            "TRIVY_TPU_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache", "trivy-tpu"),
+        ),
+        help="cache directory",
+    )
+
+
+def _add_scan_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--format", "-f", default="table",
+                   help="output format (table,json,sarif,cyclonedx,spdx-json,github,template)")
+    p.add_argument("--output", "-o", default=None, help="output file")
+    p.add_argument("--template", "-t", default=None, help="go-style template path/string")
+    p.add_argument("--severity", "-s", default=None,
+                   help="comma-separated severities (UNKNOWN,LOW,MEDIUM,HIGH,CRITICAL)")
+    p.add_argument("--scanners", default="vuln,secret",
+                   help="comma-separated scanners (vuln,misconfig,secret,license)")
+    p.add_argument("--pkg-types", default="os,library",
+                   help="comma-separated package types (os,library)")
+    p.add_argument("--db-path", default=None,
+                   help="advisory DB directory (default <cache>/db)")
+    p.add_argument("--skip-db-update", action="store_true")
+    p.add_argument("--offline-scan", action="store_true")
+    p.add_argument("--list-all-pkgs", action="store_true")
+    p.add_argument("--ignorefile", default=".trivyignore")
+    p.add_argument("--ignore-status", default=None,
+                   help="comma-separated statuses to ignore")
+    p.add_argument("--exit-code", type=int, default=0)
+    p.add_argument("--exit-on-eol", type=int, default=0)
+    p.add_argument("--no-tpu", action="store_true",
+                   help="run matching on host instead of the TPU kernel")
+    p.add_argument("--parallel", type=int, default=5,
+                   help="number of parallel analysis workers")
+    p.add_argument("--server", default=None,
+                   help="scan server URL (client mode)")
+    p.add_argument("--token", default=None, help="server auth token")
+    p.add_argument("--skip-files", action="append", default=[])
+    p.add_argument("--skip-dirs", action="append", default=[])
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="trivy-tpu",
+        description="TPU-native security scanner (artifact -> vulnerabilities, "
+        "secrets, misconfigurations, licenses)",
+    )
+    _add_global_flags(parser)
+    sub = parser.add_subparsers(dest="command")
+
+    for name, help_text, with_target in [
+        ("image", "scan a container image (tar archive or registry ref)", True),
+        ("filesystem", "scan a local filesystem directory", True),
+        ("fs", "alias of filesystem", True),
+        ("rootfs", "scan a root filesystem", True),
+        ("repository", "scan a git repository", True),
+        ("repo", "alias of repository", True),
+        ("sbom", "scan an SBOM file (CycloneDX/SPDX json)", True),
+        ("vm", "scan a VM image", True),
+    ]:
+        p = sub.add_parser(name, help=help_text)
+        _add_global_flags(p)
+        _add_scan_flags(p)
+        if name == "image":
+            p.add_argument("--input", default=None,
+                           help="image tar archive path")
+            p.add_argument("target", nargs="?", default=None)
+        else:
+            p.add_argument("target")
+
+    p = sub.add_parser("convert", help="convert a saved JSON report")
+    _add_global_flags(p)
+    p.add_argument("--format", "-f", default="table")
+    p.add_argument("--output", "-o", default=None)
+    p.add_argument("--template", "-t", default=None)
+    p.add_argument("--severity", "-s", default=None)
+    p.add_argument("report")
+
+    p = sub.add_parser("server", help="run the scan server")
+    _add_global_flags(p)
+    p.add_argument("--listen", default="localhost:4954")
+    p.add_argument("--token", default=None)
+    p.add_argument("--db-path", default=None)
+    p.add_argument("--no-tpu", action="store_true")
+
+    p = sub.add_parser("db", help="advisory DB operations")
+    _add_global_flags(p)
+    dbsub = p.add_subparsers(dest="db_command")
+    pi = dbsub.add_parser("import", help="import advisories from a JSON dump")
+    pi.add_argument("source")
+    pi.add_argument("--db-path", default=None)
+    ps = dbsub.add_parser("stats", help="show DB statistics")
+    ps.add_argument("--db-path", default=None)
+
+    p = sub.add_parser("clean", help="clean caches")
+    _add_global_flags(p)
+    p.add_argument("--all", action="store_true")
+
+    p = sub.add_parser("config", help="scan config files for misconfigurations")
+    _add_global_flags(p)
+    _add_scan_flags(p)
+    p.add_argument("target")
+
+    sub.add_parser("version", help="print version")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    log.init(debug=getattr(args, "debug", False),
+             quiet=getattr(args, "quiet", False))
+
+    if args.command in (None, "version"):
+        if args.command is None:
+            parser.print_help()
+            return 0
+        print(f"Version: {trivy_tpu.__version__}")
+        return 0
+
+    from trivy_tpu.cli import run
+
+    try:
+        if args.command in ("image", "filesystem", "fs", "rootfs",
+                            "repository", "repo", "sbom", "vm", "config"):
+            return run.run_scan(args)
+        if args.command == "convert":
+            return run.run_convert(args)
+        if args.command == "server":
+            return run.run_server(args)
+        if args.command == "db":
+            return run.run_db(args)
+        if args.command == "clean":
+            return run.run_clean(args)
+    except run.FatalError as e:
+        log.logger().error(str(e))
+        return 1
+    except FileNotFoundError as e:
+        log.logger().error(f"file not found: {e.filename or e}")
+        return 1
+    except (ValueError, OSError) as e:
+        log.logger().error(str(e))
+        return 1
+    parser.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

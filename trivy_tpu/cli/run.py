@@ -1,0 +1,320 @@
+"""Scan runner: DB bootstrap -> scanner selection -> scan -> filter ->
+report -> exit code (reference pkg/commands/artifact/run.go Runner)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from trivy_tpu.log import logger
+from trivy_tpu.types.enums import Scanner as ScannerEnum, Severity
+from trivy_tpu.types.scan import ScanOptions
+
+_log = logger()
+
+
+class FatalError(Exception):
+    pass
+
+
+def _severities(arg: str | None) -> list[Severity] | None:
+    if not arg:
+        return None
+    return [Severity.parse(s) for s in arg.split(",") if s.strip()]
+
+
+def _db_path(args) -> str:
+    return getattr(args, "db_path", None) or os.path.join(
+        args.cache_dir, "db"
+    )
+
+
+def _load_db(args):
+    from trivy_tpu.db.store import AdvisoryDB
+
+    path = _db_path(args)
+    try:
+        db = AdvisoryDB.load(path)
+        _log.info("advisory DB loaded", path=path, **db.stats())
+        return db
+    except FileNotFoundError:
+        _log.warn(
+            "no advisory DB found; vulnerability results will be empty "
+            "(import one with `trivy-tpu db import`)", path=path,
+        )
+        return AdvisoryDB()
+
+
+_ENGINE_CACHE: dict = {}
+
+
+def build_engine(args):
+    """MatchEngine, cached per db-path within the process."""
+    from trivy_tpu.detector.engine import MatchEngine
+
+    key = (_db_path(args), getattr(args, "no_tpu", False))
+    if key not in _ENGINE_CACHE:
+        db = _load_db(args)
+        _ENGINE_CACHE[key] = MatchEngine(
+            db, use_device=not getattr(args, "no_tpu", False)
+        )
+    return _ENGINE_CACHE[key]
+
+
+def make_scan_options(args) -> ScanOptions:
+    scanners = [ScannerEnum(s) for s in args.scanners.split(",") if s]
+    return ScanOptions(
+        pkg_types=args.pkg_types.split(","),
+        scanners=scanners,
+        list_all_pkgs=args.list_all_pkgs,
+    )
+
+
+def run_scan(args) -> int:
+    from trivy_tpu.cache.cache import FSCache
+    from trivy_tpu.result.filter import filter_report
+    from trivy_tpu.result.ignore import load_ignore_file
+    from trivy_tpu.report.writer import write_report
+    from trivy_tpu.scanner.scan import Scanner
+
+    cache = FSCache(args.cache_dir)
+    artifact, driver = _select_scanner(args, cache)
+    scanner = Scanner(driver, artifact)
+    report = scanner.scan_artifact(make_scan_options(args))
+
+    severities = _severities(args.severity)
+    ignore_cfg = load_ignore_file(args.ignorefile)
+    statuses = (args.ignore_status or "").split(",") if args.ignore_status else None
+    filter_report(report, severities=severities, ignore_statuses=statuses,
+                  ignore_config=ignore_cfg)
+
+    write_report(report, fmt=args.format, output=args.output,
+                 template=args.template, severities=severities)
+
+    # exit-code policy (reference pkg/commands/operation/operation.go:118)
+    if args.exit_code:
+        for res in report.results:
+            if not res.is_empty:
+                return args.exit_code
+    if args.exit_on_eol and report.metadata.os and report.metadata.os.eosl:
+        return args.exit_on_eol
+    return 0
+
+
+def _select_scanner(args, cache):
+    """reference pkg/commands/artifact/scanner.go: artifact kind x
+    standalone/client -> (artifact, driver)."""
+    if getattr(args, "server", None):
+        from trivy_tpu.rpc.client import RemoteDriver
+
+        driver = RemoteDriver(args.server, token=args.token)
+    else:
+        from trivy_tpu.scanner.local import LocalDriver
+
+        driver = LocalDriver(build_engine(args), cache)
+
+    cmd = args.command
+    if cmd == "sbom":
+        from trivy_tpu.artifact.sbom import SBOMArtifact
+
+        return SBOMArtifact(args.target, cache), driver
+    if cmd in ("filesystem", "fs", "rootfs", "config"):
+        from trivy_tpu.artifact.local_fs import FSArtifact
+
+        return FSArtifact(
+            args.target, cache,
+            skip_files=args.skip_files, skip_dirs=args.skip_dirs,
+            as_rootfs=(cmd == "rootfs"),
+            misconfig_only=(cmd == "config"),
+            parallel=args.parallel,
+        ), driver
+    if cmd in ("repository", "repo"):
+        from trivy_tpu.artifact.repo import RepoArtifact
+
+        return RepoArtifact(
+            args.target, cache,
+            skip_files=args.skip_files, skip_dirs=args.skip_dirs,
+            parallel=args.parallel,
+        ), driver
+    if cmd == "image":
+        from trivy_tpu.artifact.image import ImageArtifact
+
+        target = getattr(args, "input", None) or args.target
+        if target is None:
+            raise FatalError("image target or --input required")
+        return ImageArtifact(
+            target, cache, from_tar=bool(getattr(args, "input", None)),
+            parallel=args.parallel,
+        ), driver
+    raise FatalError(f"unsupported scan command {cmd!r}")
+
+
+def run_convert(args) -> int:
+    import json
+
+    from trivy_tpu.report.writer import write_report
+    from trivy_tpu.result.filter import filter_report
+    from trivy_tpu.types.report import Report
+
+    with open(args.report) as f:
+        doc = json.load(f)
+    report = _report_from_json(doc)
+    severities = _severities(args.severity)
+    if severities:
+        filter_report(report, severities=severities)
+    write_report(report, fmt=args.format, output=args.output,
+                 template=args.template, severities=severities)
+    return 0
+
+
+def _report_from_json(doc: dict):
+    """Rebuild a Report (subset) from its JSON rendering for `convert`."""
+    from trivy_tpu.types import report as R
+    from trivy_tpu.types.artifact import OS, PkgIdentifier, Layer
+    from trivy_tpu.types.enums import Status
+
+    rep = R.Report(
+        schema_version=doc.get("SchemaVersion", 2),
+        created_at=doc.get("CreatedAt", ""),
+        artifact_name=doc.get("ArtifactName", ""),
+        artifact_type=doc.get("ArtifactType", ""),
+    )
+    md = doc.get("Metadata") or {}
+    rep.metadata = R.Metadata(
+        size=md.get("Size", 0),
+        os=OS(family=md.get("OS", {}).get("Family", ""),
+              name=md.get("OS", {}).get("Name", ""))
+        if md.get("OS") else None,
+        image_id=md.get("ImageID", ""),
+        diff_ids=md.get("DiffIDs", []) or [],
+        repo_tags=md.get("RepoTags", []) or [],
+        repo_digests=md.get("RepoDigests", []) or [],
+    )
+    for rdoc in doc.get("Results") or []:
+        res = R.Result(
+            target=rdoc.get("Target", ""),
+            result_class=rdoc.get("Class", ""),
+            type=rdoc.get("Type", ""),
+        )
+        for v in rdoc.get("Vulnerabilities") or []:
+            ident = v.get("PkgIdentifier") or {}
+            res.vulnerabilities.append(R.DetectedVulnerability(
+                vulnerability_id=v.get("VulnerabilityID", ""),
+                vendor_ids=v.get("VendorIDs", []) or [],
+                pkg_id=v.get("PkgID", ""),
+                pkg_name=v.get("PkgName", ""),
+                pkg_path=v.get("PkgPath", ""),
+                pkg_identifier=PkgIdentifier(
+                    purl=ident.get("PURL", ""), uid=ident.get("UID", "")
+                ),
+                installed_version=v.get("InstalledVersion", ""),
+                fixed_version=v.get("FixedVersion", ""),
+                status=Status.parse(v.get("Status", "unknown")),
+                severity_source=v.get("SeveritySource", ""),
+                primary_url=v.get("PrimaryURL", ""),
+                layer=Layer(
+                    digest=(v.get("Layer") or {}).get("Digest", ""),
+                    diff_id=(v.get("Layer") or {}).get("DiffID", ""),
+                ),
+                info=R.VulnerabilityInfo(
+                    title=v.get("Title", ""),
+                    description=v.get("Description", ""),
+                    severity=v.get("Severity", "UNKNOWN"),
+                    cwe_ids=v.get("CweIDs", []) or [],
+                    cvss=v.get("CVSS", {}) or {},
+                    references=v.get("References", []) or [],
+                    published_date=v.get("PublishedDate", ""),
+                    last_modified_date=v.get("LastModifiedDate", ""),
+                    vendor_severity=v.get("VendorSeverity", {}) or {},
+                ),
+            ))
+        for s in rdoc.get("Secrets") or []:
+            res.secrets.append(R.DetectedSecret(
+                rule_id=s.get("RuleID", ""), category=s.get("Category", ""),
+                severity=s.get("Severity", "UNKNOWN"),
+                title=s.get("Title", ""), start_line=s.get("StartLine", 0),
+                end_line=s.get("EndLine", 0), match=s.get("Match", ""),
+            ))
+        for m in rdoc.get("Misconfigurations") or []:
+            res.misconfigurations.append(R.DetectedMisconfiguration(
+                type=m.get("Type", ""), id=m.get("ID", ""),
+                avd_id=m.get("AVDID", ""), title=m.get("Title", ""),
+                description=m.get("Description", ""),
+                message=m.get("Message", ""), namespace=m.get("Namespace", ""),
+                resolution=m.get("Resolution", ""),
+                severity=m.get("Severity", "UNKNOWN"),
+                primary_url=m.get("PrimaryURL", ""),
+                references=m.get("References", []) or [],
+                status=m.get("Status", ""),
+            ))
+        if rdoc.get("MisconfSummary"):
+            res.misconf_summary = R.MisconfSummary(
+                successes=rdoc["MisconfSummary"].get("Successes", 0),
+                failures=rdoc["MisconfSummary"].get("Failures", 0),
+            )
+        rep.results.append(res)
+    return rep
+
+
+def run_server(args) -> int:
+    from trivy_tpu.rpc.server import serve
+
+    engine = build_engine(args)
+    host, _, port = args.listen.partition(":")
+    serve(engine, host=host or "localhost", port=int(port or 4954),
+          token=args.token)
+    return 0
+
+
+def run_db(args) -> int:
+    from trivy_tpu.db.store import AdvisoryDB
+
+    if args.db_command == "import":
+        db = AdvisoryDB.load(args.source) if os.path.isdir(args.source) else _import_json(args.source)
+        path = getattr(args, "db_path", None) or os.path.join(args.cache_dir, "db")
+        db.save(path)
+        _log.info("imported advisory DB", path=path, **db.stats())
+        return 0
+    if args.db_command == "stats":
+        path = getattr(args, "db_path", None) or os.path.join(args.cache_dir, "db")
+        db = AdvisoryDB.load(path)
+        import json as _json
+
+        print(_json.dumps(db.stats(), indent=2))
+        return 0
+    raise FatalError("usage: trivy-tpu db {import,stats}")
+
+
+def _import_json(path: str):
+    """Import a flat JSON advisory dump: {"buckets": {...}, "vulnerability":
+    {...}} (same shape the store persists)."""
+    import gzip
+    import json
+
+    from trivy_tpu.db.model import Advisory, VulnerabilityMeta
+    from trivy_tpu.db.store import AdvisoryDB
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        blob = json.loads(f.read())
+    db = AdvisoryDB()
+    for bucket, pkgs in blob.get("buckets", {}).items():
+        for name, advs in pkgs.items():
+            for a in advs:
+                db.put_advisory(bucket, name, Advisory.from_json(a))
+    for vid, m in blob.get("vulnerability", {}).items():
+        db.put_meta(VulnerabilityMeta.from_json(vid, m))
+    return db
+
+
+def run_clean(args) -> int:
+    import shutil
+
+    if args.all:
+        shutil.rmtree(args.cache_dir, ignore_errors=True)
+        _log.info("removed cache", path=args.cache_dir)
+    else:
+        shutil.rmtree(os.path.join(args.cache_dir, "fanal"),
+                      ignore_errors=True)
+        _log.info("removed scan cache")
+    return 0

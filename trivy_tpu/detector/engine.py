@@ -17,10 +17,12 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from trivy_tpu.db.store import AdvisoryDB
-from trivy_tpu.detector.exact import advisory_matches
+from trivy_tpu.detector.exact import AdvisoryChecker
 from trivy_tpu.log import logger
 from trivy_tpu.tensorize.compile import CompiledDB, compile_db, space_of_bucket
 from trivy_tpu.utils.hashing import join_key
+from trivy_tpu.versioning import get_scheme
+from trivy_tpu.versioning.base import ParseError
 
 _log = logger("engine")
 
@@ -51,7 +53,7 @@ class MatchEngine:
     def __init__(
         self,
         db: AdvisoryDB,
-        window: int = 128,
+        window: int | None = None,
         mesh=None,
         use_device: bool = True,
     ):
@@ -62,6 +64,10 @@ class MatchEngine:
         self._ddb = None
         self._sdb = None
         self.rescreen_stats = {"candidates": 0, "confirmed": 0}
+        # lazy per-advisory compiled checkers + parsed-version memo
+        self._checkers: dict[int, AdvisoryChecker] = {}
+        self._row_space: list[str | None] | None = None
+        self._parse_cache: dict[tuple[str, str], object] = {}
         if use_device:
             from trivy_tpu.ops import match as m
 
@@ -77,6 +83,40 @@ class MatchEngine:
 
     def _eco_of_space(self, space: str) -> str | None:
         return space[:-2] if space.endswith("::") else None
+
+    def _checker(self, adv_idx: int) -> AdvisoryChecker | None:
+        ch = self._checkers.get(adv_idx)
+        if ch is None:
+            bucket, _name, adv = self.cdb.advisories[adv_idx]
+            resolved = space_of_bucket(bucket)
+            if resolved is None:
+                return None
+            ch = AdvisoryChecker(adv, resolved[1])
+            self._checkers[adv_idx] = ch
+        return ch
+
+    def _space_of_adv(self, adv_idx: int) -> str | None:
+        if self._row_space is None:
+            self._row_space = [None] * len(self.cdb.advisories)
+        s = self._row_space[adv_idx]
+        if s is None:
+            bucket = self.cdb.advisories[adv_idx][0]
+            resolved = space_of_bucket(bucket)
+            s = resolved[0] if resolved else ""
+            self._row_space[adv_idx] = s
+        return s
+
+    def _parse_version(self, scheme_name: str, version: str):
+        """-> parsed version or None; memoized."""
+        key = (scheme_name, version)
+        if key in self._parse_cache:
+            return self._parse_cache[key]
+        try:
+            v = get_scheme(scheme_name).parse(version)
+        except ParseError:
+            v = None
+        self._parse_cache[key] = v
+        return v
 
     # ------------------------------------------------------------ oracle
 
@@ -94,10 +134,18 @@ class MatchEngine:
         out = []
         for q in queries:
             hits = []
+            ver = self._parse_version(q.scheme_name, q.version)
             for i in index.get((q.space, q.name), []):
-                _bucket, _name, adv = self.cdb.advisories[i]
-                if advisory_matches(adv, q.version, q.scheme_name,
-                                    self._eco_of_space(q.space)):
+                ch = self._checker(i)
+                if ch is None:
+                    continue
+                if ver is None:
+                    # unparseable installed version: only the
+                    # empty-range "always vulnerable" advisories match
+                    if ch.adv.is_range_style and ch.always:
+                        hits.append(i)
+                    continue
+                if ch.check_parsed(ver):
                     hits.append(i)
             out.append(MatchResult(q, sorted(hits)))
         return out
@@ -127,19 +175,38 @@ class MatchEngine:
             # host-fallback names (hot rows evicted from the tensors)
             fb = self.cdb.host_fallback.get((q.space, q.name))
             if fb:
-                cand = sorted(set(cand) | set(fb))
-            eco = self._eco_of_space(q.space)
+                seen = {i for i, _ in cand}
+                cand = sorted(
+                    list(cand) + [(i, True) for i in fb if i not in seen]
+                )
+            ver = None
+            ver_parsed = False
             hits_q = []
-            for i in cand:
-                bucket, name, adv = self.cdb.advisories[i]
+            for i, needs_rescreen in cand:
                 # hash collisions: verify the name/space actually match
-                if name != q.name:
+                if self.cdb.advisories[i][1] != q.name:
                     continue
-                resolved = space_of_bucket(bucket)
-                if resolved is None or resolved[0] != q.space:
+                if self._space_of_adv(i) != q.space:
                     continue
                 n_cand += 1
-                if advisory_matches(adv, q.version, q.scheme_name, eco):
+                if not needs_rescreen:
+                    # exact row + exact pkg encoding: the kernel's interval
+                    # test IS the exact check
+                    hits_q.append(i)
+                    n_conf += 1
+                    continue
+                ch = self._checker(i)
+                if ch is None:
+                    continue
+                if not ver_parsed:
+                    ver = self._parse_version(q.scheme_name, q.version)
+                    ver_parsed = True
+                if ver is None:
+                    if ch.adv.is_range_style and ch.always:
+                        hits_q.append(i)
+                        n_conf += 1
+                    continue
+                if ch.check_parsed(ver):
                     hits_q.append(i)
                     n_conf += 1
             out.append(MatchResult(q, sorted(hits_q)))

@@ -1,0 +1,26 @@
+"""Misconfiguration -> report Result shaping (reference
+pkg/scanner/local/scan.go:371 misconfsToResults)."""
+
+from __future__ import annotations
+
+from trivy_tpu.types.artifact import Misconfiguration
+from trivy_tpu.types.enums import ResultClass
+from trivy_tpu.types.report import MisconfSummary, Result
+
+
+def to_result(misconf: Misconfiguration) -> Result | None:
+    if not misconf.successes and not misconf.failures:
+        return None
+    return Result(
+        target=misconf.file_path,
+        result_class=ResultClass.CONFIG,
+        type=misconf.file_type,
+        misconf_summary=MisconfSummary(
+            successes=len(misconf.successes),
+            failures=len(misconf.failures),
+        ),
+        misconfigurations=sorted(
+            list(misconf.failures) + list(misconf.successes),
+            key=lambda m: m.sort_key(),
+        ),
+    )

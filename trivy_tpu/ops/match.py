@@ -35,6 +35,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from trivy_tpu.tensorize.compile import CompiledDB, PackageBatch
 
 FLAG_NEEDS_HOST = 1
+FLAG_RESCREEN = 2  # pkg-level: interval hit is superset, rescreen needed
+RESCREEN_BIT = 1 << 30  # packed into the emitted advisory id
 
 
 @dataclass
@@ -87,7 +89,18 @@ def _match_kernel(
     in_iv = (rlo <= rank) & (rank <= rhi)
     host = ((rfl & FLAG_NEEDS_HOST) != 0) | ((pkg_flags[:, None] & FLAG_NEEDS_HOST) != 0)
     hit = name_eq & (in_iv | host)
-    return jnp.where(hit, radv, jnp.int32(-1))
+    # pack a "needs exact host rescreen" bit: set for needs-host rows/pkgs,
+    # for rows whose intervals are a superset of the exact check (npm
+    # advisories with secure ranges), and for pkgs whose match semantics
+    # exceed pure intervals (npm pre-release rule). Exact hits skip the
+    # Python rescreen entirely.
+    rescreen = (
+        host
+        | ((rfl & FLAG_RESCREEN) != 0)
+        | ((pkg_flags[:, None] & FLAG_RESCREEN) != 0)
+    )
+    packed = radv + jnp.where(rescreen & (radv >= 0), RESCREEN_BIT, 0)
+    return jnp.where(hit, packed, jnp.int32(-1))
 
 
 def match_batch(ddb: DeviceDB, batch: PackageBatch) -> np.ndarray:
@@ -209,10 +222,26 @@ def match_batch_sharded(sdb: ShardedDB, batch: PackageBatch) -> np.ndarray:
     return out[:b]
 
 
-def collect_candidates(hits: np.ndarray) -> list[list[int]]:
-    """[B, K] advisory-id matrix -> per-package sorted unique id lists."""
-    out: list[list[int]] = []
-    for row in hits:
-        ids = row[row >= 0]
-        out.append(sorted(set(int(x) for x in ids)))
+def collect_candidates(hits: np.ndarray) -> list[list[tuple[int, bool]]]:
+    """[B, K] packed-id matrix -> per-package sorted unique
+    (advisory id, needs_rescreen) lists. An advisory hit by both an exact
+    and a flagged row keeps needs_rescreen=False (the exact hit decides).
+    Vectorized: one nonzero scan over the whole matrix."""
+    rows, cols = np.nonzero(hits >= 0)
+    out: list[list[tuple[int, bool]]] = [[] for _ in range(hits.shape[0])]
+    if len(rows) == 0:
+        return out
+    packed = hits[rows, cols]
+    ids = packed & (RESCREEN_BIT - 1)
+    resc = (packed & RESCREEN_BIT) != 0
+    # sort by (row, id, rescreen) so the exact (False) occurrence of an id
+    # comes first and wins the dedupe
+    order = np.lexsort((resc, ids, rows))
+    rows, ids, resc = rows[order], ids[order], resc[order]
+    prev_r, prev_i = -1, -1
+    for r, i, s in zip(rows.tolist(), ids.tolist(), resc.tolist()):
+        if r == prev_r and i == prev_i:
+            continue
+        out[r].append((i, s))
+        prev_r, prev_i = r, i
     return out

@@ -1,0 +1,3 @@
+from trivy_tpu.report.writer import write_report
+
+__all__ = ["write_report"]

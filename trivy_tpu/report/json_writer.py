@@ -1,0 +1,11 @@
+"""JSON report writer (reference pkg/report JSON format, 2-space indent)."""
+
+from __future__ import annotations
+
+import json
+
+from trivy_tpu.types.report import Report
+
+
+def render_json(report: Report) -> str:
+    return json.dumps(report.to_dict(), indent=2, ensure_ascii=False) + "\n"

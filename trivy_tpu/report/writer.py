@@ -1,0 +1,64 @@
+"""Report writer dispatch (reference pkg/report/writer.go:45-99)."""
+
+from __future__ import annotations
+
+import sys
+
+from trivy_tpu.types.report import Report
+
+FORMATS = ("table", "json", "sarif", "cyclonedx", "spdx-json", "github",
+           "template")
+
+
+def write_report(
+    report: Report,
+    fmt: str = "table",
+    output: str | None = None,
+    template: str | None = None,
+    severities=None,
+) -> None:
+    if fmt == "json":
+        from trivy_tpu.report.json_writer import render_json
+
+        text = render_json(report)
+    elif fmt == "table":
+        from trivy_tpu.report.table import render_table
+
+        text = render_table(report, severities=severities)
+    elif fmt == "sarif":
+        from trivy_tpu.report.sarif import render_sarif
+
+        text = render_sarif(report)
+    elif fmt == "cyclonedx":
+        from trivy_tpu.report.cyclonedx import render_cyclonedx
+
+        text = render_cyclonedx(report)
+    elif fmt == "spdx-json":
+        from trivy_tpu.report.spdx import render_spdx_json
+
+        text = render_spdx_json(report)
+    elif fmt == "github":
+        from trivy_tpu.report.github import render_github
+
+        text = render_github(report)
+    elif fmt == "template":
+        from trivy_tpu.report.template import render_template
+
+        if not template:
+            raise ValueError("--format template requires --template")
+        text = render_template(report, template)
+    else:
+        raise ValueError(f"unknown format {fmt!r} (supported: {FORMATS})")
+
+    if output:
+        with open(output, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+
+
+def read_report_json(path: str) -> dict:
+    import json
+
+    with open(path) as f:
+        return json.load(f)

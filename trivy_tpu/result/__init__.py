@@ -1,0 +1,3 @@
+from trivy_tpu.result.filter import filter_report
+
+__all__ = ["filter_report"]

@@ -1,0 +1,3 @@
+from trivy_tpu.sbom.decode import decode_sbom_file, detect_sbom_format
+
+__all__ = ["decode_sbom_file", "detect_sbom_format"]

@@ -1,0 +1,263 @@
+"""SBOM decoding: CycloneDX / SPDX (JSON) -> BlobInfo.
+
+Model: reference pkg/sbom/io/decode.go —
+- the "operating-system" component becomes OS metadata
+- packages with apk/deb/rpm purls attach to the OS package set
+- language packages group into Applications: under their parent
+  "application" component (lockfile) when referenced by the dependency
+  graph, else aggregated per language type (decode.go addLangPkgs /
+  addOrphanPkgs)
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from trivy_tpu.log import logger
+from trivy_tpu.types.artifact import (
+    Application,
+    BlobInfo,
+    OS,
+    Package,
+    PackageInfo,
+    PkgIdentifier,
+)
+from trivy_tpu.utils.purl import parse_purl, purl_kind
+
+_log = logger("sbom")
+
+
+@dataclass
+class SBOMMeta:
+    artifact_name: str = ""
+    image_id: str = ""
+    repo_tags: list[str] = field(default_factory=list)
+    repo_digests: list[str] = field(default_factory=list)
+    diff_ids: list[str] = field(default_factory=list)
+    artifact_type: str = "cyclonedx"
+
+
+def detect_sbom_format(path: str) -> str | None:
+    """-> "cyclonedx-json" | "spdx-json" | None
+    (reference pkg/sbom/sbom.go format sniffing)."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(4 * 1024 * 1024)
+        doc = json.loads(head)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    if isinstance(doc, dict):
+        if doc.get("bomFormat") == "CycloneDX":
+            return "cyclonedx-json"
+        if "spdxVersion" in doc:
+            return "spdx-json"
+    return None
+
+
+def decode_sbom_file(path: str) -> tuple[BlobInfo, SBOMMeta]:
+    fmt = detect_sbom_format(path)
+    with open(path) as f:
+        doc = json.load(f)
+    if fmt == "cyclonedx-json":
+        return _decode_cyclonedx(doc)
+    if fmt == "spdx-json":
+        return _decode_spdx(doc)
+    raise ValueError(f"unsupported SBOM format: {path}")
+
+
+# ------------------------------------------------------------ CycloneDX
+
+
+def _decode_cyclonedx(doc: dict) -> tuple[BlobInfo, SBOMMeta]:
+    meta = SBOMMeta(artifact_type="cyclonedx")
+    blob = BlobInfo()
+    root = (doc.get("metadata") or {}).get("component") or {}
+    if root:
+        meta.artifact_name = root.get("name", "")
+        for prop in root.get("properties") or []:
+            name, value = prop.get("name", ""), prop.get("value", "")
+            if name == "aquasecurity:trivy:ImageID":
+                meta.image_id = value
+            elif name == "aquasecurity:trivy:RepoDigest":
+                meta.repo_digests.append(value)
+            elif name == "aquasecurity:trivy:RepoTag":
+                meta.repo_tags.append(value)
+            elif name == "aquasecurity:trivy:DiffID":
+                meta.diff_ids.append(value)
+
+    os_info = OS()
+    os_pkgs: list[Package] = []
+    # bom-ref -> lockfile application placeholder
+    apps: dict[str, Application] = {}
+    # bom-ref -> (lang_type, Package)
+    lang_pkgs: dict[str, tuple[str, Package]] = {}
+    counter = [0]
+
+    components = list(doc.get("components") or [])
+    for c in components:
+        ctype = c.get("type", "")
+        ref = c.get("bom-ref") or f"comp-{counter[0]}"
+        counter[0] += 1
+        if ctype == "operating-system":
+            if not os_info.detected:
+                os_info = OS(family=c.get("name", ""), name=c.get("version", ""))
+            continue
+        if ctype == "application":
+            app_type, fpath = _cdx_app_props(c)
+            if app_type:
+                apps[ref] = Application(type=app_type, file_path=fpath)
+                continue
+        pkg, kind, type_str = _component_to_package(c)
+        if pkg is None:
+            continue
+        if kind == "os":
+            os_pkgs.append(pkg)
+        else:
+            lang_pkgs.setdefault(ref, (type_str, pkg))
+
+    # dependency graph: lockfile app -> its packages
+    deps = {
+        d.get("ref"): d.get("dependsOn") or []
+        for d in doc.get("dependencies") or []
+    }
+    placed: set[str] = set()
+    for app_ref, app in apps.items():
+        stack = list(deps.get(app_ref, []))
+        seen = set()
+        while stack:
+            r = stack.pop()
+            if r in seen:
+                continue
+            seen.add(r)
+            if r in lang_pkgs:
+                t, pkg = lang_pkgs[r]
+                app.packages.append(pkg)
+                placed.add(r)
+                stack.extend(deps.get(r, []))
+
+    # orphans aggregate per language type
+    orphan_by_type: dict[str, Application] = {}
+    for ref, (t, pkg) in lang_pkgs.items():
+        if ref in placed:
+            continue
+        orphan_by_type.setdefault(t, Application(type=t)).packages.append(pkg)
+
+    applications = [a for a in apps.values() if a.packages]
+    applications += [orphan_by_type[t] for t in sorted(orphan_by_type)]
+    applications.sort(key=lambda a: (a.type, a.file_path))
+
+    blob.os = os_info
+    if os_pkgs:
+        blob.package_infos = [PackageInfo(packages=os_pkgs)]
+    blob.applications = applications
+    return blob, meta
+
+
+def _cdx_app_props(c: dict) -> tuple[str, str]:
+    app_type = fpath = ""
+    for prop in c.get("properties") or []:
+        if prop.get("name") == "aquasecurity:trivy:Type":
+            app_type = prop.get("value", "")
+        elif prop.get("name") == "aquasecurity:trivy:FilePath":
+            fpath = prop.get("value", "")
+    return app_type, fpath or c.get("name", "")
+
+
+def _component_to_package(c: dict):
+    purl_str = c.get("purl", "")
+    if not purl_str:
+        return None, None, None
+    try:
+        p = parse_purl(purl_str)
+    except ValueError:
+        _log.debug("unparseable purl", purl=purl_str)
+        return None, None, None
+    kind = purl_kind(p)
+    if kind is None:
+        return None, None, None
+    pkg = Package(
+        name=p.full_name,
+        version=c.get("version", p.version),
+        identifier=PkgIdentifier(purl=purl_str, bom_ref=c.get("bom-ref", "")),
+    )
+    if kind[0] == "os":
+        pkg.arch = p.qualifiers.get("arch", "")
+        epoch = p.qualifiers.get("epoch", "")
+        if epoch.isdigit():
+            pkg.epoch = int(epoch)
+            pkg.src_epoch = int(epoch)
+        ver = pkg.version
+        if "-" in ver and p.type in ("deb", "rpm", "apk"):
+            v, _, r = ver.rpartition("-")
+            pkg.version, pkg.release = v, r
+        for prop in c.get("properties") or []:
+            pn, pv = prop.get("name", ""), prop.get("value", "")
+            if pn == "aquasecurity:trivy:SrcName":
+                pkg.src_name = pv
+            elif pn == "aquasecurity:trivy:SrcVersion":
+                pkg.src_version = pv
+            elif pn == "aquasecurity:trivy:SrcRelease":
+                pkg.src_release = pv
+            elif pn == "aquasecurity:trivy:SrcEpoch" and pv.isdigit():
+                pkg.src_epoch = int(pv)
+            elif pn == "aquasecurity:trivy:LayerDiffID":
+                pkg.layer.diff_id = pv
+        if not pkg.src_name:
+            pkg.src_name = pkg.name
+        if not pkg.src_version:
+            pkg.src_version = pkg.version
+            pkg.src_release = pkg.release
+    for prop in c.get("properties") or []:
+        if prop.get("name") == "aquasecurity:trivy:PkgID":
+            pkg.id = prop.get("value", "")
+        elif prop.get("name") == "aquasecurity:trivy:FilePath":
+            pkg.file_path = prop.get("value", "")
+    if not pkg.id:
+        pkg.id = f"{pkg.name}@{c.get('version', p.version)}"
+    return pkg, kind[0], (kind[1] if kind[0] == "lang" else p.type)
+
+
+# ------------------------------------------------------------ SPDX
+
+
+def _decode_spdx(doc: dict) -> tuple[BlobInfo, SBOMMeta]:
+    meta = SBOMMeta(artifact_type="spdx", artifact_name=doc.get("name", ""))
+    blob = BlobInfo()
+    os_info = OS()
+    os_pkgs: list[Package] = []
+    orphan_by_type: dict[str, Application] = {}
+
+    for sp in doc.get("packages") or []:
+        purl_str = ""
+        for ref in sp.get("externalRefs") or []:
+            if ref.get("referenceType") == "purl":
+                purl_str = ref.get("referenceLocator", "")
+                break
+        if not purl_str:
+            # OS declaration: primaryPackagePurpose OPERATING-SYSTEM
+            if sp.get("primaryPackagePurpose") == "OPERATING-SYSTEM":
+                os_info = OS(
+                    family=sp.get("name", ""), name=sp.get("versionInfo", "")
+                )
+            continue
+        c = {
+            "purl": purl_str,
+            "version": sp.get("versionInfo", ""),
+            "bom-ref": sp.get("SPDXID", ""),
+        }
+        pkg, kind, type_str = _component_to_package(c)
+        if pkg is None:
+            continue
+        if kind == "os":
+            os_pkgs.append(pkg)
+        else:
+            orphan_by_type.setdefault(
+                type_str, Application(type=type_str)
+            ).packages.append(pkg)
+
+    blob.os = os_info
+    if os_pkgs:
+        blob.package_infos = [PackageInfo(packages=os_pkgs)]
+    blob.applications = [orphan_by_type[t] for t in sorted(orphan_by_type)]
+    return blob, meta

@@ -1,0 +1,4 @@
+from trivy_tpu.scanner.scan import Scanner
+from trivy_tpu.scanner.local import LocalDriver
+
+__all__ = ["LocalDriver", "Scanner"]

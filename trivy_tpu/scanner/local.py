@@ -1,0 +1,159 @@
+"""Standalone (local) scan driver (reference pkg/scanner/local/scan.go):
+applier squash -> ScanTarget -> per-class scans -> metadata fill ->
+post-scan hooks. The vulnerability matching inside runs on the TPU via
+MatchEngine."""
+
+from __future__ import annotations
+
+from trivy_tpu import vulnerability
+from trivy_tpu.detector import langpkg, ospkg
+from trivy_tpu.detector.engine import MatchEngine
+from trivy_tpu.fanal.applier import apply_layers
+from trivy_tpu.log import logger
+from trivy_tpu.types.artifact import ArtifactDetail, BlobInfo, OS
+from trivy_tpu.types.enums import ResultClass, Scanner as ScannerEnum
+from trivy_tpu.types.report import (
+    DetectedLicense,
+    DetectedSecret,
+    Result,
+)
+from trivy_tpu.types.scan import ScanOptions
+from trivy_tpu.types.serde import from_dict
+
+_log = logger("local")
+
+# app type -> human-readable target when no file path
+# (reference pkg/scanner/langpkg/scan.go:17 PkgTargets)
+PKG_TARGETS = {
+    "gemspec": "Ruby",
+    "python-pkg": "Python",
+    "conda-pkg": "Conda",
+    "node-pkg": "Node.js",
+    "jar": "Java",
+    "k8s": "Kubernetes",
+}
+
+
+class LocalDriver:
+    def __init__(self, engine: MatchEngine, cache, post_hooks=None):
+        self.engine = engine
+        self.cache = cache
+        self.post_hooks = post_hooks or []
+
+    def scan(self, target, artifact_key, blob_keys, options: ScanOptions):
+        detail = self._apply_layers(blob_keys)
+        results = self._scan_detail(target, detail, options)
+        for hook in self.post_hooks:
+            results = hook(results, options)
+        return results, detail.os
+
+    # ------------------------------------------------------------ layers
+
+    def _apply_layers(self, blob_keys: list[str]) -> ArtifactDetail:
+        blobs = []
+        for key in blob_keys:
+            raw = self.cache.get_blob(key)
+            if not raw:
+                raise RuntimeError(f"missing blob in cache: {key}")
+            blob = from_dict(BlobInfo, raw)
+            blob.diff_id = blob.diff_id or key
+            blobs.append(blob)
+        return apply_layers(blobs)
+
+    # ------------------------------------------------------------ scans
+
+    def _scan_detail(
+        self, target: str, detail: ArtifactDetail, options: ScanOptions
+    ) -> list[Result]:
+        results: list[Result] = []
+        if ScannerEnum.VULN in options.scanners:
+            results.extend(self._scan_vulns(target, detail, options))
+        if ScannerEnum.SECRET in options.scanners:
+            results.extend(self._secret_results(detail))
+        if ScannerEnum.LICENSE in options.scanners:
+            results.extend(self._license_results(detail, options))
+        results.extend(self._misconfig_results(detail))
+        return results
+
+    def _scan_vulns(
+        self, target: str, detail: ArtifactDetail, options: ScanOptions
+    ) -> list[Result]:
+        results: list[Result] = []
+        include_os = "os" in options.pkg_types
+        include_lib = "library" in options.pkg_types
+
+        if include_os and (detail.os.detected or detail.packages):
+            vulns, eosl = ([], False)
+            if detail.os.detected and detail.packages:
+                vulns, eosl = ospkg.detect(
+                    self.engine, detail.os, detail.repository, detail.packages
+                )
+                detail.os.eosl = eosl
+            vulnerability.fill_info(self.engine.db, vulns)
+            res = Result(
+                target=f"{target} ({detail.os.family} {detail.os.name})"
+                if detail.os.detected else target,
+                result_class=ResultClass.OS_PKGS,
+                type=detail.os.family,
+                vulnerabilities=sorted(vulns, key=lambda v: v.sort_key()),
+            )
+            if options.list_all_pkgs:
+                res.packages = detail.packages
+            if not res.is_empty or detail.os.detected:
+                results.append(res)
+
+        if include_lib:
+            for app in detail.applications:
+                if not app.packages:
+                    continue
+                vulns = langpkg.detect_app(self.engine, app)
+                vulnerability.fill_info(self.engine.db, vulns)
+                res = Result(
+                    target=app.file_path
+                    or PKG_TARGETS.get(app.type, app.type),
+                    result_class=ResultClass.LANG_PKGS,
+                    type=app.type,
+                    vulnerabilities=sorted(vulns, key=lambda v: v.sort_key()),
+                )
+                if options.list_all_pkgs:
+                    res.packages = app.packages
+                if not res.is_empty:
+                    results.append(res)
+        return results
+
+    def _secret_results(self, detail: ArtifactDetail) -> list[Result]:
+        results = []
+        for secret in sorted(detail.secrets, key=lambda s: s.file_path):
+            results.append(Result(
+                target=secret.file_path,
+                result_class=ResultClass.SECRET,
+                secrets=[
+                    DetectedSecret(
+                        rule_id=f.rule_id, category=f.category,
+                        severity=f.severity, title=f.title,
+                        start_line=f.start_line, end_line=f.end_line,
+                        match=f.match, layer=f.layer,
+                    )
+                    for f in secret.findings
+                ],
+            ))
+        return results
+
+    def _license_results(
+        self, detail: ArtifactDetail, options: ScanOptions
+    ) -> list[Result]:
+        from trivy_tpu.licensing.scanner import scan_licenses
+
+        return scan_licenses(detail, options)
+
+    def _misconfig_results(self, detail: ArtifactDetail) -> list[Result]:
+        results = []
+        for misconf in sorted(
+            detail.misconfigurations, key=lambda m: m.file_path
+        ):
+            from trivy_tpu.misconf.result import to_result
+
+            res = to_result(misconf)
+            if res is not None:
+                results.append(res)
+        return results

@@ -37,6 +37,7 @@ from trivy_tpu.versioning import Constraints
 from trivy_tpu.versioning.base import KEY_BYTES, ParseError
 
 FLAG_NEEDS_HOST = 1
+FLAG_RESCREEN = 2  # exact rank, but match semantics exceed pure intervals
 
 INT32_MAX = np.int32(2**31 - 1)
 
@@ -111,6 +112,9 @@ class CompiledDB:
     host_fallback: dict[tuple[str, str], list[int]]
     window: int
     stats: dict = field(default_factory=dict)
+    # encode memo caches (same packages recur across a registry crawl)
+    _hash_cache: dict = field(default_factory=dict, repr=False)
+    _key_cache: dict = field(default_factory=dict, repr=False)
 
     @property
     def n_rows(self) -> int:
@@ -122,36 +126,75 @@ class CompiledDB:
         return _rank_of(self.boundaries.get(scheme_name), key)
 
     def encode_packages(self, queries: list) -> PackageBatch:
-        """queries: [(space, name, version, scheme_name)] -> PackageBatch."""
+        """queries: [(space, name, version, scheme_name)] -> PackageBatch.
+
+        Hot path: hashes and version keys are memoized (the same packages
+        recur across artifacts in a crawl) and ranks are computed with ONE
+        vectorized searchsorted per scheme, not per query."""
         n = len(queries)
         h1 = np.zeros(n, dtype=np.uint32)
         h2 = np.zeros(n, dtype=np.uint32)
         rank = np.zeros(n, dtype=np.int32)
         flags = np.zeros(n, dtype=np.int32)
+
+        # per-scheme gather for batched ranking
+        by_scheme: dict[str, tuple[list[int], list[bytes]]] = {}
         for i, (space, name, version, scheme_name) in enumerate(queries):
-            a, b = join_key(space, name)
-            h1[i], h2[i] = a, b
-            scheme = versioning.get_scheme(scheme_name)
-            key, exact = scheme.key(version)
-            rank[i] = self.rank_of_key(scheme_name, key)
+            hk = self._hash_cache.get((space, name))
+            if hk is None:
+                hk = join_key(space, name)
+                self._hash_cache[(space, name)] = hk
+            h1[i], h2[i] = hk
+            ck = (scheme_name, version)
+            ke = self._key_cache.get(ck)
+            if ke is None:
+                ke = versioning.get_scheme(scheme_name).key(version)
+                self._key_cache[ck] = ke
+            key, exact = ke
             if not exact:
                 flags[i] |= FLAG_NEEDS_HOST
+            elif scheme_name == "npm" and "-" in version:
+                # npm pre-release rule: interval hits are a superset for
+                # pre-release versions -> exact host rescreen
+                flags[i] |= FLAG_RESCREEN
+            idxs, keys = by_scheme.setdefault(scheme_name, ([], []))
+            idxs.append(i)
+            keys.append(key)
+
+        for scheme_name, (idxs, keys) in by_scheme.items():
+            bounds = self.boundaries.get(scheme_name)
+            if bounds is None or len(bounds) == 0:
+                continue
+            arr = np.array(keys, dtype=bounds.dtype)
+            pos = np.searchsorted(bounds, arr, side="left").astype(np.int64)
+            in_range = pos < len(bounds)
+            eq = np.zeros(len(keys), dtype=bool)
+            eq[in_range] = bounds[pos[in_range]] == arr[in_range]
+            rank[np.array(idxs)] = (2 * pos + eq).astype(np.int32)
         return PackageBatch(h1, h2, rank, flags, queries)
 
 
 def _advisory_intervals(
     adv: Advisory, scheme_name: str, eco: str | None
-) -> list[tuple] | None:
-    """-> [(lo_str|None, lo_incl, hi_str|None, hi_incl)] or None for
-    needs-host (unparseable / always-candidate)."""
+) -> tuple[list[tuple], int] | None:
+    """-> ([(lo_str|None, lo_incl, hi_str|None, hi_incl)], extra_flags)
+    or None for needs-host (unparseable / always-candidate).
+
+    extra_flags carries FLAG_RESCREEN when the intervals are a superset of
+    the exact check rather than equal to it: under the npm pre-release rule
+    a secure range may not "cover" a pre-release version even though it
+    covers the point on the total order, so subtracting it would UNDERshoot
+    — instead the unsubtracted vulnerable intervals are emitted and every
+    hit is host-rescreened."""
     scheme = versioning.get_scheme(scheme_name)
     if adv.is_range_style:
         # empty string in vulnerable/patched => always vulnerable
         # (reference compare.go:23-27)
         for v in list(adv.vulnerable_versions) + list(adv.patched_versions):
             if v == "":
-                return [(None, True, None, True)]
+                return [(None, True, None, True)], 0
         npm_mode = scheme.name == "npm"
+        extra = 0
         try:
             if adv.vulnerable_versions:
                 vuln = Constraints(
@@ -161,17 +204,23 @@ def _advisory_intervals(
                 vuln = [versioning.Interval()]
             secure_exprs = list(adv.patched_versions) + list(adv.unaffected_versions)
             if secure_exprs:
-                secure = Constraints(
-                    scheme, " || ".join(secure_exprs), npm_mode
-                ).intervals()
-                vuln = _subtract(vuln, secure, scheme)
+                if npm_mode:
+                    extra = FLAG_RESCREEN  # see docstring
+                else:
+                    secure = Constraints(
+                        scheme, " || ".join(secure_exprs), npm_mode
+                    ).intervals()
+                    vuln = _subtract(vuln, secure, scheme)
         except ParseError:
             return None
-        return [(_vs(iv.lo), iv.lo_incl, _vs(iv.hi), iv.hi_incl) for iv in vuln]
+        return (
+            [(_vs(iv.lo), iv.lo_incl, _vs(iv.hi), iv.hi_incl) for iv in vuln],
+            extra,
+        )
     # OS style: [affected, fixed) — no fixed version => unbounded above
     lo = adv.affected_version or None
     hi = adv.fixed_version or None
-    return [(lo, True, hi, False)]
+    return [(lo, True, hi, False)], 0
 
 
 def _vs(parsed) -> str | None:
@@ -182,32 +231,25 @@ def _vs(parsed) -> str | None:
 
 
 def _subtract(vuln: list, secure: list, scheme) -> list:
-    """Union-of-intervals subtraction: vuln minus secure."""
-    from trivy_tpu.versioning.constraints import Interval
+    """Union-of-intervals subtraction: vuln minus secure. The surviving
+    pieces are v ∩ (-inf, s.lo) and v ∩ (s.hi, +inf) for each secure s."""
+    from trivy_tpu.versioning.constraints import Interval, _intersect
 
     out = list(vuln)
     for s in secure:
         nxt = []
         for v in out:
-            # part of v below s
             if s.lo is not None:
-                below = Interval(v.lo, v.lo_incl, s.lo, not s.lo_incl)
-                lo_ok = v.lo is None or scheme.compare_parsed(v.lo, s.lo) < 0 or (
-                    scheme.compare_parsed(v.lo, s.lo) == 0
-                    and v.lo_incl
-                    and not s.lo_incl
+                below = _intersect(
+                    v, Interval(None, True, s.lo, not s.lo_incl), scheme
                 )
-                if lo_ok and not below.is_empty(scheme):
+                if below is not None:
                     nxt.append(below)
-            # part of v above s
             if s.hi is not None:
-                above = Interval(s.hi, not s.hi_incl, v.hi, v.hi_incl)
-                hi_ok = v.hi is None or scheme.compare_parsed(v.hi, s.hi) > 0 or (
-                    scheme.compare_parsed(v.hi, s.hi) == 0
-                    and v.hi_incl
-                    and not s.hi_incl
+                above = _intersect(
+                    v, Interval(s.hi, not s.hi_incl, None, True), scheme
                 )
-                if hi_ok and not above.is_empty(scheme):
+                if above is not None:
                     nxt.append(above)
         out = nxt
         if not out:
@@ -215,7 +257,14 @@ def _subtract(vuln: list, secure: list, scheme) -> list:
     return out
 
 
-def compile_db(db: AdvisoryDB, window: int = 128) -> CompiledDB:
+MAX_AUTO_WINDOW = 512
+
+
+def compile_db(db: AdvisoryDB, window: int | None = None) -> CompiledDB:
+    """window=None: size the gather window to the largest per-hash row
+    group (rounded up to a multiple of 8, capped at MAX_AUTO_WINDOW —
+    result-transfer volume is B x window, so a tight window matters on
+    tunneled devices)."""
     advisories: list[tuple[str, str, Advisory]] = []
     raw_rows: list[dict] = []
     boundary_keys: dict[str, set] = {}
@@ -234,8 +283,8 @@ def compile_db(db: AdvisoryDB, window: int = 128) -> CompiledDB:
             for adv in advs:
                 adv_idx = len(advisories)
                 advisories.append((bucket, name, adv))
-                ivs = _advisory_intervals(adv, scheme_name, eco)
-                if ivs is None:
+                compiled = _advisory_intervals(adv, scheme_name, eco)
+                if compiled is None:
                     raw_rows.append(dict(
                         h1=h1, h2=h2, space=space, name=name,
                         lo_key=None, hi_key=None, lo_incl=True, hi_incl=True,
@@ -243,8 +292,9 @@ def compile_db(db: AdvisoryDB, window: int = 128) -> CompiledDB:
                     ))
                     n_host_rows += 1
                     continue
+                ivs, extra_flags = compiled
                 for lo_str, lo_incl, hi_str, hi_incl in ivs:
-                    flags = 0
+                    flags = extra_flags
                     lo_key = hi_key = None
                     if lo_str is not None:
                         lo_key, exact = scheme.key(lo_str)
@@ -285,6 +335,9 @@ def compile_db(db: AdvisoryDB, window: int = 128) -> CompiledDB:
     # count per h1 alone: the kernel's window starts at the first h1 match,
     # so h1-colliding names share one window and must be evicted together
     counts = Counter(r["h1"] for r in raw_rows)
+    if window is None:
+        max_count = max(counts.values(), default=1)
+        window = min(max(8, -(-max_count // 8) * 8), MAX_AUTO_WINDOW)
     host_fallback: dict[tuple[str, str], list[int]] = defaultdict(list)
     kept: list[dict] = []
     for r in raw_rows:

@@ -22,6 +22,7 @@ class ScanOptions:
     license_full: bool = False
     license_categories: dict[str, list[str]] = field(default_factory=dict)
     distro: str = ""
+    list_all_pkgs: bool = False
 
     def has_scanner(self, s: Scanner) -> bool:
         return s in self.scanners

@@ -1,0 +1,42 @@
+"""Minimal dataclass <-> dict structuring for cache round-trips
+(asdict on the way in, from_dict on the way out)."""
+
+from __future__ import annotations
+
+import dataclasses
+import types as _pytypes
+import typing
+
+
+def from_dict(cls, d):
+    """Rebuild a dataclass (recursively) from an asdict() dict."""
+    if d is None:
+        return None
+    if not dataclasses.is_dataclass(cls):
+        return d
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in d:
+            continue
+        kwargs[f.name] = _convert(hints.get(f.name), d[f.name])
+    return cls(**kwargs)
+
+
+def _convert(hint, value):
+    if value is None or hint is None:
+        return value
+    origin = typing.get_origin(hint)
+    if origin is typing.Union or origin is _pytypes.UnionType:
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        if len(args) == 1:
+            return _convert(args[0], value)
+        return value
+    if origin in (list, tuple):
+        (inner,) = typing.get_args(hint) or (None,)
+        return [_convert(inner, v) for v in value]
+    if origin is dict:
+        return value
+    if dataclasses.is_dataclass(hint) and isinstance(value, dict):
+        return from_dict(hint, value)
+    return value

@@ -1,0 +1,30 @@
+"""Context-injectable clock (reference pkg/clock/clock.go:20-37): report
+timestamps must be fakeable so golden files byte-match in tests."""
+
+from __future__ import annotations
+
+import datetime
+import os
+
+_fixed: datetime.datetime | None = None
+
+
+def set_fixed(dt: datetime.datetime | None) -> None:
+    global _fixed
+    _fixed = dt
+
+
+def now() -> datetime.datetime:
+    if _fixed is not None:
+        return _fixed
+    env = os.environ.get("TRIVY_TPU_FAKE_TIME")
+    if env:
+        return datetime.datetime.fromisoformat(env)
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def now_rfc3339() -> str:
+    t = now()
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=datetime.timezone.utc)
+    return t.isoformat().replace("+00:00", "Z")
