@@ -1,0 +1,88 @@
+"""Filesystem / rootfs artifact (reference pkg/fanal/artifact/local/fs.go):
+one walker pass, a single pseudo-blob, then the standard driver path.
+Lockfile analyzers are the point for `fs`; rootfs also enables the OS
+package analyzers (reference pkg/commands/artifact/run.go:179-185)."""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from concurrent.futures import ThreadPoolExecutor
+
+from trivy_tpu.artifact.base import ArtifactReference
+from trivy_tpu.fanal import analyzers  # noqa: F401  (registers analyzers)
+from trivy_tpu.fanal.analyzer import AnalysisResult, AnalyzerGroup
+from trivy_tpu.fanal.handlers import system_file_filter
+from trivy_tpu.fanal.walker import FSWalker
+from trivy_tpu.log import logger
+from trivy_tpu.utils import uuid as uuid_util
+
+_log = logger("fs")
+
+
+class FSArtifact:
+    def __init__(
+        self,
+        path: str,
+        cache,
+        skip_files=None,
+        skip_dirs=None,
+        as_rootfs: bool = False,
+        misconfig_only: bool = False,
+        parallel: int = 5,
+        disabled_analyzers: set[str] | None = None,
+        secret_config: str | None = None,
+    ):
+        self.path = path
+        self.cache = cache
+        self.walker = FSWalker(skip_files or [], skip_dirs or [])
+        self.as_rootfs = as_rootfs
+        self.misconfig_only = misconfig_only
+        self.parallel = max(parallel, 1)
+        self.disabled = set(disabled_analyzers or set())
+        self.secret_config = secret_config
+
+    def _group(self) -> AnalyzerGroup:
+        disabled = set(self.disabled)
+        if not self.as_rootfs:
+            # fs scans: lockfiles on, OS package DBs off would diverge from
+            # the reference, which DOES run OS analyzers for fs too when
+            # present; keep everything on.
+            pass
+        group = AnalyzerGroup.build(disabled_types=disabled)
+        for a in group.analyzers + group.post_analyzers:
+            if a.type == "secret" and self.secret_config:
+                a.configure(self.secret_config)
+        return group
+
+    def inspect(self) -> ArtifactReference:
+        group = self._group()
+        result = AnalysisResult()
+        post_files: dict = {}
+        for inp in self.walker.walk(self.path):
+            # analyze_file lazily reads ONLY files some analyzer requires;
+            # release per-file content unless a post-analyzer collected it
+            group.analyze_file(result, inp, post_files)
+            if not any(
+                inp.path in files for files in post_files.values()
+            ):
+                inp.content = None
+        group.post_analyze(result, post_files)
+        system_file_filter(result)
+        blob = result.to_blob()
+
+        # fs artifacts are keyed by a fresh UUID (reference fs.go:175-188):
+        # local trees change without content hashes, so no blob reuse
+        blob_id = "sha256:" + hashlib.sha256(
+            uuid_util.new().encode()
+        ).hexdigest()
+        self.cache.put_blob(blob_id, dataclasses.asdict(blob))
+        return ArtifactReference(
+            name=self.path,
+            type="filesystem",
+            id=blob_id,
+            blob_ids=[blob_id],
+        )
+
+    def clean(self, ref: ArtifactReference) -> None:
+        self.cache.delete_blobs(ref.blob_ids)

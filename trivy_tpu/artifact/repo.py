@@ -1,0 +1,74 @@
+"""Git repository artifact (reference pkg/fanal/artifact/repo/git.go):
+local paths walk directly; remote URLs are cloned with the system git
+(shallow) into a temp dir first."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+from trivy_tpu.artifact.base import ArtifactReference
+from trivy_tpu.artifact.local_fs import FSArtifact
+from trivy_tpu.log import logger
+
+_log = logger("repo")
+
+
+class RepoArtifact:
+    def __init__(self, target: str, cache, skip_files=None, skip_dirs=None,
+                 parallel: int = 5, branch: str = "", tag: str = "",
+                 commit: str = "", secret_config: str | None = None):
+        self.target = target
+        self.cache = cache
+        self.skip_files = skip_files
+        self.skip_dirs = skip_dirs
+        self.parallel = parallel
+        self.branch, self.tag, self.commit = branch, tag, commit
+        self.secret_config = secret_config
+        self._tmp: str | None = None
+
+    def _checkout(self) -> str:
+        if os.path.isdir(self.target):
+            return self.target
+        self._tmp = tempfile.mkdtemp(prefix="trivy-tpu-repo-")
+        cmd = ["git", "clone"]
+        if not self.commit:
+            cmd += ["--depth", "1"]  # arbitrary commits need full history
+        if self.branch:
+            cmd += ["--branch", self.branch]
+        if self.tag:
+            cmd += ["--branch", self.tag]
+        cmd += [self.target, self._tmp]
+        _log.info("cloning repository", url=self.target)
+        self._git(cmd)
+        if self.commit:
+            self._git(["git", "-C", self._tmp, "checkout", self.commit])
+        return self._tmp
+
+    @staticmethod
+    def _git(cmd: list[str]) -> None:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git failed ({' '.join(cmd[:3])}): {proc.stderr.strip()}"
+            )
+
+    def inspect(self) -> ArtifactReference:
+        path = self._checkout()
+        fs = FSArtifact(
+            path, self.cache, skip_files=self.skip_files,
+            skip_dirs=self.skip_dirs, parallel=self.parallel,
+            secret_config=self.secret_config,
+        )
+        ref = fs.inspect()
+        ref.name = self.target
+        ref.type = "repository"
+        return ref
+
+    def clean(self, ref: ArtifactReference) -> None:
+        self.cache.delete_blobs(ref.blob_ids)
+        if self._tmp:
+            shutil.rmtree(self._tmp, ignore_errors=True)
+            self._tmp = None

@@ -159,6 +159,11 @@ def main(argv: list[str] | None = None) -> int:
     except (ValueError, OSError) as e:
         log.logger().error(str(e))
         return 1
+    except Exception as e:  # scan-level failures render as one error line
+        if getattr(args, "debug", False):
+            raise
+        log.logger().error(f"{type(e).__name__}: {e}")
+        return 1
     parser.print_help()
     return 0
 

@@ -77,6 +77,9 @@ def run_scan(args) -> int:
     from trivy_tpu.report.writer import write_report
     from trivy_tpu.scanner.scan import Scanner
 
+    from trivy_tpu.fanal.analyzers import secret_analyzer
+
+    secret_analyzer.USE_DEVICE = not getattr(args, "no_tpu", False)
     cache = FSCache(args.cache_dir)
     artifact, driver = _select_scanner(args, cache)
     scanner = Scanner(driver, artifact)

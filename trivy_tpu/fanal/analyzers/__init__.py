@@ -1,0 +1,11 @@
+"""Built-in analyzers. Importing this package registers everything
+(reference pkg/fanal/analyzer/all)."""
+
+from trivy_tpu.fanal.analyzers import (  # noqa: F401
+    lang,
+    os_release,
+    pkg_apk,
+    pkg_dpkg,
+    pkg_rpm,
+    secret_analyzer,
+)

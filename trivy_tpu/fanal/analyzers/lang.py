@@ -1,0 +1,213 @@
+"""Language analyzers: declarative wiring of the dependency parsers into
+the analyzer registry (reference pkg/fanal/analyzer/language/*: mostly thin
+wrappers over pkg/dependency/parser via language.Analyze)."""
+
+from __future__ import annotations
+
+import os
+import re
+import stat
+
+from trivy_tpu.fanal.analyzer import (
+    AnalysisInput,
+    AnalysisResult,
+    Analyzer,
+    PostAnalyzer,
+    register,
+    register_post,
+)
+from trivy_tpu.parsers import golang, misc_lang, nodejs
+from trivy_tpu.parsers import python as pyparse
+from trivy_tpu.types.artifact import Application
+
+
+def _app(app_type: str, path: str, pkgs) -> AnalysisResult | None:
+    pkgs = [p for p in pkgs if p and not p.empty]
+    if not pkgs:
+        return None
+    res = AnalysisResult()
+    res.applications = [Application(type=app_type, file_path=path, packages=pkgs)]
+    return res
+
+
+class _LockfileAnalyzer(PostAnalyzer):
+    """One lockfile filename -> one application."""
+
+    app_type = ""
+    filenames: tuple = ()
+    parser = None
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        return os.path.basename(path) in self.filenames
+
+    def post_analyze(self, files: dict[str, AnalysisInput]):
+        res = AnalysisResult()
+        for path, inp in sorted(files.items()):
+            got = _app(self.app_type, path, type(self).parser(inp.read()))
+            res.merge(got)
+        return res
+
+
+def _lockfile(app_type: str, filenames: tuple, parser) -> None:
+    cls = type(
+        f"{app_type.title()}Analyzer",
+        (_LockfileAnalyzer,),
+        {"type": app_type, "app_type": app_type, "filenames": filenames,
+         "parser": staticmethod(parser)},
+    )
+    register_post(cls())
+
+
+_lockfile("npm", ("package-lock.json",), nodejs.parse_package_lock)
+_lockfile("yarn", ("yarn.lock",), nodejs.parse_yarn_lock)
+_lockfile("pnpm", ("pnpm-lock.yaml",), nodejs.parse_pnpm_lock)
+_lockfile("pip", ("requirements.txt",), pyparse.parse_requirements)
+_lockfile("pipenv", ("Pipfile.lock",), pyparse.parse_pipfile_lock)
+_lockfile("poetry", ("poetry.lock",), pyparse.parse_poetry_lock)
+_lockfile("uv", ("uv.lock",), pyparse.parse_uv_lock)
+_lockfile("gomod", ("go.mod",), golang.parse_go_mod)
+_lockfile("cargo", ("Cargo.lock",), misc_lang.parse_cargo_lock)
+_lockfile("composer", ("composer.lock",), misc_lang.parse_composer_lock)
+_lockfile("bundler", ("Gemfile.lock",), misc_lang.parse_gemfile_lock)
+_lockfile("gradle-lockfile", ("gradle.lockfile",),
+          misc_lang.parse_gradle_lockfile)
+_lockfile("sbt-lockfile", ("build.sbt.lock",), misc_lang.parse_sbt_lockfile)
+_lockfile("nuget", ("packages.lock.json",), misc_lang.parse_nuget_lock)
+_lockfile("pub", ("pubspec.lock",), misc_lang.parse_pubspec_lock)
+_lockfile("hex", ("mix.lock",), misc_lang.parse_mix_lock)
+_lockfile("cocoapods", ("Podfile.lock",), misc_lang.parse_podfile_lock)
+_lockfile("swift", ("Package.resolved",), misc_lang.parse_swift_resolved)
+_lockfile("conan", ("conan.lock",), misc_lang.parse_conan_lock)
+_lockfile("conda-environment", ("environment.yml", "environment.yaml"),
+          misc_lang.parse_conda_environment)
+
+
+@register_post
+class DotnetDepsAnalyzer(PostAnalyzer):
+    type = "dotnet-core"
+    version = 1
+    app_type = "dotnet-core"
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        return path.endswith(".deps.json")
+
+    def post_analyze(self, files):
+        res = AnalysisResult()
+        for path, inp in sorted(files.items()):
+            res.merge(_app(self.app_type, path,
+                           misc_lang.parse_deps_json(inp.read())))
+        return res
+
+
+# ------------------------------------------------- individual packages
+
+
+@register
+class NodePkgAnalyzer(Analyzer):
+    """node_modules/**/package.json -> installed node packages."""
+
+    type = "node-pkg"
+    version = 1
+
+    _RX = re.compile(r"(^|/)node_modules/(@[^/]+/)?[^/]+/package\.json$")
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        return bool(self._RX.search(path))
+
+    def analyze(self, inp: AnalysisInput):
+        pkg = nodejs.parse_package_json(inp.read())
+        if pkg is None:
+            return None
+        pkg.file_path = inp.path
+        return _app("node-pkg", inp.path, [pkg])
+
+
+@register
+class PythonPkgAnalyzer(Analyzer):
+    """site-packages dist-info/egg-info -> installed python packages."""
+
+    type = "python-pkg"
+    version = 1
+
+    _RX = re.compile(r"\.(dist-info/METADATA|egg-info/PKG-INFO|egg-info)$")
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        return bool(self._RX.search(path))
+
+    def analyze(self, inp: AnalysisInput):
+        pkg = pyparse.parse_dist_metadata(inp.read())
+        if pkg is None:
+            return None
+        pkg.file_path = inp.path
+        return _app("python-pkg", inp.path, [pkg])
+
+
+@register
+class GemspecAnalyzer(Analyzer):
+    type = "gemspec"
+    version = 1
+
+    _RX = re.compile(r"specifications/.+\.gemspec$")
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        return bool(self._RX.search(path))
+
+    def analyze(self, inp: AnalysisInput):
+        pkg = misc_lang.parse_gemspec(inp.read())
+        if pkg is None:
+            return None
+        pkg.file_path = inp.path
+        return _app("gemspec", inp.path, [pkg])
+
+
+@register
+class JarAnalyzer(Analyzer):
+    type = "jar"
+    version = 1
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        return path.endswith((".jar", ".war", ".ear", ".par"))
+
+    def analyze(self, inp: AnalysisInput):
+        return _app("jar", inp.path, misc_lang.parse_jar(inp.read(), inp.path))
+
+
+@register
+class CondaPkgAnalyzer(Analyzer):
+    type = "conda-pkg"
+    version = 1
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        return "conda-meta/" in path and path.endswith(".json")
+
+    def analyze(self, inp: AnalysisInput):
+        pkg = misc_lang.parse_conda_meta(inp.read())
+        if pkg is None:
+            return None
+        pkg.file_path = inp.path
+        return _app("conda-pkg", inp.path, [pkg])
+
+
+@register
+class GoBinaryAnalyzer(Analyzer):
+    """Executable ELF/PE/Mach-O files with embedded Go build info
+    (reference analyzer/language/golang/binary)."""
+
+    type = "gobinary"
+    version = 1
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        if size < 1024 or size > 200 * 1024 * 1024:
+            return False
+        if not (mode & (stat.S_IXUSR | stat.S_IXGRP | stat.S_IXOTH)) and mode:
+            return False
+        base = os.path.basename(path)
+        return "." not in base or base.endswith((".bin", ".exe", ".test"))
+
+    def analyze(self, inp: AnalysisInput):
+        content = inp.read()
+        if content[:4] not in (b"\x7fELF", b"MZ\x90\x00", b"\xcf\xfa\xed\xfe",
+                               b"\xfe\xed\xfa\xcf"):
+            return None
+        pkgs = golang.parse_go_binary(content)
+        return _app("gobinary", inp.path, pkgs)

@@ -1,0 +1,72 @@
+"""Secret analyzer (reference pkg/fanal/analyzer/secret/secret.go), as a
+BATCH post-analyzer: files are collected during the walk and scanned in one
+device keyword-prefilter pass + host regex on candidates, instead of the
+reference's per-file loop."""
+
+from __future__ import annotations
+
+import os
+
+from trivy_tpu.fanal.analyzer import (
+    AnalysisInput,
+    AnalysisResult,
+    PostAnalyzer,
+    register_post,
+)
+from trivy_tpu.log import logger
+from trivy_tpu.secret.scanner import SecretConfig, SecretScanner
+
+_log = logger("secret")
+
+WARN_SIZE = 10 * 1024 * 1024  # reference secret.go:110
+
+_SKIP_DIRS = ("node_modules/.cache/", ".git/", "usr/share/doc/")
+_SKIP_FILES = {"go.sum", "package-lock.json", "yarn.lock", "pnpm-lock.yaml",
+               "Pipfile.lock", "poetry.lock", "Cargo.lock", "composer.lock"}
+
+# module-level toggle set by the CLI (--no-tpu)
+USE_DEVICE = True
+
+
+@register_post
+class SecretAnalyzer(PostAnalyzer):
+    type = "secret"
+    version = 1
+
+    def __init__(self, config_path: str | None = None):
+        self._scanner = None
+        self._config_path = config_path
+
+    @property
+    def scanner(self) -> SecretScanner:
+        if self._scanner is None:
+            cfg = None
+            if self._config_path and os.path.exists(self._config_path):
+                cfg = SecretConfig.load(self._config_path)
+            self._scanner = SecretScanner(cfg)
+        return self._scanner
+
+    def configure(self, config_path: str | None) -> None:
+        self._config_path = config_path
+        self._scanner = None
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        if os.path.basename(path) in _SKIP_FILES:
+            return False
+        if any(s in path for s in _SKIP_DIRS):
+            return False
+        if self.scanner.skip_file(path):
+            return False
+        if size > WARN_SIZE:
+            _log.warn("the file is larger than 10 MiB, secret scan may be slow",
+                      path=path, size=size)
+        return True
+
+    def post_analyze(self, files: dict[str, AnalysisInput]) -> AnalysisResult | None:
+        batch = [(path, inp.read()) for path, inp in sorted(files.items())]
+        secrets = self.scanner.scan_files(batch, use_device=USE_DEVICE)
+        if not secrets:
+            return None
+        res = AnalysisResult()
+        res.secrets = secrets
+        return res

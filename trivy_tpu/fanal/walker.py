@@ -1,0 +1,116 @@
+"""File-tree walkers (reference pkg/fanal/walker):
+- FSWalker: directory traversal with skip globs (fs.go:25)
+- LayerTarWalker: container layer tars with whiteout/opaque-dir handling
+  (tar.go:35-60: ".wh." prefix files delete, ".wh..wh..opq" marks opaque)
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+import tarfile
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from trivy_tpu.fanal.analyzer import AnalysisInput, matches_any
+from trivy_tpu.log import logger
+
+_log = logger("walker")
+
+# never walked (reference walker.go defaultSkipDirs)
+DEFAULT_SKIP_DIRS = [".git", "**/.git", "proc", "sys", "dev"]
+
+MAX_FILE_SIZE = 200 * 1024 * 1024  # hard cap on single-file reads
+
+
+@dataclass
+class FSWalker:
+    skip_files: list[str] = field(default_factory=list)
+    skip_dirs: list[str] = field(default_factory=list)
+    only_dirs: list[str] = field(default_factory=list)
+
+    def walk(self, root: str) -> Iterator[AnalysisInput]:
+        root = os.path.abspath(root)
+        skip_dirs = list(self.skip_dirs) + DEFAULT_SKIP_DIRS
+        for dirpath, dirnames, filenames in os.walk(root):
+            rel_dir = os.path.relpath(dirpath, root)
+            rel_dir = "" if rel_dir == "." else rel_dir.replace(os.sep, "/")
+            # prune skipped dirs
+            keep = []
+            for d in dirnames:
+                rel = f"{rel_dir}/{d}" if rel_dir else d
+                if matches_any(rel, skip_dirs) or matches_any(d, skip_dirs):
+                    continue
+                keep.append(d)
+            dirnames[:] = sorted(keep)
+            for fname in sorted(filenames):
+                rel = f"{rel_dir}/{fname}" if rel_dir else fname
+                if matches_any(rel, self.skip_files):
+                    continue
+                full = os.path.join(dirpath, fname)
+                try:
+                    st = os.lstat(full)
+                except OSError:
+                    continue
+                if not stat.S_ISREG(st.st_mode):
+                    continue
+                if st.st_size > MAX_FILE_SIZE:
+                    _log.debug("skipping oversized file", path=rel,
+                               size=st.st_size)
+                    continue
+                yield AnalysisInput(
+                    path=rel,
+                    size=st.st_size,
+                    mode=st.st_mode,
+                    open=lambda p=full: open(p, "rb").read(),
+                )
+
+
+@dataclass
+class LayerFile:
+    input: AnalysisInput | None = None
+    whiteout: str | None = None  # path deleted by this layer
+    opaque_dir: str | None = None
+
+
+def walk_layer_tar(tar_bytes_or_path) -> tuple[list[AnalysisInput], list[str], list[str]]:
+    """-> (files, opaque_dirs, whiteout_files). Reads the whole layer tar
+    (reference walker/tar.go)."""
+    if isinstance(tar_bytes_or_path, (bytes, bytearray)):
+        import io
+
+        tf = tarfile.open(fileobj=io.BytesIO(tar_bytes_or_path))
+    else:
+        tf = tarfile.open(tar_bytes_or_path)
+    files: list[AnalysisInput] = []
+    opaque_dirs: list[str] = []
+    whiteout_files: list[str] = []
+    with tf:
+        for member in tf:
+            # strip only a leading "./", not dots of root-level dotfiles
+            name = member.name.removeprefix("./").lstrip("/")
+            if not name:
+                continue
+            base = os.path.basename(name)
+            dirn = os.path.dirname(name)
+            if base == ".wh..wh..opq":
+                opaque_dirs.append(dirn)
+                continue
+            if base.startswith(".wh."):
+                whiteout_files.append(
+                    os.path.join(dirn, base[len(".wh."):]).replace(os.sep, "/")
+                )
+                continue
+            if not member.isreg():
+                continue
+            if member.size > MAX_FILE_SIZE:
+                continue
+            f = tf.extractfile(member)
+            if f is None:
+                continue
+            content = f.read()
+            files.append(AnalysisInput(
+                path=name, content=content, size=member.size,
+                mode=member.mode,
+            ))
+    return files, opaque_dirs, whiteout_files

@@ -1,0 +1,141 @@
+"""Batched secret keyword prefilter on device.
+
+The reference's secret engine prefilters each rule by substring keywords
+before running its regex (pkg/fanal/secret/scanner.go:174-186), file by
+file on the CPU. Here the prefilter is a single device pass over a whole
+batch of files (SURVEY.md §7 step 7):
+
+- files are lowercased and packed into a [n_chunks, CHUNK] uint8 tensor
+  (chunks overlap by max-keyword-length-1 so matches never straddle)
+- every keyword is matched with L shifted byte-compares on the whole
+  tensor at once (VPU-friendly, no dynamic shapes)
+- output [n_chunks, n_keywords] any-hit reduces to per-file keyword masks;
+  only (file, rule) pairs whose keywords hit reach the host regex engine.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+CHUNK = 16384
+MAX_KW = 24  # keywords longer than this are truncated (still a prefilter)
+
+
+class KeywordBank:
+    """Fixed keyword tensor: [n_kw, MAX_KW] uint8 + lengths."""
+
+    def __init__(self, keywords: list[bytes]):
+        self.keywords = [k[:MAX_KW].lower() for k in keywords]
+        n = len(self.keywords)
+        self.kw = np.zeros((n, MAX_KW), dtype=np.uint8)
+        self.kw_len = np.zeros(n, dtype=np.int32)
+        for i, k in enumerate(self.keywords):
+            self.kw[i, : len(k)] = np.frombuffer(k, dtype=np.uint8)
+            self.kw_len[i] = len(k)
+        self.max_len = int(self.kw_len.max()) if n else 1
+
+
+@functools.lru_cache(maxsize=4)
+def _kernel(n_kw: int, max_len: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(chunks, kw, kw_len):
+        # chunks: [C, CHUNK] uint8 (already lowercased). Pad max_len-1 zero
+        # bytes so matches starting in the final max_len-1 positions of the
+        # last chunk are still tested (zero never equals a keyword byte, so
+        # padding cannot create false hits).
+        c = jnp.pad(chunks, ((0, 0), (0, max_len - 1)))
+        w = CHUNK
+
+        def match_one(k_row, k_len):
+            # AND of shifted equality over the keyword bytes
+            acc = jnp.ones((c.shape[0], w), dtype=bool)
+            for j in range(max_len):
+                eq = c[:, j: j + w] == k_row[j]
+                active = j < k_len
+                acc = acc & jnp.where(active, eq, True)
+            return acc.any(axis=1)  # [C]
+
+        hits = jax.vmap(match_one, in_axes=(0, 0), out_axes=1)(
+            kw[:, :max_len], kw_len
+        )  # [C, K]
+        return hits
+
+    return run
+
+
+class DevicePrefilter:
+    def __init__(self, bank: KeywordBank, batch_chunks: int = 1024):
+        self.bank = bank
+        self.batch_chunks = batch_chunks
+        self._run = None
+
+    def _ensure(self):
+        if self._run is None:
+            import jax.numpy as jnp
+
+            self._run = _kernel(len(self.bank.keywords), self.bank.max_len)
+            self._kw_dev = jnp.asarray(self.bank.kw)
+            self._kwlen_dev = jnp.asarray(self.bank.kw_len)
+
+    def keyword_hits(self, contents: list[bytes]) -> np.ndarray:
+        """-> bool[n_files, n_keywords]."""
+        n_kw = len(self.bank.keywords)
+        out = np.zeros((len(contents), n_kw), dtype=bool)
+        if not contents or n_kw == 0:
+            return out
+        self._ensure()
+        import jax.numpy as jnp
+
+        overlap = self.bank.max_len - 1
+        step = CHUNK - overlap
+        # build chunk list with file ownership
+        owners: list[int] = []
+        chunks: list[np.ndarray] = []
+        for fi, content in enumerate(contents):
+            low = content.lower()
+            pos = 0
+            while pos == 0 or pos < len(low):
+                piece = low[pos: pos + CHUNK]
+                if not piece:
+                    break
+                arr = np.zeros(CHUNK, dtype=np.uint8)
+                arr[: len(piece)] = np.frombuffer(piece, dtype=np.uint8)
+                chunks.append(arr)
+                owners.append(fi)
+                if pos + CHUNK >= len(low):
+                    break
+                pos += step
+            if not low:
+                continue
+        if not chunks:
+            return out
+        owners_a = np.array(owners)
+        for start in range(0, len(chunks), self.batch_chunks):
+            batch = np.stack(chunks[start: start + self.batch_chunks])
+            hits = np.asarray(self._run(
+                jnp.asarray(batch), self._kw_dev, self._kwlen_dev
+            ))
+            for row, owner in zip(hits, owners_a[start: start + len(batch)]):
+                out[owner] |= row
+        return out
+
+
+class HostPrefilter:
+    """Same contract on the CPU (bytes.find), used as fallback and oracle."""
+
+    def __init__(self, bank: KeywordBank):
+        self.bank = bank
+
+    def keyword_hits(self, contents: list[bytes]) -> np.ndarray:
+        out = np.zeros((len(contents), len(self.bank.keywords)), dtype=bool)
+        for fi, content in enumerate(contents):
+            low = content.lower()
+            for ki, k in enumerate(self.bank.keywords):
+                if k in low:
+                    out[fi, ki] = True
+        return out

@@ -1,0 +1,2 @@
+"""Lockfile/binary dependency parsers (reference pkg/dependency/parser/*):
+each parse_* takes file content and returns a list of Package."""

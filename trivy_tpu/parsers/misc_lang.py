@@ -1,0 +1,344 @@
+"""Parsers for cargo, composer, ruby, java, dotnet, dart, elixir, swift,
+conan, conda and gradle/sbt lockfiles (reference pkg/dependency/parser/*)."""
+
+from __future__ import annotations
+
+import json
+import re
+
+from trivy_tpu.types.artifact import Location, Package
+
+
+def _mk(name: str, version: str, **kw) -> Package:
+    return Package(id=f"{name}@{version}", name=name, version=version, **kw)
+
+
+# ------------------------------------------------------------ rust
+
+
+def parse_cargo_lock(content: bytes) -> list[Package]:
+    import tomllib
+
+    doc = tomllib.loads(content.decode("utf-8", "replace"))
+    out = []
+    for meta in doc.get("package") or []:
+        name, version = meta.get("name"), meta.get("version")
+        if not name or not version:
+            continue
+        pkg = _mk(name, version)
+        deps = []
+        for d in meta.get("dependencies") or []:
+            deps.append(d.split(" ")[0])
+        pkg.depends_on = deps
+        out.append(pkg)
+    by_name = {p.name: p.id for p in out}
+    for p in out:
+        p.depends_on = sorted(
+            {by_name[d] for d in p.depends_on if d in by_name}
+        )
+    return sorted(out, key=lambda p: p.id)
+
+
+# ------------------------------------------------------------ php
+
+
+def parse_composer_lock(content: bytes) -> list[Package]:
+    doc = json.loads(content)
+    out = []
+    for section, dev in (("packages", False), ("packages-dev", True)):
+        for meta in doc.get(section) or []:
+            name, version = meta.get("name"), meta.get("version", "")
+            if not name or not version:
+                continue
+            pkg = _mk(name, version.lstrip("v"), dev=dev)
+            lic = meta.get("license")
+            if isinstance(lic, list):
+                pkg.licenses = [str(x) for x in lic]
+            pkg.depends_on = sorted(
+                d for d in (meta.get("require") or {})
+                if "/" in d  # real packages, not "php"/extensions
+            )
+            out.append(pkg)
+    by_name = {p.name: p.id for p in out}
+    for p in out:
+        p.depends_on = sorted(
+            {by_name[d] for d in p.depends_on if d in by_name}
+        )
+    return sorted(out, key=lambda p: p.id)
+
+
+# ------------------------------------------------------------ ruby
+
+_GEM_RX = re.compile(r"^ {4}(?P<name>\S+) \((?P<ver>[^)]+)\)$")
+
+
+def parse_gemfile_lock(content: bytes) -> list[Package]:
+    out = []
+    in_gem = False
+    for i, line in enumerate(content.decode("utf-8", "replace").splitlines(), 1):
+        if line.strip() == "GEM":
+            in_gem = True
+            continue
+        if line and not line.startswith(" "):
+            in_gem = False
+            continue
+        if in_gem:
+            m = _GEM_RX.match(line)
+            if m:
+                pkg = _mk(m.group("name"), m.group("ver"))
+                pkg.locations = [Location(i, i)]
+                out.append(pkg)
+    return out
+
+
+_GEMSPEC_NAME = re.compile(r"\.name\s*=\s*['\"]([^'\"]+)['\"]")
+_GEMSPEC_VER = re.compile(r"\.version\s*=\s*['\"]([^'\"]+)['\"]")
+_GEMSPEC_LIC = re.compile(r"\.licenses?\s*=\s*\[?\s*['\"]([^'\"]+)['\"]")
+
+
+def parse_gemspec(content: bytes) -> Package | None:
+    text = content.decode("utf-8", "replace")
+    mn, mv = _GEMSPEC_NAME.search(text), _GEMSPEC_VER.search(text)
+    if not mn or not mv:
+        return None
+    pkg = _mk(mn.group(1), mv.group(1))
+    ml = _GEMSPEC_LIC.search(text)
+    if ml:
+        pkg.licenses = [ml.group(1)]
+    return pkg
+
+
+# ------------------------------------------------------------ java
+
+
+def parse_jar(content: bytes, path: str = "") -> list[Package]:
+    """JAR/WAR/EAR: pom.properties (groupId/artifactId/version) preferred,
+    MANIFEST.MF Implementation-* as fallback, filename last
+    (reference pkg/dependency/parser/java/jar)."""
+    import io
+    import zipfile
+
+    out: list[Package] = []
+    try:
+        zf = zipfile.ZipFile(io.BytesIO(content))
+    except zipfile.BadZipFile:
+        return []
+    with zf:
+        pom_props = [n for n in zf.namelist()
+                     if n.endswith("pom.properties")]
+        for name in pom_props:
+            try:
+                props = dict(
+                    line.split("=", 1)
+                    for line in zf.read(name).decode("utf-8", "replace").splitlines()
+                    if "=" in line and not line.startswith("#")
+                )
+            except Exception:
+                continue
+            gid = props.get("groupId", "").strip()
+            aid = props.get("artifactId", "").strip()
+            ver = props.get("version", "").strip()
+            if gid and aid and ver:
+                out.append(_mk(f"{gid}:{aid}", ver, file_path=path))
+        if not out:
+            try:
+                manifest = zf.read("META-INF/MANIFEST.MF").decode("utf-8", "replace")
+                fields = {}
+                for line in manifest.splitlines():
+                    if ":" in line:
+                        k, _, v = line.partition(":")
+                        fields[k.strip()] = v.strip()
+                gid = fields.get("Implementation-Vendor-Id") or fields.get(
+                    "Bundle-SymbolicName", "").split(";")[0]
+                aid = fields.get("Implementation-Title") or ""
+                ver = fields.get("Implementation-Version") or fields.get(
+                    "Bundle-Version", "")
+                if aid and ver:
+                    name = f"{gid}:{aid}" if gid and ":" not in aid else aid
+                    out.append(_mk(name, ver, file_path=path))
+            except KeyError:
+                pass
+    if not out and path:
+        # filename fallback: name-1.2.3.jar
+        m = re.match(r"(?P<name>.+?)-(?P<ver>\d[\w.]*)\.[jwe]ar$",
+                     path.rsplit("/", 1)[-1])
+        if m:
+            out.append(_mk(m.group("name"), m.group("ver"), file_path=path))
+    return out
+
+
+def parse_gradle_lockfile(content: bytes) -> list[Package]:
+    out = []
+    for i, line in enumerate(content.decode("utf-8", "replace").splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        coord = line.split("=")[0]
+        parts = coord.split(":")
+        if len(parts) == 3:
+            pkg = _mk(f"{parts[0]}:{parts[1]}", parts[2])
+            pkg.locations = [Location(i, i)]
+            out.append(pkg)
+    return out
+
+
+def parse_sbt_lockfile(content: bytes) -> list[Package]:
+    doc = json.loads(content)
+    out = []
+    for dep in doc.get("dependencies") or []:
+        org, name, ver = dep.get("org"), dep.get("name"), dep.get("version")
+        if org and name and ver:
+            out.append(_mk(f"{org}:{name}", ver))
+    return sorted(out, key=lambda p: p.id)
+
+
+# ------------------------------------------------------------ dotnet
+
+
+def parse_deps_json(content: bytes) -> list[Package]:
+    doc = json.loads(content)
+    out = {}
+    runtime_targets = doc.get("targets") or {}
+    for _target, pkgs in runtime_targets.items():
+        for key, meta in (pkgs or {}).items():
+            if "/" not in key:
+                continue
+            name, version = key.split("/", 1)
+            if meta.get("type") not in (None, "package"):
+                continue
+            out.setdefault(f"{name}@{version}", _mk(name, version))
+    return sorted(out.values(), key=lambda p: p.id)
+
+
+def parse_nuget_lock(content: bytes) -> list[Package]:
+    doc = json.loads(content)
+    out = {}
+    for _fw, deps in (doc.get("dependencies") or {}).items():
+        for name, meta in (deps or {}).items():
+            version = meta.get("resolved", "")
+            if not version:
+                continue
+            indirect = meta.get("type") == "Transitive"
+            out.setdefault(
+                f"{name}@{version}",
+                _mk(name, version, indirect=indirect,
+                    relationship="indirect" if indirect else "direct"),
+            )
+    return sorted(out.values(), key=lambda p: p.id)
+
+
+# ------------------------------------------------------------ dart / elixir / swift / conan / conda
+
+
+def parse_pubspec_lock(content: bytes) -> list[Package]:
+    import yaml
+
+    doc = yaml.safe_load(content) or {}
+    out = []
+    for name, meta in (doc.get("packages") or {}).items():
+        version = str(meta.get("version", ""))
+        if not version:
+            continue
+        indirect = meta.get("dependency") == "transitive"
+        out.append(_mk(name, version, indirect=indirect,
+                       relationship="indirect" if indirect else "direct"))
+    return sorted(out, key=lambda p: p.id)
+
+
+_MIX_RX = re.compile(
+    r'"(?P<name>[^"]+)":\s*\{:\w+,\s*:"?(?P=name)"?,\s*"(?P<ver>[^"]+)"'
+)
+
+
+def parse_mix_lock(content: bytes) -> list[Package]:
+    out = []
+    for i, line in enumerate(content.decode("utf-8", "replace").splitlines(), 1):
+        m = _MIX_RX.search(line)
+        if m:
+            pkg = _mk(m.group("name"), m.group("ver"))
+            pkg.locations = [Location(i, i)]
+            out.append(pkg)
+    return out
+
+
+_PODFILE_RX = re.compile(r"^ {2}- (?P<name>\S+) \((?P<ver>[^)]+)\)$")
+
+
+def parse_podfile_lock(content: bytes) -> list[Package]:
+    import yaml
+
+    doc = yaml.safe_load(content) or {}
+    out = {}
+    for entry in doc.get("PODS") or []:
+        if isinstance(entry, dict):
+            entry = next(iter(entry))
+        m = re.match(r"(?P<name>\S+) \((?P<ver>[^)]+)\)", str(entry))
+        if m:
+            out.setdefault(m.group("name"),
+                           _mk(m.group("name"), m.group("ver")))
+    return sorted(out.values(), key=lambda p: p.id)
+
+
+def parse_swift_resolved(content: bytes) -> list[Package]:
+    doc = json.loads(content)
+    out = []
+    pins = (doc.get("pins") or
+            (doc.get("object") or {}).get("pins") or [])
+    for pin in pins:
+        name = pin.get("location") or pin.get("repositoryURL") or pin.get("identity", "")
+        name = name.removesuffix(".git")
+        state = pin.get("state") or {}
+        version = state.get("version") or ""
+        if name and version:
+            out.append(_mk(name, version))
+    return sorted(out, key=lambda p: p.id)
+
+
+def parse_conan_lock(content: bytes) -> list[Package]:
+    doc = json.loads(content)
+    out = []
+    # v2: {"requires": ["name/1.0#rrev%ts", ...]}
+    for req in doc.get("requires") or []:
+        ref = req.split("#")[0].split("%")[0]
+        if "/" in ref:
+            name, version = ref.split("/", 1)
+            out.append(_mk(name, version.split("@")[0]))
+    # v1: graph_lock.nodes
+    nodes = (doc.get("graph_lock") or {}).get("nodes") or {}
+    for _id, node in nodes.items():
+        ref = (node.get("ref") or "").split("#")[0]
+        if "/" in ref:
+            name, version = ref.split("/", 1)
+            out.append(_mk(name, version.split("@")[0]))
+    uniq = {p.id: p for p in out}
+    return sorted(uniq.values(), key=lambda p: p.id)
+
+
+def parse_conda_meta(content: bytes) -> Package | None:
+    doc = json.loads(content)
+    name, version = doc.get("name"), doc.get("version")
+    if not name or not version:
+        return None
+    pkg = _mk(str(name), str(version))
+    lic = doc.get("license")
+    if lic:
+        pkg.licenses = [str(lic)]
+    return pkg
+
+
+def parse_conda_environment(content: bytes) -> list[Package]:
+    import yaml
+
+    doc = yaml.safe_load(content) or {}
+    out = []
+    for dep in doc.get("dependencies") or []:
+        if not isinstance(dep, str):
+            continue
+        # only exact "name=version(=build)" pins; range specs
+        # (>=, <=, !=, name>...) are not concrete packages
+        if any(c in dep for c in "<>!"):
+            continue
+        parts = dep.split("=")
+        if len(parts) >= 2 and parts[0] and parts[1]:
+            out.append(_mk(parts[0], parts[1]))
+    return out

@@ -1,0 +1,140 @@
+"""Node.js parsers (reference pkg/dependency/parser/nodejs/{npm,yarn,pnpm,
+packagejson}): package-lock.json v1/v2+, yarn.lock v1/berry,
+pnpm-lock.yaml, and node_modules package.json."""
+
+from __future__ import annotations
+
+import json
+import re
+
+from trivy_tpu.types.artifact import Location, Package
+
+
+def _mk(name: str, version: str, dev: bool = False,
+        indirect: bool = False) -> Package:
+    return Package(
+        id=f"{name}@{version}", name=name, version=version, dev=dev,
+        relationship="indirect" if indirect else "direct",
+        indirect=indirect,
+    )
+
+
+def parse_package_lock(content: bytes) -> list[Package]:
+    doc = json.loads(content)
+    out: dict[str, Package] = {}
+    if "packages" in doc:  # lockfile v2/v3
+        for path, meta in doc["packages"].items():
+            if not path.startswith("node_modules/"):
+                continue  # root/workspace entries
+            name = meta.get("name") or path.split("node_modules/")[-1]
+            version = meta.get("version", "")
+            if not version:
+                continue
+            indirect = "node_modules/" in path[len("node_modules/"):]
+            pkg = _mk(name, version, dev=bool(meta.get("dev")),
+                      indirect=indirect)
+            deps = list((meta.get("dependencies") or {}).keys())
+            pkg.depends_on = deps
+            out.setdefault(pkg.id, pkg)
+    else:  # v1: nested dependencies tree
+        def walk(deps: dict, depth: int):
+            for name, meta in (deps or {}).items():
+                version = meta.get("version", "")
+                if not version:
+                    continue
+                pkg = _mk(name, version, dev=bool(meta.get("dev")),
+                          indirect=depth > 0)
+                pkg.depends_on = list((meta.get("requires") or {}).keys())
+                out.setdefault(pkg.id, pkg)
+                walk(meta.get("dependencies") or {}, depth + 1)
+
+        walk(doc.get("dependencies") or {}, 0)
+    pkgs = list(out.values())
+    by_name = {p.name: p.id for p in pkgs}
+    for p in pkgs:
+        p.depends_on = sorted(
+            {by_name[d] for d in p.depends_on if d in by_name}
+        )
+    return sorted(pkgs, key=lambda p: p.id)
+
+
+_YARN_HEADER = re.compile(
+    r'^"?(?P<name>(?:@[^/@"]+/)?[^/@"]+)@(?:npm:)?[^"]*"?(?:, *"?.*)?:$'
+)
+_YARN_VERSION = re.compile(r'^ {2}version:? "?(?P<v>[^"\s]+)"?$')
+
+
+def parse_yarn_lock(content: bytes) -> list[Package]:
+    out: dict[str, Package] = {}
+    cur_name = None
+    cur_line = 0
+    for i, line in enumerate(content.decode("utf-8", "replace").splitlines(), 1):
+        if not line or line.startswith("#"):
+            continue
+        if not line.startswith(" "):
+            m = _YARN_HEADER.match(line.rstrip())
+            cur_name = m.group("name") if m else None
+            cur_line = i
+            continue
+        if cur_name:
+            m = _YARN_VERSION.match(line.rstrip())
+            if m:
+                pkg = _mk(cur_name, m.group("v"))
+                pkg.locations = [Location(cur_line, i)]
+                out.setdefault(pkg.id, pkg)
+                cur_name = None
+    return sorted(out.values(), key=lambda p: p.id)
+
+
+def parse_pnpm_lock(content: bytes) -> list[Package]:
+    import yaml
+
+    doc = yaml.safe_load(content) or {}
+    out: dict[str, Package] = {}
+    ver = str(doc.get("lockfileVersion", "5"))
+    direct: set[str] = set()
+    importers = doc.get("importers") or {".": doc}
+    for imp in importers.values():
+        for sec in ("dependencies", "devDependencies", "optionalDependencies"):
+            for name, spec in (imp.get(sec) or {}).items():
+                v = spec.get("version", "") if isinstance(spec, dict) else str(spec)
+                direct.add(f"{name}@{v.split('(')[0]}")
+    for key, meta in (doc.get("packages") or {}).items():
+        # v5: "/name/1.0.0" or "/@scope/name/1.0.0"; v6+: "/name@1.0.0";
+        # v9 keys live under "snapshots"/"packages" as "name@1.0.0"
+        k = key.lstrip("/")
+        name = version = ""
+        if "@" in k and not k.startswith("@") and ver >= "6":
+            name, _, version = k.rpartition("@")
+        elif k.startswith("@") and k.count("@") >= 2 and ver >= "6":
+            name, _, version = k.rpartition("@")
+        else:
+            parts = k.rsplit("/", 1)
+            if len(parts) == 2:
+                name, version = parts
+        version = version.split("(")[0]
+        if not name or not version:
+            continue
+        dev = bool(meta.get("dev")) if isinstance(meta, dict) else False
+        pid = f"{name}@{version}"
+        pkg = _mk(name, version, dev=dev, indirect=pid not in direct)
+        out.setdefault(pkg.id, pkg)
+    return sorted(out.values(), key=lambda p: p.id)
+
+
+def parse_package_json(content: bytes) -> Package | None:
+    """One installed node_modules/<pkg>/package.json -> node-pkg."""
+    try:
+        doc = json.loads(content)
+    except json.JSONDecodeError:
+        return None
+    name, version = doc.get("name"), doc.get("version")
+    if not name or not version:
+        return None
+    pkg = _mk(str(name), str(version))
+    lic = doc.get("license")
+    if isinstance(lic, dict):
+        lic = lic.get("type")
+    if isinstance(lic, str) and lic:
+        pkg.licenses = [lic]
+    return pkg

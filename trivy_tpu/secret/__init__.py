@@ -1,0 +1,3 @@
+from trivy_tpu.secret.scanner import SecretScanner
+
+__all__ = ["SecretScanner"]

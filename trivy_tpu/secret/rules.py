@@ -1,0 +1,180 @@
+"""Built-in secret rules (model: reference pkg/fanal/secret/builtin-rules.go,
+87 rules + 12 allow rules; the rule *shapes* — id/category/severity/regex
+with an optional secret-group + keyword prefilter — are preserved, the
+patterns below are independently authored from the public formats of each
+credential type).
+
+Rule semantics (reference pkg/fanal/secret/scanner.go:89-100):
+- keywords: cheap substring prefilter; the regex only runs if a keyword is
+  present (case-insensitive). Rules without keywords always run.
+- secret_group: named capture group to censor; else the whole match.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Rule:
+    id: str
+    category: str
+    title: str
+    severity: str
+    regex: str
+    keywords: list[str] = field(default_factory=list)
+    secret_group: str = ""
+    path_pattern: str = ""  # fnmatch on file path, empty = any
+
+
+@dataclass
+class AllowRule:
+    id: str
+    description: str = ""
+    regex: str = ""
+    path: str = ""
+
+
+_Q = r"['\"]?"
+
+BUILTIN_RULES: list[Rule] = [
+    Rule("aws-access-key-id", "AWS", "AWS Access Key ID", "CRITICAL",
+         r"(?P<secret>(?:AKIA|AGPA|AIDA|AROA|AIPA|ANPA|ANVA|ASIA)[0-9A-Z]{16})",
+         ["AKIA", "AGPA", "AIDA", "AROA", "AIPA", "ANPA", "ANVA", "ASIA"],
+         "secret"),
+    Rule("aws-secret-access-key", "AWS", "AWS Secret Access Key", "CRITICAL",
+         r"(?i)aws_?(?:secret)?_?(?:access)?_?key(?:_id)?\s*[:=]\s*" + _Q +
+         r"(?P<secret>[A-Za-z0-9/+=]{40})" + _Q,
+         ["aws"], "secret"),
+    Rule("github-pat", "GitHub", "GitHub Personal Access Token", "CRITICAL",
+         r"(?P<secret>ghp_[0-9A-Za-z]{36})", ["ghp_"], "secret"),
+    Rule("github-oauth", "GitHub", "GitHub OAuth Access Token", "CRITICAL",
+         r"(?P<secret>gho_[0-9A-Za-z]{36})", ["gho_"], "secret"),
+    Rule("github-app-token", "GitHub", "GitHub App Token", "CRITICAL",
+         r"(?P<secret>(?:ghu|ghs)_[0-9A-Za-z]{36})", ["ghu_", "ghs_"], "secret"),
+    Rule("github-refresh-token", "GitHub", "GitHub Refresh Token", "CRITICAL",
+         r"(?P<secret>ghr_[0-9A-Za-z]{76})", ["ghr_"], "secret"),
+    Rule("github-fine-grained-pat", "GitHub",
+         "GitHub Fine-grained personal access tokens", "CRITICAL",
+         r"(?P<secret>github_pat_[0-9A-Za-z_]{82})", ["github_pat_"], "secret"),
+    Rule("gitlab-pat", "GitLab", "GitLab Personal Access Token", "CRITICAL",
+         r"(?P<secret>glpat-[0-9A-Za-z\-_]{20})", ["glpat-"], "secret"),
+    Rule("private-key", "AsymmetricPrivateKey", "Asymmetric Private Key",
+         "HIGH",
+         r"(?i)-----\s*?BEGIN[ A-Z0-9_-]*?PRIVATE KEY( BLOCK)?\s*?-----[\s\S]*?----\s*?END[ A-Z0-9_-]*? PRIVATE KEY( BLOCK)?\s*?-----",
+         ["-----"]),
+    Rule("slack-access-token", "Slack", "Slack token", "HIGH",
+         r"(?P<secret>xox[baprs]-(?:[0-9a-zA-Z]{10,48})?)",
+         ["xoxb-", "xoxa-", "xoxp-", "xoxr-", "xoxs-"], "secret"),
+    Rule("slack-web-hook", "Slack", "Slack Webhook", "MEDIUM",
+         r"(?P<secret>https://hooks\.slack\.com/services/T[0-9A-Za-z_]{8,10}/B[0-9A-Za-z_]{8,12}/[0-9A-Za-z_]{23,24})",
+         ["hooks.slack.com"], "secret"),
+    Rule("stripe-publishable-token", "Stripe", "Stripe Publishable Key", "LOW",
+         r"(?P<secret>pk_(?:test|live)_[0-9a-zA-Z]{10,32})", ["pk_test", "pk_live"],
+         "secret"),
+    Rule("stripe-secret-token", "Stripe", "Stripe Secret Key", "CRITICAL",
+         r"(?P<secret>sk_(?:test|live)_[0-9a-zA-Z]{10,32})", ["sk_test", "sk_live"],
+         "secret"),
+    Rule("gcp-service-account", "Google", "Google (GCP) Service Account",
+         "CRITICAL",
+         r'"type":\s*"service_account"', ['"service_account"']),
+    Rule("gcp-api-key", "Google", "GCP API key", "CRITICAL",
+         r"(?P<secret>AIza[0-9A-Za-z\-_]{35})", ["AIza"], "secret"),
+    Rule("heroku-api-key", "Heroku", "Heroku API Key", "HIGH",
+         r"(?i)heroku[a-z0-9_ .,<\-]{0,25}[:=][^,]{0,5}" + _Q +
+         r"(?P<secret>[0-9A-F]{8}-[0-9A-F]{4}-[0-9A-F]{4}-[0-9A-F]{4}-[0-9A-F]{12})" + _Q,
+         ["heroku"], "secret"),
+    Rule("slack-bot-token", "Slack", "Slack Bot token", "HIGH",
+         r"(?P<secret>xoxb-[0-9]{10,13}-[0-9]{10,13}-[0-9a-zA-Z]{24})",
+         ["xoxb-"], "secret"),
+    Rule("npm-access-token", "npm", "npm access token", "CRITICAL",
+         r"(?P<secret>npm_[0-9A-Za-z]{36})", ["npm_"], "secret"),
+    Rule("pypi-upload-token", "PyPI", "PyPI upload token", "HIGH",
+         r"(?P<secret>pypi-AgEIcHlwaS5vcmc[0-9A-Za-z\-_]{50,1000})",
+         ["pypi-AgEIcHlwaS5vcmc"], "secret"),
+    Rule("dockerhub-pat", "Docker", "Docker Hub Personal Access Token", "HIGH",
+         r"(?P<secret>dckr_pat_[0-9A-Za-z_-]{27})", ["dckr_pat_"], "secret"),
+    Rule("jwt-token", "JWT", "JWT token", "MEDIUM",
+         r"(?P<secret>ey[a-zA-Z0-9]{17,}\.ey[a-zA-Z0-9/_\-]{17,}\.(?:[a-zA-Z0-9/_\-]{10,}={0,2})?)",
+         ["eyJ"], "secret"),
+    Rule("basic-auth-url", "General", "Basic auth credentials in URL", "HIGH",
+         r"://[a-zA-Z0-9._%+-]+:(?P<secret>[^@/\s:]{3,})@[a-zA-Z0-9.-]+",
+         ["://"], "secret"),
+    Rule("sendgrid-api-token", "SendGrid", "SendGrid API token", "CRITICAL",
+         r"(?P<secret>SG\.[a-zA-Z0-9_\-.]{66})", ["SG."], "secret"),
+    Rule("twilio-api-key", "Twilio", "Twilio API Key", "MEDIUM",
+         r"(?P<secret>SK[0-9a-fA-F]{32})", ["SK"], "secret"),
+    Rule("mailchimp-api-key", "Mailchimp", "Mailchimp API key", "CRITICAL",
+         r"(?i)(?:mailchimp|mc)[a-z0-9_ .,<\-]{0,25}[:=][^,]{0,5}" + _Q +
+         r"(?P<secret>[0-9a-f]{32}-us[0-9]{1,2})" + _Q,
+         ["mailchimp"], "secret"),
+    Rule("shopify-token", "Shopify", "Shopify token", "HIGH",
+         r"(?P<secret>shp(?:at|ca|pa|ss)_[a-fA-F0-9]{32})",
+         ["shpat_", "shpca_", "shppa_", "shpss_"], "secret"),
+    Rule("alibaba-access-key-id", "AlibabaCloud", "Alibaba AccessKey ID",
+         "HIGH", r"(?P<secret>LTAI[a-zA-Z0-9]{20})", ["LTAI"], "secret"),
+    Rule("hugging-face-access-token", "HuggingFace",
+         "Hugging Face Access Token", "CRITICAL",
+         r"(?P<secret>hf_[A-Za-z0-9]{34,40})", ["hf_"], "secret"),
+    Rule("grafana-api-token", "Grafana", "Grafana API token", "MEDIUM",
+         r"(?P<secret>eyJrIjoi[A-Za-z0-9-_=]{30,100})", ["eyJrIjoi"], "secret"),
+    Rule("openai-api-key", "OpenAI", "OpenAI API Key", "CRITICAL",
+         r"(?P<secret>sk-[A-Za-z0-9]{20}T3BlbkFJ[A-Za-z0-9]{20})",
+         ["T3BlbkFJ"], "secret"),
+    Rule("age-secret-key", "Age", "Age secret key", "MEDIUM",
+         r"(?P<secret>AGE-SECRET-KEY-1[QPZRY9X8GF2TVDW0S3JN54KHCE6MUA7L]{58})",
+         ["AGE-SECRET-KEY-1"], "secret"),
+    Rule("digitalocean-pat", "DigitalOcean",
+         "DigitalOcean Personal Access Token", "CRITICAL",
+         r"(?P<secret>dop_v1_[a-f0-9]{64})", ["dop_v1_"], "secret"),
+    Rule("digitalocean-access-token", "DigitalOcean",
+         "DigitalOcean OAuth Access Token", "CRITICAL",
+         r"(?P<secret>doo_v1_[a-f0-9]{64})", ["doo_v1_"], "secret"),
+    Rule("azure-storage-account-key", "Azure",
+         "Azure Storage Account access key", "CRITICAL",
+         r"(?i)AccountKey=(?P<secret>[A-Za-z0-9/+]{86}==)", ["AccountKey="],
+         "secret"),
+    Rule("telegram-bot-token", "Telegram", "Telegram Bot token", "HIGH",
+         r"(?i)telegram[a-z0-9_ .,<\-]{0,25}[:=][^,]{0,5}" + _Q +
+         r"(?P<secret>[0-9]{8,10}:[A-Za-z0-9_-]{35})" + _Q,
+         ["telegram"], "secret"),
+    Rule("square-access-token", "Square", "Square Access Token", "CRITICAL",
+         r"(?P<secret>sq0atp-[0-9A-Za-z\-_]{22})", ["sq0atp-"], "secret"),
+    Rule("square-oauth-secret", "Square", "Square OAuth Secret", "CRITICAL",
+         r"(?P<secret>sq0csp-[0-9A-Za-z\-_]{43})", ["sq0csp-"], "secret"),
+    Rule("private-packagist-token", "Packagist",
+         "Private Packagist token", "HIGH",
+         r"(?P<secret>packagist_[ou][ru]t_[a-f0-9]{68})",
+         ["packagist_"], "secret"),
+    Rule("mapbox-access-token", "Mapbox", "Mapbox Access Token", "MEDIUM",
+         r"(?P<secret>pk\.[a-z0-9]{60}\.[a-z0-9]{22})", ["pk."], "secret"),
+    Rule("databricks-token", "Databricks", "Databricks API token", "MEDIUM",
+         r"(?P<secret>dapi[a-h0-9]{32})", ["dapi"], "secret"),
+    Rule("generic-password-assignment", "General",
+         "Password in config assignment", "HIGH",
+         r"(?i)(?:password|passwd|pwd)\s*[:=]\s*" + _Q +
+         r"(?P<secret>[^'\"\s]{8,64})" + _Q,
+         ["password", "passwd", "pwd"], "secret",
+         path_pattern="*.env"),
+]
+
+BUILTIN_ALLOW_RULES: list[AllowRule] = [
+    AllowRule("tests", "test fixtures", path=r".*(^|/)(test|tests|testdata|spec|fixtures)/.*"),
+    AllowRule("examples", "docs and examples", path=r".*\.(md|rst|adoc)$"),
+    AllowRule("vendor", "vendored deps", path=r".*(^|/)vendor/.*"),
+    AllowRule("node-modules-docs", "node_modules docs",
+              path=r".*(^|/)node_modules/.*\.(md|markdown|txt)$"),
+    AllowRule("locale", "locale data", path=r".*(^|/)locale/.*"),
+    AllowRule("socket", "unix sockets", path=r".*\.sock$"),
+    AllowRule("placeholder-password", "common placeholder values",
+              regex=r"(?i)^(?:\$\{[^}]*\}|<[^>]*>|%[^%]*%|\*{3,}|x{4,}|your[-_].*|changeme|placeholder|example.*|dummy.*|sample.*)$"),
+]
+
+# binary file extensions never scanned (reference skips binaries)
+SKIP_EXTENSIONS = {
+    ".png", ".jpg", ".jpeg", ".gif", ".bmp", ".ico", ".webp", ".svg",
+    ".mp3", ".mp4", ".avi", ".mov", ".zip", ".gz", ".tar", ".tgz", ".xz",
+    ".bz2", ".7z", ".rar", ".jar", ".war", ".ear", ".whl", ".so", ".dylib",
+    ".dll", ".a", ".o", ".pyc", ".class", ".ttf", ".otf", ".woff", ".woff2",
+    ".eot", ".pdf", ".min.js", ".min.css",
+}

@@ -1,0 +1,271 @@
+"""Secret scanner (reference pkg/fanal/secret/scanner.go).
+
+Scan pipeline per file (scanner.go:377-463):
+  keyword prefilter -> regex findall -> allow-rule filtering -> censor the
+  secret group -> line-context extraction.
+
+Custom rules/allow-rules/exclude-blocks load from a YAML config
+(scanner.go:277 ParseConfig). The keyword prefilter is the stage the TPU
+batch kernel accelerates (trivy_tpu.ops.secret_prefilter): files are
+chunked into fixed byte tensors and all rule keywords are matched in one
+device pass; only files with keyword hits reach the host regex engine.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from trivy_tpu.log import logger
+from trivy_tpu.secret.rules import (
+    BUILTIN_ALLOW_RULES,
+    BUILTIN_RULES,
+    SKIP_EXTENSIONS,
+    AllowRule,
+    Rule,
+)
+from trivy_tpu.types.artifact import Secret, SecretFinding
+
+_log = logger("secret")
+
+
+@dataclass
+class CompiledRule:
+    rule: Rule
+    regex: re.Pattern
+    keywords: list[bytes]
+    path_rx: re.Pattern | None
+
+
+@dataclass
+class SecretConfig:
+    custom_rules: list[Rule] = field(default_factory=list)
+    custom_allow_rules: list[AllowRule] = field(default_factory=list)
+    enable_builtin_rules: list[str] = field(default_factory=list)
+    disable_rules: list[str] = field(default_factory=list)
+    disable_allow_rules: list[str] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "SecretConfig":
+        import yaml
+
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        cfg = cls()
+        for r in doc.get("rules") or []:
+            cfg.custom_rules.append(Rule(
+                id=r.get("id", ""), category=r.get("category", "General"),
+                title=r.get("title", ""),
+                severity=str(r.get("severity", "UNKNOWN")).upper(),
+                regex=r.get("regex", ""),
+                keywords=r.get("keywords", []) or [],
+                secret_group=r.get("secret-group-name", ""),
+                path_pattern=r.get("path", ""),
+            ))
+        for r in doc.get("allow-rules") or []:
+            cfg.custom_allow_rules.append(AllowRule(
+                id=r.get("id", ""), description=r.get("description", ""),
+                regex=r.get("regex", ""), path=r.get("path", ""),
+            ))
+        cfg.enable_builtin_rules = doc.get("enable-builtin-rules") or []
+        cfg.disable_rules = doc.get("disable-rules") or []
+        cfg.disable_allow_rules = doc.get("disable-allow-rules") or []
+        return cfg
+
+
+class SecretScanner:
+    def __init__(self, config: SecretConfig | None = None):
+        self._bank = None
+        self._kw_rules = None
+        self._keyword_less = None
+        config = config or SecretConfig()
+        rules = list(BUILTIN_RULES)
+        if config.enable_builtin_rules:
+            enabled = set(config.enable_builtin_rules)
+            rules = [r for r in rules if r.id in enabled]
+        rules += config.custom_rules
+        disabled = set(config.disable_rules)
+        rules = [r for r in rules if r.id not in disabled]
+
+        self.rules: list[CompiledRule] = []
+        for r in rules:
+            try:
+                self.rules.append(CompiledRule(
+                    rule=r,
+                    regex=re.compile(r.regex.encode()),
+                    keywords=[k.lower().encode() for k in r.keywords],
+                    path_rx=re.compile(re.escape(r.path_pattern).replace(r"\*", ".*") + "$")
+                    if r.path_pattern else None,
+                ))
+            except re.error as e:
+                _log.warn("invalid secret rule regex", rule=r.id, err=str(e))
+
+        allow = list(BUILTIN_ALLOW_RULES) + config.custom_allow_rules
+        disabled_allow = set(config.disable_allow_rules)
+        self.allow_rules = []
+        for a in allow:
+            if a.id in disabled_allow:
+                continue
+            self.allow_rules.append((
+                a,
+                re.compile(a.path) if a.path else None,
+                re.compile(a.regex.encode()) if a.regex else None,
+            ))
+
+    # ------------------------------------------------------------ scan
+
+    def skip_file(self, path: str) -> bool:
+        low = path.lower()
+        return any(low.endswith(ext) for ext in SKIP_EXTENSIONS)
+
+    def path_allowed(self, path: str) -> bool:
+        """True if a path-only allow rule excludes this whole path."""
+        for _a, path_rx, content_rx in self.allow_rules:
+            if path_rx is not None and content_rx is None and path_rx.match(path):
+                return True
+        return False
+
+    def _allowed(self, path: str, secret: bytes) -> bool:
+        """Value allow rules; a rule with BOTH path and regex only applies
+        where its path matches."""
+        for _a, path_rx, content_rx in self.allow_rules:
+            if content_rx is None:
+                continue
+            if path_rx is not None and not path_rx.match(path):
+                continue
+            if content_rx.match(secret):
+                return True
+        return False
+
+    # ------------------------------------------------------------ batch
+
+    def scan_files(self, batch: list[tuple[str, bytes]],
+                   use_device: bool = True) -> list[Secret]:
+        """Batched scan: one device keyword-prefilter pass over all files,
+        then the regex engine only on (file, rule) pairs with keyword hits
+        (the TPU replacement for the reference's per-file loop)."""
+        from trivy_tpu.ops.secret_prefilter import (
+            DevicePrefilter,
+            HostPrefilter,
+            KeywordBank,
+        )
+
+        eligible = [
+            (i, path, content) for i, (path, content) in enumerate(batch)
+            if not self.skip_file(path) and not self.path_allowed(path)
+            and b"\x00" not in content[:8000]
+        ]
+        if not eligible:
+            return []
+        if self._bank is None:
+            kw: list[bytes] = []
+            self._kw_rules: list[list[CompiledRule]] = []
+            seen: dict[bytes, int] = {}
+            for cr in self.rules:
+                for k in cr.keywords:
+                    if k in seen:
+                        self._kw_rules[seen[k]].append(cr)
+                    else:
+                        seen[k] = len(kw)
+                        kw.append(k)
+                        self._kw_rules.append([cr])
+            self._bank = KeywordBank(kw)
+            self._keyword_less = [cr for cr in self.rules if not cr.keywords]
+        contents = [c for (_i, _p, c) in eligible]
+        prefilter = None
+        if use_device:
+            try:
+                prefilter = DevicePrefilter(self._bank)
+                hits = prefilter.keyword_hits(contents)
+            except Exception as e:  # no device / compile issue -> host
+                _log.debug("device prefilter failed, using host", err=str(e))
+                prefilter = None
+        if prefilter is None:
+            hits = HostPrefilter(self._bank).keyword_hits(contents)
+        out = []
+        for (orig_i, path, content), hit_row in zip(eligible, hits):
+            rules = list(self._keyword_less)
+            seen_ids = set()
+            for ki in np.nonzero(hit_row)[0]:
+                for cr in self._kw_rules[ki]:
+                    if id(cr) not in seen_ids:
+                        seen_ids.add(id(cr))
+                        rules.append(cr)
+            secret = self.scan_file(path, content, rules=rules)
+            if secret is not None:
+                out.append(secret)
+        return out
+
+    def candidate_rules(self, content_lower: bytes) -> list[CompiledRule]:
+        """Keyword prefilter (scanner.go:174-186): a rule runs only if one
+        of its keywords occurs; keyword-less rules always run."""
+        out = []
+        for cr in self.rules:
+            if not cr.keywords or any(k in content_lower for k in cr.keywords):
+                out.append(cr)
+        return out
+
+    def scan_file(self, path: str, content: bytes,
+                  rules: list[CompiledRule] | None = None) -> Secret | None:
+        if self.skip_file(path) or self.path_allowed(path):
+            return None
+        if b"\x00" in content[:8000]:
+            return None  # binary
+        if rules is None:
+            rules = self.candidate_rules(content.lower())
+        findings: list[SecretFinding] = []
+        for cr in rules:
+            if cr.path_rx is not None and not cr.path_rx.match(path):
+                continue
+            for m in cr.regex.finditer(content):
+                secret_bytes, start, end = self._secret_span(cr, m)
+                if secret_bytes is None:
+                    continue
+                if self._allowed(path, secret_bytes):
+                    continue
+                findings.append(self._finding(cr, content, start, end))
+        if not findings:
+            return None
+        findings.sort(key=lambda f: (f.start_line, f.rule_id))
+        return Secret(file_path=path, findings=findings)
+
+    def _secret_span(self, cr: CompiledRule, m: re.Match):
+        if cr.rule.secret_group:
+            try:
+                s = m.group(cr.rule.secret_group)
+            except IndexError:
+                return None, 0, 0
+            if s is None:
+                return None, 0, 0
+            return s, m.start(cr.rule.secret_group), m.end(cr.rule.secret_group)
+        return m.group(0), m.start(), m.end()
+
+    def _finding(self, cr: CompiledRule, content: bytes,
+                 start: int, end: int) -> SecretFinding:
+        start_line = content.count(b"\n", 0, start) + 1
+        end_line = content.count(b"\n", 0, end) + 1
+        # censored match line (scanner.go findLocation + censoring)
+        line_start = content.rfind(b"\n", 0, start) + 1
+        line_end = content.find(b"\n", end)
+        if line_end < 0:
+            line_end = len(content)
+        censored = (
+            content[line_start:start]
+            + b"*" * min(end - start, 60)
+            + content[end:line_end]
+        )
+        match_text = censored.decode("utf-8", "replace")
+        if len(match_text) > 120:
+            match_text = match_text[:117] + "..."
+        return SecretFinding(
+            rule_id=cr.rule.id,
+            category=cr.rule.category,
+            severity=cr.rule.severity,
+            title=cr.rule.title,
+            start_line=start_line,
+            end_line=end_line,
+            match=match_text,
+            offset=start,
+        )
