@@ -1,0 +1,223 @@
+"""Report-format writers: SARIF / CycloneDX / SPDX / GitHub / cosign /
+template round-trips over a synthetic report (reference pkg/report tests)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from trivy_tpu.report.cosign import render_cosign_vuln
+from trivy_tpu.report.cyclonedx import render_cyclonedx
+from trivy_tpu.report.github import render_github
+from trivy_tpu.report.sarif import render_sarif
+from trivy_tpu.report.spdx import render_spdx_json
+from trivy_tpu.report.template import render_template, render_template_str
+from trivy_tpu.types.artifact import OS, Layer, PkgIdentifier, Package
+from trivy_tpu.types.enums import ResultClass
+from trivy_tpu.types.report import (
+    DetectedMisconfiguration,
+    DetectedSecret,
+    DetectedVulnerability,
+    Metadata,
+    Report,
+    Result,
+    VulnerabilityInfo,
+)
+
+
+@pytest.fixture()
+def report() -> Report:
+    os_pkg = Package(
+        name="musl", version="1.1.22", release="r3", id="musl@1.1.22-r3",
+        identifier=PkgIdentifier(purl="pkg:apk/alpine/musl@1.1.22-r3"),
+        src_name="musl", src_version="1.1.22", src_release="r3",
+        licenses=["MIT"],
+    )
+    app_pkg = Package(
+        name="lodash", version="4.17.4", id="lodash@4.17.4",
+        identifier=PkgIdentifier(purl="pkg:npm/lodash@4.17.4"),
+        depends_on=[],
+    )
+    vuln = DetectedVulnerability(
+        vulnerability_id="CVE-2019-14697",
+        pkg_id="musl@1.1.22-r3",
+        pkg_name="musl",
+        installed_version="1.1.22-r3",
+        fixed_version="1.1.22-r4",
+        primary_url="https://avd.aquasec.com/nvd/cve-2019-14697",
+        layer=Layer(diff_id="sha256:beee"),
+        info=VulnerabilityInfo(
+            title="musl x87 overflow",
+            description="stack underflow in math code",
+            severity="CRITICAL",
+            references=["https://nvd.example/CVE-2019-14697"],
+            cwe_ids=["CWE-787"],
+        ),
+    )
+    misconf = DetectedMisconfiguration(
+        type="dockerfile", id="DS002", avd_id="AVD-DS-0002",
+        title="root user", description="runs as root",
+        message="Specify USER", severity="HIGH", status="FAIL",
+    )
+    secret = DetectedSecret(
+        rule_id="aws-access-key-id", category="AWS", severity="CRITICAL",
+        title="AWS Access Key ID", start_line=3, end_line=3,
+        match="AKIA****************",
+    )
+    return Report(
+        artifact_name="alpine:3.10",
+        artifact_type="container_image",
+        metadata=Metadata(
+            os=OS(family="alpine", name="3.10.2"),
+            image_id="sha256:abcd",
+            repo_tags=["alpine:3.10"],
+            repo_digests=["alpine@sha256:feed"],
+            diff_ids=["sha256:beee"],
+        ),
+        results=[
+            Result(target="alpine:3.10 (alpine 3.10.2)",
+                   result_class=ResultClass.OS_PKGS, type="alpine",
+                   packages=[os_pkg], vulnerabilities=[vuln]),
+            Result(target="package-lock.json",
+                   result_class=ResultClass.LANG_PKGS, type="npm",
+                   packages=[app_pkg]),
+            Result(target="Dockerfile", result_class=ResultClass.CONFIG,
+                   type="dockerfile", misconfigurations=[misconf]),
+            Result(target="config.py", result_class=ResultClass.SECRET,
+                   secrets=[secret]),
+        ],
+    )
+
+
+def test_sarif(report):
+    doc = json.loads(render_sarif(report))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert "CVE-2019-14697" in rule_ids
+    assert "DS002" in rule_ids
+    assert "aws-access-key-id" in rule_ids
+    results = run["results"]
+    assert len(results) == 3
+    cve = next(r for r in results if r["ruleId"] == "CVE-2019-14697")
+    assert cve["level"] == "error"
+    assert cve["ruleIndex"] == rule_ids.index("CVE-2019-14697")
+    # rules are deduplicated
+    assert len(set(rule_ids)) == len(rule_ids)
+
+
+def test_cyclonedx(report):
+    doc = json.loads(render_cyclonedx(report))
+    assert doc["bomFormat"] == "CycloneDX"
+    assert doc["specVersion"] == "1.6"
+    assert doc["serialNumber"].startswith("urn:uuid:")
+    assert doc["metadata"]["component"]["type"] == "container"
+    comps = doc["components"]
+    types = {c["type"] for c in comps}
+    assert "operating-system" in types
+    purls = {c.get("purl") for c in comps}
+    assert "pkg:apk/alpine/musl@1.1.22-r3" in purls
+    assert "pkg:npm/lodash@4.17.4" in purls
+    vulns = doc["vulnerabilities"]
+    assert vulns[0]["id"] == "CVE-2019-14697"
+    assert vulns[0]["affects"][0]["versions"][0]["version"] == "1.1.22-r3"
+    assert vulns[0]["cwes"] == [787]
+    # dependency closure includes the root
+    refs = {d["ref"] for d in doc["dependencies"]}
+    assert doc["metadata"]["component"]["bom-ref"] in refs
+
+
+def test_spdx(report):
+    doc = json.loads(render_spdx_json(report))
+    assert doc["spdxVersion"] == "SPDX-2.3"
+    assert doc["SPDXID"] == "SPDXRef-DOCUMENT"
+    names = {p["name"] for p in doc["packages"]}
+    assert {"alpine:3.10", "alpine", "musl", "lodash"} <= names
+    rel_types = {r["relationshipType"] for r in doc["relationships"]}
+    assert {"DESCRIBES", "CONTAINS"} <= rel_types
+    musl = next(p for p in doc["packages"] if p["name"] == "musl")
+    assert musl["versionInfo"] == "1.1.22-r3"
+    assert musl["licenseDeclared"] == "MIT"
+    assert musl["externalRefs"][0]["referenceType"] == "purl"
+
+
+def test_github(report):
+    doc = json.loads(render_github(report))
+    assert doc["detector"]["name"] == "trivy-tpu"
+    mans = doc["manifests"]
+    assert "package-lock.json" in mans
+    resolved = mans["package-lock.json"]["resolved"]
+    assert resolved["lodash"]["package_url"] == "pkg:npm/lodash@4.17.4"
+
+
+def test_cosign(report):
+    doc = json.loads(render_cosign_vuln(report))
+    assert doc["scanner"]["result"]["ArtifactName"] == "alpine:3.10"
+    assert doc["metadata"]["scanStartedOn"]
+
+
+def test_template_builtin_junit(report):
+    out = render_template(report, "@contrib/junit.tpl")
+    assert "<testsuites>" in out
+    assert 'name="[CRITICAL] CVE-2019-14697"' in out
+    assert "musl x87 overflow" in out
+
+
+def test_template_builtin_gitlab(report):
+    out = render_template(report, "gitlab-codequality")
+    doc = json.loads(out)
+    assert doc[0]["severity"] == "critical"
+    assert doc[0]["location"]["path"] == "alpine:3.10 (alpine 3.10.2)"
+
+
+def test_template_builtin_html(report):
+    out = render_template(report, "html")
+    assert "<table>" in out and "CVE-2019-14697" in out
+
+
+def test_template_engine_constructs():
+    data = {"Results": [
+        {"Target": "a", "Vulnerabilities": [
+            {"VulnerabilityID": "CVE-1", "Severity": "HIGH"},
+            {"VulnerabilityID": "CVE-2", "Severity": "LOW"},
+        ]},
+    ]}
+    tpl = (
+        "{{ range .Results }}{{ .Target }}:"
+        "{{ range $i, $v := .Vulnerabilities }}"
+        "{{ if gt $i 0 }},{{ end }}{{ $v.VulnerabilityID }}"
+        "{{ if eq $v.Severity \"HIGH\" }}(!){{ end }}"
+        "{{ end }}{{ end }}"
+    )
+    assert render_template_str(tpl, data) == "a:CVE-1(!),CVE-2"
+
+
+def test_template_pipes_and_funcs():
+    assert render_template_str('{{ "HeLLo" | toLower }}', {}) == "hello"
+    assert render_template_str('{{ printf "%s-%s" "a" "b" }}', {}) == "a-b"
+    assert render_template_str(
+        '{{ "<x>" | escapeXML }}', {}) == "&lt;x&gt;"
+    assert render_template_str(
+        '{{ len .Items }}', {"Items": [1, 2, 3]}) == "3"
+    assert render_template_str(
+        '{{ if .Missing }}y{{ else }}n{{ end }}', {}) == "n"
+    assert render_template_str(
+        '{{ $x := "v" }}{{ $x }}', {}) == "v"
+    # whitespace trimming
+    assert render_template_str("a {{- \"b\" -}} c", {}) == "abc"
+
+
+def test_convert_roundtrip(report, tmp_path, capsys):
+    from trivy_tpu.cli.main import main
+    from trivy_tpu.report.json_writer import render_json
+
+    src = tmp_path / "report.json"
+    src.write_text(render_json(report))
+    out = tmp_path / "out.sarif"
+    rc = main(["convert", "--format", "sarif",
+               "--output", str(out), str(src)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    ids = [r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]]
+    assert "CVE-2019-14697" in ids

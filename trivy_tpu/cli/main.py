@@ -25,8 +25,10 @@ def _add_global_flags(p: argparse.ArgumentParser) -> None:
 
 
 def _add_scan_flags(p: argparse.ArgumentParser) -> None:
+    from trivy_tpu.report.writer import FORMATS
+
     p.add_argument("--format", "-f", default="table",
-                   help="output format (table,json,sarif,cyclonedx,spdx-json,github,template)")
+                   help=f"output format ({','.join(FORMATS)})")
     p.add_argument("--output", "-o", default=None, help="output file")
     p.add_argument("--template", "-t", default=None, help="go-style template path/string")
     p.add_argument("--severity", "-s", default=None,

@@ -1,0 +1,67 @@
+"""GitHub dependency snapshot writer (reference pkg/report/github/github.go).
+
+The snapshot maps each result target (manifest path) to its resolved
+package purls; intended for POST /repos/{owner}/{repo}/dependency-graph/
+snapshots.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import trivy_tpu
+from trivy_tpu.types.report import Report
+from trivy_tpu.utils import clock
+
+
+def render_github(report: Report) -> str:
+    manifests = {}
+    for res in report.results:
+        if not res.packages:
+            continue
+        resolved = {}
+        for pkg in res.packages:
+            purl = pkg.identifier.purl
+            if not purl:
+                continue
+            resolved[pkg.name] = {
+                "package_url": purl,
+                "relationship": "indirect" if pkg.indirect else "direct",
+                "scope": "development" if pkg.dev else "runtime",
+                "dependencies": sorted(pkg.depends_on or []),
+            }
+        manifests[res.target] = {
+            "name": res.target,
+            "file": {"source_location": res.target},
+            "resolved": resolved,
+        }
+
+    snapshot = {
+        "version": 0,
+        "detector": {
+            "name": "trivy-tpu",
+            "version": trivy_tpu.__version__,
+            "url": "https://github.com/trivy-tpu",
+        },
+        "metadata": {
+            "aquasecurity:trivy:RepoDigest":
+                report.metadata.repo_digests[0]
+                if report.metadata.repo_digests else "",
+            "aquasecurity:trivy:RepoTag":
+                report.metadata.repo_tags[0]
+                if report.metadata.repo_tags else "",
+        },
+        "scanned": clock.now_rfc3339(),
+        "job": {
+            "correlator": "_".join(filter(None, [
+                os.environ.get("GITHUB_WORKFLOW", ""),
+                os.environ.get("GITHUB_JOB", ""),
+            ])) or "trivy-tpu",
+            "id": os.environ.get("GITHUB_RUN_ID", ""),
+        },
+        "ref": os.environ.get("GITHUB_REF", ""),
+        "sha": os.environ.get("GITHUB_SHA", ""),
+        "manifests": manifests,
+    }
+    return json.dumps(snapshot, indent=2, ensure_ascii=False) + "\n"

@@ -1,0 +1,210 @@
+"""SARIF 2.1.0 writer (reference pkg/report/sarif.go).
+
+One run, tool.driver = trivy-tpu; a deduplicated rule per finding ID;
+one result per detected vulnerability / misconfiguration / secret /
+license, located at the scanned target (or package file path when known).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+import trivy_tpu
+from trivy_tpu.types.enums import Severity
+from trivy_tpu.types.report import Report
+
+_SARIF_VERSION = "2.1.0"
+_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+# reference pkg/report/sarif.go toSarifErrorLevel
+_LEVELS = {
+    Severity.CRITICAL: "error",
+    Severity.HIGH: "error",
+    Severity.MEDIUM: "warning",
+    Severity.LOW: "note",
+    Severity.UNKNOWN: "note",
+}
+
+# SARIF security-severity property (GitHub code-scanning convention)
+_SECURITY_SEVERITY = {
+    Severity.CRITICAL: "9.5",
+    Severity.HIGH: "8.0",
+    Severity.MEDIUM: "5.5",
+    Severity.LOW: "2.0",
+    Severity.UNKNOWN: "0.0",
+}
+
+
+def _clean_uri(target: str) -> str:
+    # artifactLocation.uri must be a valid URI: strip scheme-ish prefixes
+    # and leading slashes the way the reference does for image refs
+    out = re.sub(r"^(oci|docker|container-image)://", "", target or "")
+    return out.lstrip("/") or "."
+
+
+def _rule(rule_id: str, name: str, short: str, full: str, help_uri: str,
+          severity: Severity, tags: list[str]) -> dict:
+    help_text = f"Vulnerability {rule_id}" if "CVE" in rule_id else short
+    rule = {
+        "id": rule_id,
+        "name": name,
+        "shortDescription": {"text": short},
+        "fullDescription": {"text": full},
+        "defaultConfiguration": {"level": _LEVELS[severity]},
+        "properties": {
+            "precision": "very-high",
+            "security-severity": _SECURITY_SEVERITY[severity],
+            "tags": ["security", *tags],
+        },
+    }
+    if help_uri:
+        rule["helpUri"] = help_uri
+        rule["help"] = {
+            "text": f"{help_text}\n{help_uri}",
+            "markdown": f"**{help_text}**\n\n{help_uri}",
+        }
+    return rule
+
+
+def _result(rule_id: str, rule_index: int, level: str, message: str,
+            uri: str, start_line: int = 1, end_line: int = 1) -> dict:
+    return {
+        "ruleId": rule_id,
+        "ruleIndex": rule_index,
+        "level": level,
+        "message": {"text": message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": uri, "uriBaseId": "ROOTPATH"},
+                "region": {
+                    "startLine": max(start_line, 1),
+                    "startColumn": 1,
+                    "endLine": max(end_line, start_line, 1),
+                    "endColumn": 1,
+                },
+            },
+            "message": {"text": uri},
+        }],
+    }
+
+
+def render_sarif(report: Report) -> str:
+    rules: list[dict] = []
+    rule_index: dict[str, int] = {}
+    results: list[dict] = []
+
+    def add_rule(rid: str, **kw) -> int:
+        if rid not in rule_index:
+            rule_index[rid] = len(rules)
+            rules.append(_rule(rid, **kw))
+        return rule_index[rid]
+
+    for res in report.results:
+        uri = _clean_uri(res.target)
+        for v in res.vulnerabilities:
+            sev = v.severity
+            title = (v.info.title if v.info else "") or v.vulnerability_id
+            desc = (v.info.description if v.info else "") or title
+            idx = add_rule(
+                v.vulnerability_id,
+                name="OsPackageVulnerability"
+                if res.result_class and "os" in str(res.result_class)
+                else "LanguageSpecificPackageVulnerability",
+                short=title,
+                full=desc,
+                help_uri=v.primary_url,
+                severity=sev,
+                tags=["vulnerability", str(sev)],
+            )
+            message = (
+                f"Package: {v.pkg_name}\n"
+                f"Installed Version: {v.installed_version}\n"
+                f"Vulnerability {v.vulnerability_id}\n"
+                f"Severity: {sev}\n"
+                f"Fixed Version: {v.fixed_version or ''}\n"
+                f"Link: [{v.vulnerability_id}]({v.primary_url})"
+            )
+            results.append(_result(
+                v.vulnerability_id, idx, _LEVELS[sev], message,
+                _clean_uri(v.pkg_path) if v.pkg_path else uri,
+            ))
+        for m in res.misconfigurations:
+            sev = Severity.parse(m.severity)
+            idx = add_rule(
+                m.id, name="Misconfiguration", short=m.title,
+                full=m.description, help_uri=m.primary_url, severity=sev,
+                tags=["misconfiguration", str(sev)],
+            )
+            message = (
+                f"Artifact: {res.target}\nType: {res.type}\n"
+                f"Vulnerability {m.id}\nSeverity: {sev}\n"
+                f"Message: {m.message}\n"
+                f"Link: [{m.id}]({m.primary_url})"
+            )
+            results.append(_result(
+                m.id, idx, _LEVELS[sev], message, uri,
+                m.cause_metadata.start_line, m.cause_metadata.end_line,
+            ))
+        for s in res.secrets:
+            sev = Severity.parse(s.severity)
+            idx = add_rule(
+                s.rule_id, name="Secret", short=s.title, full=s.title,
+                help_uri="", severity=sev, tags=["secret", str(sev)],
+            )
+            message = (
+                f"Artifact: {res.target}\nType: {res.type}\n"
+                f"Secret {s.title}\nSeverity: {sev}\n"
+                f"Match: {s.match}"
+            )
+            results.append(_result(
+                s.rule_id, idx, _LEVELS[sev], message, uri,
+                s.start_line, s.end_line,
+            ))
+        for lic in res.licenses:
+            sev = Severity.parse(lic.severity)
+            rid = f"license-{lic.name}"
+            idx = add_rule(
+                rid, name="License", short=f"License {lic.name}",
+                full=f"License {lic.name} (category: {lic.category})",
+                help_uri=lic.link, severity=sev, tags=["license", str(sev)],
+            )
+            message = (
+                f"Artifact: {res.target}\nLicense {lic.name}\n"
+                f"Category: {lic.category}\nPackage: {lic.pkg_name}"
+            )
+            results.append(_result(
+                rid, idx, _LEVELS[sev], message,
+                _clean_uri(lic.file_path) if lic.file_path else uri,
+            ))
+
+    doc = {
+        "version": _SARIF_VERSION,
+        "$schema": _SCHEMA,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "fullName": "trivy-tpu: TPU-native vulnerability scanner",
+                    "informationUri": "https://github.com/trivy-tpu",
+                    "name": "trivy-tpu",
+                    "rules": rules,
+                    "version": trivy_tpu.__version__,
+                },
+            },
+            "results": results,
+            "columnKind": "utf16CodeUnits",
+            "originalUriBaseIds": {
+                "ROOTPATH": {"uri": "file:///"},
+            },
+            "properties": {
+                "imageName": report.artifact_name,
+                "repoTags": report.metadata.repo_tags,
+                "repoDigests": report.metadata.repo_digests,
+                "imageID": report.metadata.image_id,
+            },
+        }],
+    }
+    return json.dumps(doc, indent=2, ensure_ascii=False) + "\n"

@@ -7,7 +7,7 @@ import sys
 from trivy_tpu.types.report import Report
 
 FORMATS = ("table", "json", "sarif", "cyclonedx", "spdx-json", "github",
-           "template")
+           "cosign-vuln", "template")
 
 
 def write_report(
@@ -41,6 +41,10 @@ def write_report(
         from trivy_tpu.report.github import render_github
 
         text = render_github(report)
+    elif fmt == "cosign-vuln":
+        from trivy_tpu.report.cosign import render_cosign_vuln
+
+        text = render_cosign_vuln(report)
     elif fmt == "template":
         from trivy_tpu.report.template import render_template
 
