@@ -105,6 +105,10 @@ def test_sarif(report):
     assert cve["ruleIndex"] == rule_ids.index("CVE-2019-14697")
     # rules are deduplicated
     assert len(set(rule_ids)) == len(rule_ids)
+    # OS vulnerabilities are named as such
+    cve_rule = run["tool"]["driver"]["rules"][
+        rule_ids.index("CVE-2019-14697")]
+    assert cve_rule["name"] == "OsPackageVulnerability"
 
 
 def test_cyclonedx(report):
@@ -126,14 +130,24 @@ def test_cyclonedx(report):
     # dependency closure includes the root
     refs = {d["ref"] for d in doc["dependencies"]}
     assert doc["metadata"]["component"]["bom-ref"] in refs
+    # OS packages hang off the operating-system component, not a
+    # spurious application holder
+    os_comp = next(c for c in comps if c["type"] == "operating-system")
+    os_deps = next(d for d in doc["dependencies"]
+                   if d["ref"] == os_comp["bom-ref"])
+    assert "pkg:apk/alpine/musl@1.1.22-r3" in os_deps["dependsOn"]
+    app_holders = [c for c in comps if c["type"] == "application"]
+    assert all("alpine" not in c["name"] for c in app_holders)
 
 
 def test_spdx(report):
     doc = json.loads(render_spdx_json(report))
     assert doc["spdxVersion"] == "SPDX-2.3"
     assert doc["SPDXID"] == "SPDXRef-DOCUMENT"
-    names = {p["name"] for p in doc["packages"]}
-    assert {"alpine:3.10", "alpine", "musl", "lodash"} <= names
+    names = [p["name"] for p in doc["packages"]]
+    assert {"alpine:3.10", "alpine", "musl", "lodash"} <= set(names)
+    # the OS holder is not duplicated as an application holder
+    assert names.count("alpine") == 1
     rel_types = {r["relationshipType"] for r in doc["relationships"]}
     assert {"DESCRIBES", "CONTAINS"} <= rel_types
     musl = next(p for p in doc["packages"] if p["name"] == "musl")
@@ -206,6 +220,13 @@ def test_template_pipes_and_funcs():
         '{{ $x := "v" }}{{ $x }}', {}) == "v"
     # whitespace trimming
     assert render_template_str("a {{- \"b\" -}} c", {}) == "abc"
+    # piped None keeps its arg slot (len handles it -> 0)
+    assert render_template_str('{{ .Missing | len }}', {}) == "0"
+    # unknown functions and function errors fail loudly
+    with pytest.raises(ValueError):
+        render_template_str('{{ "x" | toLowr }}', {})
+    with pytest.raises(ValueError):
+        render_template_str('{{ lt "a" 1 }}', {})
 
 
 def test_convert_roundtrip(report, tmp_path, capsys):

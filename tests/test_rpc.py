@@ -1,0 +1,152 @@
+"""Client/server RPC round-trip (reference integration client_server_test):
+server holds the DB + cache, client runs analysis and ships blobs + scan
+over HTTP; token auth; DB hot-swap quiesce."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from trivy_tpu.cache.cache import MemoryCache
+from trivy_tpu.db import Advisory, AdvisoryDB
+from trivy_tpu.db.model import VulnerabilityMeta
+from trivy_tpu.detector.engine import MatchEngine
+from trivy_tpu.rpc.client import RemoteCache, RemoteDriver, RPCError
+from trivy_tpu.rpc.server import Server
+from trivy_tpu.types.scan import ScanOptions
+
+
+def _db() -> AdvisoryDB:
+    db = AdvisoryDB()
+    db.put_advisory("npm::ghsa", "lodash", Advisory(
+        vulnerability_id="CVE-2019-10744",
+        vulnerable_versions=["<4.17.12"],
+    ))
+    db.put_meta(VulnerabilityMeta.from_json("CVE-2019-10744", {
+        "Title": "prototype pollution", "Severity": "CRITICAL",
+    }))
+    return db
+
+
+@pytest.fixture()
+def server():
+    engine = MatchEngine(_db(), use_device=False)
+    srv = Server(engine, MemoryCache(), host="localhost", port=0)
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture()
+def token_server():
+    engine = MatchEngine(_db(), use_device=False)
+    srv = Server(engine, MemoryCache(), host="localhost", port=0,
+                 token="sekrit")
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def _blob() -> dict:
+    return {
+        "schema_version": 2,
+        "applications": [{
+            "type": "npm",
+            "file_path": "package-lock.json",
+            "packages": [{
+                "id": "lodash@4.17.4", "name": "lodash",
+                "version": "4.17.4",
+                "identifier": {"purl": "pkg:npm/lodash@4.17.4"},
+            }],
+        }],
+    }
+
+
+def test_health_and_version(server):
+    with urllib.request.urlopen(server.address + "/healthz") as r:
+        assert r.read() == b"ok"
+    with urllib.request.urlopen(server.address + "/version") as r:
+        assert "Version" in json.loads(r.read())
+
+
+def test_client_server_scan(server):
+    cache = RemoteCache(server.address)
+    missing_artifact, missing = cache.missing_blobs("sha256:a", ["sha256:b"])
+    assert missing_artifact and missing == ["sha256:b"]
+
+    cache.put_blob("sha256:b", _blob())
+    cache.put_artifact("sha256:a", {"schema_version": 2})
+    missing_artifact, missing = cache.missing_blobs("sha256:a", ["sha256:b"])
+    assert not missing_artifact and missing == []
+
+    driver = RemoteDriver(server.address)
+    results, os_found = driver.scan(
+        "myapp", "sha256:a", ["sha256:b"], ScanOptions()
+    )
+    assert not os_found.detected
+    assert len(results) == 1
+    vulns = results[0].vulnerabilities
+    assert [v.vulnerability_id for v in vulns] == ["CVE-2019-10744"]
+    assert vulns[0].installed_version == "4.17.4"
+    assert vulns[0].fixed_version == "4.17.12"
+    assert vulns[0].info and vulns[0].info.severity == "CRITICAL"
+
+
+def test_token_auth(token_server):
+    bad = RemoteCache(token_server.address, token="wrong")
+    with pytest.raises(RPCError):
+        bad.missing_blobs("sha256:a", [])
+    good = RemoteCache(token_server.address, token="sekrit")
+    missing_artifact, _ = good.missing_blobs("sha256:a", [])
+    assert missing_artifact
+
+    # health endpoint is not token-gated (reference listen.go:112)
+    with urllib.request.urlopen(token_server.address + "/healthz") as r:
+        assert r.read() == b"ok"
+
+
+def test_db_hot_swap(tmp_path):
+    db_dir = tmp_path / "db"
+    _db().save(str(db_dir))
+    engine = MatchEngine(AdvisoryDB.load(str(db_dir)), use_device=False)
+    srv = Server(engine, MemoryCache(), host="localhost", port=0,
+                 db_path=str(db_dir))
+    srv.start()
+    try:
+        cache = RemoteCache(srv.address)
+        cache.put_blob("sha256:b", _blob())
+        driver = RemoteDriver(srv.address)
+        results, _ = driver.scan("a", "sha256:a", ["sha256:b"], ScanOptions())
+        assert len(results[0].vulnerabilities) == 1
+
+        # grow the DB on disk, poke the reload, rescan -> new advisory
+        db2 = _db()
+        db2.put_advisory("npm::ghsa", "lodash", Advisory(
+            vulnerability_id="CVE-2020-8203",
+            vulnerable_versions=["<4.17.19"],
+        ))
+        import time
+
+        time.sleep(0.05)  # ensure a newer mtime on coarse filesystems
+        db2.save(str(db_dir))
+        assert srv.service.maybe_reload_db()
+        results, _ = driver.scan("a", "sha256:a", ["sha256:b"], ScanOptions())
+        ids = sorted(v.vulnerability_id
+                     for v in results[0].vulnerabilities)
+        assert ids == ["CVE-2019-10744", "CVE-2020-8203"]
+    finally:
+        srv.shutdown()
+
+
+def test_scan_options_roundtrip(server):
+    # list_all_pkgs travels over the wire and changes the response shape
+    cache = RemoteCache(server.address)
+    cache.put_blob("sha256:b", _blob())
+    driver = RemoteDriver(server.address)
+    results, _ = driver.scan(
+        "a", "sha256:a", ["sha256:b"], ScanOptions(list_all_pkgs=True)
+    )
+    assert results[0].packages and results[0].packages[0].name == "lodash"
+    assert results[0].packages[0].identifier.purl == "pkg:npm/lodash@4.17.4"

@@ -48,16 +48,20 @@ def _load_db(args):
 _ENGINE_CACHE: dict = {}
 
 
-def build_engine(args):
-    """MatchEngine, cached per db-path within the process."""
+def new_engine(args):
+    """Fresh MatchEngine (no process cache — callers that hot-swap the
+    engine, like the server, must not leave the old one pinned)."""
     from trivy_tpu.detector.engine import MatchEngine
 
+    db = _load_db(args)
+    return MatchEngine(db, use_device=not getattr(args, "no_tpu", False))
+
+
+def build_engine(args):
+    """MatchEngine, cached per db-path within the process."""
     key = (_db_path(args), getattr(args, "no_tpu", False))
     if key not in _ENGINE_CACHE:
-        db = _load_db(args)
-        _ENGINE_CACHE[key] = MatchEngine(
-            db, use_device=not getattr(args, "no_tpu", False)
-        )
+        _ENGINE_CACHE[key] = new_engine(args)
     return _ENGINE_CACHE[key]
 
 
@@ -108,9 +112,12 @@ def _select_scanner(args, cache):
     """reference pkg/commands/artifact/scanner.go: artifact kind x
     standalone/client -> (artifact, driver)."""
     if getattr(args, "server", None):
-        from trivy_tpu.rpc.client import RemoteDriver
+        from trivy_tpu.rpc.client import RemoteCache, RemoteDriver
 
         driver = RemoteDriver(args.server, token=args.token)
+        # analysis runs client-side but blobs land in the SERVER's cache
+        # (reference pkg/commands/artifact/scanner.go remote scanners)
+        cache = RemoteCache(args.server, token=args.token)
     else:
         from trivy_tpu.scanner.local import LocalDriver
 
@@ -260,12 +267,14 @@ def _report_from_json(doc: dict):
 
 
 def run_server(args) -> int:
+    from trivy_tpu.cache.cache import FSCache
     from trivy_tpu.rpc.server import serve
 
-    engine = build_engine(args)
+    engine = new_engine(args)
     host, _, port = args.listen.partition(":")
     serve(engine, host=host or "localhost", port=int(port or 4954),
-          token=args.token)
+          token=args.token, cache=FSCache(args.cache_dir),
+          db_path=_db_path(args))
     return 0
 
 

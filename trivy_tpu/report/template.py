@@ -156,6 +156,8 @@ def _parse(tokens: list[tuple[str, str]], i: int = 0,
 
 # ------------------------------------------------------------ evaluator
 
+_NOPIPE = object()  # sentinel: "no piped value" (None is a real value)
+
 
 def _truthy(v) -> bool:
     if v is None:
@@ -328,7 +330,7 @@ class _Engine:
 
     def eval_pipeline(self, expr: str, dot, scope: dict):
         parts = self._split_pipes(expr)
-        val = self.eval_command(parts[0], dot, scope, piped=None)
+        val = self.eval_command(parts[0], dot, scope, piped=_NOPIPE)
         for p in parts[1:]:
             val = self.eval_command(p, dot, scope, piped=val)
         return val
@@ -361,24 +363,25 @@ class _Engine:
     def eval_command(self, cmd: str, dot, scope, piped):
         args = _split_args(cmd)
         if not args:
-            return piped
+            return None if piped is _NOPIPE else piped
         head, rest = args[0], args[1:]
         if head in _FUNCS:
             vals = [self.eval_atom(a, dot, scope) for a in rest]
-            if piped is not None:
+            if piped is not _NOPIPE:
                 vals.append(piped)  # Go: piped value becomes the last arg
             try:
                 return _FUNCS[head](*vals)
-            except Exception:
-                return None
+            except Exception as exc:
+                # Go text/template fails loudly on function errors
+                raise ValueError(
+                    f"template: error calling {head!r}: {exc}"
+                ) from exc
         # Go text/template errors on undefined functions at parse time;
         # mirror that instead of silently passing the value through
         if (re.fullmatch(r"[A-Za-z_]\w*", head)
                 and head not in ("true", "false", "nil")):
             raise ValueError(f"template: function {head!r} not defined")
-        val = self.eval_atom(head, dot, scope)
-        # a bare atom with args is a field call with ignored args
-        return val if piped is None else piped
+        return self.eval_atom(head, dot, scope)
 
     def eval_atom(self, atom: str, dot, scope):
         atom = atom.strip()

@@ -1,0 +1,116 @@
+"""RPC client: remote scan driver + remote cache
+(reference pkg/rpc/client/client.go + pkg/cache/remote.go).
+
+RemoteDriver implements the scanner Driver seam over HTTP; RemoteCache
+implements the ArtifactCache write interface so analysis results land in
+the server's cache. Both retry transient failures with backoff
+(reference pkg/rpc/retry.go).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+from trivy_tpu.log import logger
+from trivy_tpu.rpc import wire
+from trivy_tpu.rpc.server import CACHE_PREFIX, SCAN_PATH
+
+_log = logger("rpc.client")
+
+RETRIES = 3
+BACKOFF_S = 0.5
+
+
+class RPCError(Exception):
+    pass
+
+
+class _Conn:
+    def __init__(self, url: str, token: str | None = None,
+                 custom_headers: dict | None = None, timeout: float = 300.0):
+        self.base = url.rstrip("/")
+        self.token = token
+        self.custom_headers = custom_headers or {}
+        self.timeout = timeout
+
+    def post(self, path: str, body: bytes) -> bytes:
+        headers = {"Content-Type": "application/json",
+                   **self.custom_headers}
+        if self.token:
+            headers["Trivy-Token"] = self.token
+        last_err: Exception | None = None
+        for attempt in range(RETRIES):
+            req = urllib.request.Request(
+                self.base + path, data=body, headers=headers, method="POST"
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return r.read()
+            except urllib.error.HTTPError as exc:
+                detail = exc.read().decode("utf-8", "replace")[:500]
+                if exc.code < 500:  # 4xx is deterministic — don't retry
+                    raise RPCError(f"{exc.code}: {detail}") from exc
+                last_err = RPCError(f"{exc.code}: {detail}")
+            except (urllib.error.URLError, OSError, TimeoutError) as exc:
+                last_err = exc
+            if attempt < RETRIES - 1:
+                time.sleep(BACKOFF_S * (2 ** attempt))
+        raise RPCError(f"rpc to {self.base}{path} failed: {last_err}")
+
+
+class RemoteDriver:
+    """Driver implementation that ships the scan to a server
+    (reference pkg/rpc/client/client.go:48-73)."""
+
+    def __init__(self, url: str, token: str | None = None,
+                 custom_headers: dict | None = None):
+        self.conn = _Conn(url, token, custom_headers)
+
+    def scan(self, target, artifact_key, blob_keys, options):
+        body = wire.scan_request(target, artifact_key, blob_keys, options)
+        raw = self.conn.post(SCAN_PATH, body)
+        return wire.decode_scan_response(raw)
+
+
+class RemoteCache:
+    """ArtifactCache over RPC (reference pkg/cache/remote.go:27): analysis
+    blobs are written into the SERVER's cache; reads happen server-side."""
+
+    def __init__(self, url: str, token: str | None = None,
+                 custom_headers: dict | None = None):
+        self.conn = _Conn(url, token, custom_headers)
+
+    def put_artifact(self, artifact_id: str, info) -> None:
+        self.conn.post(CACHE_PREFIX + "PutArtifact", wire.encode(
+            {"artifact_id": artifact_id, "artifact_info": info}
+        ))
+
+    def put_blob(self, blob_id: str, blob) -> None:
+        self.conn.post(CACHE_PREFIX + "PutBlob", wire.encode(
+            {"diff_id": blob_id, "blob_info": blob}
+        ))
+
+    def missing_blobs(self, artifact_id: str, blob_ids: list[str]):
+        raw = self.conn.post(CACHE_PREFIX + "MissingBlobs", wire.encode(
+            {"artifact_id": artifact_id, "blob_ids": blob_ids}
+        ))
+        doc = json.loads(raw)
+        return doc.get("missing_artifact", True), \
+            doc.get("missing_blob_ids", []) or []
+
+    def delete_blobs(self, blob_ids: list[str]) -> None:
+        self.conn.post(CACHE_PREFIX + "DeleteBlobs",
+                       wire.encode({"blob_ids": blob_ids}))
+
+    # LocalArtifactCache reads never happen client-side in server mode
+    def get_artifact(self, artifact_id: str) -> dict:
+        return {}
+
+    def get_blob(self, blob_id: str) -> dict:
+        return {}
+
+    def close(self) -> None:
+        pass

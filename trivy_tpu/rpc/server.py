@@ -1,0 +1,270 @@
+"""Scan + cache server (reference pkg/rpc/server + rpc/server/listen.go).
+
+Endpoints:
+  POST /twirp/trivy.scanner.v1.Scanner/Scan    scan cached blobs
+  POST /twirp/trivy.cache.v1.Cache/PutArtifact
+  POST /twirp/trivy.cache.v1.Cache/PutBlob
+  POST /twirp/trivy.cache.v1.Cache/MissingBlobs
+  POST /twirp/trivy.cache.v1.Cache/DeleteBlobs
+  GET  /healthz, GET /version
+
+Token auth via the Trivy-Token header (reference listen.go:96-108).
+A background worker watches the advisory-DB directory and hot-swaps the
+match engine between requests, quiescing in-flight scans first
+(reference listen.go:147-202 dbWorker; here the double-buffered advisory
+tensors are swapped under an RW lock so HBM holds at most old+new during
+the swap).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import trivy_tpu
+from trivy_tpu.log import logger
+from trivy_tpu.rpc import wire
+
+_log = logger("server")
+
+SCAN_PATH = "/twirp/trivy.scanner.v1.Scanner/Scan"
+CACHE_PREFIX = "/twirp/trivy.cache.v1.Cache/"
+
+
+class _RWLock:
+    """Many readers (scans) / one writer (DB swap)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writing = False
+        self._writers_waiting = 0
+
+    def acquire_read(self):
+        with self._cond:
+            # writer preference: new readers queue behind a waiting
+            # writer so the DB swap cannot starve under scan load
+            while self._writing or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writing or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writing = True
+
+    def release_write(self):
+        with self._cond:
+            self._writing = False
+            self._cond.notify_all()
+
+
+class ScanService:
+    """Holds the hot-swappable engine + the server-side cache."""
+
+    def __init__(self, engine, cache, db_path: str | None = None):
+        self.lock = _RWLock()
+        self.engine = engine
+        self.cache = cache
+        self.db_path = db_path
+        self._db_mtime = self._mtime()
+
+    def _mtime(self) -> float:
+        import os
+
+        if not self.db_path:
+            return 0.0
+        try:
+            return max(
+                os.path.getmtime(os.path.join(self.db_path, f))
+                for f in os.listdir(self.db_path)
+            )
+        except (OSError, ValueError):
+            return 0.0
+
+    def scan(self, target, artifact_key, blob_keys, options):
+        from trivy_tpu.scanner.local import LocalDriver
+
+        self.lock.acquire_read()
+        try:
+            driver = LocalDriver(self.engine, self.cache)
+            return driver.scan(target, artifact_key, blob_keys, options)
+        finally:
+            self.lock.release_read()
+
+    def maybe_reload_db(self) -> bool:
+        """Hot-swap the engine if the DB dir changed on disk."""
+        mtime = self._mtime()
+        if not self.db_path or mtime <= self._db_mtime:
+            return False
+        from trivy_tpu.db.store import AdvisoryDB
+        from trivy_tpu.detector.engine import MatchEngine
+
+        _log.info("advisory DB changed; reloading", path=self.db_path)
+        db = AdvisoryDB.load(self.db_path)
+        new_engine = MatchEngine(db, use_device=self.engine.use_device)
+        self.lock.acquire_write()  # quiesce in-flight scans
+        try:
+            self.engine = new_engine
+            self._db_mtime = mtime
+        finally:
+            self.lock.release_write()
+        _log.info("advisory DB hot-swapped", **db.stats())
+        return True
+
+
+def _make_handler(service: ScanService, token: str | None):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route into our logger
+            _log.debug("http " + (fmt % args))
+
+        def _reply(self, code: int, body: bytes,
+                   ctype: str = "application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, code: int, msg: str):
+            self._reply(code, json.dumps({"error": msg}).encode())
+
+        def _authed(self) -> bool:
+            if not token:
+                return True
+            return self.headers.get("Trivy-Token") == token
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, b"ok", "text/plain")
+            elif self.path == "/version":
+                self._reply(200, json.dumps(
+                    {"Version": trivy_tpu.__version__}).encode())
+            else:
+                self._error(404, "not found")
+
+        def do_POST(self):
+            if not self._authed():
+                self._error(401, "invalid token")
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length)
+            try:
+                if self.path == SCAN_PATH:
+                    self._handle_scan(body)
+                elif self.path.startswith(CACHE_PREFIX):
+                    self._handle_cache(self.path[len(CACHE_PREFIX):], body)
+                else:
+                    self._error(404, "not found")
+            except (json.JSONDecodeError, KeyError, TypeError) as exc:
+                # malformed request: deterministic, must not be retried
+                _log.warn("bad rpc request", path=self.path, err=str(exc))
+                self._error(400, f"bad request: {exc}")
+            except Exception as exc:  # twirp-style error envelope
+                _log.warn("rpc error", path=self.path, err=str(exc))
+                self._error(500, str(exc))
+
+        def _handle_scan(self, body: bytes):
+            target, akey, blobs, options = wire.decode_scan_request(body)
+            results, os_found = service.scan(target, akey, blobs, options)
+            self._reply(200, wire.scan_response(results, os_found))
+
+        def _handle_cache(self, method: str, body: bytes):
+            doc = json.loads(body) if body else {}
+            cache = service.cache
+            if method == "PutArtifact":
+                cache.put_artifact(doc["artifact_id"], doc["artifact_info"])
+                self._reply(200, b"{}")
+            elif method == "PutBlob":
+                cache.put_blob(doc["diff_id"], doc["blob_info"])
+                self._reply(200, b"{}")
+            elif method == "MissingBlobs":
+                missing_artifact, missing_blobs = cache.missing_blobs(
+                    doc["artifact_id"], doc.get("blob_ids") or []
+                )
+                self._reply(200, json.dumps({
+                    "missing_artifact": missing_artifact,
+                    "missing_blob_ids": missing_blobs,
+                }).encode())
+            elif method == "DeleteBlobs":
+                cache.delete_blobs(doc.get("blob_ids") or [])
+                self._reply(200, b"{}")
+            else:
+                self._error(404, f"unknown cache method {method}")
+
+    return Handler
+
+
+class Server:
+    """reference pkg/rpc/server/listen.go Server."""
+
+    def __init__(self, engine, cache, host="localhost", port=4954,
+                 token: str | None = None, db_path: str | None = None,
+                 db_reload_interval: float = 3600.0):
+        self.service = ScanService(engine, cache, db_path=db_path)
+        self.httpd = ThreadingHTTPServer(
+            (host, port), _make_handler(self.service, token)
+        )
+        self.db_reload_interval = db_reload_interval
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self):
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.service.db_path:
+            w = threading.Thread(target=self._db_worker, daemon=True)
+            w.start()
+            self._threads.append(w)
+        _log.info("server listening", addr=self.address)
+
+    def _db_worker(self):
+        # reference rpc/server/listen.go:61-79 dbWorker (hourly)
+        while not self._stop.wait(self.db_reload_interval):
+            try:
+                self.service.maybe_reload_db()
+            except Exception as exc:
+                _log.warn("db reload failed", err=str(exc))
+
+    def shutdown(self):
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def serve(engine, host="localhost", port=4954, token=None, cache=None,
+          db_path=None, db_reload_interval=3600.0):
+    """Blocking entry point for `trivy-tpu server`."""
+    if cache is None:
+        from trivy_tpu.cache.cache import MemoryCache
+
+        cache = MemoryCache()
+    srv = Server(engine, cache, host=host, port=port, token=token,
+                 db_path=db_path, db_reload_interval=db_reload_interval)
+    srv.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.shutdown()

@@ -1,0 +1,66 @@
+"""Wire (de)serialization for RPC payloads (reference pkg/rpc/convert.go).
+
+The wire shape is the internal dataclass shape (dataclasses.asdict with
+enums rendered to their values) — full fidelity both ways, rebuilt via
+types.serde.from_dict on receipt.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from typing import Any
+
+from trivy_tpu.types.artifact import OS
+from trivy_tpu.types.report import Result
+from trivy_tpu.types.scan import ScanOptions
+from trivy_tpu.types.serde import from_dict
+
+
+def _jsonable(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _jsonable(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    return obj
+
+
+def encode(obj: Any) -> bytes:
+    return json.dumps(_jsonable(obj), ensure_ascii=False).encode()
+
+
+def scan_request(target: str, artifact_key: str, blob_keys: list[str],
+                 options: ScanOptions) -> bytes:
+    return encode({
+        "target": target,
+        "artifact_id": artifact_key,
+        "blob_ids": blob_keys,
+        "options": options,
+    })
+
+
+def decode_scan_request(body: bytes) -> tuple[str, str, list[str], ScanOptions]:
+    doc = json.loads(body)
+    return (
+        doc.get("target", ""),
+        doc.get("artifact_id", ""),
+        doc.get("blob_ids", []) or [],
+        from_dict(ScanOptions, doc.get("options") or {}),
+    )
+
+
+def scan_response(results: list[Result], os_found: OS) -> bytes:
+    return encode({"results": results, "os": os_found})
+
+
+def decode_scan_response(body: bytes) -> tuple[list[Result], OS]:
+    doc = json.loads(body)
+    results = [from_dict(Result, r) for r in doc.get("results") or []]
+    os_found = from_dict(OS, doc.get("os") or {}) or OS()
+    return results, os_found

@@ -50,6 +50,9 @@ class ResultClass(str, enum.Enum):
     LICENSE_FILE = "license-file"
     CUSTOM = "custom"
 
+    def __str__(self) -> str:  # str() must render the wire value
+        return self.value
+
 
 class ArtifactType(str, enum.Enum):
     """reference pkg/fanal/types artifact types."""

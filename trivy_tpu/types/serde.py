@@ -4,6 +4,7 @@
 from __future__ import annotations
 
 import dataclasses
+import enum
 import types as _pytypes
 import typing
 
@@ -39,4 +40,10 @@ def _convert(hint, value):
         return value
     if dataclasses.is_dataclass(hint) and isinstance(value, dict):
         return from_dict(hint, value)
+    if isinstance(hint, type) and issubclass(hint, enum.Enum) \
+            and not isinstance(value, enum.Enum):
+        try:
+            return hint(value)
+        except ValueError:
+            return value
     return value
