@@ -1,0 +1,376 @@
+"""IaC misconfiguration engine: detection, parsers, checks, ignores
+(reference pkg/iac + pkg/misconf test strategy)."""
+
+from __future__ import annotations
+
+import textwrap
+
+from trivy_tpu.iac import detection
+from trivy_tpu.iac.parsers.dockerfile import parse_dockerfile
+from trivy_tpu.iac.parsers.hcl import Expr, parse_hcl, resources
+from trivy_tpu.misconf.scanner import scan_config
+
+# ------------------------------------------------------------ detection
+
+
+def test_detection():
+    assert detection.detect("Dockerfile", b"FROM x") == "dockerfile"
+    assert detection.detect("app/Dockerfile.prod", b"FROM x") == "dockerfile"
+    assert detection.detect("main.tf", b"") == "terraform"
+    assert detection.detect(
+        "deploy.yaml", b"apiVersion: v1\nkind: Pod\n") == "kubernetes"
+    assert detection.detect(
+        "stack.yaml",
+        b"Resources:\n  B:\n    Type: AWS::S3::Bucket\n",
+    ) == "cloudformation"
+    assert detection.detect("values.yaml", b"a: 1\n") == "yaml"
+    assert detection.detect(
+        "chart/templates/deploy.yaml", b"kind: Deployment") == "helm"
+    assert detection.detect("notes.txt", b"hello") is None
+
+
+# ------------------------------------------------------------ dockerfile
+
+
+DOCKERFILE = textwrap.dedent("""\
+    FROM alpine:latest AS build
+    RUN apk add curl
+    FROM alpine:3.18
+    COPY --from=build /x /x
+    RUN apt-get update
+    RUN sudo make install
+    EXPOSE 22 8080
+    ADD src /app
+    ENTRYPOINT ["a"]
+    ENTRYPOINT ["b"]
+""")
+
+
+def test_dockerfile_parser():
+    df = parse_dockerfile(DOCKERFILE.encode())
+    assert [s.base for s in df.stages] == ["alpine:latest", "alpine:3.18"]
+    assert df.stages[0].name == "build"
+    assert df.by_cmd("EXPOSE")[0].value == "22 8080"
+    run = df.by_cmd("RUN")[0]
+    assert run.start_line == 2
+    # continuations join
+    df2 = parse_dockerfile(b"RUN apt-get update && \\\n  apt-get install -y x\n")
+    assert "install" in df2.by_cmd("RUN")[0].value
+
+
+def test_dockerfile_checks():
+    m = scan_config("Dockerfile", DOCKERFILE.encode())
+    assert m is not None and m.file_type == "dockerfile"
+    failed = {f.id for f in m.failures}
+    assert {"DS001", "DS002", "DS004", "DS005", "DS010", "DS016",
+            "DS017", "DS025"} <= failed
+    passed = {s.id for s in m.successes}
+    assert "DS024" in passed  # no dist-upgrade used
+    ds2 = next(f for f in m.failures if f.id == "DS002")
+    assert ds2.status == "FAIL" and ds2.severity == "HIGH"
+    ds4 = next(f for f in m.failures if f.id == "DS004")
+    assert ds4.cause_metadata.start_line == 7
+    assert "EXPOSE 22" in ds4.cause_metadata.code.lines[0].content
+
+
+def test_dockerfile_good():
+    good = textwrap.dedent("""\
+        FROM alpine:3.18@sha256:abc
+        RUN apk add --no-cache curl
+        HEALTHCHECK CMD curl -f http://localhost/ || exit 1
+        USER appuser
+    """)
+    m = scan_config("Dockerfile", good.encode())
+    assert not m.failures
+    assert {s.id for s in m.successes} >= {"DS001", "DS002", "DS026"}
+
+
+def test_dockerfile_ignore():
+    content = DOCKERFILE.replace(
+        "EXPOSE 22 8080", "#trivy:ignore:DS004\nEXPOSE 22 8080"
+    )
+    m = scan_config("Dockerfile", content.encode())
+    assert "DS004" not in {f.id for f in m.failures}
+    # other findings survive
+    assert "DS002" in {f.id for f in m.failures}
+
+
+# ------------------------------------------------------------ kubernetes
+
+
+K8S = textwrap.dedent("""\
+    apiVersion: apps/v1
+    kind: Deployment
+    metadata:
+      name: web
+    spec:
+      template:
+        spec:
+          hostNetwork: true
+          containers:
+          - name: app
+            image: nginx:latest
+            securityContext:
+              privileged: true
+          volumes:
+          - name: sock
+            hostPath:
+              path: /var/run/docker.sock
+""")
+
+
+def test_k8s_checks():
+    m = scan_config("deploy.yaml", K8S.encode())
+    assert m.file_type == "kubernetes"
+    failed = {f.id for f in m.failures}
+    assert {"KSV006", "KSV009", "KSV013", "KSV017", "KSV023",
+            "KSV001"} <= failed
+    ksv17 = next(f for f in m.failures if f.id == "KSV017")
+    assert "app" in ksv17.message
+    assert ksv17.cause_metadata.start_line > 0
+
+
+def test_k8s_good_pod():
+    good = textwrap.dedent("""\
+        apiVersion: v1
+        kind: Pod
+        metadata:
+          name: ok
+        spec:
+          containers:
+          - name: app
+            image: nginx:1.25
+            resources:
+              limits: {cpu: "1", memory: 1Gi}
+              requests: {cpu: "0.5", memory: 512Mi}
+            securityContext:
+              privileged: false
+              allowPrivilegeEscalation: false
+              runAsNonRoot: true
+              readOnlyRootFilesystem: true
+              capabilities:
+                drop: [ALL]
+    """)
+    m = scan_config("pod.yaml", good.encode())
+    assert not m.failures, [f.id for f in m.failures]
+
+
+# ------------------------------------------------------------ terraform
+
+
+TF = textwrap.dedent("""\
+    resource "aws_s3_bucket" "logs" {
+      bucket = "my-logs"
+      acl    = "public-read"
+    }
+
+    resource "aws_security_group" "web" {
+      description = "web sg"
+      ingress {
+        from_port   = 443
+        to_port     = 443
+        cidr_blocks = ["0.0.0.0/0"]
+      }
+    }
+
+    resource "aws_ebs_volume" "data" {
+      size      = 100
+      encrypted = true
+    }
+
+    resource "aws_db_instance" "db" {
+      storage_encrypted   = true
+      publicly_accessible = true
+      tags = {
+        Name = "db"
+      }
+    }
+""")
+
+
+def test_hcl_parser():
+    blocks = parse_hcl(TF.encode())
+    rs = resources(blocks)
+    assert len(rs) == 4
+    bucket = rs[0]
+    assert bucket.labels == ["aws_s3_bucket", "logs"]
+    assert bucket.get("acl") == "public-read"
+    assert bucket.start_line == 1
+    sg = rs[1]
+    ingress = sg.child("ingress")
+    assert ingress.get("cidr_blocks") == ["0.0.0.0/0"]
+    assert ingress.get("from_port") == 443
+    db = rs[3]
+    assert db.get("tags") == {"Name": "db"}
+
+
+def test_hcl_expr_and_heredoc():
+    tf = textwrap.dedent("""\
+        resource "aws_iam_policy" "p" {
+          name   = var.name
+          policy = <<EOF
+        {"Statement": [{"Effect": "Allow", "Action": "*", "Resource": "*"}]}
+        EOF
+        }
+    """)
+    blocks = parse_hcl(tf.encode())
+    b = resources(blocks)[0]
+    assert isinstance(b.get("name"), Expr)
+    assert '"Action": "*"' in b.get("policy")
+
+
+def test_terraform_checks():
+    m = scan_config("main.tf", TF.encode())
+    assert m.file_type == "terraform"
+    failed = {f.id for f in m.failures}
+    assert {"AVD-AWS-0092", "AVD-AWS-0088", "AVD-AWS-0107",
+            "AVD-AWS-0082"} <= failed
+    passed = {s.id for s in m.successes}
+    assert "AVD-AWS-0026" in passed  # ebs encrypted
+    assert "AVD-AWS-0080" in passed  # rds storage encrypted
+    sg = next(f for f in m.failures if f.id == "AVD-AWS-0107")
+    assert "0.0.0.0/0" in sg.message
+    assert sg.cause_metadata.start_line == 6
+
+
+def test_terraform_iam_wildcard():
+    tf = textwrap.dedent("""\
+        resource "aws_iam_policy" "p" {
+          policy = "{\\"Statement\\": [{\\"Effect\\": \\"Allow\\", \\"Action\\": \\"*\\", \\"Resource\\": \\"*\\"}]}"
+        }
+    """)
+    m = scan_config("iam.tf", tf.encode())
+    assert "AVD-AWS-0057" in {f.id for f in m.failures}
+
+
+def test_tf_json():
+    content = (
+        b'{"resource": {"aws_s3_bucket": {"b": {"acl": "public-read"}},'
+        b' "aws_security_group": {"sg": {"description": "x",'
+        b' "ingress": [{"cidr_blocks": ["0.0.0.0/0"]}]}}}}'
+    )
+    m = scan_config("main.tf.json", content)
+    failed = {f.id for f in m.failures}
+    assert {"AVD-AWS-0092", "AVD-AWS-0107"} <= failed
+
+
+def test_unknown_values_stay_silent():
+    tf = textwrap.dedent("""\
+        resource "aws_ebs_volume" "v" {
+          encrypted = var.enc
+        }
+        resource "aws_db_instance" "db" {
+          storage_encrypted   = var.enc
+          publicly_accessible = var.pub
+        }
+    """)
+    m = scan_config("main.tf", tf.encode())
+    failed = {f.id for f in m.failures}
+    assert "AVD-AWS-0026" not in failed
+    assert "AVD-AWS-0080" not in failed
+    assert "AVD-AWS-0082" not in failed
+    # absent attribute = terraform default = definite FAIL
+    m2 = scan_config("main.tf",
+                     b'resource "aws_ebs_volume" "v" {\n  size = 1\n}\n')
+    assert "AVD-AWS-0026" in {f.id for f in m2.failures}
+
+
+def test_wildcard_ignore():
+    content = ("#trivy:ignore:*\n" + DOCKERFILE).encode()
+    m = scan_config("Dockerfile", content)
+    # the wildcard only covers the next line (FROM) -> DS001 suppressed
+    assert "DS001" not in {f.id for f in m.failures}
+
+
+def test_ksv012_container_overrides_pod():
+    bad = textwrap.dedent("""\
+        apiVersion: v1
+        kind: Pod
+        metadata:
+          name: p
+        spec:
+          securityContext:
+            runAsNonRoot: true
+          containers:
+          - name: app
+            image: nginx:1.25
+            securityContext:
+              runAsNonRoot: false
+    """)
+    m = scan_config("pod.yaml", bad.encode())
+    assert "KSV012" in {f.id for f in m.failures}
+
+
+# ------------------------------------------------------------ cloudformation
+
+
+CFN = textwrap.dedent("""\
+    AWSTemplateFormatVersion: "2010-09-09"
+    Resources:
+      Bucket:
+        Type: AWS::S3::Bucket
+        Properties:
+          AccessControl: PublicRead
+      SG:
+        Type: AWS::EC2::SecurityGroup
+        Properties:
+          GroupDescription: !Sub "${AWS::StackName} sg"
+          SecurityGroupIngress:
+            - CidrIp: 0.0.0.0/0
+              IpProtocol: tcp
+              FromPort: 22
+              ToPort: 22
+      Volume:
+        Type: AWS::EC2::Volume
+        Properties:
+          Encrypted: true
+          Size: 10
+""")
+
+
+def test_cloudformation_checks():
+    m = scan_config("stack.yaml", CFN.encode())
+    assert m.file_type == "cloudformation"
+    failed = {f.id for f in m.failures}
+    assert {"AVD-AWS-0092", "AVD-AWS-0088", "AVD-AWS-0086",
+            "AVD-AWS-0107"} <= failed
+    assert "AVD-AWS-0026" in {s.id for s in m.successes}
+    bucket = next(f for f in m.failures if f.id == "AVD-AWS-0092")
+    assert bucket.cause_metadata.resource == "Bucket"
+    assert bucket.cause_metadata.start_line == 4
+
+
+def test_cfn_intrinsics_parse():
+    from trivy_tpu.iac.parsers.yamlconf import cfn_resources, parse_config
+
+    docs = parse_config(CFN.encode())
+    res = cfn_resources(docs)
+    sg = res["SG"]["Properties"]
+    assert sg["GroupDescription"] == {"Fn::Sub": "${AWS::StackName} sg"}
+
+
+# ------------------------------------------------------------ e2e via fanal
+
+
+def test_config_scan_e2e(tmp_path):
+    (tmp_path / "Dockerfile").write_text("FROM alpine:latest\n")
+    (tmp_path / "deploy.yaml").write_text(K8S)
+    from trivy_tpu.cli.main import main
+    import json
+
+    out = tmp_path / "report.json"
+    rc = main([
+        "config", str(tmp_path), "--format", "json",
+        "--output", str(out), "--cache-dir", str(tmp_path / "cache"), "-q",
+    ])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    results = doc["Results"]
+    by_target = {r["Target"]: r for r in results}
+    assert any("Dockerfile" in t for t in by_target)
+    assert any("deploy.yaml" in t for t in by_target)
+    dres = next(r for r in results if "Dockerfile" in r["Target"])
+    assert dres["Class"] == "config"
+    ids = {mc["ID"] for mc in dres["Misconfigurations"]
+           if mc["Status"] == "FAIL"}
+    assert "DS001" in ids and "DS002" in ids

@@ -49,7 +49,9 @@ class FSArtifact:
             # the reference, which DOES run OS analyzers for fs too when
             # present; keep everything on.
             pass
-        group = AnalyzerGroup.build(disabled_types=disabled)
+        enabled = {"config"} if self.misconfig_only else None
+        group = AnalyzerGroup.build(disabled_types=disabled,
+                                    enabled_types=enabled)
         for a in group.analyzers + group.post_analyzers:
             if a.type == "secret" and self.secret_config:
                 a.configure(self.secret_config)

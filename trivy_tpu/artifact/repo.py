@@ -19,7 +19,8 @@ _log = logger("repo")
 class RepoArtifact:
     def __init__(self, target: str, cache, skip_files=None, skip_dirs=None,
                  parallel: int = 5, branch: str = "", tag: str = "",
-                 commit: str = "", secret_config: str | None = None):
+                 commit: str = "", secret_config: str | None = None,
+                 disabled_analyzers=None):
         self.target = target
         self.cache = cache
         self.skip_files = skip_files
@@ -27,6 +28,7 @@ class RepoArtifact:
         self.parallel = parallel
         self.branch, self.tag, self.commit = branch, tag, commit
         self.secret_config = secret_config
+        self.disabled_analyzers = disabled_analyzers
         self._tmp: str | None = None
 
     def _checkout(self) -> str:
@@ -61,6 +63,7 @@ class RepoArtifact:
             path, self.cache, skip_files=self.skip_files,
             skip_dirs=self.skip_dirs, parallel=self.parallel,
             secret_config=self.secret_config,
+            disabled_analyzers=self.disabled_analyzers,
         )
         ref = fs.inspect()
         ref.name = self.target

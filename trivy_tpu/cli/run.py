@@ -123,6 +123,15 @@ def _select_scanner(args, cache):
 
         driver = LocalDriver(build_engine(args), cache)
 
+    # analyzers whose scanner class was not requested are disabled
+    # (reference pkg/commands/artifact/run.go disabledAnalyzers)
+    scanners = set((args.scanners or "").split(","))
+    disabled: set[str] = set()
+    if "misconfig" not in scanners and args.command != "config":
+        disabled.add("config")
+    if "secret" not in scanners:
+        disabled.add("secret")
+
     cmd = args.command
     if cmd == "sbom":
         from trivy_tpu.artifact.sbom import SBOMArtifact
@@ -137,6 +146,7 @@ def _select_scanner(args, cache):
             as_rootfs=(cmd == "rootfs"),
             misconfig_only=(cmd == "config"),
             parallel=args.parallel,
+            disabled_analyzers=disabled,
         ), driver
     if cmd in ("repository", "repo"):
         from trivy_tpu.artifact.repo import RepoArtifact
@@ -145,6 +155,7 @@ def _select_scanner(args, cache):
             args.target, cache,
             skip_files=args.skip_files, skip_dirs=args.skip_dirs,
             parallel=args.parallel,
+            disabled_analyzers=disabled,
         ), driver
     if cmd == "image":
         from trivy_tpu.artifact.image import ImageArtifact
@@ -155,6 +166,7 @@ def _select_scanner(args, cache):
         return ImageArtifact(
             target, cache, from_tar=bool(getattr(args, "input", None)),
             parallel=args.parallel,
+            disabled_analyzers=disabled,
         ), driver
     raise FatalError(f"unsupported scan command {cmd!r}")
 

@@ -2,6 +2,7 @@
 (reference pkg/fanal/analyzer/all)."""
 
 from trivy_tpu.fanal.analyzers import (  # noqa: F401
+    config_analyzer,
     lang,
     os_release,
     pkg_apk,

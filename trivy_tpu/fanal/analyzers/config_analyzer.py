@@ -1,0 +1,49 @@
+"""Config (IaC) analyzer: detects config files during the walk and runs
+the misconfiguration engine over them (reference
+pkg/fanal/analyzer/config/* post-analyzers -> pkg/misconf.Scanner)."""
+
+from __future__ import annotations
+
+import os
+
+from trivy_tpu.fanal.analyzer import (
+    AnalysisInput,
+    AnalysisResult,
+    PostAnalyzer,
+    register_post,
+)
+from trivy_tpu.iac import detection
+
+_MAX_CONFIG_SIZE = 5 * 1024 * 1024
+
+_CANDIDATE_EXT = (".yaml", ".yml", ".json", ".tf", ".tf.json", ".tpl")
+
+
+def _looks_like_config(path: str) -> bool:
+    name = os.path.basename(path).lower()
+    if detection._DOCKERFILE_NAME.search(name):
+        return True
+    return name.endswith(_CANDIDATE_EXT) or name == "chart.yaml"
+
+
+@register_post
+class ConfigAnalyzer(PostAnalyzer):
+    type = "config"
+    version = 1
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        if size > _MAX_CONFIG_SIZE:
+            return False
+        return _looks_like_config(path)
+
+    def post_analyze(self, files: dict[str, AnalysisInput]):
+        from trivy_tpu.misconf.scanner import scan_config
+
+        res = AnalysisResult()
+        for path, inp in sorted(files.items()):
+            misconf = scan_config(path, inp.read())
+            if misconf is not None and (
+                misconf.failures or misconf.successes
+            ):
+                res.misconfigurations.append(misconf)
+        return res

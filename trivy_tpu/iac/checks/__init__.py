@@ -1,0 +1,2 @@
+"""Builtin checks (reference trivy-checks bundle embedded at
+pkg/iac/rego/embed.go; IDs match the published DS/KSV/AVD-AWS rules)."""

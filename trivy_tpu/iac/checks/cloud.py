@@ -1,0 +1,440 @@
+"""AWS cloud checks shared by terraform + cloudformation (reference
+pkg/iac/adapters map both formats into typed provider structs at
+pkg/iac/providers/aws; same idea here with a light canonical schema).
+
+Canonical resource view: CloudResource{type, name, attrs, lines} where
+type is e.g. "s3_bucket", "security_group", and attrs hold normalized
+fields (None = unknown/unresolved -> checks stay silent, matching the
+reference's unresolvable-value semantics)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from trivy_tpu.iac.check import Cause, check
+from trivy_tpu.iac.parsers.hcl import Block, Expr
+from trivy_tpu.iac.parsers.yamlconf import (
+    cfn_scalar,
+    get_end_line,
+    get_line,
+    strip_lines,
+)
+
+_C = ("terraform", "cloudformation")
+
+
+@dataclass
+class CloudResource:
+    type: str = ""
+    name: str = ""
+    attrs: dict = field(default_factory=dict)
+    start_line: int = 0
+    end_line: int = 0
+
+    def cause(self, message: str) -> Cause:
+        return Cause(message=message, resource=self.name,
+                     start_line=self.start_line, end_line=self.end_line)
+
+
+# ------------------------------------------------------------ terraform
+
+
+def _tf_value(v):
+    return None if isinstance(v, Expr) else v
+
+
+def _tf_tristate(b: Block, name: str, absent_default):
+    """Attribute absent -> the terraform default (a definite value);
+    present but unresolved (var./local. reference) -> None = unknown,
+    so checks stay silent instead of false-positive."""
+    if name not in b.attrs:
+        return absent_default
+    return _tf_value(b.attrs[name].value)
+
+
+def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
+    out: list[CloudResource] = []
+    res_blocks = [b for b in blocks if b.type == "resource" and
+                  len(b.labels) >= 2]
+    # companion resources referenced by bucket: aws_s3_bucket_* attach
+    # settings to buckets declared separately (tf >= 4 style)
+    sse_for: set[str] = set()
+    pab_true_for: set[str] = set()
+    for b in res_blocks:
+        t = b.labels[0]
+        if t == "aws_s3_bucket_server_side_encryption_configuration":
+            ref = b.get("bucket")
+            if isinstance(ref, Expr):
+                sse_for.add(ref.text.split(".")[-2] if "." in ref.text
+                            else ref.text)
+            elif isinstance(ref, str):
+                sse_for.add(ref)
+        if t == "aws_s3_bucket_public_access_block":
+            vals = [_tf_value(b.get(k)) for k in (
+                "block_public_acls", "block_public_policy",
+                "ignore_public_acls", "restrict_public_buckets")]
+            if all(v is True for v in vals):
+                ref = b.get("bucket")
+                key = (ref.text.split(".")[-2] if isinstance(ref, Expr)
+                       and "." in ref.text else str(ref))
+                pab_true_for.add(key)
+
+    for b in res_blocks:
+        t, name = b.labels[0], b.labels[1]
+        full = f"{t}.{name}"
+        cr = CloudResource(name=full, start_line=b.start_line,
+                           end_line=b.end_line)
+        if t == "aws_s3_bucket":
+            cr.type = "s3_bucket"
+            enc = b.child("server_side_encryption_configuration")
+            cr.attrs = {
+                "acl": _tf_value(b.get("acl")),
+                "encrypted": True if enc is not None
+                else (True if name in sse_for or b.get("bucket") in sse_for
+                      else False),
+                "public_access_block": name in pab_true_for
+                or str(_tf_value(b.get("bucket"))) in pab_true_for,
+                "logging": b.child("logging") is not None,
+                "versioning": _bool_attr(b.child("versioning"), "enabled"),
+            }
+        elif t in ("aws_security_group", "aws_security_group_rule",
+                   "aws_vpc_security_group_ingress_rule"):
+            cr.type = "security_group"
+            ingress_cidrs, egress_cidrs = [], []
+            if t == "aws_security_group":
+                for rule in b.children("ingress"):
+                    ingress_cidrs.extend(_cidrs(rule))
+                for rule in b.children("egress"):
+                    egress_cidrs.extend(_cidrs(rule))
+            elif t == "aws_security_group_rule":
+                kind = _tf_value(b.get("type"))
+                cidrs = _cidrs(b)
+                (ingress_cidrs if kind == "ingress"
+                 else egress_cidrs).extend(cidrs)
+            else:
+                v = _tf_value(b.get("cidr_ipv4"))
+                if v:
+                    ingress_cidrs.append(v)
+            cr.attrs = {
+                "ingress_cidrs": ingress_cidrs,
+                "egress_cidrs": egress_cidrs,
+                "description": _tf_value(b.get("description")),
+            }
+        elif t == "aws_ebs_volume":
+            cr.type = "ebs_volume"
+            cr.attrs = {"encrypted": _tf_tristate(b, "encrypted", False)}
+        elif t == "aws_db_instance":
+            cr.type = "rds_instance"
+            cr.attrs = {
+                "encrypted": _tf_tristate(b, "storage_encrypted", False),
+                "public": _tf_tristate(b, "publicly_accessible", False),
+            }
+        elif t == "aws_instance":
+            cr.type = "ec2_instance"
+            mo = b.child("metadata_options")
+            cr.attrs = {
+                "http_tokens": _tf_value(mo.get("http_tokens"))
+                if mo else None,
+            }
+        elif t in ("aws_iam_policy", "aws_iam_role_policy",
+                   "aws_iam_user_policy", "aws_iam_group_policy"):
+            cr.type = "iam_policy"
+            cr.attrs = {"document": _policy_doc(_tf_value(b.get("policy")))}
+        else:
+            continue
+        out.append(cr)
+    return out
+
+
+def _bool_attr(block: Block | None, name: str):
+    if block is None:
+        return None
+    return _tf_value(block.get(name))
+
+
+def _cidrs(b: Block) -> list[str]:
+    vals = b.get("cidr_blocks") or []
+    if isinstance(vals, Expr):
+        return []
+    single = b.get("cidr_block")
+    out = [v for v in vals if isinstance(v, str)]
+    if isinstance(single, str):
+        out.append(single)
+    return out
+
+
+def _policy_doc(policy) -> dict | None:
+    if isinstance(policy, str):
+        try:
+            return json.loads(policy)
+        except ValueError:
+            return None
+    if isinstance(policy, dict):
+        return policy
+    return None
+
+
+# ------------------------------------------------------------ cloudformation
+
+
+def adapt_cloudformation(resources: dict[str, dict]) -> list[CloudResource]:
+    out: list[CloudResource] = []
+    for name, res in resources.items():
+        rtype = str(res.get("Type", ""))
+        props = res.get("Properties") or {}
+        cr = CloudResource(name=name, start_line=get_line(res),
+                           end_line=get_end_line(res))
+        if rtype == "AWS::S3::Bucket":
+            cr.type = "s3_bucket"
+            pab = props.get("PublicAccessBlockConfiguration") or {}
+            pab_vals = [cfn_scalar(pab.get(k)) for k in (
+                "BlockPublicAcls", "BlockPublicPolicy",
+                "IgnorePublicAcls", "RestrictPublicBuckets")]
+            cr.attrs = {
+                "acl": cfn_scalar(props.get("AccessControl")),
+                "encrypted": bool(props.get("BucketEncryption")),
+                "public_access_block": all(
+                    v in (True, "true", "True") for v in pab_vals
+                ) and bool(pab),
+                "logging": bool(props.get("LoggingConfiguration")),
+                "versioning": cfn_scalar(
+                    (props.get("VersioningConfiguration") or {})
+                    .get("Status")) == "Enabled",
+            }
+        elif rtype == "AWS::EC2::SecurityGroup":
+            cr.type = "security_group"
+            ingress = props.get("SecurityGroupIngress") or []
+            egress = props.get("SecurityGroupEgress") or []
+            cr.attrs = {
+                "ingress_cidrs": [
+                    cfn_scalar(r.get("CidrIp")) for r in ingress
+                    if isinstance(r, dict) and cfn_scalar(r.get("CidrIp"))
+                ],
+                "egress_cidrs": [
+                    cfn_scalar(r.get("CidrIp")) for r in egress
+                    if isinstance(r, dict) and cfn_scalar(r.get("CidrIp"))
+                ],
+                "description": cfn_scalar(props.get("GroupDescription")),
+            }
+        elif rtype == "AWS::EC2::Volume":
+            cr.type = "ebs_volume"
+            cr.attrs = {
+                "encrypted": cfn_scalar(props.get("Encrypted"))
+                in (True, "true", "True"),
+            }
+        elif rtype == "AWS::RDS::DBInstance":
+            cr.type = "rds_instance"
+            cr.attrs = {
+                "encrypted": cfn_scalar(props.get("StorageEncrypted"))
+                in (True, "true", "True"),
+                "public": cfn_scalar(props.get("PubliclyAccessible"))
+                in (True, "true", "True"),
+            }
+        elif rtype in ("AWS::IAM::Policy", "AWS::IAM::ManagedPolicy"):
+            cr.type = "iam_policy"
+            cr.attrs = {
+                "document": strip_lines(props.get("PolicyDocument"))
+                if isinstance(props.get("PolicyDocument"), dict) else None,
+            }
+        else:
+            continue
+        out.append(cr)
+    return out
+
+
+# ------------------------------------------------------------ checks
+
+
+def _of_type(ctx, t: str) -> list[CloudResource]:
+    return [r for r in ctx.cloud_resources if r.type == t]
+
+
+@check("AVD-AWS-0086", "S3 bucket does not block public ACLs",
+       severity="HIGH", file_types=_C, provider="aws", service="s3",
+       resolution="Enable blocking any PUT calls with a public ACL")
+def s3_public_access(ctx):
+    out = []
+    for r in _of_type(ctx, "s3_bucket"):
+        if not r.attrs.get("public_access_block"):
+            out.append(r.cause(
+                "No public access block so not blocking public acls"))
+    return out
+
+
+@check("AVD-AWS-0088", "S3 bucket is unencrypted", severity="HIGH",
+       file_types=_C, provider="aws", service="s3",
+       resolution="Configure bucket encryption")
+def s3_encryption(ctx):
+    out = []
+    for r in _of_type(ctx, "s3_bucket"):
+        if not r.attrs.get("encrypted"):
+            out.append(r.cause("Bucket does not have encryption enabled"))
+    return out
+
+
+@check("AVD-AWS-0089", "S3 bucket logging is disabled", severity="LOW",
+       file_types=_C, provider="aws", service="s3",
+       resolution="Add a logging block to the resource")
+def s3_logging(ctx):
+    out = []
+    for r in _of_type(ctx, "s3_bucket"):
+        if not r.attrs.get("logging"):
+            out.append(r.cause("Bucket does not have logging enabled"))
+    return out
+
+
+@check("AVD-AWS-0090", "S3 bucket versioning is disabled", severity="MEDIUM",
+       file_types=_C, provider="aws", service="s3",
+       resolution="Enable versioning to protect against accidental "
+                  "deletions and overwrites")
+def s3_versioning(ctx):
+    out = []
+    for r in _of_type(ctx, "s3_bucket"):
+        if r.attrs.get("versioning") is not True:
+            out.append(r.cause("Bucket does not have versioning enabled"))
+    return out
+
+
+@check("AVD-AWS-0092", "S3 bucket uses a public ACL", severity="HIGH",
+       file_types=_C, provider="aws", service="s3",
+       resolution="Don't use canned ACLs or switch to private acl")
+def s3_public_acl(ctx):
+    out = []
+    for r in _of_type(ctx, "s3_bucket"):
+        acl = str(r.attrs.get("acl") or "")
+        if acl.lower().replace("_", "-") in (
+            "public-read", "public-read-write", "publicread",
+            "publicreadwrite", "website",
+        ):
+            out.append(r.cause(f"Bucket has a public ACL: '{acl}'"))
+    return out
+
+
+_ANYWHERE = ("0.0.0.0/0", "::/0")
+
+
+@check("AVD-AWS-0107", "Security group rule allows ingress from public "
+                       "internet", severity="CRITICAL", file_types=_C,
+       provider="aws", service="ec2",
+       resolution="Set a more restrictive CIDR range")
+def sg_open_ingress(ctx):
+    out = []
+    for r in _of_type(ctx, "security_group"):
+        for cidr in r.attrs.get("ingress_cidrs") or []:
+            if cidr in _ANYWHERE:
+                out.append(r.cause(
+                    f"Security group rule allows ingress from public "
+                    f"internet: '{cidr}'"))
+    return out
+
+
+@check("AVD-AWS-0104", "Security group rule allows egress to multiple "
+                       "public internet addresses", severity="CRITICAL",
+       file_types=_C, provider="aws", service="ec2",
+       resolution="Set a more restrictive CIDR range")
+def sg_open_egress(ctx):
+    out = []
+    for r in _of_type(ctx, "security_group"):
+        for cidr in r.attrs.get("egress_cidrs") or []:
+            if cidr in _ANYWHERE:
+                out.append(r.cause(
+                    f"Security group rule allows egress to public "
+                    f"internet: '{cidr}'"))
+    return out
+
+
+@check("AVD-AWS-0124", "Security group rule does not have a description",
+       severity="LOW", file_types=_C, provider="aws", service="ec2",
+       resolution="Add descriptions for all security groups rules")
+def sg_no_description(ctx):
+    out = []
+    for r in _of_type(ctx, "security_group"):
+        if not r.attrs.get("description"):
+            out.append(r.cause(
+                "Security group rule does not have a description"))
+    return out
+
+
+@check("AVD-AWS-0026", "EBS volume is unencrypted", severity="HIGH",
+       file_types=_C, provider="aws", service="ebs",
+       resolution="Enable encryption of EBS volume")
+def ebs_encryption(ctx):
+    out = []
+    for r in _of_type(ctx, "ebs_volume"):
+        if r.attrs.get("encrypted") is False:  # None = unknown, stay silent
+            out.append(r.cause("EBS volume is not encrypted"))
+    return out
+
+
+@check("AVD-AWS-0080", "RDS instance is unencrypted", severity="HIGH",
+       file_types=_C, provider="aws", service="rds",
+       resolution="Enable encryption for RDS instance")
+def rds_encryption(ctx):
+    out = []
+    for r in _of_type(ctx, "rds_instance"):
+        if r.attrs.get("encrypted") is False:  # None = unknown
+            out.append(r.cause(
+                "Instance does not have storage encryption enabled"))
+    return out
+
+
+@check("AVD-AWS-0082", "RDS instance is publicly accessible",
+       severity="HIGH", file_types=_C, provider="aws", service="rds",
+       resolution="Set 'publicly_accessible' to false")
+def rds_public(ctx):
+    out = []
+    for r in _of_type(ctx, "rds_instance"):
+        if r.attrs.get("public") is True:  # None = unknown
+            out.append(r.cause("Instance is exposed publicly"))
+    return out
+
+
+@check("AVD-AWS-0028", "EC2 instance allows IMDSv1", severity="HIGH",
+       file_types=_C, provider="aws", service="ec2",
+       resolution="Enable HTTP token requirement for IMDS "
+                  "(http_tokens = required)")
+def ec2_imdsv1(ctx):
+    out = []
+    for r in _of_type(ctx, "ec2_instance"):
+        tokens = r.attrs.get("http_tokens")
+        if tokens is not None and tokens != "required":
+            out.append(r.cause(
+                "Instance does not require IMDS access to require a "
+                "token"))
+        elif tokens is None:
+            out.append(r.cause(
+                "Instance does not configure metadata_options "
+                "http_tokens; IMDSv1 is allowed by default"))
+    return out
+
+
+@check("AVD-AWS-0057", "IAM policy allows wildcard actions",
+       severity="HIGH", file_types=_C, provider="aws", service="iam",
+       resolution="Specify the exact permissions required, and the "
+                  "resources they apply to")
+def iam_wildcard(ctx):
+    out = []
+    for r in _of_type(ctx, "iam_policy"):
+        doc = r.attrs.get("document")
+        if not isinstance(doc, dict):
+            continue
+        stmts = doc.get("Statement")
+        if isinstance(stmts, dict):
+            stmts = [stmts]
+        for stmt in stmts or []:
+            if not isinstance(stmt, dict):
+                continue
+            if str(stmt.get("Effect", "Allow")) != "Allow":
+                continue
+            actions = stmt.get("Action")
+            actions = [actions] if isinstance(actions, str) else actions
+            resources_ = stmt.get("Resource")
+            resources_ = [resources_] if isinstance(resources_, str) \
+                else resources_
+            if any(a == "*" for a in actions or []) and \
+                    any(x == "*" for x in resources_ or []):
+                out.append(r.cause(
+                    "IAM policy document uses wildcarded action and "
+                    "resource"))
+    return out

@@ -1,0 +1,258 @@
+"""Dockerfile checks (reference trivy-checks checks/docker/*.rego;
+IDs match the published DS rules)."""
+
+from __future__ import annotations
+
+import re
+
+from trivy_tpu.iac.check import Cause, check
+
+_D = ("dockerfile",)
+
+
+def _cause(instr, msg, stage=None) -> Cause:
+    return Cause(message=msg, resource=stage.name if stage else "",
+                 start_line=instr.start_line, end_line=instr.end_line)
+
+
+def _run_commands(df, stage):
+    """Shell commands from RUN instructions, split on &&/;."""
+    for instr in df.by_cmd("RUN", stage):
+        for part in re.split(r"&&|;", instr.value.replace("\\\n", " ")):
+            yield instr, part.strip()
+
+
+@check("DS001", "':latest' tag used", severity="MEDIUM", file_types=_D,
+       avd_id="AVD-DS-0001", provider="dockerfile", service="general",
+       resolution="Add a tag to the image in the 'FROM' statement")
+def latest_tag(ctx):
+    out = []
+    stage_names = {s.name for s in ctx.dockerfile.stages}
+    for stage in ctx.dockerfile.stages:
+        base = stage.base
+        if base in stage_names and base != stage.name:
+            continue  # references an earlier stage
+        if base.lower() == "scratch" or base.startswith("$"):
+            continue
+        ref = base.rsplit("@", 1)[0]
+        tag = ref.rsplit(":", 1)[1] if ":" in ref.split("/")[-1] else ""
+        if "@" in base:
+            continue  # digest-pinned
+        if tag == "latest" or not tag:
+            out.append(Cause(
+                message=f"Specify a tag in the 'FROM' statement for image "
+                        f"'{ref.split(':')[0]}'",
+                resource=stage.name, start_line=stage.start_line,
+                end_line=stage.start_line,
+            ))
+    return out
+
+
+@check("DS002", "Image user should not be 'root'", severity="HIGH",
+       file_types=_D, avd_id="AVD-DS-0002", provider="dockerfile",
+       service="general",
+       resolution="Add 'USER <non root user name>' line to the Dockerfile")
+def root_user(ctx):
+    df = ctx.dockerfile
+    stage = df.final_stage
+    if stage is None:
+        return []
+    users = df.by_cmd("USER", stage) or df.by_cmd("USER")
+    if not users:
+        return [Cause(
+            message="Specify at least 1 USER command in Dockerfile with "
+                    "non-root user as argument",
+            resource=stage.name, start_line=stage.start_line,
+            end_line=stage.start_line,
+        )]
+    last = users[-1]
+    if last.value.split(":")[0].strip() in ("root", "0"):
+        return [_cause(last, "Last USER command in Dockerfile should not "
+                             "be 'root'", stage)]
+    return []
+
+
+@check("DS004", "Port 22 exposed", severity="MEDIUM", file_types=_D,
+       avd_id="AVD-DS-0004", provider="dockerfile", service="general",
+       resolution="Remove 'EXPOSE 22' statement from the Dockerfile")
+def expose_ssh(ctx):
+    out = []
+    for instr in ctx.dockerfile.by_cmd("EXPOSE"):
+        for port in instr.value.split():
+            if port.split("/")[0] == "22":
+                out.append(_cause(instr,
+                                  "Port 22 should not be exposed in "
+                                  "Dockerfile"))
+    return out
+
+
+@check("DS005", "ADD instead of COPY", severity="LOW", file_types=_D,
+       avd_id="AVD-DS-0005", provider="dockerfile", service="general",
+       resolution="Use COPY instead of ADD")
+def add_instead_of_copy(ctx):
+    out = []
+    for instr in ctx.dockerfile.by_cmd("ADD"):
+        v = instr.value
+        # ADD is legitimate for remote URLs and auto-extracted archives
+        if re.search(r"https?://", v) or re.search(
+            r"\.(tar|tar\.\w+|tgz|tbz2|txz|zst)(\s|\"|$)", v
+        ):
+            continue
+        out.append(_cause(instr, f"Consider using 'COPY {v}' command "
+                                 f"instead of 'ADD {v}'"))
+    return out
+
+
+@check("DS010", "RUN using 'sudo'", severity="HIGH", file_types=_D,
+       avd_id="AVD-DS-0010", provider="dockerfile", service="general",
+       resolution="Don't use sudo in RUN")
+def run_sudo(ctx):
+    out = []
+    for instr, cmd in _run_commands(ctx.dockerfile, None):
+        if cmd.startswith("sudo ") or cmd == "sudo":
+            out.append(_cause(instr, "Using 'sudo' in Dockerfile should "
+                                     "be avoided"))
+    return out
+
+
+@check("DS012", "Duplicate stage alias", severity="CRITICAL",
+       file_types=_D, avd_id="AVD-DS-0012", provider="dockerfile",
+       service="general",
+       resolution="Use unique aliases in multi-stage builds")
+def duplicate_alias(ctx):
+    seen = {}
+    out = []
+    for stage in ctx.dockerfile.stages:
+        if stage.name != stage.base and stage.name in seen:
+            out.append(Cause(
+                message=f"Duplicate aliases '{stage.name}' are found in "
+                        f"different FROM statements",
+                resource=stage.name, start_line=stage.start_line,
+                end_line=stage.start_line,
+            ))
+        seen[stage.name] = stage
+    return out
+
+
+@check("DS013", "'RUN cd ...' to change directory", severity="MEDIUM",
+       file_types=_D, avd_id="AVD-DS-0013", provider="dockerfile",
+       service="general", resolution="Use WORKDIR instead of 'RUN cd'")
+def run_cd(ctx):
+    out = []
+    for instr, cmd in _run_commands(ctx.dockerfile, None):
+        if re.match(r"cd\s+/", cmd):
+            out.append(_cause(
+                instr, f"RUN should not be used to change directory: "
+                       f"'{cmd}'. Use 'WORKDIR' statement instead."))
+    return out
+
+
+@check("DS016", "Multiple ENTRYPOINT instructions", severity="CRITICAL",
+       file_types=_D, avd_id="AVD-DS-0016", provider="dockerfile",
+       service="general",
+       resolution="Keep one ENTRYPOINT per stage")
+def multiple_entrypoints(ctx):
+    out = []
+    for stage in ctx.dockerfile.stages:
+        eps = ctx.dockerfile.by_cmd("ENTRYPOINT", stage)
+        for extra in eps[:-1]:
+            out.append(_cause(
+                extra, "There are multiple ENTRYPOINT instructions; only "
+                       "the last one takes effect", stage))
+    return out
+
+
+@check("DS017", "'RUN apt-get update' without matching install",
+       severity="HIGH", file_types=_D, avd_id="AVD-DS-0017",
+       provider="dockerfile", service="general",
+       resolution="Combine apt-get update and install in one RUN")
+def apt_update_alone(ctx):
+    out = []
+    for instr in ctx.dockerfile.by_cmd("RUN"):
+        text = instr.value
+        if re.search(r"apt(-get)?\s+update", text) and not re.search(
+            r"apt(-get)?\s+(-\S+\s+)*install", text
+        ):
+            out.append(_cause(
+                instr, "The instruction 'RUN <package-manager> update' "
+                       "should always be followed by "
+                       "'<package-manager> install' in the same RUN "
+                       "statement"))
+    return out
+
+
+@check("DS021", "'apt-get install' without '-y'", severity="HIGH",
+       file_types=_D, avd_id="AVD-DS-0021", provider="dockerfile",
+       service="general",
+       resolution="Add -y to apt-get install")
+def apt_install_no_yes(ctx):
+    out = []
+    for instr, cmd in _run_commands(ctx.dockerfile, None):
+        if re.search(r"apt(-get)?\s+(-\S+\s+)*install", cmd):
+            if not re.search(r"(^|\s)(-y|--yes|--assume-yes|-qq)(\s|$)",
+                             cmd):
+                out.append(_cause(
+                    instr, f"'-y' flag is missed: '{cmd}'"))
+    return out
+
+
+@check("DS024", "'apt-get dist-upgrade' used", severity="HIGH",
+       file_types=_D, avd_id="AVD-DS-0024", provider="dockerfile",
+       service="general",
+       resolution="Remove apt-get dist-upgrade")
+def dist_upgrade(ctx):
+    out = []
+    for instr, cmd in _run_commands(ctx.dockerfile, None):
+        if re.search(r"apt-get\s+(-\S+\s+)*dist-upgrade", cmd):
+            out.append(_cause(
+                instr, "'apt-get dist-upgrade' should not be used in "
+                       "Dockerfile"))
+    return out
+
+
+@check("DS025", "'apk add' without '--no-cache'", severity="HIGH",
+       file_types=_D, avd_id="AVD-DS-0025", provider="dockerfile",
+       service="general",
+       resolution="Add --no-cache to apk add")
+def apk_no_cache(ctx):
+    out = []
+    for instr, cmd in _run_commands(ctx.dockerfile, None):
+        if re.search(r"apk\s+(-\S+\s+)*add", cmd) and \
+                "--no-cache" not in cmd:
+            out.append(_cause(
+                instr, f"'--no-cache' is missed: '{cmd}'"))
+    return out
+
+
+@check("DS026", "No HEALTHCHECK defined", severity="LOW", file_types=_D,
+       avd_id="AVD-DS-0026", provider="dockerfile", service="general",
+       resolution="Add HEALTHCHECK instruction in your docker container "
+                  "images")
+def no_healthcheck(ctx):
+    df = ctx.dockerfile
+    if not df.stages:
+        return []
+    if df.by_cmd("HEALTHCHECK"):
+        return []
+    stage = df.final_stage
+    return [Cause(
+        message="Add HEALTHCHECK instruction in your docker container "
+                "images",
+        resource=stage.name, start_line=stage.start_line,
+        end_line=stage.start_line,
+    )]
+
+
+@check("DS029", "'apt-get install' without '--no-install-recommends'",
+       severity="HIGH", file_types=_D, avd_id="AVD-DS-0029",
+       provider="dockerfile", service="general",
+       resolution="Add --no-install-recommends to apt-get install")
+def apt_no_recommends(ctx):
+    out = []
+    for instr, cmd in _run_commands(ctx.dockerfile, None):
+        if re.search(r"apt-get\s+(-\S+\s+)*install", cmd) and \
+                "--no-install-recommends" not in cmd:
+            out.append(_cause(
+                instr, f"'--no-install-recommends' flag is missed: "
+                       f"'{cmd}'"))
+    return out

@@ -1,0 +1,294 @@
+"""Kubernetes workload checks (reference trivy-checks
+checks/kubernetes/*.rego; IDs match the published KSV rules)."""
+
+from __future__ import annotations
+
+from trivy_tpu.iac.check import Cause, check
+from trivy_tpu.iac.parsers.yamlconf import get_end_line, get_line
+
+_K = ("kubernetes", "helm")
+
+
+def _name(res: dict) -> str:
+    md = res.get("metadata") or {}
+    return f"{res.get('kind', '')}/{md.get('name', '')}"
+
+
+def _container_cause(ctx, c: dict, msg: str) -> Cause:
+    return Cause(
+        message=msg, resource=_name(ctx.resource),
+        start_line=get_line(c) or get_line(ctx.resource),
+        end_line=get_end_line(c) or get_line(c) or get_line(ctx.resource),
+    )
+
+
+def _sc(c: dict) -> dict:
+    return c.get("securityContext") or {}
+
+
+def _pod_sc(ctx) -> dict:
+    return (ctx.pod_spec or {}).get("securityContext") or {}
+
+
+@check("KSV001", "Process can elevate its own privileges",
+       severity="MEDIUM", file_types=_K, avd_id="AVD-KSV-0001",
+       provider="kubernetes", service="general",
+       resolution="Set 'securityContext.allowPrivilegeEscalation' to "
+                  "false")
+def allow_priv_escalation(ctx):
+    out = []
+    for c in ctx.containers:
+        if _sc(c).get("allowPrivilegeEscalation") is not False:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should set "
+                f"'securityContext.allowPrivilegeEscalation' to false"))
+    return out
+
+
+@check("KSV003", "Default capabilities not dropped", severity="LOW",
+       file_types=_K, avd_id="AVD-KSV-0003", provider="kubernetes",
+       service="general",
+       resolution="Add 'ALL' to 'securityContext.capabilities.drop'")
+def drop_capabilities(ctx):
+    out = []
+    for c in ctx.containers:
+        drop = (_sc(c).get("capabilities") or {}).get("drop") or []
+        if not any(str(d).upper() == "ALL" for d in drop):
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should add 'ALL' to "
+                f"'securityContext.capabilities.drop'"))
+    return out
+
+
+@check("KSV005", "SYS_ADMIN capability added", severity="HIGH",
+       file_types=_K, avd_id="AVD-KSV-0005", provider="kubernetes",
+       service="general",
+       resolution="Remove the SYS_ADMIN capability")
+def sys_admin(ctx):
+    out = []
+    for c in ctx.containers:
+        add = (_sc(c).get("capabilities") or {}).get("add") or []
+        if any(str(a).upper() == "SYS_ADMIN" for a in add):
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should not include 'SYS_ADMIN' in "
+                f"'securityContext.capabilities.add'"))
+    return out
+
+
+@check("KSV006", "hostPath volume mounts docker.sock", severity="HIGH",
+       file_types=_K, avd_id="AVD-KSV-0006", provider="kubernetes",
+       service="general",
+       resolution="Do not mount /var/run/docker.sock")
+def docker_sock(ctx):
+    out = []
+    for v in (ctx.pod_spec or {}).get("volumes") or []:
+        hp = (v or {}).get("hostPath") or {}
+        if hp.get("path") == "/var/run/docker.sock":
+            out.append(Cause(
+                message=f"{_name(ctx.resource)} should not mount "
+                        f"'/var/run/docker.sock'",
+                resource=_name(ctx.resource),
+                start_line=get_line(v), end_line=get_end_line(v),
+            ))
+    return out
+
+
+@check("KSV008", "Access to host IPC namespace", severity="HIGH",
+       file_types=_K, avd_id="AVD-KSV-0008", provider="kubernetes",
+       service="general", resolution="Set 'spec.hostIPC' to false")
+def host_ipc(ctx):
+    if (ctx.pod_spec or {}).get("hostIPC") is True:
+        return [Cause(
+            message=f"{_name(ctx.resource)} should not set "
+                    f"'spec.template.spec.hostIPC' to true",
+            resource=_name(ctx.resource),
+            start_line=get_line(ctx.pod_spec),
+            end_line=get_line(ctx.pod_spec),
+        )]
+    return []
+
+
+@check("KSV009", "Access to host network", severity="HIGH",
+       file_types=_K, avd_id="AVD-KSV-0009", provider="kubernetes",
+       service="general", resolution="Set 'spec.hostNetwork' to false")
+def host_network(ctx):
+    if (ctx.pod_spec or {}).get("hostNetwork") is True:
+        return [Cause(
+            message=f"{_name(ctx.resource)} should not set "
+                    f"'spec.template.spec.hostNetwork' to true",
+            resource=_name(ctx.resource),
+            start_line=get_line(ctx.pod_spec),
+            end_line=get_line(ctx.pod_spec),
+        )]
+    return []
+
+
+@check("KSV010", "Access to host PID", severity="HIGH", file_types=_K,
+       avd_id="AVD-KSV-0010", provider="kubernetes", service="general",
+       resolution="Set 'spec.hostPID' to false")
+def host_pid(ctx):
+    if (ctx.pod_spec or {}).get("hostPID") is True:
+        return [Cause(
+            message=f"{_name(ctx.resource)} should not set "
+                    f"'spec.template.spec.hostPID' to true",
+            resource=_name(ctx.resource),
+            start_line=get_line(ctx.pod_spec),
+            end_line=get_line(ctx.pod_spec),
+        )]
+    return []
+
+
+@check("KSV011", "CPU not limited", severity="LOW", file_types=_K,
+       avd_id="AVD-KSV-0011", provider="kubernetes", service="general",
+       resolution="Set 'resources.limits.cpu'")
+def cpu_limit(ctx):
+    out = []
+    for c in ctx.containers:
+        limits = (c.get("resources") or {}).get("limits") or {}
+        if "cpu" not in limits:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should set "
+                f"'resources.limits.cpu'"))
+    return out
+
+
+@check("KSV012", "Runs as root user", severity="MEDIUM", file_types=_K,
+       avd_id="AVD-KSV-0012", provider="kubernetes", service="general",
+       resolution="Set 'securityContext.runAsNonRoot' to true")
+def run_as_non_root(ctx):
+    out = []
+    pod_nonroot = _pod_sc(ctx).get("runAsNonRoot") is True
+    for c in ctx.containers:
+        own = _sc(c).get("runAsNonRoot")
+        # container-level setting overrides pod-level; only an unset
+        # container inherits the pod default
+        if own is True or (own is None and pod_nonroot):
+            continue
+        out.append(_container_cause(
+            ctx, c,
+            f"Container '{c.get('name', '')}' of {_name(ctx.resource)} "
+            f"should set 'securityContext.runAsNonRoot' to true"))
+    return out
+
+
+@check("KSV013", "Image tag ':latest' used", severity="MEDIUM",
+       file_types=_K, avd_id="AVD-KSV-0013", provider="kubernetes",
+       service="general",
+       resolution="Use a specific container image tag")
+def image_tag(ctx):
+    out = []
+    for c in ctx.containers:
+        image = str(c.get("image", ""))
+        if not image or "@" in image:
+            continue
+        tail = image.split("/")[-1]
+        tag = tail.rsplit(":", 1)[1] if ":" in tail else ""
+        if not tag or tag == "latest":
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should specify an image tag"))
+    return out
+
+
+@check("KSV014", "Root file system is not read-only", severity="HIGH",
+       file_types=_K, avd_id="AVD-KSV-0014", provider="kubernetes",
+       service="general",
+       resolution="Set 'securityContext.readOnlyRootFilesystem' to true")
+def read_only_rootfs(ctx):
+    out = []
+    for c in ctx.containers:
+        if _sc(c).get("readOnlyRootFilesystem") is not True:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should set "
+                f"'securityContext.readOnlyRootFilesystem' to true"))
+    return out
+
+
+@check("KSV015", "CPU requests not specified", severity="LOW",
+       file_types=_K, avd_id="AVD-KSV-0015", provider="kubernetes",
+       service="general", resolution="Set 'resources.requests.cpu'")
+def cpu_request(ctx):
+    out = []
+    for c in ctx.containers:
+        req = (c.get("resources") or {}).get("requests") or {}
+        if "cpu" not in req:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should set "
+                f"'resources.requests.cpu'"))
+    return out
+
+
+@check("KSV016", "Memory requests not specified", severity="LOW",
+       file_types=_K, avd_id="AVD-KSV-0016", provider="kubernetes",
+       service="general", resolution="Set 'resources.requests.memory'")
+def memory_request(ctx):
+    out = []
+    for c in ctx.containers:
+        req = (c.get("resources") or {}).get("requests") or {}
+        if "memory" not in req:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should set "
+                f"'resources.requests.memory'"))
+    return out
+
+
+@check("KSV017", "Privileged container", severity="HIGH", file_types=_K,
+       avd_id="AVD-KSV-0017", provider="kubernetes", service="general",
+       resolution="Set 'securityContext.privileged' to false")
+def privileged(ctx):
+    out = []
+    for c in ctx.containers:
+        if _sc(c).get("privileged") is True:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should set "
+                f"'securityContext.privileged' to false"))
+    return out
+
+
+@check("KSV018", "Memory not limited", severity="LOW", file_types=_K,
+       avd_id="AVD-KSV-0018", provider="kubernetes", service="general",
+       resolution="Set 'resources.limits.memory'")
+def memory_limit(ctx):
+    out = []
+    for c in ctx.containers:
+        limits = (c.get("resources") or {}).get("limits") or {}
+        if "memory" not in limits:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should set "
+                f"'resources.limits.memory'"))
+    return out
+
+
+@check("KSV023", "hostPath volumes mounted", severity="MEDIUM",
+       file_types=_K, avd_id="AVD-KSV-0023", provider="kubernetes",
+       service="general", resolution="Do not set 'spec.volumes.hostPath'")
+def host_path(ctx):
+    out = []
+    for v in (ctx.pod_spec or {}).get("volumes") or []:
+        if (v or {}).get("hostPath"):
+            out.append(Cause(
+                message=f"{_name(ctx.resource)} should not set "
+                        f"'spec.template.volumes.hostPath'",
+                resource=_name(ctx.resource),
+                start_line=get_line(v), end_line=get_end_line(v),
+            ))
+    return out

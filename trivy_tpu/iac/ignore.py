@@ -1,0 +1,42 @@
+"""#trivy:ignore comment handling (reference pkg/iac/ignore/parse.go).
+
+A comment `#trivy:ignore:<rule-id>` (also `//` and `/* */` styles)
+suppresses findings of that rule on the following line, or on the same
+line when trailing. `trivy:ignore:*` suppresses everything.
+"""
+
+from __future__ import annotations
+
+import re
+
+_IGNORE = re.compile(
+    r"(?:#|//|/\*)\s*trivy:ignore:(\S+)", re.I
+)
+
+
+def parse_ignores(content: bytes) -> dict[int, set[str]]:
+    """-> {line_number: {rule_id,...}} — the lines these ignores cover."""
+    out: dict[int, set[str]] = {}
+    for n, line in enumerate(
+        content.decode("utf-8", "replace").splitlines(), start=1
+    ):
+        for m in _IGNORE.finditer(line):
+            rule = m.group(1).strip()
+            if rule.endswith("*/"):  # '/* trivy:ignore:x */' close marker
+                rule = rule[:-2].strip()
+            before = line[:m.start()].strip()
+            target = n if before else n + 1  # trailing vs standalone
+            out.setdefault(target, set()).add(rule)
+    return out
+
+
+def is_ignored(ignores: dict[int, set[str]], rule_id: str, avd_id: str,
+               start_line: int, end_line: int = 0) -> bool:
+    end = max(end_line, start_line)
+    for line in range(start_line, end + 1):
+        rules = ignores.get(line)
+        if not rules:
+            continue
+        if "*" in rules or rule_id in rules or (avd_id and avd_id in rules):
+            return True
+    return False

@@ -1,0 +1,2 @@
+"""Per-format IaC parsers producing line-annotated IRs
+(reference pkg/iac/scanners/*/parser)."""

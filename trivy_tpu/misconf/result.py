@@ -5,10 +5,27 @@ from __future__ import annotations
 
 from trivy_tpu.types.artifact import Misconfiguration
 from trivy_tpu.types.enums import ResultClass
-from trivy_tpu.types.report import MisconfSummary, Result
+from trivy_tpu.types.report import (
+    DetectedMisconfiguration,
+    MisconfSummary,
+    Result,
+)
+from trivy_tpu.types.serde import from_dict
+
+
+def _rebuild(items) -> list[DetectedMisconfiguration]:
+    # Misconfiguration.successes/failures are untyped lists, so entries
+    # come back as plain dicts after a cache round-trip
+    return [
+        m if isinstance(m, DetectedMisconfiguration)
+        else from_dict(DetectedMisconfiguration, m)
+        for m in items
+    ]
 
 
 def to_result(misconf: Misconfiguration) -> Result | None:
+    misconf.successes = _rebuild(misconf.successes)
+    misconf.failures = _rebuild(misconf.failures)
     if not misconf.successes and not misconf.failures:
         return None
     return Result(

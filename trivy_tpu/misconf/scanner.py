@@ -1,0 +1,169 @@
+"""Misconfiguration scan façade (reference pkg/misconf/scanner.go):
+file-type detection -> per-type parse -> check evaluation ->
+Misconfiguration with PASS/FAIL entries, cause line ranges and code
+snippets."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from trivy_tpu.iac import detection
+from trivy_tpu.iac.check import Cause, Check, checks_for
+from trivy_tpu.iac.ignore import is_ignored, parse_ignores
+from trivy_tpu.types.artifact import Misconfiguration
+from trivy_tpu.types.report import (
+    CauseMetadata,
+    Code,
+    DetectedMisconfiguration,
+    Line,
+)
+
+
+@dataclass
+class DockerfileCtx:
+    path: str = ""
+    dockerfile: object = None
+
+
+@dataclass
+class K8sCtx:
+    path: str = ""
+    resource: dict = field(default_factory=dict)
+
+    @property
+    def pod_spec(self):
+        from trivy_tpu.iac.parsers.yamlconf import k8s_pod_spec
+
+        return k8s_pod_spec(self.resource)
+
+    @property
+    def containers(self):
+        from trivy_tpu.iac.parsers.yamlconf import k8s_containers
+
+        return k8s_containers(self.resource)
+
+
+@dataclass
+class CloudCtx:
+    path: str = ""
+    cloud_resources: list = field(default_factory=list)
+
+
+def _contexts(file_type: str, path: str, content: bytes) -> list:
+    if file_type == detection.DOCKERFILE:
+        from trivy_tpu.iac.parsers.dockerfile import parse_dockerfile
+
+        return [DockerfileCtx(path=path,
+                              dockerfile=parse_dockerfile(content))]
+    if file_type in (detection.KUBERNETES, detection.HELM):
+        from trivy_tpu.iac.parsers.yamlconf import (
+            k8s_resources,
+            parse_config,
+        )
+
+        content = _strip_helm(content) if file_type == detection.HELM \
+            else content
+        docs = parse_config(content)
+        return [K8sCtx(path=path, resource=r)
+                for r in k8s_resources(docs)]
+    if file_type == detection.TERRAFORM:
+        from trivy_tpu.iac.checks.cloud import adapt_terraform
+        from trivy_tpu.iac.parsers.hcl import parse_hcl, parse_tf_json
+
+        parse = parse_tf_json if path.endswith(".tf.json") else parse_hcl
+        return [CloudCtx(path=path,
+                         cloud_resources=adapt_terraform(parse(content)))]
+    if file_type == detection.CLOUDFORMATION:
+        from trivy_tpu.iac.checks.cloud import adapt_cloudformation
+        from trivy_tpu.iac.parsers.yamlconf import (
+            cfn_resources,
+            parse_config,
+        )
+
+        docs = parse_config(content)
+        return [CloudCtx(path=path,
+                         cloud_resources=adapt_cloudformation(
+                             cfn_resources(docs)))]
+    return []
+
+
+def _strip_helm(content: bytes) -> bytes:
+    """Best-effort: drop {{ ... }} actions so the YAML parses
+    (reference renders charts via helm engine; full render is out of
+    scope for template-only scans)."""
+    import re
+
+    text = content.decode("utf-8", "replace")
+    text = re.sub(r"\{\{.*?\}\}", "", text, flags=re.S)
+    return text.encode()
+
+
+def _snippet(content: bytes, start: int, end: int) -> Code:
+    lines = content.decode("utf-8", "replace").splitlines()
+    out = []
+    end = min(max(end, start), len(lines))
+    for n in range(max(start, 1), end + 1):
+        if n > len(lines):
+            break
+        out.append(Line(
+            number=n, content=lines[n - 1],
+            is_cause=True,
+            first_cause=(n == start), last_cause=(n == end),
+        ))
+    return Code(lines=out)
+
+
+def _to_detected(chk: Check, file_type: str, cause: Cause | None,
+                 content: bytes, status: str) -> DetectedMisconfiguration:
+    md = CauseMetadata(provider=chk.provider, service=chk.service)
+    message = chk.title
+    if cause is not None:
+        md.resource = cause.resource
+        md.start_line = cause.start_line
+        md.end_line = max(cause.end_line, cause.start_line)
+        if cause.start_line:
+            md.code = _snippet(content, cause.start_line, md.end_line)
+        message = cause.message or chk.title
+    return DetectedMisconfiguration(
+        type=file_type, id=chk.id, avd_id=chk.avd_id, title=chk.title,
+        description=chk.description, message=message,
+        namespace=f"builtin.{chk.provider}.{chk.service}".rstrip("."),
+        query="data.builtin.deny", resolution=chk.resolution,
+        severity=chk.severity, primary_url=chk.url,
+        references=[chk.url] if chk.url else [], status=status,
+        cause_metadata=md,
+    )
+
+
+def scan_config(path: str, content: bytes,
+                file_type: str | None = None) -> Misconfiguration | None:
+    """-> Misconfiguration (successes + failures) or None if the file is
+    not a recognized config type."""
+    ftype = file_type or detection.detect(path, content)
+    if ftype is None or ftype in (detection.YAML, detection.JSON):
+        return None  # plain data files: nothing to check (yet)
+    ctxs = _contexts(ftype, path, content)
+    if not ctxs:
+        return None
+    ignores = parse_ignores(content)
+    misconf = Misconfiguration(file_type=ftype, file_path=path)
+    for chk in checks_for(ftype):
+        causes: list[Cause] = []
+        for ctx in ctxs:
+            try:
+                causes.extend(chk.run(ctx))
+            except Exception:
+                continue  # a broken check must not kill the scan
+        causes = [
+            c for c in causes
+            if not is_ignored(ignores, chk.id, chk.avd_id,
+                              c.start_line, c.end_line)
+        ]
+        if causes:
+            for c in causes:
+                misconf.failures.append(
+                    _to_detected(chk, ftype, c, content, "FAIL"))
+        else:
+            misconf.successes.append(
+                _to_detected(chk, ftype, None, content, "PASS"))
+    return misconf
