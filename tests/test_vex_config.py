@@ -1,0 +1,250 @@
+"""VEX suppression (OpenVEX/CycloneDX/CSAF) and layered config
+resolution (reference pkg/vex, pkg/flag)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from trivy_tpu.types.artifact import PkgIdentifier
+from trivy_tpu.types.report import (
+    DetectedVulnerability,
+    Report,
+    Result,
+    VulnerabilityInfo,
+)
+from trivy_tpu.vex import filter_report_vex, load_vex
+
+
+def _report() -> Report:
+    def vuln(vid, purl, name):
+        return DetectedVulnerability(
+            vulnerability_id=vid, pkg_name=name,
+            pkg_identifier=PkgIdentifier(purl=purl),
+            installed_version="1.0.0",
+            info=VulnerabilityInfo(severity="HIGH"),
+        )
+
+    return Report(results=[Result(
+        target="app", result_class="lang-pkgs", type="npm",
+        vulnerabilities=[
+            vuln("CVE-2023-1111", "pkg:npm/aaa@1.0.0", "aaa"),
+            vuln("CVE-2023-2222", "pkg:npm/bbb@1.0.0", "bbb"),
+            vuln("CVE-2023-3333", "pkg:npm/ccc@1.0.0", "ccc"),
+        ],
+    )])
+
+
+def test_openvex(tmp_path):
+    doc = {
+        "@context": "https://openvex.dev/ns/v0.2.0",
+        "statements": [
+            {"vulnerability": {"name": "CVE-2023-1111"},
+             "products": [{"@id": "pkg:npm/aaa@1.0.0"}],
+             "status": "not_affected",
+             "justification": "vulnerable_code_not_in_execute_path"},
+            {"vulnerability": {"name": "CVE-2023-2222"},
+             "products": [{"@id": "pkg:npm/OTHER@9.9.9"}],
+             "status": "not_affected"},
+        ],
+    }
+    p = tmp_path / "openvex.json"
+    p.write_text(json.dumps(doc))
+    report = _report()
+    n = filter_report_vex(report, [load_vex(str(p))])
+    assert n == 1
+    ids = [v.vulnerability_id for v in report.results[0].vulnerabilities]
+    assert ids == ["CVE-2023-2222", "CVE-2023-3333"]
+    mod = report.results[0].modified_findings
+    assert mod[0]["Status"] == "not_affected"
+    assert mod[0]["Finding"]["VulnerabilityID"] == "CVE-2023-1111"
+    assert "ExperimentalModifiedFindings" in report.results[0].to_dict()
+
+
+def test_cyclonedx_vex(tmp_path):
+    doc = {
+        "bomFormat": "CycloneDX", "specVersion": "1.5",
+        "vulnerabilities": [
+            {"id": "CVE-2023-2222",
+             "analysis": {"state": "false_positive",
+                          "justification": "code_not_reachable"},
+             "affects": [{"ref": "pkg:npm/bbb@1.0.0"}]},
+            {"id": "CVE-2023-3333",
+             "analysis": {"state": "exploitable"},
+             "affects": [{"ref": "pkg:npm/ccc@1.0.0"}]},
+        ],
+    }
+    p = tmp_path / "vex.cdx.json"
+    p.write_text(json.dumps(doc))
+    report = _report()
+    n = filter_report_vex(report, [load_vex(str(p))])
+    assert n == 1  # exploitable does NOT suppress
+    ids = [v.vulnerability_id for v in report.results[0].vulnerabilities]
+    assert ids == ["CVE-2023-1111", "CVE-2023-3333"]
+
+
+def test_csaf(tmp_path):
+    doc = {
+        "document": {"category": "csaf_vex", "title": "t"},
+        "product_tree": {"branches": [{
+            "branches": [{
+                "product": {
+                    "product_id": "P1",
+                    "product_identification_helper": {
+                        "purl": "pkg:npm/ccc@1.0.0"},
+                },
+            }],
+        }]},
+        "vulnerabilities": [{
+            "cve": "CVE-2023-3333",
+            "product_status": {"known_not_affected": ["P1"]},
+        }],
+    }
+    p = tmp_path / "csaf.json"
+    p.write_text(json.dumps(doc))
+    report = _report()
+    n = filter_report_vex(report, [load_vex(str(p))])
+    assert n == 1
+    ids = [v.vulnerability_id for v in report.results[0].vulnerabilities]
+    assert "CVE-2023-3333" not in ids
+
+
+def test_purl_version_wildcard(tmp_path):
+    # statement without a version matches every installed version
+    doc = {
+        "@context": "https://openvex.dev/ns/v0.2.0",
+        "statements": [{
+            "vulnerability": {"name": "CVE-2023-1111"},
+            "products": [{"@id": "pkg:npm/aaa"}],
+            "status": "fixed",
+        }],
+    }
+    p = tmp_path / "v.json"
+    p.write_text(json.dumps(doc))
+    report = _report()
+    assert filter_report_vex(report, [load_vex(str(p))]) == 1
+
+
+def test_openvex_alias_match(tmp_path):
+    doc = {
+        "@context": "https://openvex.dev/ns/v0.2.0",
+        "statements": [{
+            "vulnerability": {"name": "GHSA-abcd-1234",
+                              "aliases": ["CVE-2023-1111"]},
+            "status": "not_affected",
+        }],
+    }
+    p = tmp_path / "alias.json"
+    p.write_text(json.dumps(doc))
+    report = _report()
+    assert filter_report_vex(report, [load_vex(str(p))]) == 1
+
+
+def test_cyclonedx_bomref_match(tmp_path):
+    doc = {
+        "bomFormat": "CycloneDX", "specVersion": "1.5",
+        "vulnerabilities": [{
+            "id": "CVE-2023-1111",
+            "analysis": {"state": "not_affected"},
+            "affects": [{"ref": "urn:cdx:serial/1#comp-aaa"}],
+        }],
+    }
+    p = tmp_path / "br.json"
+    p.write_text(json.dumps(doc))
+    report = _report()
+    report.results[0].vulnerabilities[0].pkg_identifier.bom_ref = \
+        "urn:cdx:serial/1#comp-aaa"
+    assert filter_report_vex(report, [load_vex(str(p))]) == 1
+
+
+def test_unknown_format(tmp_path):
+    p = tmp_path / "x.json"
+    p.write_text("{}")
+    with pytest.raises(ValueError):
+        load_vex(str(p))
+
+
+# ------------------------------------------------------------ config layers
+
+
+def _parse(argv, monkeypatch, tmp_path, config_text=None):
+    from trivy_tpu.cli.config import apply_layers
+    from trivy_tpu.cli.main import build_parser
+
+    monkeypatch.chdir(tmp_path)
+    if config_text is not None:
+        (tmp_path / "trivy-tpu.yaml").write_text(config_text)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    apply_layers(args, parser, argv)
+    return args
+
+
+def test_config_file_layer(monkeypatch, tmp_path):
+    args = _parse(["filesystem", "."], monkeypatch, tmp_path,
+                  "format: json\nseverity: HIGH,CRITICAL\nparallel: 9\n")
+    assert args.format == "json"
+    assert args.severity == "HIGH,CRITICAL"
+    assert args.parallel == 9
+
+
+def test_env_beats_config(monkeypatch, tmp_path):
+    monkeypatch.setenv("TRIVY_TPU_FORMAT", "sarif")
+    args = _parse(["filesystem", "."], monkeypatch, tmp_path,
+                  "format: json\n")
+    assert args.format == "sarif"
+
+
+def test_cli_beats_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("TRIVY_TPU_FORMAT", "sarif")
+    args = _parse(["filesystem", ".", "--format", "table"],
+                  monkeypatch, tmp_path, "format: json\n")
+    assert args.format == "table"
+
+
+def test_nested_config_keys(monkeypatch, tmp_path):
+    args = _parse(["filesystem", "."], monkeypatch, tmp_path,
+                  "scan:\n  scanners: vuln\n")
+    assert args.scanners == "vuln"
+
+
+def test_bool_and_list_coercion(monkeypatch, tmp_path):
+    monkeypatch.setenv("TRIVY_TPU_LIST_ALL_PKGS", "true")
+    args = _parse(["filesystem", "."], monkeypatch, tmp_path,
+                  "skip-dirs:\n  - vendor\n  - dist\n")
+    assert args.list_all_pkgs is True
+    assert args.skip_dirs == ["vendor", "dist"]
+
+
+def test_generate_default_config(monkeypatch, tmp_path, capsys):
+    from trivy_tpu.cli.main import main
+
+    monkeypatch.chdir(tmp_path)
+    assert main(["--generate-default-config"]) == 0
+    assert (tmp_path / "trivy-tpu.yaml").exists()
+    # refuses to clobber an existing config
+    assert main(["--generate-default-config"]) == 1
+
+
+def test_short_flag_is_explicit(monkeypatch, tmp_path):
+    monkeypatch.setenv("TRIVY_TPU_FORMAT", "json")
+    args = _parse(["filesystem", ".", "-f", "table"],
+                  monkeypatch, tmp_path)
+    assert args.format == "table"
+
+
+def test_tilde_expansion(monkeypatch, tmp_path):
+    args = _parse(["filesystem", "."], monkeypatch, tmp_path,
+                  "cache-dir: ~/.cache/trivy-tpu\n")
+    assert not args.cache_dir.startswith("~")
+    assert args.cache_dir.endswith(".cache/trivy-tpu")
+
+
+def test_bad_env_value_clean_error(monkeypatch, tmp_path):
+    from trivy_tpu.cli.main import main
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("TRIVY_TPU_PARALLEL", "abc")
+    assert main(["filesystem", "."]) == 1  # no traceback, exit 1

@@ -14,6 +14,10 @@ from trivy_tpu import log
 def _add_global_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--debug", action="store_true", help="debug logging")
     p.add_argument("--quiet", "-q", action="store_true", help="suppress logs")
+    p.add_argument("--config", "-c", default=None,
+                   help="config file (default trivy-tpu.yaml if present)")
+    p.add_argument("--generate-default-config", action="store_true",
+                   help="write trivy-tpu.yaml with defaults and exit")
     p.add_argument(
         "--cache-dir",
         default=os.environ.get(
@@ -56,6 +60,11 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--token", default=None, help="server auth token")
     p.add_argument("--skip-files", action="append", default=[])
     p.add_argument("--skip-dirs", action="append", default=[])
+    p.add_argument("--vex", action="append", default=[],
+                   help="VEX file (OpenVEX / CycloneDX VEX / CSAF); "
+                        "repeatable")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="include VEX-suppressed findings in the report")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -128,6 +137,26 @@ def main(argv: list[str] | None = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     parser = build_parser()
     args = parser.parse_args(argv)
+
+    if getattr(args, "generate_default_config", False):
+        from trivy_tpu.cli.config import generate_default_config
+
+        try:
+            path = generate_default_config(getattr(args, "config", None))
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        print(f"written: {path}")
+        return 0
+
+    # layered resolution: CLI > TRIVY_TPU_* env > config file > default
+    from trivy_tpu.cli.config import apply_layers
+
+    try:
+        apply_layers(args, parser, argv)
+    except (ValueError, FileNotFoundError) as e:
+        print(str(e), file=sys.stderr)
+        return 1
     log.init(debug=getattr(args, "debug", False),
              quiet=getattr(args, "quiet", False))
 

@@ -89,6 +89,20 @@ def run_scan(args) -> int:
     scanner = Scanner(driver, artifact)
     report = scanner.scan_artifact(make_scan_options(args))
 
+    # VEX suppression runs before severity/ignore filtering
+    # (reference pkg/result/filter.go:37 -> pkg/vex/vex.go:65)
+    vex_paths = getattr(args, "vex", None) or []
+    if vex_paths:
+        from trivy_tpu.vex import filter_report_vex, load_vex
+
+        docs = [load_vex(p) for p in vex_paths]
+        n = filter_report_vex(report, docs)
+        if n:
+            _log.info("vex suppressed findings", count=n)
+    if not getattr(args, "show_suppressed", False):
+        for res in report.results:
+            res.modified_findings = []
+
     severities = _severities(args.severity)
     ignore_cfg = load_ignore_file(args.ignorefile)
     statuses = (args.ignore_status or "").split(",") if args.ignore_status else None

@@ -318,6 +318,9 @@ class Result(JSONMixin):
     secrets: list[DetectedSecret] = field(default_factory=list)
     licenses: list[DetectedLicense] = field(default_factory=list)
     custom_resources: list[CustomResource] = field(default_factory=list)
+    # findings suppressed by VEX/ignore policies (reference
+    # types.ModifiedFinding, rendered as ExperimentalModifiedFindings)
+    modified_findings: list[dict] = field(default_factory=list)
 
     @property
     def is_empty(self) -> bool:
@@ -351,6 +354,8 @@ class Result(JSONMixin):
             out["Licenses"] = [l.to_dict() for l in self.licenses]
         if self.custom_resources:
             out["CustomResources"] = [c.to_dict() for c in self.custom_resources]
+        if self.modified_findings:
+            out["ExperimentalModifiedFindings"] = self.modified_findings
         return out
 
 

@@ -1,0 +1,10 @@
+"""VEX (Vulnerability Exploitability eXchange) suppression
+(reference pkg/vex): OpenVEX, CycloneDX VEX, and CSAF documents filter
+detected vulnerabilities whose status a vendor has asserted."""
+
+from trivy_tpu.vex.vex import (  # noqa: F401
+    VexDocument,
+    VexStatement,
+    filter_report_vex,
+    load_vex,
+)

@@ -1,0 +1,265 @@
+"""VEX document parsing + report filtering (reference pkg/vex/vex.go:65
+Filter; format decoders in pkg/vex/{openvex,cyclonedx,csaf}.go).
+
+Statuses that suppress a finding: not_affected, fixed (reference
+pkg/vex/vex.go NotAffected/Fixed handling). Suppressed findings move to
+the result's modified-findings list rather than vanishing, mirroring
+--show-suppressed."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from trivy_tpu.log import logger
+from trivy_tpu.types.report import Report, Result
+from trivy_tpu.utils.purl import parse_purl
+
+_log = logger("vex")
+
+STATUS_NOT_AFFECTED = "not_affected"
+STATUS_AFFECTED = "affected"
+STATUS_FIXED = "fixed"
+STATUS_UNDER_INVESTIGATION = "under_investigation"
+
+_SUPPRESS = (STATUS_NOT_AFFECTED, STATUS_FIXED)
+
+
+@dataclass
+class VexStatement:
+    vulnerability_id: str = ""
+    vuln_aliases: list[str] = field(default_factory=list)
+    status: str = ""
+    justification: str = ""
+    impact: str = ""           # impact_statement / detail
+    # purls or bom-refs; empty = statement applies to any product
+    products: list[str] = field(default_factory=list)
+
+    def matches(self, vuln_id: str, aliases: list[str], purl: str,
+                bom_ref: str = "") -> bool:
+        finding_ids = {vuln_id, *aliases}
+        statement_ids = {self.vulnerability_id, *self.vuln_aliases}
+        if not (finding_ids & statement_ids):
+            return False
+        if not self.products:
+            return True
+        return any(
+            _purl_match(p, purl) or (bom_ref and p == bom_ref)
+            for p in self.products
+        )
+
+
+@dataclass
+class VexDocument:
+    source: str = ""
+    statements: list[VexStatement] = field(default_factory=list)
+
+
+def _purl_match(pattern: str, purl: str) -> bool:
+    """PURL containment: pattern matches when all its set fields equal
+    the target's (reference pkg/purl Match semantics)."""
+    if not purl:
+        return False
+    if pattern == purl:
+        return True
+    if not pattern.startswith("pkg:"):
+        return False  # bom-ref style identifier, not a purl
+    try:
+        a = parse_purl(pattern)
+        b = parse_purl(purl)
+    except Exception:
+        return False
+    if a.type != b.type:
+        return False
+    if a.namespace and a.namespace != b.namespace:
+        return False
+    if a.name and a.name != b.name:
+        return False
+    if a.version and a.version != b.version:
+        return False
+    for k, v in (a.qualifiers or {}).items():
+        if (b.qualifiers or {}).get(k) != v:
+            return False
+    return True
+
+
+# ------------------------------------------------------------ decoders
+
+
+def _decode_openvex(doc: dict, source: str) -> VexDocument:
+    out = VexDocument(source=source)
+    for st in doc.get("statements") or []:
+        vuln = st.get("vulnerability") or {}
+        vid = vuln.get("name") or vuln.get("@id", "")
+        aliases = [str(a) for a in vuln.get("aliases") or []]
+        products = []
+        for p in st.get("products") or []:
+            pid = p.get("@id", "") if isinstance(p, dict) else str(p)
+            if pid:
+                products.append(pid)
+            for sub in (p.get("subcomponents") or []
+                        if isinstance(p, dict) else []):
+                sid = sub.get("@id", "") if isinstance(sub, dict) \
+                    else str(sub)
+                if sid:
+                    products.append(sid)
+        out.statements.append(VexStatement(
+            vulnerability_id=vid,
+            vuln_aliases=aliases,
+            status=st.get("status", ""),
+            justification=st.get("justification", ""),
+            impact=st.get("impact_statement", ""),
+            products=products,
+        ))
+    return out
+
+
+_CDX_STATE = {
+    "not_affected": STATUS_NOT_AFFECTED,
+    "exploitable": STATUS_AFFECTED,
+    "resolved": STATUS_FIXED,
+    "resolved_with_pedigree": STATUS_FIXED,
+    "in_triage": STATUS_UNDER_INVESTIGATION,
+    "false_positive": STATUS_NOT_AFFECTED,
+}
+
+
+def _decode_cyclonedx(doc: dict, source: str) -> VexDocument:
+    out = VexDocument(source=source)
+    for v in doc.get("vulnerabilities") or []:
+        analysis = v.get("analysis") or {}
+        status = _CDX_STATE.get(analysis.get("state", ""), "")
+        products = [
+            a.get("ref", "") for a in v.get("affects") or []
+            if isinstance(a, dict) and a.get("ref")
+        ]
+        out.statements.append(VexStatement(
+            vulnerability_id=v.get("id", ""),
+            status=status,
+            justification=analysis.get("justification", ""),
+            impact=analysis.get("detail", ""),
+            products=products,
+        ))
+    return out
+
+
+def _decode_csaf(doc: dict, source: str) -> VexDocument:
+    out = VexDocument(source=source)
+    purl_by_product = _csaf_product_purls(doc.get("product_tree") or {})
+
+    def expand(ids) -> list[str]:
+        purls = []
+        for pid in ids or []:
+            purls.extend(purl_by_product.get(pid, []))
+        return purls
+
+    for v in doc.get("vulnerabilities") or []:
+        vid = v.get("cve") or (v.get("ids") or [{}])[0].get("text", "")
+        ps = v.get("product_status") or {}
+        just = ""
+        for flag in v.get("flags") or []:
+            just = flag.get("label", "") or just
+        for status, key in (
+            (STATUS_NOT_AFFECTED, "known_not_affected"),
+            (STATUS_FIXED, "fixed"),
+            (STATUS_AFFECTED, "known_affected"),
+            (STATUS_UNDER_INVESTIGATION, "under_investigation"),
+        ):
+            ids = ps.get(key)
+            if ids:
+                out.statements.append(VexStatement(
+                    vulnerability_id=vid, status=status,
+                    justification=just, products=expand(ids),
+                ))
+    return out
+
+
+def _csaf_product_purls(tree: dict) -> dict[str, list[str]]:
+    """product_id -> purls, from product_tree branches + relationships."""
+    out: dict[str, list[str]] = {}
+
+    def walk(branch):
+        if isinstance(branch, dict):
+            prod = branch.get("product")
+            if isinstance(prod, dict):
+                pid = prod.get("product_id", "")
+                helper = (prod.get("product_identification_helper")
+                          or {})
+                purl = helper.get("purl", "")
+                if pid and purl:
+                    out.setdefault(pid, []).append(purl)
+            for b in branch.get("branches") or []:
+                walk(b)
+
+    for b in tree.get("branches") or []:
+        walk(b)
+    # relationships compose products; inherit component purls
+    for rel in tree.get("relationships") or []:
+        full = (rel.get("full_product_name") or {}).get("product_id", "")
+        ref = rel.get("product_reference", "")
+        if full and ref in out:
+            out.setdefault(full, []).extend(out[ref])
+    return out
+
+
+def load_vex(path: str) -> VexDocument:
+    """Sniff the format and decode (reference pkg/vex/document.go)."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if "statements" in doc and "@context" in doc:
+        return _decode_openvex(doc, path)
+    if doc.get("bomFormat") == "CycloneDX":
+        return _decode_cyclonedx(doc, path)
+    category = (doc.get("document") or {}).get("category", "")
+    if category.startswith("csaf"):
+        return _decode_csaf(doc, path)
+    raise ValueError(f"unrecognized VEX format in {path}")
+
+
+# ------------------------------------------------------------ filtering
+
+
+def filter_report_vex(report: Report, vex_docs: list[VexDocument]) -> int:
+    """Suppress findings asserted not_affected/fixed; returns the number
+    suppressed. Suppressed entries are kept on the result as modified
+    findings (rendered under ExperimentalModifiedFindings)."""
+    total = 0
+    for res in report.results:
+        total += _filter_result(res, vex_docs)
+    return total
+
+
+def _filter_result(res: Result, vex_docs: list[VexDocument]) -> int:
+    kept = []
+    modified = getattr(res, "modified_findings", None) or []
+    for v in res.vulnerabilities:
+        purl = v.pkg_identifier.purl
+        bom_ref = v.pkg_identifier.bom_ref
+        statement = None
+        for doc in vex_docs:
+            for st in doc.statements:
+                if st.status in _SUPPRESS and st.matches(
+                    v.vulnerability_id, v.vendor_ids, purl, bom_ref
+                ):
+                    statement = (doc, st)
+                    break
+            if statement:
+                break
+        if statement is None:
+            kept.append(v)
+            continue
+        doc, st = statement
+        total_d = {
+            "Type": "vulnerability",
+            "Status": st.status,
+            "Statement": st.justification or st.impact or "",
+            "Source": doc.source,
+            "Finding": v.to_dict(),
+        }
+        modified.append(total_d)
+        _log.debug("vex suppressed", id=v.vulnerability_id,
+                   status=st.status, source=doc.source)
+    suppressed = len(res.vulnerabilities) - len(kept)
+    res.vulnerabilities = kept
+    res.modified_findings = modified
+    return suppressed
