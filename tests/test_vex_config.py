@@ -133,6 +133,7 @@ def test_openvex_alias_match(tmp_path):
         "statements": [{
             "vulnerability": {"name": "GHSA-abcd-1234",
                               "aliases": ["CVE-2023-1111"]},
+            "products": [{"@id": "pkg:npm/aaa@1.0.0"}],
             "status": "not_affected",
         }],
     }
@@ -140,6 +141,22 @@ def test_openvex_alias_match(tmp_path):
     p.write_text(json.dumps(doc))
     report = _report()
     assert filter_report_vex(report, [load_vex(str(p))]) == 1
+
+
+def test_openvex_no_products_does_not_suppress(tmp_path):
+    # a products-less statement must NOT blanket-suppress the CVE for
+    # every package in the report
+    doc = {
+        "@context": "https://openvex.dev/ns/v0.2.0",
+        "statements": [{
+            "vulnerability": {"name": "CVE-2023-1111"},
+            "status": "not_affected",
+        }],
+    }
+    p = tmp_path / "noprod.json"
+    p.write_text(json.dumps(doc))
+    report = _report()
+    assert filter_report_vex(report, [load_vex(str(p))]) == 0
 
 
 def test_cyclonedx_bomref_match(tmp_path):

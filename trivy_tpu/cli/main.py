@@ -69,6 +69,7 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
 
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
+        allow_abbrev=False,
         prog="trivy-tpu",
         description="TPU-native security scanner (artifact -> vulnerabilities, "
         "secrets, misconfigurations, licenses)",

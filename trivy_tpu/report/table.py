@@ -177,5 +177,34 @@ def _render_result(res: Result, color: bool, sev_names) -> str:
             ["Package/File", "License", "Category", "Severity"], rows, color
         )
     else:
+        if not res.modified_findings:
+            return ""
+    tail = _render_suppressed(res, color)
+    if not header_lines and not tail:
         return ""
-    return "\n".join(header_lines) + "\n\n" + (body or "") + "\n"
+    head = "\n".join(header_lines) + "\n\n" if header_lines else ""
+    return head + (body or "") + tail + "\n"
+
+
+def _render_suppressed(res: Result, color: bool) -> str:
+    """--show-suppressed section (reference pkg/report/table renders
+    suppressed vulnerabilities with status/statement/source columns)."""
+    if not res.modified_findings:
+        return ""
+    rows = []
+    for m in res.modified_findings:
+        f = m.get("Finding", {})
+        rows.append([
+            f.get("PkgName", ""),
+            f.get("VulnerabilityID", ""),
+            _sev(f.get("Severity", "UNKNOWN"), color),
+            m.get("Status", ""),
+            m.get("Statement", ""),
+            m.get("Source", ""),
+        ])
+    title = f"\nSuppressed Vulnerabilities (Total: {len(rows)})\n"
+    return title + "=" * (len(title) - 2) + "\n" + _render_grid(
+        ["Library", "Vulnerability", "Severity", "Status", "Statement",
+         "Source"],
+        rows, color,
+    )

@@ -32,7 +32,10 @@ class VexStatement:
     status: str = ""
     justification: str = ""
     impact: str = ""           # impact_statement / detail
-    # purls or bom-refs; empty = statement applies to any product
+    # purls or bom-refs; a statement with no identifiable products never
+    # suppresses (reference only suppresses on a product match — a
+    # products-less statement would otherwise drop the CVE for EVERY
+    # package in the report)
     products: list[str] = field(default_factory=list)
 
     def matches(self, vuln_id: str, aliases: list[str], purl: str,
@@ -42,7 +45,7 @@ class VexStatement:
         if not (finding_ids & statement_ids):
             return False
         if not self.products:
-            return True
+            return False
         return any(
             _purl_match(p, purl) or (bom_ref and p == bom_ref)
             for p in self.products
