@@ -1,0 +1,195 @@
+"""New dependency parsers: pom.xml, julia, wordpress, rust binary,
+nuget config/props, go.sum supplement (reference pkg/dependency/parser)."""
+
+import json
+import zlib
+
+from trivy_tpu.parsers import golang, java_pom, misc_lang
+
+POM = b"""<?xml version="1.0"?>
+<project xmlns="http://maven.apache.org/POM/4.0.0">
+  <parent>
+    <groupId>com.example</groupId>
+    <version>2.1.0</version>
+  </parent>
+  <artifactId>myapp</artifactId>
+  <properties>
+    <spring.version>5.3.20</spring.version>
+    <indirect.version>${spring.version}</indirect.version>
+  </properties>
+  <dependencyManagement>
+    <dependencies>
+      <dependency>
+        <groupId>io.netty</groupId>
+        <artifactId>netty-all</artifactId>
+        <version>4.1.77.Final</version>
+      </dependency>
+    </dependencies>
+  </dependencyManagement>
+  <dependencies>
+    <dependency>
+      <groupId>org.springframework</groupId>
+      <artifactId>spring-core</artifactId>
+      <version>${indirect.version}</version>
+    </dependency>
+    <dependency>
+      <groupId>io.netty</groupId>
+      <artifactId>netty-all</artifactId>
+    </dependency>
+    <dependency>
+      <groupId>junit</groupId>
+      <artifactId>junit</artifactId>
+      <version>4.13</version>
+      <scope>test</scope>
+    </dependency>
+    <dependency>
+      <groupId>com.unresolved</groupId>
+      <artifactId>thing</artifactId>
+      <version>${no.such.prop}</version>
+    </dependency>
+  </dependencies>
+</project>
+"""
+
+
+def test_pom_interpolation_and_management():
+    pkgs = {p.name: p.version for p in java_pom.parse_pom(POM)}
+    assert pkgs["com.example:myapp"] == "2.1.0"  # parent version inherit
+    assert pkgs["org.springframework:spring-core"] == "5.3.20"  # 2-level prop
+    assert pkgs["io.netty:netty-all"] == "4.1.77.Final"  # depMgmt pin
+    assert "junit:junit" not in pkgs  # test scope skipped
+    assert "com.unresolved:thing" not in pkgs  # unresolved dropped
+
+
+def test_pom_malformed():
+    assert java_pom.parse_pom(b"<not-a-pom/>") == []
+    assert java_pom.parse_pom(b"garbage <<<") == []
+
+
+JULIA_17 = b"""
+julia_version = "1.9.0"
+manifest_format = "2.0"
+
+[[deps.JSON]]
+uuid = "682c06a0-de6a-54ab-a142-c8b1cf79cde6"
+version = "0.21.4"
+
+[[deps.Parsers]]
+deps = ["Dates"]
+uuid = "69de0a69-1ddd-5017-9359-2bf0b02dc9f0"
+version = "2.5.10"
+
+[[deps.Dates]]
+uuid = "ade2ca70-3891-5945-98fb-dc099432e06a"
+"""
+
+
+def test_julia_manifest():
+    pkgs = misc_lang.parse_julia_manifest(JULIA_17)
+    byname = {p.name: p for p in pkgs}
+    assert byname["JSON"].version == "0.21.4"
+    assert byname["JSON"].id.startswith("682c06a0")
+    assert "Dates" not in byname  # stdlib, no version
+
+
+def test_julia_manifest_old_flat():
+    old = b"""
+[[JSON]]
+uuid = "682c06a0-de6a-54ab-a142-c8b1cf79cde6"
+version = "0.20.0"
+"""
+    pkgs = misc_lang.parse_julia_manifest(old)
+    assert [(p.name, p.version) for p in pkgs] == [("JSON", "0.20.0")]
+
+
+def test_wordpress_version():
+    php = b"<?php\n$wp_version = '6.4.2';\n$wp_db_version = 56657;\n"
+    pkg = misc_lang.parse_wordpress_version(php)
+    assert pkg.name == "wordpress" and pkg.version == "6.4.2"
+    assert misc_lang.parse_wordpress_version(b"<?php echo 1;") is None
+
+
+def test_rust_binary_audit_section():
+    audit = {
+        "packages": [
+            {"name": "myapp", "version": "0.1.0", "root": True},
+            {"name": "serde", "version": "1.0.160"},
+            {"name": "build-helper", "version": "0.3.0", "kind": "build"},
+        ]
+    }
+    blob = zlib.compress(json.dumps(audit).encode())
+    elf = (b"\x7fELF" + b"\x00" * 32 + b".dep-v0\x00" + b"\x00" * 24
+           + blob + b"\x00" * 32)
+    pkgs = misc_lang.parse_rust_binary(elf)
+    assert [(p.name, p.version) for p in pkgs] == [("serde", "1.0.160")]
+
+
+def test_nuget_packages_config():
+    xml = b"""<?xml version="1.0"?>
+<packages>
+  <package id="Newtonsoft.Json" version="13.0.1" />
+  <package id="NUnit" version="3.13.3" developmentDependency="true" />
+</packages>"""
+    pkgs = misc_lang.parse_nuget_packages_config(xml)
+    byname = {p.name: p for p in pkgs}
+    assert byname["Newtonsoft.Json"].version == "13.0.1"
+    assert byname["NUnit"].dev is True
+
+
+def test_nuget_packages_props():
+    xml = b"""<Project>
+  <ItemGroup>
+    <PackageVersion Include="Serilog" Version="3.0.1" />
+    <PackageVersion Include="Skipped" Version="$(SerilogVersion)" />
+    <GlobalPackageReference Include="StyleCop.Analyzers" Version="1.1.118" />
+  </ItemGroup>
+</Project>"""
+    pkgs = misc_lang.parse_nuget_packages_props(xml)
+    names = {p.name for p in pkgs}
+    assert names == {"Serilog", "StyleCop.Analyzers"}
+
+
+def test_go_sum():
+    content = (b"github.com/pkg/errors v0.9.1 h1:abc=\n"
+               b"github.com/pkg/errors v0.9.1/go.mod h1:def=\n"
+               b"golang.org/x/text v0.3.7/go.mod h1:xxx=\n")
+    pkgs = golang.parse_go_sum(content)
+    byname = {p.name: p.version for p in pkgs}
+    assert byname == {"github.com/pkg/errors": "0.9.1",
+                      "golang.org/x/text": "0.3.7"}
+
+
+def test_gomod_sum_supplement(tmp_path):
+    """go.mod pre-1.17 gets indirect deps from go.sum."""
+    from trivy_tpu.fanal.analyzer import AnalysisInput
+    from trivy_tpu.fanal.analyzers.lang import GoModAnalyzer
+
+    gomod = (b"module example.com/app\n\ngo 1.16\n\n"
+             b"require github.com/pkg/errors v0.9.1\n")
+    gosum = (b"github.com/pkg/errors v0.9.1 h1:a=\n"
+             b"golang.org/x/text v0.3.7/go.mod h1:b=\n")
+    files = {
+        "app/go.mod": AnalysisInput("app/go.mod", gomod),
+        "app/go.sum": AnalysisInput("app/go.sum", gosum),
+    }
+    res = GoModAnalyzer().post_analyze(files)
+    app = res.applications[0]
+    byname = {p.name: p for p in app.packages}
+    assert byname["golang.org/x/text"].indirect is True
+    assert byname["github.com/pkg/errors"].version == "0.9.1"
+
+
+def test_gomod_117_no_sum_supplement():
+    from trivy_tpu.fanal.analyzer import AnalysisInput
+    from trivy_tpu.fanal.analyzers.lang import GoModAnalyzer
+
+    gomod = (b"module example.com/app\n\ngo 1.21\n\n"
+             b"require github.com/pkg/errors v0.9.1\n")
+    gosum = b"golang.org/x/text v0.3.7/go.mod h1:b=\n"
+    files = {
+        "app/go.mod": AnalysisInput("app/go.mod", gomod),
+        "app/go.sum": AnalysisInput("app/go.sum", gosum),
+    }
+    res = GoModAnalyzer().post_analyze(files)
+    names = {p.name for p in res.applications[0].packages}
+    assert "golang.org/x/text" not in names

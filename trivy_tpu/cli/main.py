@@ -87,7 +87,7 @@ def build_parser() -> argparse.ArgumentParser:
         ("sbom", "scan an SBOM file (CycloneDX/SPDX json)", True),
         ("vm", "scan a VM image", True),
     ]:
-        p = sub.add_parser(name, help=help_text)
+        p = sub.add_parser(name, help=help_text, allow_abbrev=False)
         _add_global_flags(p)
         _add_scan_flags(p)
         if name == "image":
@@ -97,7 +97,7 @@ def build_parser() -> argparse.ArgumentParser:
         else:
             p.add_argument("target")
 
-    p = sub.add_parser("convert", help="convert a saved JSON report")
+    p = sub.add_parser("convert", help="convert a saved JSON report", allow_abbrev=False)
     _add_global_flags(p)
     p.add_argument("--format", "-f", default="table")
     p.add_argument("--output", "-o", default=None)
@@ -105,32 +105,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--severity", "-s", default=None)
     p.add_argument("report")
 
-    p = sub.add_parser("server", help="run the scan server")
+    p = sub.add_parser("server", help="run the scan server", allow_abbrev=False)
     _add_global_flags(p)
     p.add_argument("--listen", default="localhost:4954")
     p.add_argument("--token", default=None)
     p.add_argument("--db-path", default=None)
     p.add_argument("--no-tpu", action="store_true")
 
-    p = sub.add_parser("db", help="advisory DB operations")
+    p = sub.add_parser("db", help="advisory DB operations", allow_abbrev=False)
     _add_global_flags(p)
     dbsub = p.add_subparsers(dest="db_command")
-    pi = dbsub.add_parser("import", help="import advisories from a JSON dump")
+    pi = dbsub.add_parser("import", help="import advisories from a JSON dump", allow_abbrev=False)
     pi.add_argument("source")
     pi.add_argument("--db-path", default=None)
-    ps = dbsub.add_parser("stats", help="show DB statistics")
+    ps = dbsub.add_parser("stats", help="show DB statistics", allow_abbrev=False)
     ps.add_argument("--db-path", default=None)
 
-    p = sub.add_parser("clean", help="clean caches")
+    p = sub.add_parser("clean", help="clean caches", allow_abbrev=False)
     _add_global_flags(p)
     p.add_argument("--all", action="store_true")
 
-    p = sub.add_parser("config", help="scan config files for misconfigurations")
+    p = sub.add_parser("config", help="scan config files for misconfigurations", allow_abbrev=False)
     _add_global_flags(p)
     _add_scan_flags(p)
     p.add_argument("target")
 
-    sub.add_parser("version", help="print version")
+    sub.add_parser("version", help="print version", allow_abbrev=False)
     return parser
 
 

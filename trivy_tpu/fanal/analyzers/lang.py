@@ -16,7 +16,7 @@ from trivy_tpu.fanal.analyzer import (
     register,
     register_post,
 )
-from trivy_tpu.parsers import golang, misc_lang, nodejs
+from trivy_tpu.parsers import golang, java_pom, misc_lang, nodejs
 from trivy_tpu.parsers import python as pyparse
 from trivy_tpu.types.artifact import Application
 
@@ -43,16 +43,22 @@ class _LockfileAnalyzer(PostAnalyzer):
     def post_analyze(self, files: dict[str, AnalysisInput]):
         res = AnalysisResult()
         for path, inp in sorted(files.items()):
+            # post_files buckets are keyed by analyzer type; two analyzers
+            # sharing a type must not run on each other's files
+            if os.path.basename(path) not in self.filenames:
+                continue
             got = _app(self.app_type, path, type(self).parser(inp.read()))
             res.merge(got)
         return res
 
 
-def _lockfile(app_type: str, filenames: tuple, parser) -> None:
+def _lockfile(app_type: str, filenames: tuple, parser,
+              atype: str = "") -> None:
+    atype = atype or app_type
     cls = type(
-        f"{app_type.title()}Analyzer",
+        f"{atype.title()}Analyzer",
         (_LockfileAnalyzer,),
-        {"type": app_type, "app_type": app_type, "filenames": filenames,
+        {"type": atype, "app_type": app_type, "filenames": filenames,
          "parser": staticmethod(parser)},
     )
     register_post(cls())
@@ -65,7 +71,12 @@ _lockfile("pip", ("requirements.txt",), pyparse.parse_requirements)
 _lockfile("pipenv", ("Pipfile.lock",), pyparse.parse_pipfile_lock)
 _lockfile("poetry", ("poetry.lock",), pyparse.parse_poetry_lock)
 _lockfile("uv", ("uv.lock",), pyparse.parse_uv_lock)
-_lockfile("gomod", ("go.mod",), golang.parse_go_mod)
+_lockfile("julia", ("Manifest.toml",), misc_lang.parse_julia_manifest)
+_lockfile("nuget", ("packages.config",),
+          misc_lang.parse_nuget_packages_config, atype="nuget-config")
+_lockfile("nuget", ("Directory.Packages.props",),
+          misc_lang.parse_nuget_packages_props, atype="packages-props")
+_lockfile("pom", ("pom.xml",), java_pom.parse_pom)
 _lockfile("cargo", ("Cargo.lock",), misc_lang.parse_cargo_lock)
 _lockfile("composer", ("composer.lock",), misc_lang.parse_composer_lock)
 _lockfile("bundler", ("Gemfile.lock",), misc_lang.parse_gemfile_lock)
@@ -96,6 +107,45 @@ class DotnetDepsAnalyzer(PostAnalyzer):
         for path, inp in sorted(files.items()):
             res.merge(_app(self.app_type, path,
                            misc_lang.parse_deps_json(inp.read())))
+        return res
+
+
+@register_post
+class GoModAnalyzer(PostAnalyzer):
+    """go.mod (+ go.sum supplement when go.mod predates go 1.17, whose
+    lockfiles list no indirect deps — reference
+    pkg/fanal/analyzer/language/golang/mod)."""
+
+    type = "gomod"
+    version = 2
+    app_type = "gomod"
+
+    _GO_DIRECTIVE = re.compile(rb"^go\s+(\d+)\.(\d+)", re.M)
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        return os.path.basename(path) in ("go.mod", "go.sum")
+
+    def post_analyze(self, files):
+        res = AnalysisResult()
+        by_dir: dict[str, dict[str, AnalysisInput]] = {}
+        for path, inp in files.items():
+            by_dir.setdefault(os.path.dirname(path), {})[
+                os.path.basename(path)] = inp
+        for d, group in sorted(by_dir.items()):
+            if "go.mod" not in group:
+                continue
+            mod_content = group["go.mod"].read()
+            pkgs = golang.parse_go_mod(mod_content)
+            m = self._GO_DIRECTIVE.search(mod_content)
+            pre117 = m is None or (int(m.group(1)), int(m.group(2))) < (1, 17)
+            if pre117 and "go.sum" in group:
+                have = {p.name for p in pkgs}
+                for p in golang.parse_go_sum(group["go.sum"].read()):
+                    if p.name not in have:
+                        p.indirect = True
+                        p.relationship = "indirect"
+                        pkgs.append(p)
+            res.merge(_app("gomod", group["go.mod"].path, pkgs))
         return res
 
 
@@ -186,6 +236,52 @@ class CondaPkgAnalyzer(Analyzer):
             return None
         pkg.file_path = inp.path
         return _app("conda-pkg", inp.path, [pkg])
+
+
+@register
+class WordPressAnalyzer(Analyzer):
+    """wp-includes/version.php -> wordpress core version (reference
+    analyzer/language/php/wordpress)."""
+
+    type = "wordpress"
+    version = 1
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        return path.endswith("wp-includes/version.php")
+
+    def analyze(self, inp: AnalysisInput):
+        pkg = misc_lang.parse_wordpress_version(inp.read())
+        if pkg is None:
+            return None
+        pkg.file_path = inp.path
+        return _app("wordpress", inp.path, [pkg])
+
+
+@register
+class RustBinaryAnalyzer(Analyzer):
+    """Executables with a cargo-auditable dependency list embedded
+    (reference analyzer/language/rust/binary)."""
+
+    type = "rustbinary"
+    version = 1
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        if size < 1024 or size > 200 * 1024 * 1024:
+            return False
+        if not (mode & (stat.S_IXUSR | stat.S_IXGRP | stat.S_IXOTH)) and mode:
+            return False
+        base = os.path.basename(path)
+        return "." not in base or base.endswith((".bin", ".exe"))
+
+    def analyze(self, inp: AnalysisInput):
+        content = inp.read()
+        if content[:4] not in (b"\x7fELF", b"MZ\x90\x00", b"\xcf\xfa\xed\xfe",
+                               b"\xfe\xed\xfa\xcf"):
+            return None
+        if b"cargo" not in content and b"rustc" not in content:
+            return None
+        pkgs = misc_lang.parse_rust_binary(content)
+        return _app("rustbinary", inp.path, pkgs)
 
 
 @register

@@ -97,3 +97,20 @@ def parse_go_binary(content: bytes) -> list[Package]:
             if ver and ver != "(devel)":
                 pkgs.append(_mk(name, ver))
     return pkgs
+
+
+def parse_go_sum(content: bytes) -> list[Package]:
+    """go.sum (reference pkg/dependency/parser/golang/sum): used as the
+    dependency source when go.mod predates go 1.17 and lists no indirect
+    deps. Lines: `module version[/go.mod] hash`."""
+    pkgs: dict[str, Package] = {}
+    for line in content.splitlines():
+        parts = line.split()
+        if len(parts) < 3:
+            continue
+        name, ver = parts[0].decode(), parts[1].decode()
+        if ver.endswith("/go.mod"):
+            ver = ver[: -len("/go.mod")]
+        if name and ver:
+            pkgs[name] = _mk(name, ver)
+    return sorted(pkgs.values(), key=lambda p: p.id)

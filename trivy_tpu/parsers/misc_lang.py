@@ -342,3 +342,147 @@ def parse_conda_environment(content: bytes) -> list[Package]:
         if len(parts) >= 2 and parts[0] and parts[1]:
             out.append(_mk(parts[0], parts[1]))
     return out
+
+
+# ------------------------------------------------------------ julia
+
+
+def parse_julia_manifest(content: bytes) -> list[Package]:
+    """Manifest.toml (reference pkg/dependency/parser/julia/manifest):
+    supports both the flat pre-1.7 layout and the 1.7+ [deps] table."""
+    import tomllib
+
+    try:
+        doc = tomllib.loads(content.decode("utf-8", "replace"))
+    except tomllib.TOMLDecodeError:
+        return []
+    deps = doc.get("deps", doc)  # 1.7+ nests under [deps]
+    out = []
+    for name, entries in deps.items():
+        if not isinstance(entries, list):
+            continue
+        for e in entries:
+            if not isinstance(e, dict):
+                continue
+            version = e.get("version") or ""
+            uuid = e.get("uuid") or ""
+            # stdlib entries carry no version; the julia runtime provides them
+            if not version:
+                continue
+            pkg = _mk(name, str(version))
+            if uuid:
+                pkg.id = f"{uuid}@{version}"
+            out.append(pkg)
+    return sorted(out, key=lambda p: (p.name, p.version))
+
+
+# ------------------------------------------------------------ wordpress
+
+
+_WP_VERSION_RX = re.compile(
+    rb"\$wp_version\s*=\s*['\"]([0-9][0-9a-zA-Z.\-]*)['\"]")
+
+
+def parse_wordpress_version(content: bytes) -> Package | None:
+    """wp-includes/version.php (reference
+    pkg/dependency/parser/wordpress: reads $wp_version)."""
+    m = _WP_VERSION_RX.search(content)
+    if not m:
+        return None
+    return _mk("wordpress", m.group(1).decode())
+
+
+# ------------------------------------------------------------ rust binary
+
+
+def parse_rust_binary(content: bytes) -> list[Package]:
+    """Rust binaries built with cargo-auditable embed a zlib-compressed
+    JSON dependency list in a dedicated section named .dep-v0 (reference
+    pkg/dependency/parser/rust/binary via rust-audit-info). Rather than
+    fully parsing ELF/PE section tables, scan for zlib streams and accept
+    the one that inflates to the audit JSON shape. The section *name*
+    appearing in the binary's string table is the cheap gate; candidate
+    streams are probed with a bounded 64-byte inflate before committing
+    to a (size-capped) full decompression."""
+    import zlib
+
+    if b"dep-v0" not in content:
+        return []
+    view = memoryview(content)
+    out: list[Package] = []
+    pos = 0
+    while True:
+        idx = content.find(b"\x78", pos)
+        if idx < 0 or idx + 2 > len(content):
+            break
+        pos = idx + 1
+        if content[idx + 1] not in (0x01, 0x5E, 0x9C, 0xDA):
+            continue
+        window = view[idx: idx + 8 * 1024 * 1024]
+        try:
+            probe = zlib.decompressobj().decompress(window, 64)
+        except zlib.error:
+            continue
+        if not probe.startswith(b'{"packages":'):
+            continue
+        try:  # bounded full inflate: audit JSON is small (< 16 MiB)
+            blob = zlib.decompressobj().decompress(window, 16 * 1024 * 1024)
+        except zlib.error:
+            continue
+        try:
+            doc = json.loads(blob)
+        except json.JSONDecodeError:
+            continue
+        pkgs = doc.get("packages") or []
+        roots = {i for i, p in enumerate(pkgs) if p.get("root")}
+        for i, p in enumerate(pkgs):
+            name, version = p.get("name"), p.get("version")
+            if not name or not version or i in roots:
+                continue
+            if p.get("kind", "runtime") != "runtime":
+                continue
+            out.append(_mk(name, version))
+        break
+    return sorted(out, key=lambda p: p.id)
+
+
+# ------------------------------------------------------------ nuget config
+
+
+def parse_nuget_packages_config(content: bytes) -> list[Package]:
+    """packages.config (reference pkg/dependency/parser/nuget/config)."""
+    import xml.etree.ElementTree as ET
+
+    try:
+        root = ET.fromstring(content)
+    except ET.ParseError:
+        return []
+    out = []
+    for pkg in root.iter("package"):
+        name = pkg.get("id")
+        version = pkg.get("version")
+        if name and version:
+            out.append(_mk(name, version,
+                           dev=pkg.get("developmentDependency") == "true"))
+    return sorted(out, key=lambda p: p.id)
+
+
+def parse_nuget_packages_props(content: bytes) -> list[Package]:
+    """Directory.Packages.props central package management (reference
+    pkg/dependency/parser/nuget/packagesprops)."""
+    import xml.etree.ElementTree as ET
+
+    try:
+        root = ET.fromstring(content)
+    except ET.ParseError:
+        return []
+    out = []
+    for tag in ("PackageVersion", "GlobalPackageReference"):
+        for item in root.iter(tag):
+            name = item.get("Include")
+            version = item.get("Version") or ""
+            # MSBuild variable versions can't be resolved offline
+            if not name or not version or "$(" in version or "$(" in name:
+                continue
+            out.append(_mk(name, version))
+    return sorted(out, key=lambda p: p.id)
