@@ -1,0 +1,207 @@
+"""Helm chart rendering, terraform-plan and Azure ARM scanners
+(reference pkg/iac/scanners/{helm,terraformplan,azure})."""
+
+import json
+
+from trivy_tpu.iac.helm import find_chart_roots, render_chart
+from trivy_tpu.misconf.scanner import scan_config
+
+CHART = {
+    "Chart.yaml": b"name: web\nversion: 1.0.0\nappVersion: '2.1'\n",
+    "values.yaml": (b"replicas: 2\nimage:\n  repo: nginx\n  tag: '1.25'\n"
+                    b"securityContext:\n  runAsNonRoot: true\n"
+                    b"extraLabels:\n  team: infra\n"
+                    b"privileged: false\n"),
+    "templates/_helpers.tpl": (
+        b'{{- define "web.fullname" -}}\n'
+        b'{{ .Release.Name }}-{{ .Chart.Name }}\n'
+        b'{{- end -}}\n'),
+    "templates/deploy.yaml": b"""apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {{ include "web.fullname" . }}
+  labels:
+    app: {{ .Chart.Name }}
+    version: {{ .Chart.AppVersion | quote }}
+{{- range $k, $v := .Values.extraLabels }}
+    {{ $k }}: {{ $v }}
+{{- end }}
+spec:
+  replicas: {{ .Values.replicas }}
+  template:
+    spec:
+      containers:
+        - name: {{ .Chart.Name }}
+          image: "{{ .Values.image.repo }}:{{ .Values.image.tag | default "latest" }}"
+          securityContext:
+            privileged: {{ .Values.privileged }}
+{{- if .Values.securityContext }}
+            runAsNonRoot: {{ .Values.securityContext.runAsNonRoot }}
+{{- end }}
+""",
+}
+
+
+def test_helm_render_basic():
+    out = dict(render_chart(CHART))
+    body = out["templates/deploy.yaml"].decode()
+    assert "name: release-name-web" in body
+    assert 'version: "2.1"' in body
+    assert "team: infra" in body
+    assert "replicas: 2" in body
+    assert 'image: "nginx:1.25"' in body
+    assert "runAsNonRoot: True" in body or "runAsNonRoot: true" in body
+
+
+def test_helm_render_value_overrides():
+    out = dict(render_chart(CHART, {"image": {"tag": ""}}))
+    body = out["templates/deploy.yaml"].decode()
+    assert 'image: "nginx:latest"' in body  # default fires on empty tag
+
+
+def test_helm_conditional_and_else():
+    files = {
+        "Chart.yaml": b"name: c\nversion: 0.1.0\n",
+        "values.yaml": b"env: prod\n",
+        "templates/cm.yaml": (
+            b"kind: ConfigMap\napiVersion: v1\ndata:\n"
+            b"{{- if eq .Values.env \"prod\" }}\n  mode: production\n"
+            b"{{- else }}\n  mode: dev\n{{- end }}\n"
+            b"  missing: {{ .Values.nothere | default \"fallback\" }}\n"),
+    }
+    body = dict(render_chart(files))["templates/cm.yaml"].decode()
+    assert "mode: production" in body
+    assert "missing: fallback" in body
+
+
+def test_helm_nindent_toyaml():
+    files = {
+        "Chart.yaml": b"name: c\nversion: 0.1.0\n",
+        "values.yaml": b"resources:\n  limits:\n    cpu: 100m\n",
+        "templates/pod.yaml": (
+            b"kind: Pod\napiVersion: v1\nspec:\n  resources:"
+            b"{{- toYaml .Values.resources | nindent 4 }}\n"),
+    }
+    body = dict(render_chart(files))["templates/pod.yaml"].decode()
+    assert "    limits:" in body
+    assert "      cpu: 100m" in body
+
+
+def test_helm_chart_scan_end_to_end(tmp_path):
+    """Chart rendering feeds the k8s checks (privileged finding against
+    the rendered template path)."""
+    from trivy_tpu.fanal.analyzer import AnalysisInput
+    from trivy_tpu.fanal.analyzers.config_analyzer import ConfigAnalyzer
+
+    bad = dict(CHART)
+    bad["values.yaml"] = bad["values.yaml"].replace(
+        b"privileged: false", b"privileged: true")
+    files = {
+        f"mychart/{p}": AnalysisInput(f"mychart/{p}", c)
+        for p, c in bad.items()
+    }
+    res = ConfigAnalyzer().post_analyze(files)
+    deploy = [m for m in res.misconfigurations
+              if m.file_path == "mychart/templates/deploy.yaml"]
+    assert deploy, [m.file_path for m in res.misconfigurations]
+    ids = {f.id for f in deploy[0].failures}
+    assert any("privileged" in f.title.lower()
+               for f in deploy[0].failures), ids
+    assert deploy[0].file_type == "helm"
+
+
+def test_find_chart_roots():
+    paths = ["app/Chart.yaml", "app/values.yaml",
+             "app/charts/sub/Chart.yaml", "other/x.yaml"]
+    assert find_chart_roots(paths) == ["app"]
+
+
+def test_terraform_plan_scan():
+    plan = {
+        "format_version": "1.2",
+        "terraform_version": "1.5.0",
+        "planned_values": {"root_module": {
+            "resources": [
+                {"address": "aws_s3_bucket.logs", "type": "aws_s3_bucket",
+                 "name": "logs", "values": {"bucket": "logs-bucket",
+                                            "acl": "public-read"}},
+                {"address": "aws_db_instance.db",
+                 "type": "aws_db_instance",
+                 "name": "db", "values": {"storage_encrypted": False,
+                                          "publicly_accessible": True}},
+            ],
+            "child_modules": [{"resources": [
+                {"address": "module.net.aws_security_group.sg",
+                 "type": "aws_security_group", "name": "sg",
+                 "values": {"description": "",
+                            "ingress": [{"cidr_blocks": ["0.0.0.0/0"]}],
+                            "egress": []}},
+            ]}],
+        }},
+    }
+    content = json.dumps(plan).encode()
+    m = scan_config("tfplan.json", content)
+    assert m is not None and m.file_type == "terraformplan"
+    ids = {f.id for f in m.failures}
+    assert "AVD-AWS-0092" in ids  # public acl
+    assert "AVD-AWS-0082" in ids  # rds public
+    assert "AVD-AWS-0107" in ids  # open ingress (child module)
+
+
+def test_terraform_plan_public_access_block():
+    plan = {
+        "terraform_version": "1.5.0",
+        "planned_values": {"root_module": {"resources": [
+            {"address": "aws_s3_bucket.a", "type": "aws_s3_bucket",
+             "name": "a", "values": {"bucket": "guarded"}},
+            {"address": "aws_s3_bucket_public_access_block.a",
+             "type": "aws_s3_bucket_public_access_block",
+             "name": "a", "values": {
+                 "bucket": "guarded", "block_public_acls": True,
+                 "block_public_policy": True, "ignore_public_acls": True,
+                 "restrict_public_buckets": True}},
+        ]}},
+    }
+    m = scan_config("tfplan.json", json.dumps(plan).encode())
+    assert "AVD-AWS-0086" not in {f.id for f in m.failures}
+
+
+def test_azure_arm_scan():
+    arm = {
+        "$schema": "https://schema.management.azure.com/schemas/"
+                   "2019-04-01/deploymentTemplate.json#",
+        "resources": [
+            {"type": "Microsoft.Storage/storageAccounts", "name": "st",
+             "properties": {"supportsHttpsTrafficOnly": False,
+                            "minimumTlsVersion": "TLS1_0",
+                            "allowBlobPublicAccess": True}},
+            {"type": "Microsoft.Network/networkSecurityGroups",
+             "name": "nsg", "properties": {"securityRules": [
+                 {"properties": {"direction": "Inbound",
+                                 "access": "Allow",
+                                 "sourceAddressPrefix": "*",
+                                 "destinationPortRange": "22"}}]}},
+            {"type": "Microsoft.Sql/servers", "name": "sql",
+             "properties": {"publicNetworkAccess": "Enabled"}},
+        ],
+    }
+    m = scan_config("deploy.json", json.dumps(arm).encode())
+    assert m is not None and m.file_type == "azure-arm"
+    ids = {f.id for f in m.failures}
+    assert {"AVD-AZU-0008", "AVD-AZU-0011", "AVD-AZU-0007",
+            "AVD-AZU-0047", "AVD-AZU-0022"} <= ids
+
+
+def test_arm_clean_passes():
+    arm = {
+        "$schema": "https://x/deploymentTemplate.json#",
+        "resources": [
+            {"type": "Microsoft.Storage/storageAccounts", "name": "st",
+             "properties": {"supportsHttpsTrafficOnly": True,
+                            "minimumTlsVersion": "TLS1_2",
+                            "allowBlobPublicAccess": False}},
+        ],
+    }
+    m = scan_config("deploy.json", json.dumps(arm).encode())
+    failing = {f.id for f in m.failures}
+    assert not failing & {"AVD-AZU-0008", "AVD-AZU-0011", "AVD-AZU-0007"}

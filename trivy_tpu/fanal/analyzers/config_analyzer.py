@@ -37,10 +37,41 @@ class ConfigAnalyzer(PostAnalyzer):
         return _looks_like_config(path)
 
     def post_analyze(self, files: dict[str, AnalysisInput]):
+        from trivy_tpu.iac.helm import find_chart_roots, render_chart
         from trivy_tpu.misconf.scanner import scan_config
 
         res = AnalysisResult()
+        # helm charts render as a unit (reference scans the helm-engine
+        # output, not raw templates); rendered docs scan as kubernetes
+        roots = find_chart_roots(files)
+        in_chart: set[str] = set()
+        for root in roots:
+            prefix = root + "/" if root else ""
+            chart_files = {
+                p[len(prefix):]: files[p].read()
+                for p in files if p.startswith(prefix)
+            }
+            if not chart_files:
+                continue
+            # only files the helm engine consumes are chart-owned; other
+            # configs living under the chart dir (Dockerfile, *.tf, ...)
+            # still scan individually
+            in_chart.update(
+                prefix + rel for rel in chart_files
+                if rel in ("Chart.yaml", "values.yaml", "values.yml")
+                or rel.startswith("templates/")
+            )
+            for rel_path, rendered in render_chart(chart_files):
+                full = prefix + rel_path
+                misconf = scan_config(full, rendered,
+                                      file_type=detection.KUBERNETES)
+                if misconf is not None and (misconf.failures
+                                            or misconf.successes):
+                    misconf.file_type = detection.HELM
+                    res.misconfigurations.append(misconf)
         for path, inp in sorted(files.items()):
+            if path in in_chart:
+                continue
             misconf = scan_config(path, inp.read())
             if misconf is not None and (
                 misconf.failures or misconf.successes

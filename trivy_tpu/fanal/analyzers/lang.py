@@ -238,6 +238,23 @@ class CondaPkgAnalyzer(Analyzer):
         return _app("conda-pkg", inp.path, [pkg])
 
 
+def _looks_like_executable(path: str, size: int, mode: int,
+                           extra_exts: tuple = ()) -> bool:
+    """Candidate filter shared by the binary analyzers: plausible size,
+    executable bit (when mode is known), extension-less or a known
+    binary extension."""
+    if size < 1024 or size > 200 * 1024 * 1024:
+        return False
+    if not (mode & (stat.S_IXUSR | stat.S_IXGRP | stat.S_IXOTH)) and mode:
+        return False
+    base = os.path.basename(path)
+    return "." not in base or base.endswith((".bin", ".exe") + extra_exts)
+
+
+_BINARY_MAGICS = (b"\x7fELF", b"MZ\x90\x00", b"\xcf\xfa\xed\xfe",
+                  b"\xfe\xed\xfa\xcf")
+
+
 @register
 class WordPressAnalyzer(Analyzer):
     """wp-includes/version.php -> wordpress core version (reference
@@ -266,17 +283,11 @@ class RustBinaryAnalyzer(Analyzer):
     version = 1
 
     def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
-        if size < 1024 or size > 200 * 1024 * 1024:
-            return False
-        if not (mode & (stat.S_IXUSR | stat.S_IXGRP | stat.S_IXOTH)) and mode:
-            return False
-        base = os.path.basename(path)
-        return "." not in base or base.endswith((".bin", ".exe"))
+        return _looks_like_executable(path, size, mode)
 
     def analyze(self, inp: AnalysisInput):
         content = inp.read()
-        if content[:4] not in (b"\x7fELF", b"MZ\x90\x00", b"\xcf\xfa\xed\xfe",
-                               b"\xfe\xed\xfa\xcf"):
+        if content[:4] not in _BINARY_MAGICS:
             return None
         if b"cargo" not in content and b"rustc" not in content:
             return None
@@ -293,17 +304,11 @@ class GoBinaryAnalyzer(Analyzer):
     version = 1
 
     def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
-        if size < 1024 or size > 200 * 1024 * 1024:
-            return False
-        if not (mode & (stat.S_IXUSR | stat.S_IXGRP | stat.S_IXOTH)) and mode:
-            return False
-        base = os.path.basename(path)
-        return "." not in base or base.endswith((".bin", ".exe", ".test"))
+        return _looks_like_executable(path, size, mode, (".test",))
 
     def analyze(self, inp: AnalysisInput):
         content = inp.read()
-        if content[:4] not in (b"\x7fELF", b"MZ\x90\x00", b"\xcf\xfa\xed\xfe",
-                               b"\xfe\xed\xfa\xcf"):
+        if content[:4] not in _BINARY_MAGICS:
             return None
         pkgs = golang.parse_go_binary(content)
         return _app("gobinary", inp.path, pkgs)

@@ -65,7 +65,12 @@ def _load_builtins():
     if _loaded:
         return
     _loaded = True
-    from trivy_tpu.iac.checks import cloud, docker, kubernetes  # noqa: F401
+    from trivy_tpu.iac.checks import (  # noqa: F401
+        azure,
+        cloud,
+        docker,
+        kubernetes,
+    )
 
 
 def check(id: str, title: str, *, severity="MEDIUM", file_types=(),

@@ -21,7 +21,7 @@ from trivy_tpu.iac.parsers.yamlconf import (
     strip_lines,
 )
 
-_C = ("terraform", "cloudformation")
+_C = ("terraform", "cloudformation", "terraformplan")
 
 
 @dataclass
@@ -438,3 +438,132 @@ def iam_wildcard(ctx):
                     "IAM policy document uses wildcarded action and "
                     "resource"))
     return out
+
+
+# ------------------------------------------------------------ terraform plan
+
+
+def adapt_terraform_plan(doc: dict) -> list[CloudResource]:
+    """tfplan JSON (terraform show -json): planned_values.root_module
+    resources carry fully-resolved values, so the mapping mirrors
+    adapt_terraform with concrete values and no line info (reference
+    pkg/iac/scanners/terraformplan)."""
+    out: list[CloudResource] = []
+    sse_buckets: set[str] = set()
+
+    def collect_sse(mod: dict):
+        for res in mod.get("resources") or []:
+            if res.get("type") == \
+                    "aws_s3_bucket_server_side_encryption_configuration":
+                bucket = (res.get("values") or {}).get("bucket")
+                if bucket:
+                    sse_buckets.add(str(bucket))
+        for child in mod.get("child_modules") or []:
+            collect_sse(child)
+
+    def walk_module(mod: dict):
+        for res in mod.get("resources") or []:
+            cr = _plan_resource(res)
+            if cr is not None:
+                if cr.type == "s3_bucket" and \
+                        str(cr.attrs.get("bucket_name") or "") in sse_buckets:
+                    cr.attrs["encrypted"] = True
+                out.append(cr)
+        for child in mod.get("child_modules") or []:
+            walk_module(child)
+
+    planned = doc.get("planned_values") or {}
+    collect_sse(planned.get("root_module") or {})
+    walk_module(planned.get("root_module") or {})
+    return out
+
+
+def _plan_resource(res: dict) -> CloudResource | None:
+    t = str(res.get("type", ""))
+    vals = res.get("values") or {}
+    cr = CloudResource(name=str(res.get("address", "")))
+    if t == "aws_s3_bucket":
+        sse = vals.get("server_side_encryption_configuration")
+        cr.type = "s3_bucket"
+        cr.attrs = {
+            "acl": vals.get("acl"),
+            "bucket_name": vals.get("bucket"),
+            "encrypted": bool(sse),
+            "public_access_block": False,  # separate resource; see below
+            "logging": bool(vals.get("logging")),
+            "versioning": bool(
+                (vals.get("versioning") or [{}])[0].get("enabled")
+                if isinstance(vals.get("versioning"), list)
+                else (vals.get("versioning") or {}).get("enabled")),
+        }
+    elif t in ("aws_security_group", "aws_security_group_rule",
+               "aws_vpc_security_group_ingress_rule"):
+        cr.type = "security_group"
+        ingress_cidrs, egress_cidrs = [], []
+        if t == "aws_security_group":
+            for rule in vals.get("ingress") or []:
+                ingress_cidrs.extend(rule.get("cidr_blocks") or [])
+            for rule in vals.get("egress") or []:
+                egress_cidrs.extend(rule.get("cidr_blocks") or [])
+        elif t == "aws_security_group_rule":
+            cidrs = vals.get("cidr_blocks") or []
+            (ingress_cidrs if vals.get("type") == "ingress"
+             else egress_cidrs).extend(cidrs)
+        else:
+            v = vals.get("cidr_ipv4")
+            if v:
+                ingress_cidrs.append(v)
+        cr.attrs = {
+            "ingress_cidrs": ingress_cidrs,
+            "egress_cidrs": egress_cidrs,
+            "description": vals.get("description"),
+        }
+    elif t == "aws_ebs_volume":
+        cr.type = "ebs_volume"
+        cr.attrs = {"encrypted": bool(vals.get("encrypted"))}
+    elif t == "aws_db_instance":
+        cr.type = "rds_instance"
+        cr.attrs = {
+            "encrypted": bool(vals.get("storage_encrypted")),
+            "public": bool(vals.get("publicly_accessible")),
+        }
+    elif t == "aws_instance":
+        cr.type = "ec2_instance"
+        mo = vals.get("metadata_options")
+        mo = mo[0] if isinstance(mo, list) and mo else (mo or {})
+        cr.attrs = {"http_tokens": mo.get("http_tokens")}
+    elif t in ("aws_iam_policy", "aws_iam_role_policy",
+               "aws_iam_user_policy", "aws_iam_group_policy"):
+        cr.type = "iam_policy"
+        cr.attrs = {"document": _policy_doc(vals.get("policy"))}
+    else:
+        return None
+    return cr
+
+
+def plan_apply_public_access_blocks(doc: dict,
+                                    resources: list[CloudResource]) -> None:
+    """aws_s3_bucket_public_access_block resources in the plan mark their
+    bucket as protected (mirrors the companion-resource handling in
+    adapt_terraform)."""
+    protected: set[str] = set()
+
+    def walk(mod: dict):
+        for res in mod.get("resources") or []:
+            if res.get("type") == "aws_s3_bucket_public_access_block":
+                vals = res.get("values") or {}
+                bucket = vals.get("bucket")
+                if bucket and all(vals.get(k) for k in (
+                        "block_public_acls", "block_public_policy",
+                        "ignore_public_acls", "restrict_public_buckets")):
+                    protected.add(str(bucket))
+        for child in mod.get("child_modules") or []:
+            walk(child)
+
+    walk((doc.get("planned_values") or {}).get("root_module") or {})
+    if not protected:
+        return
+    for cr in resources:
+        if cr.type == "s3_bucket" and \
+                str(cr.attrs.get("bucket_name") or "") in protected:
+            cr.attrs["public_access_block"] = True

@@ -84,6 +84,31 @@ def _contexts(file_type: str, path: str, content: bytes) -> list:
         return [CloudCtx(path=path,
                          cloud_resources=adapt_cloudformation(
                              cfn_resources(docs)))]
+    if file_type == detection.TERRAFORM_PLAN:
+        import json as _json
+
+        from trivy_tpu.iac.checks.cloud import (
+            adapt_terraform_plan,
+            plan_apply_public_access_blocks,
+        )
+
+        try:
+            doc = _json.loads(content)
+        except ValueError:
+            return []
+        resources = adapt_terraform_plan(doc)
+        plan_apply_public_access_blocks(doc, resources)
+        return [CloudCtx(path=path, cloud_resources=resources)]
+    if file_type == detection.AZURE_ARM:
+        import json as _json
+
+        from trivy_tpu.iac.checks.azure import adapt_arm
+
+        try:
+            doc = _json.loads(content)
+        except ValueError:
+            return []
+        return [CloudCtx(path=path, cloud_resources=adapt_arm(doc))]
     return []
 
 

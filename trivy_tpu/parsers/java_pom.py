@@ -129,6 +129,5 @@ def parse_pom(content: bytes) -> list[Package]:
         if name in seen:
             continue
         seen.add(name)
-        out.append(Package(id=f"{name}@{v}", name=name, version=v,
-                           dev=(scope == "test")))
+        out.append(Package(id=f"{name}@{v}", name=name, version=v))
     return out
