@@ -111,9 +111,35 @@ def test_helm_chart_scan_end_to_end(tmp_path):
 
 
 def test_find_chart_roots():
+    # every chart dir is a root: subcharts render independently
     paths = ["app/Chart.yaml", "app/values.yaml",
              "app/charts/sub/Chart.yaml", "other/x.yaml"]
-    assert find_chart_roots(paths) == ["app"]
+    assert find_chart_roots(paths) == ["app", "app/charts/sub"]
+
+
+def test_nested_independent_chart_renders(tmp_path):
+    """A chart nested under another chart root (outside charts/) must
+    still render — not fall back to the lossy strip scan."""
+    from trivy_tpu.fanal.analyzer import AnalysisInput
+    from trivy_tpu.fanal.analyzers.config_analyzer import ConfigAnalyzer
+
+    files = {}
+    for root in ("", "examples/c2/"):
+        files[f"{root}Chart.yaml"] = b"name: c\nversion: 0.1.0\n"
+        files[f"{root}values.yaml"] = b"privileged: true\n"
+        files[f"{root}templates/pod.yaml"] = (
+            b"apiVersion: v1\nkind: Pod\nmetadata:\n  name: p\nspec:\n"
+            b"  containers:\n    - name: c\n      image: x:1\n"
+            b"      securityContext:\n"
+            b"        privileged: {{ .Values.privileged }}\n")
+    inputs = {p: AnalysisInput(p, c) for p, c in files.items()}
+    res = ConfigAnalyzer().post_analyze(inputs)
+    by_path = {m.file_path: m for m in res.misconfigurations}
+    for p in ("templates/pod.yaml", "examples/c2/templates/pod.yaml"):
+        assert p in by_path, sorted(by_path)
+        assert any(f.id == "KSV017" for f in by_path[p].failures), p
+        assert all(f.type == "helm"
+                   for f in by_path[p].failures + by_path[p].successes)
 
 
 def test_terraform_plan_scan():

@@ -1,0 +1,190 @@
+"""Cluster scanning subsystem (reference pkg/k8s): manifest enumeration,
+workload/RBAC/infra assessment, summary + json reports."""
+
+import json
+
+import pytest
+
+from trivy_tpu.k8s.artifacts import load_manifests, parse_manifest_docs
+from trivy_tpu.k8s.infra import assess_infra
+from trivy_tpu.k8s.rbac import assess_rbac
+from trivy_tpu.k8s.report import render_summary, to_dict
+from trivy_tpu.k8s.scanner import ClusterScanner
+
+DEPLOY = b"""apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+  namespace: prod
+spec:
+  template:
+    spec:
+      containers:
+        - name: app
+          image: nginx:1.25
+          securityContext:
+            privileged: true
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: web-svc
+  namespace: prod
+"""
+
+CRONJOB = b"""apiVersion: batch/v1
+kind: CronJob
+metadata:
+  name: backup
+spec:
+  jobTemplate:
+    spec:
+      template:
+        spec:
+          initContainers:
+            - name: prep
+              image: busybox:1.36
+          containers:
+            - name: run
+              image: backup-tool:2.0
+"""
+
+BAD_ROLE = b"""apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRole
+metadata:
+  name: god-mode
+rules:
+  - apiGroups: ["*"]
+    resources: ["*"]
+    verbs: ["*"]
+  - apiGroups: [""]
+    resources: ["secrets"]
+    verbs: ["get", "list"]
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRoleBinding
+metadata:
+  name: everyone-admin
+roleRef:
+  kind: ClusterRole
+  name: cluster-admin
+subjects:
+  - kind: Group
+    name: system:authenticated
+"""
+
+APISERVER = b"""apiVersion: v1
+kind: Pod
+metadata:
+  name: kube-apiserver-node1
+  namespace: kube-system
+spec:
+  containers:
+    - name: kube-apiserver
+      image: registry.k8s.io/kube-apiserver:v1.29.0
+      command:
+        - kube-apiserver
+        - --anonymous-auth=true
+        - --authorization-mode=AlwaysAllow
+        - --profiling=true
+"""
+
+
+def test_parse_manifests_multi_doc():
+    res = parse_manifest_docs(DEPLOY)
+    assert [(r.kind, r.name, r.namespace) for r in res] == [
+        ("Deployment", "web", "prod"), ("Service", "web-svc", "prod")]
+    assert res[0].images == ["nginx:1.25"]
+    assert res[0].fullname == "prod/Deployment/web"
+
+
+def test_cronjob_images_include_init_containers():
+    res = parse_manifest_docs(CRONJOB)
+    assert res[0].images == ["busybox:1.36", "backup-tool:2.0"]
+
+
+def test_rbac_assessment():
+    findings = assess_rbac(parse_manifest_docs(BAD_ROLE))
+    ids = {f.id for f in findings}
+    assert "KSV046" in ids  # wildcard verb+resource
+    assert "KSV041" in ids  # secrets access
+    assert "KSV051" in ids  # cluster-admin to system:authenticated
+    assert findings[0].severity == "CRITICAL"  # sorted most-severe first
+
+
+def test_infra_assessment():
+    findings = assess_infra(parse_manifest_docs(APISERVER))
+    ids = {f.id for f in findings}
+    assert "KCV0001" in ids  # anonymous auth
+    assert "KCV0007" in ids  # AlwaysAllow
+    assert "KCV0018" in ids  # profiling
+
+
+def test_cluster_scan_manifests_dir(tmp_path):
+    (tmp_path / "deploy.yaml").write_bytes(DEPLOY)
+    (tmp_path / "rbac.yaml").write_bytes(BAD_ROLE)
+    (tmp_path / "apiserver.yaml").write_bytes(APISERVER)
+    report = ClusterScanner().scan(str(tmp_path))
+    assert report.cluster_name == tmp_path.name
+    # the privileged deployment produced misconfig failures
+    web = [r for r in report.resources
+           if r.resource.fullname == "prod/Deployment/web"]
+    assert web and any(m.id == "KSV017" for m in web[0].misconfigurations)
+    assert any(f.id == "KSV046" for f in report.rbac)
+    assert any(f.id == "KCV0001" for f in report.infra)
+
+
+def test_report_renders(tmp_path):
+    (tmp_path / "deploy.yaml").write_bytes(DEPLOY)
+    (tmp_path / "rbac.yaml").write_bytes(BAD_ROLE)
+    report = ClusterScanner().scan(str(tmp_path))
+    text = render_summary(report)
+    assert "Workload Assessment" in text
+    assert "prod" in text and "Deployment" in text
+    doc = to_dict(report)
+    json.dumps(doc)  # serializable
+    assert doc["RBACAssessment"]
+
+
+def test_k8s_cli(tmp_path, capsys):
+    from trivy_tpu.cli.main import main
+
+    (tmp_path / "deploy.yaml").write_bytes(DEPLOY)
+    rc = main(["kubernetes", str(tmp_path), "--format", "json", "--quiet"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    kinds = {r["Kind"] for r in doc["Resources"]}
+    assert "Deployment" in kinds
+
+
+def test_k8s_image_tar_scan(tmp_path):
+    """Workload image resolved from a local tar dir gets a vuln scan."""
+    from tests.test_fanal import APK_INSTALLED, OS_RELEASE, _fixture_db
+    from tests.test_fanal import _mk_image_tar, _mk_layer
+    from trivy_tpu.detector.engine import MatchEngine
+
+    layer = _mk_layer({
+        "etc/os-release": OS_RELEASE.encode(),
+        "lib/apk/db/installed": APK_INSTALLED.encode(),
+    })
+    tars = tmp_path / "tars"
+    tars.mkdir()
+    _mk_image_tar(str(tars / "demo_1.0.tar"), [layer],
+                  repo_tag="demo:1.0")
+    manifests = tmp_path / "manifests"
+    manifests.mkdir()
+    (manifests / "pod.yaml").write_bytes(
+        b"apiVersion: v1\nkind: Pod\nmetadata:\n  name: p\nspec:\n"
+        b"  containers:\n    - name: c\n      image: registry/demo:1.0\n")
+    engine = MatchEngine(_fixture_db(), use_device=False)
+    scanner = ClusterScanner(scanners={"vuln", "misconfig"},
+                             image_tar_dir=str(tars), engine=engine)
+    report = scanner.scan(str(manifests))
+    pod = [r for r in report.resources
+           if r.resource.kind == "Pod"][0]
+    assert pod.image_reports, "image tar was not scanned"
+    img, rep = pod.image_reports[0]
+    assert img == "registry/demo:1.0"
+    vulns = {v.vulnerability_id for res in rep.results
+             for v in res.vulnerabilities}
+    assert "CVE-2025-1000" in vulns

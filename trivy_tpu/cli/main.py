@@ -97,6 +97,30 @@ def build_parser() -> argparse.ArgumentParser:
         else:
             p.add_argument("target")
 
+    p = sub.add_parser("kubernetes", help="scan a kubernetes cluster or "
+                       "manifests directory", allow_abbrev=False,
+                       aliases=["k8s"])
+    _add_global_flags(p)
+    p.add_argument("--report", default="summary",
+                   choices=["summary", "all"],
+                   help="report detail level")
+    p.add_argument("--format", "-f", default="table",
+                   choices=["table", "json"],
+                   help="output format")
+    p.add_argument("--output", "-o", default=None)
+    p.add_argument("--scanners", default="misconfig,rbac,infra",
+                   help="comma-separated (vuln,misconfig,rbac,infra)")
+    p.add_argument("--context", default="", help="kubeconfig context")
+    p.add_argument("--namespace", "-n", default="",
+                   help="restrict to one namespace")
+    p.add_argument("--image-tar-dir", default=None,
+                   help="directory of image tars for offline vuln scans")
+    p.add_argument("--db-path", default=None)
+    p.add_argument("--no-tpu", action="store_true")
+    p.add_argument("--parallel", type=int, default=5)
+    p.add_argument("target", nargs="?", default="cluster",
+                   help="'cluster' (live) or a manifests dir/file")
+
     p = sub.add_parser("convert", help="convert a saved JSON report", allow_abbrev=False)
     _add_global_flags(p)
     p.add_argument("--format", "-f", default="table")
@@ -174,6 +198,8 @@ def main(argv: list[str] | None = None) -> int:
         if args.command in ("image", "filesystem", "fs", "rootfs",
                             "repository", "repo", "sbom", "vm", "config"):
             return run.run_scan(args)
+        if args.command in ("kubernetes", "k8s"):
+            return run.run_k8s(args)
         if args.command == "convert":
             return run.run_convert(args)
         if args.command == "server":

@@ -185,6 +185,30 @@ def _select_scanner(args, cache):
     raise FatalError(f"unsupported scan command {cmd!r}")
 
 
+def run_k8s(args) -> int:
+    """`kubernetes` subcommand (reference pkg/k8s/commands/run.go:26)."""
+    from trivy_tpu.k8s.report import write_cluster_report
+    from trivy_tpu.k8s.scanner import ClusterScanner
+
+    scanners = {s for s in (args.scanners or "").split(",") if s}
+    engine = None
+    if "vuln" in scanners:
+        engine = build_engine(args)
+    scanner = ClusterScanner(
+        scanners=scanners, workers=args.parallel,
+        image_tar_dir=getattr(args, "image_tar_dir", None), engine=engine,
+    )
+    try:
+        report = scanner.scan(args.target, context=args.context,
+                              namespace=args.namespace)
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    fmt = "json" if args.format == "json" else args.report
+    write_cluster_report(report, fmt=fmt, output=args.output)
+    return 0
+
+
 def run_convert(args) -> int:
     import json
 

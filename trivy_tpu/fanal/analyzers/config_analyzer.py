@@ -68,6 +68,8 @@ class ConfigAnalyzer(PostAnalyzer):
                 if misconf is not None and (misconf.failures
                                             or misconf.successes):
                     misconf.file_type = detection.HELM
+                    for d in misconf.failures + misconf.successes:
+                        d.type = detection.HELM
                     res.misconfigurations.append(misconf)
         for path, inp in sorted(files.items()):
             if path in in_chart:

@@ -58,19 +58,23 @@ def all_checks() -> list[Check]:
 
 
 _loaded = False
+_load_lock = __import__("threading").Lock()
 
 
 def _load_builtins():
     global _loaded
     if _loaded:
         return
-    _loaded = True
-    from trivy_tpu.iac.checks import (  # noqa: F401
-        azure,
-        cloud,
-        docker,
-        kubernetes,
-    )
+    with _load_lock:  # parallel scan workers race the first load
+        if _loaded:
+            return
+        from trivy_tpu.iac.checks import (  # noqa: F401
+            azure,
+            cloud,
+            docker,
+            kubernetes,
+        )
+        _loaded = True
 
 
 def check(id: str, title: str, *, severity="MEDIUM", file_types=(),

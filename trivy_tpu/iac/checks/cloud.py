@@ -475,6 +475,7 @@ def adapt_terraform_plan(doc: dict) -> list[CloudResource]:
     planned = doc.get("planned_values") or {}
     collect_sse(planned.get("root_module") or {})
     walk_module(planned.get("root_module") or {})
+    plan_apply_public_access_blocks(doc, out)
     return out
 
 

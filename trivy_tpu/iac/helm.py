@@ -455,18 +455,15 @@ def _deep_merge(base: dict, over: dict) -> dict:
 
 
 def find_chart_roots(paths) -> list[str]:
-    """Directories containing a Chart.yaml, outermost charts only
-    (subcharts under charts/ render with their parent)."""
-    roots = sorted(
+    """Every directory containing a Chart.yaml. Each chart (including
+    charts/ subcharts and unrelated nested charts) renders independently:
+    render_chart only consumes a root's own templates/, so there is no
+    double-rendering. Independent rendering of subcharts approximates
+    helm's parent-merged values with the subchart's own values.yaml."""
+    return sorted(
         os.path.dirname(p) for p in paths
         if os.path.basename(p) == "Chart.yaml"
     )
-    out: list[str] = []
-    for r in roots:
-        if not any(r != o and r.startswith(o + "/") for o in out if o):
-            if not any(o == "" for o in out) or r == "":
-                out.append(r)
-    return out
 
 
 def render_chart(files: dict[str, bytes],

@@ -1,0 +1,3 @@
+"""Kubernetes cluster scanning (reference pkg/k8s atop trivy-kubernetes):
+resource enumeration, workload image extraction, misconfig + RBAC + infra
+assessment, summary/json reporting."""

@@ -1,0 +1,147 @@
+"""Cluster scan orchestration (reference pkg/k8s/scanner/scanner.go:
+parallel pipeline over cluster artifacts; vuln scan per workload image,
+misconfig scan per resource, RBAC + infra assessments merged into one
+cluster report)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import yaml
+
+from trivy_tpu.k8s.artifacts import (
+    RBAC_KINDS,
+    WORKLOAD_KINDS,
+    KubeResource,
+    load_cluster,
+    load_manifests,
+)
+from trivy_tpu.k8s.infra import InfraFinding, assess_infra
+from trivy_tpu.k8s.rbac import RbacFinding, assess_rbac
+from trivy_tpu.log import logger
+from trivy_tpu.utils.pipeline import run_pipeline
+
+_log = logger("k8s")
+
+
+@dataclass
+class ResourceResult:
+    resource: KubeResource = None
+    misconfigurations: list = field(default_factory=list)  # Detected...
+    images: list[str] = field(default_factory=list)
+    # vulnerability results per image (populated when the image is
+    # resolvable locally, e.g. an image-tar directory is given)
+    image_reports: list = field(default_factory=list)
+
+
+@dataclass
+class ClusterReport:
+    cluster_name: str = ""
+    resources: list[ResourceResult] = field(default_factory=list)
+    rbac: list[RbacFinding] = field(default_factory=list)
+    infra: list[InfraFinding] = field(default_factory=list)
+
+
+class ClusterScanner:
+    """scan(target): target is a manifests dir/file or 'cluster' for a
+    live kubeconfig-backed cluster."""
+
+    def __init__(self, scanners: set[str] | None = None, workers: int = 5,
+                 image_tar_dir: str | None = None, engine=None):
+        self.scanners = scanners or {"misconfig", "rbac", "infra"}
+        self.workers = workers
+        self.image_tar_dir = image_tar_dir
+        self.engine = engine  # MatchEngine for image vuln scans
+
+    def scan(self, target: str, context: str = "",
+             namespace: str = "") -> ClusterReport:
+        if target == "cluster":
+            resources = load_cluster(context=context, namespace=namespace)
+            name = context or "cluster"
+        else:
+            resources = load_manifests(target)
+            name = os.path.basename(os.path.abspath(target))
+        report = ClusterReport(cluster_name=name)
+        workloads = [r for r in resources if r.kind in WORKLOAD_KINDS]
+        others = [r for r in resources if r.kind not in WORKLOAD_KINDS]
+
+        if "misconfig" in self.scanners:
+            scannable = workloads + [
+                r for r in others if r.kind not in RBAC_KINDS]
+            report.resources = run_pipeline(
+                scannable, self._scan_resource, workers=self.workers)
+            report.resources = [r for r in report.resources
+                                if r is not None]
+        if "rbac" in self.scanners:
+            report.rbac = assess_rbac(resources)
+        if "infra" in self.scanners:
+            report.infra = assess_infra(resources)
+        if "vuln" in self.scanners and self.image_tar_dir:
+            self._scan_images(report)
+        return report
+
+    # ------------------------------------------------------------ steps
+
+    def _scan_resource(self, res: KubeResource) -> ResourceResult | None:
+        from trivy_tpu.misconf.scanner import scan_config
+
+        content = yaml.safe_dump(res.raw, sort_keys=False).encode()
+        misconf = scan_config(res.fullname + ".yaml", content,
+                              file_type="kubernetes")
+        rr = ResourceResult(resource=res, images=res.images)
+        if misconf is not None:
+            rr.misconfigurations = misconf.failures
+        if not rr.misconfigurations and not rr.images and \
+                res.kind not in WORKLOAD_KINDS:
+            return None if misconf is None else rr
+        return rr
+
+    def _scan_images(self, report: ClusterReport) -> None:
+        """Scan workload images resolvable as local tars: an image
+        `repo/name:tag` matches <image_tar_dir>/<name>_<tag>.tar or
+        <name>.tar (registry pulls are the online path)."""
+        seen: dict[str, object] = {}
+        for rr in report.resources:
+            for img in rr.images:
+                if img in seen:
+                    rep = seen[img]
+                else:
+                    tar = self._find_tar(img)
+                    rep = None
+                    if tar is not None:
+                        try:
+                            rep = self._scan_image_tar(tar)
+                        except Exception as e:
+                            _log.warn("image scan failed", image=img,
+                                      err=str(e))
+                    seen[img] = rep
+                if rep is not None:
+                    rr.image_reports.append((img, rep))
+
+    def _scan_image_tar(self, tar_path: str):
+        from trivy_tpu.artifact.image import ImageArtifact
+        from trivy_tpu.cache.cache import MemoryCache
+        from trivy_tpu.scanner.local import LocalDriver
+        from trivy_tpu.scanner.scan import Scanner
+        from trivy_tpu.types.scan import ScanOptions
+
+        cache = MemoryCache()
+        artifact = ImageArtifact(tar_path, cache, from_tar=True,
+                                 parallel=self.workers)
+        driver = LocalDriver(self.engine, cache)
+        return Scanner(driver, artifact).scan_artifact(ScanOptions())
+
+    def _find_tar(self, image: str) -> str | None:
+        if not self.image_tar_dir:
+            return None
+        name = image.rsplit("/", 1)[-1]
+        candidates = [
+            name.replace(":", "_") + ".tar",
+            name.split(":")[0] + ".tar",
+        ]
+        for c in candidates:
+            p = os.path.join(self.image_tar_dir, c)
+            if os.path.exists(p):
+                return p
+        return None

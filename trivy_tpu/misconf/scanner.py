@@ -87,18 +87,14 @@ def _contexts(file_type: str, path: str, content: bytes) -> list:
     if file_type == detection.TERRAFORM_PLAN:
         import json as _json
 
-        from trivy_tpu.iac.checks.cloud import (
-            adapt_terraform_plan,
-            plan_apply_public_access_blocks,
-        )
+        from trivy_tpu.iac.checks.cloud import adapt_terraform_plan
 
         try:
             doc = _json.loads(content)
         except ValueError:
             return []
-        resources = adapt_terraform_plan(doc)
-        plan_apply_public_access_blocks(doc, resources)
-        return [CloudCtx(path=path, cloud_resources=resources)]
+        return [CloudCtx(path=path,
+                         cloud_resources=adapt_terraform_plan(doc))]
     if file_type == detection.AZURE_ARM:
         import json as _json
 

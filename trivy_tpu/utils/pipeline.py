@@ -1,0 +1,62 @@
+"""Generic bounded worker pipeline (reference pkg/parallel/pipeline.go:28
+NewPipeline/Do: N workers over an item channel with a result callback).
+Threads, not asyncio: the work units (file IO, YAML parse, regex) release
+the GIL often enough, and the device batch calls serialize anyway."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+DEFAULT_WORKERS = 5  # reference pkg/parallel/pipeline.go:10
+
+
+def run_pipeline(items: Iterable[T], fn: Callable[[T], R],
+                 on_result: Callable[[R], None] | None = None,
+                 workers: int = DEFAULT_WORKERS) -> list[R]:
+    """Run fn over items with a bounded worker pool; results are returned
+    in input order. on_result (if given) is called serially, in order —
+    the reference's onItem callback contract."""
+    items = list(items)
+    if workers <= 1 or len(items) <= 1:
+        out = [fn(it) for it in items]
+        if on_result:
+            for r in out:
+                on_result(r)
+        return out
+
+    results: list = [None] * len(items)
+    errors: list = [None] * len(items)
+    q: queue.Queue = queue.Queue()
+    for i, it in enumerate(items):
+        q.put((i, it))
+
+    def worker():
+        while True:
+            try:
+                i, it = q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                results[i] = fn(it)
+            except Exception as e:  # surfaced after join, index-matched
+                errors[i] = e
+            finally:
+                q.task_done()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(min(workers, len(items)))]
+    for t in threads:
+        t.start()
+    q.join()
+    for e in errors:
+        if e is not None:
+            raise e
+    if on_result:
+        for r in results:
+            on_result(r)
+    return results
