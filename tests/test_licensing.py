@@ -1,0 +1,231 @@
+"""Licensing engine tests: SPDX expression parsing, name normalization,
+split helpers, full-text classification, and the poetry/pyproject
+analyzer (reference pkg/licensing/*_test.go shapes)."""
+
+from trivy_tpu.licensing.classifier import classify
+from trivy_tpu.licensing.expression import (
+    CompoundExpr,
+    LicenseParseError,
+    SimpleExpr,
+    parse,
+)
+from trivy_tpu.licensing.normalize import (
+    lax_split_licenses,
+    normalize,
+    normalize_spdx_expression,
+    split_licenses,
+)
+from trivy_tpu.licensing.scanner import categorize
+
+import pytest
+
+
+class TestExpression:
+    def test_simple(self):
+        assert parse("MIT") == SimpleExpr("MIT")
+
+    def test_plus(self):
+        assert parse("Apache-2.0+") == SimpleExpr("Apache-2.0", True)
+
+    def test_gnu_rendering(self):
+        assert str(SimpleExpr("GPL-2.0", False)) == "GPL-2.0-only"
+        assert str(SimpleExpr("GPL-2.0", True)) == "GPL-2.0-or-later"
+        assert str(SimpleExpr("MIT", True)) == "MIT+"
+
+    def test_precedence_stringify(self):
+        # OR binds looser than AND: parens needed around OR child of AND
+        e = parse("(MIT OR ISC) AND Apache-2.0")
+        assert isinstance(e, CompoundExpr)
+        assert str(e) == "(MIT OR ISC) AND Apache-2.0"
+        assert str(parse("MIT OR ISC AND Apache-2.0")) == \
+            "MIT OR ISC AND Apache-2.0"
+
+    def test_with(self):
+        e = parse("GPL-2.0 WITH Classpath-exception-2.0")
+        assert isinstance(e, CompoundExpr) and e.op == "WITH"
+
+    def test_lowercase_ops(self):
+        assert str(parse("MIT or ISC")) == "MIT OR ISC"
+
+    def test_invalid(self):
+        with pytest.raises(LicenseParseError):
+            parse("MIT Apache-2.0")
+        with pytest.raises(LicenseParseError):
+            parse("(MIT")
+        with pytest.raises(LicenseParseError):
+            parse("")
+
+
+class TestNormalize:
+    # the table rows mirror reference normalize_test.go cases
+    @pytest.mark.parametrize("raw,want", [
+        ("apache 2", "Apache-2.0"),
+        ("Apache License, Version 2.0", "Apache-2.0"),
+        ("The Apache Software License, Version 2.0", "Apache-2.0"),
+        ("APACHE-2.0", "Apache-2.0"),
+        ("MIT License", "MIT"),
+        ("Expat", "MIT"),
+        ("BSD", "BSD-3-Clause"),
+        ("New BSD License", "BSD-3-Clause"),
+        ("GPLv2+", "GPL-2.0-or-later"),
+        ("GPL-2.0-only", "GPL-2.0-only"),
+        ("GPL2", "GPL-2.0-only"),
+        ("GPL", "GPL-2.0-or-later"),
+        ("LGPL v3", "LGPL-3.0-only"),
+        ("ISC License", "ISC"),
+        ("Public Domain", "Unlicense"),
+        ("Zlib/libpng", "zlib-acknowledgement"),
+        ("Totally Unknown License", "Totally Unknown License"),
+    ])
+    def test_normalize(self, raw, want):
+        assert normalize(raw) == want
+
+    def test_normalize_expression(self):
+        assert normalize_spdx_expression("MIT OR Apache-2.0") == \
+            "MIT OR Apache-2.0"
+        assert normalize_spdx_expression("Expat OR ASL-2.0") == \
+            "MIT OR Apache-2.0"
+
+    def test_split_licenses(self):
+        assert split_licenses("GPL-1+,GPL-2") == ["GPL-1+", "GPL-2"]
+        assert split_licenses("GPL-1+ or Artistic or Artistic-dist") == \
+            ["GPL-1+", "Artistic", "Artistic-dist"]
+        assert split_licenses(
+            "BSD 3-Clause License or Apache License, Version 2.0") == \
+            ["BSD 3-Clause License", "Apache License, Version 2.0"]
+        assert split_licenses(
+            "GNU Lesser General Public License v2 or later (LGPLv2+)") == \
+            ["GNU Lesser General Public License v2 or later (LGPLv2+)"]
+
+    def test_split_license_text_passthrough(self):
+        got = split_licenses("Permission is hereby granted; see https://x")
+        assert len(got) == 1 and got[0].startswith("text://")
+
+    def test_lax_split(self):
+        assert lax_split_licenses("MPL 2.0 GPL2+") == \
+            ["MPL-2.0", "GPL-2.0-or-later"]
+
+
+class TestCategorize:
+    def test_known(self):
+        assert categorize("MIT") == ("notice", "LOW")
+        assert categorize("GPL-3.0-only") == ("restricted", "HIGH")
+        assert categorize("AGPL-3.0") == ("forbidden", "CRITICAL")
+
+    def test_normalized_alias(self):
+        # free-form name normalizes to its SPDX id before category lookup
+        assert categorize("Apache License, Version 2.0") == ("notice", "LOW")
+        assert categorize("GPLv3+") == ("restricted", "HIGH")
+
+    def test_custom_categories(self):
+        cat, sev = categorize("MIT", {"forbidden": ["MIT"]})
+        assert (cat, sev) == ("forbidden", "CRITICAL")
+
+
+MIT_TEXT = """\
+MIT License
+
+Copyright (c) 2024 Example
+
+Permission is hereby granted, free of charge, to any person obtaining a
+copy of this software and associated documentation files (the "Software"),
+to deal in the Software without restriction, subject to the following
+conditions:
+
+THE SOFTWARE IS PROVIDED "AS IS", WITHOUT WARRANTY OF ANY KIND.
+"""
+
+
+class TestClassifier:
+    def test_mit_text(self):
+        lf = classify("LICENSE", MIT_TEXT.encode())
+        assert lf is not None
+        assert lf.findings[0].name == "MIT"
+        assert lf.findings[0].confidence >= 0.9
+
+    def test_spdx_tag(self):
+        lf = classify("main.go", b"// SPDX-License-Identifier: BSD-3-Clause\n")
+        assert lf is not None and lf.type == "header"
+        assert [f.name for f in lf.findings] == ["BSD-3-Clause"]
+
+    def test_apache_reference_text(self):
+        text = (b"Apache License\nVersion 2.0, January 2004\n"
+                b"http://www.apache.org/licenses/\n"
+                b"Unless required by applicable law or agreed to in writing, "
+                b"software distributed under the License is distributed on an "
+                b'"AS IS" BASIS')
+        lf = classify("LICENSE.txt", text, confidence_level=0.4)
+        assert lf is not None
+        assert any(f.name == "Apache-2.0" for f in lf.findings)
+
+    def test_no_match(self):
+        assert classify("README.md", b"hello world") is None
+
+
+class TestPoetryAnalyzer:
+    def test_pyproject_marks_relationships(self):
+        from trivy_tpu.fanal.analyzer import AnalysisInput
+        from trivy_tpu.fanal.analyzers.lang import PoetryAnalyzer
+
+        lock = b"""
+[[package]]
+name = "requests"
+version = "2.31.0"
+
+[package.dependencies]
+urllib3 = ">=1.21"
+
+[[package]]
+name = "urllib3"
+version = "2.0.0"
+
+[[package]]
+name = "pytest"
+version = "8.0.0"
+"""
+        pyproject = b"""
+[tool.poetry.dependencies]
+python = "^3.11"
+requests = "^2.31"
+
+[tool.poetry.group.dev.dependencies]
+pytest = "^8.0"
+"""
+        files = {
+            "app/poetry.lock": AnalysisInput("app/poetry.lock", lock),
+            "app/pyproject.toml": AnalysisInput("app/pyproject.toml", pyproject),
+        }
+        res = PoetryAnalyzer().post_analyze(files)
+        pkgs = {p.name: p for p in res.applications[0].packages}
+        assert pkgs["requests"].relationship == "direct"
+        assert not pkgs["requests"].dev
+        assert pkgs["pytest"].dev and pkgs["pytest"].relationship == "direct"
+        assert pkgs["urllib3"].relationship == "indirect"
+
+    def test_lock_without_pyproject(self):
+        from trivy_tpu.fanal.analyzer import AnalysisInput
+        from trivy_tpu.fanal.analyzers.lang import PoetryAnalyzer
+
+        lock = b"""
+[[package]]
+name = "requests"
+version = "2.31.0"
+"""
+        files = {"poetry.lock": AnalysisInput("poetry.lock", lock)}
+        res = PoetryAnalyzer().post_analyze(files)
+        assert res.applications[0].packages[0].name == "requests"
+
+
+class TestLicenseFileAnalyzer:
+    def test_required_and_analyze(self):
+        from trivy_tpu.fanal.analyzer import AnalysisInput
+        from trivy_tpu.fanal.analyzers.license_file import LicenseFileAnalyzer
+
+        a = LicenseFileAnalyzer()
+        assert a.required("LICENSE")
+        assert a.required("pkg/COPYING.txt")
+        assert a.required("LICENSE-MIT.txt")
+        assert not a.required("main.py")
+        res = a.analyze(AnalysisInput("LICENSE", MIT_TEXT.encode()))
+        assert res is not None
+        assert res.licenses[0].findings[0].name == "MIT"

@@ -190,7 +190,13 @@ def run_k8s(args) -> int:
     from trivy_tpu.k8s.report import write_cluster_report
     from trivy_tpu.k8s.scanner import ClusterScanner
 
-    scanners = {s for s in (args.scanners or "").split(",") if s}
+    scanners = {s.strip() for s in (args.scanners or "").split(",")
+                if s.strip()}
+    valid = {"vuln", "misconfig", "rbac", "infra", "secret"}
+    if unknown := scanners - valid:
+        raise FatalError(
+            f"unknown k8s scanners: {', '.join(sorted(unknown))} "
+            f"(valid: {', '.join(sorted(valid))})")
     engine = None
     if "vuln" in scanners:
         engine = build_engine(args)

@@ -69,7 +69,7 @@ _lockfile("yarn", ("yarn.lock",), nodejs.parse_yarn_lock)
 _lockfile("pnpm", ("pnpm-lock.yaml",), nodejs.parse_pnpm_lock)
 _lockfile("pip", ("requirements.txt",), pyparse.parse_requirements)
 _lockfile("pipenv", ("Pipfile.lock",), pyparse.parse_pipfile_lock)
-_lockfile("poetry", ("poetry.lock",), pyparse.parse_poetry_lock)
+# poetry gets its own analyzer below (pyproject.toml supplements the lock)
 _lockfile("uv", ("uv.lock",), pyparse.parse_uv_lock)
 _lockfile("julia", ("Manifest.toml",), misc_lang.parse_julia_manifest)
 _lockfile("nuget", ("packages.config",),
@@ -91,6 +91,52 @@ _lockfile("swift", ("Package.resolved",), misc_lang.parse_swift_resolved)
 _lockfile("conan", ("conan.lock",), misc_lang.parse_conan_lock)
 _lockfile("conda-environment", ("environment.yml", "environment.yaml"),
           misc_lang.parse_conda_environment)
+
+
+@register_post
+class PoetryAnalyzer(PostAnalyzer):
+    """poetry.lock + sibling pyproject.toml: the lockfile lists every
+    package; pyproject marks which are direct deps and which belong to
+    dev groups (reference pkg/fanal/analyzer/language/python/poetry)."""
+
+    type = "poetry"
+    version = 2
+    app_type = "poetry"
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        return os.path.basename(path) in ("poetry.lock", "pyproject.toml")
+
+    def post_analyze(self, files):
+        res = AnalysisResult()
+        by_dir: dict[str, dict[str, AnalysisInput]] = {}
+        for path, inp in files.items():
+            by_dir.setdefault(os.path.dirname(path), {})[
+                os.path.basename(path)] = inp
+        for d, group in sorted(by_dir.items()):
+            if "poetry.lock" not in group:
+                continue
+            pkgs = pyparse.parse_poetry_lock(group["poetry.lock"].read())
+            if "pyproject.toml" in group:
+                try:
+                    proj = pyparse.parse_pyproject(group["pyproject.toml"].read())
+                except Exception:
+                    proj = None
+                if proj:
+                    direct = proj["dependencies"]
+                    dev = set().union(*proj["groups"].values()) \
+                        if proj["groups"] else set()
+                    for p in pkgs:
+                        norm = pyparse._norm_name(p.name)
+                        if norm in direct:
+                            p.relationship = "direct"
+                        elif norm in dev:
+                            p.relationship = "direct"
+                            p.dev = True
+                        else:
+                            p.relationship = "indirect"
+                            p.indirect = True
+            res.merge(_app("poetry", group["poetry.lock"].path, pkgs))
+        return res
 
 
 @register_post

@@ -77,8 +77,10 @@ def load_manifests(target: str) -> list[KubeResource]:
             for n in sorted(names):
                 if n.endswith((".yaml", ".yml", ".json")):
                     paths.append(os.path.join(root, n))
-    else:
+    elif os.path.exists(target):
         paths = [target]
+    else:
+        raise RuntimeError(f"no such manifest file or directory: {target}")
     out: list[KubeResource] = []
     for p in paths:
         try:

@@ -20,15 +20,32 @@ class InfraFinding:
     resource: str
 
 
+def _is_control_plane(res: KubeResource) -> bool:
+    """Only pods that actually belong to the control plane are assessed —
+    an application container that merely mentions "etcd" in its image
+    must not trigger KCV checks.  Control-plane static pods live in
+    kube-system and carry the kubeadm `component`/`tier` labels."""
+    meta = res.raw.get("metadata") or {}
+    if (meta.get("namespace") or res.namespace) == "kube-system":
+        return True
+    labels = meta.get("labels") or {}
+    return labels.get("tier") == "control-plane" or \
+        labels.get("component") in INFRA_NAMES
+
+
 def _component_commands(res: KubeResource) -> list[tuple[str, list[str]]]:
     """-> [(component_name, full command argv)] for control-plane pods."""
+    if not _is_control_plane(res):
+        return []
     out = []
     spec = _pod_spec(res.raw)
     for c in spec.get("containers") or []:
         image = str((c or {}).get("image", ""))
+        # component id = image basename sans tag, or exact container name
+        image_base = image.rsplit("/", 1)[-1].split(":")[0].split("@")[0]
         name = str((c or {}).get("name", ""))
         for comp in INFRA_NAMES:
-            if comp in image or comp in name:
+            if comp in (image_base, name):
                 argv = [str(x) for x in (c.get("command") or [])]
                 argv += [str(x) for x in (c.get("args") or [])]
                 out.append((comp, argv))

@@ -66,13 +66,18 @@ class ClusterScanner:
         workloads = [r for r in resources if r.kind in WORKLOAD_KINDS]
         others = [r for r in resources if r.kind not in WORKLOAD_KINDS]
 
+        scannable = workloads + [
+            r for r in others if r.kind not in RBAC_KINDS]
         if "misconfig" in self.scanners:
-            scannable = workloads + [
-                r for r in others if r.kind not in RBAC_KINDS]
             report.resources = run_pipeline(
                 scannable, self._scan_resource, workers=self.workers)
             report.resources = [r for r in report.resources
                                 if r is not None]
+        elif "vuln" in self.scanners:
+            # vuln-only scans still need the workload rows to find images
+            report.resources = [
+                ResourceResult(resource=r, images=r.images)
+                for r in scannable if r.images]
         if "rbac" in self.scanners:
             report.rbac = assess_rbac(resources)
         if "infra" in self.scanners:
@@ -92,30 +97,31 @@ class ClusterScanner:
         rr = ResourceResult(resource=res, images=res.images)
         if misconf is not None:
             rr.misconfigurations = misconf.failures
-        if not rr.misconfigurations and not rr.images and \
-                res.kind not in WORKLOAD_KINDS:
-            return None if misconf is None else rr
+        elif not rr.images and res.kind not in WORKLOAD_KINDS:
+            return None  # nothing checkable and nothing to report
         return rr
 
     def _scan_images(self, report: ClusterReport) -> None:
         """Scan workload images resolvable as local tars: an image
         `repo/name:tag` matches <image_tar_dir>/<name>_<tag>.tar or
         <name>.tar (registry pulls are the online path)."""
-        seen: dict[str, object] = {}
+        distinct = sorted({img for rr in report.resources
+                           for img in rr.images})
+
+        def scan_one(img: str):
+            tar = self._find_tar(img)
+            if tar is None:
+                return img, None
+            try:
+                return img, self._scan_image_tar(tar)
+            except Exception as e:
+                _log.warn("image scan failed", image=img, err=str(e))
+                return img, None
+
+        seen = dict(run_pipeline(distinct, scan_one, workers=self.workers))
         for rr in report.resources:
             for img in rr.images:
-                if img in seen:
-                    rep = seen[img]
-                else:
-                    tar = self._find_tar(img)
-                    rep = None
-                    if tar is not None:
-                        try:
-                            rep = self._scan_image_tar(tar)
-                        except Exception as e:
-                            _log.warn("image scan failed", image=img,
-                                      err=str(e))
-                    seen[img] = rep
+                rep = seen.get(img)
                 if rep is not None:
                     rr.image_reports.append((img, rep))
 
@@ -136,12 +142,17 @@ class ClusterScanner:
         if not self.image_tar_dir:
             return None
         name = image.rsplit("/", 1)[-1]
-        candidates = [
-            name.replace(":", "_") + ".tar",
-            name.split(":")[0] + ".tar",
-        ]
-        for c in candidates:
-            p = os.path.join(self.image_tar_dir, c)
+        exact = os.path.join(self.image_tar_dir,
+                             name.replace(":", "_") + ".tar")
+        if os.path.exists(exact):
+            return exact
+        # tag-less fallback only when the workload itself pins no tag
+        # (or the default "latest") — a versioned ref must match exactly,
+        # otherwise we would attribute the wrong image's findings to it
+        tag = name.split(":", 1)[1] if ":" in name else ""
+        if tag in ("", "latest"):
+            p = os.path.join(self.image_tar_dir,
+                             name.split(":")[0] + ".tar")
             if os.path.exists(p):
                 return p
         return None

@@ -1,0 +1,205 @@
+"""License full-text classification for `--license-full` scans.
+
+The reference delegates to google/licenseclassifier v2
+(pkg/licensing/classifier.go:36-87), a token-ngram matcher over the
+SPDX corpus.  Shipping that corpus is out of scope here; instead this
+module classifies by (a) explicit `SPDX-License-Identifier:` tags and
+(b) distinctive-phrase fingerprints for the licenses that dominate real
+artifacts.  Confidence = fraction of a license's fingerprint phrases
+found in the normalized text; findings below the confidence level are
+dropped, mirroring classifier.go:57-60.
+"""
+
+from __future__ import annotations
+
+import re
+
+from trivy_tpu.types.artifact import LicenseFile, LicenseFinding
+
+# File type markers (reference fanal/types: LicenseTypeHeader / File)
+TYPE_HEADER = "header"
+TYPE_FILE = "license-file"
+
+_SPDX_TAG_RE = re.compile(
+    r"SPDX-License-Identifier:\s*([A-Za-z0-9+.\-() ]+?)\s*(?:\*/|-->|$)",
+    re.MULTILINE,
+)
+
+# Phrases are matched against lowercased text with collapsed whitespace
+# and stripped punctuation.  Every phrase list starts with the most
+# distinctive sentence of the license body.
+_FINGERPRINTS: dict[str, list[str]] = {
+    "MIT": [
+        "permission is hereby granted free of charge to any person "
+        "obtaining a copy of this software",
+        "the software is provided as is without warranty of any kind",
+        "subject to the following conditions",
+    ],
+    "Apache-2.0": [
+        "apache license version 2 0",
+        "licensed under the apache license version 2 0",
+        "unless required by applicable law or agreed to in writing",
+        "www apache org licenses license 2 0",
+    ],
+    "BSD-3-Clause": [
+        "redistribution and use in source and binary forms",
+        "neither the name of",
+        "this software is provided by the copyright holders and "
+        "contributors as is",
+    ],
+    "BSD-2-Clause": [
+        "redistribution and use in source and binary forms",
+        "this software is provided by the copyright holders and "
+        "contributors as is",
+    ],
+    "GPL-2.0": [
+        "gnu general public license version 2",
+        "free software foundation either version 2 of the license",
+        "this program is distributed in the hope that it will be useful",
+    ],
+    "GPL-3.0": [
+        "gnu general public license version 3",
+        "free software foundation either version 3 of the license",
+        "this program is distributed in the hope that it will be useful",
+    ],
+    "LGPL-2.1": [
+        "gnu lesser general public license version 2 1",
+        "free software foundation either version 2 1 of the license",
+    ],
+    "LGPL-3.0": [
+        "gnu lesser general public license version 3",
+        "free software foundation either version 3 of the license",
+    ],
+    "AGPL-3.0": [
+        "gnu affero general public license",
+        "free software foundation either version 3 of the license",
+    ],
+    "MPL-2.0": [
+        "mozilla public license version 2 0",
+        "this source code form is subject to the terms of the mozilla "
+        "public license v 2 0",
+    ],
+    "ISC": [
+        "permission to use copy modify and or distribute this software "
+        "for any purpose with or without fee is hereby granted",
+        "the software is provided as is and the author disclaims all "
+        "warranties",
+    ],
+    "Unlicense": [
+        "this is free and unencumbered software released into the "
+        "public domain",
+        "in jurisdictions that recognize copyright laws",
+    ],
+    "CC0-1.0": [
+        "cc0 1 0 universal",
+        "the person who associated a work with this deed has dedicated "
+        "the work to the public domain",
+    ],
+    "EPL-2.0": [
+        "eclipse public license v 2 0",
+        "this program and the accompanying materials are made available "
+        "under the terms of the eclipse public license 2 0",
+    ],
+    "EPL-1.0": [
+        "eclipse public license v 1 0",
+    ],
+    "Zlib": [
+        "this software is provided as is without any express or implied "
+        "warranty",
+        "altered source versions must be plainly marked as such",
+        "the origin of this software must not be misrepresented",
+    ],
+    "BSL-1.0": [
+        "boost software license version 1 0",
+        "permission is hereby granted free of charge to any person or "
+        "organization obtaining a copy of the software",
+    ],
+    "WTFPL": [
+        "do what the fuck you want to public license",
+    ],
+    "PostgreSQL": [
+        "permission to use copy modify and distribute this software and "
+        "its documentation for any purpose without fee",
+        "in no event shall the university of california be liable",
+    ],
+    "OpenSSL": [
+        "this product includes software developed by the openssl project",
+    ],
+    "Artistic-2.0": [
+        "the artistic license 2 0",
+        "everyone is permitted to copy and distribute verbatim copies of "
+        "this license document but changing it is not allowed",
+    ],
+    "OFL-1.1": [
+        "sil open font license version 1 1",
+    ],
+    "CDDL-1.0": [
+        "common development and distribution license cddl version 1 0",
+    ],
+    "EUPL-1.2": [
+        "european union public licence v 1 2",
+    ],
+    "MS-PL": [
+        "microsoft public license ms pl",
+    ],
+}
+
+_NORM_RE = re.compile(r"[^a-z0-9]+")
+
+
+def _finding(name: str, confidence: float) -> LicenseFinding:
+    return LicenseFinding(
+        name=name, confidence=confidence,
+        link=f"https://spdx.org/licenses/{name}.html",
+    )
+
+
+def _normalize_text(data: bytes | str) -> str:
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", errors="replace")
+    return _NORM_RE.sub(" ", data.lower()).strip()
+
+
+def classify(file_path: str, content: bytes | str,
+             confidence_level: float = 0.75) -> LicenseFile | None:
+    """Classify license text in a file; None when nothing matches."""
+    raw = content.decode("utf-8", errors="replace") \
+        if isinstance(content, bytes) else content
+
+    findings: list[LicenseFinding] = []
+    seen: set[str] = set()
+    match_type = TYPE_FILE
+
+    for m in _SPDX_TAG_RE.finditer(raw):
+        expr = m.group(1).strip()
+        for name in re.split(r"\s+(?:AND|OR|WITH)\s+|[()]", expr):
+            name = name.strip()
+            if name and name not in seen:
+                seen.add(name)
+                findings.append(_finding(name, 1.0))
+        match_type = TYPE_HEADER
+
+    norm = _normalize_text(raw)
+    if norm:
+        for name, phrases in _FINGERPRINTS.items():
+            if name in seen:
+                continue
+            hits = sum(1 for p in phrases if p in norm)
+            conf = hits / len(phrases)
+            if conf >= confidence_level:
+                seen.add(name)
+                findings.append(_finding(name, round(conf, 2)))
+                match_type = TYPE_FILE
+
+    # BSD-2 fingerprint is a subset of BSD-3; prefer the more specific hit
+    names = {f.name for f in findings}
+    if "BSD-3-Clause" in names and "BSD-2-Clause" in names:
+        bsd3 = next(f for f in findings if f.name == "BSD-3-Clause")
+        bsd2 = next(f for f in findings if f.name == "BSD-2-Clause")
+        if bsd3.confidence >= bsd2.confidence:
+            findings.remove(bsd2)
+
+    if not findings:
+        return None
+    findings.sort(key=lambda f: (-f.confidence, f.name))
+    return LicenseFile(type=match_type, file_path=file_path, findings=findings)

@@ -45,7 +45,11 @@ _CATEGORY_SEVERITY = {
 
 
 def categorize(license_name: str, custom: dict | None = None) -> tuple[str, str]:
-    """-> (category, severity)"""
+    """-> (category, severity).  The name is normalized to its SPDX id
+    first (reference pkg/licensing/scanner.go:24-40)."""
+    from trivy_tpu.licensing.normalize import normalize
+
+    license_name = normalize(license_name)
     if custom:
         for cat, names in custom.items():
             if license_name in names:

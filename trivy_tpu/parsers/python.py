@@ -102,6 +102,33 @@ def parse_uv_lock(content: bytes) -> list[Package]:
     return sorted(out, key=lambda p: p.id)
 
 
+def _norm_name(name: str) -> str:
+    """PEP 503 name normalization (reference parser/python NormalizePkgName)."""
+    return re.sub(r"[-_.]+", "-", name).lower()
+
+
+def parse_pyproject(content: bytes) -> dict:
+    """pyproject.toml (PEP 518) -> {"dependencies": set of direct poetry
+    dep names, "groups": {group: set}} (reference
+    parser/python/pyproject/pyproject.go:14-45).  Used to mark
+    direct/dev relationships on poetry.lock packages."""
+    import tomllib
+
+    doc = tomllib.loads(content.decode("utf-8", "replace"))
+    poetry = (doc.get("tool") or {}).get("poetry") or {}
+    deps = {_norm_name(n) for n in (poetry.get("dependencies") or {})}
+    groups = {
+        gname: {_norm_name(n) for n in (g.get("dependencies") or {})}
+        for gname, g in (poetry.get("group") or {}).items()
+    }
+    # PEP 621 project dependencies supplement the poetry table
+    for spec in (doc.get("project") or {}).get("dependencies") or []:
+        m = re.match(r"[A-Za-z0-9._-]+", spec)
+        if m:
+            deps.add(_norm_name(m.group(0)))
+    return {"dependencies": deps, "groups": groups}
+
+
 _META_NAME = re.compile(r"^Name: (.+)$", re.M)
 _META_VERSION = re.compile(r"^Version: (.+)$", re.M)
 _META_LICENSE = re.compile(r"^License: (.+)$", re.M)
