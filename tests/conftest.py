@@ -8,6 +8,13 @@ backend initializes (it is lazy), which import-time code here guarantees.
 
 import os
 
+# The axon sitecustomize registers the TPU-tunnel PJRT plugin whenever
+# PALLAS_AXON_POOL_IPS is set, and jax's backends() initializes every
+# registered plugin even under JAX_PLATFORMS=cpu — a wedged tunnel then
+# hangs the whole suite inside make_c_api_client.  Tests are CPU-only by
+# contract, so drop the trigger before jax initializes a backend.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -1,0 +1,177 @@
+"""Compliance subsystem tests (reference pkg/compliance/*_test.go
+shapes): spec loading, scanner derivation, check-ID mapping, report
+building, and both writers."""
+
+import io
+import json
+
+import pytest
+
+from trivy_tpu.compliance.report import (
+    build_compliance_report,
+    write_compliance_report,
+)
+from trivy_tpu.compliance.spec import (
+    SpecError,
+    get_compliance_spec,
+    scanner_by_check_id,
+)
+from trivy_tpu.types.report import (
+    DetectedMisconfiguration,
+    DetectedSecret,
+    DetectedVulnerability,
+    Result,
+    VulnerabilityInfo,
+)
+
+
+class TestSpec:
+    def test_builtin_names(self):
+        for name in ("docker-cis-1.6.0", "k8s-nsa-1.0",
+                     "k8s-pss-baseline-0.1", "k8s-pss-restricted-0.1"):
+            cs = get_compliance_spec(name)
+            assert cs.spec.id == name
+            assert cs.spec.controls
+
+    def test_unknown_name(self):
+        with pytest.raises(SpecError):
+            get_compliance_spec("no-such-spec")
+
+    def test_custom_spec_from_path(self, tmp_path):
+        p = tmp_path / "spec.yaml"
+        p.write_text("""
+spec:
+  id: my-spec
+  title: My spec
+  version: "1.0"
+  controls:
+    - id: "1"
+      name: no critical CVEs
+      checks:
+        - id: CVE-2024-0001
+      severity: CRITICAL
+""")
+        cs = get_compliance_spec(f"@{p}")
+        assert cs.spec.id == "my-spec"
+        assert cs.scanners() == ["vuln"]
+
+    def test_scanner_by_check_id(self):
+        assert scanner_by_check_id("CVE-2024-1") == "vuln"
+        assert scanner_by_check_id("DLA-123-1") == "vuln"
+        assert scanner_by_check_id("VULN-CRITICAL") == "vuln"
+        assert scanner_by_check_id("AVD-KSV-0001") == "misconfig"
+        assert scanner_by_check_id("SECRET-HIGH") == "secret"
+        assert scanner_by_check_id("weird") == "unknown"
+
+    def test_scanners_deduped(self):
+        cs = get_compliance_spec("docker-cis-1.6.0")
+        s = cs.scanners()
+        assert set(s) <= {"vuln", "misconfig", "secret"}
+        assert len(s) == len(set(s))
+
+
+def _results():
+    return [
+        Result(
+            target="app/Dockerfile", result_class="config", type="dockerfile",
+            misconfigurations=[
+                DetectedMisconfiguration(
+                    id="DS002", avd_id="AVD-DS-0002", severity="HIGH",
+                    status="FAIL", title="root user"),
+                DetectedMisconfiguration(
+                    id="DS026", avd_id="AVD-DS-0026", severity="LOW",
+                    status="PASS", title="healthcheck"),
+            ],
+        ),
+        Result(
+            target="alpine:3.10 (alpine 3.10)", result_class="os-pkgs",
+            vulnerabilities=[
+                DetectedVulnerability(
+                    vulnerability_id="CVE-2024-0001", pkg_name="ssl",
+                    info=VulnerabilityInfo(severity="CRITICAL")),
+                DetectedVulnerability(
+                    vulnerability_id="CVE-2024-0002", pkg_name="ssl",
+                    info=VulnerabilityInfo(severity="MEDIUM")),
+            ],
+        ),
+        Result(
+            target="config.py", result_class="secret",
+            secrets=[DetectedSecret(rule_id="aws-access-key-id",
+                                    severity="CRITICAL")],
+        ),
+    ]
+
+
+class TestReport:
+    def test_build(self):
+        cs = get_compliance_spec("docker-cis-1.6.0")
+        rep = build_compliance_report(_results(), cs)
+        assert rep.id == "docker-cis-1.6.0"
+        by_id = {c.id: c for c in rep.results}
+        # 4.1 maps AVD-DS-0002 -> one FAIL finding
+        assert by_id["4.1"].total_fail == 1
+        # 4.2 = VULN-CRITICAL custom filter -> one critical CVE
+        assert by_id["4.2"].total_fail == 1
+        # 4.6 healthcheck passed -> no failures
+        assert by_id["4.6"].total_fail == 0
+        # 4.8 has no checks, defaultStatus FAIL
+        assert by_id["4.8"].total_fail == 1
+        # 4.10 = SECRET-CRITICAL -> one secret
+        assert by_id["4.10"].total_fail == 1
+
+    def test_json_summary_writer(self):
+        cs = get_compliance_spec("docker-cis-1.6.0")
+        rep = build_compliance_report(_results(), cs)
+        buf = io.StringIO()
+        write_compliance_report(rep, fmt="json", report="summary", output=buf)
+        doc = json.loads(buf.getvalue())
+        assert doc["ID"] == "docker-cis-1.6.0"
+        rows = {r["ID"]: r for r in doc["SummaryControls"]}
+        assert rows["4.1"]["TotalFail"] == 1
+
+    def test_json_all_writer(self):
+        cs = get_compliance_spec("docker-cis-1.6.0")
+        rep = build_compliance_report(_results(), cs)
+        buf = io.StringIO()
+        write_compliance_report(rep, fmt="json", report="all", output=buf)
+        doc = json.loads(buf.getvalue())
+        ctrl = next(c for c in doc["Results"] if c["ID"] == "4.1")
+        assert ctrl["Results"][0]["Misconfigurations"][0]["AVDID"] == \
+            "AVD-DS-0002"
+
+    def test_table_writer(self):
+        cs = get_compliance_spec("k8s-nsa-1.0")
+        rep = build_compliance_report([], cs)
+        buf = io.StringIO()
+        write_compliance_report(rep, fmt="table", report="summary", output=buf)
+        text = buf.getvalue()
+        assert "Summary Report for compliance" in text
+        assert "Non-root containers" in text
+
+    def test_vuln_check_id_direct_match(self):
+        cs = get_compliance_spec("@/dev/null") if False else None
+        from trivy_tpu.compliance.spec import ComplianceSpec, Control, Spec, SpecCheck
+
+        cs = ComplianceSpec(Spec(id="x", controls=[
+            Control(id="1", name="cve", severity="HIGH",
+                    checks=[SpecCheck("CVE-2024-0002")]),
+        ]))
+        rep = build_compliance_report(_results(), cs)
+        assert rep.results[0].total_fail == 1
+
+
+class TestCLIIntegration:
+    def test_fs_scan_with_compliance(self, tmp_path, capsys):
+        (tmp_path / "Dockerfile").write_text(
+            "FROM alpine:3.10\nADD app /app\nRUN chmod 777 /app\n")
+        from trivy_tpu.cli.main import main
+
+        rc = main(["filesystem", str(tmp_path), "--compliance",
+                   "docker-cis-1.6.0", "--format", "json",
+                   "--cache-dir", str(tmp_path / "cache")])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["ID"] == "docker-cis-1.6.0"
+        rows = {r["ID"]: r for r in doc["SummaryControls"]}
+        # ADD instead of COPY -> control 4.9 fails
+        assert rows["4.9"]["TotalFail"] >= 1

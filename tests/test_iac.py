@@ -137,6 +137,9 @@ def test_k8s_good_pod():
         metadata:
           name: ok
         spec:
+          automountServiceAccountToken: false
+          securityContext:
+            seccompProfile: {type: RuntimeDefault}
           containers:
           - name: app
             image: nginx:1.25

@@ -231,3 +231,96 @@ def test_arm_clean_passes():
     m = scan_config("deploy.json", json.dumps(arm).encode())
     failing = {f.id for f in m.failures}
     assert not failing & {"AVD-AZU-0008", "AVD-AZU-0011", "AVD-AZU-0007"}
+
+
+class TestNewKsvChecks:
+    """KSV002/024/025/029/030/036/037/103 added for the compliance specs."""
+
+    def _scan(self, doc: str):
+        from trivy_tpu.misconf.scanner import scan_config
+
+        m = scan_config("pod.yaml", doc.encode(), file_type="kubernetes")
+        assert m is not None
+        return {f.id for f in m.failures}
+
+    def test_host_ports_and_hostprocess(self):
+        failed = self._scan("""
+apiVersion: v1
+kind: Pod
+metadata: {name: p}
+spec:
+  securityContext:
+    windowsOptions: {hostProcess: true}
+  containers:
+    - name: c
+      image: x:1
+      ports: [{containerPort: 80, hostPort: 80}]
+""")
+        assert "KSV024" in failed
+        assert "KSV103" in failed
+
+    def test_seccomp_apparmor_selinux(self):
+        failed = self._scan("""
+apiVersion: v1
+kind: Pod
+metadata:
+  name: p
+  annotations:
+    container.apparmor.security.beta.kubernetes.io/c: unconfined
+spec:
+  containers:
+    - name: c
+      image: x:1
+      securityContext:
+        seLinuxOptions: {type: spc_t}
+""")
+        assert "KSV002" in failed   # unconfined apparmor
+        assert "KSV030" in failed   # no seccomp profile
+        assert "KSV025" in failed   # custom selinux type
+
+    def test_seccomp_pod_level_ok(self):
+        failed = self._scan("""
+apiVersion: v1
+kind: Pod
+metadata: {name: p}
+spec:
+  securityContext:
+    seccompProfile: {type: RuntimeDefault}
+  containers:
+    - name: c
+      image: x:1
+""")
+        assert "KSV030" not in failed
+
+    def test_root_group_and_token(self):
+        failed = self._scan("""
+apiVersion: v1
+kind: Pod
+metadata: {name: p}
+spec:
+  securityContext: {runAsGroup: 0}
+  containers: [{name: c, image: x:1}]
+""")
+        assert "KSV029" in failed
+        assert "KSV036" in failed   # default SA token automounted
+
+    def test_token_opt_out(self):
+        failed = self._scan("""
+apiVersion: v1
+kind: Pod
+metadata: {name: p}
+spec:
+  automountServiceAccountToken: false
+  containers: [{name: c, image: x:1}]
+""")
+        assert "KSV036" not in failed
+
+    def test_kube_system_namespace(self):
+        failed = self._scan("""
+apiVersion: v1
+kind: Pod
+metadata: {name: p, namespace: kube-system}
+spec:
+  containers: [{name: c, image: x:1}]
+""")
+        assert "KSV037" in failed

@@ -65,6 +65,15 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
                         "repeatable")
     p.add_argument("--show-suppressed", action="store_true",
                    help="include VEX-suppressed findings in the report")
+    p.add_argument("--license-full", action="store_true",
+                   help="also classify license headers in source files "
+                        "(license scanner)")
+    p.add_argument("--compliance", default=None,
+                   help="compliance report to generate (builtin name like "
+                        "docker-cis-1.6.0 or @path/to/spec.yaml)")
+    p.add_argument("--report", default="summary",
+                   choices=("all", "summary"),
+                   help="compliance report detail (all, summary)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -115,6 +124,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="restrict to one namespace")
     p.add_argument("--image-tar-dir", default=None,
                    help="directory of image tars for offline vuln scans")
+    p.add_argument("--compliance", default=None,
+                   help="compliance report (k8s-nsa-1.0, "
+                        "k8s-pss-baseline-0.1, k8s-pss-restricted-0.1, "
+                        "or @path)")
     p.add_argument("--db-path", default=None)
     p.add_argument("--no-tpu", action="store_true")
     p.add_argument("--parallel", type=int, default=5)

@@ -84,6 +84,20 @@ def run_scan(args) -> int:
     from trivy_tpu.fanal.analyzers import secret_analyzer
 
     secret_analyzer.USE_DEVICE = not getattr(args, "no_tpu", False)
+
+    # --compliance: the spec decides which scanners run and the report
+    # becomes a control-check report (reference artifact/run.go:
+    # ComplianceSpec.Scanners override + compliance/report.Write)
+    compliance_spec = None
+    if getattr(args, "compliance", None):
+        from trivy_tpu.compliance.spec import SpecError, get_compliance_spec
+
+        try:
+            compliance_spec = get_compliance_spec(args.compliance)
+        except (SpecError, OSError) as e:
+            raise FatalError(f"compliance spec: {e}")
+        args.scanners = ",".join(compliance_spec.scanners())
+
     cache = FSCache(args.cache_dir)
     artifact, driver = _select_scanner(args, cache)
     scanner = Scanner(driver, artifact)
@@ -109,8 +123,24 @@ def run_scan(args) -> int:
     filter_report(report, severities=severities, ignore_statuses=statuses,
                   ignore_config=ignore_cfg)
 
-    write_report(report, fmt=args.format, output=args.output,
-                 template=args.template, severities=severities)
+    if compliance_spec is not None:
+        from trivy_tpu.compliance.report import (
+            build_compliance_report,
+            write_compliance_report,
+        )
+
+        comp = build_compliance_report(report.results, compliance_spec)
+        out = open(args.output, "w") if args.output else None
+        try:
+            write_compliance_report(
+                comp, fmt="json" if args.format == "json" else "table",
+                report=getattr(args, "report", "summary"), output=out)
+        finally:
+            if out:
+                out.close()
+    else:
+        write_report(report, fmt=args.format, output=args.output,
+                     template=args.template, severities=severities)
 
     # exit-code policy (reference pkg/commands/operation/operation.go:118)
     if args.exit_code:
@@ -145,6 +175,12 @@ def _select_scanner(args, cache):
         disabled.add("config")
     if "secret" not in scanners:
         disabled.add("secret")
+    if "license" not in scanners:
+        disabled.add("license-file")
+    else:
+        from trivy_tpu.fanal.analyzers.license_file import LicenseFileAnalyzer
+
+        LicenseFileAnalyzer.full = bool(getattr(args, "license_full", False))
 
     cmd = args.command
     if cmd == "sbom":
@@ -192,11 +228,22 @@ def run_k8s(args) -> int:
 
     scanners = {s.strip() for s in (args.scanners or "").split(",")
                 if s.strip()}
-    valid = {"vuln", "misconfig", "rbac", "infra", "secret"}
+    valid = {"vuln", "misconfig", "rbac", "infra"}
     if unknown := scanners - valid:
         raise FatalError(
             f"unknown k8s scanners: {', '.join(sorted(unknown))} "
             f"(valid: {', '.join(sorted(valid))})")
+
+    compliance_spec = None
+    if getattr(args, "compliance", None):
+        from trivy_tpu.compliance.spec import SpecError, get_compliance_spec
+
+        try:
+            compliance_spec = get_compliance_spec(args.compliance)
+        except (SpecError, OSError) as e:
+            raise FatalError(f"compliance spec: {e}")
+        scanners = set(compliance_spec.scanners()) & valid or {"misconfig"}
+
     engine = None
     if "vuln" in scanners:
         engine = build_engine(args)
@@ -210,6 +257,32 @@ def run_k8s(args) -> int:
     except RuntimeError as e:
         print(str(e), file=sys.stderr)
         return 1
+    if compliance_spec is not None:
+        from trivy_tpu.compliance.report import (
+            build_compliance_report,
+            write_compliance_report,
+        )
+        from trivy_tpu.types.report import Result
+
+        results: list[Result] = []
+        for rr in report.resources:
+            if rr.misconfigurations:
+                results.append(Result(
+                    target=rr.resource.fullname, result_class="config",
+                    type="kubernetes",
+                    misconfigurations=rr.misconfigurations))
+            for img, rep in rr.image_reports:
+                results.extend(rep.results)
+        comp = build_compliance_report(results, compliance_spec)
+        out = open(args.output, "w") if args.output else None
+        try:
+            write_compliance_report(
+                comp, fmt="json" if args.format == "json" else "table",
+                report=args.report, output=out)
+        finally:
+            if out:
+                out.close()
+        return 0
     fmt = "json" if args.format == "json" else args.report
     write_cluster_report(report, fmt=fmt, output=args.output)
     return 0

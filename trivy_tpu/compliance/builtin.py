@@ -1,0 +1,291 @@
+"""Builtin compliance specs (reference: trivy-checks specs/compliance
+bundle, loaded by pkg/compliance/spec/compliance.go:86-120).
+
+Each spec maps its controls onto the check IDs this framework's
+misconfiguration engine implements (AVD-DS-* dockerfile checks,
+AVD-KSV-* kubernetes workload checks) plus the custom severity-filter
+IDs (VULN-*/SECRET-*, reference pkg/compliance/spec/custom.go)."""
+
+DOCKER_CIS = """\
+spec:
+  id: docker-cis-1.6.0
+  title: CIS Docker Community Edition Benchmark v1.6.0
+  description: CIS Docker Community Edition Benchmark
+  version: "1.6.0"
+  platform: docker
+  type: cis
+  relatedResources:
+    - https://www.cisecurity.org/benchmark/docker
+  controls:
+    - id: "4.1"
+      name: Ensure a user for the container has been created
+      description: Create a non-root user for the container in the
+        Dockerfile for the container image.
+      checks:
+        - id: AVD-DS-0002
+      severity: HIGH
+    - id: "4.2"
+      name: Ensure that containers use only trusted base images
+      description: Base images should be reviewed; scan images for
+        critical vulnerabilities.
+      checks:
+        - id: VULN-CRITICAL
+      severity: CRITICAL
+    - id: "4.4"
+      name: Ensure images are scanned and rebuilt to include security patches
+      description: Images should be scanned frequently; high severity
+        vulnerabilities indicate missing patches.
+      checks:
+        - id: VULN-HIGH
+      severity: HIGH
+    - id: "4.6"
+      name: Ensure that HEALTHCHECK instructions have been added
+      description: Add the HEALTHCHECK instruction to Dockerfiles.
+      checks:
+        - id: AVD-DS-0026
+      severity: LOW
+    - id: "4.7"
+      name: Ensure update instructions are not used alone in the Dockerfile
+      description: Do not use update instructions alone; combine with
+        install in a single RUN.
+      checks:
+        - id: AVD-DS-0017
+      severity: HIGH
+    - id: "4.8"
+      name: Ensure setuid and setgid permissions are removed
+      description: Remove setuid/setgid permissions in the images.
+      defaultStatus: FAIL
+      severity: MEDIUM
+    - id: "4.9"
+      name: Ensure that COPY is used instead of ADD
+      description: Use COPY instead of ADD in Dockerfiles.
+      checks:
+        - id: AVD-DS-0005
+      severity: LOW
+    - id: "4.10"
+      name: Ensure secrets are not stored in Dockerfiles
+      description: Do not store secrets in Dockerfiles.
+      checks:
+        - id: SECRET-CRITICAL
+      severity: CRITICAL
+    - id: "5.8"
+      name: Ensure privileged ports are not mapped
+      description: The container should not expose privileged ports (<1024).
+      checks:
+        - id: AVD-DS-0004
+      severity: MEDIUM
+"""
+
+K8S_NSA = """\
+spec:
+  id: k8s-nsa-1.0
+  title: National Security Agency - Kubernetes Hardening Guidance v1.0
+  description: Kubernetes Hardening Guidance by NSA and CISA
+  version: "1.0"
+  platform: k8s
+  type: nsa
+  relatedResources:
+    - https://www.nsa.gov/Press-Room/News-Highlights/Article/Article/2716980/
+  controls:
+    - id: "1.0"
+      name: Non-root containers
+      description: Check that container is not running as root
+      checks:
+        - id: AVD-KSV-0012
+      severity: MEDIUM
+    - id: "1.1"
+      name: Immutable container file systems
+      description: Check that container root file system is immutable
+      checks:
+        - id: AVD-KSV-0014
+      severity: LOW
+    - id: "1.2"
+      name: Preventing privileged containers
+      description: Controls whether Pods can run privileged containers
+      checks:
+        - id: AVD-KSV-0017
+      severity: HIGH
+    - id: "1.3"
+      name: Share containers process namespaces
+      description: Controls whether containers can share process namespaces
+      checks:
+        - id: AVD-KSV-0008
+      severity: HIGH
+    - id: "1.4"
+      name: Share host process namespaces
+      description: Controls whether share host process namespaces
+      checks:
+        - id: AVD-KSV-0010
+      severity: HIGH
+    - id: "1.5"
+      name: Use the host network
+      description: Controls whether containers can use the host network
+      checks:
+        - id: AVD-KSV-0009
+      severity: HIGH
+    - id: "1.6"
+      name: Run with root privileges or with root group membership
+      description: Controls whether container applications can run with
+        root privileges or with root group membership
+      checks:
+        - id: AVD-KSV-0029
+      severity: LOW
+    - id: "1.7"
+      name: Restricts escalation to root privileges
+      description: Control check restrictions escalation to root privileges
+      checks:
+        - id: AVD-KSV-0001
+      severity: MEDIUM
+    - id: "1.8"
+      name: Sets the SELinux context of the container
+      description: Control checks if pod sets the SELinux context of the container
+      checks:
+        - id: AVD-KSV-0025
+      severity: MEDIUM
+    - id: "1.9"
+      name: Restrict a container's access to resources with AppArmor
+      description: Control checks the restriction of containers access to
+        resources with AppArmor
+      checks:
+        - id: AVD-KSV-0002
+      severity: MEDIUM
+    - id: "1.10"
+      name: Sets the seccomp profile used to sandbox containers
+      description: Control checks the sets the seccomp profile used to
+        sandbox containers
+      checks:
+        - id: AVD-KSV-0030
+      severity: LOW
+    - id: "1.11"
+      name: Protecting Pod service account tokens
+      description: Control check whether disable secret token been mount
+      checks:
+        - id: AVD-KSV-0036
+      severity: MEDIUM
+    - id: "1.12"
+      name: Namespace kube-system should not be used by users
+      description: Control check whether Namespace kube-system is not be used by users
+      checks:
+        - id: AVD-KSV-0037
+      severity: MEDIUM
+    - id: "2.0"
+      name: Vulnerability scanning
+      description: Scan workload images for critical vulnerabilities
+      checks:
+        - id: VULN-CRITICAL
+      severity: CRITICAL
+"""
+
+K8S_PSS_BASELINE = """\
+spec:
+  id: k8s-pss-baseline-0.1
+  title: Kubernetes Pod Security Standards - Baseline
+  description: Kubernetes Pod Security Standards - Baseline profile
+  version: "0.1"
+  platform: k8s
+  type: pss
+  relatedResources:
+    - https://kubernetes.io/docs/concepts/security/pod-security-standards/
+  controls:
+    - id: "1"
+      name: Host Processes
+      description: Windows pods offer the ability to run HostProcess containers
+      checks:
+        - id: AVD-KSV-0103
+      severity: HIGH
+    - id: "2"
+      name: Host Namespaces (PID)
+      description: Sharing the host namespaces must be disallowed
+      checks:
+        - id: AVD-KSV-0010
+      severity: HIGH
+    - id: "3"
+      name: Host Namespaces (IPC)
+      description: Sharing the host IPC namespace must be disallowed
+      checks:
+        - id: AVD-KSV-0008
+      severity: HIGH
+    - id: "4"
+      name: Host Namespaces (network)
+      description: Sharing the host network namespace must be disallowed
+      checks:
+        - id: AVD-KSV-0009
+      severity: HIGH
+    - id: "5"
+      name: Privileged Containers
+      description: Privileged Pods disable most security mechanisms and
+        must be disallowed
+      checks:
+        - id: AVD-KSV-0017
+      severity: HIGH
+    - id: "6"
+      name: HostPath Volumes
+      description: HostPath volumes must be forbidden
+      checks:
+        - id: AVD-KSV-0023
+      severity: MEDIUM
+    - id: "7"
+      name: Host Ports
+      description: HostPorts should be disallowed entirely or restricted
+      checks:
+        - id: AVD-KSV-0024
+      severity: HIGH
+"""
+
+K8S_PSS_RESTRICTED = """\
+spec:
+  id: k8s-pss-restricted-0.1
+  title: Kubernetes Pod Security Standards - Restricted
+  description: Kubernetes Pod Security Standards - Restricted profile
+  version: "0.1"
+  platform: k8s
+  type: pss
+  relatedResources:
+    - https://kubernetes.io/docs/concepts/security/pod-security-standards/
+  controls:
+    - id: "1"
+      name: Privileged Containers
+      description: Privileged Pods disable most security mechanisms
+      checks:
+        - id: AVD-KSV-0017
+      severity: HIGH
+    - id: "2"
+      name: Privilege Escalation
+      description: Privilege escalation must not be allowed
+      checks:
+        - id: AVD-KSV-0001
+      severity: MEDIUM
+    - id: "3"
+      name: Running as Non-root
+      description: Containers must be required to run as non-root users
+      checks:
+        - id: AVD-KSV-0012
+      severity: MEDIUM
+    - id: "4"
+      name: Read-only root filesystem
+      description: Containers should use a read-only root filesystem
+      checks:
+        - id: AVD-KSV-0014
+      severity: LOW
+    - id: "5"
+      name: Capabilities
+      description: Containers must drop ALL capabilities
+      checks:
+        - id: AVD-KSV-0003
+      severity: LOW
+    - id: "6"
+      name: Host Namespaces
+      description: Sharing host namespaces must be disallowed
+      checks:
+        - id: AVD-KSV-0008
+        - id: AVD-KSV-0009
+        - id: AVD-KSV-0010
+      severity: HIGH
+"""
+
+BUILTIN_SPECS: dict[str, str] = {
+    "docker-cis-1.6.0": DOCKER_CIS,
+    "k8s-nsa-1.0": K8S_NSA,
+    "k8s-pss-baseline-0.1": K8S_PSS_BASELINE,
+    "k8s-pss-restricted-0.1": K8S_PSS_RESTRICTED,
+}

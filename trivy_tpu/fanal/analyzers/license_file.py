@@ -30,7 +30,8 @@ class LicenseFileAnalyzer(Analyzer):
     type = "license-file"
     version = 1
 
-    # toggled per scan by the runner when --license-full is set
+    # class attrs toggled per scan by cli.run._select_scanner when
+    # --license-full is set (same pattern as secret_analyzer.USE_DEVICE)
     full = False
     confidence_level = 0.75
 
@@ -42,9 +43,11 @@ class LicenseFileAnalyzer(Analyzer):
         if ext in _TEXT_EXTS and (stem in _LICENSE_NAMES
                                   or base in _LICENSE_NAMES):
             return True
-        # e.g. LICENSE-MIT, LICENSE.Apache-2.0
-        if any(stem.startswith(n + "-") or stem.startswith(n + ".")
-               for n in ("license", "licence", "copying")):
+        # e.g. LICENSE-MIT.txt, LICENSE.Apache-2.0 — but not source files
+        # like license-checker.py (tooling, not license text)
+        if ext not in _SOURCE_EXTS and \
+                any(stem.startswith(n + "-") or stem.startswith(n + ".")
+                    for n in ("license", "licence", "copying")):
             return True
         if self.full and ext in _SOURCE_EXTS:
             return True

@@ -292,3 +292,191 @@ def host_path(ctx):
                 start_line=get_line(v), end_line=get_end_line(v),
             ))
     return out
+
+@check("KSV002", "Default AppArmor profile not set", severity="MEDIUM",
+       file_types=_K, avd_id="AVD-KSV-0002", provider="kubernetes",
+       service="general",
+       resolution="Remove 'container.apparmor.security.beta.kubernetes.io' "
+                  "annotations or set them to 'runtime/default'")
+def apparmor_profile(ctx):
+    out = []
+    annotations = (ctx.resource.get("metadata") or {}).get("annotations") or {}
+    tmpl_md = {}
+    spec = ctx.resource.get("spec") or {}
+    if isinstance(spec.get("template"), dict):
+        tmpl_md = (spec["template"].get("metadata") or {})
+    tmpl_ann = tmpl_md.get("annotations") or {}
+    for ann in ({**annotations, **tmpl_ann}).items():
+        key, value = ann
+        if key.startswith("container.apparmor.security.beta.kubernetes.io/") \
+                and value not in ("runtime/default", "localhost/default"):
+            out.append(Cause(
+                message=f"{_name(ctx.resource)} should specify an AppArmor "
+                        f"profile of 'runtime/default'",
+                resource=_name(ctx.resource),
+                start_line=get_line(ctx.resource),
+                end_line=get_line(ctx.resource),
+            ))
+    return out
+
+
+@check("KSV024", "Access to host ports", severity="HIGH", file_types=_K,
+       avd_id="AVD-KSV-0024", provider="kubernetes", service="general",
+       resolution="Do not set 'spec.containers.ports.hostPort'")
+def host_ports(ctx):
+    out = []
+    for c in ctx.containers:
+        for p in c.get("ports") or []:
+            if (p or {}).get("hostPort"):
+                out.append(_container_cause(
+                    ctx, c,
+                    f"Container '{c.get('name', '')}' of "
+                    f"{_name(ctx.resource)} should not set "
+                    f"'ports.hostPort'"))
+    return out
+
+
+@check("KSV029", "A root primary or supplementary GID set", severity="LOW",
+       file_types=_K, avd_id="AVD-KSV-0029", provider="kubernetes",
+       service="general",
+       resolution="Set 'securityContext.runAsGroup' to a non-zero integer "
+                  "and do not include group 0 in 'supplementalGroups'")
+def root_group(ctx):
+    out = []
+    pod_sc = _pod_sc(ctx)
+    if pod_sc.get("runAsGroup") == 0 or pod_sc.get("fsGroup") == 0 or \
+            0 in (pod_sc.get("supplementalGroups") or []):
+        out.append(Cause(
+            message=f"{_name(ctx.resource)} should not set a root group "
+                    f"(runAsGroup/fsGroup/supplementalGroups of 0)",
+            resource=_name(ctx.resource),
+            start_line=get_line(ctx.pod_spec),
+            end_line=get_line(ctx.pod_spec),
+        ))
+    for c in ctx.containers:
+        if _sc(c).get("runAsGroup") == 0:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of {_name(ctx.resource)} "
+                f"should not set 'securityContext.runAsGroup' to 0"))
+    return out
+
+
+@check("KSV030", "Runtime/Default Seccomp profile not set", severity="LOW",
+       file_types=_K, avd_id="AVD-KSV-0030", provider="kubernetes",
+       service="general",
+       resolution="Set 'securityContext.seccompProfile.type' to "
+                  "'RuntimeDefault'")
+def seccomp_profile(ctx):
+    allowed = ("RuntimeDefault", "Localhost")
+    pod_type = (_pod_sc(ctx).get("seccompProfile") or {}).get("type")
+    out = []
+    for c in ctx.containers:
+        own = (_sc(c).get("seccompProfile") or {}).get("type")
+        effective = own if own is not None else pod_type
+        if effective not in allowed:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of {_name(ctx.resource)} "
+                f"should set 'securityContext.seccompProfile.type' to "
+                f"'RuntimeDefault'"))
+    return out
+
+
+@check("KSV036", "Service account token mounted automatically",
+       severity="MEDIUM", file_types=_K, avd_id="AVD-KSV-0036",
+       provider="kubernetes", service="general",
+       resolution="Set 'automountServiceAccountToken' to false or mount "
+                  "the token only where needed")
+def automount_token(ctx):
+    spec = ctx.pod_spec or {}
+    # mounting is acceptable when the pod opts out, or when it explicitly
+    # runs as a dedicated (non-default) service account that needs it
+    if spec.get("automountServiceAccountToken") is False:
+        return []
+    if spec.get("automountServiceAccountToken") is True or \
+            spec.get("serviceAccountName", "default") == "default":
+        return [Cause(
+            message=f"{_name(ctx.resource)} should set "
+                    f"'automountServiceAccountToken' to false",
+            resource=_name(ctx.resource),
+            start_line=get_line(ctx.pod_spec),
+            end_line=get_line(ctx.pod_spec),
+        )]
+    return []
+
+
+@check("KSV037", "User Pods should not be placed in kube-system namespace",
+       severity="MEDIUM", file_types=_K, avd_id="AVD-KSV-0037",
+       provider="kubernetes", service="general",
+       resolution="Deploy user workloads outside the kube-system namespace")
+def kube_system_namespace(ctx):
+    md = ctx.resource.get("metadata") or {}
+    if md.get("namespace") != "kube-system":
+        return []
+    labels = md.get("labels") or {}
+    # control-plane components themselves are exempt
+    if labels.get("tier") == "control-plane" or "component" in labels:
+        return []
+    return [Cause(
+        message=f"{_name(ctx.resource)} should not be deployed in the "
+                f"'kube-system' namespace",
+        resource=_name(ctx.resource),
+        start_line=get_line(ctx.resource),
+        end_line=get_line(ctx.resource),
+    )]
+
+
+@check("KSV103", "HostProcess container defined", severity="HIGH",
+       file_types=_K, avd_id="AVD-KSV-0103", provider="kubernetes",
+       service="general",
+       resolution="Do not enable 'windowsOptions.hostProcess'")
+def host_process(ctx):
+    out = []
+    pod_wo = _pod_sc(ctx).get("windowsOptions") or {}
+    if pod_wo.get("hostProcess") is True:
+        out.append(Cause(
+            message=f"{_name(ctx.resource)} should not set "
+                    f"'securityContext.windowsOptions.hostProcess' to true",
+            resource=_name(ctx.resource),
+            start_line=get_line(ctx.pod_spec),
+            end_line=get_line(ctx.pod_spec),
+        ))
+    for c in ctx.containers:
+        wo = _sc(c).get("windowsOptions") or {}
+        if wo.get("hostProcess") is True:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of {_name(ctx.resource)} "
+                f"should not enable 'windowsOptions.hostProcess'"))
+    return out
+
+
+@check("KSV025", "SELinux custom options set", severity="MEDIUM",
+       file_types=_K, avd_id="AVD-KSV-0025", provider="kubernetes",
+       service="general",
+       resolution="Do not set 'securityContext.seLinuxOptions' custom "
+                  "type/user/role")
+def selinux_options(ctx):
+    out = []
+
+    def bad(opts: dict) -> bool:
+        return bool(opts.get("user") or opts.get("role") or
+                    (opts.get("type") and opts["type"] not in
+                     ("container_t", "container_init_t", "container_kvm_t")))
+
+    if bad(_pod_sc(ctx).get("seLinuxOptions") or {}):
+        out.append(Cause(
+            message=f"{_name(ctx.resource)} should not set custom "
+                    f"'securityContext.seLinuxOptions'",
+            resource=_name(ctx.resource),
+            start_line=get_line(ctx.pod_spec),
+            end_line=get_line(ctx.pod_spec),
+        ))
+    for c in ctx.containers:
+        if bad(_sc(c).get("seLinuxOptions") or {}):
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of {_name(ctx.resource)} "
+                f"should not set custom 'securityContext.seLinuxOptions'"))
+    return out
