@@ -1,0 +1,300 @@
+"""Image acquisition tests: reference-style fake daemon + fake registry
+(the reference uses aquasecurity/testdocker the same way — an in-process
+fake Docker daemon and registry; internal/testutil)."""
+
+import gzip
+import hashlib
+import io
+import json
+import socketserver
+import tarfile
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from trivy_tpu.artifact.image import ImageArtifact, TarImage
+from trivy_tpu.artifact.image_source import (
+    DaemonImage,
+    RegistryImage,
+    SourceError,
+    parse_reference,
+    resolve_image,
+)
+from trivy_tpu.cache.cache import MemoryCache
+
+
+class TestParseReference:
+    @pytest.mark.parametrize("ref,want", [
+        ("alpine", ("index.docker.io", "library/alpine", "latest", "")),
+        ("alpine:3.10", ("index.docker.io", "library/alpine", "3.10", "")),
+        ("grafana/grafana", ("index.docker.io", "grafana/grafana", "latest", "")),
+        ("ghcr.io/a/b:v1", ("ghcr.io", "a/b", "v1", "")),
+        ("localhost:5000/x", ("localhost:5000", "x", "latest", "")),
+        ("r.example.com/team/app:1.2", ("r.example.com", "team/app", "1.2", "")),
+        ("alpine@sha256:" + "0" * 64,
+         ("index.docker.io", "library/alpine", "", "sha256:" + "0" * 64)),
+    ])
+    def test_parse(self, ref, want):
+        assert parse_reference(ref) == want
+
+
+# ---------------------------------------------------------- fixtures
+
+
+def _mk_layer(files: dict[str, bytes]) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for path, content in files.items():
+            info = tarfile.TarInfo(path)
+            info.size = len(content)
+            tf.addfile(info, io.BytesIO(content))
+    return buf.getvalue()
+
+
+def _mk_docker_save(layers: list[bytes], repo_tag="demo:1.0") -> bytes:
+    diff_ids = ["sha256:" + hashlib.sha256(l).hexdigest() for l in layers]
+    config = {
+        "architecture": "amd64", "os": "linux", "config": {},
+        "rootfs": {"type": "layers", "diff_ids": diff_ids},
+        "history": [{"created_by": f"layer-{i}"} for i in range(len(layers))],
+    }
+    cfg_raw = json.dumps(config).encode()
+    cfg_name = hashlib.sha256(cfg_raw).hexdigest() + ".json"
+    manifest = [{"Config": cfg_name, "RepoTags": [repo_tag],
+                 "Layers": [f"l{i}/layer.tar" for i in range(len(layers))]}]
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        def add(name, content):
+            info = tarfile.TarInfo(name)
+            info.size = len(content)
+            tf.addfile(info, io.BytesIO(content))
+        add(cfg_name, cfg_raw)
+        for i, l in enumerate(layers):
+            add(f"l{i}/layer.tar", l)
+        add("manifest.json", json.dumps(manifest).encode())
+    return buf.getvalue()
+
+
+LAYER = _mk_layer({
+    "etc/alpine-release": b"3.19.0\n",
+    "app/requirements.txt": b"flask==1.0\n",
+})
+SAVE_TAR = _mk_docker_save([LAYER])
+
+
+# ------------------------------------------------------- fake daemon
+
+
+class _UnixHTTPServer(socketserver.UnixStreamServer):
+    allow_reuse_address = True
+
+    def get_request(self):
+        request, _ = super().get_request()
+        return request, ("localhost", 0)  # BaseHTTPRequestHandler wants a pair
+
+
+class _DaemonHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.path.endswith("/json"):
+            if "missing" in self.path:
+                self._reply(404, b"{}")
+            else:
+                self._reply(200, b"{}")
+        elif self.path.endswith("/get"):
+            self._reply(200, SAVE_TAR, ctype="application/x-tar")
+        else:
+            self._reply(404, b"not found")
+
+    def _reply(self, code, body, ctype="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def daemon_socket(tmp_path):
+    sock_path = str(tmp_path / "docker.sock")
+    srv = _UnixHTTPServer(sock_path, _DaemonHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield sock_path
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestDaemonImage:
+    def test_export(self, daemon_socket):
+        img = DaemonImage("demo:1.0", daemon_socket)
+        try:
+            assert img.name == "demo:1.0"
+            assert len(img.diff_ids()) == 1
+            layer = img.layer_bytes(0)
+            with tarfile.open(fileobj=io.BytesIO(layer)) as tf:
+                assert "etc/alpine-release" in tf.getnames()
+        finally:
+            img.close()
+
+    def test_missing_image(self, daemon_socket):
+        with pytest.raises(SourceError, match="not found"):
+            DaemonImage("missing:1.0", daemon_socket)
+
+    def test_resolve_chain_docker_env(self, daemon_socket, monkeypatch):
+        monkeypatch.setenv("DOCKER_HOST", f"unix://{daemon_socket}")
+        img = resolve_image("demo:1.0", sources=("docker",))
+        try:
+            assert img.diff_ids()
+        finally:
+            img.close()
+
+    def test_resolve_chain_all_fail(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("DOCKER_HOST", f"unix://{tmp_path}/nope.sock")
+        with pytest.raises(SourceError, match="docker.*podman"):
+            resolve_image("demo:1.0", sources=("docker", "podman"))
+
+
+# ------------------------------------------------------ fake registry
+
+
+class _RegistryHandler(BaseHTTPRequestHandler):
+    # class-level store set up by the fixture
+    repo = "team/app"
+    token = "test-token-123"
+    blobs: dict = {}
+    manifest_raw = b""
+    manifest_type = "application/vnd.oci.image.manifest.v1+json"
+    index_raw = b""
+    require_auth = True
+
+    def log_message(self, *a):
+        pass
+
+    def _authed(self):
+        if not self.require_auth:
+            return True
+        return self.headers.get("Authorization") == f"Bearer {self.token}"
+
+    def do_GET(self):
+        if self.path.startswith("/token"):
+            self._reply(200, json.dumps({"token": self.token}).encode())
+            return
+        if not self._authed():
+            self.send_response(401)
+            host = self.headers.get("Host", "localhost")
+            self.send_header(
+                "WWW-Authenticate",
+                f'Bearer realm="http://{host}/token",service="test-registry"')
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        if "/manifests/" in self.path:
+            ref = self.path.rsplit("/", 1)[1]
+            if ref == "multi":
+                self._reply(200, self.index_raw,
+                            ctype="application/vnd.oci.image.index.v1+json")
+            else:
+                self._reply(200, self.manifest_raw, ctype=self.manifest_type)
+            return
+        if "/blobs/" in self.path:
+            digest = self.path.rsplit("/", 1)[1]
+            body = self.blobs.get(digest)
+            if body is None:
+                self._reply(404, b"{}")
+            else:
+                self._reply(200, body, ctype="application/octet-stream")
+            return
+        self._reply(404, b"{}")
+
+    def _reply(self, code, body, ctype="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Docker-Content-Digest",
+                         "sha256:" + hashlib.sha256(body).hexdigest())
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def registry(tmp_path):
+    layer_gz = gzip.compress(LAYER)
+    layer_digest = "sha256:" + hashlib.sha256(layer_gz).hexdigest()
+    diff_id = "sha256:" + hashlib.sha256(LAYER).hexdigest()
+    config = {
+        "architecture": "amd64", "os": "linux", "config": {},
+        "rootfs": {"type": "layers", "diff_ids": [diff_id]},
+    }
+    cfg_raw = json.dumps(config).encode()
+    cfg_digest = "sha256:" + hashlib.sha256(cfg_raw).hexdigest()
+    manifest = {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.oci.image.manifest.v1+json",
+        "config": {"digest": cfg_digest, "size": len(cfg_raw)},
+        "layers": [{"digest": layer_digest, "size": len(layer_gz)}],
+    }
+    manifest_raw = json.dumps(manifest).encode()
+    manifest_digest = "sha256:" + hashlib.sha256(manifest_raw).hexdigest()
+    index = {
+        "schemaVersion": 2,
+        "manifests": [
+            {"digest": "sha256:" + "b" * 64,
+             "platform": {"os": "windows", "architecture": "amd64"}},
+            {"digest": manifest_digest,
+             "platform": {"os": "linux", "architecture": "amd64"}},
+        ],
+    }
+    _RegistryHandler.blobs = {cfg_digest: cfg_raw, layer_digest: layer_gz,
+                              manifest_digest: manifest_raw}
+    _RegistryHandler.manifest_raw = manifest_raw
+    _RegistryHandler.index_raw = json.dumps(index).encode()
+    _RegistryHandler.require_auth = True
+
+    srv = HTTPServer(("127.0.0.1", 0), _RegistryHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestRegistryImage:
+    def test_pull_with_token_auth(self, registry):
+        img = RegistryImage(f"{registry}/team/app:1.0", insecure=True)
+        assert img.diff_ids()
+        layer = img.layer_bytes(0)  # transparently gunzipped
+        with tarfile.open(fileobj=io.BytesIO(layer)) as tf:
+            assert "app/requirements.txt" in tf.getnames()
+        assert img.repo_digest.startswith(f"{registry}/team/app@sha256:")
+
+    def test_index_platform_selection(self, registry):
+        # the 'multi' tag returns an OCI index; the linux/amd64 child
+        # must be picked and fetched by digest
+        img = RegistryImage(f"{registry}/team/app:multi", insecure=True)
+        assert img.config.get("os") == "linux"
+
+    def test_resolve_remote_fallback(self, registry, monkeypatch, tmp_path):
+        monkeypatch.setenv("DOCKER_HOST", f"unix://{tmp_path}/no.sock")
+        img = resolve_image(f"{registry}/team/app:1.0",
+                            sources=("docker", "remote"), insecure=True)
+        assert img.diff_ids()
+
+
+class TestImageArtifactFromRegistry:
+    def test_inspect_end_to_end(self, registry):
+        cache = MemoryCache()
+        art = ImageArtifact(
+            f"{registry}/team/app:1.0", cache, from_tar=False,
+            image_sources=("remote",), insecure=True)
+        ref = art.inspect()
+        assert ref.type == "container_image"
+        assert len(ref.blob_ids) == 1
+        blob = cache.get_blob(ref.blob_ids[0])
+        apps = blob.get("applications") or []
+        assert any(a.get("file_path") == "app/requirements.txt"
+                   for a in apps)
+        assert ref.image_metadata["RepoDigests"]

@@ -119,6 +119,10 @@ class ImageArtifact:
         parallel: int = 5,
         disabled_analyzers: set[str] | None = None,
         secret_config: str | None = None,
+        image_sources: tuple[str, ...] = ("docker", "podman", "remote"),
+        insecure: bool = False,
+        username: str = "",
+        password: str = "",
     ):
         self.target = target
         self.cache = cache
@@ -126,6 +130,10 @@ class ImageArtifact:
         self.parallel = parallel
         self.disabled = set(disabled_analyzers or set())
         self.secret_config = secret_config
+        self.image_sources = image_sources
+        self.insecure = insecure
+        self.username = username
+        self.password = password
 
     def _group(self) -> AnalyzerGroup:
         group = AnalyzerGroup.build(disabled_types=self.disabled)
@@ -135,18 +143,26 @@ class ImageArtifact:
         return group
 
     def inspect(self) -> ArtifactReference:
-        if not self.from_tar:
-            raise ImageError(
-                "daemon/registry image sources are not wired yet; "
-                "use --input with a docker-save/OCI tar archive"
-            )
-        img = TarImage(self.target)
+        if self.from_tar:
+            img = TarImage(self.target)
+        else:
+            # daemon/registry fallback chain
+            # (reference pkg/fanal/image/image.go:26-58)
+            from trivy_tpu.artifact.image_source import SourceError, resolve_image
+
+            try:
+                img = resolve_image(
+                    self.target, sources=self.image_sources,
+                    insecure=self.insecure,
+                    username=self.username, password=self.password)
+            except SourceError as e:
+                raise ImageError(str(e)) from e
         try:
-            return self._inspect_tar(img)
+            return self._inspect_image(img)
         finally:
             img.close()
 
-    def _inspect_tar(self, img: TarImage) -> ArtifactReference:
+    def _inspect_image(self, img) -> ArtifactReference:
         group = self._group()
         versions = group.versions()
         diff_ids = img.diff_ids()
@@ -173,10 +189,11 @@ class ImageArtifact:
             self.cache.put_artifact(artifact_id, dataclasses.asdict(info))
 
         size = 0
-        try:
-            size = os.path.getsize(self.target)
-        except OSError:
-            pass
+        if self.from_tar:
+            try:
+                size = os.path.getsize(self.target)
+            except OSError:
+                pass
         return ArtifactReference(
             name=img.name,
             type="container_image",
@@ -186,13 +203,14 @@ class ImageArtifact:
                 "ImageID": img.config_digest,
                 "DiffIDs": diff_ids,
                 "RepoTags": [img.name] if ":" in img.name else [],
-                "RepoDigests": [],
+                "RepoDigests": [img.repo_digest]
+                if getattr(img, "repo_digest", "") else [],
                 "ImageConfig": img.config,
                 "Size": size,
             },
         )
 
-    def _inspect_layer(self, group, img: TarImage, i: int, diff_id: str,
+    def _inspect_layer(self, group, img, i: int, diff_id: str,
                        blob_id: str) -> None:
         _log.info("analyzing layer...", diff_id=diff_id[:19])
         layer = img.layer_bytes(i)

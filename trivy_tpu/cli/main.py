@@ -102,6 +102,15 @@ def build_parser() -> argparse.ArgumentParser:
         if name == "image":
             p.add_argument("--input", default=None,
                            help="image tar archive path")
+            p.add_argument("--image-src", default="docker,podman,remote",
+                           help="comma-separated image sources tried in "
+                                "order (docker,podman,remote)")
+            p.add_argument("--insecure", action="store_true",
+                           help="allow plain-HTTP / unverified registries")
+            p.add_argument("--username", default=os.environ.get(
+                "TRIVY_TPU_USERNAME", ""), help="registry username")
+            p.add_argument("--password", default=os.environ.get(
+                "TRIVY_TPU_PASSWORD", ""), help="registry password")
             p.add_argument("target", nargs="?", default=None)
         else:
             p.add_argument("target")
@@ -157,6 +166,23 @@ def build_parser() -> argparse.ArgumentParser:
     pi.add_argument("--db-path", default=None)
     ps = dbsub.add_parser("stats", help="show DB statistics", allow_abbrev=False)
     ps.add_argument("--db-path", default=None)
+
+    p = sub.add_parser("registry", help="registry authentication",
+                       allow_abbrev=False)
+    _add_global_flags(p)
+    regsub = p.add_subparsers(dest="registry_command")
+    pl = regsub.add_parser("login", help="log in to a registry",
+                           allow_abbrev=False)
+    _add_global_flags(pl)
+    pl.add_argument("--username", "-u", required=True)
+    pl.add_argument("--password", default=None,
+                    help="password (omit to read from stdin)")
+    pl.add_argument("--password-stdin", action="store_true")
+    pl.add_argument("server")
+    po = regsub.add_parser("logout", help="log out of a registry",
+                           allow_abbrev=False)
+    _add_global_flags(po)
+    po.add_argument("server")
 
     p = sub.add_parser("clean", help="clean caches", allow_abbrev=False)
     _add_global_flags(p)
@@ -221,6 +247,8 @@ def main(argv: list[str] | None = None) -> int:
             return run.run_db(args)
         if args.command == "clean":
             return run.run_clean(args)
+        if args.command == "registry":
+            return run.run_registry(args)
     except run.FatalError as e:
         log.logger().error(str(e))
         return 1

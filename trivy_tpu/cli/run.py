@@ -213,10 +213,18 @@ def _select_scanner(args, cache):
         target = getattr(args, "input", None) or args.target
         if target is None:
             raise FatalError("image target or --input required")
+        sources = tuple(
+            s.strip() for s in
+            getattr(args, "image_src", "docker,podman,remote").split(",")
+            if s.strip())
         return ImageArtifact(
             target, cache, from_tar=bool(getattr(args, "input", None)),
             parallel=args.parallel,
             disabled_analyzers=disabled,
+            image_sources=sources,
+            insecure=getattr(args, "insecure", False),
+            username=getattr(args, "username", ""),
+            password=getattr(args, "password", ""),
         ), driver
     raise FatalError(f"unsupported scan command {cmd!r}")
 
@@ -459,3 +467,47 @@ def run_clean(args) -> int:
                       ignore_errors=True)
         _log.info("removed scan cache")
     return 0
+
+
+def run_registry(args) -> int:
+    """`registry login|logout` (reference pkg/commands/auth): credentials
+    are stored docker-config style so the registry client
+    (artifact.image_source._docker_config_auth) picks them up."""
+    import base64
+    import json as _json
+
+    sub = getattr(args, "registry_command", None)
+    cfg_dir = os.environ.get("DOCKER_CONFIG",
+                             os.path.expanduser("~/.docker"))
+    cfg_path = os.path.join(cfg_dir, "config.json")
+    try:
+        with open(cfg_path, "rb") as f:
+            cfg = _json.load(f)
+    except (OSError, ValueError):
+        cfg = {}
+    auths = cfg.setdefault("auths", {})
+
+    if sub == "login":
+        password = args.password
+        if password is None or getattr(args, "password_stdin", False):
+            password = sys.stdin.readline().rstrip("\n")
+        if not password:
+            raise FatalError("no password provided (use --password or pipe "
+                             "it to stdin with --password-stdin)")
+        raw = f"{args.username}:{password}".encode()
+        auths[args.server] = {"auth": base64.b64encode(raw).decode()}
+        os.makedirs(cfg_dir, exist_ok=True)
+        fd = os.open(cfg_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            _json.dump(cfg, f, indent=2)
+        _log.info("logged in", registry=args.server)
+        return 0
+    if sub == "logout":
+        if auths.pop(args.server, None) is None:
+            _log.warn("not logged in", registry=args.server)
+            return 0
+        with open(cfg_path, "w") as f:
+            _json.dump(cfg, f, indent=2)
+        _log.info("logged out", registry=args.server)
+        return 0
+    raise FatalError("usage: registry {login|logout} <server>")
