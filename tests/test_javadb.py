@@ -1,0 +1,129 @@
+"""Java DB (sha1->GAV) and jar-identification chain tests
+(reference pkg/javadb + dependency/parser/java/jar/parse_test.go)."""
+
+import hashlib
+import io
+import json
+import zipfile
+
+from trivy_tpu.db.javadb import GAV, JavaDB, default_path
+from trivy_tpu.parsers.misc_lang import parse_jar
+
+
+def _mk_jar(entries: dict[str, bytes]) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        for name, content in entries.items():
+            zf.writestr(name, content)
+    return buf.getvalue()
+
+
+POM_PROPS = b"groupId=org.example\nartifactId=lib\nversion=1.2.3\n"
+MANIFEST = (b"Manifest-Version: 1.0\n"
+            b"Implementation-Title: cool-lib\n"
+            b"Implementation-Version: 4.5.6\n"
+            b"Implementation-Vendor-Id: com.vendor\n")
+
+
+class TestJavaDB:
+    def test_create_import_search(self, tmp_path):
+        path = default_path(str(tmp_path))
+        db = JavaDB.create(path)
+        n = db.import_entries([
+            {"groupId": "org.apache.logging.log4j", "artifactId": "log4j-core",
+             "version": "2.14.1", "sha1": "ABCD" + "0" * 36},
+            {"groupId": "org.example", "artifactId": "dup",
+             "version": "1.0", "sha1": "1" * 40},
+            {"groupId": "com.other", "artifactId": "dup",
+             "version": "1.0", "sha1": "2" * 40},
+        ])
+        assert n == 3
+        db.write_metadata()
+        db.close()
+
+        ro = JavaDB(path)
+        gav = ro.search_by_sha1("abcd" + "0" * 36)  # case-insensitive
+        assert gav == GAV("org.apache.logging.log4j", "log4j-core", "2.14.1")
+        assert ro.search_by_sha1("f" * 40) is None
+        # unique artifactId resolves; ambiguous does not
+        assert ro.search_by_artifact_id("log4j-core", "2.14.1") == \
+            "org.apache.logging.log4j"
+        assert ro.search_by_artifact_id("dup", "1.0") is None
+        assert ro.stats()["artifacts"] == 3
+        ro.close()
+
+    def test_missing_db_finds_nothing(self, tmp_path):
+        db = JavaDB(str(tmp_path / "nope.sqlite"))
+        assert db.search_by_sha1("a" * 40) is None
+        assert db.search_by_artifact_id("x", "1") is None
+
+
+class TestJarIdentification:
+    def test_pom_properties_wins(self):
+        jar = _mk_jar({
+            "META-INF/maven/org.example/lib/pom.properties": POM_PROPS,
+        })
+        pkgs = parse_jar(jar, "lib-1.2.3.jar", client=None)
+        assert [(p.name, p.version) for p in pkgs] == \
+            [("org.example:lib", "1.2.3")]
+
+    def test_sha1_lookup(self, tmp_path):
+        jar = _mk_jar({"x.class": b"\xca\xfe\xba\xbe"})
+        sha1 = hashlib.sha1(jar).hexdigest()
+        db = JavaDB.create(str(tmp_path / "j.sqlite"))
+        db.import_entries([{"groupId": "org.found", "artifactId": "via-sha1",
+                            "version": "9.9", "sha1": sha1}])
+        pkgs = parse_jar(jar, "unknown.jar", client=db)
+        db.close()
+        assert [(p.name, p.version) for p in pkgs] == \
+            [("org.found:via-sha1", "9.9")]
+
+    def test_manifest_fallback(self):
+        jar = _mk_jar({"META-INF/MANIFEST.MF": MANIFEST})
+        pkgs = parse_jar(jar, "whatever.jar", client=None)
+        assert [(p.name, p.version) for p in pkgs] == \
+            [("com.vendor:cool-lib", "4.5.6")]
+
+    def test_filename_with_groupid_heuristic(self, tmp_path):
+        jar = _mk_jar({"x.class": b"zz"})
+        db = JavaDB.create(str(tmp_path / "j.sqlite"))
+        db.import_entries([{"groupId": "org.heuristic", "artifactId": "neat",
+                            "version": "2.0", "sha1": "9" * 40}])
+        pkgs = parse_jar(jar, "neat-2.0.jar", client=db)
+        db.close()
+        assert [(p.name, p.version) for p in pkgs] == \
+            [("org.heuristic:neat", "2.0")]
+
+    def test_filename_fallback_no_db(self):
+        jar = _mk_jar({"x.class": b"zz"})
+        pkgs = parse_jar(jar, "plain-3.1.4.jar", client=None)
+        assert [(p.name, p.version) for p in pkgs] == [("plain", "3.1.4")]
+
+    def test_inner_jar_recursion(self):
+        inner = _mk_jar({
+            "META-INF/maven/org.dep/inner/pom.properties":
+                b"groupId=org.dep\nartifactId=inner\nversion=0.1\n",
+        })
+        outer = _mk_jar({
+            "META-INF/maven/org.app/fat/pom.properties":
+                b"groupId=org.app\nartifactId=fat\nversion=1.0\n",
+            "BOOT-INF/lib/inner-0.1.jar": inner,
+        })
+        pkgs = parse_jar(outer, "fat-1.0.jar", client=None)
+        names = {(p.name, p.version) for p in pkgs}
+        assert ("org.app:fat", "1.0") in names
+        assert ("org.dep:inner", "0.1") in names
+
+    def test_cli_import_java(self, tmp_path, capsys):
+        from trivy_tpu.cli.main import main
+
+        dump = tmp_path / "java.jsonl"
+        dump.write_text(json.dumps({
+            "groupId": "g", "artifactId": "a", "version": "1", "sha1": "3" * 40,
+        }) + "\n")
+        rc = main(["db", "import-java", str(dump),
+                   "--cache-dir", str(tmp_path / "cache")])
+        assert rc == 0
+        db = JavaDB(default_path(str(tmp_path / "cache")))
+        assert db.search_by_sha1("3" * 40) == GAV("g", "a", "1")
+        db.close()

@@ -162,10 +162,19 @@ def build_parser() -> argparse.ArgumentParser:
     _add_global_flags(p)
     dbsub = p.add_subparsers(dest="db_command")
     pi = dbsub.add_parser("import", help="import advisories from a JSON dump", allow_abbrev=False)
+    _add_global_flags(pi)
     pi.add_argument("source")
     pi.add_argument("--db-path", default=None)
     ps = dbsub.add_parser("stats", help="show DB statistics", allow_abbrev=False)
+    _add_global_flags(ps)
     ps.add_argument("--db-path", default=None)
+    pj = dbsub.add_parser(
+        "import-java",
+        help="import a java sha1->GAV dump (JSONL: "
+             '{"groupId","artifactId","version","sha1"} per line)',
+        allow_abbrev=False)
+    _add_global_flags(pj)
+    pj.add_argument("source")
 
     p = sub.add_parser("registry", help="registry authentication",
                        allow_abbrev=False)

@@ -85,6 +85,13 @@ def run_scan(args) -> int:
 
     secret_analyzer.USE_DEVICE = not getattr(args, "no_tpu", False)
 
+    # jar sha1->GAV lookups use the java DB when it has been imported
+    # (reference pkg/javadb updater singleton)
+    from trivy_tpu.db import javadb
+
+    jdb_path = javadb.default_path(args.cache_dir)
+    javadb.configure(jdb_path if os.path.exists(jdb_path) else None)
+
     # --compliance: the spec decides which scanners run and the report
     # becomes a control-check report (reference artifact/run.go:
     # ComplianceSpec.Scanners override + compliance/report.Write)
@@ -431,7 +438,22 @@ def run_db(args) -> int:
 
         print(_json.dumps(db.stats(), indent=2))
         return 0
-    raise FatalError("usage: trivy-tpu db {import,stats}")
+    if args.db_command == "import-java":
+        import gzip
+        import json as _json
+
+        from trivy_tpu.db import javadb
+
+        jdb = javadb.JavaDB.create(javadb.default_path(args.cache_dir))
+        opener = gzip.open if args.source.endswith(".gz") else open
+        with opener(args.source, "rb") as f:
+            entries = (_json.loads(line) for line in f if line.strip())
+            n = jdb.import_entries(entries)
+        jdb.write_metadata()
+        jdb.close()
+        _log.info("imported java DB", entries=n)
+        return 0
+    raise FatalError("usage: trivy-tpu db {import,import-java,stats}")
 
 
 def _import_json(path: str):

@@ -111,59 +111,105 @@ def parse_gemspec(content: bytes) -> Package | None:
 # ------------------------------------------------------------ java
 
 
-def parse_jar(content: bytes, path: str = "") -> list[Package]:
-    """JAR/WAR/EAR: pom.properties (groupId/artifactId/version) preferred,
-    MANIFEST.MF Implementation-* as fallback, filename last
-    (reference pkg/dependency/parser/java/jar)."""
+_JAR_FILENAME_RX = re.compile(r"(?P<name>.+?)-(?P<ver>\d[\w.]*)\.[jwe]ar$")
+
+
+def _parse_jar_filename(path: str) -> tuple[str, str]:
+    """name-1.2.3.jar -> (artifactId, version)."""
+    m = _JAR_FILENAME_RX.match(path.rsplit("/", 1)[-1])
+    return (m.group("name"), m.group("ver")) if m else ("", "")
+
+
+def parse_jar(content: bytes, path: str = "", client=None,
+              _depth: int = 0) -> list[Package]:
+    """JAR/WAR/EAR identification (reference
+    pkg/dependency/parser/java/jar/parse.go:120-260):
+    pom.properties preferred; inner jars recursed; then javadb sha1
+    lookup; MANIFEST.MF Implementation-*; javadb artifactId->groupId
+    heuristic; filename last.  `client` is a db.javadb.JavaDB (the
+    process-wide one is used when None)."""
+    import hashlib
     import io
     import zipfile
+
+    if client is None:
+        from trivy_tpu.db.javadb import client as _javadb_client
+
+        client = _javadb_client()
 
     out: list[Package] = []
     try:
         zf = zipfile.ZipFile(io.BytesIO(content))
     except zipfile.BadZipFile:
         return []
+    file_aid, file_ver = _parse_jar_filename(path)
+    found_own_pom = False
+    manifest_fields: dict[str, str] = {}
     with zf:
-        pom_props = [n for n in zf.namelist()
-                     if n.endswith("pom.properties")]
-        for name in pom_props:
-            try:
-                props = dict(
-                    line.split("=", 1)
-                    for line in zf.read(name).decode("utf-8", "replace").splitlines()
-                    if "=" in line and not line.startswith("#")
-                )
-            except Exception:
-                continue
-            gid = props.get("groupId", "").strip()
-            aid = props.get("artifactId", "").strip()
-            ver = props.get("version", "").strip()
-            if gid and aid and ver:
-                out.append(_mk(f"{gid}:{aid}", ver, file_path=path))
-        if not out:
-            try:
-                manifest = zf.read("META-INF/MANIFEST.MF").decode("utf-8", "replace")
-                fields = {}
-                for line in manifest.splitlines():
+        for name in zf.namelist():
+            base = name.rsplit("/", 1)[-1]
+            if base == "pom.properties":
+                try:
+                    props = dict(
+                        line.split("=", 1)
+                        for line in
+                        zf.read(name).decode("utf-8", "replace").splitlines()
+                        if "=" in line and not line.startswith("#")
+                    )
+                except Exception:
+                    continue
+                gid = props.get("groupId", "").strip()
+                aid = props.get("artifactId", "").strip()
+                ver = props.get("version", "").strip()
+                if gid and aid and ver:
+                    out.append(_mk(f"{gid}:{aid}", ver, file_path=path))
+                    if aid == file_aid and ver == file_ver:
+                        found_own_pom = True
+            elif base == "MANIFEST.MF":
+                text = zf.read(name).decode("utf-8", "replace")
+                for line in text.splitlines():
                     if ":" in line:
                         k, _, v = line.partition(":")
-                        fields[k.strip()] = v.strip()
-                gid = fields.get("Implementation-Vendor-Id") or fields.get(
-                    "Bundle-SymbolicName", "").split(";")[0]
-                aid = fields.get("Implementation-Title") or ""
-                ver = fields.get("Implementation-Version") or fields.get(
-                    "Bundle-Version", "")
-                if aid and ver:
-                    name = f"{gid}:{aid}" if gid and ":" not in aid else aid
-                    out.append(_mk(name, ver, file_path=path))
-            except KeyError:
-                pass
-    if not out and path:
-        # filename fallback: name-1.2.3.jar
-        m = re.match(r"(?P<name>.+?)-(?P<ver>\d[\w.]*)\.[jwe]ar$",
-                     path.rsplit("/", 1)[-1])
-        if m:
-            out.append(_mk(m.group("name"), m.group("ver"), file_path=path))
+                        manifest_fields[k.strip()] = v.strip()
+            elif base.endswith((".jar", ".war", ".ear")) and _depth < 3:
+                # fat jars bundle their dependencies (parse.go:184-196)
+                try:
+                    inner = zf.read(name)
+                except Exception:
+                    continue
+                out.extend(parse_jar(inner, f"{path}/{name}" if path else name,
+                                     client=client, _depth=_depth + 1))
+    if found_own_pom or (out and not file_aid):
+        return out
+
+    # manifest identification (parse.go:100-118)
+    if manifest_fields:
+        gid = manifest_fields.get("Implementation-Vendor-Id") or \
+            manifest_fields.get("Bundle-SymbolicName", "").split(";")[0]
+        aid = manifest_fields.get("Implementation-Title") or ""
+        ver = manifest_fields.get("Implementation-Version") or \
+            manifest_fields.get("Bundle-Version", "")
+        if aid and ver:
+            name = f"{gid}:{aid}" if gid and ":" not in aid else aid
+            out.append(_mk(name, ver, file_path=path))
+            return out
+
+    # sha1 lookup against the java DB (parse.go:123-127, :235-249)
+    if client is not None:
+        sha1 = hashlib.sha1(content).hexdigest()
+        gav = client.search_by_sha1(sha1)
+        if gav is not None:
+            out.append(_mk(gav.name, gav.version, file_path=path))
+            return out
+
+    if file_aid and file_ver:
+        # groupId via the (artifactId, version) heuristic (parse.go:139)
+        name = file_aid
+        if client is not None:
+            gid = client.search_by_artifact_id(file_aid, file_ver)
+            if gid:
+                name = f"{gid}:{file_aid}"
+        out.append(_mk(name, file_ver, file_path=path))
     return out
 
 
