@@ -1,0 +1,205 @@
+"""Redis cache backend tests against an in-process fake RESP server
+(the reference spins a real redis via testcontainers,
+integration/client_server_test.go:548; here a stdlib fake suffices)."""
+
+import socket
+import socketserver
+import threading
+
+import pytest
+
+from trivy_tpu.cache.redis import (
+    RedisCache,
+    RedisError,
+    RespClient,
+    parse_redis_url,
+)
+
+
+class _FakeRedisHandler(socketserver.StreamRequestHandler):
+    store: dict = {}
+    set_log: list = []
+    auth: str = ""
+
+    def handle(self):
+        authed = not self.auth
+        while True:
+            try:
+                args = self._read_command()
+            except (ConnectionError, ValueError):
+                return
+            if args is None:
+                return
+            cmd = args[0].decode().upper()
+            if cmd == "AUTH":
+                if args[-1].decode() == self.auth:
+                    authed = True
+                    self._ok()
+                else:
+                    self._err("WRONGPASS invalid password")
+                continue
+            if not authed:
+                self._err("NOAUTH Authentication required.")
+                continue
+            getattr(self, f"_cmd_{cmd.lower()}", self._unknown)(args)
+
+    def _read_command(self):
+        line = self.rfile.readline()
+        if not line:
+            return None
+        if not line.startswith(b"*"):
+            raise ValueError(line)
+        n = int(line[1:].strip())
+        args = []
+        for _ in range(n):
+            hdr = self.rfile.readline()
+            size = int(hdr[1:].strip())
+            args.append(self.rfile.read(size))
+            self.rfile.read(2)
+        return args
+
+    def _ok(self):
+        self.wfile.write(b"+OK\r\n")
+
+    def _err(self, msg):
+        self.wfile.write(f"-{msg}\r\n".encode())
+
+    def _int(self, n):
+        self.wfile.write(f":{n}\r\n".encode())
+
+    def _bulk(self, data):
+        if data is None:
+            self.wfile.write(b"$-1\r\n")
+        else:
+            self.wfile.write(b"$%d\r\n%s\r\n" % (len(data), data))
+
+    def _unknown(self, args):
+        self._err(f"ERR unknown command {args[0].decode()!r}")
+
+    def _cmd_ping(self, args):
+        self.wfile.write(b"+PONG\r\n")
+
+    def _cmd_select(self, args):
+        self._ok()
+
+    def _cmd_set(self, args):
+        self.store[args[1]] = args[2]
+        self.set_log.append(args[1])
+        self._ok()
+
+    def _cmd_get(self, args):
+        self._bulk(self.store.get(args[1]))
+
+    def _cmd_exists(self, args):
+        self._int(sum(1 for k in args[1:] if k in self.store))
+
+    def _cmd_del(self, args):
+        n = 0
+        for k in args[1:]:
+            if self.store.pop(k, None) is not None:
+                n += 1
+        self._int(n)
+
+    def _cmd_scan(self, args):
+        pattern = args[3].decode()
+        prefix = pattern.rstrip("*").encode()
+        keys = [k for k in self.store if k.startswith(prefix)]
+        self.wfile.write(b"*2\r\n$1\r\n0\r\n")
+        self.wfile.write(f"*{len(keys)}\r\n".encode())
+        for k in keys:
+            self._bulk(k)
+
+
+@pytest.fixture
+def fake_redis():
+    _FakeRedisHandler.store = {}
+    _FakeRedisHandler.set_log = []
+    _FakeRedisHandler.auth = ""
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                          _FakeRedisHandler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"redis://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestParseURL:
+    def test_basic(self):
+        assert parse_redis_url("redis://h:6380/2") == {
+            "host": "h", "port": 6380, "username": "", "password": "",
+            "db": 2, "tls": False}
+
+    def test_auth_and_tls(self):
+        got = parse_redis_url("rediss://user:pw@h:7000")
+        assert got["username"] == "user" and got["password"] == "pw"
+        assert got["tls"] is True
+
+    def test_bad_scheme(self):
+        with pytest.raises(RedisError):
+            parse_redis_url("http://h")
+
+
+class TestRedisCache:
+    def test_round_trip(self, fake_redis):
+        cache = RedisCache(fake_redis)
+        cache.put_artifact("sha256:a1", {"architecture": "amd64"})
+        cache.put_blob("sha256:b1", {"os": {"family": "alpine"}})
+
+        missing_artifact, missing = cache.missing_blobs(
+            "sha256:a1", ["sha256:b1", "sha256:b2"])
+        assert missing_artifact is False
+        assert missing == ["sha256:b2"]
+
+        assert cache.get_artifact("sha256:a1")["architecture"] == "amd64"
+        assert cache.get_blob("sha256:b1")["os"]["family"] == "alpine"
+        assert cache.get_blob("sha256:nope") == {}
+
+        cache.delete_blobs(["sha256:b1"])
+        _, missing = cache.missing_blobs("sha256:a1", ["sha256:b1"])
+        assert missing == ["sha256:b1"]
+        cache.close()
+
+    def test_keys_use_fanal_prefix(self, fake_redis):
+        cache = RedisCache(fake_redis)
+        cache.put_blob("sha256:xyz", {"k": 1})
+        assert b"fanal::blob::sha256:xyz" in _FakeRedisHandler.store
+        cache.close()
+
+    def test_clear_only_fanal_keys(self, fake_redis):
+        cache = RedisCache(fake_redis)
+        cache.put_blob("sha256:b", {"k": 1})
+        _FakeRedisHandler.store[b"other::key"] = b"keep"
+        cache.clear()
+        assert b"other::key" in _FakeRedisHandler.store
+        assert all(not k.startswith(b"fanal::")
+                   for k in _FakeRedisHandler.store)
+        cache.close()
+
+    def test_auth(self, fake_redis):
+        _FakeRedisHandler.auth = "sekret"
+        host = fake_redis[len("redis://"):]
+        with pytest.raises(RedisError):
+            RedisCache(f"redis://{host}")
+        cache = RedisCache(f"redis://:sekret@{host}")
+        cache.put_blob("sha256:b", {"k": 1})
+        assert cache.get_blob("sha256:b") == {"k": 1}
+        cache.close()
+
+    def test_scan_uses_redis_cache(self, fake_redis, tmp_path):
+        """End-to-end: fs scan with --cache-backend redis:// populates
+        the shared cache."""
+        from trivy_tpu.cli.main import main
+
+        (tmp_path / "app").mkdir()
+        (tmp_path / "app" / "requirements.txt").write_text("flask==1.0\n")
+        rc = main(["filesystem", str(tmp_path), "--format", "json",
+                   "--cache-backend", fake_redis, "--scanners", "vuln",
+                   "--cache-dir", str(tmp_path / "cache"), "--quiet",
+                   "--output", str(tmp_path / "out.json")])
+        assert rc == 0
+        # fs artifacts clean their random-keyed blob after the scan
+        # (reference artifact/local/fs.go), so assert on writes seen
+        assert any(k.startswith(b"fanal::blob::")
+                   for k in _FakeRedisHandler.set_log)

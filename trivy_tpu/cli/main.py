@@ -58,6 +58,13 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--server", default=None,
                    help="scan server URL (client mode)")
     p.add_argument("--token", default=None, help="server auth token")
+    p.add_argument("--cache-backend", default="fs",
+                   help="cache backend: fs, memory, or redis://host:port")
+    p.add_argument("--redis-ca", default="", help="redis CA cert path")
+    p.add_argument("--redis-cert", default="", help="redis client cert path")
+    p.add_argument("--redis-key", default="", help="redis client key path")
+    p.add_argument("--redis-tls", action="store_true",
+                   help="enable TLS for the redis cache backend")
     p.add_argument("--skip-files", action="append", default=[])
     p.add_argument("--skip-dirs", action="append", default=[])
     p.add_argument("--vex", action="append", default=[],

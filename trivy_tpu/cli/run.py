@@ -105,7 +105,27 @@ def run_scan(args) -> int:
             raise FatalError(f"compliance spec: {e}")
         args.scanners = ",".join(compliance_spec.scanners())
 
-    cache = FSCache(args.cache_dir)
+    backend = getattr(args, "cache_backend", "fs") or "fs"
+    if backend.startswith(("redis://", "rediss://")):
+        from trivy_tpu.cache.redis import RedisCache, RedisError
+
+        try:
+            cache = RedisCache(
+                backend, ca_cert=getattr(args, "redis_ca", ""),
+                cert=getattr(args, "redis_cert", ""),
+                key=getattr(args, "redis_key", ""),
+                tls=getattr(args, "redis_tls", False))
+        except (OSError, RedisError) as e:
+            raise FatalError(f"redis cache backend: {e}")
+    elif backend == "memory":
+        from trivy_tpu.cache.cache import MemoryCache
+
+        cache = MemoryCache()
+    elif backend == "fs":
+        cache = FSCache(args.cache_dir)
+    else:
+        raise FatalError(
+            f"unknown cache backend {backend!r} (fs, memory, redis://...)")
     artifact, driver = _select_scanner(args, cache)
     scanner = Scanner(driver, artifact)
     report = scanner.scan_artifact(make_scan_options(args))
