@@ -1,0 +1,216 @@
+"""Plugin (subprocess) and module (extension) system tests
+(reference pkg/plugin/*_test.go + pkg/module shapes)."""
+
+import io
+import json
+import os
+import zipfile
+
+import pytest
+
+from trivy_tpu.module import ModuleManager
+from trivy_tpu.plugin import PluginError, PluginManager
+
+MANIFEST = """\
+name: echo-plugin
+version: "0.1.0"
+summary: prints its arguments
+platforms:
+  - selector:
+      os: linux
+    uri: ./echo.sh
+    bin: ./echo.sh
+"""
+
+SCRIPT = "#!/bin/sh\necho plugin-ran \"$@\" > \"$PLUGIN_OUT\"\n"
+
+
+def _mk_plugin_dir(tmp_path):
+    src = tmp_path / "src-plugin"
+    src.mkdir()
+    (src / "plugin.yaml").write_text(MANIFEST)
+    (src / "echo.sh").write_text(SCRIPT)
+    os.chmod(src / "echo.sh", 0o755)
+    return str(src)
+
+
+class TestPluginManager:
+    def test_install_from_dir_and_run(self, tmp_path):
+        mgr = PluginManager(str(tmp_path / "cache"))
+        p = mgr.install(_mk_plugin_dir(tmp_path))
+        assert p.name == "echo-plugin"
+        assert [pl.name for pl in mgr.list()] == ["echo-plugin"]
+
+        out = tmp_path / "out.txt"
+        os.environ["PLUGIN_OUT"] = str(out)
+        try:
+            rc = mgr.run("echo-plugin", ["hello", "world"])
+        finally:
+            del os.environ["PLUGIN_OUT"]
+        assert rc == 0
+        assert out.read_text().strip() == "plugin-ran hello world"
+
+    def test_install_from_zip(self, tmp_path):
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            zf.writestr("plugin.yaml", MANIFEST)
+            zf.writestr("echo.sh", SCRIPT)
+        zpath = tmp_path / "plugin.zip"
+        zpath.write_bytes(buf.getvalue())
+        mgr = PluginManager(str(tmp_path / "cache"))
+        p = mgr.install(str(zpath))
+        assert p.name == "echo-plugin"
+        assert mgr.get("echo-plugin") is not None
+
+    def test_zip_slip_rejected(self, tmp_path):
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            zf.writestr("../evil.txt", "boom")
+        zpath = tmp_path / "evil.zip"
+        zpath.write_bytes(buf.getvalue())
+        mgr = PluginManager(str(tmp_path / "cache"))
+        with pytest.raises(PluginError, match="unsafe path"):
+            mgr.install(str(zpath))
+
+    def test_uninstall(self, tmp_path):
+        mgr = PluginManager(str(tmp_path / "cache"))
+        mgr.install(_mk_plugin_dir(tmp_path))
+        assert mgr.uninstall("echo-plugin") is True
+        assert mgr.uninstall("echo-plugin") is False
+        assert mgr.list() == []
+
+    def test_platform_selector_mismatch(self, tmp_path):
+        src = tmp_path / "p"
+        src.mkdir()
+        (src / "plugin.yaml").write_text(
+            "name: winonly\nversion: '1'\nplatforms:\n"
+            "  - selector: {os: windows}\n    uri: ./x.exe\n    bin: ./x.exe\n")
+        mgr = PluginManager(str(tmp_path / "cache"))
+        mgr.install(str(src))
+        with pytest.raises(PluginError, match="does not support"):
+            mgr.run("winonly", [])
+
+    def test_cli_plugin_as_subcommand(self, tmp_path, monkeypatch):
+        from trivy_tpu.cli.main import main
+
+        cache = tmp_path / "cache"
+        monkeypatch.setenv("TRIVY_TPU_CACHE_DIR", str(cache))
+        mgr = PluginManager(str(cache))
+        mgr.install(_mk_plugin_dir(tmp_path))
+        out = tmp_path / "out.txt"
+        monkeypatch.setenv("PLUGIN_OUT", str(out))
+        rc = main(["echo-plugin", "via-cli"])
+        assert rc == 0
+        assert "plugin-ran via-cli" in out.read_text()
+
+
+GOOD_MODULE = '''\
+name = "spring4shell"
+version = 1
+
+def required(path):
+    return path.endswith(".jar")
+
+def analyze(path, content):
+    if b"JndiLookup" in content:
+        return {"vulnerable": True, "path": path}
+    return None
+
+def post_scan(results, options):
+    for r in results:
+        for v in getattr(r, "vulnerabilities", []):
+            if v.vulnerability_id == "CVE-0000-0001":
+                v.severity_source = "module"
+    return results
+'''
+
+
+class TestModuleManager:
+    def test_load_registers_and_unload_removes(self, tmp_path):
+        from trivy_tpu.fanal.analyzer import AnalyzerGroup
+
+        mdir = tmp_path / "modules"
+        mdir.mkdir()
+        (mdir / "spring4shell.py").write_text(GOOD_MODULE)
+        mgr = ModuleManager(str(mdir))
+        assert mgr.load() == 1
+        try:
+            group = AnalyzerGroup.build()
+            assert any(a.type == "module:spring4shell"
+                       for a in group.analyzers)
+        finally:
+            mgr.unload()
+        group = AnalyzerGroup.build()
+        assert not any(a.type.startswith("module:") for a in group.analyzers)
+
+    def test_module_analyze_emits_custom_resource(self, tmp_path):
+        from trivy_tpu.fanal.analyzer import AnalysisInput
+
+        mdir = tmp_path / "modules"
+        mdir.mkdir()
+        (mdir / "spring4shell.py").write_text(GOOD_MODULE)
+        mgr = ModuleManager(str(mdir))
+        mgr.load()
+        try:
+            analyzer = mgr._analyzers[0]
+            assert analyzer.required("lib/log4j.jar")
+            assert not analyzer.required("readme.md")
+            res = analyzer.analyze(
+                AnalysisInput("lib/log4j.jar", b"...JndiLookup..."))
+            assert res.custom_resources[0].data == {
+                "vulnerable": True, "path": "lib/log4j.jar"}
+            assert analyzer.analyze(
+                AnalysisInput("lib/ok.jar", b"clean")) is None
+        finally:
+            mgr.unload()
+
+    def test_broken_module_skipped(self, tmp_path):
+        mdir = tmp_path / "modules"
+        mdir.mkdir()
+        (mdir / "broken.py").write_text("this is ( not python")
+        (mdir / "good.py").write_text(GOOD_MODULE)
+        mgr = ModuleManager(str(mdir))
+        assert mgr.load() == 1
+        mgr.unload()
+
+    def test_post_scan_hook_runs_in_scan(self, tmp_path, capsys):
+        """End-to-end: a module post_scan hook that injects a custom
+        result is visible in the CLI report."""
+        from trivy_tpu.cli.main import main
+
+        mdir = tmp_path / "modules"
+        mdir.mkdir()
+        (mdir / "injector.py").write_text('''\
+name = "injector"
+version = 1
+
+def post_scan(results, options):
+    from trivy_tpu.types.report import Result
+    results.append(Result(target="module-injected", result_class="custom"))
+    return results
+''')
+        root = tmp_path / "scan-root"
+        (root / "app").mkdir(parents=True)
+        (root / "app" / "requirements.txt").write_text("flask==1.0\n")
+        rc = main(["filesystem", str(root), "--format", "json",
+                   "--cache-dir", str(tmp_path / "cache"),
+                   "--module-dir", str(mdir),
+                   "--scanners", "vuln", "--quiet", "--list-all-pkgs"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        targets = {r["Target"] for r in doc["Results"]}
+        assert "module-injected" in targets
+
+    def test_cli_module_install_list_uninstall(self, tmp_path, capsys):
+        from trivy_tpu.cli.main import main
+
+        src = tmp_path / "mymod.py"
+        src.write_text(GOOD_MODULE)
+        cache = str(tmp_path / "cache")
+        assert main(["module", "install", str(src),
+                     "--cache-dir", cache]) == 0
+        capsys.readouterr()
+        assert main(["module", "list", "--cache-dir", cache]) == 0
+        assert "mymod.py" in capsys.readouterr().out
+        assert main(["module", "uninstall", "mymod",
+                     "--cache-dir", cache]) == 0

@@ -67,6 +67,9 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
                    help="enable TLS for the redis cache backend")
     p.add_argument("--skip-files", action="append", default=[])
     p.add_argument("--skip-dirs", action="append", default=[])
+    p.add_argument("--module-dir", default=None,
+                   help="directory of scan-module extensions "
+                        "(default <cache>/modules)")
     p.add_argument("--vex", action="append", default=[],
                    help="VEX file (OpenVEX / CycloneDX VEX / CSAF); "
                         "repeatable")
@@ -183,6 +186,45 @@ def build_parser() -> argparse.ArgumentParser:
     _add_global_flags(pj)
     pj.add_argument("source")
 
+    p = sub.add_parser("plugin", help="manage plugins", allow_abbrev=False)
+    _add_global_flags(p)
+    plsub = p.add_subparsers(dest="plugin_command")
+    pp = plsub.add_parser("install", help="install a plugin from a local "
+                          "dir, zip, or URL", allow_abbrev=False)
+    _add_global_flags(pp)
+    pp.add_argument("source")
+    pp = plsub.add_parser("uninstall", help="remove an installed plugin",
+                          allow_abbrev=False)
+    _add_global_flags(pp)
+    pp.add_argument("name")
+    pp = plsub.add_parser("list", help="list installed plugins",
+                          allow_abbrev=False)
+    _add_global_flags(pp)
+    pp = plsub.add_parser("info", help="show plugin details",
+                          allow_abbrev=False)
+    _add_global_flags(pp)
+    pp.add_argument("name")
+    pp = plsub.add_parser("run", help="run a plugin", allow_abbrev=False)
+    _add_global_flags(pp)
+    pp.add_argument("name")
+    pp.add_argument("plugin_args", nargs=argparse.REMAINDER)
+
+    p = sub.add_parser("module", help="manage scan modules",
+                       allow_abbrev=False)
+    _add_global_flags(p)
+    mosub = p.add_subparsers(dest="module_command")
+    mm = mosub.add_parser("install", help="install a module (.py file)",
+                          allow_abbrev=False)
+    _add_global_flags(mm)
+    mm.add_argument("source")
+    mm = mosub.add_parser("uninstall", help="remove a module",
+                          allow_abbrev=False)
+    _add_global_flags(mm)
+    mm.add_argument("name")
+    mm = mosub.add_parser("list", help="list installed modules",
+                          allow_abbrev=False)
+    _add_global_flags(mm)
+
     p = sub.add_parser("registry", help="registry authentication",
                        allow_abbrev=False)
     _add_global_flags(p)
@@ -216,6 +258,22 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     parser = build_parser()
+
+    # `trivy-tpu <plugin-name> args…` runs an installed plugin
+    # (reference pkg/plugin/plugin.go:101 + cmd/trivy plugin-mode)
+    known = {"image", "filesystem", "fs", "rootfs", "repository", "repo",
+             "sbom", "vm", "kubernetes", "k8s", "convert", "server", "db",
+             "clean", "config", "version", "registry", "plugin", "module"}
+    if argv and not argv[0].startswith("-") and argv[0] not in known:
+        from trivy_tpu.plugin import PluginManager
+
+        cache_dir = os.environ.get(
+            "TRIVY_TPU_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache", "trivy-tpu"))
+        mgr = PluginManager(cache_dir)
+        if mgr.get(argv[0]) is not None:
+            return mgr.run(argv[0], argv[1:])
+
     args = parser.parse_args(argv)
 
     if getattr(args, "generate_default_config", False):
@@ -265,6 +323,10 @@ def main(argv: list[str] | None = None) -> int:
             return run.run_clean(args)
         if args.command == "registry":
             return run.run_registry(args)
+        if args.command == "plugin":
+            return run.run_plugin(args)
+        if args.command == "module":
+            return run.run_module(args)
     except run.FatalError as e:
         log.logger().error(str(e))
         return 1

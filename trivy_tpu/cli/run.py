@@ -105,6 +105,27 @@ def run_scan(args) -> int:
             raise FatalError(f"compliance spec: {e}")
         args.scanners = ",".join(compliance_spec.scanners())
 
+    # module extensions: custom analyzers + post-scan hooks
+    # (reference pkg/module manager wired into the runner)
+    from trivy_tpu.module import ModuleManager
+
+    mod_mgr = ModuleManager(
+        getattr(args, "module_dir", None)
+        or os.path.join(args.cache_dir, "modules"))
+    mod_mgr.load()
+    try:
+        return _run_scan_core(args, compliance_spec)
+    finally:
+        mod_mgr.unload()
+
+
+def _run_scan_core(args, compliance_spec) -> int:
+    from trivy_tpu.cache.cache import FSCache
+    from trivy_tpu.result.filter import filter_report
+    from trivy_tpu.result.ignore import load_ignore_file
+    from trivy_tpu.report.writer import write_report
+    from trivy_tpu.scanner.scan import Scanner
+
     backend = getattr(args, "cache_backend", "fs") or "fs"
     if backend.startswith(("redis://", "rediss://")):
         from trivy_tpu.cache.redis import RedisCache, RedisError
@@ -553,3 +574,68 @@ def run_registry(args) -> int:
         _log.info("logged out", registry=args.server)
         return 0
     raise FatalError("usage: registry {login|logout} <server>")
+
+
+def run_plugin(args) -> int:
+    """`plugin install|uninstall|list|info|run` (reference pkg/plugin)."""
+    from trivy_tpu.plugin import PluginError, PluginManager
+
+    mgr = PluginManager(args.cache_dir)
+    sub = getattr(args, "plugin_command", None)
+    try:
+        if sub == "install":
+            p = mgr.install(args.source)
+            print(f"installed {p.name} {p.version}".rstrip())
+            return 0
+        if sub == "uninstall":
+            if not mgr.uninstall(args.name):
+                raise FatalError(f"plugin {args.name!r} is not installed")
+            return 0
+        if sub == "list":
+            for p in mgr.list():
+                print(f"{p.name}\t{p.version}\t{p.summary}")
+            return 0
+        if sub == "info":
+            p = mgr.get(args.name)
+            if p is None:
+                raise FatalError(f"plugin {args.name!r} is not installed")
+            print(f"name: {p.name}\nversion: {p.version}\n"
+                  f"summary: {p.summary}\ndescription: {p.description}")
+            return 0
+        if sub == "run":
+            return mgr.run(args.name, list(args.plugin_args))
+    except PluginError as e:
+        raise FatalError(str(e))
+    raise FatalError("usage: plugin {install|uninstall|list|info|run}")
+
+
+def run_module(args) -> int:
+    """`module install|uninstall|list` (reference pkg/module manager):
+    modules are .py files under <cache>/modules loaded at scan time."""
+    import shutil
+
+    mod_dir = os.path.join(args.cache_dir, "modules")
+    sub = getattr(args, "module_command", None)
+    if sub == "install":
+        if not args.source.endswith(".py") or not os.path.exists(args.source):
+            raise FatalError(f"module source must be an existing .py file: "
+                             f"{args.source}")
+        os.makedirs(mod_dir, exist_ok=True)
+        dest = os.path.join(mod_dir, os.path.basename(args.source))
+        shutil.copyfile(args.source, dest)
+        _log.info("installed module", path=dest)
+        return 0
+    if sub == "uninstall":
+        name = args.name if args.name.endswith(".py") else args.name + ".py"
+        path = os.path.join(mod_dir, name)
+        if not os.path.exists(path):
+            raise FatalError(f"module {args.name!r} is not installed")
+        os.unlink(path)
+        return 0
+    if sub == "list":
+        if os.path.isdir(mod_dir):
+            for f in sorted(os.listdir(mod_dir)):
+                if f.endswith(".py"):
+                    print(f)
+        return 0
+    raise FatalError("usage: module {install|uninstall|list}")

@@ -131,6 +131,13 @@ def register_post(a) -> PostAnalyzer:
     return a
 
 
+def unregister(a) -> None:
+    """Remove a dynamically registered analyzer (module system)."""
+    for reg in (_ANALYZERS, _POST_ANALYZERS):
+        if a in reg:
+            reg.remove(a)
+
+
 # analyzer type groups (reference pkg/fanal/analyzer/const.go:150-258)
 TYPE_OSES = {
     "os-release", "alpine", "amazon", "debian", "photon", "redhat-base",

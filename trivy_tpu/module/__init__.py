@@ -1,0 +1,1 @@
+from trivy_tpu.module.manager import ModuleManager  # noqa: F401

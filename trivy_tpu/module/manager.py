@@ -1,0 +1,144 @@
+"""Module extension system (reference pkg/module: wazero-hosted WASM
+custom analyzers/post-scanners, module.go:15-17, api/).
+
+The reference embeds a WASM runtime because Go cannot hot-load Go; a
+Python host hot-loads Python, so modules here are plain .py files in
+<cache>/modules (or --module-dir).  The ABI mirrors the reference's
+(api/module.go): a module exposes
+
+    name = "happy-module"          # module identity
+    version = 1                    # bumps invalidate analysis caches
+
+    def required(path) -> bool          # which files it wants (optional)
+    def analyze(path, content) -> dict | None
+        # -> custom-resource payload attached to the blob (optional)
+    def post_scan(results, options) -> results
+        # -> mutate/extend scan results (optional)
+
+Modules with `analyze` register a custom analyzer (type
+"module:<name>"); modules with `post_scan` register a post-scan hook —
+the same two registries the reference wires modules into
+(module.go RegisterPostScanner + analyzer registration).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+from trivy_tpu.fanal.analyzer import (
+    AnalysisResult,
+    Analyzer,
+    register,
+    unregister,
+)
+from trivy_tpu.log import logger
+from trivy_tpu.scanner import post
+from trivy_tpu.types.artifact import CustomResource
+
+_log = logger("module")
+
+
+class _ModuleAnalyzer(Analyzer):
+    """Wraps a module's analyze() as a fanal analyzer emitting
+    CustomResources (reference serialize.AnalysisResult custom)."""
+
+    def __init__(self, mod):
+        self.mod = mod
+        self.type = f"module:{mod.name}"
+        self.version = getattr(mod, "version", 1)
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        fn = getattr(self.mod, "required", None)
+        if fn is None:
+            return False
+        try:
+            return bool(fn(path))
+        except Exception as e:
+            _log.warn("module required() failed", module=self.mod.name,
+                      err=str(e))
+            return False
+
+    def analyze(self, inp):
+        try:
+            data = self.mod.analyze(inp.path, inp.read())
+        except Exception as e:
+            _log.warn("module analyze() failed", module=self.mod.name,
+                      path=inp.path, err=str(e))
+            return None
+        if data is None:
+            return None
+        res = AnalysisResult()
+        res.custom_resources = [CustomResource(
+            type=self.type, file_path=inp.path, data=data)]
+        return res
+
+
+class ModuleManager:
+    """Loads modules and registers their hooks; unload() reverses both
+    (the reference keeps one wazero runtime per scan — here the
+    registries are process-global, so tests must unload)."""
+
+    def __init__(self, module_dir: str):
+        self.module_dir = module_dir
+        self.modules: list = []
+        self._analyzers: list[_ModuleAnalyzer] = []
+        self._hooks: list = []
+
+    def load(self) -> int:
+        if not os.path.isdir(self.module_dir):
+            return 0
+        for fname in sorted(os.listdir(self.module_dir)):
+            if not fname.endswith(".py") or fname.startswith("_"):
+                continue
+            path = os.path.join(self.module_dir, fname)
+            try:
+                mod = self._load_file(path)
+            except Exception as e:
+                _log.warn("module load failed", path=path, err=str(e))
+                continue
+            if not getattr(mod, "name", ""):
+                mod.name = os.path.splitext(fname)[0]
+            self.modules.append(mod)
+            if callable(getattr(mod, "analyze", None)):
+                analyzer = _ModuleAnalyzer(mod)
+                register(analyzer)
+                self._analyzers.append(analyzer)
+            if callable(getattr(mod, "post_scan", None)):
+                hook = self._wrap_post_scan(mod)
+                post.register_post_scanner(hook)
+                self._hooks.append(hook)
+            _log.info("loaded module", name=mod.name,
+                      version=getattr(mod, "version", 1))
+        return len(self.modules)
+
+    @staticmethod
+    def _load_file(path: str):
+        name = "trivy_tpu_module_" + \
+            os.path.splitext(os.path.basename(path))[0]
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    @staticmethod
+    def _wrap_post_scan(mod):
+        def hook(results, options):
+            try:
+                out = mod.post_scan(results, options)
+                return results if out is None else out
+            except Exception as e:
+                _log.warn("module post_scan() failed", module=mod.name,
+                          err=str(e))
+                return results
+        hook.__name__ = f"module:{mod.name}"
+        return hook
+
+    def unload(self) -> None:
+        for a in self._analyzers:
+            unregister(a)
+        for h in self._hooks:
+            post.unregister_post_scanner(h)
+        self._analyzers.clear()
+        self._hooks.clear()
+        self.modules.clear()

@@ -1,0 +1,5 @@
+from trivy_tpu.plugin.manager import (  # noqa: F401
+    Plugin,
+    PluginError,
+    PluginManager,
+)

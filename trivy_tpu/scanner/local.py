@@ -41,10 +41,15 @@ class LocalDriver:
         self.post_hooks = post_hooks or []
 
     def scan(self, target, artifact_key, blob_keys, options: ScanOptions):
+        from trivy_tpu.scanner import post
+
         detail = self._apply_layers(blob_keys)
         results = self._scan_detail(target, detail, options)
         for hook in self.post_hooks:
             results = hook(results, options)
+        # globally registered hooks (module extensions; reference
+        # pkg/scanner/local/scan.go:152 -> post/post_scan.go:35)
+        results = post.scan(results, options)
         return results, detail.os
 
     # ------------------------------------------------------------ layers
