@@ -1,0 +1,124 @@
+"""VM-image artifact (reference pkg/fanal/artifact/vm): open the disk
+(raw / partitioned / sparse VMDK), locate supported filesystems, walk
+their files through the analyzer pipeline as one pseudo-blob — same
+shape as the local-fs artifact but sourced from the guest filesystem.
+
+The reference also streams AMI/EBS snapshots via the AWS SDK
+(vm/{ami,ebs}.go); that source is network-gated and out of scope here —
+local image files cover the same analysis path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from trivy_tpu.artifact.base import ArtifactReference
+from trivy_tpu.fanal import analyzers  # noqa: F401  (registers analyzers)
+from trivy_tpu.fanal.analyzer import AnalysisInput, AnalysisResult, AnalyzerGroup
+from trivy_tpu.fanal.handlers import system_file_filter
+from trivy_tpu.fanal.vm.disk import DiskError, find_filesystems, open_disk
+from trivy_tpu.fanal.vm.ext4 import Ext4, Ext4Error
+from trivy_tpu.log import logger
+
+_log = logger("vm")
+
+MAX_FILE_SIZE = 256 * 1024 * 1024  # skip larger guest files
+
+
+class VMError(Exception):
+    pass
+
+
+class VMArtifact:
+    def __init__(
+        self,
+        target: str,
+        cache,
+        parallel: int = 5,
+        disabled_analyzers: set[str] | None = None,
+        secret_config: str | None = None,
+    ):
+        self.target = target
+        self.cache = cache
+        self.parallel = parallel
+        self.disabled = set(disabled_analyzers or set())
+        self.secret_config = secret_config
+
+    def _group(self) -> AnalyzerGroup:
+        group = AnalyzerGroup.build(disabled_types=self.disabled)
+        for a in group.analyzers + group.post_analyzers:
+            if a.type == "secret" and self.secret_config:
+                a.configure(self.secret_config)
+        return group
+
+    def inspect(self) -> ArtifactReference:
+        try:
+            fh = open_disk(self.target)
+        except DiskError as e:
+            raise VMError(str(e)) from e
+        except OSError as e:
+            raise VMError(f"cannot open VM image {self.target}: {e}") from e
+        try:
+            filesystems = find_filesystems(fh)
+            if not filesystems:
+                raise VMError(
+                    f"no supported filesystem found in {self.target} "
+                    "(ext4 is supported; xfs detection only)")
+            group = self._group()
+            result = AnalysisResult()
+            post_files: dict = {}
+            digest = hashlib.sha256()
+            for fstype, offset in filesystems:
+                if fstype != "ext4":
+                    _log.warn("unsupported guest filesystem skipped",
+                              fstype=fstype, offset=offset)
+                    continue
+                self._walk_ext4(fh, offset, group, result, post_files,
+                                digest)
+            group.post_analyze(result, post_files)
+            system_file_filter(result)
+        finally:
+            fh.close()
+
+        blob = result.to_blob()
+        blob_id = "sha256:" + digest.hexdigest()
+        self.cache.put_blob(blob_id, dataclasses.asdict(blob))
+        return ArtifactReference(
+            name=self.target,
+            type="vm",
+            id=blob_id,
+            blob_ids=[blob_id],
+        )
+
+    def _walk_ext4(self, fh, offset, group, result, post_files,
+                   digest) -> None:
+        try:
+            fs = Ext4(fh, offset)
+        except Ext4Error as e:
+            _log.warn("ext4 open failed", offset=offset, err=str(e))
+            return
+        n = 0
+        for path, inode in fs.walk():
+            if inode.size > MAX_FILE_SIZE:
+                _log.debug("guest file too large, skipped", path=path,
+                           size=inode.size)
+                continue
+            inp = AnalysisInput(
+                path=path, size=inode.size, mode=inode.mode,
+                open=lambda fs=fs, inode=inode: fs.read_file(inode),
+            )
+            group.analyze_file(result, inp, post_files)
+            if inp.content is not None:
+                digest.update(path.encode())
+                digest.update(inp.content)
+                if not any(inp.path in files
+                           for files in post_files.values()):
+                    inp.content = None
+            else:
+                digest.update(path.encode())
+            n += 1
+        _log.info("walked guest filesystem", offset=offset, files=n)
+
+    def clean(self, ref: ArtifactReference) -> None:
+        self.cache.delete_blobs(ref.blob_ids)

@@ -274,6 +274,14 @@ def _select_scanner(args, cache):
             username=getattr(args, "username", ""),
             password=getattr(args, "password", ""),
         ), driver
+    if cmd == "vm":
+        from trivy_tpu.artifact.vm import VMArtifact
+
+        return VMArtifact(
+            args.target, cache,
+            parallel=args.parallel,
+            disabled_analyzers=disabled,
+        ), driver
     raise FatalError(f"unsupported scan command {cmd!r}")
 
 
