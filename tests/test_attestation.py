@@ -1,0 +1,161 @@
+"""Attestation (DSSE/in-toto) + rekor client tests
+(reference pkg/attestation/attestation_test.go + pkg/rekor/client_test.go
+use httptest fake servers the same way)."""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from trivy_tpu.attestation import (
+    AttestationError,
+    parse_statement,
+    unwrap_cosign_predicate,
+)
+from trivy_tpu.attestation.rekor import (
+    Client,
+    EntryID,
+    OverGetEntriesLimit,
+    RekorError,
+)
+
+
+def _envelope(statement: dict, payload_type="application/vnd.in-toto+json"):
+    return {
+        "payloadType": payload_type,
+        "payload": base64.b64encode(json.dumps(statement).encode()).decode(),
+        "signatures": [{"keyid": "", "sig": "x"}],
+    }
+
+
+CDX = {"bomFormat": "CycloneDX", "specVersion": "1.5", "components": []}
+
+STATEMENT = {
+    "_type": "https://in-toto.io/Statement/v0.1",
+    "predicateType": "https://cyclonedx.org/bom",
+    "subject": [{"name": "alpine:3.10", "digest": {"sha256": "ab" * 32}}],
+    "predicate": {"Data": CDX},
+}
+
+
+class TestStatement:
+    def test_parse(self):
+        s = parse_statement(json.dumps(_envelope(STATEMENT)))
+        assert s.predicate_type == "https://cyclonedx.org/bom"
+        assert s.subject[0]["name"] == "alpine:3.10"
+        assert unwrap_cosign_predicate(s) == CDX
+
+    def test_bad_payload_type(self):
+        env = _envelope(STATEMENT, payload_type="application/json")
+        with pytest.raises(AttestationError, match="payload type"):
+            parse_statement(json.dumps(env))
+
+    def test_bad_payload(self):
+        env = _envelope(STATEMENT)
+        env["payload"] = "!!not-base64-json!!"
+        with pytest.raises(AttestationError):
+            parse_statement(json.dumps(env))
+
+    def test_plain_predicate_passthrough(self):
+        st = dict(STATEMENT, predicate={"plain": 1})
+        s = parse_statement(json.dumps(_envelope(st)))
+        assert unwrap_cosign_predicate(s) == {"plain": 1}
+
+
+class TestSBOMAttestation:
+    def test_scan_cosign_sbom_attestation(self, tmp_path):
+        """A cosign SBOM attestation decodes to the inner CycloneDX."""
+        from trivy_tpu.sbom.decode import decode_sbom_file
+
+        cdx = {
+            "bomFormat": "CycloneDX", "specVersion": "1.5",
+            "metadata": {"component": {"name": "alpine:3.10"}},
+            "components": [{
+                "type": "library", "name": "musl", "version": "1.1.22-r3",
+                "purl": "pkg:apk/alpine/musl@1.1.22-r3",
+            }],
+        }
+        st = dict(STATEMENT, predicate={"Data": cdx})
+        p = tmp_path / "sbom.att.json"
+        p.write_text(json.dumps(_envelope(st)))
+        blob, meta = decode_sbom_file(str(p))
+        assert meta.artifact_name == "alpine:3.10"
+        names = {pkg.name for pi in blob.package_infos for pkg in pi.packages}
+        assert "musl" in names
+
+
+class TestEntryID:
+    def test_parse_80(self):
+        e = EntryID.parse("1" * 16 + "a" * 64)
+        assert e.tree_id == "1" * 16 and e.uuid == "a" * 64
+        assert str(e) == "1" * 16 + "a" * 64
+
+    def test_parse_64(self):
+        e = EntryID.parse("b" * 64)
+        assert e.tree_id == "" and e.uuid == "b" * 64
+
+    def test_bad_length(self):
+        with pytest.raises(RekorError):
+            EntryID.parse("short")
+
+
+class _FakeRekorHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers.get("Content-Length", 0))))
+        if self.path == "/api/v1/index/retrieve":
+            if body.get("hash", "").endswith("found"):
+                out = ["1" * 16 + "c" * 64]
+            else:
+                out = []
+            self._reply(out)
+        elif self.path == "/api/v1/log/entries/retrieve":
+            att = base64.b64encode(
+                json.dumps(_envelope(STATEMENT)).encode()).decode()
+            self._reply([{uuid: {"attestation": {"data": att}, "body": ""}}
+                         for uuid in body.get("entryUUIDs", [])])
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def _reply(self, doc):
+        raw = json.dumps(doc).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+
+@pytest.fixture
+def rekor_url():
+    srv = HTTPServer(("127.0.0.1", 0), _FakeRekorHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestRekorClient:
+    def test_search_and_get(self, rekor_url):
+        c = Client(rekor_url)
+        ids = c.search("sha256:found")
+        assert len(ids) == 1 and ids[0].uuid == "c" * 64
+        entries = c.get_entries(ids)
+        assert len(entries) == 1
+        s = parse_statement(entries[0].statement)
+        assert s.predicate_type == "https://cyclonedx.org/bom"
+
+    def test_search_empty(self, rekor_url):
+        assert Client(rekor_url).search("sha256:nope") == []
+
+    def test_entries_limit(self, rekor_url):
+        ids = [EntryID.parse("d" * 64)] * 11
+        with pytest.raises(OverGetEntriesLimit):
+            Client(rekor_url).get_entries(ids)

@@ -1,0 +1,7 @@
+from trivy_tpu.attestation.statement import (  # noqa: F401
+    AttestationError,
+    Statement,
+    is_attestation,
+    parse_statement,
+    unwrap_cosign_predicate,
+)

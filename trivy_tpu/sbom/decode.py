@@ -38,15 +38,7 @@ class SBOMMeta:
     artifact_type: str = "cyclonedx"
 
 
-def detect_sbom_format(path: str) -> str | None:
-    """-> "cyclonedx-json" | "spdx-json" | None
-    (reference pkg/sbom/sbom.go format sniffing)."""
-    try:
-        with open(path, "rb") as f:
-            head = f.read(4 * 1024 * 1024)
-        doc = json.loads(head)
-    except (json.JSONDecodeError, UnicodeDecodeError):
-        return None
+def _classify_doc(doc) -> str | None:
     if isinstance(doc, dict):
         if doc.get("bomFormat") == "CycloneDX":
             return "cyclonedx-json"
@@ -55,10 +47,41 @@ def detect_sbom_format(path: str) -> str | None:
     return None
 
 
+def detect_sbom_format(path: str) -> str | None:
+    """-> "cyclonedx-json" | "spdx-json" | "attest-*" | None
+    (reference pkg/sbom/sbom.go format sniffing incl. in-toto
+    attestations)."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(8 * 1024 * 1024)
+        doc = json.loads(head)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    fmt = _classify_doc(doc)
+    if fmt:
+        return fmt
+    from trivy_tpu.attestation import is_attestation
+
+    if is_attestation(doc):
+        return "attestation"
+    return None
+
+
 def decode_sbom_file(path: str) -> tuple[BlobInfo, SBOMMeta]:
     fmt = detect_sbom_format(path)
     with open(path) as f:
         doc = json.load(f)
+    if fmt == "attestation":
+        # cosign SBOM attestation: DSSE envelope -> in-toto statement ->
+        # predicate(.Data) holds the actual SBOM (reference
+        # pkg/attestation + sbom.go attestation decode)
+        from trivy_tpu.attestation import parse_statement, unwrap_cosign_predicate
+
+        inner = unwrap_cosign_predicate(parse_statement(doc))
+        if isinstance(inner, str):
+            inner = json.loads(inner)
+        doc = inner
+        fmt = _classify_doc(doc)
     if fmt == "cyclonedx-json":
         return _decode_cyclonedx(doc)
     if fmt == "spdx-json":
