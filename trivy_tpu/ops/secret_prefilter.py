@@ -126,13 +126,28 @@ class DevicePrefilter:
 
 
 class HostPrefilter:
-    """Same contract on the CPU (bytes.find), used as fallback and oracle."""
+    """Same contract on the CPU, used as fallback and oracle.  One-pass
+    C++ Aho-Corasick when the native library builds (trivy_tpu.native.ac,
+    replacing the reference's rules x strings.Contains loop,
+    scanner.go:174-186); pure-Python bytes.find otherwise."""
 
-    def __init__(self, bank: KeywordBank):
+    def __init__(self, bank: KeywordBank, use_native: bool = True):
         self.bank = bank
+        self._native = None
+        if use_native and bank.keywords:
+            try:
+                from trivy_tpu.native.ac import NativeMatcher
+
+                self._native = NativeMatcher(bank.keywords)
+            except (RuntimeError, OSError):
+                self._native = None
 
     def keyword_hits(self, contents: list[bytes]) -> np.ndarray:
         out = np.zeros((len(contents), len(self.bank.keywords)), dtype=bool)
+        if self._native is not None:
+            for fi, content in enumerate(contents):
+                out[fi] = self._native.scan(content)
+            return out
         for fi, content in enumerate(contents):
             low = content.lower()
             for ki, k in enumerate(self.bank.keywords):
