@@ -1,0 +1,137 @@
+"""Tests for the special-purpose analyzers: rpmqa manifest, Red Hat
+buildinfo, executable digests, in-image SBOMs, and apk-history packages
+(reference pkg/fanal/analyzer/{pkg/rpm/rpmqa,buildinfo,executable,sbom,
+imgconf/apk}_test.go shapes)."""
+
+import json
+
+from trivy_tpu.artifact.image import _history_apk_packages
+from trivy_tpu.fanal.analyzer import AnalysisInput
+from trivy_tpu.fanal.analyzers.misc import (
+    ContentManifestAnalyzer,
+    ExecutableAnalyzer,
+    RedHatDockerfileAnalyzer,
+    RpmqaAnalyzer,
+    SbomAnalyzer,
+)
+
+
+class TestRpmqa:
+    LINE = ("openssl\t1.1.1k-21.cm1\t1654534587\t1640213218\t"
+            "Microsoft Corporation\t(none)\t1998757\tx86_64\t0\t"
+            "openssl-1.1.1k-21.cm1.src.rpm")
+
+    def test_required(self):
+        a = RpmqaAnalyzer()
+        assert a.required("var/lib/rpmmanifest/container-manifest-2")
+        assert not a.required("var/lib/rpm/Packages")
+
+    def test_parse(self):
+        a = RpmqaAnalyzer()
+        res = a.analyze(AnalysisInput(
+            "var/lib/rpmmanifest/container-manifest-2",
+            self.LINE.encode() + b"\nmalformed line\n"))
+        pkgs = res.package_infos[0].packages
+        assert len(pkgs) == 1
+        p = pkgs[0]
+        assert (p.name, p.version, p.release, p.arch) == \
+            ("openssl", "1.1.1k", "21.cm1", "x86_64")
+        assert p.src_name == "openssl"
+
+
+class TestBuildInfo:
+    def test_content_manifest(self):
+        a = ContentManifestAnalyzer()
+        assert a.required("root/buildinfo/content_manifests/ubi8.json")
+        assert not a.required("etc/content_manifests/x.json")
+        res = a.analyze(AnalysisInput(
+            "root/buildinfo/content_manifests/ubi8.json",
+            json.dumps({"content_sets": ["rhel-8-for-x86_64-baseos-rpms"]})
+            .encode()))
+        assert res.build_info.content_sets == \
+            ["rhel-8-for-x86_64-baseos-rpms"]
+
+    def test_dockerfile(self):
+        a = RedHatDockerfileAnalyzer()
+        path = "root/buildinfo/Dockerfile-ubi8-8.4-211"
+        assert a.required(path)
+        content = (b'FROM sha256:123\n'
+                   b'LABEL maintainer="Red Hat" \\\n'
+                   b'      com.redhat.component="ubi8-container" \\\n'
+                   b'      architecture="x86_64"\n')
+        res = a.analyze(AnalysisInput(path, content))
+        assert res.build_info.nvr == "ubi8-container-8.4-211"
+        assert res.build_info.arch == "x86_64"
+
+    def test_dockerfile_missing_labels(self):
+        a = RedHatDockerfileAnalyzer()
+        res = a.analyze(AnalysisInput(
+            "root/buildinfo/Dockerfile-x-1-1", b"FROM scratch\n"))
+        assert res is None
+
+
+class TestExecutable:
+    def test_digest_for_elf(self):
+        a = ExecutableAnalyzer()
+        assert a.required("usr/bin/app", size=4096, mode=0o755)
+        assert not a.required("usr/bin/app", size=4096, mode=0o644)
+        res = a.analyze(AnalysisInput("usr/bin/app",
+                                      b"\x7fELF" + b"\x00" * 100))
+        assert list(res.digests) == ["usr/bin/app"]
+        assert res.digests["usr/bin/app"].startswith("sha256:")
+
+    def test_non_binary_skipped(self):
+        a = ExecutableAnalyzer()
+        assert a.analyze(AnalysisInput("usr/bin/script",
+                                       b"#!/bin/sh\necho hi\n")) is None
+
+
+class TestSbomInImage:
+    CDX = {
+        "bomFormat": "CycloneDX", "specVersion": "1.5",
+        "components": [{
+            "type": "library", "name": "postgresql", "version": "15.1.0",
+            "purl": "pkg:bitnami/postgresql@15.1.0",
+        }],
+    }
+
+    def test_required(self):
+        a = SbomAnalyzer()
+        assert a.required("usr/share/sbom/app.cdx.json")
+        assert a.required("opt/bitnami/postgresql/.spdx-postgresql.spdx")
+        assert not a.required("etc/config.json")
+
+    def test_decode(self):
+        a = SbomAnalyzer()
+        res = a.analyze(AnalysisInput(
+            "opt/bitnami/postgresql/.sbom.cdx.json",
+            json.dumps(self.CDX).encode()))
+        assert res is not None
+        all_pkgs = [p for app in res.applications for p in app.packages] + \
+            [p for pi in res.package_infos for p in pi.packages]
+        assert any(p.name == "postgresql" for p in all_pkgs)
+
+    def test_invalid_json_skipped(self):
+        a = SbomAnalyzer()
+        assert a.analyze(AnalysisInput("x.cdx.json", b"{nope")) is None
+
+
+class TestApkHistory:
+    def test_pinned_and_unpinned(self):
+        history = [
+            {"created_by": "/bin/sh -c apk add --no-cache curl=8.5.0-r0 "
+                           "ca-certificates"},
+            {"created_by": "/bin/sh -c echo done"},
+            {"created_by": "RUN apk add bash=5.2.21-r0 && apk add jq"},
+        ]
+        pkgs = {p.name: p.version for p in _history_apk_packages(history)}
+        assert pkgs["curl"] == "8.5.0-r0"
+        assert pkgs["ca-certificates"] == ""
+        assert pkgs["bash"] == "5.2.21-r0"
+        assert "jq" in pkgs
+        assert "echo" not in pkgs
+
+    def test_flags_and_vars_skipped(self):
+        history = [{"created_by": "apk add -U --virtual .deps $PKGS gcc"}]
+        pkgs = {p.name for p in _history_apk_packages(history)}
+        assert pkgs == {".deps", "gcc"} or pkgs == {"gcc"}

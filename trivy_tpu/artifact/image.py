@@ -14,6 +14,7 @@ import hashlib
 import io
 import json
 import os
+import re
 import tarfile
 
 from trivy_tpu.artifact.base import ArtifactReference
@@ -243,6 +244,12 @@ class ImageArtifact:
             created=cfg.get("created", ""),
             os=cfg.get("os", ""),
         )
+        # history packages: `apk add` commands in image history
+        # (reference analyzer/imgconf/apk — offline subset: pinned
+        # `pkg=ver` entries carry versions, unpinned are name-only)
+        info.history_packages = _history_apk_packages(
+            cfg.get("history") or [])
+
         # secrets in config env (reference analyzer/imgconf/secret)
         env = (cfg.get("config") or {}).get("Env") or []
         if env:
@@ -258,3 +265,39 @@ class ImageArtifact:
 
     def clean(self, ref: ArtifactReference) -> None:
         pass  # layer blobs stay cached (that IS the resume mechanism)
+
+
+_APK_ADD_RE = re.compile(r"\bapk\b[^|;&]*?\badd\b([^|;&]*)")
+
+
+def _history_apk_packages(history: list[dict]) -> list[Package]:
+    """Parse `apk add` invocations out of image-config history
+    (reference pkg/fanal/analyzer/imgconf/apk/apk.go:147-180; the
+    reference additionally resolves versions/deps via a fetched
+    APKINDEX — network-gated here, so only pinned versions are kept)."""
+    out: list[Package] = []
+    seen: set[str] = set()
+    for h in history:
+        cmd = h.get("created_by", "")
+        for m in _APK_ADD_RE.finditer(cmd):
+            tokens = m.group(1).split()
+            skip_next = False
+            for tok in tokens:
+                if skip_next:  # argument of --virtual/-t: a group name
+                    skip_next = False
+                    continue
+                if tok in ("--virtual", "-t"):
+                    skip_next = True
+                    continue
+                if tok.startswith("-") or tok.startswith("$"):
+                    continue
+                if tok in (".", "&&", "\\") or tok.startswith("."):
+                    continue
+                name, _, ver = tok.partition("=")
+                if not name or name in seen:
+                    continue
+                seen.add(name)
+                out.append(Package(
+                    id=f"{name}@{ver}" if ver else name,
+                    name=name, version=ver))
+    return out

@@ -63,6 +63,8 @@ class AnalysisResult:
     system_installed_files: list[str] = field(default_factory=list)
     custom_resources: list[CustomResource] = field(default_factory=list)
     misconfigurations: list = field(default_factory=list)
+    build_info: object | None = None
+    digests: dict = field(default_factory=dict)
 
     def merge(self, other: "AnalysisResult | None") -> None:
         if other is None:
@@ -77,6 +79,15 @@ class AnalysisResult:
         self.system_installed_files.extend(other.system_installed_files)
         self.custom_resources.extend(other.custom_resources)
         self.misconfigurations.extend(other.misconfigurations)
+        if other.build_info is not None:
+            bi, obi = self.build_info, other.build_info
+            if bi is None:
+                self.build_info = obi
+            else:  # merge fields (content manifest + dockerfile analyzers)
+                bi.content_sets = bi.content_sets or obi.content_sets
+                bi.nvr = bi.nvr or obi.nvr
+                bi.arch = bi.arch or obi.arch
+        self.digests.update(other.digests)
 
     def to_blob(self) -> BlobInfo:
         blob = BlobInfo()
@@ -92,6 +103,8 @@ class AnalysisResult:
         blob.licenses = sorted(self.licenses, key=lambda l: (l.file_path, l.package_name))
         blob.misconfigurations = self.misconfigurations
         blob.custom_resources = self.custom_resources
+        blob.build_info = self.build_info
+        blob.digests = dict(sorted(self.digests.items()))
         return blob
 
 

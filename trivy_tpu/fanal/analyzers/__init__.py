@@ -5,6 +5,7 @@ from trivy_tpu.fanal.analyzers import (  # noqa: F401
     config_analyzer,
     lang,
     license_file,
+    misc,
     os_release,
     pkg_apk,
     pkg_dpkg,

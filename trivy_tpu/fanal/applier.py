@@ -75,6 +75,9 @@ def apply_layers(layers: list[BlobInfo]) -> ArtifactDetail:
         merged.os = merged.os.merge(layer.os)
         if layer.repository is not None:
             merged.repository = layer.repository
+        if layer.build_info is not None:
+            merged.build_info = layer.build_info  # last layer wins
+        merged.digests.update(layer.digests)
 
         for pkg_info in layer.package_infos:
             path_map.set(pkg_info.file_path, "type:ospkg", pkg_info)

@@ -67,6 +67,18 @@ def detect_sbom_format(path: str) -> str | None:
     return None
 
 
+def decode_sbom_bytes(content: bytes) -> tuple[BlobInfo, SBOMMeta]:
+    """Decode an in-memory SBOM document (used by the in-image SBOM
+    analyzer, reference pkg/fanal/analyzer/sbom)."""
+    doc = json.loads(content)
+    fmt = _classify_doc(doc)
+    if fmt == "cyclonedx-json":
+        return _decode_cyclonedx(doc)
+    if fmt == "spdx-json":
+        return _decode_spdx(doc)
+    raise ValueError("unsupported SBOM document")
+
+
 def decode_sbom_file(path: str) -> tuple[BlobInfo, SBOMMeta]:
     fmt = detect_sbom_format(path)
     with open(path) as f:

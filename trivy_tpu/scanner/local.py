@@ -44,6 +44,7 @@ class LocalDriver:
         from trivy_tpu.scanner import post
 
         detail = self._apply_layers(blob_keys)
+        self._merge_artifact_info(detail, artifact_key)
         results = self._scan_detail(target, detail, options)
         for hook in self.post_hooks:
             results = hook(results, options)
@@ -51,6 +52,23 @@ class LocalDriver:
         # pkg/scanner/local/scan.go:152 -> post/post_scan.go:35)
         results = post.scan(results, options)
         return results, detail.os
+
+    def _merge_artifact_info(self, detail: ArtifactDetail,
+                             artifact_key: str) -> None:
+        """Merge image-config analysis (env secrets, apk-history
+        packages) into the squashed detail (reference applier
+        ApplyLayers consumes ArtifactInfo alongside the blobs)."""
+        if not artifact_key:
+            return
+        raw = self.cache.get_artifact(artifact_key)
+        if not raw:
+            return
+        from trivy_tpu.types.artifact import ArtifactInfo
+
+        info = from_dict(ArtifactInfo, raw)
+        detail.image_config = info
+        if info.secret is not None and info.secret.findings:
+            detail.secrets.append(info.secret)
 
     # ------------------------------------------------------------ layers
 

@@ -377,6 +377,17 @@ class CustomResource(JSONMixin):
 
 
 @dataclass
+class BuildInfo(JSONMixin):
+    """Red Hat build metadata (reference artifact.go BuildInfo):
+    content sets from root/buildinfo content manifests, NVR/arch from
+    buildinfo Dockerfiles — used for Red Hat advisory matching."""
+
+    content_sets: list[str] = field(default_factory=list)
+    nvr: str = ""
+    arch: str = ""
+
+
+@dataclass
 class BlobInfo(JSONMixin):
     """Per-layer (or per-pseudo-blob) analysis result
     (reference pkg/fanal/types/artifact.go:122-149)."""
@@ -395,6 +406,9 @@ class BlobInfo(JSONMixin):
     secrets: list[Secret] = field(default_factory=list)
     licenses: list[LicenseFile] = field(default_factory=list)
     custom_resources: list[CustomResource] = field(default_factory=list)
+    build_info: BuildInfo | None = None
+    # sha256 digests of unpackaged executables (rekor SBOM discovery)
+    digests: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -425,3 +439,5 @@ class ArtifactDetail(JSONMixin):
     licenses: list[LicenseFile] = field(default_factory=list)
     image_config: ArtifactInfo | None = None
     custom_resources: list[CustomResource] = field(default_factory=list)
+    build_info: BuildInfo | None = None
+    digests: dict[str, str] = field(default_factory=dict)
