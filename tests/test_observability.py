@@ -1,0 +1,171 @@
+"""Tracing, server metrics, and rekor SBOM-discovery tests
+(SURVEY §5 greenfield subsystems)."""
+
+import base64
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from trivy_tpu.utils import trace
+
+
+class TestTrace:
+    def setup_method(self):
+        trace.enable(True)
+        trace.reset()
+
+    def teardown_method(self):
+        trace.enable(False)
+
+    def test_nested_spans(self):
+        with trace.span("outer"):
+            with trace.span("inner", files=3):
+                pass
+        text = trace.render()
+        lines = text.splitlines()
+        assert lines[0].startswith("outer")
+        assert lines[1].strip().startswith("inner")
+        assert "files=3" in lines[1]
+        assert "ms" in lines[0]
+
+    def test_disabled_is_noop(self):
+        trace.enable(False)
+        with trace.span("ignored"):
+            pass
+        assert trace.render() == ""
+
+    def test_add_meta(self):
+        with trace.span("s"):
+            trace.add_meta(pkgs=7)
+        assert "pkgs=7" in trace.render()
+
+    def test_cli_trace_output(self, tmp_path, capsys):
+        from trivy_tpu.cli.main import main
+
+        (tmp_path / "r").mkdir()
+        (tmp_path / "r" / "requirements.txt").write_text("flask==1.0\n")
+        rc = main(["filesystem", str(tmp_path / "r"), "--format", "json",
+                   "--cache-dir", str(tmp_path / "cache"),
+                   "--scanners", "vuln", "--quiet", "--trace",
+                   "--output", str(tmp_path / "out.json")])
+        assert rc == 0
+        err = capsys.readouterr().err
+        assert "scan_artifact" in err
+        assert "apply_layers" in err
+        assert "detect" in err
+
+
+class TestServerMetrics:
+    def test_render_and_record(self):
+        from trivy_tpu.rpc.server import Metrics
+
+        m = Metrics()
+        m.record(0.5, findings=3)
+        m.record(0.25, error=True)
+        text = m.render().decode()
+        assert "trivy_tpu_scans_total 2" in text
+        assert "trivy_tpu_scan_errors_total 1" in text
+        assert "trivy_tpu_findings_total 3" in text
+        assert "trivy_tpu_scan_seconds_sum 0.75" in text
+
+    def test_metrics_endpoint(self, tmp_path):
+        import urllib.request
+
+        from trivy_tpu.cache.cache import MemoryCache
+        from trivy_tpu.detector.engine import MatchEngine
+        from trivy_tpu.db.store import AdvisoryDB
+        from trivy_tpu.rpc.server import Server
+
+        engine = MatchEngine(AdvisoryDB(), use_device=False)
+        srv = Server(engine, MemoryCache(), host="localhost", port=0)
+        srv.start()
+        try:
+            with urllib.request.urlopen(srv.address + "/metrics",
+                                        timeout=10) as resp:
+                body = resp.read().decode()
+            assert "trivy_tpu_scans_total 0" in body
+        finally:
+            srv.shutdown()
+
+
+CDX = {
+    "bomFormat": "CycloneDX", "specVersion": "1.5",
+    "components": [{
+        "type": "library", "name": "github.com/spf13/cobra",
+        "version": "1.8.0", "purl": "pkg:golang/github.com/spf13/cobra@1.8.0",
+    }],
+}
+
+
+def _attestation() -> bytes:
+    st = {
+        "_type": "https://in-toto.io/Statement/v0.1",
+        "predicateType": "https://cyclonedx.org/bom",
+        "subject": [],
+        "predicate": {"Data": CDX},
+    }
+    env = {
+        "payloadType": "application/vnd.in-toto+json",
+        "payload": base64.b64encode(json.dumps(st).encode()).decode(),
+        "signatures": [],
+    }
+    return json.dumps(env).encode()
+
+
+class _FakeRekor(BaseHTTPRequestHandler):
+    known_hash = ""
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers.get("Content-Length", 0))))
+        if self.path == "/api/v1/index/retrieve":
+            if body.get("hash") == f"sha256:{self.known_hash}":
+                self._reply(["e" * 64])
+            else:
+                self._reply([])
+        else:
+            att = base64.b64encode(_attestation()).decode()
+            self._reply([{u: {"attestation": {"data": att}}}
+                         for u in body.get("entryUUIDs", [])])
+
+    def _reply(self, doc):
+        raw = json.dumps(doc).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+
+class TestUnpackagedDiscovery:
+    def test_discover(self):
+        import hashlib
+
+        from trivy_tpu.fanal.unpackaged import discover_sboms
+        from trivy_tpu.types.artifact import ArtifactDetail
+
+        binary = b"\x7fELF fake binary"
+        digest = hashlib.sha256(binary).hexdigest()
+        _FakeRekor.known_hash = digest
+        srv = HTTPServer(("127.0.0.1", 0), _FakeRekor)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}"
+            detail = ArtifactDetail()
+            detail.digests = {
+                "usr/bin/tool": f"sha256:{digest}",
+                "usr/bin/unknown": "sha256:" + "0" * 64,
+            }
+            n = discover_sboms(detail, url)
+            assert n == 1
+            pkgs = [p for a in detail.applications for p in a.packages]
+            assert any(p.name == "github.com/spf13/cobra" for p in pkgs)
+        finally:
+            srv.shutdown()
+            srv.server_close()

@@ -67,6 +67,15 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
                    help="enable TLS for the redis cache backend")
     p.add_argument("--skip-files", action="append", default=[])
     p.add_argument("--skip-dirs", action="append", default=[])
+    p.add_argument("--sbom-sources", default="",
+                   help="comma-separated SBOM discovery sources for "
+                        "unpackaged binaries (rekor)")
+    p.add_argument("--rekor-url", default="https://rekor.sigstore.dev",
+                   help="rekor server URL for --sbom-sources rekor")
+    p.add_argument("--trace", action="store_true",
+                   help="print a stage-timing trace after the scan "
+                        "(set TRIVY_TPU_JAX_TRACE_DIR for a device "
+                        "profile)")
     p.add_argument("--module-dir", default=None,
                    help="directory of scan-module extensions "
                         "(default <cache>/modules)")

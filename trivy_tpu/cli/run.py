@@ -71,6 +71,9 @@ def make_scan_options(args) -> ScanOptions:
         pkg_types=args.pkg_types.split(","),
         scanners=scanners,
         list_all_pkgs=args.list_all_pkgs,
+        sbom_sources=[s for s in
+                      getattr(args, "sbom_sources", "").split(",") if s],
+        rekor_url=getattr(args, "rekor_url", "https://rekor.sigstore.dev"),
     )
 
 
@@ -108,7 +111,11 @@ def run_scan(args) -> int:
     # module extensions: custom analyzers + post-scan hooks
     # (reference pkg/module manager wired into the runner)
     from trivy_tpu.module import ModuleManager
+    from trivy_tpu.utils import trace
 
+    if getattr(args, "trace", False):
+        trace.enable(True)
+        trace.reset()
     mod_mgr = ModuleManager(
         getattr(args, "module_dir", None)
         or os.path.join(args.cache_dir, "modules"))
@@ -117,6 +124,9 @@ def run_scan(args) -> int:
         return _run_scan_core(args, compliance_spec)
     finally:
         mod_mgr.unload()
+        if getattr(args, "trace", False):
+            trace.render(sys.stderr)
+            trace.enable(False)
 
 
 def _run_scan_core(args, compliance_spec) -> int:

@@ -72,6 +72,45 @@ class _RWLock:
             self._cond.notify_all()
 
 
+class Metrics:
+    """Scan-server counters exposed at /metrics in Prometheus text
+    format (SURVEY §5: greenfield for the TPU sidecar — scans/sec,
+    findings, hot-swap count)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.scans_total = 0
+        self.scan_errors_total = 0
+        self.scan_seconds_sum = 0.0
+        self.findings_total = 0
+        self.db_reloads_total = 0
+
+    def record(self, seconds: float, findings: int = 0,
+               error: bool = False) -> None:
+        with self._lock:
+            self.scans_total += 1
+            self.scan_seconds_sum += seconds
+            self.findings_total += findings
+            if error:
+                self.scan_errors_total += 1
+
+    def render(self) -> bytes:
+        with self._lock:
+            rows = [
+                ("trivy_tpu_scans_total", self.scans_total),
+                ("trivy_tpu_scan_errors_total", self.scan_errors_total),
+                ("trivy_tpu_scan_seconds_sum",
+                 round(self.scan_seconds_sum, 6)),
+                ("trivy_tpu_findings_total", self.findings_total),
+                ("trivy_tpu_db_reloads_total", self.db_reloads_total),
+            ]
+        out = []
+        for name, value in rows:
+            out.append(f"# TYPE {name} counter")
+            out.append(f"{name} {value}")
+        return ("\n".join(out) + "\n").encode()
+
+
 class ScanService:
     """Holds the hot-swappable engine + the server-side cache."""
 
@@ -81,6 +120,7 @@ class ScanService:
         self.cache = cache
         self.db_path = db_path
         self._db_mtime = self._mtime()
+        self.metrics = Metrics()
 
     def _mtime(self) -> float:
         import os
@@ -96,12 +136,23 @@ class ScanService:
             return 0.0
 
     def scan(self, target, artifact_key, blob_keys, options):
+        import time
+
         from trivy_tpu.scanner.local import LocalDriver
 
         self.lock.acquire_read()
+        start = time.perf_counter()
         try:
             driver = LocalDriver(self.engine, self.cache)
-            return driver.scan(target, artifact_key, blob_keys, options)
+            results, os_found = driver.scan(
+                target, artifact_key, blob_keys, options)
+            self.metrics.record(
+                time.perf_counter() - start,
+                findings=sum(len(r.vulnerabilities) for r in results))
+            return results, os_found
+        except Exception:
+            self.metrics.record(time.perf_counter() - start, error=True)
+            raise
         finally:
             self.lock.release_read()
 
@@ -122,6 +173,8 @@ class ScanService:
             self._db_mtime = mtime
         finally:
             self.lock.release_write()
+        with self.metrics._lock:
+            self.metrics.db_reloads_total += 1
         _log.info("advisory DB hot-swapped", **db.stats())
         return True
 
@@ -155,6 +208,9 @@ def _make_handler(service: ScanService, token: str | None):
             elif self.path == "/version":
                 self._reply(200, json.dumps(
                     {"Version": trivy_tpu.__version__}).encode())
+            elif self.path == "/metrics":
+                self._reply(200, service.metrics.render(),
+                            "text/plain; version=0.0.4")
             else:
                 self._error(404, "not found")
 

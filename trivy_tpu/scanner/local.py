@@ -42,15 +42,26 @@ class LocalDriver:
 
     def scan(self, target, artifact_key, blob_keys, options: ScanOptions):
         from trivy_tpu.scanner import post
+        from trivy_tpu.utils import trace
 
-        detail = self._apply_layers(blob_keys)
-        self._merge_artifact_info(detail, artifact_key)
-        results = self._scan_detail(target, detail, options)
-        for hook in self.post_hooks:
-            results = hook(results, options)
-        # globally registered hooks (module extensions; reference
-        # pkg/scanner/local/scan.go:152 -> post/post_scan.go:35)
-        results = post.scan(results, options)
+        with trace.span("apply_layers"):
+            detail = self._apply_layers(blob_keys)
+            self._merge_artifact_info(detail, artifact_key)
+            trace.add_meta(pkgs=len(detail.packages),
+                           apps=len(detail.applications))
+        if "rekor" in (options.sbom_sources or []):
+            from trivy_tpu.fanal.unpackaged import discover_sboms
+
+            with trace.span("rekor_sbom_discovery"):
+                discover_sboms(detail, options.rekor_url)
+        with trace.span("detect"):
+            results = self._scan_detail(target, detail, options)
+        with trace.span("post_hooks"):
+            for hook in self.post_hooks:
+                results = hook(results, options)
+            # globally registered hooks (module extensions; reference
+            # pkg/scanner/local/scan.go:152 -> post/post_scan.go:35)
+            results = post.scan(results, options)
         return results, detail.os
 
     def _merge_artifact_info(self, detail: ArtifactDetail,

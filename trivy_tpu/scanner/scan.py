@@ -37,13 +37,19 @@ class Scanner:
         self.artifact = artifact
 
     def scan_artifact(self, options: ScanOptions) -> Report:
-        ref = self.artifact.inspect()
-        try:
-            results, os_found = self.driver.scan(
-                ref.name, ref.id, ref.blob_ids, options
-            )
-        finally:
-            self.artifact.clean(ref)
+        from trivy_tpu.utils import trace
+
+        with trace.span("scan_artifact"):
+            with trace.span("inspect"):
+                ref = self.artifact.inspect()
+                trace.add_meta(blobs=len(ref.blob_ids))
+            try:
+                with trace.span("driver.scan"), trace.jax_profile():
+                    results, os_found = self.driver.scan(
+                        ref.name, ref.id, ref.blob_ids, options
+                    )
+            finally:
+                self.artifact.clean(ref)
 
         metadata = Metadata(os=os_found if os_found.detected else None)
         if ref.image_metadata:

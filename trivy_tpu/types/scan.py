@@ -23,6 +23,9 @@ class ScanOptions:
     license_categories: dict[str, list[str]] = field(default_factory=dict)
     distro: str = ""
     list_all_pkgs: bool = False
+    # SBOM discovery sources for unpackaged binaries ("rekor")
+    sbom_sources: list[str] = field(default_factory=list)
+    rekor_url: str = "https://rekor.sigstore.dev"
 
     def has_scanner(self, s: Scanner) -> bool:
         return s in self.scanners
