@@ -93,7 +93,37 @@ def build_queries(rng: random.Random, n_images=2000, pkgs_per_image=120):
     return queries
 
 
+def _ensure_device():
+    """Probe device init in a subprocess with a timeout: a wedged TPU
+    tunnel otherwise hangs jax.devices() forever (the axon plugin is
+    initialized even under JAX_PLATFORMS=cpu).  On failure the bench
+    still completes on CPU and reports its platform honestly."""
+    import os
+    import subprocess
+
+    if os.environ.get("TRIVY_TPU_BENCH_NO_PROBE"):
+        return
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=180, capture_output=True)
+        if probe.returncode == 0:
+            return
+    except subprocess.TimeoutExpired:
+        pass
+    print("device init unavailable; falling back to CPU", file=sys.stderr)
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # jax may already be imported (axon sitecustomize): env vars are too
+    # late then; the config route always works before first backend use
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main():
+    _ensure_device()
+
     from trivy_tpu.detector.engine import MatchEngine
 
     rng = random.Random(20240101)

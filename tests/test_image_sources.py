@@ -298,3 +298,48 @@ class TestImageArtifactFromRegistry:
         assert any(a.get("file_path") == "app/requirements.txt"
                    for a in apps)
         assert ref.image_metadata["RepoDigests"]
+
+
+class TestOCIArtifactDownload:
+    def test_download_db_artifact(self, tmp_path):
+        """An OCI artifact whose layer is a tar.gz unpacks into the
+        destination (reference pkg/oci/artifact.go)."""
+        import gzip as _gzip
+        import tarfile as _tarfile
+
+        from trivy_tpu.db.oci import DB_MEDIA_TYPE, download_artifact
+
+        # build a db-artifact layer: tar.gz containing db.json
+        payload = io.BytesIO()
+        with _tarfile.open(fileobj=payload, mode="w") as tf:
+            data = b'{"buckets": {}}'
+            info = _tarfile.TarInfo("db.json")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        layer_gz = _gzip.compress(payload.getvalue())
+        layer_digest = "sha256:" + hashlib.sha256(layer_gz).hexdigest()
+        manifest = {
+            "schemaVersion": 2,
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "config": {"digest": "sha256:" + "9" * 64, "size": 2},
+            "layers": [{"mediaType": DB_MEDIA_TYPE,
+                        "digest": layer_digest, "size": len(layer_gz)}],
+        }
+        _RegistryHandler.blobs = {layer_digest: layer_gz}
+        _RegistryHandler.manifest_raw = json.dumps(manifest).encode()
+        _RegistryHandler.require_auth = False
+
+        srv = HTTPServer(("127.0.0.1", 0), _RegistryHandler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            reg = f"127.0.0.1:{srv.server_address[1]}"
+            dest = str(tmp_path / "db")
+            names = download_artifact(f"{reg}/aquasec/trivy-db:2", dest,
+                                      media_type=DB_MEDIA_TYPE,
+                                      insecure=True)
+            assert "db.json" in names
+            assert (tmp_path / "db" / "db.json").exists()
+        finally:
+            srv.shutdown()
+            srv.server_close()

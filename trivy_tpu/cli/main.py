@@ -187,6 +187,14 @@ def build_parser() -> argparse.ArgumentParser:
     ps = dbsub.add_parser("stats", help="show DB statistics", allow_abbrev=False)
     _add_global_flags(ps)
     ps.add_argument("--db-path", default=None)
+    pd = dbsub.add_parser(
+        "download",
+        help="download the advisory DB as an OCI artifact",
+        allow_abbrev=False)
+    _add_global_flags(pd)
+    pd.add_argument("--db-repository",
+                    default="ghcr.io/aquasecurity/trivy-db:2")
+    pd.add_argument("--insecure", action="store_true")
     pj = dbsub.add_parser(
         "import-java",
         help="import a java sha1->GAV dump (JSONL: "

@@ -497,6 +497,19 @@ def run_db(args) -> int:
 
         print(_json.dumps(db.stats(), indent=2))
         return 0
+    if args.db_command == "download":
+        from trivy_tpu.db.oci import DB_MEDIA_TYPE, OCIError, download_artifact
+
+        dest = getattr(args, "db_path", None) or os.path.join(
+            args.cache_dir, "db")
+        try:
+            names = download_artifact(
+                args.db_repository, dest, media_type=DB_MEDIA_TYPE,
+                insecure=getattr(args, "insecure", False))
+        except OCIError as e:
+            raise FatalError(str(e))
+        _log.info("advisory DB downloaded", path=dest, files=len(names))
+        return 0
     if args.db_command == "import-java":
         import gzip
         import json as _json

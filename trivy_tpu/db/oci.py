@@ -1,0 +1,70 @@
+"""OCI artifact downloads (reference pkg/oci/artifact.go + pkg/db
+OCI pull): the advisory DB / checks bundle are distributed as single-
+layer OCI artifacts (tar.gz media types).  Reuses the registry client
+from the image-acquisition chain; network-gated — `db import` remains
+the offline path."""
+
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import tarfile
+
+from trivy_tpu.artifact.image_source import RegistryClient, SourceError, parse_reference
+from trivy_tpu.log import logger
+
+_log = logger("oci")
+
+DB_MEDIA_TYPE = "application/vnd.aquasec.trivy.db.layer.v1.tar+gzip"
+JAVADB_MEDIA_TYPE = "application/vnd.aquasec.trivy.javadb.layer.v1.tar+gzip"
+CHECKS_MEDIA_TYPE = "application/vnd.oci.image.layer.v1.tar+gzip"
+
+
+class OCIError(Exception):
+    pass
+
+
+def download_artifact(ref: str, dest_dir: str,
+                      media_type: str | None = None,
+                      insecure: bool = False,
+                      username: str = "", password: str = "") -> list[str]:
+    """Pull an OCI artifact and unpack its (first matching) layer into
+    dest_dir.  Returns the extracted member names."""
+    registry, repo, tag, digest = parse_reference(ref)
+    client = RegistryClient(registry, insecure=insecure,
+                            username=username, password=password)
+    try:
+        manifest, _ = client.manifest(repo, digest or tag)
+    except SourceError as e:
+        raise OCIError(f"artifact manifest {ref}: {e}") from e
+    layers = manifest.get("layers") or []
+    layer = None
+    for cand in layers:
+        if media_type is None or cand.get("mediaType") == media_type:
+            layer = cand
+            break
+    if layer is None:
+        raise OCIError(
+            f"no layer with media type {media_type!r} in {ref} "
+            f"(found: {[c.get('mediaType') for c in layers]})")
+    try:
+        data = client.blob(repo, layer["digest"])
+    except SourceError as e:
+        raise OCIError(f"artifact blob {ref}: {e}") from e
+    if data[:2] == b"\x1f\x8b":
+        data = gzip.decompress(data)
+
+    os.makedirs(dest_dir, exist_ok=True)
+    names: list[str] = []
+    with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+        for member in tf.getmembers():
+            # path traversal guard
+            dest = os.path.realpath(os.path.join(dest_dir, member.name))
+            if not dest.startswith(os.path.realpath(dest_dir) + os.sep) \
+                    and dest != os.path.realpath(dest_dir):
+                raise OCIError(f"unsafe path in artifact: {member.name}")
+        tf.extractall(dest_dir, filter="data")
+        names = tf.getnames()
+    _log.info("downloaded OCI artifact", ref=ref, files=len(names))
+    return names
