@@ -153,11 +153,36 @@ class MatchEngine:
     # ------------------------------------------------------------ device
 
     def detect(self, queries: list[PkgQuery]) -> list[MatchResult]:
-        """Kernel + host rescreen. Identical output to oracle_detect."""
+        """Kernel + host rescreen. Identical output to oracle_detect.
+
+        Duplicate queries (the dominant shape of a registry crawl —
+        images share most of their packages) are deduplicated before the
+        kernel and rescreen; results fan back out by index."""
         if not queries:
             return []
         if not self.use_device:
             return self.oracle_detect(queries)
+
+        key_of: dict[tuple, int] = {}
+        uniq: list[PkgQuery] = []
+        idx_map = [0] * len(queries)
+        for j, q in enumerate(queries):
+            k = (q.space, q.name, q.version, q.scheme_name)
+            u = key_of.get(k)
+            if u is None:
+                u = len(uniq)
+                key_of[k] = u
+                uniq.append(q)
+            idx_map[j] = u
+        if len(uniq) < len(queries):
+            uniq_hits = self._detect_unique(uniq)
+            return [MatchResult(q, uniq_hits[idx_map[j]])
+                    for j, q in enumerate(queries)]
+        hits = self._detect_unique(queries)
+        return [MatchResult(q, h) for q, h in zip(queries, hits)]
+
+    def _detect_unique(self, queries: list[PkgQuery]) -> list[list[int]]:
+        """-> sorted advisory-index list per (unique) query."""
         from trivy_tpu.ops import match as m
 
         batch = self.cdb.encode_packages(
@@ -209,7 +234,7 @@ class MatchEngine:
                 if ch.check_parsed(ver):
                     hits_q.append(i)
                     n_conf += 1
-            out.append(MatchResult(q, sorted(hits_q)))
+            out.append(sorted(hits_q))
         self.rescreen_stats["candidates"] += n_cand
         self.rescreen_stats["confirmed"] += n_conf
         return out
