@@ -118,6 +118,12 @@ def build_parser() -> argparse.ArgumentParser:
         p = sub.add_parser(name, help=help_text, allow_abbrev=False)
         _add_global_flags(p)
         _add_scan_flags(p)
+        if name in ("repository", "repo"):
+            p.add_argument("--branch", default="",
+                           help="git branch to check out")
+            p.add_argument("--tag", default="", help="git tag to check out")
+            p.add_argument("--commit", default="",
+                           help="git commit to check out")
         if name == "image":
             p.add_argument("--input", default=None,
                            help="image tar archive path")

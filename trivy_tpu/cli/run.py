@@ -264,6 +264,9 @@ def _select_scanner(args, cache):
             skip_files=args.skip_files, skip_dirs=args.skip_dirs,
             parallel=args.parallel,
             disabled_analyzers=disabled,
+            branch=getattr(args, "branch", ""),
+            tag=getattr(args, "tag", ""),
+            commit=getattr(args, "commit", ""),
         ), driver
     if cmd == "image":
         from trivy_tpu.artifact.image import ImageArtifact
