@@ -308,3 +308,82 @@ def test_walker_root_dotfiles_and_whiteouts():
     assert [f.path for f in files] == [".env"]
     assert whiteouts == ["config"]
     assert opaque == ["dir"]
+
+
+class TestRepoCheckout:
+    """Revision flags on the repo artifact (reference artifact/repo/git.go
+    clone options)."""
+
+    def _mk_repo(self, tmp_path):
+        import subprocess
+        repo = tmp_path / "src"
+        repo.mkdir()
+        (repo / "requirements.txt").write_text("flask==1.0\n")
+        env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+               "PATH": os.environ["PATH"], "HOME": str(tmp_path)}
+        def git(*args):
+            subprocess.run(["git", "-C", str(repo), *args], check=True,
+                           capture_output=True, env=env)
+        subprocess.run(["git", "init", "-q", "-b", "main", str(repo)],
+                       check=True, capture_output=True, env=env)
+        git("add", "-A")
+        git("commit", "-qm", "v1")
+        git("tag", "v1.0")
+        (repo / "requirements.txt").write_text("flask==2.0\n")
+        git("add", "-A")
+        git("commit", "-qm", "v2")
+        return repo
+
+    def test_clone_tag(self, tmp_path):
+        import pytest as _pytest
+        from trivy_tpu.artifact.repo import RepoArtifact
+        from trivy_tpu.cache.cache import MemoryCache
+
+        repo = self._mk_repo(tmp_path)
+        art = RepoArtifact(f"file://{repo}", MemoryCache(), tag="v1.0")
+        ref = art.inspect()
+        blob = art.cache.get_blob(ref.blob_ids[0])
+        pkgs = [p for a in blob["applications"] for p in a["packages"]]
+        assert pkgs[0]["version"] == "1.0"
+        art.clean(ref)
+        assert art._tmp is None
+
+    def test_branch_tag_conflict(self, tmp_path):
+        import pytest as _pytest
+        from trivy_tpu.artifact.repo import RepoArtifact
+        from trivy_tpu.cache.cache import MemoryCache
+
+        art = RepoArtifact("https://x/r.git", MemoryCache(),
+                           branch="main", tag="v1")
+        with _pytest.raises(RuntimeError, match="mutually exclusive"):
+            art.inspect()
+
+    def test_dash_ref_rejected(self, tmp_path):
+        import pytest as _pytest
+        from trivy_tpu.artifact.repo import RepoArtifact
+        from trivy_tpu.cache.cache import MemoryCache
+
+        art = RepoArtifact("https://x/r.git", MemoryCache(), commit="-f")
+        with _pytest.raises(RuntimeError, match="invalid git ref"):
+            art.inspect()
+
+    def test_failed_clone_cleans_tmp(self, tmp_path, monkeypatch):
+        import glob
+
+        import pytest as _pytest
+        from trivy_tpu.artifact.repo import RepoArtifact
+        from trivy_tpu.cache.cache import MemoryCache
+
+        monkeypatch.setenv("TMPDIR", str(tmp_path / "tmp"))
+        (tmp_path / "tmp").mkdir()
+        import tempfile as _tempfile
+        _tempfile.tempdir = None  # re-read TMPDIR
+        try:
+            art = RepoArtifact(f"file://{tmp_path}/nope.git", MemoryCache(),
+                               branch="missing")
+            with _pytest.raises(RuntimeError):
+                art.inspect()
+            assert not glob.glob(str(tmp_path / "tmp" / "trivy-tpu-repo-*"))
+        finally:
+            _tempfile.tempdir = None

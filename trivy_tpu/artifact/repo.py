@@ -32,21 +32,38 @@ class RepoArtifact:
         self._tmp: str | None = None
 
     def _checkout(self) -> str:
+        if self.branch and self.tag:
+            raise RuntimeError("--branch and --tag are mutually exclusive")
+        for ref in (self.branch, self.tag, self.commit):
+            if ref.startswith("-"):
+                raise RuntimeError(f"invalid git ref {ref!r}")
         if os.path.isdir(self.target):
+            if self.branch or self.tag or self.commit:
+                # a local directory is scanned in place; silently ignoring
+                # the requested revision would mis-attribute the report,
+                # so check it out (fails loudly on a non-git dir)
+                self._git(["git", "-C", self.target, "checkout",
+                           self.commit or self.tag or self.branch, "--"])
             return self.target
         self._tmp = tempfile.mkdtemp(prefix="trivy-tpu-repo-")
-        cmd = ["git", "clone"]
-        if not self.commit:
-            cmd += ["--depth", "1"]  # arbitrary commits need full history
-        if self.branch:
-            cmd += ["--branch", self.branch]
-        if self.tag:
-            cmd += ["--branch", self.tag]
-        cmd += [self.target, self._tmp]
-        _log.info("cloning repository", url=self.target)
-        self._git(cmd)
-        if self.commit:
-            self._git(["git", "-C", self._tmp, "checkout", self.commit])
+        try:
+            cmd = ["git", "clone"]
+            if not self.commit:
+                cmd += ["--depth", "1"]  # arbitrary commits need history
+            if self.branch:
+                cmd += ["--branch", self.branch]
+            if self.tag:
+                cmd += ["--branch", self.tag]
+            cmd += ["--", self.target, self._tmp]
+            _log.info("cloning repository", url=self.target)
+            self._git(cmd)
+            if self.commit:
+                self._git(["git", "-C", self._tmp, "checkout",
+                           self.commit, "--"])
+        except Exception:
+            shutil.rmtree(self._tmp, ignore_errors=True)
+            self._tmp = None
+            raise
         return self._tmp
 
     @staticmethod
