@@ -113,17 +113,28 @@ def test_rootfs_scan(env, tmp_path, capsys):
     os_res = next(r for (c, _t), r in results.items() if c == "os-pkgs")
     ids = {v["VulnerabilityID"] for v in os_res["Vulnerabilities"]}
     assert ids == {"CVE-2025-1000"}  # busybox 1.36.1-r4 >= fix, not vulnerable
+    # rootfs scans disable lockfile analyzers (reference run.go:186-190)
     lang = [r for r in doc["Results"] if r["Class"] == "lang-pkgs"]
-    targets = {r["Target"]: r for r in lang}
-    assert "app/package-lock.json" in targets
-    assert {v["VulnerabilityID"] for v in
-            targets["app/package-lock.json"]["Vulnerabilities"]} == {"CVE-2019-10744"}
-    assert "app/requirements.txt" in targets
+    assert "app/package-lock.json" not in {r["Target"] for r in lang}
     secrets = [r for r in doc["Results"] if r["Class"] == "secret"]
     assert secrets, "expected secret findings"
     rules = {s["RuleID"] for r in secrets for s in r["Secrets"]}
     assert "aws-access-key-id" in rules
     assert "generic-password-assignment" in rules
+
+    # the same tree as a filesystem scan reads the lockfiles instead
+    rc, doc = _scan([
+        "filesystem", str(root), "--format", "json",
+        "--db-path", str(env / "db"), "--cache-dir", str(env / "cache"),
+        "--scanners", "vuln", "--quiet",
+    ], capsys)
+    assert rc == 0
+    targets = {r["Target"]: r for r in doc["Results"]
+               if r["Class"] == "lang-pkgs"}
+    assert "app/package-lock.json" in targets
+    assert {v["VulnerabilityID"] for v in
+            targets["app/package-lock.json"]["Vulnerabilities"]} == {"CVE-2019-10744"}
+    assert "app/requirements.txt" in targets
 
 
 def _mk_layer(files: dict[str, bytes]) -> bytes:

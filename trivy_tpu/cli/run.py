@@ -240,7 +240,22 @@ def _select_scanner(args, cache):
 
         LicenseFileAnalyzer.full = bool(getattr(args, "license_full", False))
 
+    # per-target analyzer gating (reference artifact/run.go:178-215):
+    # fs scans read lockfiles, not installed-package stores; rootfs the
+    # inverse; repository additionally skips OS analyzers
+    from trivy_tpu.fanal.analyzer import (
+        TYPE_INDIVIDUAL_PKGS,
+        TYPE_LOCKFILES,
+        TYPE_OSES,
+    )
+
     cmd = args.command
+    if cmd in ("filesystem", "fs"):
+        disabled |= TYPE_INDIVIDUAL_PKGS | {"sbom"}
+    elif cmd == "rootfs":
+        disabled |= TYPE_LOCKFILES
+    elif cmd in ("repository", "repo"):
+        disabled |= TYPE_INDIVIDUAL_PKGS | TYPE_OSES | {"sbom"}
     if cmd == "sbom":
         from trivy_tpu.artifact.sbom import SBOMArtifact
 

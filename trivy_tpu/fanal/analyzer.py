@@ -163,7 +163,7 @@ TYPE_INDIVIDUAL_PKGS = {
 }
 TYPE_LOCKFILES = {
     "bundler", "npm", "yarn", "pnpm", "bun", "pip", "pipenv", "poetry", "uv",
-    "gomod", "cargo", "composer", "jar", "pom", "gradle-lockfile",
+    "gomod", "cargo", "composer", "pom", "gradle-lockfile",
     "sbt-lockfile", "nuget", "dotnet-core", "packages-props", "conan", "pub",
     "hex", "swift", "cocoapods", "conda-environment", "julia", "sbt",
 }
