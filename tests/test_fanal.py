@@ -398,3 +398,39 @@ class TestRepoCheckout:
             assert not glob.glob(str(tmp_path / "tmp" / "trivy-tpu-repo-*"))
         finally:
             _tempfile.tempdir = None
+
+
+def test_ignore_unfixed_and_file_patterns(env, tmp_path, capsys):
+    """--ignore-unfixed drops no-fix findings; --file-patterns routes
+    nonstandard file names into an analyzer (reference
+    pkg/result/filter.go + analyzer.go filePatterns)."""
+    root = tmp_path / "proj"
+    root.mkdir()
+    # nonstandard requirements name only reachable via --file-patterns
+    (root / "requirements-prod.txt").write_text("requests==2.19.1\n")
+    rc, doc = _scan([
+        "filesystem", str(root), "--format", "json",
+        "--db-path", str(env / "db"), "--cache-dir", str(env / "cache"),
+        "--scanners", "vuln", "--quiet",
+        "--file-patterns", r"pip:requirements-prod\.txt$",
+    ], capsys)
+    assert rc == 0
+    targets = {r["Target"] for r in doc["Results"]}
+    assert "requirements-prod.txt" in targets
+    res = next(r for r in doc["Results"]
+               if r["Target"] == "requirements-prod.txt")
+    # CVE-2018-18074 has no fixed version in the fixture DB
+    assert {v["VulnerabilityID"] for v in res["Vulnerabilities"]} == \
+        {"CVE-2018-18074"}
+
+    rc, doc = _scan([
+        "filesystem", str(root), "--format", "json",
+        "--db-path", str(env / "db"), "--cache-dir", str(env / "cache"),
+        "--scanners", "vuln", "--quiet",
+        "--file-patterns", r"pip:requirements-prod\.txt$",
+        "--ignore-unfixed",
+    ], capsys)
+    assert rc == 0
+    for r in doc["Results"]:
+        for v in r.get("Vulnerabilities") or []:
+            assert v.get("FixedVersion"), "unfixed finding not filtered"

@@ -120,6 +120,7 @@ class ImageArtifact:
         parallel: int = 5,
         disabled_analyzers: set[str] | None = None,
         secret_config: str | None = None,
+        file_patterns: list[str] | None = None,
         image_sources: tuple[str, ...] = ("docker", "podman", "remote"),
         insecure: bool = False,
         username: str = "",
@@ -131,13 +132,15 @@ class ImageArtifact:
         self.parallel = parallel
         self.disabled = set(disabled_analyzers or set())
         self.secret_config = secret_config
+        self.file_patterns = file_patterns or []
         self.image_sources = image_sources
         self.insecure = insecure
         self.username = username
         self.password = password
 
     def _group(self) -> AnalyzerGroup:
-        group = AnalyzerGroup.build(disabled_types=self.disabled)
+        group = AnalyzerGroup.build(disabled_types=self.disabled,
+                                    file_patterns=self.file_patterns)
         for a in group.analyzers + group.post_analyzers:
             if a.type == "secret" and self.secret_config:
                 a.configure(self.secret_config)

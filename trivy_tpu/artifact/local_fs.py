@@ -32,6 +32,7 @@ class FSArtifact:
         parallel: int = 5,
         disabled_analyzers: set[str] | None = None,
         secret_config: str | None = None,
+        file_patterns: list[str] | None = None,
     ):
         self.path = path
         self.cache = cache
@@ -41,6 +42,7 @@ class FSArtifact:
         self.parallel = max(parallel, 1)
         self.disabled = set(disabled_analyzers or set())
         self.secret_config = secret_config
+        self.file_patterns = file_patterns or []
 
     def _group(self) -> AnalyzerGroup:
         disabled = set(self.disabled)
@@ -51,7 +53,8 @@ class FSArtifact:
             pass
         enabled = {"config"} if self.misconfig_only else None
         group = AnalyzerGroup.build(disabled_types=disabled,
-                                    enabled_types=enabled)
+                                    enabled_types=enabled,
+                                    file_patterns=self.file_patterns)
         for a in group.analyzers + group.post_analyzers:
             if a.type == "secret" and self.secret_config:
                 a.configure(self.secret_config)

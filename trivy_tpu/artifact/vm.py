@@ -38,15 +38,18 @@ class VMArtifact:
         parallel: int = 5,
         disabled_analyzers: set[str] | None = None,
         secret_config: str | None = None,
+        file_patterns: list[str] | None = None,
     ):
         self.target = target
         self.cache = cache
         self.parallel = parallel
         self.disabled = set(disabled_analyzers or set())
         self.secret_config = secret_config
+        self.file_patterns = file_patterns or []
 
     def _group(self) -> AnalyzerGroup:
-        group = AnalyzerGroup.build(disabled_types=self.disabled)
+        group = AnalyzerGroup.build(disabled_types=self.disabled,
+                                    file_patterns=self.file_patterns)
         for a in group.analyzers + group.post_analyzers:
             if a.type == "secret" and self.secret_config:
                 a.configure(self.secret_config)

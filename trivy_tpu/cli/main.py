@@ -47,6 +47,10 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--offline-scan", action="store_true")
     p.add_argument("--list-all-pkgs", action="store_true")
     p.add_argument("--ignorefile", default=".trivyignore")
+    p.add_argument("--ignore-unfixed", action="store_true",
+                   help="hide vulnerabilities with no fixed version")
+    p.add_argument("--file-patterns", action="append", default=[],
+                   help="analyzer file pattern (type:regex); repeatable")
     p.add_argument("--ignore-status", default=None,
                    help="comma-separated statuses to ignore")
     p.add_argument("--exit-code", type=int, default=0)

@@ -179,7 +179,8 @@ def _run_scan_core(args, compliance_spec) -> int:
     ignore_cfg = load_ignore_file(args.ignorefile)
     statuses = (args.ignore_status or "").split(",") if args.ignore_status else None
     filter_report(report, severities=severities, ignore_statuses=statuses,
-                  ignore_config=ignore_cfg)
+                  ignore_config=ignore_cfg,
+                  ignore_unfixed=getattr(args, "ignore_unfixed", False))
 
     if compliance_spec is not None:
         from trivy_tpu.compliance.report import (
@@ -270,6 +271,7 @@ def _select_scanner(args, cache):
             misconfig_only=(cmd == "config"),
             parallel=args.parallel,
             disabled_analyzers=disabled,
+            file_patterns=getattr(args, "file_patterns", []),
         ), driver
     if cmd in ("repository", "repo"):
         from trivy_tpu.artifact.repo import RepoArtifact
@@ -297,6 +299,7 @@ def _select_scanner(args, cache):
             target, cache, from_tar=bool(getattr(args, "input", None)),
             parallel=args.parallel,
             disabled_analyzers=disabled,
+            file_patterns=getattr(args, "file_patterns", []),
             image_sources=sources,
             insecure=getattr(args, "insecure", False),
             username=getattr(args, "username", ""),
@@ -309,6 +312,7 @@ def _select_scanner(args, cache):
             args.target, cache,
             parallel=args.parallel,
             disabled_analyzers=disabled,
+            file_patterns=getattr(args, "file_patterns", []),
         ), driver
     raise FatalError(f"unsupported scan command {cmd!r}")
 

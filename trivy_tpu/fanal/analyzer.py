@@ -169,6 +169,15 @@ TYPE_LOCKFILES = {
 }
 
 
+def _pattern_required(a, path: str, size: int, mode: int) -> bool:
+    """required() widened by --file-patterns regexes attached to the
+    analyzer copy (reference analyzer.go:321-377 filePatterns)."""
+    pats = getattr(a, "extra_patterns", None)
+    if pats and any(p.search(path) for p in pats):
+        return True
+    return a.required(path, size, mode)
+
+
 @dataclass
 class AnalyzerGroup:
     """The set of analyzers active for one scan."""
@@ -181,8 +190,21 @@ class AnalyzerGroup:
         cls,
         disabled_types: set[str] | None = None,
         enabled_types: set[str] | None = None,
+        file_patterns: list[str] | None = None,
     ) -> "AnalyzerGroup":
+        """file_patterns: `analyzer-type:regex` entries (reference
+        analyzer.go:321-377 filePatterns) — a file whose path matches the
+        regex is fed to that analyzer even if required() declines it."""
+        import re as _re
+
         disabled = disabled_types or set()
+        patterns: dict[str, list] = {}
+        for entry in file_patterns or []:
+            atype, _, pat = entry.partition(":")
+            if not pat:
+                raise ValueError(
+                    f"invalid file pattern {entry!r} (want type:regex)")
+            patterns.setdefault(atype, []).append(_re.compile(pat))
 
         def keep(a: Analyzer) -> bool:
             if a.type in disabled:
@@ -191,9 +213,19 @@ class AnalyzerGroup:
                 return False
             return True
 
+        def wrap(a):
+            pats = patterns.get(a.type)
+            if not pats:
+                return a
+            import copy
+
+            a2 = copy.copy(a)
+            a2.extra_patterns = pats
+            return a2
+
         return cls(
-            analyzers=[a for a in _ANALYZERS if keep(a)],
-            post_analyzers=[a for a in _POST_ANALYZERS if keep(a)],
+            analyzers=[wrap(a) for a in _ANALYZERS if keep(a)],
+            post_analyzers=[wrap(a) for a in _POST_ANALYZERS if keep(a)],
         )
 
     def versions(self) -> dict[str, int]:
@@ -206,7 +238,7 @@ class AnalyzerGroup:
                      post_files: dict) -> None:
         for a in self.analyzers:
             try:
-                if not a.required(inp.path, inp.size, inp.mode):
+                if not _pattern_required(a, inp.path, inp.size, inp.mode):
                     continue
                 result.merge(a.analyze(inp))
             except Exception as e:  # analyzer bugs must not kill the scan
@@ -214,7 +246,7 @@ class AnalyzerGroup:
                            path=inp.path, err=str(e))
         for pa in self.post_analyzers:
             try:
-                if pa.required(inp.path, inp.size, inp.mode):
+                if _pattern_required(pa, inp.path, inp.size, inp.mode):
                     inp.read()
                     post_files.setdefault(pa.type, {})[inp.path] = inp
             except Exception as e:

@@ -40,12 +40,19 @@ class _LockfileAnalyzer(PostAnalyzer):
     def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
         return os.path.basename(path) in self.filenames
 
+    def _accepts(self, path: str) -> bool:
+        # post_files buckets are keyed by analyzer type; two analyzers
+        # sharing a type must not run on each other's files.  Files routed
+        # in via --file-patterns are accepted too.
+        if os.path.basename(path) in self.filenames:
+            return True
+        return any(p.search(path)
+                   for p in getattr(self, "extra_patterns", ()))
+
     def post_analyze(self, files: dict[str, AnalysisInput]):
         res = AnalysisResult()
         for path, inp in sorted(files.items()):
-            # post_files buckets are keyed by analyzer type; two analyzers
-            # sharing a type must not run on each other's files
-            if os.path.basename(path) not in self.filenames:
+            if not self._accepts(path):
                 continue
             got = _app(self.app_type, path, type(self).parser(inp.read()))
             res.merge(got)

@@ -15,11 +15,12 @@ def filter_report(
     ignore_statuses: list[str] | None = None,
     ignore_config: IgnoreConfig | None = None,
     include_non_failures: bool = False,
+    ignore_unfixed: bool = False,
 ) -> Report:
     for res in report.results:
         filter_result(
             res, severities, ignore_statuses, ignore_config,
-            include_non_failures,
+            include_non_failures, ignore_unfixed,
         )
     return report
 
@@ -30,6 +31,7 @@ def filter_result(
     ignore_statuses=None,
     ignore_config: IgnoreConfig | None = None,
     include_non_failures: bool = False,
+    ignore_unfixed: bool = False,
 ) -> None:
     sev_names = {str(s) for s in severities} if severities else None
     statuses = set(ignore_statuses or [])
@@ -43,6 +45,9 @@ def filter_result(
         for v in res.vulnerabilities
         if sev_ok(str(v.severity))
         and (not statuses or v.status.label not in statuses)
+        # --ignore-unfixed (reference pkg/result/filter.go): drop
+        # findings with no fix available
+        and not (ignore_unfixed and not v.fixed_version)
         and not ign.ignored(
             "vulnerabilities", v.vulnerability_id,
             path=v.pkg_path or res.target, purl=v.pkg_identifier.purl,
