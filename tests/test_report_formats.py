@@ -242,3 +242,38 @@ def test_convert_roundtrip(report, tmp_path, capsys):
     doc = json.loads(out.read_text())
     ids = [r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]]
     assert "CVE-2019-14697" in ids
+
+
+def test_dependency_tree_rendering():
+    from trivy_tpu.report.table import render_table
+    from trivy_tpu.types.report import (
+        DetectedVulnerability,
+        Metadata,
+        Report,
+        Result,
+        VulnerabilityInfo,
+    )
+    from trivy_tpu.types.artifact import Package
+
+    res = Result(
+        target="app/package-lock.json", result_class="lang-pkgs", type="npm",
+        packages=[
+            Package(id="demo@1.0.0", name="demo", version="1.0.0",
+                    depends_on=["express@4.0.0"]),
+            Package(id="express@4.0.0", name="express", version="4.0.0",
+                    depends_on=["lodash@4.17.4"]),
+            Package(id="lodash@4.17.4", name="lodash", version="4.17.4"),
+        ],
+        vulnerabilities=[DetectedVulnerability(
+            vulnerability_id="CVE-2019-10744", pkg_id="lodash@4.17.4",
+            pkg_name="lodash", installed_version="4.17.4",
+            info=VulnerabilityInfo(severity="CRITICAL", title="pp"))],
+    )
+    report = Report(artifact_name="x", artifact_type="filesystem",
+                    metadata=Metadata(), results=[res])
+    text = render_table(report, dependency_tree=True)
+    assert "Dependency Origin Tree" in text
+    assert "lodash@4.17.4 (vulnerable)" in text
+    assert "└── express@4.0.0" in text
+    # without the flag the tree is absent
+    assert "Origin Tree" not in render_table(report)

@@ -49,6 +49,9 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--ignorefile", default=".trivyignore")
     p.add_argument("--ignore-unfixed", action="store_true",
                    help="hide vulnerabilities with no fixed version")
+    p.add_argument("--dependency-tree", action="store_true",
+                   help="show a reversed dependency origin tree for "
+                        "vulnerable packages (table format)")
     p.add_argument("--file-patterns", action="append", default=[],
                    help="analyzer file pattern (type:regex); repeatable")
     p.add_argument("--ignore-status", default=None,
@@ -271,7 +274,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("clean", help="clean caches", allow_abbrev=False)
     _add_global_flags(p)
-    p.add_argument("--all", action="store_true")
+    p.add_argument("--all", "-a", action="store_true",
+                   help="remove everything under the cache dir")
+    p.add_argument("--scan-cache", action="store_true",
+                   help="remove cached scan blobs")
+    p.add_argument("--vuln-db", action="store_true",
+                   help="remove the advisory DB")
+    p.add_argument("--java-db", action="store_true",
+                   help="remove the java GAV DB")
 
     p = sub.add_parser("config", help="scan config files for misconfigurations", allow_abbrev=False)
     _add_global_flags(p)

@@ -199,7 +199,8 @@ def _run_scan_core(args, compliance_spec) -> int:
                 out.close()
     else:
         write_report(report, fmt=args.format, output=args.output,
-                     template=args.template, severities=severities)
+                     template=args.template, severities=severities,
+                     dependency_tree=getattr(args, "dependency_tree", False))
 
     # exit-code policy (reference pkg/commands/operation/operation.go:118)
     if args.exit_code:
@@ -573,12 +574,24 @@ def _import_json(path: str):
 
 
 def run_clean(args) -> int:
+    """`clean` (reference pkg/commands/clean): selective cache removal."""
     import shutil
 
     if args.all:
         shutil.rmtree(args.cache_dir, ignore_errors=True)
         _log.info("removed cache", path=args.cache_dir)
-    else:
+        return 0
+    selected = False
+    if getattr(args, "vuln_db", False):
+        shutil.rmtree(os.path.join(args.cache_dir, "db"), ignore_errors=True)
+        _log.info("removed advisory DB")
+        selected = True
+    if getattr(args, "java_db", False):
+        shutil.rmtree(os.path.join(args.cache_dir, "javadb"),
+                      ignore_errors=True)
+        _log.info("removed java DB")
+        selected = True
+    if getattr(args, "scan_cache", False) or not selected:
         shutil.rmtree(os.path.join(args.cache_dir, "fanal"),
                       ignore_errors=True)
         _log.info("removed scan cache")

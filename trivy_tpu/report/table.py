@@ -90,14 +90,57 @@ def _wrap(s: str, width: int) -> list[str]:
     return lines or [""]
 
 
-def render_table(report: Report, severities=None) -> str:
+def render_table(report: Report, severities=None,
+                 dependency_tree: bool = False) -> str:
     color = _color_enabled()
     out = []
     sev_names = [str(s) for s in severities] if severities else _SEV_ORDER
     for res in report.results:
-        out.append(_render_result(res, color, sev_names))
+        rendered = _render_result(res, color, sev_names)
+        if rendered and dependency_tree and res.vulnerabilities:
+            tree = _render_dependency_tree(res)
+            if tree:
+                rendered += tree
+        out.append(rendered)
     text = "\n".join(x for x in out if x)
     return text if text else "No issues detected.\n"
+
+
+def _render_dependency_tree(res: Result) -> str:
+    """--dependency-tree: why is each vulnerable package present?
+    Reversed origin tree from the lockfile dependency graph
+    (reference pkg/report/table renderedDeps)."""
+    parents: dict[str, list[str]] = {}
+    by_id: dict[str, object] = {}
+    for p in res.packages:
+        pid = p.id or f"{p.name}@{p.version}"
+        by_id[pid] = p
+        for dep in p.depends_on:
+            parents.setdefault(dep, []).append(pid)
+    if not parents:
+        return ""
+    vuln_ids = []
+    seen = set()
+    for v in res.vulnerabilities:
+        pid = v.pkg_id or f"{v.pkg_name}@{v.installed_version}"
+        if pid not in seen:
+            seen.add(pid)
+            vuln_ids.append(pid)
+    lines = ["", "Dependency Origin Tree (Reversed)", "=" * 33]
+    for pid in vuln_ids:
+        lines.append(f"{pid} (vulnerable)")
+        chain = []
+        cur, depth = pid, 0
+        while depth < 8:
+            ups = parents.get(cur) or []
+            if not ups:
+                break
+            cur = sorted(ups)[0]
+            chain.append(cur)
+            depth += 1
+        for i, anc in enumerate(chain):
+            lines.append("    " * i + "└── " + anc)
+    return "\n".join(lines) + "\n"
 
 
 def _render_result(res: Result, color: bool, sev_names) -> str:

@@ -16,6 +16,7 @@ def write_report(
     output: str | None = None,
     template: str | None = None,
     severities=None,
+    dependency_tree: bool = False,
 ) -> None:
     if fmt == "json":
         from trivy_tpu.report.json_writer import render_json
@@ -24,7 +25,8 @@ def write_report(
     elif fmt == "table":
         from trivy_tpu.report.table import render_table
 
-        text = render_table(report, severities=severities)
+        text = render_table(report, severities=severities,
+                            dependency_tree=dependency_tree)
     elif fmt == "sarif":
         from trivy_tpu.report.sarif import render_sarif
 
