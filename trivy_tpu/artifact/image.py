@@ -180,13 +180,23 @@ class ImageArtifact:
             artifact_id, blob_ids
         )
         missing_set = set(missing_blobs)
-        # base layers are guessed from history to skip secret scanning
-        # there (reference image.go:527) — not yet implemented; all layers
-        # get the full analyzer set.
+        # base layers (guessed from history) skip secret scanning: their
+        # secrets are the base image author's, not this image's
+        # (reference image.go:527 guessBaseLayers)
+        base_diff_ids = set(_guess_base_diff_ids(
+            diff_ids, img.config.get("history") or []))
+        no_secret_group = None
         for i, (diff_id, blob_id) in enumerate(zip(diff_ids, blob_ids)):
             if blob_id not in missing_set:
                 continue
-            self._inspect_layer(group, img, i, diff_id, blob_id)
+            g = group
+            if diff_id in base_diff_ids:
+                if no_secret_group is None:
+                    no_secret_group = AnalyzerGroup.build(
+                        disabled_types=self.disabled | {"secret"},
+                        file_patterns=self.file_patterns)
+                g = no_secret_group
+            self._inspect_layer(g, img, i, diff_id, blob_id)
 
         if missing_artifact:
             info = self._inspect_config(img)
@@ -303,4 +313,44 @@ def _history_apk_packages(history: list[dict]) -> list[Package]:
                 out.append(Package(
                     id=f"{name}@{ver}" if ver else name,
                     name=name, version=ver))
+    return out
+
+
+def guess_base_image_index(history: list[dict]) -> int:
+    """Index of the last base-image history entry: the trailing CMD of
+    the base image, scanning backward past this image's own metadata
+    entries (reference pkg/fanal/image/image.go:111-137)."""
+    found_non_empty = False
+    for i in range(len(history) - 1, -1, -1):
+        h = history[i]
+        empty = bool(h.get("empty_layer"))
+        if not found_non_empty:
+            if empty:
+                continue
+            found_non_empty = True
+        if not empty:
+            continue
+        created_by = h.get("created_by", "")
+        if created_by.startswith("/bin/sh -c #(nop)  CMD") or \
+                created_by.startswith("CMD"):
+            return i
+    return -1
+
+
+def _guess_base_diff_ids(diff_ids: list[str],
+                         history: list[dict]) -> list[str]:
+    """history index -> diff IDs (empty layers excluded)
+    (reference image.go:527-554)."""
+    base_index = guess_base_image_index(history)
+    out = []
+    diff_idx = 0
+    for i, h in enumerate(history):
+        if i > base_index:
+            break
+        if h.get("empty_layer"):
+            continue
+        if diff_idx >= len(diff_ids):
+            return []
+        out.append(diff_ids[diff_idx])
+        diff_idx += 1
     return out
