@@ -434,3 +434,18 @@ def test_ignore_unfixed_and_file_patterns(env, tmp_path, capsys):
     for r in doc["Results"]:
         for v in r.get("Vulnerabilities") or []:
             assert v.get("FixedVersion"), "unfixed finding not filtered"
+
+
+def test_secret_prefilter_straddles_chunk_boundary():
+    """A keyword split across two chunks is caught by the overlap
+    windows (SURVEY hard part #2: chunk batching with overlap)."""
+    from trivy_tpu.ops.secret_prefilter import (
+        CHUNK, DevicePrefilter, HostPrefilter, KeywordBank,
+    )
+
+    bank = KeywordBank([b"secret_keyword"])
+    # place the keyword so it starts 5 bytes before the chunk boundary
+    content = b"x" * (CHUNK - 5) + b"SECRET_KEYWORD" + b"y" * 100
+    dev = DevicePrefilter(bank).keyword_hits([content])
+    host = HostPrefilter(bank).keyword_hits([content])
+    assert dev[0, 0] and (dev == host).all()
