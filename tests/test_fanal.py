@@ -215,6 +215,24 @@ def test_image_tar_scan(env, tmp_path, capsys):
     assert doc2["Results"] == doc["Results"]
 
 
+def test_file_patterns_in_cache_key(tmp_path):
+    """Scans with different --file-patterns must not share cached layer
+    analyses (ADVICE r1; reference CalcKey includes FilePatterns)."""
+    from trivy_tpu.artifact.image import ImageArtifact
+    from trivy_tpu.cache.cache import MemoryCache
+
+    layer = _mk_layer({"etc/os-release": OS_RELEASE.encode()})
+    tar_path = str(tmp_path / "img.tar")
+    _mk_image_tar(tar_path, [layer])
+    cache = MemoryCache()
+    ref_plain = ImageArtifact(tar_path, cache, from_tar=True).inspect()
+    ref_pat = ImageArtifact(
+        tar_path, cache, from_tar=True,
+        file_patterns=["pip:custom-req\\.txt"]).inspect()
+    assert ref_plain.blob_ids != ref_pat.blob_ids
+    assert ref_plain.id != ref_pat.id
+
+
 def test_layer_attribution(env, tmp_path, capsys):
     layer1 = _mk_layer({
         "etc/os-release": OS_RELEASE.encode(),
@@ -359,6 +377,33 @@ class TestRepoCheckout:
         assert pkgs[0]["version"] == "1.0"
         art.clean(ref)
         assert art._tmp is None
+
+    def test_local_dir_with_revision_does_not_mutate(self, tmp_path):
+        """A local dir scanned at a revision must be cloned to a temp dir,
+        never checked out in place (ADVICE r1: scanner is read-only)."""
+        import subprocess
+
+        from trivy_tpu.artifact.repo import RepoArtifact
+        from trivy_tpu.cache.cache import MemoryCache
+
+        repo = self._mk_repo(tmp_path)
+        head_before = subprocess.run(
+            ["git", "-C", str(repo), "rev-parse", "HEAD"],
+            capture_output=True, text=True, check=True).stdout
+        worktree_before = (repo / "requirements.txt").read_text()
+        art = RepoArtifact(str(repo), MemoryCache(), tag="v1.0")
+        ref = art.inspect()
+        # scan saw the v1.0 content...
+        blob = art.cache.get_blob(ref.blob_ids[0])
+        pkgs = [p for a in blob["applications"] for p in a["packages"]]
+        assert pkgs[0]["version"] == "1.0"
+        # ...but the user's repo is untouched
+        head_after = subprocess.run(
+            ["git", "-C", str(repo), "rev-parse", "HEAD"],
+            capture_output=True, text=True, check=True).stdout
+        assert head_after == head_before
+        assert (repo / "requirements.txt").read_text() == worktree_before
+        art.clean(ref)
 
     def test_branch_tag_conflict(self, tmp_path):
         import pytest as _pytest

@@ -72,6 +72,37 @@ class TestPluginManager:
         with pytest.raises(PluginError, match="unsafe path"):
             mgr.install(str(zpath))
 
+    def test_traversal_manifest_name_rejected(self, tmp_path):
+        """A manifest name like ../../x must not escape the plugin root
+        (ADVICE r1: zip-slip via manifest name)."""
+        victim = tmp_path / "victim"
+        victim.mkdir()
+        (victim / "keep.txt").write_text("data")
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            zf.writestr("plugin.yaml",
+                        MANIFEST.replace("echo-plugin", "../../victim"))
+            zf.writestr("echo.sh", SCRIPT)
+        zpath = tmp_path / "evil-name.zip"
+        zpath.write_bytes(buf.getvalue())
+        mgr = PluginManager(str(tmp_path / "cache"))
+        with pytest.raises(PluginError, match="invalid plugin name"):
+            mgr.install(str(zpath))
+        assert (victim / "keep.txt").exists()
+        with pytest.raises(PluginError, match="invalid plugin name"):
+            mgr.uninstall("../../victim")
+
+    def test_dot_name_rejected(self, tmp_path):
+        """name '.' would resolve _dir() to the plugin root and rmtree
+        every installed plugin; 'my..plugin' is a legal single component."""
+        mgr = PluginManager(str(tmp_path / "cache"))
+        mgr.install(_mk_plugin_dir(tmp_path))
+        for bad in (".", ".."):
+            with pytest.raises(PluginError, match="invalid plugin name"):
+                mgr.uninstall(bad)
+        assert [p.name for p in mgr.list()] == ["echo-plugin"]
+        assert mgr.get("my..plugin") is None  # valid name, just not installed
+
     def test_uninstall(self, tmp_path):
         mgr = PluginManager(str(tmp_path / "cache"))
         mgr.install(_mk_plugin_dir(tmp_path))

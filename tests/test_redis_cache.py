@@ -203,3 +203,54 @@ class TestRedisCache:
         # (reference artifact/local/fs.go), so assert on writes seen
         assert any(k.startswith(b"fanal::blob::")
                    for k in _FakeRedisHandler.set_log)
+
+
+class TestTLSVerification:
+    """rediss:// without --redis-ca must verify against system roots;
+    only an explicit insecure opt-in may disable verification (ADVICE r1)."""
+
+    def _wrap_ctxs(self, monkeypatch):
+        import ssl as _ssl
+
+        import trivy_tpu.cache.redis as redis_mod
+
+        captured = []
+        real = _ssl.create_default_context
+
+        def fake_create(cafile=None):
+            ctx = real(cafile=cafile)
+            captured.append(ctx)
+            return ctx
+
+        class _FakeSock:
+            def sendall(self, *_): raise OSError("fake")
+            def recv(self, *_): return b""
+            def close(self): pass
+
+        monkeypatch.setattr(redis_mod.ssl, "create_default_context",
+                            fake_create)
+        monkeypatch.setattr(
+            redis_mod.socket, "create_connection",
+            lambda *a, **k: _FakeSock())
+        return captured
+
+    def test_default_verifies(self, monkeypatch):
+        import ssl as _ssl
+        captured = self._wrap_ctxs(monkeypatch)
+        # wrap_socket on a fake socket fails — we only care about the
+        # context configuration at the moment of wrapping
+        with pytest.raises(Exception):
+            RespClient("localhost", 1, tls=True)
+        assert captured, "TLS context was never created"
+        ctx = captured[0]
+        assert ctx.verify_mode == _ssl.CERT_REQUIRED
+        assert ctx.check_hostname
+
+    def test_insecure_optin_disables(self, monkeypatch):
+        import ssl as _ssl
+        captured = self._wrap_ctxs(monkeypatch)
+        with pytest.raises(Exception):
+            RespClient("localhost", 1, tls=True, insecure=True)
+        ctx = captured[0]
+        assert ctx.verify_mode == _ssl.CERT_NONE
+        assert not ctx.check_hostname

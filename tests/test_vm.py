@@ -203,3 +203,19 @@ class TestSparseVMDK:
         fh.seek(len(payload))
         assert fh.read(4096) == b"\x00" * 4096
         fh.close()
+
+
+def test_unwritten_extent_reads_as_zeros():
+    """Unwritten (preallocated) extents must not leak stale disk bytes
+    (ADVICE r1); they read back as zeros like holes."""
+    import struct
+
+    from trivy_tpu.fanal.vm.ext4 import Ext4
+
+    # leaf extent node: header + two extents, one written one unwritten
+    hdr = struct.pack("<HHHHI", 0xF30A, 2, 4, 0, 0)
+    written = struct.pack("<IHHI", 0, 1, 0, 100)          # block 0 -> phys 100
+    unwritten = struct.pack("<IHHI", 1, 32768 + 1, 0, 101)  # block 1, uninit
+    node = hdr + written + unwritten
+    blocks = list(Ext4._extent_blocks(object.__new__(Ext4), node))
+    assert blocks == [(0, 100, 1)]

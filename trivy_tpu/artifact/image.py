@@ -172,9 +172,11 @@ class ImageArtifact:
         diff_ids = img.diff_ids()
         # cache keys: diffID x analyzer versions (reference image.go:169)
         blob_ids = [
-            cache_key(d, analyzer_versions=versions) for d in diff_ids
+            cache_key(d, analyzer_versions=versions,
+                      patterns=self.file_patterns) for d in diff_ids
         ]
-        artifact_id = cache_key(img.config_digest, analyzer_versions=versions)
+        artifact_id = cache_key(img.config_digest, analyzer_versions=versions,
+                                patterns=self.file_patterns)
 
         missing_artifact, missing_blobs = self.cache.missing_blobs(
             artifact_id, blob_ids

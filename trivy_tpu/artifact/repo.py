@@ -37,18 +37,18 @@ class RepoArtifact:
         for ref in (self.branch, self.tag, self.commit):
             if ref.startswith("-"):
                 raise RuntimeError(f"invalid git ref {ref!r}")
-        if os.path.isdir(self.target):
-            if self.branch or self.tag or self.commit:
-                # a local directory is scanned in place; silently ignoring
-                # the requested revision would mis-attribute the report,
-                # so check it out (fails loudly on a non-git dir)
-                self._git(["git", "-C", self.target, "checkout",
-                           self.commit or self.tag or self.branch, "--"])
+        local = os.path.isdir(self.target)
+        if local and not (self.branch or self.tag or self.commit):
             return self.target
+        # A scanner must never mutate its input: a local directory with a
+        # requested revision is cloned (--local shares objects, no copy) into
+        # a temp dir and checked out THERE, leaving the user's HEAD alone.
         self._tmp = tempfile.mkdtemp(prefix="trivy-tpu-repo-")
         try:
             cmd = ["git", "clone"]
-            if not self.commit:
+            if local:
+                cmd += ["--local"]
+            elif not self.commit:
                 cmd += ["--depth", "1"]  # arbitrary commits need history
             if self.branch:
                 cmd += ["--branch", self.branch]

@@ -31,12 +31,14 @@ class RespClient:
     def __init__(self, host: str, port: int, *, username: str = "",
                  password: str = "", db: int = 0, tls: bool = False,
                  ca_cert: str = "", cert: str = "", key: str = "",
-                 timeout: float = 10.0):
+                 insecure: bool = False, timeout: float = 10.0):
         sock = socket.create_connection((host, port), timeout=timeout)
         if tls:
-            ctx = ssl.create_default_context(
-                cafile=ca_cert or None)
-            if not ca_cert:
+            # No --redis-ca means "verify against system roots", never
+            # "don't verify"; disabling verification requires an explicit
+            # insecure opt-in (reference redis.go errors without CA+cert+key).
+            ctx = ssl.create_default_context(cafile=ca_cert or None)
+            if insecure:
                 ctx.check_hostname = False
                 ctx.verify_mode = ssl.CERT_NONE
             if cert and key:
@@ -137,7 +139,7 @@ class RedisCache:
 
     def __init__(self, backend: str, *, ca_cert: str = "", cert: str = "",
                  key: str = "", tls: bool = False, ttl: int = 0,
-                 client: RespClient | None = None):
+                 insecure: bool = False, client: RespClient | None = None):
         if client is not None:
             self._client = client
         else:
@@ -146,7 +148,7 @@ class RedisCache:
             self._client = RespClient(
                 opts["host"], opts["port"], username=opts["username"],
                 password=opts["password"], db=opts["db"], tls=opts["tls"],
-                ca_cert=ca_cert, cert=cert, key=key)
+                ca_cert=ca_cert, cert=cert, key=key, insecure=insecure)
         self.ttl = ttl
 
     @staticmethod

@@ -72,6 +72,9 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--redis-key", default="", help="redis client key path")
     p.add_argument("--redis-tls", action="store_true",
                    help="enable TLS for the redis cache backend")
+    p.add_argument("--redis-insecure", action="store_true",
+                   help="skip certificate verification for the redis "
+                        "cache backend (NOT recommended)")
     p.add_argument("--skip-files", action="append", default=[])
     p.add_argument("--skip-dirs", action="append", default=[])
     p.add_argument("--sbom-sources", default="",

@@ -145,7 +145,8 @@ def _run_scan_core(args, compliance_spec) -> int:
                 backend, ca_cert=getattr(args, "redis_ca", ""),
                 cert=getattr(args, "redis_cert", ""),
                 key=getattr(args, "redis_key", ""),
-                tls=getattr(args, "redis_tls", False))
+                tls=getattr(args, "redis_tls", False),
+                insecure=getattr(args, "redis_insecure", False))
         except (OSError, RedisError) as e:
             raise FatalError(f"redis cache backend: {e}")
     elif backend == "memory":
@@ -528,7 +529,7 @@ def run_db(args) -> int:
         try:
             names = download_artifact(
                 args.db_repository, dest, media_type=DB_MEDIA_TYPE,
-                insecure=getattr(args, "insecure", False))
+                insecure=getattr(args, "redis_insecure", False))
         except OCIError as e:
             raise FatalError(str(e))
         _log.info("advisory DB downloaded", path=dest, files=len(names))

@@ -164,8 +164,11 @@ class Ext4:
                 off = 12 + i * 12
                 ee_block, ee_len, hi, lo = struct.unpack_from(
                     "<IHHI", node_raw, off)
-                if ee_len > 32768:  # unwritten extent marker
-                    ee_len -= 32768
+                if ee_len > 32768:
+                    # unwritten extent: allocated but uninitialized — reads
+                    # as zeros, so treat it as a hole rather than exposing
+                    # whatever stale bytes sit on disk
+                    continue
                 yield ee_block, (hi << 32) | lo, ee_len
         else:
             for i in range(entries):

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import platform as _platform
+import re
 import shutil
 import stat
 import subprocess
@@ -100,11 +101,18 @@ class Plugin:
         return proc.returncode
 
 
+_SAFE_NAME = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
 class PluginManager:
     def __init__(self, cache_dir: str):
         self.root = os.path.join(cache_dir, "plugin")
 
     def _dir(self, name: str) -> str:
+        # the name may come from an untrusted zip/URL manifest; a name like
+        # "../../target" would rmtree/copytree outside the plugin root
+        if not _SAFE_NAME.match(name) or name in (".", ".."):
+            raise PluginError(f"invalid plugin name {name!r}")
         return os.path.join(self.root, name)
 
     # ------------------------------------------------------------- list
