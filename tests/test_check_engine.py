@@ -1,0 +1,393 @@
+"""User-extensible check engine tests (the Rego-equivalent surface:
+reference pkg/iac/rego/scanner_test.go + pkg/policy shapes)."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from trivy_tpu.iac import engine
+from trivy_tpu.iac.engine import (
+    CheckLoadError,
+    CheckSet,
+    input_doc,
+    load_check_path,
+    resolve_path,
+)
+
+K8S_BAD = b"""\
+apiVersion: v1
+kind: Pod
+metadata:
+  name: badpod
+spec:
+  hostNetwork: true
+  containers:
+    - name: app
+      image: nginx
+      securityContext:
+        privileged: true
+"""
+
+K8S_GOOD = b"""\
+apiVersion: v1
+kind: Pod
+metadata:
+  name: goodpod
+spec:
+  containers:
+    - name: app
+      image: nginx
+      securityContext:
+        runAsNonRoot: true
+        privileged: false
+"""
+
+YAML_CHECK = """\
+id: USR-001
+title: hostNetwork must not be used
+severity: HIGH
+type: kubernetes
+deny:
+  - path: spec.hostNetwork
+    equals: true
+    message: pod uses hostNetwork
+"""
+
+PY_CHECK = '''\
+__check__ = {
+    "id": "USR-100",
+    "title": "images must come from corp registry",
+    "severity": "CRITICAL",
+    "type": "kubernetes",
+    "namespace": "user.registry",
+}
+
+def deny(input, data=None):
+    allowed = (data or {}).get("allowed_registries", ["corp.example"])
+    out = []
+    for c in (input.get("spec", {}).get("containers") or []):
+        image = c.get("image", "")
+        if not any(image.startswith(r + "/") for r in allowed):
+            out.append({"message": f"image {image} not from corp registry"})
+    return out
+'''
+
+
+@pytest.fixture(autouse=True)
+def _reset_engine():
+    yield
+    engine.reset()
+
+
+def _scan(content: bytes, path="pod.yaml"):
+    from trivy_tpu.misconf.scanner import scan_config
+
+    return scan_config(path, content)
+
+
+class TestResolvePath:
+    DOC = {"spec": {"containers": [
+        {"name": "a", "ports": [{"port": 80}, {"port": 443}]},
+        {"name": "b"},
+    ], "hostNetwork": True}}
+
+    def test_scalar(self):
+        assert resolve_path(self.DOC, "spec.hostNetwork") == [True]
+
+    def test_wildcard(self):
+        assert resolve_path(self.DOC, "spec.containers[*].name") == ["a", "b"]
+
+    def test_nested_wildcards(self):
+        assert resolve_path(
+            self.DOC, "spec.containers[*].ports[*].port") == [80, 443]
+
+    def test_index(self):
+        assert resolve_path(self.DOC, "spec.containers[1].name") == ["b"]
+
+    def test_missing(self):
+        assert resolve_path(self.DOC, "spec.nope.deep") == []
+
+
+class TestYamlDSL:
+    def test_custom_check_fails_and_passes(self, tmp_path):
+        d = tmp_path / "checks"
+        d.mkdir()
+        (d / "hostnet.yaml").write_text(YAML_CHECK)
+        engine.configure(check_paths=[str(d)], namespaces=["user"])
+
+        m = _scan(K8S_BAD)
+        fail_ids = {f.id for f in m.failures}
+        assert "USR-001" in fail_ids
+        f = next(f for f in m.failures if f.id == "USR-001")
+        assert f.message == "pod uses hostNetwork"
+        assert f.severity == "HIGH"
+        assert f.namespace == "user"
+        assert f.cause_metadata.resource == "badpod"
+
+        m2 = _scan(K8S_GOOD)
+        assert "USR-001" in {p.id for p in m2.successes}
+        assert "USR-001" not in {f.id for f in m2.failures}
+
+    def test_namespace_gating(self, tmp_path):
+        """Custom checks outside enabled namespaces are not evaluated
+        (reference scanner.go:193-196)."""
+        d = tmp_path / "checks"
+        d.mkdir()
+        (d / "hostnet.yaml").write_text(YAML_CHECK)
+        engine.configure(check_paths=[str(d)])  # no --check-namespaces
+        m = _scan(K8S_BAD)
+        all_ids = {x.id for x in m.failures + m.successes}
+        assert "USR-001" not in all_ids
+
+    def test_operators(self, tmp_path):
+        check = textwrap.dedent("""\
+            id: USR-OPS
+            title: ops
+            type: kubernetes
+            deny:
+              - all:
+                  - path: kind
+                    equals: Pod
+                  - path: spec.containers[*].image
+                    regex: "^nginx"
+                  - not:
+                      path: spec.containers[*].securityContext.runAsNonRoot
+                      equals: true
+                message: nginx must run non-root
+        """)
+        d = tmp_path / "c"
+        d.mkdir()
+        (d / "ops.yaml").write_text(check)
+        engine.configure(check_paths=[str(d)], namespaces=["user"])
+        assert "USR-OPS" in {f.id for f in _scan(K8S_BAD).failures}
+        assert "USR-OPS" in {s.id for s in _scan(K8S_GOOD).successes}
+
+    def test_bad_check_rejected(self, tmp_path):
+        (tmp_path / "bad.yaml").write_text("id: X\ntitle: t\n"
+                                           "type: kubernetes\n"
+                                           "deny:\n  - path: a.b\n")
+        with pytest.raises(CheckLoadError, match="no operator"):
+            load_check_path(str(tmp_path / "bad.yaml"))
+
+    def test_unknown_type_rejected(self, tmp_path):
+        (tmp_path / "bad.yaml").write_text(
+            "id: X\ntitle: t\ntype: nonsense\ndeny: []\n")
+        with pytest.raises(CheckLoadError, match="unknown source type"):
+            load_check_path(str(tmp_path / "bad.yaml"))
+
+
+class TestPythonChecks:
+    def test_python_check_with_data(self, tmp_path):
+        d = tmp_path / "checks"
+        d.mkdir()
+        (d / "registry.py").write_text(PY_CHECK)
+        data_dir = tmp_path / "data"
+        data_dir.mkdir()
+        (data_dir / "registries.yaml").write_text(
+            "allowed_registries: [registry.corp]\n")
+        engine.configure(check_paths=[str(d)],
+                         namespaces=["user"],
+                         data_paths=[str(data_dir)])
+        m = _scan(K8S_BAD)
+        f = next(f for f in m.failures if f.id == "USR-100")
+        assert "nginx not from corp registry" in f.message
+        assert f.severity == "CRITICAL"
+        assert f.namespace == "user.registry"
+
+        ok = K8S_GOOD.replace(b"image: nginx",
+                              b"image: registry.corp/nginx")
+        m2 = _scan(ok)
+        assert "USR-100" in {s.id for s in m2.successes}
+
+    def test_deprecated_skipped_by_default(self, tmp_path):
+        d = tmp_path / "checks"
+        d.mkdir()
+        (d / "old.yaml").write_text(YAML_CHECK + "deprecated: true\n")
+        engine.configure(check_paths=[str(d)], namespaces=["user"])
+        assert "USR-001" not in {
+            x.id for m in [_scan(K8S_BAD)] for x in m.failures + m.successes}
+        engine.configure(check_paths=[str(d)], namespaces=["user"],
+                         include_deprecated=True)
+        assert "USR-001" in {f.id for f in _scan(K8S_BAD).failures}
+
+    def test_broken_check_file_errors(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def deny(i): return []\n")
+        with pytest.raises(CheckLoadError, match="__check__"):
+            load_check_path(str(tmp_path / "broken.py"))
+
+
+class TestDockerfileInput:
+    def test_dockerfile_check(self, tmp_path):
+        check = textwrap.dedent("""\
+            id: USR-DF1
+            title: no curl-pipe-sh
+            type: dockerfile
+            severity: CRITICAL
+            deny:
+              - path: Stages[*].Commands[*].Value
+                regex: "curl[^|]*\\\\|\\\\s*sh"
+                message: curl | sh detected
+        """)
+        d = tmp_path / "c"
+        d.mkdir()
+        (d / "df.yaml").write_text(check)
+        engine.configure(check_paths=[str(d)], namespaces=["user"])
+        bad = b"FROM alpine\nRUN curl http://x.sh | sh\n"
+        m = _scan(bad, path="Dockerfile")
+        assert "USR-DF1" in {f.id for f in m.failures}
+        good = b"FROM alpine\nRUN apk add --no-cache curl\nUSER app\n"
+        m2 = _scan(good, path="Dockerfile")
+        assert "USR-DF1" in {s.id for s in m2.successes}
+
+    def test_input_doc_shape(self):
+        from trivy_tpu.iac.parsers.dockerfile import parse_dockerfile
+        from trivy_tpu.misconf.scanner import DockerfileCtx
+
+        df = parse_dockerfile(b"FROM alpine AS base\nRUN echo hi\n")
+        doc = input_doc(DockerfileCtx(path="Dockerfile", dockerfile=df))
+        assert doc["Stages"][0]["Name"] == "base"
+        cmds = doc["Stages"][0]["Commands"]
+        assert [c["Cmd"] for c in cmds] == ["from", "run"]
+        assert cmds[1]["StartLine"] == 2
+
+
+class TestCloudInput:
+    def test_terraform_user_check(self, tmp_path):
+        check = textwrap.dedent("""\
+            id: USR-TF1
+            title: buckets must be tagged
+            type: cloud
+            deny:
+              - all:
+                  - path: Resources[*].Type
+                    equals: s3_bucket
+                  - not:
+                      path: Resources[*].Values.tags
+                      exists: true
+                message: s3 bucket without tags
+        """)
+        d = tmp_path / "c"
+        d.mkdir()
+        (d / "tf.yaml").write_text(check)
+        engine.configure(check_paths=[str(d)], namespaces=["user"])
+        tf = b'resource "aws_s3_bucket" "b" {\n  bucket = "x"\n}\n'
+        m = _scan(tf, path="main.tf")
+        assert "USR-TF1" in {f.id for f in m.failures}
+
+
+class TestCLIEndToEnd:
+    def test_config_scan_with_custom_check(self, tmp_path, capsys):
+        from trivy_tpu.cli.main import main
+
+        target = tmp_path / "cfg"
+        target.mkdir()
+        (target / "pod.yaml").write_bytes(K8S_BAD)
+        checks = tmp_path / "checks"
+        checks.mkdir()
+        (checks / "hostnet.yaml").write_text(YAML_CHECK)
+        out = tmp_path / "out.json"
+        rc = main(["config", str(target), "--format", "json",
+                   "--config-check", str(checks),
+                   "--check-namespaces", "user",
+                   "--cache-dir", str(tmp_path / "cache"),
+                   "--quiet", "--output", str(out)])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        ids = {mc["ID"] for r in doc.get("Results", [])
+               for mc in r.get("Misconfigurations", [])}
+        assert "USR-001" in ids
+
+    def test_bad_check_path_is_fatal(self, tmp_path, capsys):
+        from trivy_tpu.cli.main import main
+
+        target = tmp_path / "cfg"
+        target.mkdir()
+        (target / "pod.yaml").write_bytes(K8S_GOOD)
+        (tmp_path / "bad.yaml").write_text(
+            "id: X\ntitle: t\ntype: nonsense\ndeny: []\n")
+        rc = main(["config", str(target),
+                   "--config-check", str(tmp_path / "bad.yaml"),
+                   "--cache-dir", str(tmp_path / "cache"), "--quiet"])
+        assert rc == 1
+
+
+class TestBundle:
+    def test_bundle_paths_and_staleness(self, tmp_path, monkeypatch):
+        from trivy_tpu.policy import bundle
+
+        cache = str(tmp_path / "cache")
+        # nothing cached, no repo -> no paths
+        assert bundle.bundle_check_paths(cache) == []
+
+        calls = []
+
+        def fake_download(ref, dest, media_type=None, insecure=False):
+            calls.append(ref)
+            os.makedirs(dest, exist_ok=True)
+            with open(os.path.join(dest, "hostnet.yaml"), "w") as f:
+                f.write(YAML_CHECK)
+            return ["hostnet.yaml"]
+
+        import trivy_tpu.db.oci as oci
+
+        monkeypatch.setattr(oci, "download_artifact", fake_download)
+        paths = bundle.bundle_check_paths(cache, repository="reg.io/checks:1")
+        assert calls == ["reg.io/checks:1"]
+        assert len(paths) == 1
+        checks = load_check_path(paths[0])
+        assert [c.id for c in checks] == ["USR-001"]
+
+        # fresh metadata -> no second download
+        bundle.bundle_check_paths(cache, repository="reg.io/checks:1")
+        assert len(calls) == 1
+        # stale metadata -> refresh
+        meta = bundle._metadata_path(cache)
+        with open(meta) as f:
+            doc = json.load(f)
+        doc["downloaded_at"] -= bundle.UPDATE_INTERVAL_S + 1
+        with open(meta, "w") as f:
+            json.dump(doc, f)
+        bundle.bundle_check_paths(cache, repository="reg.io/checks:1")
+        assert len(calls) == 2
+        # skip_update honors the flag even when stale
+        with open(meta, "w") as f:
+            json.dump(doc, f)
+        bundle.bundle_check_paths(cache, repository="reg.io/checks:1",
+                                  skip_update=True)
+        assert len(calls) == 2
+
+    def test_bundle_python_checks_refused(self, tmp_path):
+        """Downloaded bundles are data-only: a .py in bundle content is
+        never executed (code execution needs explicit --config-check)."""
+        d = tmp_path / "bundle"
+        d.mkdir()
+        (d / "evil.py").write_text(
+            "import sys\nsys.BUNDLE_PWNED = True\n"
+            "__check__ = {'id': 'X', 'title': 't', 'type': 'kubernetes'}\n"
+            "def deny(input): return []\n")
+        (d / "ok.yaml").write_text(YAML_CHECK)
+        import sys
+
+        cs = CheckSet(bundle_paths=[str(d)], namespaces=["user"])
+        assert not hasattr(sys, "BUNDLE_PWNED")
+        assert [c.id for c in cs.user_checks] == ["USR-001"]
+
+    def test_update_failure_keeps_cached_bundle(self, tmp_path, monkeypatch):
+        from trivy_tpu.policy import bundle
+
+        cache = str(tmp_path / "cache")
+        content = bundle._content_dir(cache)
+        os.makedirs(content)
+        with open(os.path.join(content, "x.yaml"), "w") as f:
+            f.write(YAML_CHECK)
+
+        import trivy_tpu.db.oci as oci
+
+        def boom(*a, **k):
+            raise oci.OCIError("offline")
+
+        monkeypatch.setattr(oci, "download_artifact", boom)
+        paths = bundle.bundle_check_paths(cache, repository="reg.io/c:1")
+        assert paths == [content]

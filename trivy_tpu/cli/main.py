@@ -103,6 +103,30 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--report", default="summary",
                    choices=("all", "summary"),
                    help="compliance report detail (all, summary)")
+    _add_check_flags(p)
+
+
+def _add_check_flags(p) -> None:
+    """Misconfig check-engine flags (reference pkg/flag/rego_flags.go)."""
+    p.add_argument("--config-check", action="append", default=[],
+                   dest="config_check",
+                   help="path to a custom check file (.py/.yaml) or a "
+                        "directory of them; repeatable")
+    p.add_argument("--check-namespaces", action="append", default=[],
+                   dest="check_namespaces",
+                   help="enable custom-check namespaces (e.g. 'user'); "
+                        "repeatable")
+    p.add_argument("--config-data", action="append", default=[],
+                   dest="config_data",
+                   help="path to YAML/JSON data made available to custom "
+                        "checks; repeatable")
+    p.add_argument("--include-deprecated-checks", action="store_true",
+                   help="also run checks marked deprecated")
+    p.add_argument("--checks-bundle-repository", default="",
+                   help="OCI repository for the check bundle "
+                        "(overrides the builtin bundle source)")
+    p.add_argument("--skip-check-update", action="store_true",
+                   help="do not refresh the downloaded check bundle")
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -120,13 +120,49 @@ def run_scan(args) -> int:
         getattr(args, "module_dir", None)
         or os.path.join(args.cache_dir, "modules"))
     mod_mgr.load()
+
+    from trivy_tpu.iac import engine as check_engine
+
     try:
+        # custom misconfig checks: builtin bundle + --config-check paths,
+        # gated by --check-namespaces (reference pkg/iac/rego +
+        # pkg/policy); skipped entirely when misconfig isn't scanned
+        if "misconfig" in (args.scanners or "").split(",") \
+                or args.command == "config":
+            _configure_check_engine(args)
         return _run_scan_core(args, compliance_spec)
     finally:
+        check_engine.reset()
         mod_mgr.unload()
         if getattr(args, "trace", False):
             trace.render(sys.stderr)
             trace.enable(False)
+
+
+def _configure_check_engine(args) -> None:
+    from trivy_tpu.iac import engine as check_engine
+    from trivy_tpu.iac.engine import CheckLoadError
+    from trivy_tpu.policy.bundle import bundle_check_paths
+
+    # user-supplied paths may contain Python checks (explicit opt-in to
+    # code execution); a downloaded bundle is data-only — its .py files
+    # are refused at load time (reference Rego bundles are sandboxed by
+    # the OPA interpreter; we get the same property by construction)
+    user_paths = list(getattr(args, "config_check", []) or [])
+    bundle_paths = bundle_check_paths(
+        args.cache_dir,
+        repository=getattr(args, "checks_bundle_repository", ""),
+        skip_update=getattr(args, "skip_check_update", False))
+    try:
+        check_engine.configure(
+            check_paths=user_paths,
+            bundle_paths=bundle_paths,
+            namespaces=getattr(args, "check_namespaces", []),
+            data_paths=getattr(args, "config_data", []),
+            include_deprecated=getattr(
+                args, "include_deprecated_checks", False))
+    except (CheckLoadError, OSError) as e:
+        raise FatalError(f"loading checks: {e}")
 
 
 def _run_scan_core(args, compliance_spec) -> int:
@@ -529,7 +565,7 @@ def run_db(args) -> int:
         try:
             names = download_artifact(
                 args.db_repository, dest, media_type=DB_MEDIA_TYPE,
-                insecure=getattr(args, "redis_insecure", False))
+                insecure=getattr(args, "insecure", False))
         except OCIError as e:
             raise FatalError(str(e))
         _log.info("advisory DB downloaded", path=dest, files=len(names))

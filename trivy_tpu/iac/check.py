@@ -29,6 +29,8 @@ class Check:
     provider: str = ""      # dockerfile/kubernetes/aws/...
     service: str = ""
     url: str = ""
+    namespace: str = "builtin"  # top-level gates evaluation (engine.py)
+    deprecated: bool = False
     # fn(ctx) -> list[Cause]; empty list = pass
     fn: object = None
 

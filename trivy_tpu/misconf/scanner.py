@@ -8,7 +8,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from trivy_tpu.iac import detection
-from trivy_tpu.iac.check import Cause, Check, checks_for
+from trivy_tpu.iac.check import Cause, Check
 from trivy_tpu.iac.ignore import is_ignored, parse_ignores
 from trivy_tpu.types.artifact import Misconfiguration
 from trivy_tpu.types.report import (
@@ -145,11 +145,14 @@ def _to_detected(chk: Check, file_type: str, cause: Cause | None,
         if cause.start_line:
             md.code = _snippet(content, cause.start_line, md.end_line)
         message = cause.message or chk.title
+    ns = chk.namespace
+    if ns == "builtin":
+        ns = f"builtin.{chk.provider}.{chk.service}".rstrip(".")
     return DetectedMisconfiguration(
         type=file_type, id=chk.id, avd_id=chk.avd_id, title=chk.title,
         description=chk.description, message=message,
-        namespace=f"builtin.{chk.provider}.{chk.service}".rstrip("."),
-        query="data.builtin.deny", resolution=chk.resolution,
+        namespace=ns,
+        query=f"data.{ns.split('.')[0]}.deny", resolution=chk.resolution,
         severity=chk.severity, primary_url=chk.url,
         references=[chk.url] if chk.url else [], status=status,
         cause_metadata=md,
@@ -168,7 +171,9 @@ def scan_config(path: str, content: bytes,
         return None
     ignores = parse_ignores(content)
     misconf = Misconfiguration(file_type=ftype, file_path=path)
-    for chk in checks_for(ftype):
+    from trivy_tpu.iac.engine import active
+
+    for chk in active().checks_for(ftype):
         causes: list[Cause] = []
         for ctx in ctxs:
             try:

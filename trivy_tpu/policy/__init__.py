@@ -1,0 +1,3 @@
+from trivy_tpu.policy.bundle import bundle_check_paths, update_bundle
+
+__all__ = ["bundle_check_paths", "update_bundle"]
