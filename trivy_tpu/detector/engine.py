@@ -46,6 +46,16 @@ class MatchResult:
     adv_indices: list[int]  # indices into CompiledDB.advisories
 
 
+def _merge_candidates(a: list[tuple[int, bool]],
+                      b: list[tuple[int, bool]]) -> list[tuple[int, bool]]:
+    """Merge two sorted-unique (adv_id, needs_rescreen) lists; an exact
+    (False) occurrence wins over a rescreen one."""
+    merged: dict[int, bool] = {}
+    for i, r in a + b:
+        merged[i] = merged.get(i, True) and r
+    return sorted(merged.items())
+
+
 class MatchEngine:
     """Holds the advisory DB in compiled tensor form (and on device) and
     answers batched detection queries."""
@@ -68,6 +78,7 @@ class MatchEngine:
         self._checkers: dict[int, AdvisoryChecker] = {}
         self._row_space: list[str | None] | None = None
         self._parse_cache: dict[tuple[str, str], object] = {}
+        self._ddb_hot = None
         if use_device:
             from trivy_tpu.ops import match as m
 
@@ -75,6 +86,9 @@ class MatchEngine:
                 self._sdb = m.ShardedDB.from_compiled(self.cdb, mesh)
             else:
                 self._ddb = m.DeviceDB.from_compiled(self.cdb)
+            # hot names ("linux"-class) match on device against their own
+            # partition; small (few names), so replicated not sharded
+            self._ddb_hot = m.DeviceDB.hot_from_compiled(self.cdb)
 
     # ------------------------------------------------------------ helpers
 
@@ -194,16 +208,25 @@ class MatchEngine:
             hits = m.match_batch(self._ddb, batch)
         candidates = m.collect_candidates(hits)
 
+        # hot-name queries additionally run against the hot partition
+        # (transfer is |hot queries| x hot_window, tiny after dedupe)
+        hot_idx = [
+            j for j, q in enumerate(queries)
+            if (q.space, q.name) in self.cdb.host_fallback
+        ]
+        if hot_idx and self._ddb_hot is not None:
+            sub = m.PackageBatch(
+                h1=batch.h1[hot_idx], h2=batch.h2[hot_idx],
+                rank=batch.rank[hot_idx], flags=batch.flags[hot_idx],
+                queries=[batch.queries[j] for j in hot_idx],
+            )
+            hot_hits = m.match_batch(self._ddb_hot, sub)
+            for j, cand in zip(hot_idx, m.collect_candidates(hot_hits)):
+                candidates[j] = _merge_candidates(candidates[j], cand)
+
         out = []
         n_cand = n_conf = 0
         for q, cand in zip(queries, candidates):
-            # host-fallback names (hot rows evicted from the tensors)
-            fb = self.cdb.host_fallback.get((q.space, q.name))
-            if fb:
-                seen = {i for i, _ in cand}
-                cand = sorted(
-                    list(cand) + [(i, True) for i in fb if i not in seen]
-                )
             ver = None
             ver_parsed = False
             hits_q = []

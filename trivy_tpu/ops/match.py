@@ -66,6 +66,26 @@ class DeviceDB:
             window=cdb.window,
         )
 
+    @classmethod
+    def hot_from_compiled(cls, cdb: CompiledDB,
+                          device=None) -> "DeviceDB | None":
+        """Hot partition (names whose row group exceeds the main window)
+        as its own DeviceDB with the hot window — matched by the same
+        kernel, only for queries that route to a hot name."""
+        if cdb.hot_h1 is None or len(cdb.hot_h1) == 0:
+            return None
+        put = functools.partial(jax.device_put, device=device)
+        return cls(
+            h1=put(cdb.hot_h1),
+            h2=put(cdb.hot_h2),
+            lo=put(cdb.hot_lo),
+            hi=put(cdb.hot_hi),
+            flags=put(cdb.hot_flags),
+            adv=put(cdb.hot_adv),
+            n_rows=len(cdb.hot_h1),
+            window=cdb.hot_window,
+        )
+
 
 @functools.partial(jax.jit, static_argnames=("window",))
 def _match_kernel(

@@ -108,9 +108,22 @@ class CompiledDB:
     boundaries: dict[str, np.ndarray]
     # flat advisory list: (bucket, pkg_name, Advisory)
     advisories: list[tuple[str, str, Advisory]]
-    # names too hot for the window: (space, name) -> list[adv_idx]
+    # names too hot for the window: (space, name) -> list[adv_idx].
+    # Their rows live in the hot partition below; this map is the
+    # routing key (and the pure-host fallback when no device is used).
     host_fallback: dict[tuple[str, str], list[int]]
     window: int
+    # hot partition: rows of names whose group exceeds `window`, laid
+    # out identically but matched with their own (larger) window so
+    # "linux"-class names stay on device instead of degenerating to a
+    # per-advisory host loop
+    hot_h1: np.ndarray | None = None
+    hot_h2: np.ndarray | None = None
+    hot_lo: np.ndarray | None = None
+    hot_hi: np.ndarray | None = None
+    hot_flags: np.ndarray | None = None
+    hot_adv: np.ndarray | None = None
+    hot_window: int = 0
     stats: dict = field(default_factory=dict)
     # encode memo caches (same packages recur across a registry crawl)
     _hash_cache: dict = field(default_factory=dict, repr=False)
@@ -270,6 +283,10 @@ def compile_db(db: AdvisoryDB, window: int | None = None) -> CompiledDB:
     boundary_keys: dict[str, set] = {}
     n_host_rows = 0
 
+    # version-string -> (key, exact) memo: fixed versions repeat heavily
+    # in real trivy-db (the same "2.4.1-r0" appears across many CVEs)
+    key_memo: dict[tuple[str, str], tuple[bytes, bool]] = {}
+
     for bucket, pkgs in db.buckets.items():
         resolved = space_of_bucket(bucket)
         if resolved is None:
@@ -297,11 +314,19 @@ def compile_db(db: AdvisoryDB, window: int | None = None) -> CompiledDB:
                     flags = extra_flags
                     lo_key = hi_key = None
                     if lo_str is not None:
-                        lo_key, exact = scheme.key(lo_str)
+                        mk = key_memo.get((scheme_name, lo_str))
+                        if mk is None:
+                            mk = scheme.key(lo_str)
+                            key_memo[(scheme_name, lo_str)] = mk
+                        lo_key, exact = mk
                         if not exact:
                             flags |= FLAG_NEEDS_HOST
                     if hi_str is not None:
-                        hi_key, exact = scheme.key(hi_str)
+                        mk = key_memo.get((scheme_name, hi_str))
+                        if mk is None:
+                            mk = scheme.key(hi_str)
+                            key_memo[(scheme_name, hi_str)] = mk
+                        hi_key, exact = mk
                         if not exact:
                             flags |= FLAG_NEEDS_HOST
                     if flags & FLAG_NEEDS_HOST:
@@ -326,59 +351,99 @@ def compile_db(db: AdvisoryDB, window: int | None = None) -> CompiledDB:
         for s, keys in boundary_keys.items()
     }
 
-    def rank_of(scheme_name: str, key: bytes) -> int:
-        return _rank_of(boundaries.get(scheme_name), key)
-
-    # evict names with too many rows to the host fallback
+    # partition: names with too many rows for the window go to a hot
+    # partition with its own window (matched on device too; see
+    # CompiledDB.hot_*)
     from collections import Counter, defaultdict
 
     # count per h1 alone: the kernel's window starts at the first h1 match,
     # so h1-colliding names share one window and must be evicted together
     counts = Counter(r["h1"] for r in raw_rows)
-    if window is None:
+    auto_window = window is None
+    if auto_window:
         max_count = max(counts.values(), default=1)
         window = min(max(8, -(-max_count // 8) * 8), MAX_AUTO_WINDOW)
     host_fallback: dict[tuple[str, str], list[int]] = defaultdict(list)
     kept: list[dict] = []
+    hot: list[dict] = []
     for r in raw_rows:
         if counts[r["h1"]] > window:
             host_fallback[(r["space"], r["name"])].append(r["adv"])
+            hot.append(r)
             continue
         kept.append(r)
+    if auto_window and hot:
+        # eviction guarantees every kept group fits a (possibly much)
+        # smaller window than the pre-eviction bound; shrink it — result
+        # transfer is B x window, so this is pure savings
+        max_kept = max((counts[r["h1"]] for r in kept), default=1)
+        window = max(8, -(-max_kept // 8) * 8)
     # dedupe fallback advisory ids (multi-interval advisories)
     host_fallback = {
         k: sorted(set(v)) for k, v in host_fallback.items()
     }
 
-    kept.sort(key=lambda r: (r["h1"], r["h2"]))
-    n = len(kept)
-    row_h1 = np.zeros(n, dtype=np.uint32)
-    row_h2 = np.zeros(n, dtype=np.uint32)
-    row_lo = np.zeros(n, dtype=np.int32)
-    row_hi = np.zeros(n, dtype=np.int32)
-    row_flags = np.zeros(n, dtype=np.int32)
-    row_adv = np.zeros(n, dtype=np.int32)
-    for i, r in enumerate(kept):
-        row_h1[i], row_h2[i] = r["h1"], r["h2"]
-        row_flags[i], row_adv[i] = r["flags"], r["adv"]
-        if r["flags"] & FLAG_NEEDS_HOST:
-            row_lo[i], row_hi[i] = 0, INT32_MAX
-            continue
-        if r["lo_key"] is None:
-            row_lo[i] = 0
-        else:
-            a = rank_of(r["scheme"], r["lo_key"])
-            row_lo[i] = a if r["lo_incl"] else a + 1
-        if r["hi_key"] is None:
-            row_hi[i] = INT32_MAX
-        else:
-            b = rank_of(r["scheme"], r["hi_key"])
-            row_hi[i] = b if r["hi_incl"] else b - 1
+    def fill(rows: list[dict]):
+        """rows -> (h1, h2, lo, hi, flags, adv) arrays, (h1,h2)-sorted.
+        Rank assignment is batched: ONE searchsorted per (scheme, side)
+        instead of one per row — the difference between seconds and
+        minutes at real trivy-db scale (millions of rows)."""
+        rows.sort(key=lambda r: (r["h1"], r["h2"]))
+        n = len(rows)
+        a_h1 = np.zeros(n, dtype=np.uint32)
+        a_h2 = np.zeros(n, dtype=np.uint32)
+        a_lo = np.zeros(n, dtype=np.int32)
+        a_hi = np.full(n, INT32_MAX, dtype=np.int32)
+        a_flags = np.zeros(n, dtype=np.int32)
+        a_adv = np.zeros(n, dtype=np.int32)
+        pending: dict[str, tuple[list, list, list, list]] = {}
+        for i, r in enumerate(rows):
+            a_h1[i], a_h2[i] = r["h1"], r["h2"]
+            a_flags[i], a_adv[i] = r["flags"], r["adv"]
+            if r["flags"] & FLAG_NEEDS_HOST:
+                a_lo[i], a_hi[i] = 0, INT32_MAX
+                continue
+            idxs, keys, sides, incls = pending.setdefault(
+                r["scheme"], ([], [], [], []))
+            if r["lo_key"] is not None:
+                idxs.append(i); keys.append(r["lo_key"])
+                sides.append(0); incls.append(r["lo_incl"])
+            if r["hi_key"] is not None:
+                idxs.append(i); keys.append(r["hi_key"])
+                sides.append(1); incls.append(r["hi_incl"])
+        for scheme_name, (idxs, keys, sides, incls) in pending.items():
+            bounds = boundaries.get(scheme_name)
+            if bounds is None or len(bounds) == 0 or not idxs:
+                continue
+            arr = np.array(keys, dtype=bounds.dtype)
+            pos = np.searchsorted(bounds, arr, side="left").astype(np.int64)
+            in_range = pos < len(bounds)
+            eq = np.zeros(len(keys), dtype=bool)
+            eq[in_range] = bounds[pos[in_range]] == arr[in_range]
+            rank = (2 * pos + eq).astype(np.int32)
+            ii = np.array(idxs)
+            ss = np.array(sides)
+            inc = np.array(incls)
+            lo_sel = ss == 0
+            a_lo[ii[lo_sel]] = rank[lo_sel] + (~inc[lo_sel])
+            hi_sel = ~lo_sel
+            a_hi[ii[hi_sel]] = rank[hi_sel] - (~inc[hi_sel])
+        return a_h1, a_h2, a_lo, a_hi, a_flags, a_adv
+
+    row_h1, row_h2, row_lo, row_hi, row_flags, row_adv = fill(kept)
+    hot_arrays = fill(hot) if hot else None
+    hot_window = 0
+    if hot:
+        hot_max = max(Counter(r["h1"] for r in hot).values())
+        hot_window = -(-hot_max // 8) * 8
+
     stats = {
-        "rows": n,
+        "rows": len(kept),
         "advisories": len(advisories),
         "host_rows": n_host_rows,
         "fallback_names": len(host_fallback),
+        "hot_rows": len(hot),
+        "hot_window": hot_window,
         "boundary_keys": {s: len(b) for s, b in boundaries.items()},
     }
     _log.info("compiled advisory DB", **stats)
@@ -386,5 +451,12 @@ def compile_db(db: AdvisoryDB, window: int | None = None) -> CompiledDB:
         row_h1=row_h1, row_h2=row_h2, row_lo=row_lo, row_hi=row_hi,
         row_flags=row_flags, row_adv=row_adv,
         boundaries=boundaries, advisories=advisories,
-        host_fallback=dict(host_fallback), window=window, stats=stats,
+        host_fallback=dict(host_fallback), window=window,
+        hot_h1=hot_arrays[0] if hot_arrays else None,
+        hot_h2=hot_arrays[1] if hot_arrays else None,
+        hot_lo=hot_arrays[2] if hot_arrays else None,
+        hot_hi=hot_arrays[3] if hot_arrays else None,
+        hot_flags=hot_arrays[4] if hot_arrays else None,
+        hot_adv=hot_arrays[5] if hot_arrays else None,
+        hot_window=hot_window, stats=stats,
     )
