@@ -46,16 +46,6 @@ class MatchResult:
     adv_indices: list[int]  # indices into CompiledDB.advisories
 
 
-def _merge_candidates(a: list[tuple[int, bool]],
-                      b: list[tuple[int, bool]]) -> list[tuple[int, bool]]:
-    """Merge two sorted-unique (adv_id, needs_rescreen) lists; an exact
-    (False) occurrence wins over a rescreen one."""
-    merged: dict[int, bool] = {}
-    for i, r in a + b:
-        merged[i] = merged.get(i, True) and r
-    return sorted(merged.items())
-
-
 class MatchEngine:
     """Holds the advisory DB in compiled tensor form (and on device) and
     answers batched detection queries."""
@@ -76,9 +66,10 @@ class MatchEngine:
         self.rescreen_stats = {"candidates": 0, "confirmed": 0}
         # lazy per-advisory compiled checkers + parsed-version memo
         self._checkers: dict[int, AdvisoryChecker] = {}
-        self._row_space: list[str | None] | None = None
         self._parse_cache: dict[tuple[str, str], object] = {}
         self._ddb_hot = None
+        self._name_tokens: dict[tuple[str, str], int] | None = None
+        self._adv_tok = None
         if use_device:
             from trivy_tpu.ops import match as m
 
@@ -91,6 +82,28 @@ class MatchEngine:
             self._ddb_hot = m.DeviceDB.hot_from_compiled(self.cdb)
 
     # ------------------------------------------------------------ helpers
+
+    @property
+    def device_db(self):
+        """The resident single-device DB tensors (None in mesh/host
+        modes) — public handle for benches and diagnostics."""
+        return self._ddb
+
+    @staticmethod
+    def dedupe_queries(queries: list[PkgQuery]):
+        """-> (unique queries, index map original->unique)."""
+        key_of: dict[tuple, int] = {}
+        uniq: list[PkgQuery] = []
+        idx_map = [0] * len(queries)
+        for j, q in enumerate(queries):
+            k = (q.space, q.name, q.version, q.scheme_name)
+            u = key_of.get(k)
+            if u is None:
+                u = len(uniq)
+                key_of[k] = u
+                uniq.append(q)
+            idx_map[j] = u
+        return uniq, idx_map
 
     def _bucket_scheme(self, bucket: str) -> tuple[str, str] | None:
         return space_of_bucket(bucket)
@@ -109,16 +122,34 @@ class MatchEngine:
             self._checkers[adv_idx] = ch
         return ch
 
-    def _space_of_adv(self, adv_idx: int) -> str | None:
-        if self._row_space is None:
-            self._row_space = [None] * len(self.cdb.advisories)
-        s = self._row_space[adv_idx]
-        if s is None:
-            bucket = self.cdb.advisories[adv_idx][0]
-            resolved = space_of_bucket(bucket)
-            s = resolved[0] if resolved else ""
-            self._row_space[adv_idx] = s
-        return s
+    def _ensure_tokens(self) -> None:
+        """Integer token per (space, name), and per advisory: turns the
+        per-candidate hash-collision check (string compares in Python)
+        into one vectorized int compare."""
+        if self._name_tokens is not None:
+            return
+        import numpy as np
+
+        names: dict[tuple[str, str], int] = {}
+        space_by_bucket: dict[str, str | None] = {}
+        toks = np.empty(len(self.cdb.advisories), dtype=np.int64)
+        for i, (bucket, name, _adv) in enumerate(self.cdb.advisories):
+            space = space_by_bucket.get(bucket, "?")
+            if space == "?":
+                resolved = space_of_bucket(bucket)
+                space = resolved[0] if resolved else None
+                space_by_bucket[bucket] = space
+            if space is None:
+                toks[i] = -1
+                continue
+            key = (space, name)
+            tok = names.get(key)
+            if tok is None:
+                tok = len(names)
+                names[key] = tok
+            toks[i] = tok
+        self._name_tokens = names
+        self._adv_tok = toks
 
     def _parse_version(self, scheme_name: str, version: str):
         """-> parsed version or None; memoized."""
@@ -177,17 +208,7 @@ class MatchEngine:
         if not self.use_device:
             return self.oracle_detect(queries)
 
-        key_of: dict[tuple, int] = {}
-        uniq: list[PkgQuery] = []
-        idx_map = [0] * len(queries)
-        for j, q in enumerate(queries):
-            k = (q.space, q.name, q.version, q.scheme_name)
-            u = key_of.get(k)
-            if u is None:
-                u = len(uniq)
-                key_of[k] = u
-                uniq.append(q)
-            idx_map[j] = u
+        uniq, idx_map = self.dedupe_queries(queries)
         if len(uniq) < len(queries):
             uniq_hits = self._detect_unique(uniq)
             return [MatchResult(q, uniq_hits[idx_map[j]])
@@ -196,7 +217,13 @@ class MatchEngine:
         return [MatchResult(q, h) for q, h in zip(queries, hits)]
 
     def _detect_unique(self, queries: list[PkgQuery]) -> list[list[int]]:
-        """-> sorted advisory-index list per (unique) query."""
+        """-> sorted advisory-index list per (unique) query.
+
+        Exact hits are confirmed fully vectorized (one int compare per
+        candidate for the hash-collision check); only flagged rescreen
+        candidates reach the per-advisory Python comparators."""
+        import numpy as np
+
         from trivy_tpu.ops import match as m
 
         batch = self.cdb.encode_packages(
@@ -206,7 +233,8 @@ class MatchEngine:
             hits = m.match_batch_sharded(self._sdb, batch)
         else:
             hits = m.match_batch(self._ddb, batch)
-        candidates = m.collect_candidates(hits)
+        rows, cols = np.nonzero(hits >= 0)
+        packed = hits[rows, cols]
 
         # hot-name queries additionally run against the hot partition
         # (transfer is |hot queries| x hot_window, tiny after dedupe)
@@ -221,43 +249,54 @@ class MatchEngine:
                 queries=[batch.queries[j] for j in hot_idx],
             )
             hot_hits = m.match_batch(self._ddb_hot, sub)
-            for j, cand in zip(hot_idx, m.collect_candidates(hot_hits)):
-                candidates[j] = _merge_candidates(candidates[j], cand)
+            hrows, hcols = np.nonzero(hot_hits >= 0)
+            rows = np.concatenate(
+                [rows, np.asarray(hot_idx, dtype=rows.dtype)[hrows]])
+            packed = np.concatenate([packed, hot_hits[hrows, hcols]])
 
-        out = []
-        n_cand = n_conf = 0
-        for q, cand in zip(queries, candidates):
-            ver = None
-            ver_parsed = False
-            hits_q = []
-            for i, needs_rescreen in cand:
-                # hash collisions: verify the name/space actually match
-                if self.cdb.advisories[i][1] != q.name:
-                    continue
-                if self._space_of_adv(i) != q.space:
-                    continue
-                n_cand += 1
-                if not needs_rescreen:
-                    # exact row + exact pkg encoding: the kernel's interval
-                    # test IS the exact check
-                    hits_q.append(i)
+        ids = packed & (m.RESCREEN_BIT - 1)
+        resc = (packed & m.RESCREEN_BIT) != 0
+
+        # dedupe (row, id) keeping the exact (non-rescreen) occurrence
+        if len(rows):
+            order = np.lexsort((resc, ids, rows))
+            rows, ids, resc = rows[order], ids[order], resc[order]
+            keep = np.ones(len(rows), dtype=bool)
+            keep[1:] = (rows[1:] != rows[:-1]) | (ids[1:] != ids[:-1])
+            rows, ids, resc = rows[keep], ids[keep], resc[keep]
+
+        # hash-collision screen: advisory's (space, name) token must equal
+        # the query's
+        self._ensure_tokens()
+        q_tok = np.fromiter(
+            (self._name_tokens.get((q.space, q.name), -2) for q in queries),
+            dtype=np.int64, count=len(queries))
+        valid = self._adv_tok[ids] == q_tok[rows]
+        rows, ids, resc = rows[valid], ids[valid], resc[valid]
+
+        out: list[list[int]] = [[] for _ in queries]
+        # exact hits: the kernel's interval test IS the exact check
+        ex_rows, ex_ids = rows[~resc], ids[~resc]
+        for r, i in zip(ex_rows.tolist(), ex_ids.tolist()):
+            out[r].append(i)
+        n_conf = len(ex_rows)
+
+        # flagged candidates: exact per-advisory comparators on host
+        for r, i in zip(rows[resc].tolist(), ids[resc].tolist()):
+            q = queries[r]
+            ch = self._checker(i)
+            if ch is None:
+                continue
+            ver = self._parse_version(q.scheme_name, q.version)
+            if ver is None:
+                if ch.adv.is_range_style and ch.always:
+                    out[r].append(i)
                     n_conf += 1
-                    continue
-                ch = self._checker(i)
-                if ch is None:
-                    continue
-                if not ver_parsed:
-                    ver = self._parse_version(q.scheme_name, q.version)
-                    ver_parsed = True
-                if ver is None:
-                    if ch.adv.is_range_style and ch.always:
-                        hits_q.append(i)
-                        n_conf += 1
-                    continue
-                if ch.check_parsed(ver):
-                    hits_q.append(i)
-                    n_conf += 1
-            out.append(sorted(hits_q))
-        self.rescreen_stats["candidates"] += n_cand
+                continue
+            if ch.check_parsed(ver):
+                out[r].append(i)
+                n_conf += 1
+
+        self.rescreen_stats["candidates"] += len(rows)
         self.rescreen_stats["confirmed"] += n_conf
-        return out
+        return [sorted(h) for h in out]

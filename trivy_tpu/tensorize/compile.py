@@ -44,6 +44,19 @@ INT32_MAX = np.int32(2**31 - 1)
 _log = logger("tensorize")
 
 
+def _ranks_of(bounds: np.ndarray, keys: list[bytes]) -> np.ndarray:
+    """Vectorized scaled ranks of encoded keys in a sorted S-dtype
+    boundary table: rank = 2*pos + (key present). Shares the NUL-strip
+    equality caveat with _rank_of (S-dtype compares strip trailing
+    NULs on both sides, so direct array equality is exact)."""
+    arr = np.array(keys, dtype=bounds.dtype)
+    pos = np.searchsorted(bounds, arr, side="left").astype(np.int64)
+    in_range = pos < len(bounds)
+    eq = np.zeros(len(keys), dtype=bool)
+    eq[in_range] = bounds[pos[in_range]] == arr[in_range]
+    return (2 * pos + eq).astype(np.int32)
+
+
 def _rank_of(bounds: np.ndarray | None, key: bytes) -> int:
     """Scaled rank of an encoded key in a sorted S-dtype boundary table.
     NB: numpy S-dtype strips trailing NULs, so equality must compare the
@@ -178,12 +191,7 @@ class CompiledDB:
             bounds = self.boundaries.get(scheme_name)
             if bounds is None or len(bounds) == 0:
                 continue
-            arr = np.array(keys, dtype=bounds.dtype)
-            pos = np.searchsorted(bounds, arr, side="left").astype(np.int64)
-            in_range = pos < len(bounds)
-            eq = np.zeros(len(keys), dtype=bool)
-            eq[in_range] = bounds[pos[in_range]] == arr[in_range]
-            rank[np.array(idxs)] = (2 * pos + eq).astype(np.int32)
+            rank[np.array(idxs)] = _ranks_of(bounds, keys)
         return PackageBatch(h1, h2, rank, flags, queries)
 
 
@@ -415,12 +423,7 @@ def compile_db(db: AdvisoryDB, window: int | None = None) -> CompiledDB:
             bounds = boundaries.get(scheme_name)
             if bounds is None or len(bounds) == 0 or not idxs:
                 continue
-            arr = np.array(keys, dtype=bounds.dtype)
-            pos = np.searchsorted(bounds, arr, side="left").astype(np.int64)
-            in_range = pos < len(bounds)
-            eq = np.zeros(len(keys), dtype=bool)
-            eq[in_range] = bounds[pos[in_range]] == arr[in_range]
-            rank = (2 * pos + eq).astype(np.int32)
+            rank = _ranks_of(bounds, keys)
             ii = np.array(idxs)
             ss = np.array(sides)
             inc = np.array(incls)
