@@ -1,0 +1,490 @@
+"""Batched bit-parallel secret matching on device (SURVEY §7 step 7,
+the TPU replacement for the reference's per-file regex loop,
+pkg/fanal/secret/scanner.go:377-463).
+
+Three-tier design, correct by construction:
+
+1. **Device NFA (Shift-And)** — most secret patterns are fixed-length
+   byte-class sequences once {m} repeats are unrolled (`ghp_[A-Za-z0-9]{36}`,
+   `AKIA[A-Z2-7]{16}`, ...). Those compile exactly to a bit-parallel
+   Shift-And automaton: state bitmask D advances per byte as
+   ``D = ((D << 1) | 1) & B[c]`` with multi-uint32 words for patterns up
+   to 192 states. One `lax.scan` over chunk bytes runs EVERY pattern on
+   EVERY file simultaneously ([chunks, patterns, words] uint32 state).
+2. **Candidate windows** — the kernel emits block-resolution hit bitmaps
+   (any match end inside each 128-byte block), not full positions: the
+   device->host transfer is [chunks, patterns, 128] bools per 16 KiB
+   chunk. The host runs the rule's real regex ONLY inside hit windows
+   (for capture groups / censoring spans), never over whole files.
+3. Patterns that don't compile to a bounded class sequence fall back to
+   the keyword tier (block windows when the regex has finite width, the
+   reference's whole-file scan only for unbounded patterns like PEM
+   private keys).
+
+False negatives are impossible: tier-1 automata accept exactly the rule
+language; windows are expanded by the pattern width so the verifying
+regex sees every candidate in full.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+import re._constants as sre_c
+import re._parser as sre_parse
+
+import numpy as np
+
+CHUNK = 16384
+BLOCK = 128
+NBLOCK = CHUNK // BLOCK
+MAX_STATES = 192  # 6 uint32 words
+WORD_BITS = 32
+
+
+# ----------------------------------------------------- class sequences
+
+
+def _class_from_in(items, ignorecase: bool) -> np.ndarray | None:
+    """sre IN items -> 256-bool acceptance mask."""
+    mask = np.zeros(256, dtype=bool)
+    negate = False
+    for op, arg in items:
+        if op is sre_c.NEGATE:
+            negate = True
+        elif op is sre_c.LITERAL:
+            if arg > 255:
+                return None
+            mask[arg] = True
+        elif op is sre_c.RANGE:
+            lo, hi = arg
+            if hi > 255:
+                return None
+            mask[lo: hi + 1] = True
+        elif op is sre_c.CATEGORY:
+            cat = _category_mask(arg)
+            if cat is None:
+                return None
+            mask |= cat
+        else:
+            return None
+    if negate:
+        mask = ~mask
+    if ignorecase:
+        mask = _close_case(mask)
+    return mask
+
+
+def _category_mask(cat) -> np.ndarray | None:
+    mask = np.zeros(256, dtype=bool)
+    if cat is sre_c.CATEGORY_DIGIT:
+        mask[ord("0"): ord("9") + 1] = True
+    elif cat is sre_c.CATEGORY_NOT_DIGIT:
+        mask[:] = True
+        mask[ord("0"): ord("9") + 1] = False
+    elif cat is sre_c.CATEGORY_WORD:
+        for a, b in ((48, 57), (65, 90), (97, 122)):
+            mask[a: b + 1] = True
+        mask[ord("_")] = True
+    elif cat is sre_c.CATEGORY_NOT_WORD:
+        m = _category_mask(sre_c.CATEGORY_WORD)
+        mask = ~m
+    elif cat is sre_c.CATEGORY_SPACE:
+        for c in b" \t\n\r\f\v":
+            mask[c] = True
+    elif cat is sre_c.CATEGORY_NOT_SPACE:
+        m = _category_mask(sre_c.CATEGORY_SPACE)
+        mask = ~m
+    else:
+        return None
+    return mask
+
+
+def _close_case(mask: np.ndarray) -> np.ndarray:
+    out = mask.copy()
+    for c in range(ord("a"), ord("z") + 1):
+        if mask[c] or mask[c - 32]:
+            out[c] = out[c - 32] = True
+    return out
+
+
+def _literal_class(ch: int, ignorecase: bool) -> np.ndarray | None:
+    if ch > 255:
+        return None
+    mask = np.zeros(256, dtype=bool)
+    mask[ch] = True
+    if ignorecase:
+        mask = _close_case(mask)
+    return mask
+
+
+def _walk(items, flags: int) -> list[np.ndarray] | None:
+    """sre parse-tree items -> list of 256-bool classes, or None if the
+    pattern is not a fixed-length class sequence."""
+    ic = bool(flags & re.IGNORECASE)
+    seq: list[np.ndarray] = []
+    for op, arg in items:
+        if op is sre_c.LITERAL:
+            cls = _literal_class(arg, ic)
+            if cls is None:
+                return None
+            seq.append(cls)
+        elif op is sre_c.NOT_LITERAL:
+            cls = _literal_class(arg, ic)
+            if cls is None:
+                return None
+            seq.append(~cls)
+        elif op is sre_c.IN:
+            cls = _class_from_in(arg, ic)
+            if cls is None:
+                return None
+            seq.append(cls)
+        elif op is sre_c.ANY:
+            mask = np.ones(256, dtype=bool)
+            if not flags & re.DOTALL:
+                mask[ord("\n")] = False
+            seq.append(mask)
+        elif op is sre_c.CATEGORY:
+            cls = _category_mask(arg)
+            if cls is None:
+                return None
+            seq.append(cls)
+        elif op in (sre_c.MAX_REPEAT, sre_c.MIN_REPEAT):
+            lo, hi, sub = arg
+            if lo != hi or not isinstance(lo, int):
+                return None
+            inner = _walk(list(sub), flags)
+            if inner is None:
+                return None
+            seq.extend(inner * lo)
+        elif op is sre_c.SUBPATTERN:
+            _group, add_f, del_f, sub = arg
+            inner = _walk(list(sub), (flags | add_f) & ~del_f)
+            if inner is None:
+                return None
+            seq.extend(inner)
+        elif op is sre_c.BRANCH:
+            _none, branches = arg
+            alts = [_walk(list(b), flags) for b in branches]
+            if any(a is None for a in alts):
+                return None
+            lens = {len(a) for a in alts}
+            if len(lens) != 1:
+                return None
+            # per-position class union: a SUPERSET of the alternation
+            # (cross-branch mixes accepted too) — safe, because device
+            # hits are only candidate windows the real regex verifies
+            merged = []
+            for i in range(lens.pop()):
+                m = np.zeros(256, dtype=bool)
+                for a in alts:
+                    m |= a[i]
+                merged.append(m)
+            seq.extend(merged)
+        else:
+            # anchors, lookarounds, groups refs, variable repeats, ...
+            return None
+    return seq
+
+
+def compile_class_sequence(pattern: str) -> list[np.ndarray] | None:
+    """regex -> fixed-length class sequence (or None). The sequence
+    accepts a SUPERSET of the regex language (equal except across
+    same-length alternations, where per-position unions admit mixes),
+    so Shift-And hits are complete candidates for regex verification —
+    never a source of false negatives."""
+    try:
+        parsed = sre_parse.parse(pattern)
+    except re.error:
+        return None
+    seq = _walk(list(parsed), parsed.state.flags)
+    if seq is None or not seq or len(seq) > MAX_STATES:
+        return None
+    return seq
+
+
+def regex_width(pattern: str) -> tuple[int, int] | None:
+    """(min, max) match width, or None if unparseable. max is capped by
+    sre at MAXWIDTH for unbounded patterns."""
+    try:
+        parsed = sre_parse.parse(pattern)
+    except re.error:
+        return None
+    lo, hi = parsed.getwidth()
+    return int(lo), int(hi)
+
+
+def has_anchor(pattern: str) -> bool:
+    """True if the pattern uses ^/$/\\b-style assertions anywhere (those
+    are position-sensitive, so window slicing could change semantics)."""
+    try:
+        parsed = sre_parse.parse(pattern)
+    except re.error:
+        return True
+
+    def walk(items) -> bool:
+        for op, arg in items:
+            if op is sre_c.AT:
+                return True
+            if op in (sre_c.ASSERT, sre_c.ASSERT_NOT):
+                return True
+            if op is sre_c.SUBPATTERN:
+                if walk(list(arg[3])):
+                    return True
+            elif op in (sre_c.MAX_REPEAT, sre_c.MIN_REPEAT):
+                if walk(list(arg[2])):
+                    return True
+            elif op is sre_c.BRANCH:
+                for b in arg[1]:
+                    if walk(list(b)):
+                        return True
+        return False
+
+    return walk(list(parsed))
+
+
+def required_literal(pattern: str) -> bytes | None:
+    """Longest literal byte run every match of the pattern must contain
+    (>=3 bytes), lowercased, or None.
+
+    Used to anchor candidate windows: scanning for this literal can
+    never lose a match, unlike the rule's configured keywords which are
+    only a heuristic prefilter. Conservative: runs inside optional
+    parts, branches, or lookarounds don't count."""
+    try:
+        parsed = sre_parse.parse(pattern)
+    except re.error:
+        return None
+    runs: list[bytes] = []
+
+    def walk(items) -> None:
+        cur = bytearray()
+
+        def flush():
+            if len(cur) >= 3:
+                runs.append(bytes(cur))
+            cur.clear()
+
+        for op, arg in items:
+            if op is sre_c.LITERAL and arg < 256:
+                cur.append(arg)
+            elif op is sre_c.SUBPATTERN:
+                flush()
+                walk(list(arg[3]))
+            elif op in (sre_c.MAX_REPEAT, sre_c.MIN_REPEAT):
+                lo, _hi, sub = arg
+                flush()
+                if isinstance(lo, int) and lo >= 1:
+                    walk(list(sub))
+            else:
+                flush()
+        flush()
+
+    walk(list(parsed))
+    if not runs:
+        return None
+    return max(runs, key=len).lower()
+
+
+# ------------------------------------------------------------ the bank
+
+
+class NFABank:
+    """Stacked Shift-And tables for P patterns.
+
+    B: uint32[P, 256, W] — bit s of word w set iff state (w*32+s) of the
+    pattern accepts the byte. final: uint32[P, W] final-state bit."""
+
+    def __init__(self, sequences: list[list[np.ndarray]]):
+        self.lengths = [len(s) for s in sequences]
+        self.n = len(sequences)
+        max_len = max(self.lengths, default=1)
+        self.words = max(1, -(-max_len // WORD_BITS))
+        self.B = np.zeros((self.n, 256, self.words), dtype=np.uint32)
+        self.final = np.zeros((self.n, self.words), dtype=np.uint32)
+        for p, seq in enumerate(sequences):
+            for s, cls in enumerate(seq):
+                w, b = divmod(s, WORD_BITS)
+                self.B[p, cls, w] |= np.uint32(1 << b)
+            w, b = divmod(len(seq) - 1, WORD_BITS)
+            self.final[p, w] = np.uint32(1 << b)
+        self.max_len = max_len
+
+
+@functools.lru_cache(maxsize=4)
+def _nfa_kernel(n_pat: int, words: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def run(chunks, B, final):
+        """chunks: uint8[C, CHUNK]; B: uint32[P,256,W]; final: uint32[P,W]
+        -> bool[C, P, NBLOCK] any-match-end per 128-byte block."""
+        C = chunks.shape[0]
+        blocks = chunks.reshape(C, NBLOCK, BLOCK)
+
+        def outer(D, block_bytes):
+            # block_bytes: [C, BLOCK]
+            hit = jnp.zeros((C, n_pat), dtype=bool)
+            for j in range(BLOCK):
+                c = block_bytes[:, j]  # [C]
+                Bc = jnp.transpose(B[:, c, :], (1, 0, 2))  # [C, P, W]
+                # multi-word shift-left-1 with carry, then inject bit 0
+                carry = jnp.concatenate(
+                    [jnp.zeros_like(D[..., :1]), D[..., :-1] >> 31], axis=-1)
+                D = ((D << 1) | carry).at[..., 0].set(
+                    (D[..., 0] << 1) | (carry[..., 0] | 1))
+                D = D & Bc
+                hit = hit | ((D & final[None]) != 0).any(axis=-1)
+            return D, hit
+
+        D0 = jnp.zeros((C, n_pat, words), dtype=jnp.uint32)
+        _, hits = lax.scan(outer, D0, jnp.swapaxes(blocks, 0, 1))
+        return jnp.transpose(hits, (1, 2, 0))  # [C, P, NBLOCK]
+
+    return run
+
+
+@functools.lru_cache(maxsize=4)
+def _kw_block_kernel(n_kw: int, max_len: int):
+    """Keyword matcher at block resolution: like the prefilter kernel
+    but emitting [C, K, NBLOCK] (block of the keyword START)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(chunks, kw, kw_len):
+        c = jnp.pad(chunks, ((0, 0), (0, max_len - 1)))
+        w = CHUNK
+
+        def match_one(k_row, k_len):
+            acc = jnp.ones((c.shape[0], w), dtype=bool)
+            for j in range(max_len):
+                eq = c[:, j: j + w] == k_row[j]
+                active = j < k_len
+                acc = acc & jnp.where(active, eq, True)
+            return acc.reshape(acc.shape[0], NBLOCK, BLOCK).any(axis=2)
+
+        hits = jax.vmap(match_one, in_axes=(0, 0), out_axes=1)(
+            kw[:, :max_len], kw_len
+        )  # [C, K, NBLOCK]
+        return hits
+
+    return run
+
+
+# ------------------------------------------------------------ chunking
+
+
+def chunk_files(contents: list[bytes], overlap: int,
+                lower: bool = False):
+    """-> (chunks uint8[N, CHUNK], owners int[N], starts int[N]).
+    starts[i] is the file offset of chunk i's first byte."""
+    owners: list[int] = []
+    starts: list[int] = []
+    arrs: list[np.ndarray] = []
+    step = CHUNK - overlap
+    for fi, content in enumerate(contents):
+        data = content.lower() if lower else content
+        pos = 0
+        while True:
+            piece = data[pos: pos + CHUNK]
+            if not piece and pos > 0:
+                break
+            arr = np.zeros(CHUNK, dtype=np.uint8)
+            if piece:
+                arr[: len(piece)] = np.frombuffer(piece, dtype=np.uint8)
+            arrs.append(arr)
+            owners.append(fi)
+            starts.append(pos)
+            if pos + CHUNK >= len(data):
+                break
+            pos += step
+    if not arrs:
+        return (np.zeros((0, CHUNK), dtype=np.uint8),
+                np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
+    return np.stack(arrs), np.array(owners), np.array(starts)
+
+
+class DeviceSecretMatcher:
+    """Runs tier-1 NFA patterns and tier-2 keyword blocks on device,
+    returning per-file candidate windows (byte ranges)."""
+
+    def __init__(self, nfa_bank: NFABank | None, kw_bank=None,
+                 batch_chunks: int = 512):
+        self.nfa = nfa_bank
+        self.kw = kw_bank
+        self.batch_chunks = batch_chunks
+
+    def nfa_windows(self, contents: list[bytes]) -> list[dict[int, list]]:
+        """-> per file: {pattern_idx: [(start, end), ...]} candidate
+        byte windows (already expanded by pattern length)."""
+        out: list[dict[int, list]] = [dict() for _ in contents]
+        if self.nfa is None or self.nfa.n == 0:
+            return out
+        import jax.numpy as jnp
+
+        run = _nfa_kernel(self.nfa.n, self.nfa.words)
+        B = jnp.asarray(self.nfa.B)
+        final = jnp.asarray(self.nfa.final)
+        chunks, owners, starts = chunk_files(
+            contents, overlap=self.nfa.max_len - 1)
+        lens = np.array(self.nfa.lengths)
+        for s0 in range(0, len(chunks), self.batch_chunks):
+            batch = chunks[s0: s0 + self.batch_chunks]
+            hits = np.asarray(run(jnp.asarray(batch), B, final))
+            ci, pi, bi = np.nonzero(hits)
+            for c, p, b in zip(ci.tolist(), pi.tolist(), bi.tolist()):
+                fi = int(owners[s0 + c])
+                base = int(starts[s0 + c])
+                L = int(lens[p])
+                lo = max(base + b * BLOCK - L + 1, 0)
+                hi = min(base + (b + 1) * BLOCK, len(contents[fi]))
+                out[fi].setdefault(p, []).append((lo, hi))
+        for d in out:
+            for p in d:
+                d[p] = _merge_windows(d[p])
+        return out
+
+    def keyword_windows(self, contents: list[bytes], pad: list[int]
+                        ) -> list[dict[int, list]]:
+        """pad[k]: bytes to expand around a hit block of keyword k
+        (the max regex width of the rules using it).
+        -> per file: {keyword_idx: [(start, end), ...]}"""
+        out: list[dict[int, list]] = [dict() for _ in contents]
+        if self.kw is None or not self.kw.keywords:
+            return out
+        import jax.numpy as jnp
+
+        run = _kw_block_kernel(len(self.kw.keywords), self.kw.max_len)
+        kw_dev = jnp.asarray(self.kw.kw)
+        kwlen_dev = jnp.asarray(self.kw.kw_len)
+        chunks, owners, starts = chunk_files(
+            contents, overlap=self.kw.max_len - 1, lower=True)
+        for s0 in range(0, len(chunks), self.batch_chunks):
+            batch = chunks[s0: s0 + self.batch_chunks]
+            hits = np.asarray(run(jnp.asarray(batch), kw_dev, kwlen_dev))
+            ci, ki, bi = np.nonzero(hits)
+            for c, k, b in zip(ci.tolist(), ki.tolist(), bi.tolist()):
+                fi = int(owners[s0 + c])
+                base = int(starts[s0 + c])
+                w = pad[k]
+                lo = max(base + b * BLOCK - w, 0)
+                hi = min(base + (b + 1) * BLOCK + w, len(contents[fi]))
+                out[fi].setdefault(k, []).append((lo, hi))
+        for d in out:
+            for k in d:
+                d[k] = _merge_windows(d[k])
+        return out
+
+
+def _merge_windows(wins: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    wins.sort()
+    out = []
+    for lo, hi in wins:
+        if out and lo <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out

@@ -77,9 +77,7 @@ class SecretConfig:
 
 class SecretScanner:
     def __init__(self, config: SecretConfig | None = None):
-        self._bank = None
-        self._kw_rules = None
-        self._keyword_less = None
+        self._tiers = None
         config = config or SecretConfig()
         rules = list(BUILTIN_RULES)
         if config.enable_builtin_rules:
@@ -141,17 +139,96 @@ class SecretScanner:
 
     # ------------------------------------------------------------ batch
 
+    MAX_WINDOW_WIDTH = 4096  # regexes wider than this scan whole-file
+
+    def _ensure_tiers(self) -> None:
+        """Partition rules into device tiers (SURVEY §7 step 7):
+
+        - nfa: regex compiles EXACTLY to a fixed-length class sequence
+          -> device Shift-And automaton; host regex only inside candidate
+          windows (for groups/censoring)
+        - window: a required literal factor exists and the regex has
+          bounded width -> device literal scan at block resolution,
+          host regex inside windows
+        - file: keyword-prefiltered whole-file host regex (unbounded
+          patterns, e.g. PEM blocks)
+        - always: keyword-less whole-file host regex
+        """
+        if self._tiers is not None:
+            return
+        from trivy_tpu.ops.secret_nfa import (
+            NFABank,
+            compile_class_sequence,
+            has_anchor,
+            regex_width,
+            required_literal,
+        )
+        from trivy_tpu.ops.secret_prefilter import KeywordBank
+
+        nfa_rules: list[CompiledRule] = []
+        nfa_seqs = []
+        window_rules: list[tuple[CompiledRule, int]] = []  # (rule, lit idx)
+        file_rules: list[CompiledRule] = []
+        always_rules: list[CompiledRule] = []
+        lits: list[bytes] = []
+        lit_idx: dict[bytes, int] = {}
+        lit_pad: list[int] = []
+        for cr in self.rules:
+            pattern = cr.rule.regex
+            seq = compile_class_sequence(pattern)
+            if seq is not None:
+                nfa_rules.append(cr)
+                nfa_seqs.append(seq)
+                continue
+            width = regex_width(pattern)
+            lit = required_literal(pattern)
+            if (lit is not None and width is not None
+                    and width[1] < self.MAX_WINDOW_WIDTH
+                    and not has_anchor(pattern)):
+                i = lit_idx.get(lit)
+                if i is None:
+                    i = len(lits)
+                    lit_idx[lit] = i
+                    lits.append(lit)
+                    lit_pad.append(0)
+                lit_pad[i] = max(lit_pad[i], width[1])
+                window_rules.append((cr, i))
+                continue
+            (file_rules if cr.keywords else always_rules).append(cr)
+        self._tiers = {
+            "nfa_rules": nfa_rules,
+            "nfa_bank": NFABank(nfa_seqs) if nfa_seqs else None,
+            "window_rules": window_rules,
+            "lit_bank": KeywordBank(lits) if lits else None,
+            "lit_pad": lit_pad,
+            "file_rules": file_rules,
+            "always_rules": always_rules,
+        }
+        # any-hit prefilter bank over the file-tier rules' keywords
+        kw: list[bytes] = []
+        kw_rules: list[list[CompiledRule]] = []
+        seen: dict[bytes, int] = {}
+        for cr in file_rules:
+            for k in cr.keywords:
+                if k in seen:
+                    kw_rules[seen[k]].append(cr)
+                else:
+                    seen[k] = len(kw)
+                    kw.append(k)
+                    kw_rules.append([cr])
+        self._tiers["kw_bank"] = KeywordBank(kw) if kw else None
+        self._tiers["kw_rules"] = kw_rules
+        _log.debug(
+            "secret rule tiers",
+            nfa=len(nfa_rules), window=len(window_rules),
+            file=len(file_rules), always=len(always_rules))
+
     def scan_files(self, batch: list[tuple[str, bytes]],
                    use_device: bool = True) -> list[Secret]:
-        """Batched scan: one device keyword-prefilter pass over all files,
-        then the regex engine only on (file, rule) pairs with keyword hits
+        """Batched scan: device NFA + literal-window passes over all
+        files at once, host regex only inside candidate windows; rules
+        that can't window-verify keep the whole-file host path
         (the TPU replacement for the reference's per-file loop)."""
-        from trivy_tpu.ops.secret_prefilter import (
-            DevicePrefilter,
-            HostPrefilter,
-            KeywordBank,
-        )
-
         eligible = [
             (i, path, content) for i, (path, content) in enumerate(batch)
             if not self.skip_file(path) and not self.path_allowed(path)
@@ -159,44 +236,115 @@ class SecretScanner:
         ]
         if not eligible:
             return []
-        if self._bank is None:
-            kw: list[bytes] = []
-            self._kw_rules: list[list[CompiledRule]] = []
-            seen: dict[bytes, int] = {}
-            for cr in self.rules:
-                for k in cr.keywords:
-                    if k in seen:
-                        self._kw_rules[seen[k]].append(cr)
-                    else:
-                        seen[k] = len(kw)
-                        kw.append(k)
-                        self._kw_rules.append([cr])
-            self._bank = KeywordBank(kw)
-            self._keyword_less = [cr for cr in self.rules if not cr.keywords]
-        contents = [c for (_i, _p, c) in eligible]
-        prefilter = None
-        if use_device:
-            try:
-                prefilter = DevicePrefilter(self._bank)
-                hits = prefilter.keyword_hits(contents)
-            except Exception as e:  # no device / compile issue -> host
-                _log.debug("device prefilter failed, using host", err=str(e))
-                prefilter = None
-        if prefilter is None:
-            hits = HostPrefilter(self._bank).keyword_hits(contents)
+        if not use_device:
+            return self._scan_files_host(eligible)
+        self._ensure_tiers()
+        try:
+            return self._scan_files_device(eligible)
+        except Exception as e:  # no device / compile issue -> host
+            _log.debug("device secret path failed, using host", err=str(e))
+            return self._scan_files_host(eligible)
+
+    def _scan_files_host(self, eligible) -> list[Secret]:
         out = []
-        for (orig_i, path, content), hit_row in zip(eligible, hits):
-            rules = list(self._keyword_less)
-            seen_ids = set()
-            for ki in np.nonzero(hit_row)[0]:
-                for cr in self._kw_rules[ki]:
-                    if id(cr) not in seen_ids:
-                        seen_ids.add(id(cr))
-                        rules.append(cr)
-            secret = self.scan_file(path, content, rules=rules)
+        for _i, path, content in eligible:
+            secret = self.scan_file(path, content)
             if secret is not None:
                 out.append(secret)
         return out
+
+    def _scan_files_device(self, eligible) -> list[Secret]:
+        from trivy_tpu.ops.secret_nfa import DeviceSecretMatcher
+        from trivy_tpu.ops.secret_prefilter import DevicePrefilter
+
+        t = self._tiers
+        contents = [c for (_i, _p, c) in eligible]
+        matcher = DeviceSecretMatcher(t["nfa_bank"], t["lit_bank"])
+        nfa_wins = matcher.nfa_windows(contents)
+        lit_wins = matcher.keyword_windows(contents, t["lit_pad"]) \
+            if t["lit_bank"] is not None else [dict() for _ in contents]
+        if t["kw_bank"] is not None:
+            kw_hits = DevicePrefilter(t["kw_bank"]).keyword_hits(contents)
+        else:
+            kw_hits = np.zeros((len(contents), 0), dtype=bool)
+
+        out = []
+        for fi, (_orig, path, content) in enumerate(eligible):
+            low = None
+            findings: list[SecretFinding] = []
+            spans: set[tuple[str, int, int]] = set()
+
+            def kw_present(cr) -> bool:
+                # reference semantics: a rule with keywords only runs
+                # when one occurs in the file (scanner.go:174-186)
+                nonlocal low
+                if not cr.keywords:
+                    return True
+                if low is None:
+                    low = content.lower()
+                return any(k in low for k in cr.keywords)
+
+            # tier 1: device NFA candidates
+            for p, wins in nfa_wins[fi].items():
+                cr = t["nfa_rules"][p]
+                if cr.path_rx is not None and not cr.path_rx.match(path):
+                    continue
+                if not kw_present(cr):
+                    continue
+                self._verify_windows(cr, path, content, wins,
+                                     findings, spans)
+            # tier 2: literal-anchored windows
+            done_rules = set()
+            for cr, li in t["window_rules"]:
+                wins = lit_wins[fi].get(li)
+                if not wins or id(cr) in done_rules:
+                    continue
+                done_rules.add(id(cr))
+                if cr.path_rx is not None and not cr.path_rx.match(path):
+                    continue
+                if not kw_present(cr):
+                    continue
+                self._verify_windows(cr, path, content, wins,
+                                     findings, spans)
+            # tier 3: keyword-prefiltered whole-file rules
+            hit_row = kw_hits[fi]
+            seen_ids = set()
+            for ki in np.nonzero(hit_row)[0]:
+                for cr in t["kw_rules"][ki]:
+                    if id(cr) in seen_ids:
+                        continue
+                    seen_ids.add(id(cr))
+                    self._verify_windows(cr, path, content,
+                                         [(0, len(content))],
+                                         findings, spans)
+            # tier 4: keyword-less whole-file rules
+            for cr in t["always_rules"]:
+                self._verify_windows(cr, path, content,
+                                     [(0, len(content))], findings, spans)
+
+            if findings:
+                findings.sort(key=lambda f: (f.start_line, f.rule_id))
+                out.append(Secret(file_path=path, findings=findings))
+        return out
+
+    def _verify_windows(self, cr: CompiledRule, path: str, content: bytes,
+                        wins, findings, spans) -> None:
+        """Run the rule's real regex inside candidate windows; dedupe by
+        (rule, span) since windows may overlap across chunks."""
+        if cr.path_rx is not None and not cr.path_rx.match(path):
+            return
+        for lo, hi in wins:
+            for m in cr.regex.finditer(content, lo, hi):
+                secret_bytes, start, end = self._secret_span(cr, m)
+                if secret_bytes is None:
+                    continue
+                key = (cr.rule.id, start, end)
+                if key in spans:
+                    continue
+                spans.add(key)
+                if self._allowed(path, secret_bytes):
+                    continue
+                findings.append(self._finding(cr, content, start, end))
 
     def candidate_rules(self, content_lower: bytes) -> list[CompiledRule]:
         """Keyword prefilter (scanner.go:174-186): a rule runs only if one
