@@ -147,3 +147,34 @@ def test_rescreen_efficiency(db):
     # candidates are name-matched rows; interval test should cut most
     # non-matching versions before the host sees them
     assert st["confirmed"] >= st["candidates"] * 0.25, st
+
+
+def test_detect_many_pipelined_matches_detect(db):
+    """The pipelined crawl path (async dispatch, deferred collect) must
+    produce exactly the same results as per-batch detect."""
+    engine = MatchEngine(db, window=32)
+    queries = _random_queries(random.Random(21), n=1200)
+    a = engine.detect(queries)
+    b = engine.detect_many(queries, batch_size=256, depth=2)
+    assert [r.adv_indices for r in a] == [r.adv_indices for r in b]
+    oracle = engine.oracle_detect(queries)
+    assert [r.adv_indices for r in b] == [r.adv_indices for r in oracle]
+
+
+def test_npm_prerelease_inexact_key_in_subtracted_hull():
+    """Regression (r4 review): an npm pre-release version with an INEXACT
+    key (FLAG_NEEDS_HOST, no FLAG_RESCREEN) must still reach the
+    PRE_ONLY hull rows when subtraction removed all exact rows."""
+    from trivy_tpu.db import Advisory, AdvisoryDB
+
+    adv_db = AdvisoryDB()
+    adv_db.put_advisory("npm::ghsa", "lodash", Advisory(
+        vulnerability_id="CVE-X",
+        vulnerable_versions=[">=1.5.0-alpha.1 <2.0.0"],
+        patched_versions=[">=1.4.0"],
+    ))
+    engine = MatchEngine(adv_db, window=32)
+    q = PkgQuery("npm::", "lodash", "1.5.0-alpha." + "x" * 60, "npm")
+    dev = engine.detect([q])[0].adv_indices
+    ora = engine.oracle_detect([q])[0].adv_indices
+    assert dev == ora

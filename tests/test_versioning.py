@@ -190,9 +190,9 @@ def test_key_property_random(scheme_name):
 
 
 def _contains(iv_tuple, pv, scheme):
-    """Containment for _advisory_intervals' (lo, lo_incl, hi, hi_incl)
-    string-boundary tuples."""
-    lo, lo_incl, hi, hi_incl = iv_tuple
+    """Containment for _advisory_intervals' (lo, lo_incl, hi, hi_incl,
+    flags) string-boundary tuples."""
+    lo, lo_incl, hi, hi_incl = iv_tuple[:4]
     if lo is not None:
         d = scheme.compare_parsed(pv, scheme.parse(lo))
         if d < 0 or (d == 0 and not lo_incl):
@@ -295,8 +295,8 @@ class TestConstraints:
         ]
         scheme = versioning.get_scheme("generic")
         for adv in advisories:
-            ivs, extra = _advisory_intervals(adv, "generic", "go")
-            assert extra == 0
+            ivs = _advisory_intervals(adv, "generic", "go")
+            assert all(iv[4] == 0 for iv in ivs)
             checker = AdvisoryChecker(adv, "generic")
             for _ in range(400):
                 v = ".".join(str(rng.randint(0, 7)) for _ in range(3))
@@ -307,12 +307,15 @@ class TestConstraints:
                 assert in_iv == checker.check_parsed(pv), (adv, v)
 
     def test_npm_prerelease_secure_subtraction_flagged(self):
-        """npm advisory with secure ranges: the compiled intervals must stay
-        the UNSUBTRACTED vulnerable hull with a rescreen flag — subtracting
-        would lose pre-release versions the npm rule still matches."""
+        """npm advisory with secure ranges compiles to subtracted intervals
+        (exact for release versions) PLUS the unsubtracted vulnerable hull
+        gated FLAG_PRE_ONLY|FLAG_RESCREEN — pre-release versions the npm
+        rule still matches live only in the gated superset rows."""
         from trivy_tpu.db.model import Advisory
         from trivy_tpu.detector.exact import AdvisoryChecker
-        from trivy_tpu.tensorize.compile import FLAG_RESCREEN, _advisory_intervals
+        from trivy_tpu.tensorize.compile import (
+            FLAG_PRE_ONLY, FLAG_RESCREEN, _advisory_intervals,
+        )
 
         adv = Advisory(
             vulnerable_versions=["<2.0.0-beta.3"],
@@ -320,11 +323,21 @@ class TestConstraints:
         )
         checker = AdvisoryChecker(adv, "npm")
         assert checker.check("2.0.0-alpha.5")  # npm rule: not "patched"
-        ivs, extra = _advisory_intervals(adv, "npm", "npm")
-        assert extra == FLAG_RESCREEN
+        ivs = _advisory_intervals(adv, "npm", "npm")
         scheme = versioning.get_scheme("npm")
         pv = scheme.parse("2.0.0-alpha.5")
-        assert any(_contains(iv, pv, scheme) for iv in ivs)
+        pre_rows = [iv for iv in ivs if iv[4] & FLAG_PRE_ONLY]
+        exact_rows = [iv for iv in ivs if not iv[4]]
+        # the pre-release point survives only in the gated superset rows,
+        # and those are always rescreened
+        assert any(_contains(iv, pv, scheme) for iv in pre_rows)
+        assert all(iv[4] & FLAG_RESCREEN for iv in pre_rows)
+        assert not any(_contains(iv, pv, scheme) for iv in exact_rows)
+        # subtracted rows are exact for release versions
+        for v in ("1.0.0", "1.9.4", "1.9.5", "2.1.0"):
+            rv = scheme.parse(v)
+            in_exact = any(_contains(iv, rv, scheme) for iv in exact_rows)
+            assert in_exact == checker.check(v), v
 
     def test_npm_prerelease_secure_end_to_end(self):
         """The device path must find the pre-release npm match the oracle

@@ -67,6 +67,9 @@ class MatchEngine:
         # lazy per-advisory compiled checkers + parsed-version memo
         self._checkers: dict[int, AdvisoryChecker] = {}
         self._parse_cache: dict[tuple[str, str], object] = {}
+        # (adv_idx, version) -> bool rescreen verdict memo: the same
+        # packages recur across artifacts of a crawl
+        self._verdict_cache: dict[tuple[int, str], bool] = {}
         self._ddb_hot = None
         self._name_tokens: dict[tuple[str, str], int] | None = None
         self._adv_tok = None
@@ -216,31 +219,76 @@ class MatchEngine:
         hits = self._detect_unique(queries)
         return [MatchResult(q, h) for q, h in zip(queries, hits)]
 
-    def _detect_unique(self, queries: list[PkgQuery]) -> list[list[int]]:
-        """-> sorted advisory-index list per (unique) query.
+    def detect_many(self, queries: list[PkgQuery], batch_size: int = 65536,
+                    depth: int = 3) -> list[MatchResult]:
+        """Pipelined crawl: up to `depth` batches are deduped, encoded and
+        *dispatched* to the device before the first result is collected,
+        so device round-trips (over a possibly high-latency link) overlap
+        the host post-processing of earlier batches. jax dispatch is
+        async — the Pending handles are futures."""
+        if not self.use_device:
+            out = []
+            for i in range(0, len(queries), batch_size):
+                out.extend(self.oracle_detect(queries[i: i + batch_size]))
+            return out
+        from collections import deque
 
-        Exact hits are confirmed fully vectorized (one int compare per
-        candidate for the hash-collision check); only flagged rescreen
-        candidates reach the per-advisory Python comparators."""
-        import numpy as np
+        results: list[MatchResult] = []
+        pend: deque = deque()
 
+        def flush_one():
+            qs, uniq, idx_map, ctx = pend.popleft()
+            uniq_hits = self._collect_unique(ctx)
+            if idx_map is None:
+                results.extend(
+                    MatchResult(q, h) for q, h in zip(qs, uniq_hits))
+            else:
+                results.extend(
+                    MatchResult(q, uniq_hits[idx_map[j]])
+                    for j, q in enumerate(qs))
+
+        for i in range(0, len(queries), batch_size):
+            qs = queries[i: i + batch_size]
+            uniq, idx_map = self.dedupe_queries(qs)
+            if len(uniq) == len(qs):
+                uniq, idx_map = qs, None
+            pend.append((qs, uniq, idx_map, self._dispatch_unique(uniq)))
+            while len(pend) >= depth:
+                flush_one()
+        while pend:
+            flush_one()
+        return results
+
+    def _rescreen_one(self, adv_idx: int, q: PkgQuery) -> bool:
+        """Exact host verdict for one flagged (advisory, query) candidate."""
+        ch = self._checker(adv_idx)
+        if ch is None:
+            return False
+        ver = self._parse_version(q.scheme_name, q.version)
+        if ver is None:
+            # unparseable installed version: only the empty-range
+            # "always vulnerable" advisories match
+            return ch.adv.is_range_style and ch.always
+        return ch.check_parsed(ver)
+
+    def _dispatch_unique(self, queries: list[PkgQuery]) -> dict:
+        """Encode and enqueue the device work for a unique-query batch
+        without blocking. -> opaque ctx for _collect_unique."""
         from trivy_tpu.ops import match as m
 
-        batch = self.cdb.encode_packages(
+        cdb = self.cdb
+        batch = cdb.encode_packages(
             [(q.space, q.name, q.version, q.scheme_name) for q in queries]
         )
+        ctx = {"queries": queries, "batch": batch,
+               "main": None, "sharded": None, "hot": None}
         if self._sdb is not None:
-            hits = m.match_batch_sharded(self._sdb, batch)
-        else:
-            hits = m.match_batch(self._ddb, batch)
-        rows, cols = np.nonzero(hits >= 0)
-        packed = hits[rows, cols]
-
-        # hot-name queries additionally run against the hot partition
-        # (transfer is |hot queries| x hot_window, tiny after dedupe)
+            ctx["sharded"] = m.sharded_dispatch(self._sdb, batch)
+        elif self._ddb is not None:
+            ctx["main"] = m.match_dispatch(self._ddb, batch)
         hot_idx = [
             j for j, q in enumerate(queries)
-            if (q.space, q.name) in self.cdb.host_fallback
+            if (q.space, q.name) in cdb.host_fallback
         ]
         if hot_idx and self._ddb_hot is not None:
             sub = m.PackageBatch(
@@ -248,22 +296,76 @@ class MatchEngine:
                 rank=batch.rank[hot_idx], flags=batch.flags[hot_idx],
                 queries=[batch.queries[j] for j in hot_idx],
             )
-            hot_hits = m.match_batch(self._ddb_hot, sub)
-            hrows, hcols = np.nonzero(hot_hits >= 0)
-            rows = np.concatenate(
-                [rows, np.asarray(hot_idx, dtype=rows.dtype)[hrows]])
-            packed = np.concatenate([packed, hot_hits[hrows, hcols]])
+            ctx["hot"] = (hot_idx, m.match_dispatch(self._ddb_hot, sub), sub)
+        return ctx
 
-        ids = packed & (m.RESCREEN_BIT - 1)
-        resc = (packed & m.RESCREEN_BIT) != 0
+    def _detect_unique(self, queries: list[PkgQuery]) -> list[list[int]]:
+        return self._collect_unique(self._dispatch_unique(queries))
 
-        # dedupe (row, id) keeping the exact (non-rescreen) occurrence
-        if len(rows):
-            order = np.lexsort((resc, ids, rows))
-            rows, ids, resc = rows[order], ids[order], resc[order]
-            keep = np.ones(len(rows), dtype=bool)
-            keep[1:] = (rows[1:] != rows[:-1]) | (ids[1:] != ids[:-1])
-            rows, ids, resc = rows[keep], ids[keep], resc[keep]
+    def _collect_unique(self, ctx: dict) -> list[list[int]]:
+        """-> sorted advisory-index list per (unique) query.
+
+        The kernel returns bit-packed hit masks; the host maps set bits to
+        row indices with its own searchsorted over the resident numpy
+        copies, screens hash collisions with one vectorized token compare,
+        and confirms exact hits with no per-hit Python at all (np.split on
+        row boundaries). Only flagged rescreen candidates — needs-host
+        versions and npm pre-release queries — reach the per-advisory
+        Python comparators, behind an (advisory, version) verdict memo."""
+        import numpy as np
+
+        from trivy_tpu.ops import match as m
+
+        cdb = self.cdb
+        queries = ctx["queries"]
+        batch = ctx["batch"]
+
+        all_rows: list[np.ndarray] = []
+        all_ids: list[np.ndarray] = []
+        all_rfl: list[np.ndarray] = []
+        if ctx["sharded"] is not None:
+            masks = ctx["sharded"].collect()  # [D, B, W]
+            base = self._sdb.shard_base
+            for d in range(masks.shape[0]):
+                lo_i = d * base
+                hi_i = min(lo_i + self._sdb.shard_len, cdb.n_rows)
+                if lo_i >= cdb.n_rows:
+                    break
+                start = np.searchsorted(
+                    cdb.row_h1[lo_i:hi_i], batch.h1).astype(np.int64) + lo_i
+                rows_d, offs_d = np.nonzero(masks[d])
+                ridx = start[rows_d] + offs_d
+                all_rows.append(rows_d)
+                all_ids.append(cdb.row_adv[ridx])
+                all_rfl.append(cdb.row_flags[ridx])
+        elif ctx["main"] is not None:
+            mask = ctx["main"].collect()  # [B, W]
+            start = np.searchsorted(cdb.row_h1, batch.h1).astype(np.int64)
+            rows0, offs0 = np.nonzero(mask)
+            ridx = start[rows0] + offs0
+            all_rows.append(rows0)
+            all_ids.append(cdb.row_adv[ridx])
+            all_rfl.append(cdb.row_flags[ridx])
+
+        # hot-name queries additionally run against the hot partition
+        # (transfer is |hot queries| x hot_window bits, tiny after dedupe)
+        if ctx["hot"] is not None:
+            hot_idx, hot_pending, sub = ctx["hot"]
+            hmask = hot_pending.collect()
+            hstart = np.searchsorted(cdb.hot_h1, sub.h1).astype(np.int64)
+            hrows, hoffs = np.nonzero(hmask)
+            hridx = hstart[hrows] + hoffs
+            all_rows.append(np.asarray(hot_idx, dtype=np.int64)[hrows])
+            all_ids.append(cdb.hot_adv[hridx])
+            all_rfl.append(cdb.hot_flags[hridx])
+
+        rows = np.concatenate(all_rows) if all_rows else np.empty(0, np.int64)
+        if len(rows) == 0:
+            return [[] for _ in queries]
+        ids = np.concatenate(all_ids).astype(np.int64)
+        rfl = np.concatenate(all_rfl)
+        pfl = batch.flags[rows]
+        resc = ((rfl | pfl) & (m.FLAG_NEEDS_HOST | m.FLAG_RESCREEN)) != 0
 
         # hash-collision screen: advisory's (space, name) token must equal
         # the query's
@@ -274,29 +376,34 @@ class MatchEngine:
         valid = self._adv_tok[ids] == q_tok[rows]
         rows, ids, resc = rows[valid], ids[valid], resc[valid]
 
-        out: list[list[int]] = [[] for _ in queries]
-        # exact hits: the kernel's interval test IS the exact check
-        ex_rows, ex_ids = rows[~resc], ids[~resc]
-        for r, i in zip(ex_rows.tolist(), ex_ids.tolist()):
-            out[r].append(i)
-        n_conf = len(ex_rows)
+        # dedupe (row, id) keeping the exact (non-rescreen) occurrence
+        # (multi-interval advisories, shard halos, pre-only twin rows)
+        order = np.lexsort((resc, ids, rows))
+        rows, ids, resc = rows[order], ids[order], resc[order]
+        keep = np.ones(len(rows), dtype=bool)
+        keep[1:] = (rows[1:] != rows[:-1]) | (ids[1:] != ids[:-1])
+        rows, ids, resc = rows[keep], ids[keep], resc[keep]
 
-        # flagged candidates: exact per-advisory comparators on host
-        for r, i in zip(rows[resc].tolist(), ids[resc].tolist()):
-            q = queries[r]
-            ch = self._checker(i)
-            if ch is None:
-                continue
-            ver = self._parse_version(q.scheme_name, q.version)
-            if ver is None:
-                if ch.adv.is_range_style and ch.always:
-                    out[r].append(i)
-                    n_conf += 1
-                continue
-            if ch.check_parsed(ver):
-                out[r].append(i)
-                n_conf += 1
+        # exact hits confirm as-is; flagged candidates get the exact
+        # comparators (memoized per (advisory, version))
+        conf = ~resc
+        flagged = np.nonzero(resc)[0]
+        if len(flagged):
+            vcache = self._verdict_cache
+            for k in flagged.tolist():
+                q = queries[rows[k]]
+                ckey = (int(ids[k]), q.version)
+                v = vcache.get(ckey)
+                if v is None:
+                    v = self._rescreen_one(ckey[0], q)
+                    vcache[ckey] = v
+                if v:
+                    conf[k] = True
 
+        rows_c, ids_c = rows[conf], ids[conf]
         self.rescreen_stats["candidates"] += len(rows)
-        self.rescreen_stats["confirmed"] += n_conf
-        return [sorted(h) for h in out]
+        self.rescreen_stats["confirmed"] += len(rows_c)
+        # rows_c is sorted with ids ascending within each row: np.split on
+        # row boundaries yields the final per-query sorted hit lists
+        bounds = np.searchsorted(rows_c, np.arange(1, len(queries)))
+        return [a.tolist() for a in np.split(ids_c, bounds)]

@@ -8,11 +8,21 @@ against the resident advisory tensors.
 Algorithm (all int32/uint32, XLA-friendly, no dynamic shapes):
   1. vectorized binary search of each package's h1 in the sorted row_h1
      (jnp.searchsorted lowers to an O(log N) while loop on TPU)
-  2. gather a fixed window of `W` consecutive rows per package
+  2. ONE gather of a fixed window of `W` consecutive 8-lane rows per
+     package from the interleaved [N, 8] row table (h1,h2,lo,hi,flags
+     packed side by side so a single gather serves every field — six
+     independent gathers ran 38x slower on real TPU hardware)
   3. hit = (h1,h2 equal) AND (lo_rank <= pkg_rank <= hi_rank
                               OR row NEEDS_HOST OR pkg NEEDS_HOST)
-  4. emit the advisory id per hit (-1 otherwise); the host compresses and
-     rescreens candidates with the exact comparators.
+           AND (row not PRE_ONLY OR pkg flagged pre-release)
+  4. the kernel returns a *bit-packed* hit mask (uint32[B, W/32], 8 bytes
+     per query instead of a 4*W-byte id matrix — the device link may be a
+     tunnel, so result bytes are the scarce resource). The host recomputes
+     window starts with its own numpy searchsorted and maps set bits back
+     to advisory ids/flags from its resident copies.
+
+Batch shapes are padded up to power-of-two buckets so the jit cache hits
+for every batch of a crawl (recompiles cost seconds per shape on TPU).
 
 Sharding: the DB rows are the big tensor, so they shard over the "db" mesh
 axis (each shard carries a W-row halo from its right neighbour so windows
@@ -36,19 +46,45 @@ from trivy_tpu.tensorize.compile import CompiledDB, PackageBatch
 
 FLAG_NEEDS_HOST = 1
 FLAG_RESCREEN = 2  # pkg-level: interval hit is superset, rescreen needed
-RESCREEN_BIT = 1 << 30  # packed into the emitted advisory id
+FLAG_PRE_ONLY = 4  # row-level: only candidates for pre-release queries
+
+TABLE_LANES = 8  # int32 lanes per row: h1,h2,lo,hi,flags + 3 pad
+
+_PAD_H1 = np.uint32(0xFFFFFFFF)
+
+
+def _words(window: int) -> int:
+    """Output words per query for a given guarantee window."""
+    return -(-window // 32)
+
+
+def _bucket(n: int) -> int:
+    """Pad batch sizes to 128 * 2^k so jit shapes repeat across batches."""
+    if n <= 128:
+        return 128
+    return 128 << (-(-n // 128) - 1).bit_length()
+
+
+def _pack_table(h1, h2, lo, hi, flags) -> np.ndarray:
+    """-> int32[N, TABLE_LANES] interleaved row table (one gather serves
+    all fields). h1/h2 are bitcast; equality compares are unaffected."""
+    n = len(h1)
+    t = np.zeros((n, TABLE_LANES), dtype=np.int32)
+    t[:, 0] = h1.view(np.int32)
+    t[:, 1] = h2.view(np.int32)
+    t[:, 2] = lo
+    t[:, 3] = hi
+    t[:, 4] = flags
+    return t
 
 
 @dataclass
 class DeviceDB:
-    """Advisory row tensors resident on device (HBM)."""
+    """Advisory rows resident on device (HBM): the sorted h1 key column
+    (binary-search target) plus the interleaved row table."""
 
-    h1: jax.Array  # uint32[N]
-    h2: jax.Array  # uint32[N]
-    lo: jax.Array  # int32[N]
-    hi: jax.Array  # int32[N]
-    flags: jax.Array  # int32[N]
-    adv: jax.Array  # int32[N]
+    h1: jax.Array  # uint32[N], sorted
+    table: jax.Array  # int32[N, TABLE_LANES]
     n_rows: int
     window: int
 
@@ -57,11 +93,8 @@ class DeviceDB:
         put = functools.partial(jax.device_put, device=device)
         return cls(
             h1=put(cdb.row_h1),
-            h2=put(cdb.row_h2),
-            lo=put(cdb.row_lo),
-            hi=put(cdb.row_hi),
-            flags=put(cdb.row_flags),
-            adv=put(cdb.row_adv),
+            table=put(_pack_table(cdb.row_h1, cdb.row_h2, cdb.row_lo,
+                                  cdb.row_hi, cdb.row_flags)),
             n_rows=cdb.n_rows,
             window=cdb.window,
         )
@@ -77,63 +110,121 @@ class DeviceDB:
         put = functools.partial(jax.device_put, device=device)
         return cls(
             h1=put(cdb.hot_h1),
-            h2=put(cdb.hot_h2),
-            lo=put(cdb.hot_lo),
-            hi=put(cdb.hot_hi),
-            flags=put(cdb.hot_flags),
-            adv=put(cdb.hot_adv),
+            table=put(_pack_table(cdb.hot_h1, cdb.hot_h2, cdb.hot_lo,
+                                  cdb.hot_hi, cdb.hot_flags)),
             n_rows=len(cdb.hot_h1),
             window=cdb.hot_window,
         )
 
 
 @functools.partial(jax.jit, static_argnames=("window",))
-def _match_kernel(
-    row_h1, row_h2, row_lo, row_hi, row_flags, row_adv,
-    pkg_h1, pkg_h2, pkg_rank, pkg_flags, *, window: int
-):
-    """-> int32[B, window]: advisory id per hit, -1 elsewhere."""
+def _match_kernel(row_h1, table, pkg_h1, pkg_h2, pkg_rank, pkg_flags,
+                  *, window: int):
+    """-> uint32[B, W/32]: bit w%32 of word w//32 set iff the row at
+    (window start + w) is a hit for the query."""
     n = row_h1.shape[0]
+    w = _words(window) * 32
     start = jnp.searchsorted(row_h1, pkg_h1, side="left").astype(jnp.int32)
-    offs = start[:, None] + jnp.arange(window, dtype=jnp.int32)[None, :]
+    offs = start[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
     in_bounds = offs < n
     idx = jnp.minimum(offs, n - 1)
-    rh1 = row_h1[idx]
-    rh2 = row_h2[idx]
-    rlo = row_lo[idx]
-    rhi = row_hi[idx]
-    rfl = row_flags[idx]
-    radv = row_adv[idx]
-    name_eq = in_bounds & (rh1 == pkg_h1[:, None]) & (rh2 == pkg_h2[:, None])
+    rows = table[idx]  # [B, w, TABLE_LANES] — the one gather
+    rh1 = rows[..., 0]
+    rh2 = rows[..., 1]
+    rlo = rows[..., 2]
+    rhi = rows[..., 3]
+    rfl = rows[..., 4]
+    ph1 = jax.lax.bitcast_convert_type(pkg_h1, jnp.int32)
+    ph2 = jax.lax.bitcast_convert_type(pkg_h2, jnp.int32)
+    name_eq = in_bounds & (rh1 == ph1[:, None]) & (rh2 == ph2[:, None])
     rank = pkg_rank[:, None]
     in_iv = (rlo <= rank) & (rank <= rhi)
-    host = ((rfl & FLAG_NEEDS_HOST) != 0) | ((pkg_flags[:, None] & FLAG_NEEDS_HOST) != 0)
-    hit = name_eq & (in_iv | host)
-    # pack a "needs exact host rescreen" bit: set for needs-host rows/pkgs,
-    # for rows whose intervals are a superset of the exact check (npm
-    # advisories with secure ranges), and for pkgs whose match semantics
-    # exceed pure intervals (npm pre-release rule). Exact hits skip the
-    # Python rescreen entirely.
-    rescreen = (
-        host
-        | ((rfl & FLAG_RESCREEN) != 0)
-        | ((pkg_flags[:, None] & FLAG_RESCREEN) != 0)
+    host = ((rfl & FLAG_NEEDS_HOST) != 0) | (
+        (pkg_flags[:, None] & FLAG_NEEDS_HOST) != 0)
+    # PRE_ONLY rows admit pre-release-flagged queries AND needs-host
+    # queries (inexact keys still parse host-side and may truly match in
+    # the unsubtracted hull; both kinds are always host-rescreened)
+    pre_ok = ((rfl & FLAG_PRE_ONLY) == 0) | (
+        (pkg_flags[:, None] & (FLAG_RESCREEN | FLAG_NEEDS_HOST)) != 0)
+    hit = name_eq & (in_iv | host) & pre_ok
+    bits = hit.reshape(hit.shape[0], -1, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(bits * weights[None, None, :], axis=-1)
+
+
+def _unpack_words(words: np.ndarray, window: int) -> np.ndarray:
+    """uint32[B, W/32] -> bool[B, ceil32(W)] hit mask."""
+    if words.size == 0:
+        return np.zeros((words.shape[0], _words(window) * 32), dtype=bool)
+    words = np.ascontiguousarray(words)
+    return np.unpackbits(
+        words.view(np.uint8).reshape(words.shape[0], -1),
+        axis=1, bitorder="little").astype(bool)
+
+
+def _sorted_padded(batch: PackageBatch, bucket: int):
+    """Sort queries by h1 (near-monotonic gather indices) and pad to the
+    bucket with no-match sentinels. -> (order, h1, h2, rank, flags)."""
+    order = np.argsort(batch.h1, kind="stable")
+    pad = bucket - len(order)
+
+    def prep(a, fill):
+        s = a[order]
+        if pad:
+            s = np.concatenate([s, np.full(pad, fill, a.dtype)])
+        return s
+
+    return (
+        order,
+        prep(batch.h1, _PAD_H1),
+        prep(batch.h2, _PAD_H1),
+        prep(batch.rank, np.int32(0)),
+        prep(batch.flags, np.int32(0)),
     )
-    packed = radv + jnp.where(rescreen & (radv >= 0), RESCREEN_BIT, 0)
-    return jnp.where(hit, packed, jnp.int32(-1))
+
+
+@dataclass
+class Pending:
+    """An in-flight device match: the jax array is a future — dispatches
+    are async, so a crawl can enqueue several batches before paying the
+    (possibly tunneled) device round-trip once, overlapped."""
+
+    words: jax.Array  # uint32[bucket, W/32]
+    order: np.ndarray
+    b: int
+    window: int
+
+    def collect(self) -> np.ndarray:
+        """Block and -> bool[B, ceil32(W)] mask in original query order."""
+        mask_sorted = _unpack_words(np.asarray(self.words)[: self.b],
+                                    self.window)
+        mask = np.empty_like(mask_sorted)
+        mask[self.order] = mask_sorted
+        return mask
+
+
+def match_dispatch(ddb: DeviceDB, batch: PackageBatch) -> Pending | None:
+    """Enqueue a match without blocking. None when there is no work."""
+    b = len(batch.h1)
+    if ddb.n_rows == 0 or b == 0:
+        return None
+    order, h1, h2, rank, flags = _sorted_padded(batch, _bucket(b))
+    words = _match_kernel(
+        ddb.h1, ddb.table,
+        jnp.asarray(h1), jnp.asarray(h2),
+        jnp.asarray(rank), jnp.asarray(flags),
+        window=ddb.window,
+    )
+    return Pending(words=words, order=order, b=b, window=ddb.window)
 
 
 def match_batch(ddb: DeviceDB, batch: PackageBatch) -> np.ndarray:
-    """Single-device match -> int32[B, W] advisory ids (-1 = no hit)."""
-    if ddb.n_rows == 0 or len(batch.h1) == 0:
-        return np.full((len(batch.h1), ddb.window), -1, dtype=np.int32)
-    out = _match_kernel(
-        ddb.h1, ddb.h2, ddb.lo, ddb.hi, ddb.flags, ddb.adv,
-        jnp.asarray(batch.h1), jnp.asarray(batch.h2),
-        jnp.asarray(batch.rank), jnp.asarray(batch.flags),
-        window=ddb.window,
-    )
-    return np.asarray(out)
+    """Single-device match -> bool[B, ceil32(W)] hit mask in the original
+    query order. Row index of bit (b, w) = searchsorted(row_h1, h1[b]) + w."""
+    p = match_dispatch(ddb, batch)
+    if p is None:
+        return np.zeros((len(batch.h1), _words(ddb.window) * 32), dtype=bool)
+    return p.collect()
 
 
 # --------------------------------------------------------------- sharded
@@ -145,61 +236,61 @@ class ShardedDB:
     and sharded over the mesh "db" axis."""
 
     h1: jax.Array  # uint32[D, S]
-    h2: jax.Array
-    lo: jax.Array
-    hi: jax.Array
-    flags: jax.Array
-    adv: jax.Array
+    table: jax.Array  # int32[D, S, TABLE_LANES]
     mesh: Mesh
     window: int
     shard_len: int
+    shard_base: int  # global row stride between shard starts
 
     @classmethod
     def from_compiled(cls, cdb: CompiledDB, mesh: Mesh) -> "ShardedDB":
         n_db = mesh.shape["db"]
         w = cdb.window
         n = cdb.n_rows
-        shard_len = -(-max(n, 1) // n_db) + w  # ceil + halo
+        base = -(-max(n, 1) // n_db)
+        shard_len = base + w  # ceil + halo
+
         def shard(arr, fill):
             out = np.full((n_db, shard_len), fill, dtype=arr.dtype)
-            base = -(-max(n, 1) // n_db)
             for d in range(n_db):
                 lo_i = d * base
                 hi_i = min(lo_i + shard_len, n)
                 if lo_i < n:
                     out[d, : hi_i - lo_i] = arr[lo_i:hi_i]
             return out
+
         # pad rows with h1=0xffffffff so searchsorted lands before padding
         # and name_eq fails on it (no real hash is all-ones with h2 ones too)
-        pad_h1 = np.uint32(0xFFFFFFFF)
-        sharded = cls(
-            h1=None, h2=None, lo=None, hi=None, flags=None, adv=None,
-            mesh=mesh, window=w, shard_len=shard_len,
+        h1s = shard(cdb.row_h1, _PAD_H1)
+        tables = np.stack([
+            _pack_table(h1s[d],
+                        shard(cdb.row_h2, _PAD_H1)[d],
+                        shard(cdb.row_lo, 0)[d],
+                        shard(cdb.row_hi, -1)[d],
+                        shard(cdb.row_flags, 0)[d])
+            for d in range(n_db)
+        ])
+        return cls(
+            h1=jax.device_put(h1s, NamedSharding(mesh, P("db", None))),
+            table=jax.device_put(
+                tables, NamedSharding(mesh, P("db", None, None))),
+            mesh=mesh, window=w, shard_len=shard_len, shard_base=base,
         )
-        spec = NamedSharding(mesh, P("db", None))
-        sharded.h1 = jax.device_put(shard(cdb.row_h1, pad_h1), spec)
-        sharded.h2 = jax.device_put(shard(cdb.row_h2, pad_h1), spec)
-        sharded.lo = jax.device_put(shard(cdb.row_lo, 0), spec)
-        sharded.hi = jax.device_put(shard(cdb.row_hi, -1), spec)
-        sharded.flags = jax.device_put(shard(cdb.row_flags, 0), spec)
-        sharded.adv = jax.device_put(shard(cdb.row_adv, -1), spec)
-        return sharded
 
 
 @functools.partial(jax.jit, static_argnames=("window", "mesh"))
-def _sharded_match(
-    row_h1, row_h2, row_lo, row_hi, row_flags, row_adv,
-    pkg_h1, pkg_h2, pkg_rank, pkg_flags, *, window: int, mesh: Mesh
-):
+def _sharded_match(row_h1, table, pkg_h1, pkg_h2, pkg_rank, pkg_flags,
+                   *, window: int, mesh: Mesh):
     """DB sharded over "db", packages sharded over "data".
-    -> int32[n_db, B, W] stacked per-shard hits (host dedupes the halo)."""
+    -> uint32[n_db, B, W/32] stacked per-shard hit words (the host maps
+    each shard's bits through that shard's own window starts and dedupes
+    the halo overlap)."""
 
-    def local(rh1, rh2, rlo, rhi, rfl, radv, ph1, ph2, prank, pflags):
+    def local(rh1, rtab, ph1, ph2, prank, pflags):
         out = _match_kernel(
-            rh1[0], rh2[0], rlo[0], rhi[0], rfl[0], radv[0],
-            ph1, ph2, prank, pflags, window=window,
+            rh1[0], rtab[0], ph1, ph2, prank, pflags, window=window,
         )
-        return out[None]  # [1, b_local, W]
+        return out[None]  # [1, b_local, W/32]
 
     from jax import shard_map
 
@@ -207,61 +298,63 @@ def _sharded_match(
         local,
         mesh=mesh,
         in_specs=(
-            P("db", None), P("db", None), P("db", None),
-            P("db", None), P("db", None), P("db", None),
+            P("db", None), P("db", None, None),
             P("data"), P("data"), P("data"), P("data"),
         ),
         out_specs=P("db", "data", None),
-    )(
-        row_h1, row_h2, row_lo, row_hi, row_flags, row_adv,
-        pkg_h1, pkg_h2, pkg_rank, pkg_flags,
+    )(row_h1, table, pkg_h1, pkg_h2, pkg_rank, pkg_flags)
+
+
+@dataclass
+class ShardedPending:
+    """In-flight sharded match (see Pending)."""
+
+    out: jax.Array  # uint32[n_db, bucket, W/32]
+    order: np.ndarray
+    b: int
+    window: int
+    n_db: int
+
+    def collect(self) -> np.ndarray:
+        """Block and -> bool[n_db, B, ceil32(W)] per-shard masks in the
+        original query order."""
+        w = _words(self.window) * 32
+        out = np.asarray(self.out)[:, : self.b]
+        masks = np.empty((self.n_db, self.b, w), dtype=bool)
+        for d in range(self.n_db):
+            m = _unpack_words(out[d], self.window)
+            masks[d][self.order] = m
+        return masks
+
+
+def sharded_dispatch(sdb: ShardedDB,
+                     batch: PackageBatch) -> ShardedPending | None:
+    """Enqueue a sharded match without blocking. None when no work."""
+    n_data = sdb.mesh.shape["data"]
+    n_db = sdb.mesh.shape["db"]
+    b = len(batch.h1)
+    if b == 0:
+        return None
+    bucket = _bucket(max(b, n_data))
+    bucket += (-bucket) % n_data
+    order, h1, h2, rank, flags = _sorted_padded(batch, bucket)
+    spec = NamedSharding(sdb.mesh, P("data"))
+    out = _sharded_match(
+        sdb.h1, sdb.table,
+        jax.device_put(h1, spec), jax.device_put(h2, spec),
+        jax.device_put(rank, spec), jax.device_put(flags, spec),
+        window=sdb.window, mesh=sdb.mesh,
     )
+    return ShardedPending(out=out, order=order, b=b,
+                          window=sdb.window, n_db=n_db)
 
 
 def match_batch_sharded(sdb: ShardedDB, batch: PackageBatch) -> np.ndarray:
-    """Sharded match -> int32[B, n_db * W] advisory ids (-1 = no hit).
-    The batch is padded up to a multiple of the "data" axis size."""
-    n_data = sdb.mesh.shape["data"]
-    b = len(batch.h1)
-    if b == 0:
-        return np.full((0, sdb.mesh.shape["db"] * sdb.window), -1, np.int32)
-    pad = (-b) % n_data
-    def padded(a, fill):
-        return np.concatenate([a, np.full(pad, fill, a.dtype)]) if pad else a
-    spec = NamedSharding(sdb.mesh, P("data"))
-    ph1 = jax.device_put(padded(batch.h1, np.uint32(0xFFFFFFFF)), spec)
-    ph2 = jax.device_put(padded(batch.h2, np.uint32(0xFFFFFFFF)), spec)
-    prank = jax.device_put(padded(batch.rank, np.int32(0)), spec)
-    pflags = jax.device_put(padded(batch.flags, np.int32(0)), spec)
-    out = _sharded_match(
-        sdb.h1, sdb.h2, sdb.lo, sdb.hi, sdb.flags, sdb.adv,
-        ph1, ph2, prank, pflags, window=sdb.window, mesh=sdb.mesh,
-    )
-    out = np.asarray(out)  # [n_db, B+pad, W]
-    out = np.moveaxis(out, 0, 1).reshape(out.shape[1], -1)  # [B+pad, n_db*W]
-    return out[:b]
-
-
-def collect_candidates(hits: np.ndarray) -> list[list[tuple[int, bool]]]:
-    """[B, K] packed-id matrix -> per-package sorted unique
-    (advisory id, needs_rescreen) lists. An advisory hit by both an exact
-    and a flagged row keeps needs_rescreen=False (the exact hit decides).
-    Vectorized: one nonzero scan over the whole matrix."""
-    rows, cols = np.nonzero(hits >= 0)
-    out: list[list[tuple[int, bool]]] = [[] for _ in range(hits.shape[0])]
-    if len(rows) == 0:
-        return out
-    packed = hits[rows, cols]
-    ids = packed & (RESCREEN_BIT - 1)
-    resc = (packed & RESCREEN_BIT) != 0
-    # sort by (row, id, rescreen) so the exact (False) occurrence of an id
-    # comes first and wins the dedupe
-    order = np.lexsort((resc, ids, rows))
-    rows, ids, resc = rows[order], ids[order], resc[order]
-    prev_r, prev_i = -1, -1
-    for r, i, s in zip(rows.tolist(), ids.tolist(), resc.tolist()):
-        if r == prev_r and i == prev_i:
-            continue
-        out[r].append((i, s))
-        prev_r, prev_i = r, i
-    return out
+    """Sharded match -> bool[n_db, B, ceil32(W)] per-shard hit masks in the
+    original query order. Global row index of bit (d, b, w) =
+    d*shard_base + local_searchsorted(shard_h1_d, h1[b]) + w."""
+    p = sharded_dispatch(sdb, batch)
+    if p is None:
+        return np.zeros(
+            (sdb.mesh.shape["db"], 0, _words(sdb.window) * 32), dtype=bool)
+    return p.collect()

@@ -1,29 +1,35 @@
-"""Batched bit-parallel secret matching on device (SURVEY §7 step 7,
-the TPU replacement for the reference's per-file regex loop,
+"""Batched secret screening on device (SURVEY §7 step 7, the TPU
+replacement for the reference's per-file regex loop,
 pkg/fanal/secret/scanner.go:377-463).
 
-Three-tier design, correct by construction:
+Design: the device is a *screen*, the host regex is the *verifier*. Every
+rule (and every rule keyword) compiles to an **anchor**: up to K=8
+consecutive byte-class predicates chosen as the least-likely window of the
+pattern (literal bytes are 1/256-density classes, so "ghp_", "AKIA",
+"xoxb-" anchors are essentially free of false hits). One kernel evaluates
+every anchor at every byte position of a [chunks, CHUNK] uint8 tensor:
 
-1. **Device NFA (Shift-And)** — most secret patterns are fixed-length
-   byte-class sequences once {m} repeats are unrolled (`ghp_[A-Za-z0-9]{36}`,
-   `AKIA[A-Z2-7]{16}`, ...). Those compile exactly to a bit-parallel
-   Shift-And automaton: state bitmask D advances per byte as
-   ``D = ((D << 1) | 1) & B[c]`` with multi-uint32 words for patterns up
-   to 192 states. One `lax.scan` over chunk bytes runs EVERY pattern on
-   EVERY file simultaneously ([chunks, patterns, words] uint32 state).
-2. **Candidate windows** — the kernel emits block-resolution hit bitmaps
-   (any match end inside each 128-byte block), not full positions: the
-   device->host transfer is [chunks, patterns, 128] bools per 16 KiB
-   chunk. The host runs the rule's real regex ONLY inside hit windows
-   (for capture groups / censoring spans), never over whole files.
-3. Patterns that don't compile to a bounded class sequence fall back to
-   the keyword tier (block windows when the regex has finite width, the
-   reference's whole-file scan only for unbounded patterns like PEM
-   private keys).
+  1. one tiny-table gather `table[byte] -> uint32[NW]` turns each byte
+     into a packed predicate-membership bitset (distinct classes across
+     the whole bank are deduplicated; NW words of 32)
+  2. an anchor hit at position i is the AND over j<K of predicate bit
+     (i+j) — K shifted elementwise ops, fully position-parallel
+     (VPU-friendly; no serial per-byte scan, no [P,256,W] gathers — the
+     round-3 Shift-And ran 10x slower than host regex on real TPU)
+  3. hits reduce to *chunk resolution* and pack to uint32 rule-bitmap
+     words: the device->host transfer is ~16 bytes per 16 KiB chunk
+     (0.1% of corpus volume — the device link may be a tunnel)
 
-False negatives are impossible: tier-1 automata accept exactly the rule
-language; windows are expanded by the pattern width so the verifying
-regex sees every candidate in full.
+The host then runs the real regex only inside hit chunks (expanded by the
+pattern width so straddling matches are seen in full), and reads keyword
+presence for the reference's keyword-prefilter semantics straight from
+the same bitmap — no host-side lowercasing pass at all (case variance is
+folded into the anchor classes).
+
+False negatives are impossible by construction: anchor classes are
+case-closed supersets, keywords are truncated (never extended), chunk
+overlap covers the anchor span, and any anchor that cannot be encoded
+(class-budget overflow) degrades to always-hit, never to never-hit.
 """
 
 from __future__ import annotations
@@ -36,10 +42,8 @@ import re._parser as sre_parse
 import numpy as np
 
 CHUNK = 16384
-BLOCK = 128
-NBLOCK = CHUNK // BLOCK
-MAX_STATES = 192  # 6 uint32 words
-WORD_BITS = 32
+K_ANCHOR = 8
+MAX_CLASS_WORDS = 4  # up to 128 distinct byte classes per bank
 
 
 # ----------------------------------------------------- class sequences
@@ -187,18 +191,21 @@ def _walk(items, flags: int) -> list[np.ndarray] | None:
     return seq
 
 
+MAX_SEQ = 512  # sanity cap for {m} unrolling
+
+
 def compile_class_sequence(pattern: str) -> list[np.ndarray] | None:
     """regex -> fixed-length class sequence (or None). The sequence
     accepts a SUPERSET of the regex language (equal except across
     same-length alternations, where per-position unions admit mixes),
-    so Shift-And hits are complete candidates for regex verification —
+    so anchor hits are complete candidates for regex verification —
     never a source of false negatives."""
     try:
         parsed = sre_parse.parse(pattern)
     except re.error:
         return None
     seq = _walk(list(parsed), parsed.state.flags)
-    if seq is None or not seq or len(seq) > MAX_STATES:
+    if seq is None or not seq or len(seq) > MAX_SEQ:
         return None
     return seq
 
@@ -286,90 +293,116 @@ def required_literal(pattern: str) -> bytes | None:
     return max(runs, key=len).lower()
 
 
-# ------------------------------------------------------------ the bank
+# ------------------------------------------------------------- anchors
 
 
-class NFABank:
-    """Stacked Shift-And tables for P patterns.
-
-    B: uint32[P, 256, W] — bit s of word w set iff state (w*32+s) of the
-    pattern accepts the byte. final: uint32[P, W] final-state bit."""
-
-    def __init__(self, sequences: list[list[np.ndarray]]):
-        self.lengths = [len(s) for s in sequences]
-        self.n = len(sequences)
-        max_len = max(self.lengths, default=1)
-        self.words = max(1, -(-max_len // WORD_BITS))
-        self.B = np.zeros((self.n, 256, self.words), dtype=np.uint32)
-        self.final = np.zeros((self.n, self.words), dtype=np.uint32)
-        for p, seq in enumerate(sequences):
-            for s, cls in enumerate(seq):
-                w, b = divmod(s, WORD_BITS)
-                self.B[p, cls, w] |= np.uint32(1 << b)
-            w, b = divmod(len(seq) - 1, WORD_BITS)
-            self.final[p, w] = np.uint32(1 << b)
-        self.max_len = max_len
+def choose_anchor(seq: list[np.ndarray]) -> tuple[int, list[np.ndarray]]:
+    """Pick the least-likely window of up to K_ANCHOR consecutive classes
+    (minimum product of class densities). -> (offset, classes)."""
+    k = min(K_ANCHOR, len(seq))
+    dens = [max(int(m.sum()), 1) for m in seq]
+    best_s, best_p = 0, float("inf")
+    for s in range(len(seq) - k + 1):
+        p = 1.0
+        for d in dens[s: s + k]:
+            p *= d / 256.0
+        if p < best_p:
+            best_p, best_s = p, s
+    return best_s, seq[best_s: best_s + k]
 
 
-@functools.lru_cache(maxsize=4)
-def _nfa_kernel(n_pat: int, words: int):
+def literal_anchor(lit: bytes) -> list[np.ndarray]:
+    """Case-closed singleton classes for (up to K_ANCHOR bytes of) a
+    literal byte run — matches the literal case-insensitively, a superset
+    of any case-sensitive occurrence."""
+    out = []
+    for b in lit[:K_ANCHOR]:
+        m = np.zeros(256, dtype=bool)
+        m[b] = True
+        out.append(_close_case(m))
+    return out
+
+
+class AnchorBank:
+    """Compiled anchor set: a byte->predicate-bitset table plus per-row
+    (word, bit, active) indices for up to K_ANCHOR positions.
+
+    Rows whose classes exceed the MAX_CLASS_WORDS budget become
+    *always-hit* (all positions inactive) — a pure perf degradation,
+    never a correctness one."""
+
+    def __init__(self, rows: list[list[np.ndarray]]):
+        self.n = len(rows)
+        self.rw = max(1, -(-self.n // 32))  # output words
+        cls_ids: dict[bytes, int] = {}
+        budget = MAX_CLASS_WORDS * 32
+        self.bit_word = np.zeros((self.n, K_ANCHOR), dtype=np.int32)
+        self.bit_idx = np.zeros((self.n, K_ANCHOR), dtype=np.uint32)
+        self.active = np.zeros((self.n, K_ANCHOR), dtype=bool)
+        self.overflow_rows: set[int] = set()
+        masks: list[np.ndarray] = []
+        for r, classes in enumerate(rows):
+            # stage this row's new classes; commit only if the whole row
+            # fits the budget (a rejected row must not burn slots)
+            new: dict[bytes, np.ndarray] = {}
+            ids: list[bytes] = []
+            for m in classes[:K_ANCHOR]:
+                key = np.packbits(m).tobytes()
+                if key not in cls_ids and key not in new:
+                    new[key] = m
+                ids.append(key)
+            if not ids or len(cls_ids) + len(new) > budget:
+                self.overflow_rows.add(r)
+                continue  # row stays always-hit
+            for key, m in new.items():
+                cls_ids[key] = len(cls_ids)
+                masks.append(m)
+            for j, key in enumerate(ids):
+                i = cls_ids[key]
+                self.bit_word[r, j] = i // 32
+                self.bit_idx[r, j] = i % 32
+                self.active[r, j] = True
+        self.words = max(1, -(-len(cls_ids) // 32))
+        self.table = np.zeros((256, self.words), dtype=np.uint32)
+        for i, m in enumerate(masks):
+            self.table[m, i // 32] |= np.uint32(1 << (i % 32))
+
+    @property
+    def overflowed(self) -> int:
+        return len(self.overflow_rows)
+
+
+@functools.lru_cache(maxsize=8)
+def _anchor_kernel(n_rules: int, n_words: int, rw: int):
     import jax
     import jax.numpy as jnp
-    from jax import lax
 
     @jax.jit
-    def run(chunks, B, final):
-        """chunks: uint8[C, CHUNK]; B: uint32[P,256,W]; final: uint32[P,W]
-        -> bool[C, P, NBLOCK] any-match-end per 128-byte block."""
-        C = chunks.shape[0]
-        blocks = chunks.reshape(C, NBLOCK, BLOCK)
+    def run(chunks, table, bit_word, bit_idx, active):
+        """chunks: uint8[C, CHUNK]; -> uint32[C, rw] packed per-chunk
+        rule-hit bitmap."""
+        pred = table[chunks.astype(jnp.int32)]  # [C, CHUNK, NW]
+        pred = jnp.pad(pred, ((0, 0), (0, K_ANCHOR - 1), (0, 0)))
 
-        def outer(D, block_bytes):
-            # block_bytes: [C, BLOCK]
-            hit = jnp.zeros((C, n_pat), dtype=bool)
-            for j in range(BLOCK):
-                c = block_bytes[:, j]  # [C]
-                Bc = jnp.transpose(B[:, c, :], (1, 0, 2))  # [C, P, W]
-                # multi-word shift-left-1 with carry, then inject bit 0
-                carry = jnp.concatenate(
-                    [jnp.zeros_like(D[..., :1]), D[..., :-1] >> 31], axis=-1)
-                D = ((D << 1) | carry).at[..., 0].set(
-                    (D[..., 0] << 1) | (carry[..., 0] | 1))
-                D = D & Bc
-                hit = hit | ((D & final[None]) != 0).any(axis=-1)
-            return D, hit
+        def one_rule(params):
+            bw, bi, act = params  # [K], [K], [K]
+            acc = jnp.ones((chunks.shape[0], CHUNK), dtype=bool)
+            for j in range(K_ANCHOR):
+                pj = pred[:, j: j + CHUNK, :]
+                ok = jnp.zeros_like(acc)
+                for w in range(n_words):
+                    bits = ((pj[:, :, w] >> bi[j]) & 1) != 0
+                    ok = jnp.where(bw[j] == w, bits, ok)
+                acc = acc & (ok | ~act[j])
+            return acc.any(axis=1)  # [C]
 
-        D0 = jnp.zeros((C, n_pat, words), dtype=jnp.uint32)
-        _, hits = lax.scan(outer, D0, jnp.swapaxes(blocks, 0, 1))
-        return jnp.transpose(hits, (1, 2, 0))  # [C, P, NBLOCK]
-
-    return run
-
-
-@functools.lru_cache(maxsize=4)
-def _kw_block_kernel(n_kw: int, max_len: int):
-    """Keyword matcher at block resolution: like the prefilter kernel
-    but emitting [C, K, NBLOCK] (block of the keyword START)."""
-    import jax
-    import jax.numpy as jnp
-
-    @jax.jit
-    def run(chunks, kw, kw_len):
-        c = jnp.pad(chunks, ((0, 0), (0, max_len - 1)))
-        w = CHUNK
-
-        def match_one(k_row, k_len):
-            acc = jnp.ones((c.shape[0], w), dtype=bool)
-            for j in range(max_len):
-                eq = c[:, j: j + w] == k_row[j]
-                active = j < k_len
-                acc = acc & jnp.where(active, eq, True)
-            return acc.reshape(acc.shape[0], NBLOCK, BLOCK).any(axis=2)
-
-        hits = jax.vmap(match_one, in_axes=(0, 0), out_axes=1)(
-            kw[:, :max_len], kw_len
-        )  # [C, K, NBLOCK]
-        return hits
+        hits = jax.lax.map(one_rule, (bit_word, bit_idx, active))  # [R, C]
+        hit = hits.T  # [C, R]
+        pad_r = rw * 32 - n_rules
+        hb = jnp.pad(hit, ((0, 0), (0, pad_r)))
+        hb = hb.reshape(hit.shape[0], rw, 32).astype(jnp.uint32)
+        weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+        return jnp.sum(hb * weights[None, None, :], axis=-1)
 
     return run
 
@@ -377,7 +410,7 @@ def _kw_block_kernel(n_kw: int, max_len: int):
 # ------------------------------------------------------------ chunking
 
 
-def chunk_files(contents: list[bytes], overlap: int,
+def chunk_files(contents: list[bytes], overlap: int = K_ANCHOR - 1,
                 lower: bool = False):
     """-> (chunks uint8[N, CHUNK], owners int[N], starts int[N]).
     starts[i] is the file offset of chunk i's first byte."""
@@ -407,79 +440,47 @@ def chunk_files(contents: list[bytes], overlap: int,
     return np.stack(arrs), np.array(owners), np.array(starts)
 
 
-class DeviceSecretMatcher:
-    """Runs tier-1 NFA patterns and tier-2 keyword blocks on device,
-    returning per-file candidate windows (byte ranges)."""
+class AnchorMatcher:
+    """Runs the anchor bank over a file batch and maps chunk-level hits
+    back to per-file windows / presence bits."""
 
-    def __init__(self, nfa_bank: NFABank | None, kw_bank=None,
-                 batch_chunks: int = 512):
-        self.nfa = nfa_bank
-        self.kw = kw_bank
+    def __init__(self, bank: AnchorBank, batch_chunks: int = 512):
+        self.bank = bank
         self.batch_chunks = batch_chunks
 
-    def nfa_windows(self, contents: list[bytes]) -> list[dict[int, list]]:
-        """-> per file: {pattern_idx: [(start, end), ...]} candidate
-        byte windows (already expanded by pattern length)."""
-        out: list[dict[int, list]] = [dict() for _ in contents]
-        if self.nfa is None or self.nfa.n == 0:
-            return out
+    def chunk_hits(self, contents: list[bytes]):
+        """-> (hits bool[n_chunks, n_rows], owners, starts). Device
+        dispatches are pipelined (async) and synced once at the end."""
         import jax.numpy as jnp
 
-        run = _nfa_kernel(self.nfa.n, self.nfa.words)
-        B = jnp.asarray(self.nfa.B)
-        final = jnp.asarray(self.nfa.final)
-        chunks, owners, starts = chunk_files(
-            contents, overlap=self.nfa.max_len - 1)
-        lens = np.array(self.nfa.lengths)
+        bank = self.bank
+        chunks, owners, starts = chunk_files(contents)
+        run = _anchor_kernel(bank.n, bank.words, bank.rw)
+        table = jnp.asarray(bank.table)
+        bw = jnp.asarray(bank.bit_word)
+        bi = jnp.asarray(bank.bit_idx)
+        act = jnp.asarray(bank.active)
+        outs = []
         for s0 in range(0, len(chunks), self.batch_chunks):
             batch = chunks[s0: s0 + self.batch_chunks]
-            hits = np.asarray(run(jnp.asarray(batch), B, final))
-            ci, pi, bi = np.nonzero(hits)
-            for c, p, b in zip(ci.tolist(), pi.tolist(), bi.tolist()):
-                fi = int(owners[s0 + c])
-                base = int(starts[s0 + c])
-                L = int(lens[p])
-                lo = max(base + b * BLOCK - L + 1, 0)
-                hi = min(base + (b + 1) * BLOCK, len(contents[fi]))
-                out[fi].setdefault(p, []).append((lo, hi))
-        for d in out:
-            for p in d:
-                d[p] = _merge_windows(d[p])
-        return out
-
-    def keyword_windows(self, contents: list[bytes], pad: list[int]
-                        ) -> list[dict[int, list]]:
-        """pad[k]: bytes to expand around a hit block of keyword k
-        (the max regex width of the rules using it).
-        -> per file: {keyword_idx: [(start, end), ...]}"""
-        out: list[dict[int, list]] = [dict() for _ in contents]
-        if self.kw is None or not self.kw.keywords:
-            return out
-        import jax.numpy as jnp
-
-        run = _kw_block_kernel(len(self.kw.keywords), self.kw.max_len)
-        kw_dev = jnp.asarray(self.kw.kw)
-        kwlen_dev = jnp.asarray(self.kw.kw_len)
-        chunks, owners, starts = chunk_files(
-            contents, overlap=self.kw.max_len - 1, lower=True)
-        for s0 in range(0, len(chunks), self.batch_chunks):
-            batch = chunks[s0: s0 + self.batch_chunks]
-            hits = np.asarray(run(jnp.asarray(batch), kw_dev, kwlen_dev))
-            ci, ki, bi = np.nonzero(hits)
-            for c, k, b in zip(ci.tolist(), ki.tolist(), bi.tolist()):
-                fi = int(owners[s0 + c])
-                base = int(starts[s0 + c])
-                w = pad[k]
-                lo = max(base + b * BLOCK - w, 0)
-                hi = min(base + (b + 1) * BLOCK + w, len(contents[fi]))
-                out[fi].setdefault(k, []).append((lo, hi))
-        for d in out:
-            for k in d:
-                d[k] = _merge_windows(d[k])
-        return out
+            real = len(batch)
+            if real < self.batch_chunks:
+                batch = np.concatenate([
+                    batch,
+                    np.zeros((self.batch_chunks - real, CHUNK), np.uint8)])
+            outs.append((run(jnp.asarray(batch), table, bw, bi, act), real))
+        if not outs:
+            return (np.zeros((0, bank.n), dtype=bool), owners, starts)
+        words = np.concatenate(
+            [np.asarray(o)[:real] for o, real in outs])  # [NC, rw]
+        bits = np.unpackbits(
+            np.ascontiguousarray(words).view(np.uint8).reshape(
+                words.shape[0], -1),
+            axis=1, bitorder="little")[:, : bank.n]
+        return bits.astype(bool), owners, starts
 
 
-def _merge_windows(wins: list[tuple[int, int]]) -> list[tuple[int, int]]:
+def merge_windows(wins: list[tuple[int, int]]) -> list[tuple[int, int]]:
     wins.sort()
     out = []
     for lo, hi in wins:

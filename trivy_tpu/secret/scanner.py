@@ -144,84 +144,86 @@ class SecretScanner:
     def _ensure_tiers(self) -> None:
         """Partition rules into device tiers (SURVEY §7 step 7):
 
-        - nfa: regex compiles EXACTLY to a fixed-length class sequence
-          -> device Shift-And automaton; host regex only inside candidate
-          windows (for groups/censoring)
-        - window: a required literal factor exists and the regex has
-          bounded width -> device literal scan at block resolution,
-          host regex inside windows
+        - seq: regex compiles EXACTLY to a fixed-length class sequence
+          -> the least-likely K consecutive classes become the device
+          anchor; host regex only inside hit-chunk windows
+        - lit: a required literal factor exists and the regex has bounded
+          width -> the literal becomes a case-closed anchor
         - file: keyword-prefiltered whole-file host regex (unbounded
           patterns, e.g. PEM blocks)
         - always: keyword-less whole-file host regex
-        """
+
+        Every rule keyword also becomes an anchor row, so the reference's
+        keyword-prefilter semantics (scanner.go:174-186) read straight
+        off the same device bitmap — no host lowercasing pass."""
         if self._tiers is not None:
             return
         from trivy_tpu.ops.secret_nfa import (
-            NFABank,
+            AnchorBank,
+            choose_anchor,
             compile_class_sequence,
             has_anchor,
+            literal_anchor,
             regex_width,
             required_literal,
         )
-        from trivy_tpu.ops.secret_prefilter import KeywordBank
 
-        nfa_rules: list[CompiledRule] = []
-        nfa_seqs = []
-        window_rules: list[tuple[CompiledRule, int]] = []  # (rule, lit idx)
+        # (rule, window pad before chunk, pad after chunk, tier kind)
+        anchor_rules: list[tuple[CompiledRule, int, int, str]] = []
+        rows: list[list[np.ndarray]] = []
         file_rules: list[CompiledRule] = []
         always_rules: list[CompiledRule] = []
-        lits: list[bytes] = []
-        lit_idx: dict[bytes, int] = {}
-        lit_pad: list[int] = []
         for cr in self.rules:
             pattern = cr.rule.regex
             seq = compile_class_sequence(pattern)
             if seq is not None:
-                nfa_rules.append(cr)
-                nfa_seqs.append(seq)
+                off, classes = choose_anchor(seq)
+                rows.append(classes)
+                anchor_rules.append((cr, off, len(seq) - off, "seq"))
                 continue
             width = regex_width(pattern)
             lit = required_literal(pattern)
             if (lit is not None and width is not None
                     and width[1] < self.MAX_WINDOW_WIDTH
                     and not has_anchor(pattern)):
-                i = lit_idx.get(lit)
-                if i is None:
-                    i = len(lits)
-                    lit_idx[lit] = i
-                    lits.append(lit)
-                    lit_pad.append(0)
-                lit_pad[i] = max(lit_pad[i], width[1])
-                window_rules.append((cr, i))
+                rows.append(literal_anchor(lit))
+                anchor_rules.append((cr, width[1], width[1], "lit"))
                 continue
             (file_rules if cr.keywords else always_rules).append(cr)
+
+        # keyword rows (deduped across rules) appended after rule anchors
+        kw_ids: dict[bytes, int] = {}
+        for cr in self.rules:
+            for k in cr.keywords:
+                if k not in kw_ids:
+                    kw_ids[k] = len(anchor_rules) + len(kw_ids)
+                    rows.append(literal_anchor(k))
+
+        bank = AnchorBank(rows) if rows else None
+        # keywords whose device bit is EXACT (not a truncated/overflowed
+        # superset): a set bit alone proves presence; others need a host
+        # substring confirm to preserve reference prefilter semantics
+        from trivy_tpu.ops.secret_nfa import K_ANCHOR
+
+        kw_exact = {
+            k: len(k) <= K_ANCHOR
+            and (bank is None or i not in bank.overflow_rows)
+            for k, i in kw_ids.items()
+        }
         self._tiers = {
-            "nfa_rules": nfa_rules,
-            "nfa_bank": NFABank(nfa_seqs) if nfa_seqs else None,
-            "window_rules": window_rules,
-            "lit_bank": KeywordBank(lits) if lits else None,
-            "lit_pad": lit_pad,
+            "bank": bank,
+            "anchor_rules": anchor_rules,
+            "kw_ids": kw_ids,
+            "kw_exact": kw_exact,
             "file_rules": file_rules,
             "always_rules": always_rules,
         }
-        # any-hit prefilter bank over the file-tier rules' keywords
-        kw: list[bytes] = []
-        kw_rules: list[list[CompiledRule]] = []
-        seen: dict[bytes, int] = {}
-        for cr in file_rules:
-            for k in cr.keywords:
-                if k in seen:
-                    kw_rules[seen[k]].append(cr)
-                else:
-                    seen[k] = len(kw)
-                    kw.append(k)
-                    kw_rules.append([cr])
-        self._tiers["kw_bank"] = KeywordBank(kw) if kw else None
-        self._tiers["kw_rules"] = kw_rules
         _log.debug(
             "secret rule tiers",
-            nfa=len(nfa_rules), window=len(window_rules),
-            file=len(file_rules), always=len(always_rules))
+            seq=sum(1 for a in anchor_rules if a[3] == "seq"),
+            lit=sum(1 for a in anchor_rules if a[3] == "lit"),
+            file=len(file_rules), always=len(always_rules),
+            keywords=len(kw_ids))
 
     def scan_files(self, batch: list[tuple[str, bytes]],
                    use_device: bool = True) -> list[Secret]:
@@ -254,70 +256,77 @@ class SecretScanner:
         return out
 
     def _scan_files_device(self, eligible) -> list[Secret]:
-        from trivy_tpu.ops.secret_nfa import DeviceSecretMatcher
-        from trivy_tpu.ops.secret_prefilter import DevicePrefilter
+        from trivy_tpu.ops.secret_nfa import (
+            CHUNK, AnchorMatcher, merge_windows,
+        )
 
         t = self._tiers
         contents = [c for (_i, _p, c) in eligible]
-        matcher = DeviceSecretMatcher(t["nfa_bank"], t["lit_bank"])
-        nfa_wins = matcher.nfa_windows(contents)
-        lit_wins = matcher.keyword_windows(contents, t["lit_pad"]) \
-            if t["lit_bank"] is not None else [dict() for _ in contents]
-        if t["kw_bank"] is not None:
-            kw_hits = DevicePrefilter(t["kw_bank"]).keyword_hits(contents)
-        else:
-            kw_hits = np.zeros((len(contents), 0), dtype=bool)
+        anchor_rules = t["anchor_rules"]
+        n_a = len(anchor_rules)
+        kw_ids = t["kw_ids"]
+        nf = len(contents)
+        windows: list[dict[int, list]] = [dict() for _ in range(nf)]
+        kw_present_f = np.zeros((nf, len(kw_ids)), dtype=bool)
+        if t["bank"] is not None:
+            hits, owners, starts = AnchorMatcher(t["bank"]).chunk_hits(
+                contents)
+            ci, ri = np.nonzero(hits)
+            for c, r in zip(ci.tolist(), ri.tolist()):
+                fi = int(owners[c])
+                if r < n_a:
+                    cr, pad_lo, pad_hi, _kind = anchor_rules[r]
+                    base = int(starts[c])
+                    lo = max(base - pad_lo, 0)
+                    hi = min(base + CHUNK + pad_hi, len(contents[fi]))
+                    windows[fi].setdefault(r, []).append((lo, hi))
+                else:
+                    kw_present_f[fi, r - n_a] = True
 
+        kw_exact = t["kw_exact"]
         out = []
         for fi, (_orig, path, content) in enumerate(eligible):
-            low = None
             findings: list[SecretFinding] = []
             spans: set[tuple[str, int, int]] = set()
+            low = None
 
             def kw_present(cr) -> bool:
-                # reference semantics: a rule with keywords only runs
-                # when one occurs in the file (scanner.go:174-186)
+                # reference semantics: a rule with keywords only runs when
+                # one occurs in the file (scanner.go:174-186). The device
+                # bitmap is exact for short keywords; truncated/overflowed
+                # ones are a superset, so a set bit for those is confirmed
+                # with the host substring check (only then is the file
+                # lowercased — absent bits need no host work at all)
                 nonlocal low
                 if not cr.keywords:
                     return True
-                if low is None:
-                    low = content.lower()
-                return any(k in low for k in cr.keywords)
-
-            # tier 1: device NFA candidates
-            for p, wins in nfa_wins[fi].items():
-                cr = t["nfa_rules"][p]
-                if cr.path_rx is not None and not cr.path_rx.match(path):
-                    continue
-                if not kw_present(cr):
-                    continue
-                self._verify_windows(cr, path, content, wins,
-                                     findings, spans)
-            # tier 2: literal-anchored windows
-            done_rules = set()
-            for cr, li in t["window_rules"]:
-                wins = lit_wins[fi].get(li)
-                if not wins or id(cr) in done_rules:
-                    continue
-                done_rules.add(id(cr))
-                if cr.path_rx is not None and not cr.path_rx.match(path):
-                    continue
-                if not kw_present(cr):
-                    continue
-                self._verify_windows(cr, path, content, wins,
-                                     findings, spans)
-            # tier 3: keyword-prefiltered whole-file rules
-            hit_row = kw_hits[fi]
-            seen_ids = set()
-            for ki in np.nonzero(hit_row)[0]:
-                for cr in t["kw_rules"][ki]:
-                    if id(cr) in seen_ids:
+                for k in cr.keywords:
+                    if not kw_present_f[fi, kw_ids[k] - n_a]:
                         continue
-                    seen_ids.add(id(cr))
-                    self._verify_windows(cr, path, content,
-                                         [(0, len(content))],
-                                         findings, spans)
-            # tier 4: keyword-less whole-file rules
+                    if kw_exact[k]:
+                        return True
+                    if low is None:
+                        low = content.lower()
+                    if k in low:
+                        return True
+                return False
+
+            # anchored rules: host regex inside hit-chunk windows
+            for r, wins in sorted(windows[fi].items()):
+                cr = anchor_rules[r][0]
+                if cr.path_rx is not None and not cr.path_rx.match(path):
+                    continue
+                if not kw_present(cr):
+                    continue
+                self._verify_windows(cr, path, content,
+                                     merge_windows(wins), findings, spans)
+            # keyword-prefiltered whole-file rules
+            for cr in t["file_rules"]:
+                if not kw_present(cr):
+                    continue
+                self._verify_windows(cr, path, content,
+                                     [(0, len(content))], findings, spans)
+            # keyword-less whole-file rules
             for cr in t["always_rules"]:
                 self._verify_windows(cr, path, content,
                                      [(0, len(content))], findings, spans)

@@ -38,6 +38,7 @@ from trivy_tpu.versioning.base import KEY_BYTES, ParseError
 
 FLAG_NEEDS_HOST = 1
 FLAG_RESCREEN = 2  # exact rank, but match semantics exceed pure intervals
+FLAG_PRE_ONLY = 4  # row only matches queries flagged pre-release
 
 INT32_MAX = np.int32(2**31 - 1)
 
@@ -197,25 +198,28 @@ class CompiledDB:
 
 def _advisory_intervals(
     adv: Advisory, scheme_name: str, eco: str | None
-) -> tuple[list[tuple], int] | None:
-    """-> ([(lo_str|None, lo_incl, hi_str|None, hi_incl)], extra_flags)
-    or None for needs-host (unparseable / always-candidate).
+) -> list[tuple] | None:
+    """-> [(lo_str|None, lo_incl, hi_str|None, hi_incl, flags)] or None for
+    needs-host (unparseable / always-candidate).
 
-    extra_flags carries FLAG_RESCREEN when the intervals are a superset of
-    the exact check rather than equal to it: under the npm pre-release rule
-    a secure range may not "cover" a pre-release version even though it
-    covers the point on the total order, so subtracting it would UNDERshoot
-    — instead the unsubtracted vulnerable intervals are emitted and every
-    hit is host-rescreened."""
+    npm with secure ranges emits TWO row sets: the subtracted intervals
+    (exact for non-pre-release query versions — the npm pre-release rule
+    only ever *removes* matches, and removes none for a non-pre-release
+    version), plus the unsubtracted vulnerable intervals gated with
+    FLAG_PRE_ONLY | FLAG_RESCREEN. A pre-release query (which the encoder
+    flags FLAG_RESCREEN) may be truly vulnerable at a point the order-level
+    subtraction removed — a secure range can cover the point on the total
+    order without "covering" the pre-release per the npm rule — so those
+    queries match against the unsubtracted superset and every such hit is
+    host-rescreened with the exact comparators."""
     scheme = versioning.get_scheme(scheme_name)
     if adv.is_range_style:
         # empty string in vulnerable/patched => always vulnerable
         # (reference compare.go:23-27)
         for v in list(adv.vulnerable_versions) + list(adv.patched_versions):
             if v == "":
-                return [(None, True, None, True)], 0
+                return [(None, True, None, True, 0)]
         npm_mode = scheme.name == "npm"
-        extra = 0
         try:
             if adv.vulnerable_versions:
                 vuln = Constraints(
@@ -224,24 +228,28 @@ def _advisory_intervals(
             else:
                 vuln = [versioning.Interval()]
             secure_exprs = list(adv.patched_versions) + list(adv.unaffected_versions)
+            pre_rows: list = []
             if secure_exprs:
                 if npm_mode:
-                    extra = FLAG_RESCREEN  # see docstring
-                else:
-                    secure = Constraints(
-                        scheme, " || ".join(secure_exprs), npm_mode
-                    ).intervals()
-                    vuln = _subtract(vuln, secure, scheme)
+                    pre_rows = [
+                        (_vs(iv.lo), iv.lo_incl, _vs(iv.hi), iv.hi_incl,
+                         FLAG_PRE_ONLY | FLAG_RESCREEN)
+                        for iv in vuln
+                    ]
+                secure = Constraints(
+                    scheme, " || ".join(secure_exprs), npm_mode
+                ).intervals()
+                vuln = _subtract(vuln, secure, scheme)
         except ParseError:
             return None
-        return (
-            [(_vs(iv.lo), iv.lo_incl, _vs(iv.hi), iv.hi_incl) for iv in vuln],
-            extra,
-        )
+        return [
+            (_vs(iv.lo), iv.lo_incl, _vs(iv.hi), iv.hi_incl, 0)
+            for iv in vuln
+        ] + pre_rows
     # OS style: [affected, fixed) — no fixed version => unbounded above
     lo = adv.affected_version or None
     hi = adv.fixed_version or None
-    return [(lo, True, hi, False)], 0
+    return [(lo, True, hi, False, 0)]
 
 
 def _vs(parsed) -> str | None:
@@ -317,9 +325,8 @@ def compile_db(db: AdvisoryDB, window: int | None = None) -> CompiledDB:
                     ))
                     n_host_rows += 1
                     continue
-                ivs, extra_flags = compiled
-                for lo_str, lo_incl, hi_str, hi_incl in ivs:
-                    flags = extra_flags
+                for lo_str, lo_incl, hi_str, hi_incl, iv_flags in compiled:
+                    flags = iv_flags
                     lo_key = hi_key = None
                     if lo_str is not None:
                         mk = key_memo.get((scheme_name, lo_str))

@@ -153,9 +153,15 @@ def synth_trivy_db(
 
 
 def synth_queries(db: AdvisoryDB, n_queries: int,
-                  seed: int = 7) -> list:
+                  seed: int = 7, hot_frac: float = 0.15,
+                  miss_frac: float = 0.1) -> list:
     """Draw queries against the synthetic DB: mix of hot names (the
-    whole point of the fallback path), tail names, and misses."""
+    whole point of the fallback path), tail names, and misses.
+
+    hot_frac=0.15 is the Zipf stress shape (every 7th package is a
+    "linux"-class name — far denser than a real scan); hot_frac~0.01 with
+    miss_frac~0.35 approximates a real registry crawl where most packages
+    have no or few advisories (~1-5 matches/query)."""
     from trivy_tpu.detector.engine import PkgQuery
     from trivy_tpu.tensorize.compile import space_of_bucket
 
@@ -173,9 +179,9 @@ def synth_queries(db: AdvisoryDB, n_queries: int,
     out = []
     for i in range(n_queries):
         r = rng.random()
-        if r < 0.15 and hot_pool:
+        if r < hot_frac and hot_pool:
             space, name, scheme = hot_pool[rng.randrange(len(hot_pool))]
-        elif r < 0.9 and pool:
+        elif r < 1.0 - miss_frac and pool:
             space, name, scheme = pool[rng.randrange(len(pool))]
         else:  # miss
             space, name, scheme = "debian 12", f"nosuch-{i}", "deb"
