@@ -155,8 +155,8 @@ def test_go_sum():
                b"golang.org/x/text v0.3.7/go.mod h1:xxx=\n")
     pkgs = golang.parse_go_sum(content)
     byname = {p.name: p.version for p in pkgs}
-    assert byname == {"github.com/pkg/errors": "0.9.1",
-                      "golang.org/x/text": "0.3.7"}
+    assert byname == {"github.com/pkg/errors": "v0.9.1",
+                      "golang.org/x/text": "v0.3.7"}
 
 
 def test_gomod_sum_supplement(tmp_path):
@@ -176,7 +176,7 @@ def test_gomod_sum_supplement(tmp_path):
     app = res.applications[0]
     byname = {p.name: p for p in app.packages}
     assert byname["golang.org/x/text"].indirect is True
-    assert byname["github.com/pkg/errors"].version == "0.9.1"
+    assert byname["github.com/pkg/errors"].version == "v0.9.1"
 
 
 def test_gomod_117_no_sum_supplement():

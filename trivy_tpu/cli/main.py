@@ -39,6 +39,9 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
                    help="comma-separated severities (UNKNOWN,LOW,MEDIUM,HIGH,CRITICAL)")
     p.add_argument("--scanners", default="vuln,secret",
                    help="comma-separated scanners (vuln,misconfig,secret,license)")
+    p.add_argument("--secret-config", default="trivy-secret.yaml",
+                   help="custom secret rule config path (reference "
+                        "--secret-config)")
     p.add_argument("--pkg-types", default="os,library",
                    help="comma-separated package types (os,library)")
     p.add_argument("--db-path", default=None,

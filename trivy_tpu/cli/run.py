@@ -309,6 +309,7 @@ def _select_scanner(args, cache):
             misconfig_only=(cmd == "config"),
             parallel=args.parallel,
             disabled_analyzers=disabled,
+            secret_config=getattr(args, "secret_config", None),
             file_patterns=getattr(args, "file_patterns", []),
         ), driver
     if cmd in ("repository", "repo"):
@@ -319,6 +320,7 @@ def _select_scanner(args, cache):
             skip_files=args.skip_files, skip_dirs=args.skip_dirs,
             parallel=args.parallel,
             disabled_analyzers=disabled,
+            secret_config=getattr(args, "secret_config", None),
             branch=getattr(args, "branch", ""),
             tag=getattr(args, "tag", ""),
             commit=getattr(args, "commit", ""),
@@ -337,6 +339,7 @@ def _select_scanner(args, cache):
             target, cache, from_tar=bool(getattr(args, "input", None)),
             parallel=args.parallel,
             disabled_analyzers=disabled,
+            secret_config=getattr(args, "secret_config", None),
             file_patterns=getattr(args, "file_patterns", []),
             image_sources=sources,
             insecure=getattr(args, "insecure", False),
@@ -350,6 +353,7 @@ def _select_scanner(args, cache):
             args.target, cache,
             parallel=args.parallel,
             disabled_analyzers=disabled,
+            secret_config=getattr(args, "secret_config", None),
             file_patterns=getattr(args, "file_patterns", []),
         ), driver
     raise FatalError(f"unsupported scan command {cmd!r}")

@@ -48,6 +48,12 @@ class AdvisoryDB:
     buckets: dict[str, dict[str, list[Advisory]]] = field(default_factory=dict)
     metadata_bucket: dict[str, VulnerabilityMeta] = field(default_factory=dict)
     meta: Metadata = field(default_factory=Metadata)
+    # Red Hat OVAL v2 CPE-indexed entries (trivy-db redhat-oval layout):
+    # redhat_entries: pkg -> [{"key": CVE/RHSA id, "entries": [...]}]
+    # redhat_cpe: {"repository": {name: [idx]}, "nvr": {nvr: [idx]},
+    #              "cpe": {idx(str): cpe string}}
+    redhat_entries: dict[str, list[dict]] = field(default_factory=dict)
+    redhat_cpe: dict[str, dict] = field(default_factory=dict)
 
     # ------------------------------------------------------------ write
 
@@ -56,6 +62,18 @@ class AdvisoryDB:
 
     def put_meta(self, meta: VulnerabilityMeta) -> None:
         self.metadata_bucket[meta.id] = meta
+
+    def put_redhat_entry(self, pkg_name: str, key: str,
+                         entries: list[dict]) -> None:
+        self.redhat_entries.setdefault(pkg_name, []).append(
+            {"key": key, "entries": entries})
+
+    def expand_redhat(self) -> None:
+        """Resolve CPE-indexed Red Hat entries into plain per-major
+        "redhat N" buckets (see trivy_tpu.detector.redhat)."""
+        from trivy_tpu.detector.redhat import expand_redhat_entries
+
+        expand_redhat_entries(self)
 
     # ------------------------------------------------------------ read
 
@@ -106,6 +124,10 @@ class AdvisoryDB:
                 vid: m.to_json() for vid, m in self.metadata_bucket.items()
             },
         }
+        if self.redhat_entries:
+            blob["redhat"] = self.redhat_entries
+        if self.redhat_cpe:
+            blob["redhat_cpe"] = self.redhat_cpe
         data = json.dumps(blob, separators=(",", ":")).encode()
         fname = os.path.join(path, "trivy_tpu.db.json")
         if compress:
@@ -135,6 +157,10 @@ class AdvisoryDB:
                     db.put_advisory(bucket, name, Advisory.from_json(a))
         for vid, m in blob.get("vulnerability", {}).items():
             db.put_meta(VulnerabilityMeta.from_json(vid, m))
+        db.redhat_entries = blob.get("redhat", {}) or {}
+        db.redhat_cpe = blob.get("redhat_cpe", {}) or {}
+        if db.redhat_entries:
+            db.expand_redhat()
         mpath = os.path.join(path, "metadata.json")
         if os.path.exists(mpath):
             with open(mpath) as f:

@@ -21,15 +21,15 @@ LANG_ECOSYSTEM: dict[str, str] = {
     "rustbinary": "cargo", "cargo": "cargo",
     "composer": "composer", "composer-vendor": "composer",
     "gobinary": "go", "gomod": "go",
-    "jar": "maven", "pom": "maven", "gradle-lockfile": "maven",
-    "sbt-lockfile": "maven",
+    "jar": "maven", "pom": "maven", "gradle": "maven",
+    "sbt": "maven",
     "npm": "npm", "yarn": "npm", "pnpm": "npm", "bun": "npm",
     "node-pkg": "npm", "javascript": "npm",
     "nuget": "nuget", "dotnet-core": "nuget", "packages-props": "nuget",
     "pipenv": "pip", "poetry": "pip", "pip": "pip", "python-pkg": "pip",
     "uv": "pip",
     "pub": "pub",
-    "hex": "hex",
+    "hex": "erlang",  # reference driver.go: ftypes.Hex -> vulnerability.Erlang
     "conan": "conan",
     "swift": "swift",
     "cocoapods": "cocoapods",
@@ -107,11 +107,12 @@ def created_fixed_versions(adv: Advisory) -> str:
     """reference driver.go:145-166 createFixedVersions: prefer
     PatchedVersions; else derive from '<x' bounds in vulnerable ranges."""
     if adv.patched_versions:
-        return ", ".join(sorted(set(adv.patched_versions)))
+        # DB order preserved (reference joins PatchedVersions as stored)
+        return ", ".join(dict.fromkeys(adv.patched_versions))
     fixed = []
     for vv in adv.vulnerable_versions:
         for s in vv.split(","):
             s = s.strip()
             if s.startswith("<") and not s.startswith("<="):
                 fixed.append(s[1:].strip())
-    return ", ".join(sorted(set(fixed)))
+    return ", ".join(dict.fromkeys(fixed))

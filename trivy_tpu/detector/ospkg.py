@@ -163,6 +163,11 @@ def detect(
         else:
             os_ver = _minor(os_ver)
         space = f"{family} {os_ver}"
+    elif cfg.source_id == "redhat":
+        # centos resolves against the Red Hat data (reference redhat.go);
+        # the CPE-entry table expands into "redhat {major}" buckets at DB
+        # load (trivy_tpu.detector.redhat)
+        space = f"redhat {normalize_os_version(family, os_ver)}"
     else:
         space = bucket_for(family, os_ver)
 
@@ -172,22 +177,56 @@ def detect(
 
     queries = []
     q_pkgs = []
+    host_pairs: list[tuple[Package, list[Advisory]]] = []
     for pkg in pkgs:
-        if cfg.source_id == "redhat" and any(
-            pkg.release.endswith(s) for s in _REDHAT_EXCLUDED_SUFFIXES
-        ):
-            continue
-        name = pkg.src_name or pkg.name
-        version = pkg.full_src_version() or pkg.full_version()
+        if cfg.source_id == "redhat":
+            if any(pkg.release.endswith(s)
+                   for s in _REDHAT_EXCLUDED_SUFFIXES):
+                continue
+            # Red Hat OVAL v2 is keyed by BINARY package name with the
+            # modular stream prefixed (reference redhat.go:100,186-197)
+            name = _modular_name(pkg.name, pkg.modularity_label)
+            version = pkg.full_version()
+            if pkg.build_info is not None:
+                # build metadata (UBI) overrides the default content
+                # sets: resolve CPE entries host-side per package
+                # (reference redhat.go:102-110)
+                from trivy_tpu.detector import redhat as rh
+
+                nvr = f"{pkg.build_info.nvr}-{pkg.build_info.arch}"
+                host_pairs.append((pkg, rh.content_set_advisories(
+                    engine.db, name,
+                    pkg.build_info.content_sets, [nvr])))
+                continue
+        else:
+            name = pkg.src_name or pkg.name
+            version = pkg.full_src_version() or pkg.full_version()
         queries.append(PkgQuery(space, name, version, cfg.scheme))
         q_pkgs.append(pkg)
 
     results = engine.detect(queries)
+    pairs: list[tuple[Package, list[Advisory]]] = [
+        (pkg, [engine.cdb.advisories[i][2] for i in res.adv_indices])
+        for pkg, res in zip(q_pkgs, results)
+    ]
+    if host_pairs:
+        # build-metadata advisories bypass the device bucket, but still
+        # need the exact version check the kernel would have applied
+        from trivy_tpu.detector.exact import AdvisoryChecker
+
+        screened = []
+        for pkg, advs in host_pairs:
+            version = pkg.full_version()
+            kept = [
+                adv for adv in advs
+                if AdvisoryChecker(adv, cfg.scheme).check(version)
+            ]
+            screened.append((pkg, kept))
+        pairs.extend(screened)
     vulns: list[DetectedVulnerability] = []
-    for pkg, res in zip(q_pkgs, results):
+    for pkg, advisories in pairs:
         per_cve: dict[str, tuple[Advisory, int]] = {}
-        for idx in res.adv_indices:
-            _bucket, _name, adv = engine.cdb.advisories[idx]
+        for idx, adv in enumerate(advisories):
             # arch filter (reference redhat.go:131-137)
             if cfg.check_arches and adv.arches and pkg.arch != "noarch":
                 if pkg.arch not in adv.arches:
@@ -215,6 +254,18 @@ def detect(
             "updates are not provided",
         )
     return vulns, eosl
+
+
+def _modular_name(name: str, label: str) -> str:
+    """"nodejs:12:<build>:<ctx>" + "npm" -> "nodejs:12::npm" (reference
+    redhat.go:186-197 addModularNamespace: insert after the 2nd colon)."""
+    count = 0
+    for i, ch in enumerate(label):
+        if ch == ":":
+            count += 1
+            if count == 2:
+                return label[:i] + "::" + name
+    return name
 
 
 def _newer_fix(engine, scheme_name, a: Advisory, b: Advisory) -> bool:

@@ -163,8 +163,8 @@ TYPE_INDIVIDUAL_PKGS = {
 }
 TYPE_LOCKFILES = {
     "bundler", "npm", "yarn", "pnpm", "bun", "pip", "pipenv", "poetry", "uv",
-    "gomod", "cargo", "composer", "pom", "gradle-lockfile",
-    "sbt-lockfile", "nuget", "dotnet-core", "packages-props", "conan", "pub",
+    "gomod", "cargo", "composer", "pom", "gradle",
+    "sbt", "nuget", "dotnet-core", "packages-props", "conan", "pub",
     "hex", "swift", "cocoapods", "conda-environment", "julia", "sbt",
 }
 

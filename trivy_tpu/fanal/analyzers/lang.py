@@ -87,9 +87,9 @@ _lockfile("pom", ("pom.xml",), java_pom.parse_pom)
 _lockfile("cargo", ("Cargo.lock",), misc_lang.parse_cargo_lock)
 _lockfile("composer", ("composer.lock",), misc_lang.parse_composer_lock)
 _lockfile("bundler", ("Gemfile.lock",), misc_lang.parse_gemfile_lock)
-_lockfile("gradle-lockfile", ("gradle.lockfile",),
+_lockfile("gradle", ("gradle.lockfile",),
           misc_lang.parse_gradle_lockfile)
-_lockfile("sbt-lockfile", ("build.sbt.lock",), misc_lang.parse_sbt_lockfile)
+_lockfile("sbt", ("build.sbt.lock",), misc_lang.parse_sbt_lockfile)
 _lockfile("nuget", ("packages.lock.json",), misc_lang.parse_nuget_lock)
 _lockfile("pub", ("pubspec.lock",), misc_lang.parse_pubspec_lock)
 _lockfile("hex", ("mix.lock",), misc_lang.parse_mix_lock)

@@ -53,6 +53,12 @@ class SecretAnalyzer(PostAnalyzer):
     def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
         if os.path.basename(path) in _SKIP_FILES:
             return False
+        # never scan the secret config itself: only the root-level file
+        # named like the config (reference secret.go:175 compares
+        # base(configPath) to the walked relative path)
+        if self._config_path and \
+                os.path.basename(self._config_path) == path:
+            return False
         if any(s in path for s in _SKIP_DIRS):
             return False
         if self.scanner.skip_file(path):

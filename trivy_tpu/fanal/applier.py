@@ -129,6 +129,8 @@ def apply_layers(layers: list[BlobInfo]) -> ArtifactDetail:
     merged.licenses = kept_licenses
 
     for pkg in merged.packages:
+        if merged.build_info is not None:
+            pkg.build_info = merged.build_info
         if not pkg.layer.digest and not pkg.layer.diff_id:
             origin = _lookup_origin_pkg(pkg, layers)
             if origin is not None:

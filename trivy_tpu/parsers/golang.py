@@ -11,8 +11,10 @@ from trivy_tpu.types.artifact import Package
 
 
 def _mk(name: str, version: str, **kw) -> Package:
+    # go module versions keep their "v" prefix (reference
+    # pkg/dependency/parser/golang/mod reports "v2.7.1+incompatible")
     return Package(id=f"{name}@{version}", name=name,
-                   version=version.lstrip("v"), **kw)
+                   version=version, **kw)
 
 
 _REQ_BLOCK = re.compile(r"require\s*\(([^)]*)\)", re.S)

@@ -242,17 +242,23 @@ def parse_sbt_lockfile(content: bytes) -> list[Package]:
 
 
 def parse_deps_json(content: bytes) -> list[Package]:
+    """Reference pkg/dependency/parser/dotnet/core_deps: type=package
+    entries from "libraries", filtered to runtime libraries when the
+    runtimeTarget's target section is present (an entry that exists there
+    but has no runtime/runtimeTargets/native content is compile-only)."""
     doc = json.loads(content)
+    target_libs = (doc.get("targets") or {}).get(
+        ((doc.get("runtimeTarget") or {}).get("name")) or "")
     out = {}
-    runtime_targets = doc.get("targets") or {}
-    for _target, pkgs in runtime_targets.items():
-        for key, meta in (pkgs or {}).items():
-            if "/" not in key:
-                continue
-            name, version = key.split("/", 1)
-            if meta.get("type") not in (None, "package"):
-                continue
-            out.setdefault(f"{name}@{version}", _mk(name, version))
+    for key, meta in (doc.get("libraries") or {}).items():
+        if "/" not in key or str(meta.get("type", "")).lower() != "package":
+            continue
+        if target_libs is not None:
+            lib = target_libs.get(key)
+            if lib is not None and not lib:
+                continue  # present but empty: compile-only
+        name, version = key.split("/", 1)
+        out.setdefault(f"{name}@{version}", _mk(name, version))
     return sorted(out.values(), key=lambda p: p.id)
 
 
@@ -333,6 +339,8 @@ def parse_swift_resolved(content: bytes) -> list[Package]:
     for pin in pins:
         name = pin.get("location") or pin.get("repositoryURL") or pin.get("identity", "")
         name = name.removesuffix(".git")
+        # reference trims the URL scheme: "github.com/apple/swift-nio"
+        name = name.removeprefix("https://").removeprefix("http://")
         state = pin.get("state") or {}
         version = state.get("version") or ""
         if name and version:

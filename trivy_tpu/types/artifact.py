@@ -148,6 +148,9 @@ class Package(JSONMixin):
     indirect: bool = False
     relationship: str = ""  # "direct" | "indirect" | "root" | "workspace" | ""
     depends_on: list[str] = field(default_factory=list)
+    # Red Hat build metadata attached by the applier (reference attaches
+    # the owning layer's buildinfo per package; artifact-level here)
+    build_info: "BuildInfo | None" = None
     layer: Layer = field(default_factory=Layer)
     file_path: str = ""
     digest: str = ""

@@ -126,8 +126,8 @@ class LangType(str, enum.Enum):
     GO_MODULE = "gomod"
     JAR = "jar"
     POM = "pom"
-    GRADLE = "gradle-lockfile"
-    SBT = "sbt-lockfile"
+    GRADLE = "gradle"
+    SBT = "sbt"
     NPM = "npm"
     YARN = "yarn"
     PNPM = "pnpm"

@@ -115,8 +115,8 @@ def purl_for_package(kind: str, type_str: str, name: str, version: str,
         "python-pkg": "pypi", "pip": "pypi", "pipenv": "pypi",
         "poetry": "pypi", "uv": "pypi",
         "gemspec": "gem", "bundler": "gem",
-        "jar": "maven", "pom": "maven", "gradle-lockfile": "maven",
-        "sbt-lockfile": "maven",
+        "jar": "maven", "pom": "maven", "gradle": "maven",
+        "sbt": "maven",
         "gobinary": "golang", "gomod": "golang",
         "rustbinary": "cargo", "cargo": "cargo",
         "composer": "composer", "composer-vendor": "composer",

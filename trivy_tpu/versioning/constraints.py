@@ -97,6 +97,46 @@ class Comparator:
         return any(iv.contains(v, scheme) for iv in self.intervals)
 
 
+_BRACKET_RX = re.compile(r"[\[\(][^\[\]\(\)]*[\]\)]")
+
+
+def _maven_ranges(expr: str) -> str:
+    """Maven bracket ranges -> operator syntax: "[2.9.0,2.9.10.7)" becomes
+    ">=2.9.0, <2.9.10.7"; comma-separated bracket groups are a union
+    (reference maven comparer via go-mvn-version). OR-groups without
+    brackets pass through unchanged; a group mixing bracket and bare
+    syntax is an error, never silently truncated."""
+    out = []
+    for group in expr.split("||"):
+        g = group.strip()
+        if "[" not in g and "(" not in g:
+            out.append(g)
+            continue
+        brackets = _BRACKET_RX.findall(g)
+        rest = _BRACKET_RX.sub("", g).strip(" ,")
+        if rest or not brackets:
+            raise ParseError(f"mixed/unbalanced maven range {group!r}")
+        for b in brackets:
+            open_b, close_b = b[0], b[-1]
+            inner = b[1:-1].strip()
+            if "," not in inner:
+                if open_b == "[" and close_b == "]" and inner:
+                    out.append(f"={inner}")
+                else:
+                    raise ParseError(f"invalid maven range {b!r}")
+                continue
+            lo, hi = (s.strip() for s in inner.split(",", 1))
+            parts = []
+            if lo:
+                parts.append((">=" if open_b == "[" else ">") + lo)
+            if hi:
+                parts.append(("<=" if close_b == "]" else "<") + hi)
+            if not parts:
+                raise ParseError(f"unbounded maven range {b!r}")
+            out.append(", ".join(parts))
+    return " || ".join(out)
+
+
 class Constraints:
     """Parsed constraint: OR of AND-groups of comparators."""
 
@@ -104,6 +144,8 @@ class Constraints:
         self.scheme = scheme
         self.expr = expr
         self.npm_mode = npm_mode
+        if scheme.name == "maven":
+            expr = _maven_ranges(expr)
         self.groups: list[list[Comparator]] = []
         for group_expr in expr.split("||"):
             group_expr = group_expr.strip()
