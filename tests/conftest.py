@@ -25,3 +25,27 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# shared fake-redis server fixture (RESP2 subset) for cache/e2e tests
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def fake_redis():
+    import socketserver
+    import threading
+
+    from test_redis_cache import _FakeRedisHandler
+
+    _FakeRedisHandler.store = {}
+    _FakeRedisHandler.set_log = []
+    _FakeRedisHandler.auth = ""
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                          _FakeRedisHandler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"redis://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()

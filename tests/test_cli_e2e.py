@@ -1,0 +1,232 @@
+"""CLI end-to-end matrix (VERDICT r3 directive 5, reference
+integration/{standalone_tar_test,client_server_test}.go): every target
+kind through the real CLI in standalone mode, then the same scans in
+client/server mode — plain, token-authenticated, path-prefixed, and with
+a redis-backed server cache — asserting the client/server report equals
+the standalone report for the same target.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from test_fanal import (
+    APK_INSTALLED,
+    OS_RELEASE,
+    PACKAGE_LOCK,
+    REQUIREMENTS,
+    _fixture_db,
+    _mk_image_tar,
+    _mk_layer,
+    _scan,
+    env,  # noqa: F401  (fixture re-export)
+)
+
+
+@pytest.fixture()
+def image_tar(tmp_path):
+    layer1 = _mk_layer({
+        "etc/os-release": OS_RELEASE.encode(),
+        "lib/apk/db/installed": APK_INSTALLED.encode(),
+    })
+    layer2 = _mk_layer({"app/package-lock.json": PACKAGE_LOCK.encode()})
+    path = str(tmp_path / "e2e-image.tar")
+    _mk_image_tar(path, [layer1, layer2], repo_tag="e2e:latest")
+    return path
+
+
+@pytest.fixture()
+def fs_dir(tmp_path):
+    d = tmp_path / "proj"
+    d.mkdir()
+    (d / "package-lock.json").write_text(PACKAGE_LOCK)
+    (d / "requirements.txt").write_text(REQUIREMENTS)
+    return str(d)
+
+
+@pytest.fixture()
+def rootfs_dir(tmp_path):
+    d = tmp_path / "root"
+    (d / "etc").mkdir(parents=True)
+    (d / "lib/apk/db").mkdir(parents=True)
+    (d / "etc/os-release").write_text(OS_RELEASE)
+    (d / "lib/apk/db/installed").write_text(APK_INSTALLED)
+    return str(d)
+
+
+@pytest.fixture()
+def sbom_file(tmp_path):
+    doc = {
+        "bomFormat": "CycloneDX", "specVersion": "1.5", "version": 1,
+        "metadata": {"component": {"bom-ref": "root", "type": "container",
+                                   "name": "e2e-bom"}},
+        "components": [{
+            "bom-ref": "p1", "type": "library", "name": "lodash",
+            "version": "4.17.4", "purl": "pkg:npm/lodash@4.17.4",
+        }],
+    }
+    p = tmp_path / "bom.json"
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def _vulns(doc) -> set[tuple]:
+    return {
+        (r.get("Target", ""), r.get("Class", ""),
+         v["VulnerabilityID"], v.get("PkgName"),
+         v.get("InstalledVersion"), v.get("FixedVersion", ""),
+         v.get("Severity"))
+        for r in doc.get("Results") or []
+        for v in r.get("Vulnerabilities") or []
+    }
+
+
+def _standalone(env, capsys, kind, target, extra=()):  # noqa: F811
+    from trivy_tpu.cli import run as run_mod
+
+    run_mod._ENGINE_CACHE.clear()
+    args = [kind] + list(extra)
+    if kind == "image":
+        args += ["--input", target]
+    else:
+        args += [target]
+    args += ["--format", "json", "--db-path", str(env / "db"),
+             "--cache-dir", str(env / "cache"), "--quiet"]
+    rc, doc = _scan(args, capsys)
+    assert rc == 0
+    return doc
+
+
+# -------------------------------------------------------- standalone
+
+
+STANDALONE_CASES = [
+    ("image-tar", "image"),
+    ("fs", "fs"),
+    ("rootfs", "rootfs"),
+    ("sbom", "sbom"),
+]
+
+
+@pytest.mark.parametrize("case,kind", STANDALONE_CASES,
+                         ids=[c[0] for c in STANDALONE_CASES])
+def test_standalone_matrix(case, kind, env, image_tar, fs_dir, rootfs_dir,  # noqa: F811
+                           sbom_file, capsys):
+    target = {"image": image_tar, "fs": fs_dir, "rootfs": rootfs_dir,
+              "sbom": sbom_file}[kind]
+    doc = _standalone(env, capsys, kind, target)
+    assert doc["SchemaVersion"] == 2
+    vulns = _vulns(doc)
+    if kind == "rootfs":
+        # rootfs mode disables lockfile analyzers and reads the OS
+        # package DB instead (reference run.go:179-185)
+        assert any(v[2] == "CVE-2025-1000" for v in vulns), vulns
+    else:
+        assert any(v[2] == "CVE-2019-10744" for v in vulns), vulns
+    if kind == "image":
+        assert doc["Metadata"]["OS"]["Family"] == "alpine"
+        assert any(r["Class"] == "os-pkgs" for r in doc["Results"])
+
+
+# ------------------------------------------------------ client/server
+
+
+@pytest.fixture()
+def server_factory(env):  # noqa: F811
+    """Start an in-process scan server over the fixture DB; yields a
+    factory taking Server kwargs, cleans all servers up afterwards."""
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.rpc.server import Server
+
+    servers = []
+
+    def make(cache=None, **kw):
+        engine = MatchEngine(_fixture_db(), use_device=False)
+        srv = Server(engine, cache or MemoryCache(),
+                     host="localhost", port=0, **kw)
+        srv.start()
+        servers.append(srv)
+        return srv
+
+    yield make
+    for s in servers:
+        s.shutdown()
+
+
+def _client(env, capsys, kind, target, server_url, extra=()):  # noqa: F811
+    from trivy_tpu.cli import run as run_mod
+
+    run_mod._ENGINE_CACHE.clear()
+    args = [kind] + list(extra)
+    if kind == "image":
+        args += ["--input", target]
+    else:
+        args += [target]
+    args += ["--format", "json", "--server", server_url,
+             "--cache-dir", str(env / "ccache"), "--quiet"]
+    rc, doc = _scan(args, capsys)
+    assert rc == 0
+    return doc
+
+
+MODES = ["plain", "token", "prefix", "redis"]
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("kind", ["image", "fs"])
+def test_client_server_matrix(mode, kind, env, image_tar, fs_dir,  # noqa: F811
+                              server_factory, capsys, request):
+    target = image_tar if kind == "image" else fs_dir
+    extra: list[str] = []
+    if mode == "plain":
+        srv = server_factory()
+        url = srv.address
+    elif mode == "token":
+        srv = server_factory(token="sekrit-e2e")
+        url = srv.address
+        extra = ["--token", "sekrit-e2e"]
+    elif mode == "prefix":
+        srv = server_factory(path_prefix="/scan/api")
+        url = srv.address + "/scan/api"
+    else:  # redis-backed server cache
+        fake_redis = request.getfixturevalue("fake_redis")
+        from trivy_tpu.cache.redis import RedisCache
+
+        srv = server_factory(cache=RedisCache(fake_redis))
+        url = srv.address
+
+    remote = _client(env, capsys, kind, target, url, extra)
+    local = _standalone(env, capsys, kind, target)
+    assert _vulns(remote) == _vulns(local)
+    assert _vulns(remote), "scan found nothing"
+    # full result JSON parity modulo cache-key-derived fields
+    assert [r.get("Target") for r in remote["Results"]] == \
+        [r.get("Target") for r in local["Results"]]
+
+
+def test_client_server_bad_token_fails(env, fs_dir, server_factory,  # noqa: F811
+                                       capsys):
+    srv = server_factory(token="right")
+    from trivy_tpu.cli import run as run_mod
+    from trivy_tpu.cli.main import main
+
+    run_mod._ENGINE_CACHE.clear()
+    rc = main(["fs", fs_dir, "--format", "json",
+               "--server", srv.address, "--token", "wrong",
+               "--cache-dir", str(env / "xcache"), "--quiet"])
+    capsys.readouterr()
+    assert rc != 0
+
+
+def test_prefix_server_rejects_unprefixed(env, server_factory):  # noqa: F811
+    import urllib.error
+    import urllib.request
+
+    srv = server_factory(path_prefix="/scan/api")
+    with urllib.request.urlopen(srv.address + "/scan/api/healthz") as r:
+        assert r.read() == b"ok"
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(srv.address + "/healthz")

@@ -1,0 +1,248 @@
+"""Twirp wire-compatibility tests (VERDICT r3 directive 7): the proto3
+codec round-trips, cross-checks byte-for-byte against the real protobuf
+runtime built from dynamic descriptors (an independent implementation of
+the wire format), and a reference-style Twirp request — binary protobuf
+POSTed to /twirp/trivy.scanner.v1.Scanner/Scan — round-trips through the
+live server."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from trivy_tpu.rpc import twirp
+
+
+class TestCodec:
+    def test_scalar_roundtrip(self):
+        doc = {"family": "alpine", "name": "3.18", "eosl": True}
+        raw = twirp.encode_message("OS", doc)
+        assert twirp.decode_message("OS", raw) == doc
+
+    def test_nested_and_repeated(self):
+        doc = {
+            "target": "img", "artifact_id": "sha256:a",
+            "blob_ids": ["sha256:b", "sha256:c"],
+            "options": {"scanners": ["vuln"], "pkg_types": ["library"],
+                        "include_dev_deps": True},
+        }
+        raw = twirp.encode_message("ScanRequest", doc)
+        assert twirp.decode_message("ScanRequest", raw) == doc
+
+    def test_map_fields(self):
+        doc = {
+            "vulnerability_id": "CVE-1", "severity": 3,
+            "vendor_severity": {"nvd": 3, "redhat": 2},
+            "cvss": {"nvd": {"v3_score": 9.8, "v3_vector": "AV:N"}},
+        }
+        raw = twirp.encode_message("Vulnerability", doc)
+        got = twirp.decode_message("Vulnerability", raw)
+        assert got["vendor_severity"] == {"nvd": 3, "redhat": 2}
+        assert got["cvss"]["nvd"]["v3_score"] == 9.8
+
+    def test_negative_int32(self):
+        raw = twirp.encode_message("Location", {"start_line": -5})
+        assert twirp.decode_message("Location", raw)["start_line"] == -5
+
+    def test_unknown_fields_skipped(self):
+        # encode with a schema superset: field 99 must be skipped
+        raw = twirp.encode_message("OS", {"family": "debian"})
+        raw += twirp._enc_field(99, "string", "future")
+        assert twirp.decode_message("OS", raw) == {"family": "debian"}
+
+    def test_json_mapping(self):
+        doc = {"missing_artifact": True, "missing_blob_ids": ["sha256:x"]}
+        j = twirp.to_json_obj("MissingBlobsResponse", doc)
+        assert j == {"missingArtifact": True,
+                     "missingBlobIds": ["sha256:x"]}
+        assert twirp.from_json_obj("MissingBlobsResponse", j) == doc
+        # snake_case also accepted on input
+        assert twirp.from_json_obj(
+            "MissingBlobsResponse",
+            {"missing_artifact": True}) == {"missing_artifact": True}
+
+    def test_timestamp_json(self):
+        ts = twirp._ts_parse("2021-08-25T12:20:30Z")
+        assert twirp._ts_json(ts) == "2021-08-25T12:20:30Z"
+
+
+class TestAgainstProtobufRuntime:
+    """Build the same messages with google.protobuf dynamic descriptors
+    (an independent proto implementation) and compare bytes."""
+
+    @pytest.fixture(scope="class")
+    def factory(self):
+        pb = pytest.importorskip("google.protobuf")  # noqa: F841
+        from google.protobuf import (
+            descriptor_pb2,
+            descriptor_pool,
+            message_factory,
+        )
+
+        fdp = descriptor_pb2.FileDescriptorProto()
+        fdp.name = "x.proto"
+        fdp.package = "x"
+        fdp.syntax = "proto3"
+        os_m = fdp.message_type.add()
+        os_m.name = "OS"
+        for i, (n, t) in enumerate([
+            ("family", 9), ("name", 9), ("eosl", 8), ("extended", 8),
+        ], start=1):
+            f = os_m.field.add()
+            f.name, f.number, f.type = n, i, t
+            f.label = 1
+        req = fdp.message_type.add()
+        req.name = "ScanRequest"
+        for n, num, t, label, tn in [
+            ("target", 1, 9, 1, ""), ("artifact_id", 2, 9, 1, ""),
+            ("blob_ids", 3, 9, 3, ""), ("options", 4, 11, 1, ".x.OS"),
+        ]:
+            f = req.field.add()
+            f.name, f.number, f.type, f.label = n, num, t, label
+            if tn:
+                f.type_name = tn
+        pool = descriptor_pool.DescriptorPool()
+        pool.Add(fdp)
+        return {
+            "OS": message_factory.GetMessageClass(
+                pool.FindMessageTypeByName("x.OS")),
+            "ScanRequest": message_factory.GetMessageClass(
+                pool.FindMessageTypeByName("x.ScanRequest")),
+        }
+
+    def test_os_bytes_match(self, factory):
+        msg = factory["OS"](family="alpine", name="3.18", eosl=True)
+        ours = twirp.encode_message(
+            "OS", {"family": "alpine", "name": "3.18", "eosl": True})
+        assert ours == msg.SerializeToString()
+
+    def test_scan_request_decode_theirs(self, factory):
+        # ScanRequest with options typed as x.OS to reuse field 4's
+        # message wire shape
+        msg = factory["ScanRequest"](
+            target="alpine:3.18", artifact_id="sha256:a",
+            blob_ids=["sha256:b", "sha256:c"])
+        got = twirp.decode_message("ScanRequest", msg.SerializeToString())
+        assert got["target"] == "alpine:3.18"
+        assert got["artifact_id"] == "sha256:a"
+        assert got["blob_ids"] == ["sha256:b", "sha256:c"]
+        # and the reverse: our bytes parse in their runtime
+        theirs = factory["ScanRequest"]()
+        theirs.ParseFromString(twirp.encode_message("ScanRequest", {
+            "target": "alpine:3.18", "blob_ids": ["x", "y"]}))
+        assert theirs.target == "alpine:3.18"
+        assert list(theirs.blob_ids) == ["x", "y"]
+
+
+class TestTwirpServer:
+    @pytest.fixture()
+    def server(self):
+        from trivy_tpu.cache.cache import MemoryCache
+        from trivy_tpu.db import Advisory, AdvisoryDB
+        from trivy_tpu.db.model import VulnerabilityMeta
+        from trivy_tpu.detector.engine import MatchEngine
+        from trivy_tpu.rpc.server import Server
+
+        db = AdvisoryDB()
+        db.put_advisory("npm::ghsa", "lodash", Advisory(
+            vulnerability_id="CVE-2019-10744",
+            vulnerable_versions=["<4.17.12"],
+        ))
+        db.put_meta(VulnerabilityMeta.from_json("CVE-2019-10744", {
+            "Title": "prototype pollution", "Severity": "CRITICAL",
+        }))
+        srv = Server(MatchEngine(db, use_device=False), MemoryCache(),
+                     host="localhost", port=0)
+        srv.start()
+        yield srv
+        srv.shutdown()
+
+    def _post(self, url, body, ctype):
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": ctype}, method="POST")
+        with urllib.request.urlopen(req) as r:
+            return r.headers.get("Content-Type"), r.read()
+
+    def _blob_proto(self) -> dict:
+        return {
+            "schema_version": 2,
+            "applications": [{
+                "type": "npm", "file_path": "package-lock.json",
+                "packages": [{
+                    "id": "lodash@4.17.4", "name": "lodash",
+                    "version": "4.17.4",
+                    "identifier": {"purl": "pkg:npm/lodash@4.17.4"},
+                }],
+            }],
+        }
+
+    @pytest.mark.parametrize("ctype", [twirp.PROTO_CT, twirp.JSON_CT])
+    def test_scan_roundtrip(self, server, ctype):
+        base = server.address
+        # 1. push the blob through the Twirp cache service
+        if ctype == twirp.PROTO_CT:
+            body = twirp.encode_message("PutBlobRequest", {
+                "diff_id": "sha256:b", "blob_info": self._blob_proto()})
+        else:
+            body = json.dumps(twirp.to_json_obj("PutBlobRequest", {
+                "diff_id": "sha256:b",
+                "blob_info": self._blob_proto()})).encode()
+        self._post(base + "/twirp/trivy.cache.v1.Cache/PutBlob",
+                   body, ctype)
+        # 2. MissingBlobs now reports it present
+        if ctype == twirp.PROTO_CT:
+            body = twirp.encode_message("MissingBlobsRequest", {
+                "artifact_id": "sha256:a", "blob_ids": ["sha256:b"]})
+            ct, out = self._post(
+                base + "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+                body, ctype)
+            missing = twirp.decode_message("MissingBlobsResponse", out)
+        else:
+            body = json.dumps({"artifactId": "sha256:a",
+                               "blobIds": ["sha256:b"]}).encode()
+            ct, out = self._post(
+                base + "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+                body, ctype)
+            missing = twirp.from_json_obj("MissingBlobsResponse",
+                                          json.loads(out))
+        assert missing.get("missing_blob_ids") in (None, [])
+        # 3. Scan over the Twirp scanner service
+        scan_req = {
+            "target": "myapp", "artifact_id": "sha256:a",
+            "blob_ids": ["sha256:b"],
+            "options": {"scanners": ["vuln"]},
+        }
+        if ctype == twirp.PROTO_CT:
+            body = twirp.encode_message("ScanRequest", scan_req)
+            ct, out = self._post(
+                base + "/twirp/trivy.scanner.v1.Scanner/Scan", body, ctype)
+            assert ct.startswith(twirp.PROTO_CT)
+            resp = twirp.decode_message("ScanResponse", out)
+        else:
+            body = json.dumps(twirp.to_json_obj(
+                "ScanRequest", scan_req)).encode()
+            ct, out = self._post(
+                base + "/twirp/trivy.scanner.v1.Scanner/Scan", body, ctype)
+            assert ct.startswith(twirp.JSON_CT)
+            resp = twirp.from_json_obj("ScanResponse", json.loads(out))
+        results = resp.get("results") or []
+        assert len(results) == 1
+        vulns = results[0].get("vulnerabilities") or []
+        assert [v["vulnerability_id"] for v in vulns] == ["CVE-2019-10744"]
+        assert vulns[0]["installed_version"] == "4.17.4"
+        assert vulns[0]["severity"] == 4  # CRITICAL
+        assert results[0]["class"] == "lang-pkgs"
+
+    def test_bad_route_twirp_error(self, server):
+        import urllib.error
+
+        req = urllib.request.Request(
+            server.address + "/twirp/trivy.scanner.v1.Scanner/Nope",
+            data=b"", headers={"Content-Type": twirp.JSON_CT},
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        doc = json.loads(exc.value.read())
+        assert doc["code"] == "bad_route"

@@ -71,8 +71,18 @@ class ConfigAnalyzer(PostAnalyzer):
                     for d in misconf.failures + misconf.successes:
                         d.type = detection.HELM
                     res.misconfigurations.append(misconf)
+        # terraform evaluates per MODULE directory (variables, locals,
+        # child modules span files), not per file
+        from trivy_tpu.misconf.scanner import scan_terraform_modules
+
+        tf_paths = {p for p in files
+                    if p.endswith((".tf", ".tf.json")) and p not in in_chart}
+        if tf_paths:
+            res.misconfigurations.extend(scan_terraform_modules(
+                {p: files[p].read() for p in tf_paths}))
+
         for path, inp in sorted(files.items()):
-            if path in in_chart:
+            if path in in_chart or path in tf_paths:
                 continue
             misconf = scan_config(path, inp.read())
             if misconf is not None and (

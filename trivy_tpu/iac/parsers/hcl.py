@@ -41,6 +41,7 @@ class Block:
     blocks: list["Block"] = field(default_factory=list)
     start_line: int = 0
     end_line: int = 0
+    src_path: str = ""             # set by the terraform evaluator
 
     def get(self, name: str, default=None):
         a = self.attrs.get(name)
@@ -65,7 +66,7 @@ class Block:
 _TOKEN_RE = re.compile(r"""
     (?P<comment>\#[^\n]*|//[^\n]*|/\*.*?\*/)
   | (?P<heredoc><<-?\s*(?P<hd_tag>\w+)\n)
-  | (?P<string>"(?:[^"\\]|\\.|\$\{[^}]*\})*")
+  | (?P<string>"(?:\$\{[^}]*\}|[^"\\]|\\.)*")
   | (?P<number>-?\d+(?:\.\d+)?)
   | (?P<ident>[A-Za-z_][\w.\-*\[\]"]*)
   | (?P<punct>[{}\[\](),=:])

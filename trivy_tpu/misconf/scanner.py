@@ -5,6 +5,7 @@ snippets."""
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 
 from trivy_tpu.iac import detection
@@ -67,12 +68,16 @@ def _contexts(file_type: str, path: str, content: bytes) -> list:
         return [K8sCtx(path=path, resource=r)
                 for r in k8s_resources(docs)]
     if file_type == detection.TERRAFORM:
+        # single-file entry: evaluate as a one-file module so expressions
+        # (locals, functions, interpolations) still resolve
         from trivy_tpu.iac.checks.cloud import adapt_terraform
-        from trivy_tpu.iac.parsers.hcl import parse_hcl, parse_tf_json
+        from trivy_tpu.iac.terraform import ModuleLoader, evaluate_module
 
-        parse = parse_tf_json if path.endswith(".tf.json") else parse_hcl
+        dirname = os.path.dirname(path)
+        loader = ModuleLoader({path: content})
+        ev = evaluate_module({path: content}, dirname, loader)
         return [CloudCtx(path=path,
-                         cloud_resources=adapt_terraform(parse(content)))]
+                         cloud_resources=adapt_terraform(ev.blocks))]
     if file_type == detection.CLOUDFORMATION:
         from trivy_tpu.iac.checks.cloud import adapt_cloudformation
         from trivy_tpu.iac.parsers.yamlconf import (
@@ -159,16 +164,45 @@ def _to_detected(chk: Check, file_type: str, cause: Cause | None,
     )
 
 
-def scan_config(path: str, content: bytes,
-                file_type: str | None = None) -> Misconfiguration | None:
-    """-> Misconfiguration (successes + failures) or None if the file is
-    not a recognized config type."""
-    ftype = file_type or detection.detect(path, content)
-    if ftype is None or ftype in (detection.YAML, detection.JSON):
-        return None  # plain data files: nothing to check (yet)
-    ctxs = _contexts(ftype, path, content)
-    if not ctxs:
-        return None
+def scan_terraform_modules(
+        files: dict[str, bytes]) -> list[Misconfiguration]:
+    """Directory-aware terraform scan: evaluate each ROOT module (child
+    modules expand inline through their `source` dirs, reference
+    pkg/iac/scanners/terraform), then run the checks over the evaluated
+    resources, attributing findings to each resource's source file."""
+    from trivy_tpu.iac.checks.cloud import adapt_terraform
+    from trivy_tpu.iac.engine import active
+    from trivy_tpu.iac.terraform import (
+        ModuleLoader,
+        evaluate_module,
+        module_dirs,
+    )
+
+    tf_files = {p: c for p, c in files.items()
+                if p.endswith((".tf", ".tf.json"))}
+    if not tf_files:
+        return []
+    loader = ModuleLoader(tf_files)
+    per_file: dict[str, list] = {}
+    for d in module_dirs(tf_files, loader=loader):
+        ev = evaluate_module(loader.tf_files(d), d, loader)
+        for blk in ev.blocks:
+            per_file.setdefault(blk.src_path, []).append(blk)
+    out: list[Misconfiguration] = []
+    for path in sorted(per_file):
+        content = files.get(path, b"")
+        ctxs = [CloudCtx(path=path,
+                         cloud_resources=adapt_terraform(per_file[path]))]
+        misconf = _run_checks(detection.TERRAFORM, path, ctxs, content)
+        if misconf.failures or misconf.successes:
+            out.append(misconf)
+    return out
+
+
+def _run_checks(ftype: str, path: str, ctxs: list,
+                content: bytes) -> Misconfiguration:
+    """Run every active check for `ftype` over the contexts, apply
+    `#trivy:ignore` comments, and collect FAIL/PASS findings."""
     ignores = parse_ignores(content)
     misconf = Misconfiguration(file_type=ftype, file_path=path)
     from trivy_tpu.iac.engine import active
@@ -193,3 +227,16 @@ def scan_config(path: str, content: bytes,
             misconf.successes.append(
                 _to_detected(chk, ftype, None, content, "PASS"))
     return misconf
+
+
+def scan_config(path: str, content: bytes,
+                file_type: str | None = None) -> Misconfiguration | None:
+    """-> Misconfiguration (successes + failures) or None if the file is
+    not a recognized config type."""
+    ftype = file_type or detection.detect(path, content)
+    if ftype is None or ftype in (detection.YAML, detection.JSON):
+        return None  # plain data files: nothing to check (yet)
+    ctxs = _contexts(ftype, path, content)
+    if not ctxs:
+        return None
+    return _run_checks(ftype, path, ctxs, content)

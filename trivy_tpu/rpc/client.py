@@ -37,7 +37,10 @@ class _Conn:
         self.timeout = timeout
 
     def post(self, path: str, body: bytes) -> bytes:
+        # the extended-fidelity internal encoding is marked so the server
+        # can tell it apart from reference Twirp clients on the same paths
         headers = {"Content-Type": "application/json",
+                   "X-Trivy-Tpu-Wire": "internal",
                    **self.custom_headers}
         if self.token:
             headers["Trivy-Token"] = self.token

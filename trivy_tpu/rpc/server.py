@@ -179,12 +179,25 @@ class ScanService:
         return True
 
 
-def _make_handler(service: ScanService, token: str | None):
+def _make_handler(service: ScanService, token: str | None,
+                  path_prefix: str = ""):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
         def log_message(self, fmt, *args):  # route into our logger
             _log.debug("http " + (fmt % args))
+
+        def parse_request(self) -> bool:
+            # mount the whole service under a URL path prefix (reference
+            # client_server_test.go "with path prefix"): requests outside
+            # it 404 via the normal routing (stripped path won't match)
+            ok = super().parse_request()
+            if ok and path_prefix:
+                if self.path.startswith(path_prefix):
+                    self.path = self.path[len(path_prefix):] or "/"
+                else:
+                    self.path = "\x00" + self.path  # never matches a route
+            return ok
 
         def _reply(self, code: int, body: bytes,
                    ctype: str = "application/json"):
@@ -220,6 +233,21 @@ def _make_handler(service: ScanService, token: str | None):
                 return
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length)
+            if self.path.startswith("/twirp/") and \
+                    self.headers.get("X-Trivy-Tpu-Wire") != "internal":
+                # reference wire protocol (Twirp protobuf / proto3-JSON).
+                # This framework's own client marks its extended-fidelity
+                # JSON encoding with the header above; anything else on
+                # the twirp paths is treated as a reference client.
+                from trivy_tpu.rpc import twirp
+
+                res = twirp.handle(
+                    service, self.path,
+                    self.headers.get("Content-Type", ""), body)
+                if res is not None:
+                    status, ct, out = res
+                    self._reply(status, out, ct)
+                    return
             try:
                 if self.path == SCAN_PATH:
                     self._handle_scan(body)
@@ -271,10 +299,14 @@ class Server:
 
     def __init__(self, engine, cache, host="localhost", port=4954,
                  token: str | None = None, db_path: str | None = None,
-                 db_reload_interval: float = 3600.0):
+                 db_reload_interval: float = 3600.0,
+                 path_prefix: str = ""):
+        if path_prefix and not path_prefix.startswith("/"):
+            path_prefix = "/" + path_prefix
         self.service = ScanService(engine, cache, db_path=db_path)
         self.httpd = ThreadingHTTPServer(
-            (host, port), _make_handler(self.service, token)
+            (host, port),
+            _make_handler(self.service, token, path_prefix.rstrip("/"))
         )
         self.db_reload_interval = db_reload_interval
         self._stop = threading.Event()
