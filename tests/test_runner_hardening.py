@@ -1,0 +1,150 @@
+"""Runner hardening (VERDICT r3 directive 10): --ignore-policy filter,
+per-scan timeout, and metadata-keyed DB hot swap."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from test_fanal import _fixture_db, _scan, env  # noqa: F401
+
+
+class TestIgnorePolicy:
+    def _scan_with_policy(self, env, tmp_path, capsys, policy: str,  # noqa: F811
+                          suffix: str):
+        d = tmp_path / "proj"
+        d.mkdir(exist_ok=True)
+        (d / "package-lock.json").write_text(json.dumps({
+            "name": "demo", "lockfileVersion": 3, "packages": {
+                "": {"name": "demo", "version": "1.0.0"},
+                "node_modules/lodash": {"version": "4.17.4"},
+            },
+        }))
+        pol = tmp_path / f"policy{suffix}"
+        pol.write_text(policy)
+        from trivy_tpu.cli import run as run_mod
+
+        run_mod._ENGINE_CACHE.clear()
+        rc, doc = _scan([
+            "fs", str(d), "--format", "json",
+            "--db-path", str(env / "db"),
+            "--cache-dir", str(env / "cache"),
+            "--ignore-policy", str(pol), "--quiet",
+        ], capsys)
+        assert rc == 0
+        return {v["VulnerabilityID"] for r in doc.get("Results") or []
+                for v in r.get("Vulnerabilities") or []}
+
+    def test_yaml_policy_drops_matching(self, env, tmp_path, capsys):  # noqa: F811
+        ids = self._scan_with_policy(env, tmp_path, capsys, (
+            "ignore:\n"
+            "  - path: VulnerabilityID\n"
+            "    equals: CVE-2019-10744\n"), ".yaml")
+        assert "CVE-2019-10744" not in ids
+
+    def test_yaml_policy_keeps_nonmatching(self, env, tmp_path, capsys):  # noqa: F811
+        ids = self._scan_with_policy(env, tmp_path, capsys, (
+            "ignore:\n"
+            "  - path: VulnerabilityID\n"
+            "    equals: CVE-0000-0000\n"), ".yaml")
+        assert "CVE-2019-10744" in ids
+
+    def test_python_policy(self, env, tmp_path, capsys):  # noqa: F811
+        ids = self._scan_with_policy(env, tmp_path, capsys, (
+            "def ignore(finding):\n"
+            "    return finding.get('PkgName') == 'lodash'\n"), ".py")
+        assert "CVE-2019-10744" not in ids
+
+    def test_bad_policy_is_fatal(self, env, tmp_path, capsys):  # noqa: F811
+        from trivy_tpu.cli.main import main
+
+        pol = tmp_path / "bad.yaml"
+        pol.write_text("ignore: {not: [a list}\n")
+        rc = main(["fs", str(tmp_path), "--db-path", str(env / "db"),
+                   "--cache-dir", str(env / "cache"),
+                   "--ignore-policy", str(pol), "--quiet"])
+        capsys.readouterr()
+        assert rc != 0
+
+
+class TestScanTimeout:
+    def test_parse_duration(self):
+        from trivy_tpu.cli.run import _parse_duration
+
+        assert _parse_duration(None) == 300.0
+        assert _parse_duration("90") == 90.0
+        assert _parse_duration("5m") == 300.0
+        assert _parse_duration("1h30m") == 5400.0
+        assert _parse_duration("45s") == 45.0
+
+    def test_deadline_exceeded(self):
+        from trivy_tpu.cli.run import FatalError, _scan_with_timeout
+
+        class SlowScanner:
+            def scan_artifact(self, options):
+                time.sleep(5)
+
+        with pytest.raises(FatalError, match="deadline"):
+            _scan_with_timeout(SlowScanner(), None, 0.2)
+
+    def test_fast_scan_passes_through(self):
+        from trivy_tpu.cli.run import _scan_with_timeout
+
+        class FastScanner:
+            def scan_artifact(self, options):
+                return {"ok": True}
+
+        assert _scan_with_timeout(FastScanner(), None, 5.0) == {"ok": True}
+
+    def test_worker_exception_propagates(self):
+        from trivy_tpu.cli.run import _scan_with_timeout
+
+        class Boom:
+            def scan_artifact(self, options):
+                raise ValueError("boom")
+
+        with pytest.raises(ValueError, match="boom"):
+            _scan_with_timeout(Boom(), None, 5.0)
+
+
+class TestMetadataHotSwap:
+    def test_reload_keyed_on_metadata_not_mtime(self, tmp_path):
+        import os
+
+        from trivy_tpu.cache.cache import MemoryCache
+        from trivy_tpu.db.store import Metadata
+        from trivy_tpu.detector.engine import MatchEngine
+        from trivy_tpu.rpc.server import ScanService
+
+        db = _fixture_db()
+        db.meta = Metadata(updated_at="2024-01-01T00:00:00Z")
+        path = str(tmp_path / "db")
+        db.save(path)
+        svc = ScanService(MatchEngine(db, use_device=False),
+                          MemoryCache(), db_path=path)
+        # touching files without a metadata change must NOT reload
+        # (reference db.go:97 keys on metadata, not timestamps)
+        os.utime(os.path.join(path, "metadata.json"))
+        assert svc.maybe_reload_db() is False
+        # a metadata change reloads
+        db.meta = Metadata(updated_at="2024-02-02T00:00:00Z")
+        db.save(path)
+        assert svc.maybe_reload_db() is True
+        assert svc.maybe_reload_db() is False
+
+
+def test_parse_duration_go_style_edge_cases():
+    """Regression (r4 review): '500ms' must not parse as 500 minutes and
+    trailing garbage must be rejected."""
+    import pytest as _pytest
+
+    from trivy_tpu.cli.run import FatalError, _parse_duration
+
+    assert _parse_duration("500ms") == 0.5
+    assert _parse_duration("1m30s") == 90.0
+    with _pytest.raises(FatalError):
+        _parse_duration("5m30")
+    with _pytest.raises(FatalError):
+        _parse_duration("bogus")

@@ -265,3 +265,239 @@ def test_bad_env_value_clean_error(monkeypatch, tmp_path):
     monkeypatch.chdir(tmp_path)
     monkeypatch.setenv("TRIVY_TPU_PARALLEL", "abc")
     assert main(["filesystem", "."]) == 1  # no traceback, exit 1
+
+
+# ---------------------------------------------------------------- r4:
+# reachability, repositories, OCI attestation (reference pkg/vex/vex.go
+# reachRoot, pkg/vex/repo, pkg/vex/oci.go)
+
+
+def _graph_report():
+    """app (root dep) -> lib -> vulnerable leaf zlib; plus a second
+    independent path root -> other -> zlib."""
+    from trivy_tpu.types.report import (
+        DetectedVulnerability, PkgIdentifier, Report, Result,
+    )
+    from trivy_tpu.types.artifact import Package
+
+    def pkg(pid, purl, deps=()):
+        p = Package(id=pid, name=pid.split("@")[0],
+                    version=pid.split("@")[1], depends_on=list(deps))
+        p.identifier = PkgIdentifier(purl=purl, uid=pid)
+        return p
+
+    res = Result(
+        target="app/package-lock.json", result_class="lang-pkgs",
+        type="npm",
+        packages=[
+            pkg("app@1.0.0", "pkg:npm/app@1.0.0", ["lib@2.0.0"]),
+            pkg("lib@2.0.0", "pkg:npm/lib@2.0.0", ["zlib@1.2.3"]),
+            pkg("other@3.0.0", "pkg:npm/other@3.0.0", ["zlib@1.2.3"]),
+            pkg("zlib@1.2.3", "pkg:npm/zlib@1.2.3"),
+        ],
+        vulnerabilities=[DetectedVulnerability(
+            vulnerability_id="CVE-2042-1", pkg_name="zlib",
+            installed_version="1.2.3",
+            pkg_identifier=PkgIdentifier(purl="pkg:npm/zlib@1.2.3",
+                                         uid="zlib@1.2.3"),
+        )],
+    )
+    return Report(artifact_name="repo", results=[res])
+
+
+def _openvex(products):
+    return {
+        "@context": "https://openvex.dev/ns/v0.2.0",
+        "statements": [{
+            "vulnerability": {"name": "CVE-2042-1"},
+            "status": "not_affected",
+            "justification": "vulnerable_code_not_in_execute_path",
+            "products": products,
+        }],
+    }
+
+
+class TestReachability:
+    def _filter(self, report, doc):
+        import json as _json
+        import tempfile
+
+        from trivy_tpu.vex import filter_report_vex, load_vex
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            _json.dump(doc, f)
+        return filter_report_vex(report, [load_vex(f.name)])
+
+    def test_statement_on_one_parent_path_keeps_finding(self):
+        """zlib is reachable via both lib and other; a statement covering
+        only lib must NOT suppress (reference reachRoot)."""
+        report = _graph_report()
+        n = self._filter(report, _openvex([
+            {"@id": "pkg:npm/lib@2.0.0",
+             "subcomponents": [{"@id": "pkg:npm/zlib@1.2.3"}]},
+        ]))
+        assert n == 0
+        assert report.results[0].vulnerabilities
+
+    def test_statements_on_all_paths_suppress(self):
+        report = _graph_report()
+        n = self._filter(report, _openvex([
+            {"@id": "pkg:npm/lib@2.0.0",
+             "subcomponents": [{"@id": "pkg:npm/zlib@1.2.3"}]},
+            {"@id": "pkg:npm/other@3.0.0",
+             "subcomponents": [{"@id": "pkg:npm/zlib@1.2.3"}]},
+        ]))
+        assert n == 1
+        assert not report.results[0].vulnerabilities
+        assert report.results[0].modified_findings
+
+    def test_statement_on_leaf_suppresses(self):
+        report = _graph_report()
+        n = self._filter(report, _openvex(
+            [{"@id": "pkg:npm/zlib@1.2.3"}]))
+        assert n == 1
+
+    def test_subcomponent_mismatch_keeps(self):
+        report = _graph_report()
+        n = self._filter(report, _openvex([
+            {"@id": "pkg:npm/lib@2.0.0",
+             "subcomponents": [{"@id": "pkg:npm/somethingelse@9"}]},
+        ]))
+        assert n == 0
+
+
+class TestRepositorySet:
+    def _mk_repo(self, cache, name, doc):
+        import json as _json
+        import os
+
+        d = os.path.join(cache, "vex", "repositories", name, "0.1")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "index.json"), "w") as f:
+            _json.dump({"packages": [
+                {"id": "pkg:npm/zlib", "location": "docs/zlib.openvex.json",
+                 "format": "openvex"},
+            ]}, f)
+        os.makedirs(os.path.join(d, "docs"), exist_ok=True)
+        with open(os.path.join(d, "docs", "zlib.openvex.json"), "w") as f:
+            _json.dump(doc, f)
+        os.makedirs(os.path.join(cache, "vex"), exist_ok=True)
+        with open(os.path.join(cache, "vex", "repository.yaml"), "a") as f:
+            f.write(f"repositories:\n  - name: {name}\n"
+                    f"    url: https://example.com/{name}\n"
+                    f"    enabled: true\n")
+
+    def test_repo_lookup_and_suppression(self, tmp_path):
+        from trivy_tpu.vex import filter_report_vex
+        from trivy_tpu.vex.repo import RepositorySet
+
+        cache = str(tmp_path)
+        self._mk_repo(cache, "corp", _openvex(
+            [{"@id": "pkg:npm/zlib@1.2.3"}]))
+        rs = RepositorySet(cache)
+        assert rs
+        stmts = rs.candidate_statements("pkg:npm/zlib@1.2.3")
+        assert stmts and stmts[0][1].vulnerability_id == "CVE-2042-1"
+        assert rs.candidate_statements("pkg:npm/absent@1.0.0") == []
+        report = _graph_report()
+        assert filter_report_vex(report, [rs]) == 1
+
+    def test_missing_cache_is_nonfatal(self, tmp_path):
+        from trivy_tpu.vex.repo import RepositorySet
+
+        rs = RepositorySet(str(tmp_path))
+        assert not rs
+        assert rs.candidate_statements("pkg:npm/zlib@1.2.3") == []
+
+    def test_document_escape_is_blocked(self, tmp_path):
+        import json as _json
+        import os
+
+        from trivy_tpu.vex.repo import RepositorySet
+
+        cache = str(tmp_path)
+        d = os.path.join(cache, "vex", "repositories", "evil", "0.1")
+        os.makedirs(d)
+        with open(os.path.join(d, "index.json"), "w") as f:
+            _json.dump({"packages": [
+                {"id": "pkg:npm/zlib", "location": "../../../../etc/passwd"},
+            ]}, f)
+        os.makedirs(os.path.join(cache, "vex"), exist_ok=True)
+        with open(os.path.join(cache, "vex", "repository.yaml"), "w") as f:
+            f.write("repositories:\n  - name: evil\n    url: x\n")
+        rs = RepositorySet(cache)
+        assert rs.candidate_statements("pkg:npm/zlib@1.0.0") == []
+
+
+class TestOCIAttestation:
+    def test_decode_raw_openvex(self):
+        import json as _json
+
+        from trivy_tpu.vex.oci import _decode_attestation
+
+        doc = _decode_attestation(
+            _json.dumps(_openvex([{"@id": "pkg:npm/zlib@1.2.3"}])).encode(),
+            "oci")
+        assert doc is not None and doc.statements
+
+    def test_decode_dsse_envelope(self):
+        import base64
+        import json as _json
+
+        from trivy_tpu.vex.oci import _decode_attestation
+
+        statement = {
+            "_type": "https://in-toto.io/Statement/v0.1",
+            "predicateType": "https://openvex.dev/ns/v0.2.0",
+            "predicate": _openvex([{"@id": "pkg:npm/zlib@1.2.3"}]),
+        }
+        envelope = {
+            "payloadType": "application/vnd.in-toto+json",
+            "payload": base64.b64encode(
+                _json.dumps(statement).encode()).decode(),
+            "signatures": [],
+        }
+        doc = _decode_attestation(_json.dumps(envelope).encode(), "oci")
+        assert doc is not None
+        assert doc.statements[0].vulnerability_id == "CVE-2042-1"
+
+    def test_non_image_report_returns_none(self):
+        from trivy_tpu.vex.oci import load_oci_vex
+
+        assert load_oci_vex(_graph_report()) is None
+
+
+def test_cycle_without_statement_keeps_finding():
+    """Regression (r4 review): a dependency cycle detached from the root
+    must keep the finding, not crash unpacking an empty hit."""
+    from trivy_tpu.types.artifact import Package
+    from trivy_tpu.types.report import (
+        DetectedVulnerability, PkgIdentifier, Report, Result,
+    )
+    from trivy_tpu.vex import filter_report_vex
+    from trivy_tpu.vex.vex import VexDocument, VexStatement
+
+    def pkg(pid, purl, deps=()):
+        p = Package(id=pid, name=pid.split("@")[0],
+                    version=pid.split("@")[1], depends_on=list(deps))
+        p.identifier = PkgIdentifier(purl=purl, uid=pid)
+        return p
+
+    res = Result(
+        target="t", result_class="lang-pkgs", type="npm",
+        packages=[
+            pkg("a@1", "pkg:npm/a@1", ["b@1"]),
+            pkg("b@1", "pkg:npm/b@1", ["a@1"]),  # cycle, no root path
+        ],
+        vulnerabilities=[DetectedVulnerability(
+            vulnerability_id="CVE-9", pkg_name="a",
+            pkg_identifier=PkgIdentifier(purl="pkg:npm/a@1", uid="a@1"),
+        )],
+    )
+    report = Report(artifact_name="x", results=[res])
+    doc = VexDocument(source="s", statements=[VexStatement(
+        vulnerability_id="CVE-OTHER", status="not_affected",
+        products=["pkg:npm/zzz@1"])])
+    assert filter_report_vex(report, [doc]) == 0
+    assert report.results[0].vulnerabilities

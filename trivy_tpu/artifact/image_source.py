@@ -361,7 +361,7 @@ class RegistryImage:
 
 
 def resolve_image(target: str,
-                  sources: tuple[str, ...] = ("docker", "podman", "remote"),
+                  sources: tuple[str, ...] = ("containerd", "docker", "podman", "remote"),
                   insecure: bool = False,
                   username: str = "", password: str = ""):
     """Try each source in order, collecting errors
@@ -369,6 +369,21 @@ def resolve_image(target: str,
     errors: list[str] = []
     for source in sources:
         try:
+            if source == "containerd":
+                from trivy_tpu.artifact.containerd import (
+                    ContainerdImage,
+                    containerd_root,
+                )
+
+                if not os.path.exists(containerd_root()):
+                    raise SourceError("no containerd root found")
+                try:
+                    return ContainerdImage(target)
+                except Exception as e:
+                    # ANY containerd failure (permissions, corrupt bolt
+                    # pages, bad blobs) must fall through to the next
+                    # source, never abort the chain
+                    raise SourceError(str(e))
             if source == "docker":
                 host = os.environ.get("DOCKER_HOST", "")
                 if host.startswith("unix://"):

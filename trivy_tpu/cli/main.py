@@ -50,6 +50,10 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--offline-scan", action="store_true")
     p.add_argument("--list-all-pkgs", action="store_true")
     p.add_argument("--ignorefile", default=".trivyignore")
+    p.add_argument("--ignore-policy", default=None,
+                   help="finding ignore policy: .yaml condition DSL or "
+                        ".py with ignore(finding) (reference's Rego "
+                        "--ignore-policy)")
     p.add_argument("--ignore-unfixed", action="store_true",
                    help="hide vulnerabilities with no fixed version")
     p.add_argument("--dependency-tree", action="store_true",
@@ -63,6 +67,9 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--exit-on-eol", type=int, default=0)
     p.add_argument("--no-tpu", action="store_true",
                    help="run matching on host instead of the TPU kernel")
+    p.add_argument("--timeout", default="5m",
+                   help="per-scan deadline (e.g. 300s, 5m, 1h; "
+                        "reference --timeout default 5m)")
     p.add_argument("--parallel", type=int, default=5,
                    help="number of parallel analysis workers")
     p.add_argument("--server", default=None,
@@ -93,7 +100,9 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
                    help="directory of scan-module extensions "
                         "(default <cache>/modules)")
     p.add_argument("--vex", action="append", default=[],
-                   help="VEX file (OpenVEX / CycloneDX VEX / CSAF); "
+                   help="VEX source: a document path (OpenVEX / CycloneDX "
+                        "VEX / CSAF), 'repo' (cached VEX repositories), "
+                        "or 'oci' (image-attached attestation); "
                         "repeatable")
     p.add_argument("--show-suppressed", action="store_true",
                    help="include VEX-suppressed findings in the report")
@@ -164,7 +173,7 @@ def build_parser() -> argparse.ArgumentParser:
         if name == "image":
             p.add_argument("--input", default=None,
                            help="image tar archive path")
-            p.add_argument("--image-src", default="docker,podman,remote",
+            p.add_argument("--image-src", default="containerd,docker,podman,remote",
                            help="comma-separated image sources tried in "
                                 "order (docker,podman,remote)")
             p.add_argument("--insecure", action="store_true",

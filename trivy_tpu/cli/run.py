@@ -165,6 +165,57 @@ def _configure_check_engine(args) -> None:
         raise FatalError(f"loading checks: {e}")
 
 
+def _parse_duration(spec: str | None) -> float:
+    """Go-style duration ("5m", "300s", "1h30m", "500ms") or bare
+    seconds -> seconds (reference --timeout, default 5m). Trailing
+    garbage is an error, not silently dropped."""
+    import re as _re
+
+    if not spec:
+        return 300.0
+    try:
+        return float(spec)
+    except ValueError:
+        pass
+    unit_rx = r"(\d+(?:\.\d+)?)(ms|h|m|s)"
+    if not _re.fullmatch(f"(?:{unit_rx})+", spec):
+        raise FatalError(f"invalid --timeout {spec!r}")
+    total = 0.0
+    for n, unit in _re.findall(unit_rx, spec):
+        total += float(n) * {"h": 3600.0, "m": 60.0, "s": 1.0,
+                             "ms": 0.001}[unit]
+    if total <= 0:
+        raise FatalError(f"invalid --timeout {spec!r}")
+    return total
+
+
+def _scan_with_timeout(scanner, options, timeout_s: float):
+    """Per-scan deadline (reference artifact/run.go:338 ctx timeout).
+    The scan runs in a worker thread; on deadline the CLI fails with the
+    reference's DeadlineExceeded advice (the worker, being a daemon
+    thread, cannot outlive the process)."""
+    import threading
+
+    box: dict = {}
+
+    def work():
+        try:
+            box["report"] = scanner.scan_artifact(options)
+        except BaseException as exc:  # re-raised on the main thread
+            box["error"] = exc
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise FatalError(
+            f"scan deadline exceeded ({timeout_s:.0f}s); increase "
+            "--timeout (e.g. --timeout 10m)")
+    if "error" in box:
+        raise box["error"]
+    return box["report"]
+
+
 def _run_scan_core(args, compliance_spec) -> int:
     from trivy_tpu.cache.cache import FSCache
     from trivy_tpu.result.filter import filter_report
@@ -196,16 +247,35 @@ def _run_scan_core(args, compliance_spec) -> int:
             f"unknown cache backend {backend!r} (fs, memory, redis://...)")
     artifact, driver = _select_scanner(args, cache)
     scanner = Scanner(driver, artifact)
-    report = scanner.scan_artifact(make_scan_options(args))
+    report = _scan_with_timeout(
+        scanner, make_scan_options(args),
+        _parse_duration(getattr(args, "timeout", None)))
 
     # VEX suppression runs before severity/ignore filtering
-    # (reference pkg/result/filter.go:37 -> pkg/vex/vex.go:65)
-    vex_paths = getattr(args, "vex", None) or []
-    if vex_paths:
+    # (reference pkg/result/filter.go:37 -> pkg/vex/vex.go:65).
+    # Sources: a document path, "repo" (cached VEX repositories), or
+    # "oci" (attestation attached to the scanned image).
+    vex_specs = getattr(args, "vex", None) or []
+    if vex_specs:
         from trivy_tpu.vex import filter_report_vex, load_vex
 
-        docs = [load_vex(p) for p in vex_paths]
-        n = filter_report_vex(report, docs)
+        sources = []
+        for spec in vex_specs:
+            if spec == "repo":
+                from trivy_tpu.vex.repo import RepositorySet
+
+                rs = RepositorySet(args.cache_dir)
+                if rs:
+                    sources.append(rs)
+            elif spec == "oci":
+                from trivy_tpu.vex.oci import load_oci_vex
+
+                doc = load_oci_vex(report)
+                if doc is not None:
+                    sources.append(doc)
+            else:
+                sources.append(load_vex(spec))
+        n = filter_report_vex(report, sources) if sources else 0
         if n:
             _log.info("vex suppressed findings", count=n)
     if not getattr(args, "show_suppressed", False):
@@ -215,9 +285,20 @@ def _run_scan_core(args, compliance_spec) -> int:
     severities = _severities(args.severity)
     ignore_cfg = load_ignore_file(args.ignorefile)
     statuses = (args.ignore_status or "").split(",") if args.ignore_status else None
+    ignore_policy = None
+    if getattr(args, "ignore_policy", None):
+        from trivy_tpu.result.policy import load_ignore_policy
+
+        try:
+            ignore_policy = load_ignore_policy(args.ignore_policy)
+        except Exception as e:
+            # .py policies can raise anything at import time
+            # (SyntaxError, ImportError, ...); all of it is user input
+            raise FatalError(f"ignore policy: {e}")
     filter_report(report, severities=severities, ignore_statuses=statuses,
                   ignore_config=ignore_cfg,
-                  ignore_unfixed=getattr(args, "ignore_unfixed", False))
+                  ignore_unfixed=getattr(args, "ignore_unfixed", False),
+                  ignore_policy=ignore_policy)
 
     if compliance_spec is not None:
         from trivy_tpu.compliance.report import (
@@ -333,7 +414,7 @@ def _select_scanner(args, cache):
             raise FatalError("image target or --input required")
         sources = tuple(
             s.strip() for s in
-            getattr(args, "image_src", "docker,podman,remote").split(",")
+            getattr(args, "image_src", "containerd,docker,podman,remote").split(",")
             if s.strip())
         return ImageArtifact(
             target, cache, from_tar=bool(getattr(args, "input", None)),
@@ -549,7 +630,13 @@ def run_db(args) -> int:
     from trivy_tpu.db.store import AdvisoryDB
 
     if args.db_command == "import":
-        db = AdvisoryDB.load(args.source) if os.path.isdir(args.source) else _import_json(args.source)
+        if os.path.isdir(args.source):
+            db = AdvisoryDB.load(args.source)
+        else:
+            from trivy_tpu.db.trivydb import try_load
+
+            # a real trivy-db boltdb artifact imports directly
+            db = try_load(args.source) or _import_json(args.source)
         path = getattr(args, "db_path", None) or os.path.join(args.cache_dir, "db")
         db.save(path)
         _log.info("imported advisory DB", path=path, **db.stats())

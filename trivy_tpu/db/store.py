@@ -149,6 +149,13 @@ class AdvisoryDB:
         elif os.path.exists(fname):
             with open(fname, "rb") as f:
                 blob = json.loads(f.read())
+        elif os.path.exists(os.path.join(path, "trivy.db")):
+            # a downloaded reference trivy-db artifact (BoltDB); the
+            # sibling metadata.json still loads below
+            from trivy_tpu.db.trivydb import load_trivy_db
+
+            db = load_trivy_db(os.path.join(path, "trivy.db"))
+            blob = {}
         else:
             raise FileNotFoundError(f"no advisory DB at {path}")
         for bucket, pkgs in blob.get("buckets", {}).items():

@@ -119,21 +119,38 @@ class ScanService:
         self.engine = engine
         self.cache = cache
         self.db_path = db_path
-        self._db_mtime = self._mtime()
+        self._db_state = self._db_identity()
         self.metrics = Metrics()
 
-    def _mtime(self) -> float:
+    def _db_identity(self) -> tuple:
+        """DB identity for hot-swap decisions: the metadata document
+        (UpdatedAt/Version — reference pkg/db/db.go:97 NeedsUpdate reads
+        metadata, not file timestamps) plus an mtime fallback for DBs
+        written without metadata."""
+        import json
         import os
 
         if not self.db_path:
-            return 0.0
+            return ()
+        meta_path = os.path.join(self.db_path, "metadata.json")
         try:
-            return max(
+            with open(meta_path, encoding="utf-8") as f:
+                md = json.load(f)
+            ident = (md.get("Version"), md.get("UpdatedAt"),
+                     md.get("NextUpdate"), md.get("DownloadedAt"))
+            # a DB written without meaningful metadata falls back to
+            # timestamps below — an empty tuple must not pin the identity
+            if any(ident[1:]):
+                return ident
+        except (OSError, ValueError):
+            pass
+        try:
+            return (max(
                 os.path.getmtime(os.path.join(self.db_path, f))
                 for f in os.listdir(self.db_path)
-            )
+            ),)
         except (OSError, ValueError):
-            return 0.0
+            return ()
 
     def scan(self, target, artifact_key, blob_keys, options):
         import time
@@ -157,9 +174,10 @@ class ScanService:
             self.lock.release_read()
 
     def maybe_reload_db(self) -> bool:
-        """Hot-swap the engine if the DB dir changed on disk."""
-        mtime = self._mtime()
-        if not self.db_path or mtime <= self._db_mtime:
+        """Hot-swap the engine when the DB *metadata* changed (a new
+        UpdatedAt/Version), not merely a file timestamp."""
+        state = self._db_identity()
+        if not self.db_path or not state or state == self._db_state:
             return False
         from trivy_tpu.db.store import AdvisoryDB
         from trivy_tpu.detector.engine import MatchEngine
@@ -170,7 +188,7 @@ class ScanService:
         self.lock.acquire_write()  # quiesce in-flight scans
         try:
             self.engine = new_engine
-            self._db_mtime = mtime
+            self._db_state = state
         finally:
             self.lock.release_write()
         with self.metrics._lock:

@@ -37,19 +37,45 @@ class VexStatement:
     # products-less statement would otherwise drop the CVE for EVERY
     # package in the report)
     products: list[str] = field(default_factory=list)
+    # product id -> subcomponent ids (OpenVEX: the statement applies to
+    # vulnerabilities in these subcomponents of the product). A product
+    # with no subcomponents applies to the product itself and everything
+    # below it.
+    subcomponents: dict[str, list[str]] = field(default_factory=dict)
+
+    def _ids_match(self, vuln_id: str, aliases: list[str]) -> bool:
+        return bool({vuln_id, *aliases} &
+                    {self.vulnerability_id, *self.vuln_aliases})
 
     def matches(self, vuln_id: str, aliases: list[str], purl: str,
                 bom_ref: str = "") -> bool:
-        finding_ids = {vuln_id, *aliases}
-        statement_ids = {self.vulnerability_id, *self.vuln_aliases}
-        if not (finding_ids & statement_ids):
-            return False
-        if not self.products:
+        if not self._ids_match(vuln_id, aliases) or not self.products:
             return False
         return any(
             _purl_match(p, purl) or (bom_ref and p == bom_ref)
             for p in self.products
         )
+
+    def matches_component(self, vuln_id: str, aliases: list[str],
+                          node_purl: str, node_ref: str,
+                          leaf_purl: str, leaf_ref: str) -> bool:
+        """Reachability form (reference vex.go NotAffected(vuln, product,
+        subComponent)): the statement's product must match the graph
+        node, and when the statement carries subcomponents the vulnerable
+        leaf must be one of them."""
+        if not self._ids_match(vuln_id, aliases) or not self.products:
+            return False
+        for p in self.products:
+            if not (_purl_match(p, node_purl)
+                    or (node_ref and p == node_ref)):
+                continue
+            subs = self.subcomponents.get(p)
+            if not subs:
+                return True
+            if any(_purl_match(s, leaf_purl)
+                   or (leaf_ref and s == leaf_ref) for s in subs):
+                return True
+        return False
 
 
 @dataclass
@@ -96,16 +122,20 @@ def _decode_openvex(doc: dict, source: str) -> VexDocument:
         vid = vuln.get("name") or vuln.get("@id", "")
         aliases = [str(a) for a in vuln.get("aliases") or []]
         products = []
+        subcomponents: dict[str, list[str]] = {}
         for p in st.get("products") or []:
             pid = p.get("@id", "") if isinstance(p, dict) else str(p)
             if pid:
                 products.append(pid)
+            subs = []
             for sub in (p.get("subcomponents") or []
                         if isinstance(p, dict) else []):
                 sid = sub.get("@id", "") if isinstance(sub, dict) \
                     else str(sub)
                 if sid:
-                    products.append(sid)
+                    subs.append(sid)
+            if pid and subs:
+                subcomponents[pid] = subs
         out.statements.append(VexStatement(
             vulnerability_id=vid,
             vuln_aliases=aliases,
@@ -113,6 +143,7 @@ def _decode_openvex(doc: dict, source: str) -> VexDocument:
             justification=st.get("justification", ""),
             impact=st.get("impact_statement", ""),
             products=products,
+            subcomponents=subcomponents,
         ))
     return out
 
@@ -222,46 +253,137 @@ def load_vex(path: str) -> VexDocument:
 # ------------------------------------------------------------ filtering
 
 
-def filter_report_vex(report: Report, vex_docs: list[VexDocument]) -> int:
+@dataclass
+class _Node:
+    """One component in the report's dependency graph."""
+
+    purl: str = ""
+    ref: str = ""
+    parents: list[str] = field(default_factory=list)
+    root: bool = False
+
+
+def _component_graph(report: Report) -> dict[str, _Node]:
+    """Report -> child-to-parents component graph (the reference builds
+    the same shape through the SBOM encoder, vex.go:75-78): package
+    `depends_on` edges point downward, so each dependency records its
+    dependents as parents; packages nobody depends on hang off a root
+    node carrying the artifact's identity (image purl when present)."""
+    nodes: dict[str, _Node] = {}
+    root_purl = ""
+    md = getattr(report, "metadata", None)
+    if md is not None and getattr(md, "repo_digests", None):
+        # pkg:oci purl of the scanned image (reference purl.TypeOCI)
+        dig = md.repo_digests[0]
+        if "@" in dig:
+            name, digest = dig.rsplit("@", 1)
+            root_purl = (f"pkg:oci/{name.rsplit('/', 1)[-1]}@{digest}"
+                         f"?repository_url={name}")
+    nodes["__root__"] = _Node(purl=root_purl, ref=report.artifact_name,
+                              root=True)
+    for res in report.results:
+        key_of: dict[str, str] = {}
+        for p in res.packages:
+            uid = p.identifier.uid or p.identifier.purl or \
+                f"{res.target}:{p.id}"
+            key_of[p.id] = uid
+            nodes.setdefault(uid, _Node(
+                purl=p.identifier.purl,
+                ref=p.identifier.bom_ref or ""))
+        has_parent: set[str] = set()
+        for p in res.packages:
+            uid = key_of[p.id]
+            for dep in p.depends_on:
+                child = key_of.get(dep)
+                if child is not None:
+                    nodes[child].parents.append(uid)
+                    has_parent.add(child)
+        for p in res.packages:
+            uid = key_of[p.id]
+            if uid not in has_parent and \
+                    "__root__" not in nodes[uid].parents:
+                nodes[uid].parents.append("__root__")
+    return nodes
+
+
+def filter_report_vex(report: Report, vex_sources: list) -> int:
     """Suppress findings asserted not_affected/fixed; returns the number
     suppressed. Suppressed entries are kept on the result as modified
-    findings (rendered under ExperimentalModifiedFindings)."""
+    findings (rendered under ExperimentalModifiedFindings).
+
+    Suppression is reachability-aware (reference vex.go reachRoot): a
+    statement may target an ANCESTOR product (e.g. the container image or
+    an aggregate package) with the vulnerable package as subcomponent,
+    and a finding is only suppressed when every dependency path from the
+    vulnerable component to the root is covered by a statement."""
+    graph = _component_graph(report)
     total = 0
     for res in report.results:
-        total += _filter_result(res, vex_docs)
+        total += _filter_result(res, vex_sources, graph)
     return total
 
 
-def _filter_result(res: Result, vex_docs: list[VexDocument]) -> int:
+def _candidates(src, vuln, purl: str) -> list[tuple[str, VexStatement]]:
+    """Statements of one source possibly relevant to (vuln, component)."""
+    if hasattr(src, "candidate_statements"):
+        return src.candidate_statements(purl)
+    return [(src.source, st) for st in src.statements]
+
+
+def _filter_result(res: Result, vex_sources: list,
+                   graph: dict[str, _Node]) -> int:
     kept = []
     modified = getattr(res, "modified_findings", None) or []
     for v in res.vulnerabilities:
-        purl = v.pkg_identifier.purl
-        bom_ref = v.pkg_identifier.bom_ref
-        statement = None
-        for doc in vex_docs:
-            for st in doc.statements:
-                if st.status in _SUPPRESS and st.matches(
-                    v.vulnerability_id, v.vendor_ids, purl, bom_ref
-                ):
-                    statement = (doc, st)
-                    break
-            if statement:
-                break
-        if statement is None:
+        leaf_purl = v.pkg_identifier.purl
+        leaf_ref = v.pkg_identifier.bom_ref
+        leaf_uid = v.pkg_identifier.uid or leaf_purl
+
+        hit: list = []  # last matching (source, statement)
+
+        def blocked(node: _Node) -> bool:
+            for src in vex_sources:
+                for source, st in _candidates(src, v, node.purl):
+                    if st.status in _SUPPRESS and st.matches_component(
+                        v.vulnerability_id, v.vendor_ids,
+                        node.purl, node.ref, leaf_purl, leaf_ref,
+                    ):
+                        hit[:] = [source, st]
+                        return True
+            return False
+
+        leaf = graph.get(leaf_uid) or _Node(purl=leaf_purl, ref=leaf_ref)
+
+        def reaches_root(uid: str, node: _Node, seen: set) -> bool:
+            if blocked(node):
+                return False
+            if node.root or not node.parents:
+                return True
+            seen.add(uid)
+            for parent in node.parents:
+                if parent in seen:
+                    continue
+                pn = graph.get(parent)
+                if pn is None or reaches_root(parent, pn, seen):
+                    return True
+            return False
+
+        if reaches_root(leaf_uid, leaf, set()) or not hit:
+            # no path reached the root AND nothing was blocked: a
+            # dependency cycle with no matching statement — keep the
+            # finding (suppression requires an actual statement)
             kept.append(v)
             continue
-        doc, st = statement
-        total_d = {
+        source, st = hit
+        modified.append({
             "Type": "vulnerability",
             "Status": st.status,
             "Statement": st.justification or st.impact or "",
-            "Source": doc.source,
+            "Source": source,
             "Finding": v.to_dict(),
-        }
-        modified.append(total_d)
+        })
         _log.debug("vex suppressed", id=v.vulnerability_id,
-                   status=st.status, source=doc.source)
+                   status=st.status, source=source)
     suppressed = len(res.vulnerabilities) - len(kept)
     res.vulnerabilities = kept
     res.modified_findings = modified
