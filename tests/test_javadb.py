@@ -127,3 +127,53 @@ class TestJarIdentification:
         db = JavaDB(default_path(str(tmp_path / "cache")))
         assert db.search_by_sha1("3" * 40) == GAV("g", "a", "1")
         db.close()
+
+
+def test_reads_real_trivy_java_db_schema(tmp_path):
+    """The real trivy-java-db (sqlite: artifacts+indices with BLOB sha1)
+    is consumed natively — no conversion step (r4)."""
+    import sqlite3
+
+    from trivy_tpu.db.javadb import JavaDB
+
+    path = str(tmp_path / "trivy-java.db")
+    conn = sqlite3.connect(path)
+    conn.executescript("""
+        CREATE TABLE artifacts(id INTEGER PRIMARY KEY, group_id TEXT,
+                               artifact_id TEXT);
+        CREATE TABLE indices(artifact_id INTEGER, version TEXT,
+                             sha1 BLOB, archive_type TEXT);
+        INSERT INTO artifacts VALUES (1, 'org.apache.commons',
+                                      'commons-text');
+        INSERT INTO indices VALUES (1, '1.9',
+                                    X'aabbccddeeff00112233445566778899aabbccdd',
+                                    'jar');
+    """)
+    conn.commit()
+    conn.close()
+    jdb = JavaDB(path)
+    gav = jdb.search_by_sha1("aabbccddeeff00112233445566778899aabbccdd")
+    assert gav is not None
+    assert (gav.group_id, gav.artifact_id, gav.version) == \
+        ("org.apache.commons", "commons-text", "1.9")
+    assert jdb.search_by_artifact_id("commons-text", "1.9") == \
+        "org.apache.commons"
+    assert jdb.stats() == {"artifacts": 1}
+
+
+REF_JAVA_DB = ("/root/reference/pkg/fanal/analyzer/language/java/jar/"
+               "testdata/java-db/trivy-java.db")
+
+
+def test_reads_reference_java_db_fixture():
+    import os
+
+    import pytest as _pytest
+
+    if not os.path.exists(REF_JAVA_DB):
+        _pytest.skip("reference checkout not available")
+    from trivy_tpu.db.javadb import JavaDB
+
+    jdb = JavaDB(REF_JAVA_DB)
+    gav = jdb.search_by_sha1("bd70dfeb39cc83c6934be24fa377b21e541dbe76")
+    assert gav is not None and gav.artifact_id == "tomcat-embed-websocket"

@@ -188,3 +188,128 @@ def test_k8s_image_tar_scan(tmp_path):
     vulns = {v.vulnerability_id for res in rep.results
              for v in res.vulnerabilities}
     assert "CVE-2025-1000" in vulns
+
+
+# ------------------------------------------------------------- r4: API
+# client replacing the kubectl subprocess (reference client-go)
+
+
+class _FakeAPIServer:
+    """Minimal kube API server over plain HTTP with bearer-token auth."""
+
+    RESOURCES = {
+        "/api/v1/pods": [{
+            "metadata": {"name": "web", "namespace": "prod"},
+            "spec": {"containers": [{"name": "c",
+                                     "image": "nginx:1.25"}]},
+        }],
+        "/apis/apps/v1/deployments": [{
+            "metadata": {"name": "api", "namespace": "prod"},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "a", "image": "api:2.0"}]}}},
+        }],
+        "/apis/rbac.authorization.k8s.io/v1/clusterroles": [{
+            "metadata": {"name": "admin-all"},
+            "rules": [{"apiGroups": ["*"], "resources": ["*"],
+                       "verbs": ["*"]}],
+        }],
+    }
+
+    def start(self):
+        import http.server
+        import json as _json
+        import threading
+
+        resources = self.RESOURCES
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.headers.get("Authorization") != "Bearer tok123":
+                    self.send_response(401)
+                    self.end_headers()
+                    return
+                if self.path == "/version":
+                    body = _json.dumps({"gitVersion": "v1.29.0"}).encode()
+                elif self.path in resources:
+                    body = _json.dumps(
+                        {"items": resources[self.path]}).encode()
+                else:
+                    body = b'{"items": []}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = http.server.ThreadingHTTPServer(("localhost", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        return f"http://localhost:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def fake_apiserver(tmp_path, monkeypatch):
+    srv = _FakeAPIServer()
+    url = srv.start()
+    kubeconfig = tmp_path / "config"
+    kubeconfig.write_text(
+        "apiVersion: v1\nkind: Config\ncurrent-context: test\n"
+        "contexts:\n  - name: test\n    context:\n"
+        "      cluster: c1\n      user: u1\n"
+        "clusters:\n  - name: c1\n    cluster:\n"
+        f"      server: {url}\n"
+        "users:\n  - name: u1\n    user:\n      token: tok123\n")
+    monkeypatch.setenv("KUBECONFIG", str(kubeconfig))
+    yield url
+    srv.stop()
+
+
+class TestKubeClient:
+    def test_version_and_list(self, fake_apiserver):
+        from trivy_tpu.k8s.client import KubeClient
+
+        c = KubeClient()
+        assert c.version()["gitVersion"] == "v1.29.0"
+        pods = c.list("Pod")
+        assert pods[0]["metadata"]["name"] == "web"
+        assert pods[0]["kind"] == "Pod"  # filled in from list context
+        roles = c.list("ClusterRole")
+        assert roles[0]["metadata"]["name"] == "admin-all"
+
+    def test_bad_token_raises(self, fake_apiserver, tmp_path, monkeypatch):
+        from trivy_tpu.k8s.client import KubeClient, KubeError
+
+        cfg = tmp_path / "bad"
+        cfg.write_text(
+            "current-context: t\n"
+            "contexts: [{name: t, context: {cluster: c, user: u}}]\n"
+            f"clusters: [{{name: c, cluster: {{server: {fake_apiserver}}}}}]\n"
+            "users: [{name: u, user: {token: WRONG}}]\n")
+        monkeypatch.setenv("KUBECONFIG", str(cfg))
+        with pytest.raises(KubeError):
+            KubeClient().list("Pod")
+
+    def test_load_cluster_api_enumerates(self, fake_apiserver):
+        from trivy_tpu.k8s.artifacts import load_cluster_api
+
+        res = load_cluster_api()
+        by_kind = {}
+        for r in res:
+            by_kind.setdefault(r.kind, []).append(r)
+        assert [p.name for p in by_kind["Pod"]] == ["web"]
+        assert by_kind["Pod"][0].images == ["nginx:1.25"]
+        assert [d.name for d in by_kind["Deployment"]] == ["api"]
+        assert "ClusterRole" in by_kind
+
+    def test_no_credentials_raises(self, tmp_path, monkeypatch):
+        from trivy_tpu.k8s.client import KubeClient, KubeError
+
+        monkeypatch.setenv("KUBECONFIG", str(tmp_path / "absent"))
+        with pytest.raises(KubeError):
+            KubeClient()

@@ -229,3 +229,47 @@ class TestLicenseFileAnalyzer:
         res = a.analyze(AnalysisInput("LICENSE", MIT_TEXT.encode()))
         assert res is not None
         assert res.licenses[0].findings[0].name == "MIT"
+
+
+class TestNgramClassifier:
+    """r4: token-ngram matching (reference licenseclassifier v2 shape) —
+    tolerant of reflowed/edited text where exact phrase search fails."""
+
+    def test_edited_mit_still_classifies(self):
+        from trivy_tpu.licensing.classifier import classify
+
+        # word substitutions + reflow: exact phrase matching would fail
+        text = (
+            "Permission is hereby granted, free of charge, to any\n"
+            "person obtaining one copy of this software and associated\n"
+            "documentation, subject to the following conditions apply.\n"
+            "THE SOFTWARE IS PROVIDED 'AS IS', WITHOUT WARRANTY OF ANY\n"
+            "KIND, express or implied.\n"
+        )
+        lf = classify("LICENSE", text.encode(), confidence_level=0.5)
+        assert lf is not None
+        assert lf.findings[0].name == "MIT"
+        assert 0.5 <= lf.findings[0].confidence < 1.0
+
+    def test_unrelated_text_no_match(self):
+        from trivy_tpu.licensing.classifier import classify
+
+        assert classify("README", b"just a readme about nothing "
+                        b"with many ordinary words" * 10) is None
+
+    def test_custom_corpus_extension(self):
+        from trivy_tpu.licensing.classifier import (
+            add_license_text,
+            classify,
+        )
+
+        add_license_text("Corp-1.0", (
+            "the corp proprietary license version one grants the "
+            "receiving party a limited revocable right to evaluate "
+            "this software within corp premises only"))
+        lf = classify("LICENSE", (
+            b"The Corp proprietary license version one grants the "
+            b"receiving party a limited revocable right to evaluate "
+            b"this software within Corp premises only."))
+        assert lf is not None
+        assert any(f.name == "Corp-1.0" for f in lf.findings)

@@ -245,3 +245,102 @@ def post_scan(results, options):
         assert "mymod.py" in capsys.readouterr().out
         assert main(["module", "uninstall", "mymod",
                      "--cache-dir", cache]) == 0
+
+
+class TestPluginIndexAndOCI:
+    """r4: index resolution + OCI install (reference manager.go:99-101)."""
+
+    def _index_yaml(self):
+        return (
+            "plugins:\n"
+            "  - name: referrer\n"
+            "    repository: localhost:5000/plugins/referrer:latest\n"
+            "    summary: look up referrers\n"
+            "  - name: count\n"
+            "    repository: ghcr.io/org/count:1.0\n"
+            "    summary: count findings\n")
+
+    def test_index_search_and_resolution(self, tmp_path):
+        import os
+
+        from trivy_tpu.plugin.manager import PluginManager
+
+        mgr = PluginManager(str(tmp_path))
+        assert mgr.index() == []
+        os.makedirs(mgr.root, exist_ok=True)
+        with open(mgr.index_path, "w") as f:
+            f.write(self._index_yaml())
+        assert [p["name"] for p in mgr.index()] == ["referrer", "count"]
+        assert [p["name"] for p in mgr.search("count")] == ["count"]
+        assert mgr._resolve_index_name("referrer") == \
+            "localhost:5000/plugins/referrer:latest"
+        assert mgr._resolve_index_name("unknown") == "unknown"
+
+    def test_oci_install_from_fake_registry(self, tmp_path):
+        import gzip
+        import hashlib
+        import http.server
+        import io
+        import json as _json
+        import os
+        import tarfile
+        import threading
+
+        from trivy_tpu.plugin.manager import PluginManager
+
+        # plugin layer: tar.gz holding plugin.yaml + a script
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            manifest_y = (
+                "name: hello\nversion: 0.1.0\nsummary: test plugin\n"
+                "platforms:\n  - selector: {os: linux, arch: amd64}\n"
+                "    uri: ''\n    bin: ./hello.sh\n").encode()
+            for fn, data in (("plugin.yaml", manifest_y),
+                             ("hello.sh", b"#!/bin/sh\necho hi\n")):
+                info = tarfile.TarInfo(fn)
+                info.size = len(data)
+                info.mode = 0o755
+                tf.addfile(info, io.BytesIO(data))
+        layer = gzip.compress(buf.getvalue())
+        layer_digest = "sha256:" + hashlib.sha256(layer).hexdigest()
+        manifest = _json.dumps({
+            "schemaVersion": 2,
+            "layers": [{
+                "mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+                "digest": layer_digest, "size": len(layer)}],
+        }).encode()
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.endswith("/manifests/latest"):
+                    body, ctype = manifest, \
+                        "application/vnd.oci.image.manifest.v1+json"
+                elif self.path.endswith(f"/blobs/{layer_digest}"):
+                    body, ctype = layer, "application/octet-stream"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = http.server.ThreadingHTTPServer(("localhost", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            port = srv.server_address[1]
+            mgr = PluginManager(str(tmp_path))
+            plugin = mgr.install(f"localhost:{port}/tools/hello:latest",
+                                 insecure=True)
+            assert plugin.name == "hello"
+            assert os.path.exists(
+                os.path.join(mgr._dir("hello"), "hello.sh"))
+            assert mgr.get("hello") is not None
+        finally:
+            srv.shutdown()
+            srv.server_close()

@@ -42,6 +42,16 @@ class ContainerdError(Exception):
     pass
 
 
+def _host_arch() -> str:
+    """Host architecture in OCI platform terms (the image variant that
+    actually runs on this node is the one to scan)."""
+    import platform as _plat
+
+    machine = _plat.machine().lower()
+    return {"x86_64": "amd64", "aarch64": "arm64",
+            "arm64": "arm64", "amd64": "amd64"}.get(machine, machine)
+
+
 def containerd_root() -> str:
     return os.environ.get("CONTAINERD_ROOT", DEFAULT_ROOT)
 
@@ -91,9 +101,10 @@ class ContainerdImage:
         manifest = json.loads(self._blob(digest))
         if media in _MANIFEST_LIST_TYPES or "manifests" in manifest:
             chosen = None
+            host_arch = _host_arch()
             for m in manifest.get("manifests", []):
                 plat = m.get("platform") or {}
-                if plat.get("architecture") in ("amd64", ""):
+                if plat.get("architecture") in (host_arch, ""):
                     chosen = m
                     break
             if chosen is None and manifest.get("manifests"):

@@ -175,7 +175,7 @@ def build_parser() -> argparse.ArgumentParser:
                            help="image tar archive path")
             p.add_argument("--image-src", default="containerd,docker,podman,remote",
                            help="comma-separated image sources tried in "
-                                "order (docker,podman,remote)")
+                                "order (containerd,docker,podman,remote)")
             p.add_argument("--insecure", action="store_true",
                            help="allow plain-HTTP / unverified registries")
             p.add_argument("--username", default=os.environ.get(

@@ -171,10 +171,15 @@ def _parse_duration(spec: str | None) -> float:
     garbage is an error, not silently dropped."""
     import re as _re
 
+    import math
+
     if not spec:
         return 300.0
     try:
-        return float(spec)
+        v = float(spec)
+        if not math.isfinite(v) or v <= 0:
+            raise FatalError(f"invalid --timeout {spec!r}")
+        return v
     except ValueError:
         pass
     unit_rx = r"(\d+(?:\.\d+)?)(ms|h|m|s)"

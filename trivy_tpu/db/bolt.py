@@ -110,8 +110,17 @@ class Bucket:
 
 class BoltDB:
     def __init__(self, path: str):
-        with open(path, "rb") as f:
-            self.data = memoryview(f.read())
+        import mmap
+
+        # mmap, not read(): real trivy-db artifacts are hundreds of MB
+        # and access is page-at-offset — no reason to copy the file
+        self._f = open(path, "rb")
+        try:
+            self.data = memoryview(mmap.mmap(
+                self._f.fileno(), 0, access=mmap.ACCESS_READ))
+        except ValueError:  # empty file
+            self._f.close()
+            raise BoltError(f"{path} is not a boltdb file")
         def read_meta(off: int):
             if off + PAGE_HEADER.size + META.size > len(self.data):
                 return None

@@ -48,9 +48,16 @@ class JavaDB:
     def __init__(self, path: str | None = None):
         self.path = path
         self._conn: sqlite3.Connection | None = None
+        self._upstream = False  # real trivy-java-db schema
         if path and os.path.exists(path):
             self._conn = sqlite3.connect(f"file:{path}?mode=ro", uri=True,
                                          check_same_thread=False)
+            tables = {r[0] for r in self._conn.execute(
+                "SELECT name FROM sqlite_master WHERE type='table'")}
+            # the real trivy-java-db splits artifacts(id,group,artifact)
+            # from indices(artifact_id,version,sha1 BLOB,archive_type);
+            # it is consumed natively, no conversion step
+            self._upstream = "indices" in tables
 
     # ------------------------------------------------------------ build
 
@@ -72,6 +79,7 @@ class JavaDB:
         db = cls.__new__(cls)
         db.path = path
         db._conn = conn
+        db._upstream = False
         return db
 
     def import_entries(self, entries) -> int:
@@ -100,6 +108,12 @@ class JavaDB:
     def search_by_sha1(self, sha1: str) -> GAV | None:
         if self._conn is None:
             return None
+        if self._upstream:
+            row = self._conn.execute(
+                "SELECT a.group_id, a.artifact_id, i.version "
+                "FROM indices i JOIN artifacts a ON a.id = i.artifact_id "
+                "WHERE i.sha1 = ?", (bytes.fromhex(sha1),)).fetchone()
+            return GAV(*row) if row else None
         row = self._conn.execute(
             "SELECT group_id, artifact_id, version FROM artifacts "
             "WHERE sha1 = ?", (sha1.lower(),)).fetchone()
@@ -112,10 +126,17 @@ class JavaDB:
         reference heuristic (parse.go:138-140)."""
         if self._conn is None:
             return None
-        rows = self._conn.execute(
-            "SELECT DISTINCT group_id FROM artifacts "
-            "WHERE artifact_id = ? AND version = ? LIMIT 2",
-            (artifact_id, version)).fetchall()
+        if self._upstream:
+            rows = self._conn.execute(
+                "SELECT DISTINCT a.group_id FROM artifacts a "
+                "JOIN indices i ON a.id = i.artifact_id "
+                "WHERE a.artifact_id = ? AND i.version = ? LIMIT 2",
+                (artifact_id, version)).fetchall()
+        else:
+            rows = self._conn.execute(
+                "SELECT DISTINCT group_id FROM artifacts "
+                "WHERE artifact_id = ? AND version = ? LIMIT 2",
+                (artifact_id, version)).fetchall()
         if len(rows) == 1:
             return rows[0][0]
         return None
@@ -123,7 +144,9 @@ class JavaDB:
     def stats(self) -> dict:
         if self._conn is None:
             return {"artifacts": 0}
-        n = self._conn.execute("SELECT COUNT(*) FROM artifacts").fetchone()[0]
+        table = "indices" if self._upstream else "artifacts"
+        n = self._conn.execute(
+            f"SELECT COUNT(*) FROM {table}").fetchone()[0]
         return {"artifacts": n}
 
     def close(self) -> None:

@@ -140,12 +140,17 @@ def kubectl_available() -> bool:
 
 def load_cluster(context: str = "", namespace: str = "",
                  kinds: tuple = _KUBECTL_KINDS) -> list[KubeResource]:
-    """Enumerate a live cluster through kubectl (the reference uses
-    client-go; a subprocess keeps this dependency-free and auth flows
-    through the user's kubeconfig)."""
+    """Enumerate a live cluster: the API-server client first (kubeconfig
+    or in-cluster service account, reference client-go), kubectl as a
+    last-resort fallback."""
+    try:
+        return load_cluster_api(context, namespace, kinds)
+    except Exception as e:
+        _log.debug("api client unavailable, trying kubectl", err=str(e))
     if not kubectl_available():
         raise RuntimeError(
-            "kubectl not found; scan a manifests directory instead")
+            "no kubeconfig/in-cluster credentials and no kubectl; "
+            "scan a manifests directory instead")
     out: list[KubeResource] = []
     for kind in kinds:
         cmd = ["kubectl", "get", kind, "-o", "json"]
@@ -162,4 +167,54 @@ def load_cluster(context: str = "", namespace: str = "",
                        err=proc.stderr.decode("utf-8", "replace")[:200])
             continue
         out.extend(parse_manifest_docs(proc.stdout))
+    return out
+
+
+# kubectl plural -> API object Kind
+_PLURAL_KIND = {
+    "pods": "Pod", "deployments": "Deployment",
+    "statefulsets": "StatefulSet", "daemonsets": "DaemonSet",
+    "replicasets": "ReplicaSet", "jobs": "Job", "cronjobs": "CronJob",
+    "services": "Service", "configmaps": "ConfigMap",
+    "roles": "Role", "clusterroles": "ClusterRole",
+    "rolebindings": "RoleBinding",
+    "clusterrolebindings": "ClusterRoleBinding",
+    "nodes": "Node",
+}
+
+
+def load_cluster_api(context: str = "", namespace: str = "",
+                     kinds: tuple = _KUBECTL_KINDS) -> list[KubeResource]:
+    """Enumerate a live cluster through the API server directly
+    (trivy_tpu.k8s.client; no kubectl subprocess)."""
+    from trivy_tpu.k8s.client import API_PATHS, KubeClient
+
+    client = KubeClient(context=context)
+    out: list[KubeResource] = []
+    errors = 0
+    attempted = 0
+    for plural in kinds:
+        kind = _PLURAL_KIND.get(plural, plural)
+        if kind not in API_PATHS:
+            continue
+        attempted += 1
+        try:
+            items = client.list(kind, namespace=namespace)
+        except Exception as e:
+            _log.debug("list failed", kind=kind, err=str(e))
+            errors += 1
+            continue
+        for item in items:
+            meta = item.get("metadata") or {}
+            out.append(KubeResource(
+                kind=item.get("kind", kind),
+                name=meta.get("name", ""),
+                namespace=meta.get("namespace", ""),
+                raw=item,
+            ))
+    if not out and errors == attempted and attempted:
+        # every list failed (e.g. exec-based kubeconfig auth this client
+        # doesn't speak): surface the failure so load_cluster can fall
+        # back to kubectl, which does support it
+        raise RuntimeError("all API list calls failed (unsupported auth?)")
     return out

@@ -2,12 +2,17 @@
 
 The reference delegates to google/licenseclassifier v2
 (pkg/licensing/classifier.go:36-87), a token-ngram matcher over the
-SPDX corpus.  Shipping that corpus is out of scope here; instead this
-module classifies by (a) explicit `SPDX-License-Identifier:` tags and
-(b) distinctive-phrase fingerprints for the licenses that dominate real
-artifacts.  Confidence = fraction of a license's fingerprint phrases
-found in the normalized text; findings below the confidence level are
-dropped, mirroring classifier.go:57-60.
+SPDX corpus.  Shipping the full corpus is out of scope here; the same
+ALGORITHM runs over distinctive excerpts of the licenses that dominate
+real artifacts: each license compiles to a set of word trigrams, a
+document's trigram set is intersected with it, and confidence is the
+contained fraction — tolerant of reflowed text, punctuation and small
+edits, unlike exact phrase search.  Explicit `SPDX-License-Identifier:`
+tags classify at confidence 1.0.  Findings below the confidence level
+are dropped, mirroring classifier.go:57-60.
+
+Custom corpora extend coverage: `add_license_text(name, text)` compiles
+any license body into the matcher at runtime.
 """
 
 from __future__ import annotations
@@ -146,6 +151,37 @@ _FINGERPRINTS: dict[str, list[str]] = {
 
 _NORM_RE = re.compile(r"[^a-z0-9]+")
 
+_NGRAM = 3
+
+
+def _ngrams(text: str) -> set[tuple[str, ...]]:
+    words = text.split()
+    if len(words) < _NGRAM:
+        return {tuple(words)} if words else set()
+    return {tuple(words[i:i + _NGRAM])
+            for i in range(len(words) - _NGRAM + 1)}
+
+
+_GRAM_SETS: dict[str, set] = {}
+
+
+def _gram_set(name: str) -> set:
+    """Compiled word-trigram set of a license's excerpt corpus."""
+    grams = _GRAM_SETS.get(name)
+    if grams is None:
+        grams = set()
+        for phrase in _FINGERPRINTS.get(name, ()):
+            grams |= _ngrams(phrase)
+        _GRAM_SETS[name] = grams
+    return grams
+
+
+def add_license_text(name: str, text: str) -> None:
+    """Extend the matcher with a license body (user corpus)."""
+    _FINGERPRINTS.setdefault(name, []).append(
+        _NORM_RE.sub(" ", text.lower()).strip())
+    _GRAM_SETS.pop(name, None)
+
 
 def _finding(name: str, confidence: float) -> LicenseFinding:
     return LicenseFinding(
@@ -181,11 +217,14 @@ def classify(file_path: str, content: bytes | str,
 
     norm = _normalize_text(raw)
     if norm:
-        for name, phrases in _FINGERPRINTS.items():
+        doc_grams = _ngrams(norm)
+        for name in _FINGERPRINTS:
             if name in seen:
                 continue
-            hits = sum(1 for p in phrases if p in norm)
-            conf = hits / len(phrases)
+            grams = _gram_set(name)
+            if not grams:
+                continue
+            conf = len(grams & doc_grams) / len(grams)
             if conf >= confidence_level:
                 seen.add(name)
                 findings.append(_finding(name, round(conf, 2)))

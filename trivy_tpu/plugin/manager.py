@@ -138,9 +138,50 @@ class PluginManager:
 
     # ---------------------------------------------------------- install
 
+    DEFAULT_INDEX_URL = ("https://aquasecurity.github.io/"
+                         "trivy-plugin-index/v1/index.yaml")
+
+    @property
+    def index_path(self) -> str:
+        return os.path.join(self.root, "index.yaml")
+
+    def update_index(self, url: str = "") -> None:
+        """Download the plugin index (reference manager.go index.yaml)."""
+        url = url or self.DEFAULT_INDEX_URL
+        with urllib.request.urlopen(url, timeout=60) as resp:
+            data = resp.read()
+        os.makedirs(self.root, exist_ok=True)
+        with open(self.index_path, "wb") as f:
+            f.write(data)
+        _log.info("plugin index updated", url=url)
+
+    def index(self) -> list[dict]:
+        """Cached index entries: [{name, repository, summary, ...}]."""
+        if not os.path.exists(self.index_path):
+            return []
+        with open(self.index_path, encoding="utf-8") as f:
+            doc = yaml.safe_load(f) or {}
+        return doc.get("plugins") or []
+
+    def search(self, keyword: str = "") -> list[dict]:
+        kw = keyword.lower()
+        return [p for p in self.index()
+                if kw in (p.get("name", "") + p.get("summary", "")).lower()]
+
+    def _resolve_index_name(self, name: str) -> str:
+        """Bare plugin name -> its repository via the cached index
+        (reference tryIndex, manager.go:101)."""
+        for p in self.index():
+            if p.get("name") == name and p.get("repository"):
+                _log.info("plugin found in the index", name=name,
+                          repository=p["repository"])
+                return p["repository"]
+        return name
+
     def install(self, source: str, insecure: bool = False) -> Plugin:
-        """source: local dir with plugin.yaml, local .zip, or http(s) URL
-        to a zip (reference manager.go:99)."""
+        """source: local dir with plugin.yaml, local .zip, http(s) URL to
+        a zip, an OCI reference (registry/repo:tag), or a bare index name
+        (reference manager.go:99)."""
         if os.path.isdir(source):
             return self._install_dir(source)
         if source.endswith(".zip") and os.path.exists(source):
@@ -156,9 +197,58 @@ class PluginManager:
                 return self._install_zip(tmp)
             finally:
                 os.unlink(tmp)
+        if re.fullmatch(r"[A-Za-z0-9][A-Za-z0-9._-]*", source):
+            source = self._resolve_index_name(source)
+        if "/" in source:  # OCI reference
+            return self._install_oci(source, insecure=insecure)
         raise PluginError(
             f"unsupported plugin source {source!r} "
-            "(local dir, .zip, or http(s) URL)")
+            "(local dir, .zip, http(s) URL, OCI ref, or index name)")
+
+    def _install_oci(self, ref: str, insecure: bool = False) -> Plugin:
+        """Pull a plugin OCI artifact: every tar(.gz) layer unpacks into
+        the staging dir, which must yield a plugin.yaml."""
+        from trivy_tpu.artifact.image_source import (
+            RegistryClient,
+            SourceError,
+            parse_reference,
+        )
+
+        registry, repo, tag, digest = parse_reference(ref)
+        tmp = os.path.join(self.root, ".oci-unpack")
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            client = RegistryClient(registry, insecure=insecure)
+            try:
+                manifest, _ = client.manifest(repo, digest or tag)
+            except SourceError as e:
+                raise PluginError(f"plugin OCI manifest {ref}: {e}")
+            import gzip as _gzip
+            import io
+            import tarfile
+
+            for layer in manifest.get("layers") or []:
+                try:
+                    data = client.blob(repo, layer.get("digest", ""))
+                except SourceError as e:
+                    raise PluginError(f"plugin OCI blob {ref}: {e}")
+                if data[:2] == b"\x1f\x8b":
+                    data = _gzip.decompress(data)
+                if not tarfile.is_tarfile(io.BytesIO(data)):
+                    continue
+                with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+                    try:
+                        # the "data" filter rejects absolute paths, ..
+                        # traversal and escaping links member-by-member
+                        tf.extractall(tmp, filter="data")
+                    except tarfile.TarError as e:
+                        raise PluginError(
+                            f"unsafe path in plugin layer: {e}")
+            return self._install_dir(tmp)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
 
     def _install_dir(self, source: str) -> Plugin:
         manifest = os.path.join(source, "plugin.yaml")

@@ -32,6 +32,13 @@ INDEX_FILE = "index.json"
 DEFAULT_REPO_URL = "https://github.com/aquasecurity/vexhub"
 
 
+def _version_sort_key(name: str):
+    try:
+        return (1, tuple(int(p) for p in name.split(".")))
+    except ValueError:
+        return (0, name)
+
+
 @dataclass
 class Repository:
     name: str = ""
@@ -41,9 +48,13 @@ class Repository:
 
     def index(self) -> dict[str, dict] | None:
         """-> {package id: {"location": ..., "format": ...}} or None when
-        the repository has never been cached."""
+        the repository has never been cached. With several cached spec
+        versions, the highest version's index wins (deterministic, never
+        a stale directory os.walk happened to visit first)."""
         path = None
-        for root, _dirs, fns in os.walk(self.dir):
+        for root, dirs, fns in os.walk(self.dir):
+            # visit version dirs newest-first ("0.10" > "0.9" numerically)
+            dirs.sort(key=_version_sort_key, reverse=True)
             if INDEX_FILE in fns:
                 path = os.path.join(root, INDEX_FILE)
                 break
