@@ -195,7 +195,7 @@ def main():
 
     # warm up: jit compile at the crawl's bucket shapes (head AND tail
     # batch sizes round to different buckets) + fill encode caches
-    batch = 65536
+    batch = 131072
     engine.detect(queries[:batch])
     tail = n_q % batch or batch
     engine.detect(queries[-tail:])
@@ -220,8 +220,9 @@ def main():
     if ddb is not None:
         m.match_batch(ddb, pb)
     device_s = time.time() - t0  # kernel + bitmask transfer to host
-    # actual bytes crossing the link: the batch is padded to its bucket
-    transfer_bytes = m._bucket(len(uniq)) * _words(cdb.window) * 4
+    # bucket padding is sliced off on device, so the link carries only
+    # the real batch's words
+    transfer_bytes = len(uniq) * _words(cdb.window) * 4
 
     # host post-process (bit->row mapping, token screen, dedupe, split):
     # full unique-batch detect minus the encode+device stages

@@ -324,3 +324,101 @@ spec:
   containers: [{name: c, image: x:1}]
 """)
         assert "KSV037" in failed
+
+
+class TestGCPChecks:
+    """r4: google provider terraform checks (reference
+    pkg/iac/adapters/terraform/google)."""
+
+    def _fails(self, tf: bytes) -> set[str]:
+        from trivy_tpu.misconf.scanner import scan_config
+
+        m = scan_config("main.tf", tf)
+        return {f.id for f in (m.failures if m else [])}
+
+    def test_public_bucket_member(self):
+        fails = self._fails(b'''
+resource "google_storage_bucket_iam_member" "pub" {
+  bucket = "b"
+  role = "roles/storage.objectViewer"
+  member = "allUsers"
+}
+''')
+        assert "AVD-GCP-0001" in fails
+
+    def test_open_firewall_and_uniform_access(self):
+        fails = self._fails(b'''
+resource "google_compute_firewall" "fw" {
+  source_ranges = ["0.0.0.0/0"]
+  allow { protocol = "tcp"
+          ports = ["22"] }
+}
+resource "google_storage_bucket" "b" { name = "data" }
+''')
+        assert "AVD-GCP-0027" in fails
+        assert "AVD-GCP-0002" in fails
+
+    def test_sql_and_gke(self):
+        fails = self._fails(b'''
+resource "google_sql_database_instance" "db" {
+  settings {
+    ip_configuration {
+      ipv4_enabled = true
+    }
+  }
+}
+resource "google_container_cluster" "gke" {
+  enable_legacy_abac = true
+}
+''')
+        assert "AVD-GCP-0017" in fails
+        assert "AVD-GCP-0015" in fails
+        assert "AVD-GCP-0064" in fails
+        assert "AVD-GCP-0059" in fails
+
+    def test_hardened_resources_pass(self):
+        fails = self._fails(b'''
+resource "google_storage_bucket" "b" {
+  name = "data"
+  uniform_bucket_level_access = true
+}
+resource "google_sql_database_instance" "db" {
+  settings {
+    ip_configuration {
+      ipv4_enabled = false
+      require_ssl = true
+    }
+  }
+}
+resource "google_container_cluster" "gke" {
+  private_cluster_config { enable_private_nodes = true }
+  network_policy { enabled = true }
+}
+''')
+        assert not fails & {"AVD-GCP-0002", "AVD-GCP-0017",
+                            "AVD-GCP-0015", "AVD-GCP-0059",
+                            "AVD-GCP-0064"}
+
+    def test_unresolved_values_stay_silent(self):
+        """r4 review: unresolved var references must not fail checks."""
+        fails = self._fails(b'''
+variable "uniform" {}
+resource "google_storage_bucket" "b" {
+  uniform_bucket_level_access = var.uniform
+}
+resource "google_sql_database_instance" "db" {
+  settings { ip_configuration { ipv4_enabled = var.pub
+                                require_ssl = var.tls } }
+}
+''')
+        assert not fails & {"AVD-GCP-0002", "AVD-GCP-0017",
+                            "AVD-GCP-0015"}
+
+    def test_disabled_network_policy_fails(self):
+        """r4 review: network_policy { enabled = false } is disabled."""
+        fails = self._fails(b'''
+resource "google_container_cluster" "gke" {
+  network_policy { enabled = false }
+}
+''')
+        assert "AVD-GCP-0061" in fails

@@ -70,6 +70,10 @@ class MatchEngine:
         # (adv_idx, version) -> bool rescreen verdict memo: the same
         # packages recur across artifacts of a crawl
         self._verdict_cache: dict[tuple[int, str], bool] = {}
+        # full per-query result memo for detect_many crawls: images share
+        # most of their packages, so across a registry crawl nearly every
+        # query after the first batches is a repeat
+        self._crawl_cache: dict[tuple, list[int]] = {}
         self._ddb_hot = None
         self._name_tokens: dict[tuple[str, str], int] | None = None
         self._adv_tok = None
@@ -225,7 +229,11 @@ class MatchEngine:
         *dispatched* to the device before the first result is collected,
         so device round-trips (over a possibly high-latency link) overlap
         the host post-processing of earlier batches. jax dispatch is
-        async — the Pending handles are futures."""
+        async — the Pending handles are futures.
+
+        Unique-query results memoize ACROSS batches: a registry crawl's
+        images share most of their packages, so later batches dispatch
+        only the queries never seen before."""
         if not self.use_device:
             out = []
             for i in range(0, len(queries), batch_size):
@@ -233,26 +241,37 @@ class MatchEngine:
             return out
         from collections import deque
 
+        cache = self._crawl_cache
+        inflight: set = set()  # dispatched but not yet flushed (FIFO
+        # flushing guarantees they are cached before any later batch
+        # that references them is flushed)
         results: list[MatchResult] = []
         pend: deque = deque()
 
         def flush_one():
-            qs, uniq, idx_map, ctx = pend.popleft()
-            uniq_hits = self._collect_unique(ctx)
-            if idx_map is None:
-                results.extend(
-                    MatchResult(q, h) for q, h in zip(qs, uniq_hits))
-            else:
-                results.extend(
-                    MatchResult(q, uniq_hits[idx_map[j]])
-                    for j, q in enumerate(qs))
+            qs, keys, ctx = pend.popleft()
+            fresh_hits = self._collect_unique(ctx) if ctx is not None \
+                else []
+            for k, h in zip(keys, fresh_hits):
+                cache[k] = h
+                inflight.discard(k)
+            results.extend(
+                MatchResult(q, cache[(q.space, q.name, q.version,
+                                      q.scheme_name)])
+                for q in qs)
 
         for i in range(0, len(queries), batch_size):
             qs = queries[i: i + batch_size]
-            uniq, idx_map = self.dedupe_queries(qs)
-            if len(uniq) == len(qs):
-                uniq, idx_map = qs, None
-            pend.append((qs, uniq, idx_map, self._dispatch_unique(uniq)))
+            fresh = []
+            keys = []
+            for q in qs:
+                k = (q.space, q.name, q.version, q.scheme_name)
+                if k not in cache and k not in inflight:
+                    fresh.append(q)
+                    keys.append(k)
+                    inflight.add(k)
+            ctx = self._dispatch_unique(fresh) if fresh else None
+            pend.append((qs, keys, ctx))
             while len(pend) >= depth:
                 flush_one()
         while pend:

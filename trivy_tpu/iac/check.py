@@ -74,6 +74,7 @@ def _load_builtins():
             azure,
             cloud,
             docker,
+            gcp,
             kubernetes,
         )
         _loaded = True

@@ -55,6 +55,9 @@ def _tf_tristate(b: Block, name: str, absent_default):
 
 def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
     out: list[CloudResource] = []
+    from trivy_tpu.iac.checks.gcp import adapt_terraform_gcp
+
+    out.extend(adapt_terraform_gcp(blocks))
     res_blocks = [b for b in blocks if b.type == "resource" and
                   len(b.labels) >= 2]
     # companion resources referenced by bucket: aws_s3_bucket_* attach

@@ -87,6 +87,11 @@ class DeviceDB:
     table: jax.Array  # int32[N, TABLE_LANES]
     n_rows: int
     window: int
+    # largest batch bucket dispatched so far: later (smaller) batches pad
+    # up to it so the jit cache keeps hitting — crawl-cache dedupe makes
+    # per-batch fresh counts vary wildly, and a fresh compile costs
+    # seconds while padded gather rows cost microseconds
+    bucket_floor: int = 0
 
     @classmethod
     def from_compiled(cls, cdb: CompiledDB, device=None) -> "DeviceDB":
@@ -195,8 +200,10 @@ class Pending:
     window: int
 
     def collect(self) -> np.ndarray:
-        """Block and -> bool[B, ceil32(W)] mask in original query order."""
-        mask_sorted = _unpack_words(np.asarray(self.words)[: self.b],
+        """Block and -> bool[B, ceil32(W)] mask in original query order.
+        The bucket-padding rows are sliced off ON DEVICE so only the real
+        batch's words cross the (possibly tunneled) link."""
+        mask_sorted = _unpack_words(np.asarray(self.words[: self.b]),
                                     self.window)
         mask = np.empty_like(mask_sorted)
         mask[self.order] = mask_sorted
@@ -208,7 +215,9 @@ def match_dispatch(ddb: DeviceDB, batch: PackageBatch) -> Pending | None:
     b = len(batch.h1)
     if ddb.n_rows == 0 or b == 0:
         return None
-    order, h1, h2, rank, flags = _sorted_padded(batch, _bucket(b))
+    bucket = max(_bucket(b), ddb.bucket_floor)
+    ddb.bucket_floor = bucket
+    order, h1, h2, rank, flags = _sorted_padded(batch, bucket)
     words = _match_kernel(
         ddb.h1, ddb.table,
         jnp.asarray(h1), jnp.asarray(h2),
@@ -319,7 +328,7 @@ class ShardedPending:
         """Block and -> bool[n_db, B, ceil32(W)] per-shard masks in the
         original query order."""
         w = _words(self.window) * 32
-        out = np.asarray(self.out)[:, : self.b]
+        out = np.asarray(self.out[:, : self.b])
         masks = np.empty((self.n_db, self.b, w), dtype=bool)
         for d in range(self.n_db):
             m = _unpack_words(out[d], self.window)
