@@ -422,3 +422,92 @@ resource "google_container_cluster" "gke" {
 }
 ''')
         assert "AVD-GCP-0061" in fails
+
+
+class TestExtendedAWSChecks:
+    """r4: cloudtrail/efs/eks/sqs/sns/elb/cloudfront terraform checks."""
+
+    def _fails(self, tf: bytes) -> set[str]:
+        from trivy_tpu.misconf.scanner import scan_config
+
+        m = scan_config("main.tf", tf)
+        return {f.id for f in (m.failures if m else [])}
+
+    def test_insecure_resources_fail(self):
+        fails = self._fails(b'''
+resource "aws_cloudtrail" "t" { name = "t" }
+resource "aws_efs_file_system" "f" {}
+resource "aws_eks_cluster" "e" { name = "c" }
+resource "aws_sqs_queue" "q" {}
+resource "aws_sns_topic" "n" {}
+resource "aws_lb_listener" "l" { protocol = "HTTP" }
+resource "aws_cloudfront_distribution" "cf" {
+  default_cache_behavior { viewer_protocol_policy = "allow-all" }
+}
+''')
+        assert {"AVD-AWS-0014", "AVD-AWS-0015", "AVD-AWS-0016",
+                "AVD-AWS-0037", "AVD-AWS-0040", "AVD-AWS-0096",
+                "AVD-AWS-0095", "AVD-AWS-0054",
+                "AVD-AWS-0012"} <= fails
+
+    def test_hardened_resources_pass(self):
+        fails = self._fails(b'''
+resource "aws_cloudtrail" "t" {
+  is_multi_region_trail = true
+  kms_key_id = "arn:aws:kms:key/1"
+  enable_log_file_validation = true
+}
+resource "aws_efs_file_system" "f" { encrypted = true }
+resource "aws_eks_cluster" "e" {
+  vpc_config { endpoint_public_access = false }
+}
+resource "aws_sqs_queue" "q" { sqs_managed_sse_enabled = true }
+resource "aws_sns_topic" "n" { kms_master_key_id = "alias/sns" }
+resource "aws_lb_listener" "l" { protocol = "HTTPS" }
+resource "aws_cloudfront_distribution" "cf" {
+  default_cache_behavior { viewer_protocol_policy = "redirect-to-https" }
+}
+''')
+        assert not fails & {"AVD-AWS-0014", "AVD-AWS-0015", "AVD-AWS-0016",
+                            "AVD-AWS-0037", "AVD-AWS-0040", "AVD-AWS-0096",
+                            "AVD-AWS-0095", "AVD-AWS-0054", "AVD-AWS-0012"}
+
+    def test_unresolved_encryption_silent(self):
+        fails = self._fails(b'''
+resource "aws_sqs_queue" "q" { kms_master_key_id = var.key }
+resource "aws_sns_topic" "n" { kms_master_key_id = var.key }
+''')
+        assert not fails & {"AVD-AWS-0096", "AVD-AWS-0095"}
+
+    def test_review_fixes_r4b(self):
+        """network_policy{} defaults DISABLED; dataplane v2 exempts 0061;
+        kms_key_id reference stays silent; ordered_cache_behavior counts."""
+        fails = self._fails(b'''
+resource "google_container_cluster" "c1" { network_policy {} }
+resource "google_container_cluster" "c2" {
+  datapath_provider = "ADVANCED_DATAPATH"
+}
+resource "aws_cloudtrail" "t" {
+  kms_key_id = aws_kms_key.trail.arn
+  is_multi_region_trail = true
+  enable_log_file_validation = true
+}
+resource "aws_sqs_queue" "q" { sqs_managed_sse_enabled = var.sse }
+resource "aws_cloudfront_distribution" "cf" {
+  default_cache_behavior { viewer_protocol_policy = "https-only" }
+  ordered_cache_behavior { viewer_protocol_policy = "allow-all" }
+}
+''')
+        assert "AVD-GCP-0061" in fails        # c1: block present, disabled
+        assert "AVD-AWS-0015" not in fails    # kms ref = configured
+        assert "AVD-AWS-0096" not in fails    # unresolved sse = unknown
+        assert "AVD-AWS-0012" in fails        # ordered behavior allow-all
+        # c2 (dataplane v2) must not be among the 0061 causes
+        from trivy_tpu.misconf.scanner import scan_config
+
+        m = scan_config("main.tf", b'''
+resource "google_container_cluster" "c2" {
+  datapath_provider = "ADVANCED_DATAPATH"
+}
+''')
+        assert "AVD-GCP-0061" not in {f.id for f in m.failures}

@@ -72,8 +72,10 @@ class MatchEngine:
         self._verdict_cache: dict[tuple[int, str], bool] = {}
         # full per-query result memo for detect_many crawls: images share
         # most of their packages, so across a registry crawl nearly every
-        # query after the first batches is a repeat
+        # query after the first batches is a repeat. Bounded so a
+        # long-lived server's RSS cannot climb with scan diversity.
         self._crawl_cache: dict[tuple, list[int]] = {}
+        self.crawl_cache_max = 2_000_000
         self._ddb_hot = None
         self._name_tokens: dict[tuple[str, str], int] | None = None
         self._adv_tok = None
@@ -252,6 +254,8 @@ class MatchEngine:
             qs, keys, ctx = pend.popleft()
             fresh_hits = self._collect_unique(ctx) if ctx is not None \
                 else []
+            if len(cache) + len(keys) > self.crawl_cache_max:
+                cache.clear()  # crude bound beats an unbounded server
             for k, h in zip(keys, fresh_hits):
                 cache[k] = h
                 inflight.discard(k)

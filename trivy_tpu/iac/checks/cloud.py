@@ -144,6 +144,59 @@ def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
                    "aws_iam_user_policy", "aws_iam_group_policy"):
             cr.type = "iam_policy"
             cr.attrs = {"document": _policy_doc(_tf_value(b.get("policy")))}
+        elif t == "aws_cloudtrail":
+            cr.type = "cloudtrail"
+            cr.attrs = {
+                "multi_region": _tf_tristate(
+                    b, "is_multi_region_trail", False),
+                "kms_key": _tf_value(b.get("kms_key_id")),
+                "kms_unknown": isinstance(b.get("kms_key_id"), Expr),
+                "log_validation": _tf_tristate(
+                    b, "enable_log_file_validation", False),
+            }
+        elif t == "aws_efs_file_system":
+            cr.type = "efs"
+            cr.attrs = {"encrypted": _tf_tristate(b, "encrypted", False)}
+        elif t == "aws_eks_cluster":
+            vpc = b.child("vpc_config")
+            cr.type = "eks_cluster"
+            cr.attrs = {
+                "public_access": _tf_tristate(
+                    vpc, "endpoint_public_access", True)
+                if vpc else True,
+                "public_cidrs": (_tf_value(vpc.get("public_access_cidrs"))
+                                 if vpc else None) or ["0.0.0.0/0"],
+            }
+        elif t == "aws_sqs_queue":
+            cr.type = "sqs_queue"
+            cr.attrs = {
+                "encrypted": bool(_tf_value(b.get("kms_master_key_id")))
+                or _tf_tristate(b, "sqs_managed_sse_enabled", False)
+                is True,
+                "unknown_enc": isinstance(
+                    b.get("kms_master_key_id"), Expr)
+                or isinstance(b.get("sqs_managed_sse_enabled"), Expr),
+            }
+        elif t == "aws_sns_topic":
+            cr.type = "sns_topic"
+            cr.attrs = {
+                "encrypted": bool(_tf_value(b.get("kms_master_key_id"))),
+                "unknown_enc": isinstance(
+                    b.get("kms_master_key_id"), Expr),
+            }
+        elif t in ("aws_lb_listener", "aws_alb_listener"):
+            cr.type = "lb_listener"
+            cr.attrs = {"protocol": _tf_value(b.get("protocol"))}
+        elif t == "aws_cloudfront_distribution":
+            # every cache behavior counts (reference adapts
+            # ordered_cache_behavior blocks too)
+            policies = []
+            for cb in (b.children("default_cache_behavior")
+                       + b.children("ordered_cache_behavior")):
+                policies.append(_tf_value(
+                    cb.get("viewer_protocol_policy")))
+            cr.type = "cloudfront"
+            cr.attrs = {"viewer_protocols": policies}
         else:
             continue
         out.append(cr)
@@ -571,3 +624,112 @@ def plan_apply_public_access_blocks(doc: dict,
         if cr.type == "s3_bucket" and \
                 str(cr.attrs.get("bucket_name") or "") in protected:
             cr.attrs["public_access_block"] = True
+
+
+@check("AVD-AWS-0014", "CloudTrail is not a multi-region trail",
+       severity="MEDIUM", file_types=_C, provider="aws",
+       service="cloudtrail", resolution="Enable is_multi_region_trail")
+def cloudtrail_multi_region(ctx):
+    out = []
+    for r in _of_type(ctx, "cloudtrail"):
+        if r.attrs.get("multi_region") is False:
+            out.append(r.cause("Trail is not a multi-region trail"))
+    return out
+
+
+@check("AVD-AWS-0015", "CloudTrail is not encrypted with a customer key",
+       severity="HIGH", file_types=_C, provider="aws",
+       service="cloudtrail", resolution="Set kms_key_id")
+def cloudtrail_encryption(ctx):
+    out = []
+    for r in _of_type(ctx, "cloudtrail"):
+        # kms_key_id = aws_kms_key.x.arn is the idiomatic form: an
+        # unresolved reference means a key IS configured — stay silent
+        if not r.attrs.get("kms_key") and not r.attrs.get("kms_unknown"):
+            out.append(r.cause("Trail is not encrypted with a CMK"))
+    return out
+
+
+@check("AVD-AWS-0016", "CloudTrail log file validation is disabled",
+       severity="HIGH", file_types=_C, provider="aws",
+       service="cloudtrail", resolution="Enable log file validation")
+def cloudtrail_validation(ctx):
+    out = []
+    for r in _of_type(ctx, "cloudtrail"):
+        if r.attrs.get("log_validation") is False:
+            out.append(r.cause("Trail does not have log validation "
+                               "enabled"))
+    return out
+
+
+@check("AVD-AWS-0037", "EFS file system is unencrypted", severity="HIGH",
+       file_types=_C, provider="aws", service="efs",
+       resolution="Enable encryption for the file system")
+def efs_encryption(ctx):
+    out = []
+    for r in _of_type(ctx, "efs"):
+        if r.attrs.get("encrypted") is False:
+            out.append(r.cause("File system is not encrypted"))
+    return out
+
+
+@check("AVD-AWS-0040", "EKS cluster endpoint is publicly accessible",
+       severity="CRITICAL", file_types=_C, provider="aws", service="eks",
+       resolution="Disable endpoint_public_access or restrict "
+                  "public_access_cidrs")
+def eks_public_endpoint(ctx):
+    out = []
+    for r in _of_type(ctx, "eks_cluster"):
+        if r.attrs.get("public_access") is True and \
+                "0.0.0.0/0" in (r.attrs.get("public_cidrs") or []):
+            out.append(r.cause(
+                "Cluster endpoint is publicly accessible from anywhere"))
+    return out
+
+
+@check("AVD-AWS-0096", "SQS queue is unencrypted", severity="HIGH",
+       file_types=_C, provider="aws", service="sqs",
+       resolution="Enable server-side encryption for the queue")
+def sqs_encryption(ctx):
+    out = []
+    for r in _of_type(ctx, "sqs_queue"):
+        if not r.attrs.get("encrypted") and not r.attrs.get("unknown_enc"):
+            out.append(r.cause("Queue is not encrypted"))
+    return out
+
+
+@check("AVD-AWS-0095", "SNS topic is unencrypted", severity="HIGH",
+       file_types=_C, provider="aws", service="sns",
+       resolution="Set kms_master_key_id on the topic")
+def sns_encryption(ctx):
+    out = []
+    for r in _of_type(ctx, "sns_topic"):
+        if not r.attrs.get("encrypted") and not r.attrs.get("unknown_enc"):
+            out.append(r.cause("Topic does not have encryption enabled"))
+    return out
+
+
+@check("AVD-AWS-0054", "Load balancer listener uses plain HTTP",
+       severity="CRITICAL", file_types=_C, provider="aws", service="elb",
+       resolution="Switch the listener to HTTPS/TLS")
+def lb_plain_http(ctx):
+    out = []
+    for r in _of_type(ctx, "lb_listener"):
+        if str(r.attrs.get("protocol") or "").upper() == "HTTP":
+            out.append(r.cause("Listener uses plain HTTP"))
+    return out
+
+
+@check("AVD-AWS-0012", "CloudFront distribution allows unencrypted "
+                       "viewer traffic", severity="HIGH", file_types=_C,
+       provider="aws", service="cloudfront",
+       resolution="Set viewer_protocol_policy to redirect-to-https or "
+                  "https-only")
+def cloudfront_viewer_policy(ctx):
+    out = []
+    for r in _of_type(ctx, "cloudfront"):
+        if any(str(p or "") == "allow-all"
+               for p in r.attrs.get("viewer_protocols") or []):
+            out.append(r.cause(
+                "Distribution allows unencrypted communications"))
+    return out

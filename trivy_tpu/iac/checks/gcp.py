@@ -5,6 +5,7 @@ CloudResource layer)."""
 from __future__ import annotations
 
 from trivy_tpu.iac.check import check
+from trivy_tpu.iac.parsers.hcl import Expr
 from trivy_tpu.iac.checks.cloud import (
     CloudResource,
     _tf_tristate,
@@ -72,10 +73,13 @@ def adapt_terraform_gcp(blocks) -> list[CloudResource]:
                 "private_nodes": _tf_tristate(
                     private, "enable_private_nodes", False)
                 if private else False,
-                # a network_policy block defaults to enabled; its
-                # "enabled" attribute can disable it explicitly
-                "network_policy": _tf_tristate(np_block, "enabled", True)
+                # the provider defaults network_policy.enabled to FALSE
+                # even when the block is present (reference gke adapt.go)
+                "network_policy": _tf_tristate(np_block, "enabled", False)
                 if np_block else False,
+                "datapath": _tf_val(b.get("datapath_provider")),
+                "datapath_unresolved": isinstance(
+                    b.get("datapath_provider"), Expr),
             }
         elif t == "google_compute_instance":
             cr.type = "gcp_instance"
@@ -191,6 +195,12 @@ def gke_private_nodes(ctx):
 def gke_network_policy(ctx):
     out = []
     for r in _of_type(ctx, "gke_cluster"):
+        # dataplane v2 enforces network policy without the block; an
+        # unresolved datapath_provider stays silent (unknown)
+        if str(r.attrs.get("datapath") or "") == "ADVANCED_DATAPATH":
+            continue
+        if r.attrs.get("datapath_unresolved"):
+            continue
         if r.attrs.get("network_policy") is False:
             out.append(r.cause("Cluster does not have a network policy"))
     return out

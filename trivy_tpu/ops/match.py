@@ -201,10 +201,12 @@ class Pending:
 
     def collect(self) -> np.ndarray:
         """Block and -> bool[B, ceil32(W)] mask in original query order.
-        The bucket-padding rows are sliced off ON DEVICE so only the real
-        batch's words cross the (possibly tunneled) link."""
-        mask_sorted = _unpack_words(np.asarray(self.words[: self.b]),
-                                    self.window)
+        Bucket padding is sliced off ON DEVICE so only ~the real batch's
+        words cross the (possibly tunneled) link; the slice length rounds
+        up to 128 rows so distinct batch sizes share compiled shapes."""
+        cut = min(-(-self.b // 128) * 128, self.words.shape[0])
+        mask_sorted = _unpack_words(
+            np.asarray(self.words[:cut])[: self.b], self.window)
         mask = np.empty_like(mask_sorted)
         mask[self.order] = mask_sorted
         return mask
@@ -328,7 +330,8 @@ class ShardedPending:
         """Block and -> bool[n_db, B, ceil32(W)] per-shard masks in the
         original query order."""
         w = _words(self.window) * 32
-        out = np.asarray(self.out[:, : self.b])
+        cut = min(-(-self.b // 128) * 128, self.out.shape[1])
+        out = np.asarray(self.out[:, :cut])[:, : self.b]
         masks = np.empty((self.n_db, self.b, w), dtype=bool)
         for d in range(self.n_db):
             m = _unpack_words(out[d], self.window)
