@@ -479,6 +479,174 @@ resource "aws_sns_topic" "n" { kms_master_key_id = var.key }
 ''')
         assert not fails & {"AVD-AWS-0096", "AVD-AWS-0095"}
 
+    def test_http_redirect_listener_exempt(self):
+        """An HTTP listener that redirects to HTTPS is the idiomatic
+        force-HTTPS setup and must not fire AVD-AWS-0054."""
+        fails = self._fails(b'''
+resource "aws_lb_listener" "http" {
+  protocol = "HTTP"
+  default_action {
+    type = "redirect"
+    redirect {
+      protocol    = "HTTPS"
+      status_code = "HTTP_301"
+    }
+  }
+}
+''')
+        assert "AVD-AWS-0054" not in fails
+
+    def test_cloudformation_coverage(self):
+        """The r4 checks fire from CloudFormation templates too (they
+        declare file_types including cloudformation)."""
+        import json as _json
+
+        from trivy_tpu.misconf.scanner import scan_config
+
+        doc = {
+            "AWSTemplateFormatVersion": "2010-09-09",
+            "Resources": {
+                "Trail": {"Type": "AWS::CloudTrail::Trail",
+                          "Properties": {}},
+                "Fs": {"Type": "AWS::EFS::FileSystem", "Properties": {}},
+                "Cluster": {"Type": "AWS::EKS::Cluster", "Properties": {}},
+                "Q": {"Type": "AWS::SQS::Queue", "Properties": {}},
+                "T": {"Type": "AWS::SNS::Topic", "Properties": {}},
+                "L": {"Type": "AWS::ElasticLoadBalancingV2::Listener",
+                      "Properties": {"Protocol": "HTTP"}},
+                "Cf": {"Type": "AWS::CloudFront::Distribution",
+                       "Properties": {"DistributionConfig": {
+                           "DefaultCacheBehavior": {
+                               "ViewerProtocolPolicy": "allow-all"}}}},
+            },
+        }
+        m = scan_config("template.json", _json.dumps(doc).encode())
+        fails = {f.id for f in (m.failures if m else [])}
+        assert {"AVD-AWS-0014", "AVD-AWS-0015", "AVD-AWS-0016",
+                "AVD-AWS-0037", "AVD-AWS-0040", "AVD-AWS-0096",
+                "AVD-AWS-0095", "AVD-AWS-0054", "AVD-AWS-0012"} <= fails
+        # hardened template stays silent (incl. redirect exemption)
+        doc2 = {
+            "AWSTemplateFormatVersion": "2010-09-09",
+            "Resources": {
+                "Trail": {"Type": "AWS::CloudTrail::Trail", "Properties": {
+                    "IsMultiRegionTrail": True,
+                    "KMSKeyId": {"Ref": "Key"},
+                    "EnableLogFileValidation": True}},
+                "Fs": {"Type": "AWS::EFS::FileSystem",
+                       "Properties": {"Encrypted": True}},
+                "Cluster": {"Type": "AWS::EKS::Cluster", "Properties": {
+                    "ResourcesVpcConfig": {
+                        "EndpointPublicAccess": False}}},
+                "Q": {"Type": "AWS::SQS::Queue",
+                      "Properties": {"SqsManagedSseEnabled": True}},
+                "T": {"Type": "AWS::SNS::Topic",
+                      "Properties": {"KmsMasterKeyId": "alias/x"}},
+                "L": {"Type": "AWS::ElasticLoadBalancingV2::Listener",
+                      "Properties": {"Protocol": "HTTP",
+                                     "DefaultActions": [{
+                                         "Type": "redirect",
+                                         "RedirectConfig": {
+                                             "Protocol": "HTTPS"}}]}},
+                "Cf": {"Type": "AWS::CloudFront::Distribution",
+                       "Properties": {"DistributionConfig": {
+                           "DefaultCacheBehavior": {
+                               "ViewerProtocolPolicy":
+                                   "redirect-to-https"}}}},
+            },
+        }
+        m = scan_config("template.json", _json.dumps(doc2).encode())
+        fails = {f.id for f in (m.failures if m else [])}
+        assert not fails & {"AVD-AWS-0014", "AVD-AWS-0015", "AVD-AWS-0016",
+                            "AVD-AWS-0037", "AVD-AWS-0040", "AVD-AWS-0096",
+                            "AVD-AWS-0095", "AVD-AWS-0054", "AVD-AWS-0012"}
+
+    def test_cfn_unresolved_intrinsics_silent(self):
+        """Boolean attrs set to unresolved intrinsics (Ref/Fn::If) are
+        unknown, not failing-False (review r4c)."""
+        import json as _json
+
+        from trivy_tpu.misconf.scanner import scan_config
+
+        doc = {
+            "AWSTemplateFormatVersion": "2010-09-09",
+            "Resources": {
+                "Trail": {"Type": "AWS::CloudTrail::Trail", "Properties": {
+                    "IsMultiRegionTrail": {"Ref": "MultiRegion"},
+                    "KMSKeyId": {"Ref": "Key"},
+                    "EnableLogFileValidation": {"Ref": "Validate"}}},
+                "Fs": {"Type": "AWS::EFS::FileSystem",
+                       "Properties": {"Encrypted": {"Ref": "Enc"}}},
+                "Cluster": {"Type": "AWS::EKS::Cluster", "Properties": {
+                    "ResourcesVpcConfig": {
+                        "EndpointPublicAccess": {"Fn::If": [
+                            "Cond", True, False]}}}},
+            },
+        }
+        m = scan_config("template.json", _json.dumps(doc).encode())
+        fails = {f.id for f in (m.failures if m else [])}
+        assert not fails & {"AVD-AWS-0014", "AVD-AWS-0015", "AVD-AWS-0016",
+                            "AVD-AWS-0037", "AVD-AWS-0040"}
+
+    def test_tfplan_coverage(self):
+        """The r4 checks fire from terraform plan JSON too."""
+        import json as _json
+
+        from trivy_tpu.misconf.scanner import scan_config
+
+        plan = {
+            "format_version": "1.2",
+            "terraform_version": "1.7.0",
+            "planned_values": {"root_module": {"resources": [
+                {"address": "aws_cloudtrail.t", "type": "aws_cloudtrail",
+                 "values": {"name": "t"}},
+                {"address": "aws_eks_cluster.e", "type": "aws_eks_cluster",
+                 "values": {"vpc_config": [{}]}},
+                {"address": "aws_lb_listener.l", "type": "aws_lb_listener",
+                 "values": {"protocol": "HTTP", "default_action": [
+                     {"type": "forward"}]}},
+                {"address": "aws_lb_listener.r", "type": "aws_lb_listener",
+                 "values": {"protocol": "HTTP", "default_action": [
+                     {"type": "redirect",
+                      "redirect": [{"protocol": "HTTPS"}]}]}},
+                {"address": "aws_cloudfront_distribution.cf",
+                 "type": "aws_cloudfront_distribution",
+                 "values": {"default_cache_behavior": [
+                     {"viewer_protocol_policy": "allow-all"}]}},
+            ]}},
+        }
+        m = scan_config("tfplan.json", _json.dumps(plan).encode())
+        fails = {f.id for f in (m.failures if m else [])}
+        assert {"AVD-AWS-0014", "AVD-AWS-0040", "AVD-AWS-0054",
+                "AVD-AWS-0012"} <= fails
+        # the redirect listener must be exempt: exactly one 0054 finding
+        n_0054 = sum(1 for f in (m.failures if m else [])
+                     if f.id == "AVD-AWS-0054")
+        assert n_0054 == 1
+
+    def test_eks_unresolved_cidrs_silent(self):
+        """Unresolved public_access_cidrs is unknown, not 0.0.0.0/0."""
+        fails = self._fails(b'''
+resource "aws_eks_cluster" "e" {
+  vpc_config { public_access_cidrs = var.allowed }
+}
+''')
+        assert "AVD-AWS-0040" not in fails
+        # restricted literal cidrs stay silent too
+        fails = self._fails(b'''
+resource "aws_eks_cluster" "e" {
+  vpc_config { public_access_cidrs = ["10.0.0.0/8"] }
+}
+''')
+        assert "AVD-AWS-0040" not in fails
+        # while an explicit open cidr still fails
+        fails = self._fails(b'''
+resource "aws_eks_cluster" "e" {
+  vpc_config { public_access_cidrs = ["0.0.0.0/0"] }
+}
+''')
+        assert "AVD-AWS-0040" in fails
+
     def test_review_fixes_r4b(self):
         """network_policy{} defaults DISABLED; dataplane v2 exempts 0061;
         kms_key_id reference stays silent; ordered_cache_behavior counts."""

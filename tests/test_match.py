@@ -161,6 +161,21 @@ def test_detect_many_pipelined_matches_detect(db):
     assert [r.adv_indices for r in b] == [r.adv_indices for r in oracle]
 
 
+def test_detect_many_cache_bound_survives(db):
+    """Regression (r4 review): tripping the crawl-cache RSS bound must
+    not break repeat-query lookups mid-crawl (the old mid-flush clear
+    raised KeyError for queries deduped against evicted entries)."""
+    engine = MatchEngine(db, window=32)
+    engine.crawl_cache_max = 8  # trip the bound constantly
+    queries = _random_queries(random.Random(5), n=600)
+    queries = queries + queries[:200]  # guaranteed repeats
+    b = engine.detect_many(queries, batch_size=64, depth=3)
+    oracle = engine.oracle_detect(queries)
+    assert [r.adv_indices for r in b] == [r.adv_indices for r in oracle]
+    # the bound is enforced between crawls
+    assert len(engine._crawl_cache) <= 8 or not engine._crawl_cache
+
+
 def test_npm_prerelease_inexact_key_in_subtracted_hull():
     """Regression (r4 review): an npm pre-release version with an INEXACT
     key (FLAG_NEEDS_HOST, no FLAG_RESCREEN) must still reach the

@@ -160,12 +160,17 @@ def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
         elif t == "aws_eks_cluster":
             vpc = b.child("vpc_config")
             cr.type = "eks_cluster"
+            # absent cidrs -> AWS default 0.0.0.0/0; present but
+            # unresolved (variable/expression) -> _tf_value gives None =
+            # unknown, so the check stays silent instead of false-positive
+            raw_cidrs = vpc.get("public_access_cidrs") if vpc else None
+            cidrs = ["0.0.0.0/0"] if raw_cidrs is None \
+                else _tf_value(raw_cidrs)
             cr.attrs = {
                 "public_access": _tf_tristate(
                     vpc, "endpoint_public_access", True)
                 if vpc else True,
-                "public_cidrs": (_tf_value(vpc.get("public_access_cidrs"))
-                                 if vpc else None) or ["0.0.0.0/0"],
+                "public_cidrs": cidrs,
             }
         elif t == "aws_sqs_queue":
             cr.type = "sqs_queue"
@@ -186,7 +191,18 @@ def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
             }
         elif t in ("aws_lb_listener", "aws_alb_listener"):
             cr.type = "lb_listener"
-            cr.attrs = {"protocol": _tf_value(b.get("protocol"))}
+            # an HTTP listener whose default action redirects to HTTPS is
+            # the idiomatic force-HTTPS setup and is exempt (reference
+            # avd-aws-0054 checks default action redirect protocol)
+            redirect_https = False
+            for act in b.children("default_action"):
+                if _tf_value(act.get("type")) == "redirect":
+                    red = act.child("redirect")
+                    proto = _tf_value(red.get("protocol")) if red else None
+                    if proto is None or str(proto).upper() == "HTTPS":
+                        redirect_https = True
+            cr.attrs = {"protocol": _tf_value(b.get("protocol")),
+                        "redirect_https": redirect_https}
         elif t == "aws_cloudfront_distribution":
             # every cache behavior counts (reference adapts
             # ordered_cache_behavior blocks too)
@@ -232,6 +248,24 @@ def _policy_doc(policy) -> dict | None:
 
 
 # ------------------------------------------------------------ cloudformation
+
+
+def _cfn_tristate(props: dict, key: str, default):
+    """CFN boolean attr -> True / False / None(=unknown, stay silent).
+    Mirrors _tf_tristate: an unresolved intrinsic must not read as a
+    definite failing value."""
+    v = props.get(key)
+    if v is None:
+        return default
+    if isinstance(v, dict):
+        v = cfn_scalar(v)
+        if v is None:
+            return None  # Ref / Fn::If etc. → unknown
+    if v in (True, "true", "True"):
+        return True
+    if v in (False, "false", "False"):
+        return False
+    return None
 
 
 def adapt_cloudformation(resources: dict[str, dict]) -> list[CloudResource]:
@@ -293,6 +327,78 @@ def adapt_cloudformation(resources: dict[str, dict]) -> list[CloudResource]:
                 "document": strip_lines(props.get("PolicyDocument"))
                 if isinstance(props.get("PolicyDocument"), dict) else None,
             }
+        elif rtype == "AWS::CloudTrail::Trail":
+            cr.type = "cloudtrail"
+            cr.attrs = {
+                "multi_region": _cfn_tristate(
+                    props, "IsMultiRegionTrail", False),
+                "kms_key": cfn_scalar(props.get("KMSKeyId")),
+                "kms_unknown": isinstance(props.get("KMSKeyId"), dict),
+                "log_validation": _cfn_tristate(
+                    props, "EnableLogFileValidation", False),
+            }
+        elif rtype == "AWS::EFS::FileSystem":
+            cr.type = "efs"
+            cr.attrs = {
+                "encrypted": _cfn_tristate(props, "Encrypted", False),
+            }
+        elif rtype == "AWS::EKS::Cluster":
+            cr.type = "eks_cluster"
+            rvc = props.get("ResourcesVpcConfig") or {}
+            cidrs_raw = rvc.get("PublicAccessCidrs")
+            if cidrs_raw is None:
+                cidrs: list | None = ["0.0.0.0/0"]
+            elif isinstance(cidrs_raw, dict):
+                cidrs = None  # intrinsic → unknown, stay silent
+            else:
+                cidrs = [cfn_scalar(c) for c in cidrs_raw if cfn_scalar(c)]
+            cr.attrs = {
+                "public_access": _cfn_tristate(
+                    rvc, "EndpointPublicAccess", True),
+                "public_cidrs": cidrs,
+            }
+        elif rtype == "AWS::SQS::Queue":
+            cr.type = "sqs_queue"
+            cr.attrs = {
+                "encrypted": bool(cfn_scalar(props.get("KmsMasterKeyId")))
+                or _cfn_tristate(props, "SqsManagedSseEnabled", False)
+                is True,
+                "unknown_enc": isinstance(props.get("KmsMasterKeyId"), dict)
+                or isinstance(props.get("SqsManagedSseEnabled"), dict),
+            }
+        elif rtype == "AWS::SNS::Topic":
+            cr.type = "sns_topic"
+            cr.attrs = {
+                "encrypted": bool(cfn_scalar(props.get("KmsMasterKeyId"))),
+                "unknown_enc": isinstance(props.get("KmsMasterKeyId"),
+                                          dict),
+            }
+        elif rtype == "AWS::ElasticLoadBalancingV2::Listener":
+            cr.type = "lb_listener"
+            redirect_https = False
+            for act in props.get("DefaultActions") or []:
+                if not isinstance(act, dict):
+                    continue
+                if str(cfn_scalar(act.get("Type")) or "").lower() == \
+                        "redirect":
+                    proto = cfn_scalar(
+                        (act.get("RedirectConfig") or {}).get("Protocol"))
+                    if proto is None or str(proto).upper() == "HTTPS":
+                        redirect_https = True
+            cr.attrs = {"protocol": cfn_scalar(props.get("Protocol")),
+                        "redirect_https": redirect_https}
+        elif rtype == "AWS::CloudFront::Distribution":
+            cr.type = "cloudfront"
+            dc = props.get("DistributionConfig") or {}
+            policies = []
+            dcb = dc.get("DefaultCacheBehavior")
+            if isinstance(dcb, dict):
+                policies.append(cfn_scalar(dcb.get("ViewerProtocolPolicy")))
+            for cb in dc.get("CacheBehaviors") or []:
+                if isinstance(cb, dict):
+                    policies.append(
+                        cfn_scalar(cb.get("ViewerProtocolPolicy")))
+            cr.attrs = {"viewer_protocols": policies}
         else:
             continue
         out.append(cr)
@@ -593,6 +699,69 @@ def _plan_resource(res: dict) -> CloudResource | None:
                "aws_iam_user_policy", "aws_iam_group_policy"):
         cr.type = "iam_policy"
         cr.attrs = {"document": _policy_doc(vals.get("policy"))}
+    elif t == "aws_cloudtrail":
+        cr.type = "cloudtrail"
+        cr.attrs = {
+            "multi_region": bool(vals.get("is_multi_region_trail")),
+            "kms_key": vals.get("kms_key_id"),
+            # plan values are already resolved; computed-but-unknown
+            # attrs are simply absent from the planned values
+            "kms_unknown": False,
+            "log_validation": bool(vals.get("enable_log_file_validation")),
+        }
+    elif t == "aws_efs_file_system":
+        cr.type = "efs"
+        cr.attrs = {"encrypted": bool(vals.get("encrypted"))}
+    elif t == "aws_eks_cluster":
+        cr.type = "eks_cluster"
+        vpcs = vals.get("vpc_config")
+        vpc = vpcs[0] if isinstance(vpcs, list) and vpcs else (
+            vpcs if isinstance(vpcs, dict) else {})
+        pub = vpc.get("endpoint_public_access")
+        cidrs = vpc.get("public_access_cidrs")
+        cr.attrs = {
+            "public_access": True if pub is None else bool(pub),
+            "public_cidrs": ["0.0.0.0/0"] if cidrs is None
+            else [c for c in cidrs if isinstance(c, str)],
+        }
+    elif t == "aws_sqs_queue":
+        cr.type = "sqs_queue"
+        cr.attrs = {
+            "encrypted": bool(vals.get("kms_master_key_id"))
+            or bool(vals.get("sqs_managed_sse_enabled")),
+            "unknown_enc": False,
+        }
+    elif t == "aws_sns_topic":
+        cr.type = "sns_topic"
+        cr.attrs = {
+            "encrypted": bool(vals.get("kms_master_key_id")),
+            "unknown_enc": False,
+        }
+    elif t in ("aws_lb_listener", "aws_alb_listener"):
+        cr.type = "lb_listener"
+        redirect_https = False
+        for act in vals.get("default_action") or []:
+            if not isinstance(act, dict) or act.get("type") != "redirect":
+                continue
+            reds = act.get("redirect")
+            red = reds[0] if isinstance(reds, list) and reds else (
+                reds if isinstance(reds, dict) else {})
+            proto = red.get("protocol")
+            if proto is None or str(proto).upper() == "HTTPS":
+                redirect_https = True
+        cr.attrs = {"protocol": vals.get("protocol"),
+                    "redirect_https": redirect_https}
+    elif t == "aws_cloudfront_distribution":
+        cr.type = "cloudfront"
+        policies = []
+        for key in ("default_cache_behavior", "ordered_cache_behavior"):
+            v = vals.get(key)
+            items = v if isinstance(v, list) else (
+                [v] if isinstance(v, dict) else [])
+            for cb in items:
+                if isinstance(cb, dict):
+                    policies.append(cb.get("viewer_protocol_policy"))
+        cr.attrs = {"viewer_protocols": policies}
     else:
         return None
     return cr
@@ -715,7 +884,8 @@ def sns_encryption(ctx):
 def lb_plain_http(ctx):
     out = []
     for r in _of_type(ctx, "lb_listener"):
-        if str(r.attrs.get("protocol") or "").upper() == "HTTP":
+        if str(r.attrs.get("protocol") or "").upper() == "HTTP" \
+                and not r.attrs.get("redirect_https"):
             out.append(r.cause("Listener uses plain HTTP"))
     return out
 

@@ -107,6 +107,11 @@ class PackageBatch:
     rank: np.ndarray  # int32[B]
     flags: np.ndarray  # int32[B]
     queries: list  # original (space, name, version, scheme_name)
+    # engine-interned (space,name) / (scheme,version) tokens, filled when
+    # the CompiledDB carries token dicts (saves the match engine a second
+    # per-query Python pass during result collection)
+    ntok: np.ndarray | None = None  # int64[B]
+    vtok: np.ndarray | None = None  # int64[B]
 
 
 @dataclass
@@ -142,6 +147,9 @@ class CompiledDB:
     # encode memo caches (same packages recur across a registry crawl)
     _hash_cache: dict = field(default_factory=dict, repr=False)
     _key_cache: dict = field(default_factory=dict, repr=False)
+    # token dicts injected by the match engine (see PackageBatch.ntok)
+    name_tokens: dict | None = field(default=None, repr=False)
+    version_tokens: dict | None = field(default=None, repr=False)
 
     @property
     def n_rows(self) -> int:
@@ -163,6 +171,10 @@ class CompiledDB:
         h2 = np.zeros(n, dtype=np.uint32)
         rank = np.zeros(n, dtype=np.int32)
         flags = np.zeros(n, dtype=np.int32)
+        ntoks = self.name_tokens
+        vtoks = self.version_tokens
+        ntok = np.empty(n, dtype=np.int64) if ntoks is not None else None
+        vtok = np.empty(n, dtype=np.int64) if vtoks is not None else None
 
         # per-scheme gather for batched ranking
         by_scheme: dict[str, tuple[list[int], list[bytes]]] = {}
@@ -172,7 +184,15 @@ class CompiledDB:
                 hk = join_key(space, name)
                 self._hash_cache[(space, name)] = hk
             h1[i], h2[i] = hk
+            if ntok is not None:
+                ntok[i] = ntoks.get((space, name), -2)
             ck = (scheme_name, version)
+            if vtok is not None:
+                t = vtoks.get(ck)
+                if t is None:
+                    t = len(vtoks)
+                    vtoks[ck] = t
+                vtok[i] = t
             ke = self._key_cache.get(ck)
             if ke is None:
                 ke = versioning.get_scheme(scheme_name).key(version)
@@ -193,7 +213,8 @@ class CompiledDB:
             if bounds is None or len(bounds) == 0:
                 continue
             rank[np.array(idxs)] = _ranks_of(bounds, keys)
-        return PackageBatch(h1, h2, rank, flags, queries)
+        return PackageBatch(h1, h2, rank, flags, queries,
+                            ntok=ntok, vtok=vtok)
 
 
 def _advisory_intervals(
