@@ -561,6 +561,54 @@ resource "aws_lb_listener" "http" {
                             "AVD-AWS-0037", "AVD-AWS-0040", "AVD-AWS-0096",
                             "AVD-AWS-0095", "AVD-AWS-0054", "AVD-AWS-0012"}
 
+    def test_http_to_http_redirect_still_fails(self):
+        """redirect.protocol defaults to #{protocol}: an HTTP listener
+        redirecting without an explicit HTTPS protocol keeps serving
+        plain HTTP and must still fire (review r4d)."""
+        fails = self._fails(b'''
+resource "aws_lb_listener" "h" {
+  protocol = "HTTP"
+  default_action {
+    type = "redirect"
+    redirect { port = "443" }
+  }
+}
+''')
+        assert "AVD-AWS-0054" in fails
+
+    def test_tfplan_after_unknown_silent(self):
+        """Encryption keys created in the same apply are unknown at plan
+        time (after_unknown), not unset — stay silent (review r4d)."""
+        import json as _json
+
+        from trivy_tpu.misconf.scanner import scan_config
+
+        plan = {
+            "format_version": "1.2",
+            "terraform_version": "1.7.0",
+            "planned_values": {"root_module": {"resources": [
+                {"address": "aws_cloudtrail.t", "type": "aws_cloudtrail",
+                 "values": {"name": "t", "is_multi_region_trail": True,
+                            "enable_log_file_validation": True}},
+                {"address": "aws_sns_topic.n", "type": "aws_sns_topic",
+                 "values": {"name": "n"}},
+                {"address": "aws_eks_cluster.e", "type": "aws_eks_cluster",
+                 "values": {"vpc_config": [{}]}},
+            ]}},
+            "resource_changes": [
+                {"address": "aws_cloudtrail.t",
+                 "change": {"after_unknown": {"kms_key_id": True}}},
+                {"address": "aws_sns_topic.n",
+                 "change": {"after_unknown": {"kms_master_key_id": True}}},
+                {"address": "aws_eks_cluster.e",
+                 "change": {"after_unknown": {"vpc_config": [
+                     {"public_access_cidrs": True}]}}},
+            ],
+        }
+        m = scan_config("tfplan.json", _json.dumps(plan).encode())
+        fails = {f.id for f in (m.failures if m else [])}
+        assert not fails & {"AVD-AWS-0015", "AVD-AWS-0095", "AVD-AWS-0040"}
+
     def test_cfn_unresolved_intrinsics_silent(self):
         """Boolean attrs set to unresolved intrinsics (Ref/Fn::If) are
         unknown, not failing-False (review r4c)."""

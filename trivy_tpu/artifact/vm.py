@@ -1,11 +1,9 @@
 """VM-image artifact (reference pkg/fanal/artifact/vm): open the disk
-(raw / partitioned / sparse VMDK), locate supported filesystems, walk
-their files through the analyzer pipeline as one pseudo-blob — same
-shape as the local-fs artifact but sourced from the guest filesystem.
-
-The reference also streams AMI/EBS snapshots via the AWS SDK
-(vm/{ami,ebs}.go); that source is network-gated and out of scope here —
-local image files cover the same analysis path.
+(raw / partitioned / sparse VMDK, or an `ebs:snap-…`/`ami:ami-…`
+snapshot streamed block-by-block through the EBS direct APIs), locate
+supported filesystems, walk their files through the analyzer pipeline
+as one pseudo-blob — same shape as the local-fs artifact but sourced
+from the guest filesystem.
 """
 
 from __future__ import annotations
@@ -19,11 +17,15 @@ from trivy_tpu.fanal.analyzer import AnalysisInput, AnalysisResult, AnalyzerGrou
 from trivy_tpu.fanal.handlers import system_file_filter
 from trivy_tpu.fanal.vm.disk import DiskError, find_filesystems, open_disk
 from trivy_tpu.fanal.vm.ext4 import Ext4, Ext4Error
+from trivy_tpu.fanal.vm.xfs import Xfs, XfsError
 from trivy_tpu.log import logger
 
 _log = logger("vm")
 
 MAX_FILE_SIZE = 256 * 1024 * 1024  # skip larger guest files
+
+# guest filesystems we can walk: fstype -> (reader class, error class)
+_FILESYSTEMS = {"ext4": (Ext4, Ext4Error), "xfs": (Xfs, XfsError)}
 
 
 class VMError(Exception):
@@ -39,6 +41,7 @@ class VMArtifact:
         disabled_analyzers: set[str] | None = None,
         secret_config: str | None = None,
         file_patterns: list[str] | None = None,
+        aws_client_factory=None,
     ):
         self.target = target
         self.cache = cache
@@ -46,6 +49,8 @@ class VMArtifact:
         self.disabled = set(disabled_analyzers or set())
         self.secret_config = secret_config
         self.file_patterns = file_patterns or []
+        # injectable AWS client factory for ebs:/ami: targets (tests)
+        self.aws_client_factory = aws_client_factory
 
     def _group(self) -> AnalyzerGroup:
         group = AnalyzerGroup.build(disabled_types=self.disabled,
@@ -57,7 +62,16 @@ class VMArtifact:
 
     def inspect(self) -> ArtifactReference:
         try:
-            fh = open_disk(self.target)
+            if self.target.startswith(("ebs:", "ami:")):
+                from trivy_tpu.fanal.vm.ebs import EBSError, open_ebs_target
+
+                try:
+                    fh = open_ebs_target(self.target,
+                                         self.aws_client_factory)
+                except EBSError as e:
+                    raise VMError(str(e)) from e
+            else:
+                fh = open_disk(self.target)
         except DiskError as e:
             raise VMError(str(e)) from e
         except OSError as e:
@@ -67,18 +81,18 @@ class VMArtifact:
             if not filesystems:
                 raise VMError(
                     f"no supported filesystem found in {self.target} "
-                    "(ext4 is supported; xfs detection only)")
+                    "(ext4 and xfs are supported)")
             group = self._group()
             result = AnalysisResult()
             post_files: dict = {}
             digest = hashlib.sha256()
             for fstype, offset in filesystems:
-                if fstype != "ext4":
+                if fstype not in _FILESYSTEMS:
                     _log.warn("unsupported guest filesystem skipped",
                               fstype=fstype, offset=offset)
                     continue
-                self._walk_ext4(fh, offset, group, result, post_files,
-                                digest)
+                self._walk_fs(fstype, fh, offset, group, result,
+                              post_files, digest)
             group.post_analyze(result, post_files)
             system_file_filter(result)
         finally:
@@ -94,12 +108,14 @@ class VMArtifact:
             blob_ids=[blob_id],
         )
 
-    def _walk_ext4(self, fh, offset, group, result, post_files,
-                   digest) -> None:
+    def _walk_fs(self, fstype, fh, offset, group, result, post_files,
+                 digest) -> None:
+        fs_cls, fs_err = _FILESYSTEMS[fstype]
         try:
-            fs = Ext4(fh, offset)
-        except Ext4Error as e:
-            _log.warn("ext4 open failed", offset=offset, err=str(e))
+            fs = fs_cls(fh, offset)
+        except fs_err as e:
+            _log.warn("filesystem open failed", fstype=fstype,
+                      offset=offset, err=str(e))
             return
         n = 0
         for path, inode in fs.walk():

@@ -196,11 +196,17 @@ def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
             # avd-aws-0054 checks default action redirect protocol)
             redirect_https = False
             for act in b.children("default_action"):
-                if _tf_value(act.get("type")) == "redirect":
-                    red = act.child("redirect")
-                    proto = _tf_value(red.get("protocol")) if red else None
-                    if proto is None or str(proto).upper() == "HTTPS":
-                        redirect_https = True
+                if _tf_value(act.get("type")) != "redirect":
+                    continue
+                red = act.child("redirect")
+                raw_proto = red.get("protocol") if red else None
+                if raw_proto is None:
+                    # redirect.protocol defaults to #{protocol}: an HTTP
+                    # listener redirecting keeps HTTP — not exempt
+                    continue
+                proto = _tf_value(raw_proto)
+                if proto is None or str(proto).upper() == "HTTPS":
+                    redirect_https = True  # unresolved expr = unknown
             cr.attrs = {"protocol": _tf_value(b.get("protocol")),
                         "redirect_https": redirect_https}
         elif t == "aws_cloudfront_distribution":
@@ -379,12 +385,16 @@ def adapt_cloudformation(resources: dict[str, dict]) -> list[CloudResource]:
             for act in props.get("DefaultActions") or []:
                 if not isinstance(act, dict):
                     continue
-                if str(cfn_scalar(act.get("Type")) or "").lower() == \
+                if str(cfn_scalar(act.get("Type")) or "").lower() != \
                         "redirect":
-                    proto = cfn_scalar(
-                        (act.get("RedirectConfig") or {}).get("Protocol"))
-                    if proto is None or str(proto).upper() == "HTTPS":
-                        redirect_https = True
+                    continue
+                raw_proto = (act.get("RedirectConfig") or {}).get(
+                    "Protocol")
+                if raw_proto is None:
+                    continue  # defaults to #{protocol}: not exempt
+                proto = cfn_scalar(raw_proto)
+                if proto is None or str(proto).upper() == "HTTPS":
+                    redirect_https = True  # intrinsic = unknown
             cr.attrs = {"protocol": cfn_scalar(props.get("Protocol")),
                         "redirect_https": redirect_https}
         elif rtype == "AWS::CloudFront::Distribution":
@@ -613,6 +623,15 @@ def adapt_terraform_plan(doc: dict) -> list[CloudResource]:
     out: list[CloudResource] = []
     sse_buckets: set[str] = set()
 
+    # attrs computed at apply time are absent from planned_values;
+    # resource_changes' after_unknown marks them so absent-vs-unknown is
+    # distinguishable (an unknown encryption key must not read as unset)
+    unknowns: dict[str, dict] = {}
+    for rc in doc.get("resource_changes") or []:
+        au = (rc.get("change") or {}).get("after_unknown")
+        if isinstance(au, dict):
+            unknowns[str(rc.get("address", ""))] = au
+
     def collect_sse(mod: dict):
         for res in mod.get("resources") or []:
             if res.get("type") == \
@@ -625,7 +644,8 @@ def adapt_terraform_plan(doc: dict) -> list[CloudResource]:
 
     def walk_module(mod: dict):
         for res in mod.get("resources") or []:
-            cr = _plan_resource(res)
+            cr = _plan_resource(
+                res, unknowns.get(str(res.get("address", "")), {}))
             if cr is not None:
                 if cr.type == "s3_bucket" and \
                         str(cr.attrs.get("bucket_name") or "") in sse_buckets:
@@ -641,9 +661,11 @@ def adapt_terraform_plan(doc: dict) -> list[CloudResource]:
     return out
 
 
-def _plan_resource(res: dict) -> CloudResource | None:
+def _plan_resource(res: dict,
+                   unknown: dict | None = None) -> CloudResource | None:
     t = str(res.get("type", ""))
     vals = res.get("values") or {}
+    unknown = unknown or {}
     cr = CloudResource(name=str(res.get("address", "")))
     if t == "aws_s3_bucket":
         sse = vals.get("server_side_encryption_configuration")
@@ -704,38 +726,48 @@ def _plan_resource(res: dict) -> CloudResource | None:
         cr.attrs = {
             "multi_region": bool(vals.get("is_multi_region_trail")),
             "kms_key": vals.get("kms_key_id"),
-            # plan values are already resolved; computed-but-unknown
-            # attrs are simply absent from the planned values
-            "kms_unknown": False,
+            # a key created in the same apply is unknown at plan time
+            # (marked in after_unknown, absent from planned values)
+            "kms_unknown": bool(unknown.get("kms_key_id")),
             "log_validation": bool(vals.get("enable_log_file_validation")),
         }
     elif t == "aws_efs_file_system":
         cr.type = "efs"
-        cr.attrs = {"encrypted": bool(vals.get("encrypted"))}
+        enc = vals.get("encrypted")
+        cr.attrs = {"encrypted": None if unknown.get("encrypted")
+                    else bool(enc)}
     elif t == "aws_eks_cluster":
         cr.type = "eks_cluster"
         vpcs = vals.get("vpc_config")
         vpc = vpcs[0] if isinstance(vpcs, list) and vpcs else (
             vpcs if isinstance(vpcs, dict) else {})
+        vu = unknown.get("vpc_config")
+        vu = vu[0] if isinstance(vu, list) and vu else (
+            vu if isinstance(vu, dict) else {})
         pub = vpc.get("endpoint_public_access")
         cidrs = vpc.get("public_access_cidrs")
+        if cidrs is None:
+            cidrs_attr = None if vu.get("public_access_cidrs") \
+                else ["0.0.0.0/0"]
+        else:
+            cidrs_attr = [c for c in cidrs if isinstance(c, str)]
         cr.attrs = {
             "public_access": True if pub is None else bool(pub),
-            "public_cidrs": ["0.0.0.0/0"] if cidrs is None
-            else [c for c in cidrs if isinstance(c, str)],
+            "public_cidrs": cidrs_attr,
         }
     elif t == "aws_sqs_queue":
         cr.type = "sqs_queue"
         cr.attrs = {
             "encrypted": bool(vals.get("kms_master_key_id"))
             or bool(vals.get("sqs_managed_sse_enabled")),
-            "unknown_enc": False,
+            "unknown_enc": bool(unknown.get("kms_master_key_id")
+                                or unknown.get("sqs_managed_sse_enabled")),
         }
     elif t == "aws_sns_topic":
         cr.type = "sns_topic"
         cr.attrs = {
             "encrypted": bool(vals.get("kms_master_key_id")),
-            "unknown_enc": False,
+            "unknown_enc": bool(unknown.get("kms_master_key_id")),
         }
     elif t in ("aws_lb_listener", "aws_alb_listener"):
         cr.type = "lb_listener"
@@ -747,7 +779,9 @@ def _plan_resource(res: dict) -> CloudResource | None:
             red = reds[0] if isinstance(reds, list) and reds else (
                 reds if isinstance(reds, dict) else {})
             proto = red.get("protocol")
-            if proto is None or str(proto).upper() == "HTTPS":
+            # absent protocol defaults to #{protocol} (scheme kept):
+            # only an explicit HTTPS redirect exempts the listener
+            if proto is not None and str(proto).upper() == "HTTPS":
                 redirect_https = True
         cr.attrs = {"protocol": vals.get("protocol"),
                     "redirect_https": redirect_https}
