@@ -313,3 +313,206 @@ class TestKubeClient:
         monkeypatch.setenv("KUBECONFIG", str(tmp_path / "absent"))
         with pytest.raises(KubeError):
             KubeClient()
+
+
+# ------------------------------------------------------ node collector
+
+
+NODE_INFO = {
+    "apiVersion": "v1",
+    "kind": "NodeInfo",
+    "nodeName": "worker-1",
+    "type": "worker",
+    "info": {
+        "kubeletConfFilePermissions": {"values": ["644"]},
+        "kubeletConfFileOwnership": {"values": ["root:root"]},
+        "kubeletConfigYamlConfigurationFilePermission": {"values": ["777"]},
+        "kubeletConfigYamlConfigurationFileOwnership":
+            {"values": ["ubuntu:ubuntu"]},
+        "kubeletAnonymousAuthArgumentSet": {"values": ["true"]},
+        "kubeletAuthorizationModeArgumentSet": {"values": ["Webhook"]},
+        "kubeletClientCaFileArgumentSet":
+            {"values": ["/etc/kubernetes/pki/ca.crt"]},
+        "kubeletReadOnlyPortArgumentSet": {"values": ["10255"]},
+        "kubeletProtectKernelDefaultsArgumentSet": {"values": ["true"]},
+        "kubeletRotateCertificatesArgumentSet": {"values": ["true"]},
+    },
+}
+
+
+class TestNodeCollector:
+    def test_assess_node_info(self):
+        from trivy_tpu.k8s.node_collector import assess_node_info
+
+        findings = assess_node_info(NODE_INFO)
+        ids = {f.id for f in findings}
+        assert "KCV0073" in ids  # config.yaml 777
+        assert "KCV0074" in ids  # config.yaml ubuntu:ubuntu
+        assert "KCV0077" in ids  # anonymous auth true
+        assert "KCV0080" in ids  # read-only port 10255
+        # compliant keys stay silent
+        assert "KCV0069" not in ids  # 644 permissions ok
+        assert "KCV0078" not in ids  # Webhook authz ok
+        assert "KCV0082" not in ids  # protect kernel defaults true
+        # uncollected keys are unknown, not failing
+        assert "KCV0083" not in ids
+        assert all(f.resource == "Node/worker-1" for f in findings)
+
+    def test_offline_nodeinfo_manifest(self, tmp_path):
+        """NodeInfo documents among scanned manifests are assessed
+        (out-of-band collector runs for air-gapped clusters)."""
+        (tmp_path / "nodeinfo.json").write_text(json.dumps(NODE_INFO))
+        report = ClusterScanner(scanners={"infra"}).scan(str(tmp_path))
+        assert any(f.id == "KCV0077" for f in report.infra)
+
+    def test_collector_job_shape(self):
+        from trivy_tpu.k8s.node_collector import collector_job
+
+        job = collector_job("worker-1")
+        assert job["kind"] == "Job"
+        spec = job["spec"]["template"]["spec"]
+        assert spec["nodeName"] == "worker-1"
+        paths = {v["hostPath"]["path"] for v in spec["volumes"]}
+        assert "/var/lib/kubelet" in paths
+        assert "/etc/kubernetes" in paths
+
+    def test_collector_job_long_node_names(self):
+        """63-char limits: long node names truncate with a hash suffix
+        (no collisions) and label values stay valid (review r4e)."""
+        from trivy_tpu.k8s.node_collector import collector_job
+
+        a = "node-" + "a" * 200 + "-one"
+        b = "node-" + "a" * 200 + "-two"
+        ja, jb = collector_job(a), collector_job(b)
+        assert ja["metadata"]["name"] != jb["metadata"]["name"]
+        for j, n in ((ja, a), (jb, b)):
+            assert len(j["metadata"]["name"]) <= 63
+            assert len(j["metadata"]["labels"]["node"]) <= 63
+            assert j["spec"]["template"]["spec"]["nodeName"] == n
+
+    def test_streaming_timeout_values(self):
+        """KCV0081 must not substring-match '0' inside real durations
+        like 4h0m0s (review r4e)."""
+        from trivy_tpu.k8s.node_collector import assess_node_info
+
+        ok = assess_node_info({"nodeName": "n", "info": {
+            "kubeletStreamingConnectionIdleTimeoutArgumentSet":
+                {"values": ["4h0m0s"]}}})
+        assert not any(f.id == "KCV0081" for f in ok)
+        bad = assess_node_info({"nodeName": "n", "info": {
+            "kubeletStreamingConnectionIdleTimeoutArgumentSet":
+                {"values": ["0"]}}})
+        assert any(f.id == "KCV0081" for f in bad)
+
+    def test_failed_pod_waits_for_retry(self):
+        """A single Failed pod must not abort collection while the
+        backoffLimit retry can still succeed (review r4e)."""
+        from trivy_tpu.k8s.node_collector import collect_node_info
+
+        class FakeClient:
+            def __init__(self):
+                self.polls = 0
+
+            def post(self, path, body):
+                return body
+
+            def list(self, kind, namespace="", selector=""):
+                self.polls += 1
+                pods = [{"metadata": {"name": "p1"},
+                         "status": {"phase": "Failed"}}]
+                if self.polls > 1:  # retry pod appears on the 2nd poll
+                    pods.append({"metadata": {"name": "p2"},
+                                 "status": {"phase": "Succeeded"}})
+                return pods
+
+            def pod_logs(self, namespace, pod):
+                return json.dumps(NODE_INFO).encode()
+
+            def delete(self, path):
+                return {}
+
+        doc = collect_node_info(FakeClient(), "worker-1", poll_s=0.01)
+        assert doc is not None and doc["nodeName"] == "worker-1"
+
+    def test_collect_node_info_flow(self):
+        """Job create -> pod poll -> log read -> cleanup, against a fake
+        client."""
+        from trivy_tpu.k8s.node_collector import collect_node_info
+
+        class FakeClient:
+            def __init__(self):
+                self.posted = []
+                self.deleted = []
+
+            def post(self, path, body):
+                self.posted.append((path, body))
+                return body
+
+            def list(self, kind, namespace="", selector=""):
+                assert kind == "Pod"
+                assert "node=worker-1" in selector
+                return [{"metadata": {"name": "node-collector-worker-1-x"},
+                         "status": {"phase": "Succeeded"}}]
+
+            def pod_logs(self, namespace, pod):
+                return json.dumps(NODE_INFO).encode()
+
+            def delete(self, path):
+                self.deleted.append(path)
+                return {}
+
+        client = FakeClient()
+        doc = collect_node_info(client, "worker-1", poll_s=0.01)
+        assert doc["nodeName"] == "worker-1"
+        paths = [p for p, _ in client.posted]
+        assert any("jobs" in p for p in paths)
+        assert any(p == "/api/v1/namespaces" for p in paths)  # ns ensured
+        assert client.deleted and "node-collector-worker-1" in \
+            client.deleted[0]
+
+    def test_live_cluster_merges_node_findings(self):
+        """ClusterScanner live path dispatches the collector per node and
+        merges the findings (fake client, no cluster)."""
+
+        class FakeClient:
+            def post(self, path, body):
+                return body
+
+            def list(self, kind, namespace="", selector=""):
+                if kind == "Node":
+                    return [{"metadata": {"name": "worker-1"}}]
+                return [{"metadata": {"name": "p"},
+                         "status": {"phase": "Succeeded"}}]
+
+            def pod_logs(self, namespace, pod):
+                return json.dumps(NODE_INFO).encode()
+
+            def delete(self, path):
+                return {}
+
+        import trivy_tpu.k8s.scanner as scanner_mod
+
+        sc = ClusterScanner(scanners={"infra"},
+                            kube_client_factory=FakeClient)
+        # live enumeration itself is stubbed to an empty cluster
+        orig = scanner_mod.load_cluster
+        scanner_mod.load_cluster = lambda **kw: []
+        try:
+            report = sc.scan("cluster")
+        finally:
+            scanner_mod.load_cluster = orig
+        assert any(f.id == "KCV0077" for f in report.infra)
+
+    def test_disable_node_collector(self):
+        sc = ClusterScanner(scanners={"infra"}, disable_node_collector=True,
+                            kube_client_factory=lambda: (_ for _ in ()).throw(
+                                AssertionError("must not build client")))
+        import trivy_tpu.k8s.scanner as scanner_mod
+
+        orig = scanner_mod.load_cluster
+        scanner_mod.load_cluster = lambda **kw: []
+        try:
+            report = sc.scan("cluster")
+        finally:
+            scanner_mod.load_cluster = orig
+        assert report.infra == []

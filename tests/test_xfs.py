@@ -332,8 +332,12 @@ class TestEBS:
         assert blob["os"]["family"] == "alpine"
         assert ebs.get_calls > 0
 
-    def test_missing_boto3_message(self):
+    def test_missing_boto3_message(self, monkeypatch):
+        import sys
+
         from trivy_tpu.artifact.vm import VMError
 
+        # force the import failure even where boto3 is installed
+        monkeypatch.setitem(sys.modules, "boto3", None)
         with pytest.raises(VMError, match="boto3"):
             VMArtifact("ebs:snap-none", MemoryCache()).inspect()

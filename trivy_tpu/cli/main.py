@@ -211,6 +211,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--db-path", default=None)
     p.add_argument("--no-tpu", action="store_true")
     p.add_argument("--parallel", type=int, default=5)
+    p.add_argument("--disable-node-collector", action="store_true",
+                   help="skip the per-node collector Job on live "
+                        "cluster scans")
+    p.add_argument("--node-collector-namespace", default=None,
+                   help="namespace for node-collector Jobs "
+                        "(default trivy-temp)")
+    p.add_argument("--node-collector-imageref", default=None,
+                   help="node-collector image to run")
     p.add_argument("target", nargs="?", default="cluster",
                    help="'cluster' (live) or a manifests dir/file")
 

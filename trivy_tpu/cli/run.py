@@ -474,6 +474,12 @@ def run_k8s(args) -> int:
     scanner = ClusterScanner(
         scanners=scanners, workers=args.parallel,
         image_tar_dir=getattr(args, "image_tar_dir", None), engine=engine,
+        disable_node_collector=getattr(args, "disable_node_collector",
+                                       False),
+        node_collector_namespace=getattr(args, "node_collector_namespace",
+                                         None),
+        node_collector_image=getattr(args, "node_collector_imageref",
+                                     None),
     )
     try:
         report = scanner.scan(args.target, context=args.context,

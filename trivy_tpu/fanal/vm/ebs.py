@@ -49,6 +49,7 @@ class EBSDisk(io.RawIOBase):
         self.volume_bytes = 0
         self._tokens: dict[int, str] = {}
         self._cache: OrderedDict[int, bytes] = OrderedDict()
+        self._zero = b""
         self._load_block_map()
 
     def _load_block_map(self) -> None:
@@ -78,26 +79,29 @@ class EBSDisk(io.RawIOBase):
                   block_size=self.block_size)
 
     def _block(self, index: int) -> bytes:
+        token = self._tokens.get(index)
+        if token is None:
+            # hole: shared zero buffer, never cached — sparse snapshots
+            # would otherwise evict network-fetched blocks from the LRU
+            if len(self._zero) != self.block_size:
+                self._zero = b"\x00" * self.block_size
+            return self._zero
         cached = self._cache.get(index)
         if cached is not None:
             self._cache.move_to_end(index)
             return cached
-        token = self._tokens.get(index)
-        if token is None:
-            data = b"\x00" * self.block_size  # hole
-        else:
-            try:
-                resp = self.client.get_snapshot_block(
-                    SnapshotId=self.snapshot_id, BlockIndex=index,
-                    BlockToken=token)
-            except Exception as e:
-                raise EBSError(
-                    f"cannot fetch block {index} of {self.snapshot_id}: "
-                    f"{e}") from e
-            body = resp["BlockData"]
-            data = body.read() if hasattr(body, "read") else bytes(body)
-            if len(data) < self.block_size:
-                data += b"\x00" * (self.block_size - len(data))
+        try:
+            resp = self.client.get_snapshot_block(
+                SnapshotId=self.snapshot_id, BlockIndex=index,
+                BlockToken=token)
+        except Exception as e:
+            raise EBSError(
+                f"cannot fetch block {index} of {self.snapshot_id}: "
+                f"{e}") from e
+        body = resp["BlockData"]
+        data = body.read() if hasattr(body, "read") else bytes(body)
+        if len(data) < self.block_size:
+            data += b"\x00" * (self.block_size - len(data))
         self._cache[index] = data
         if len(self._cache) > CACHE_BLOCKS:
             self._cache.popitem(last=False)

@@ -288,7 +288,6 @@ class Xfs:
             chunks[logical * bs] = data
         if not chunks:
             return out
-        buf = bytearray()
         end = max(off + len(d) for off, d in chunks.items())
         buf = bytearray(end)
         for off, d in chunks.items():

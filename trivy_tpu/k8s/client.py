@@ -137,23 +137,44 @@ class KubeClient:
 
     # ------------------------------------------------------------- api
 
-    def get(self, path: str) -> dict:
-        req = urllib.request.Request(self.server + path)
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 raw: bool = False):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(self.server + path, data=data,
+                                     method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         ctx = self._ctx if self.server.startswith("https") else None
         try:
             with urllib.request.urlopen(req, timeout=30, context=ctx) as r:
-                return json.loads(r.read())
+                payload = r.read()
+                return payload if raw else json.loads(payload)
         except urllib.error.HTTPError as e:
-            raise KubeError(f"GET {path}: HTTP {e.code}")
+            raise KubeError(f"{method} {path}: HTTP {e.code}")
         except (urllib.error.URLError, OSError, ValueError) as e:
-            raise KubeError(f"GET {path}: {e}")
+            raise KubeError(f"{method} {path}: {e}")
+
+    def get(self, path: str) -> dict:
+        return self._request("GET", path)
+
+    def post(self, path: str, body: dict) -> dict:
+        return self._request("POST", path, body)
+
+    def delete(self, path: str) -> dict:
+        return self._request("DELETE", path)
+
+    def pod_logs(self, namespace: str, pod: str) -> bytes:
+        return self._request(
+            "GET", f"/api/v1/namespaces/{namespace}/pods/{pod}/log",
+            raw=True)
 
     def version(self) -> dict:
         return self.get("/version")
 
-    def list(self, kind: str, namespace: str = "") -> list[dict]:
+    def list(self, kind: str, namespace: str = "",
+             selector: str = "") -> list[dict]:
         """All objects of `kind` (cluster-wide unless namespaced); each
         item gets apiVersion/kind filled in (list responses omit them)."""
         spec = API_PATHS.get(kind)
@@ -165,6 +186,10 @@ class KubeClient:
             path = f"{prefix}/namespaces/{namespace}/{plural}"
         else:
             path = f"{prefix}/{plural}"
+        if selector:
+            from urllib.parse import quote
+
+            path += f"?labelSelector={quote(selector)}"
         doc = self.get(path)
         api_version = prefix.rsplit("/", 1)[-1] if prefix == "/api/v1" \
             else prefix[len("/apis/"):]

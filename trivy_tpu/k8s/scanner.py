@@ -48,11 +48,20 @@ class ClusterScanner:
     live kubeconfig-backed cluster."""
 
     def __init__(self, scanners: set[str] | None = None, workers: int = 5,
-                 image_tar_dir: str | None = None, engine=None):
+                 image_tar_dir: str | None = None, engine=None,
+                 disable_node_collector: bool = False,
+                 node_collector_namespace: str | None = None,
+                 node_collector_image: str | None = None,
+                 kube_client_factory=None):
         self.scanners = scanners or {"misconfig", "rbac", "infra"}
         self.workers = workers
         self.image_tar_dir = image_tar_dir
         self.engine = engine  # MatchEngine for image vuln scans
+        self.disable_node_collector = disable_node_collector
+        self.node_collector_namespace = node_collector_namespace
+        self.node_collector_image = node_collector_image
+        # injectable for tests; defaults to KubeClient(context=...)
+        self.kube_client_factory = kube_client_factory
 
     def scan(self, target: str, context: str = "",
              namespace: str = "") -> ClusterReport:
@@ -82,9 +91,54 @@ class ClusterScanner:
             report.rbac = assess_rbac(resources)
         if "infra" in self.scanners:
             report.infra = assess_infra(resources)
+            report.infra.extend(self._node_findings(resources, target,
+                                                    context))
         if "vuln" in self.scanners and self.image_tar_dir:
             self._scan_images(report)
         return report
+
+    def _node_findings(self, resources: list[KubeResource], target: str,
+                       context: str) -> list[InfraFinding]:
+        """Node-level KCV findings: NodeInfo documents found among the
+        scanned manifests (out-of-band collector runs) are assessed
+        directly; live cluster scans additionally dispatch the
+        node-collector Job per node unless disabled."""
+        from trivy_tpu.k8s.node_collector import (
+            assess_node_info,
+            collect_node_info,
+        )
+
+        out: list[InfraFinding] = []
+        for res in resources:
+            if res.kind == "NodeInfo":
+                out.extend(assess_node_info(res.raw))
+        if target != "cluster" or self.disable_node_collector:
+            return out
+        try:
+            if self.kube_client_factory is not None:
+                client = self.kube_client_factory()
+            else:
+                from trivy_tpu.k8s.client import KubeClient
+
+                client = KubeClient(context=context)
+            nodes = [n["metadata"]["name"] for n in client.list("Node")]
+        except Exception as e:
+            _log.warn("node-collector skipped", err=str(e))
+            return out
+        kwargs = {}
+        if self.node_collector_namespace:
+            kwargs["namespace"] = self.node_collector_namespace
+        if self.node_collector_image:
+            kwargs["image"] = self.node_collector_image
+
+        def collect_one(node: str):
+            doc = collect_node_info(client, node, **kwargs)
+            return assess_node_info(doc) if doc else []
+
+        for findings in run_pipeline(nodes, collect_one,
+                                     workers=self.workers):
+            out.extend(findings)
+        return out
 
     # ------------------------------------------------------------ steps
 
