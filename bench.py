@@ -165,6 +165,12 @@ def bench_secrets(n_files: int = 1500) -> dict:
     }
 
 
+def _native_collect_active() -> bool:
+    from trivy_tpu.native import collect as ncollect
+
+    return ncollect.available()
+
+
 def main():
     device_status = _ensure_device()
 
@@ -283,6 +289,8 @@ def main():
         "db_build_s": round(build_s, 1),
         "db_compile_s": round(compile_s, 1),
         "db_hbm_mb": round(hbm_bytes / 1e6, 1),
+        "e2e_s": round(e2e_s, 2),
+        "native_collect": _native_collect_active(),
         "batch_unique": len(uniq),
         "stage_encode_s": round(encode_s, 3),
         "stage_device_s": round(device_s, 3),

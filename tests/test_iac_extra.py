@@ -609,6 +609,36 @@ resource "aws_lb_listener" "h" {
         fails = {f.id for f in (m.failures if m else [])}
         assert not fails & {"AVD-AWS-0015", "AVD-AWS-0095", "AVD-AWS-0040"}
 
+    def test_tfplan_computed_redirect_protocol_exempt(self):
+        """A redirect protocol computed at apply time (after_unknown) is
+        unknown, not an HTTP-to-HTTP redirect (review r4f)."""
+        import json as _json
+
+        from trivy_tpu.misconf.scanner import scan_config
+
+        plan = {
+            "format_version": "1.2",
+            "terraform_version": "1.7.0",
+            "planned_values": {"root_module": {"resources": [
+                {"address": "aws_lb_listener.l", "type": "aws_lb_listener",
+                 "values": {"protocol": "HTTP", "default_action": [
+                     {"type": "redirect", "redirect": [{}]}]}},
+            ]}},
+            "resource_changes": [
+                {"address": "aws_lb_listener.l",
+                 "change": {"after_unknown": {"default_action": [
+                     {"redirect": [{"protocol": True}]}]}}},
+            ],
+        }
+        m = scan_config("tfplan.json", _json.dumps(plan).encode())
+        fails = {f.id for f in (m.failures if m else [])}
+        assert "AVD-AWS-0054" not in fails
+        # without the unknown mark, the same shape still fails
+        plan["resource_changes"] = []
+        m = scan_config("tfplan.json", _json.dumps(plan).encode())
+        fails = {f.id for f in (m.failures if m else [])}
+        assert "AVD-AWS-0054" in fails
+
     def test_cfn_unresolved_intrinsics_silent(self):
         """Boolean attrs set to unresolved intrinsics (Ref/Fn::If) are
         unknown, not failing-False (review r4c)."""

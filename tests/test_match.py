@@ -161,6 +161,24 @@ def test_detect_many_pipelined_matches_detect(db):
     assert [r.adv_indices for r in b] == [r.adv_indices for r in oracle]
 
 
+def test_native_decode_matches_numpy(db, monkeypatch):
+    """The C++ mask decoder and the numpy fallback must be bit-identical
+    (including hot-partition routing and rescreen flags)."""
+    from trivy_tpu.native import collect as ncollect
+
+    queries = _random_queries(random.Random(31), n=800)
+    engine = MatchEngine(db, window=32)
+    with_native = engine.detect(queries)
+    monkeypatch.setattr(ncollect, "available", lambda: False)
+    engine2 = MatchEngine(db, window=32)
+    without = engine2.detect(queries)
+    assert [r.adv_indices for r in with_native] == \
+        [r.adv_indices for r in without]
+    oracle = engine.oracle_detect(queries)
+    assert [r.adv_indices for r in with_native] == \
+        [r.adv_indices for r in oracle]
+
+
 def test_detect_many_cache_bound_survives(db):
     """Regression (r4 review): tripping the crawl-cache RSS bound must
     not break repeat-query lookups mid-crawl (the old mid-flush clear

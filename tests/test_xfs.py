@@ -226,6 +226,47 @@ class TestXfsReader:
         with open(img, "rb") as fh, pytest.raises(XfsError):
             Xfs(fh)
 
+    def test_hostile_dir_extent_bounded(self, xfs_image):
+        """Crafted directory extent maps must not force multi-GiB
+        allocations (review r4f): a sparse far-offset block assembles
+        only itself, and a max-count extent trips the dirblock cap."""
+        evil_ino = _ino(INODE_TABLE_BLK, 7)
+        far = (32 * 1024 ** 3 // BS) - 2  # just below the leaf boundary
+        with open(xfs_image, "r+b") as f:
+            f.seek(INODE_TABLE_BLK * BS + 7 * INO_SIZE)
+            f.write(_dinode(0o40755, 2, BS, 1, _extent(far, DATA_BLK, 1)))
+        with open(xfs_image, "rb") as fh:
+            fs = Xfs(fh)
+            # sparse assembly: one dirblock, no flat 32 GiB buffer
+            entries = fs.read_dir(fs.inode(evil_ino))
+            assert isinstance(entries, list)
+        # a max-count extent (2^21-1 blocks of "directory data")
+        with open(xfs_image, "r+b") as f:
+            f.seek(INODE_TABLE_BLK * BS + 7 * INO_SIZE)
+            f.write(_dinode(0o40755, 2, BS, 1,
+                            _extent(0, DATA_BLK, (1 << 21) - 1)))
+        with open(xfs_image, "rb") as fh:
+            fs = Xfs(fh)
+            # fails bounded (AG bounds / short read / dirblock cap), no
+            # multi-GiB allocation
+            with pytest.raises(XfsError):
+                fs.read_dir(fs.inode(evil_ino))
+            # walk survives (bad dir skipped)
+            assert dict(fs.walk())
+
+    def test_hostile_symlink_size_bounded(self, xfs_image):
+        """A symlink claiming a huge size/extent map reads at most
+        PATH_MAX-ish bytes (review r4f)."""
+        evil_ino = _ino(INODE_TABLE_BLK, 7)
+        fork = _extent(0, DATA_BLK, (1 << 21) - 1)  # max-count extent
+        with open(xfs_image, "r+b") as f:
+            f.seek(INODE_TABLE_BLK * BS + 7 * INO_SIZE)
+            f.write(_dinode(0o120777, 2, 1 << 40, 1, fork))
+        with open(xfs_image, "rb") as fh:
+            fs = Xfs(fh)
+            target = fs.read_symlink(fs.inode(evil_ino))
+            assert len(target) <= 4096
+
 
 class TestVMArtifactXfs:
     def test_inspect_xfs(self, xfs_image):

@@ -76,6 +76,8 @@ class VMArtifact:
             raise VMError(str(e)) from e
         except OSError as e:
             raise VMError(f"cannot open VM image {self.target}: {e}") from e
+        from trivy_tpu.fanal.vm.ebs import EBSError
+
         try:
             filesystems = find_filesystems(fh)
             if not filesystems:
@@ -95,6 +97,10 @@ class VMArtifact:
                               post_files, digest)
             group.post_analyze(result, post_files)
             system_file_filter(result)
+        except EBSError as e:
+            # block fetches during the walk can fail (throttling, expired
+            # tokens) — keep the VMError contract for callers
+            raise VMError(str(e)) from e
         finally:
             fh.close()
 

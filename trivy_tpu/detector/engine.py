@@ -382,57 +382,10 @@ class MatchEngine:
         cdb = self.cdb
         queries = ctx["queries"]
         batch = ctx["batch"]
+        flag_mask = m.FLAG_NEEDS_HOST | m.FLAG_RESCREEN
 
-        all_rows: list[np.ndarray] = []
-        all_ids: list[np.ndarray] = []
-        all_rfl: list[np.ndarray] = []
-        if ctx["sharded"] is not None:
-            masks = ctx["sharded"].collect()  # [D, B, W]
-            base = self._sdb.shard_base
-            for d in range(masks.shape[0]):
-                lo_i = d * base
-                hi_i = min(lo_i + self._sdb.shard_len, cdb.n_rows)
-                if lo_i >= cdb.n_rows:
-                    break
-                start = np.searchsorted(
-                    cdb.row_h1[lo_i:hi_i], batch.h1).astype(np.int64) + lo_i
-                rows_d, offs_d = np.nonzero(masks[d])
-                ridx = start[rows_d] + offs_d
-                all_rows.append(rows_d)
-                all_ids.append(cdb.row_adv[ridx])
-                all_rfl.append(cdb.row_flags[ridx])
-        elif ctx["main"] is not None:
-            mask = ctx["main"].collect()  # [B, W]
-            start = np.searchsorted(cdb.row_h1, batch.h1).astype(np.int64)
-            rows0, offs0 = np.nonzero(mask)
-            ridx = start[rows0] + offs0
-            all_rows.append(rows0)
-            all_ids.append(cdb.row_adv[ridx])
-            all_rfl.append(cdb.row_flags[ridx])
-
-        # hot-name queries additionally run against the hot partition
-        # (transfer is |hot queries| x hot_window bits, tiny after dedupe)
-        if ctx["hot"] is not None:
-            hot_idx, hot_pending, sub = ctx["hot"]
-            hmask = hot_pending.collect()
-            hstart = np.searchsorted(cdb.hot_h1, sub.h1).astype(np.int64)
-            hrows, hoffs = np.nonzero(hmask)
-            hridx = hstart[hrows] + hoffs
-            all_rows.append(np.asarray(hot_idx, dtype=np.int64)[hrows])
-            all_ids.append(cdb.hot_adv[hridx])
-            all_rfl.append(cdb.hot_flags[hridx])
-
-        rows = np.concatenate(all_rows) if all_rows else np.empty(0, np.int64)
-        if len(rows) == 0:
-            return [[] for _ in queries]
-        ids = np.concatenate(all_ids).astype(np.int64)
-        rfl = np.concatenate(all_rfl)
-        pfl = batch.flags[rows]
-        resc = ((rfl | pfl) & (m.FLAG_NEEDS_HOST | m.FLAG_RESCREEN)) != 0
-
-        # hash-collision screen: advisory's (space, name) token must equal
-        # the query's. Tokens were interned during encode_packages; the
-        # fallback loop only runs for batches encoded without token dicts.
+        # query tokens (interned during encode_packages; the fallback
+        # loop only runs for batches encoded without token dicts)
         self._ensure_tokens()
         q_tok, q_vt = batch.ntok, batch.vtok
         if q_tok is None or q_vt is None:
@@ -448,8 +401,79 @@ class MatchEngine:
                     t = len(vtok)
                     vtok[vk] = t
                 q_vt[j] = t
-        valid = self._adv_tok[ids] == q_tok[rows]
-        rows, ids, resc = rows[valid], ids[valid], resc[valid]
+
+        native = None
+        if ctx["sharded"] is None:
+            from trivy_tpu.native import collect as ncollect
+
+            if ncollect.available():
+                native = ncollect
+
+        # each part: token-screened (rows, ids, resc) for one device
+        # source, rows in original query indices
+        parts: list[tuple] = []
+
+        def add_part(pending, key_h1, adv, rfl_col, sub=None, qidx=None):
+            """Decode one source. sub = sub-batch (hot partition); qidx
+            maps its rows back to original query indices."""
+            h1 = sub.h1 if sub is not None else batch.h1
+            fl = sub.flags if sub is not None else batch.flags
+            tok = q_tok if qidx is None else q_tok[qidx]
+            start = np.searchsorted(key_h1, h1).astype(np.int64)
+            if native is not None:
+                decoded = native.decode_mask(
+                    pending.collect_words(), start, len(key_h1),
+                    adv, rfl_col, self._adv_tok, tok, fl, flag_mask)
+            else:
+                decoded = None
+            if decoded is None:
+                mask = pending.collect()
+                rows0, offs0 = np.nonzero(mask)
+                ridx = start[rows0] + offs0
+                ids0 = adv[ridx].astype(np.int64)
+                resc0 = ((rfl_col[ridx] | fl[rows0]) & flag_mask) != 0
+                valid = self._adv_tok[ids0] == tok[rows0]
+                rows0, ids0, resc0 = \
+                    rows0[valid], ids0[valid], resc0[valid]
+            else:
+                rows0, ids0, resc0 = decoded
+            if qidx is not None:
+                rows0 = np.asarray(qidx, dtype=np.int64)[rows0]
+            parts.append((rows0, ids0, resc0))
+
+        if ctx["sharded"] is not None:
+            masks = ctx["sharded"].collect()  # [D, B, W]
+            base = self._sdb.shard_base
+            for d in range(masks.shape[0]):
+                lo_i = d * base
+                hi_i = min(lo_i + self._sdb.shard_len, cdb.n_rows)
+                if lo_i >= cdb.n_rows:
+                    break
+                start = np.searchsorted(
+                    cdb.row_h1[lo_i:hi_i], batch.h1).astype(np.int64) + lo_i
+                rows_d, offs_d = np.nonzero(masks[d])
+                ridx = start[rows_d] + offs_d
+                ids_d = cdb.row_adv[ridx].astype(np.int64)
+                resc_d = ((cdb.row_flags[ridx] | batch.flags[rows_d])
+                          & flag_mask) != 0
+                valid = self._adv_tok[ids_d] == q_tok[rows_d]
+                parts.append((rows_d[valid], ids_d[valid], resc_d[valid]))
+        elif ctx["main"] is not None:
+            add_part(ctx["main"], cdb.row_h1, cdb.row_adv, cdb.row_flags)
+
+        # hot-name queries additionally run against the hot partition
+        # (transfer is |hot queries| x hot_window bits, tiny after dedupe)
+        if ctx["hot"] is not None:
+            hot_idx, hot_pending, sub = ctx["hot"]
+            add_part(hot_pending, cdb.hot_h1, cdb.hot_adv, cdb.hot_flags,
+                     sub=sub, qidx=hot_idx)
+
+        parts = [p for p in parts if len(p[0])]
+        if not parts:
+            return [[] for _ in queries]
+        rows = np.concatenate([p[0] for p in parts])
+        ids = np.concatenate([p[1] for p in parts])
+        resc = np.concatenate([p[2] for p in parts])
 
         # dedupe (row, id) keeping the exact (non-rescreen) occurrence
         # (multi-interval advisories, shard halos, pre-only twin rows)

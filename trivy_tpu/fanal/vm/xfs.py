@@ -261,39 +261,53 @@ class Xfs:
     def read_symlink(self, inode: Inode) -> str:
         if inode.format == FMT_LOCAL:
             return inode.fork[:inode.size].decode("utf-8", "replace")
-        # remote symlink: v5 blocks carry a 56-byte XSLM header each
+        # remote symlink: v5 blocks carry a 56-byte XSLM header each.
+        # Symlink targets cap at PATH_MAX; don't trust a crafted
+        # size/extent map to drive larger reads.
+        size = min(inode.size, 4096)
         raw = bytearray()
         bs = self.sb.block_size
         for _logical, physical, count in self._extents(inode):
             for c in range(count):
+                if len(raw) >= size:
+                    break
                 blk = self._read_at(self._fsblock_byte(physical + c), bs)
                 raw += blk[56:] if blk[:4] == SYMLINK_MAGIC else blk
-        return bytes(raw[:inode.size]).decode("utf-8", "replace")
+        return bytes(raw[:size]).decode("utf-8", "replace")
 
     # ------------------------------------------------------ directories
+
+    # untrusted images: bound per-directory work so a crafted extent map
+    # (logical offsets just below the 32 GiB leaf boundary, or 2^21-block
+    # extents) cannot force multi-GiB allocations
+    MAX_DIR_BLOCKS = 65536  # 256 MiB of directory data at 4 KiB blocks
 
     def read_dir(self, inode: Inode) -> list[DirEntry]:
         if inode.format == FMT_LOCAL:
             return self._read_sf_dir(inode.fork)
         out: list[DirEntry] = []
-        dirblk = self.sb.block_size << self.sb.dirblklog
         bs = self.sb.block_size
-        # collect directory data bytes below the leaf boundary,
-        # dirblock-aligned so each parses independently
-        chunks: dict[int, bytes] = {}
+        blk_per_dirblk = 1 << self.sb.dirblklog
+        # assemble directory blocks sparsely: dirblock index -> buffer
+        # (dirblocks can span extents when dirblklog > 0)
+        dirblocks: dict[int, bytearray] = {}
         for logical, physical, count in self._extents(inode):
             if logical * bs >= DIR_LEAF_OFFSET:
                 continue  # leaf/freeindex metadata, not entries
-            data = self._read_at(self._fsblock_byte(physical), count * bs)
-            chunks[logical * bs] = data
-        if not chunks:
-            return out
-        end = max(off + len(d) for off, d in chunks.items())
-        buf = bytearray(end)
-        for off, d in chunks.items():
-            buf[off:off + len(d)] = d
-        for base in range(0, len(buf), dirblk):
-            out.extend(self._parse_dir_block(bytes(buf[base:base + dirblk])))
+            for c in range(count):
+                lblock = logical + c
+                dindex, within = divmod(lblock, blk_per_dirblk)
+                buf = dirblocks.get(dindex)
+                if buf is None:
+                    if len(dirblocks) >= self.MAX_DIR_BLOCKS:
+                        raise XfsError("directory too large")
+                    buf = dirblocks[dindex] = \
+                        bytearray(bs * blk_per_dirblk)
+                data = self._read_at(
+                    self._fsblock_byte(physical + c), bs)
+                buf[within * bs:(within + 1) * bs] = data
+        for dindex in sorted(dirblocks):
+            out.extend(self._parse_dir_block(bytes(dirblocks[dindex])))
         return out
 
     def _read_sf_dir(self, fork: bytes) -> list[DirEntry]:

@@ -771,17 +771,30 @@ def _plan_resource(res: dict,
         }
     elif t in ("aws_lb_listener", "aws_alb_listener"):
         cr.type = "lb_listener"
+
+        def _first_block(v):
+            if isinstance(v, list) and v:
+                return v[0] if isinstance(v[0], dict) else {}
+            return v if isinstance(v, dict) else {}
+
         redirect_https = False
-        for act in vals.get("default_action") or []:
+        acts = vals.get("default_action") or []
+        unk_acts = unknown.get("default_action") or []
+        for i, act in enumerate(acts):
             if not isinstance(act, dict) or act.get("type") != "redirect":
                 continue
-            reds = act.get("redirect")
-            red = reds[0] if isinstance(reds, list) and reds else (
-                reds if isinstance(reds, dict) else {})
+            red = _first_block(act.get("redirect"))
             proto = red.get("protocol")
-            # absent protocol defaults to #{protocol} (scheme kept):
-            # only an explicit HTTPS redirect exempts the listener
-            if proto is not None and str(proto).upper() == "HTTPS":
+            if proto is None:
+                # computed at apply time -> unknown -> exempt (matches
+                # the HCL/CFN unknown handling); truly absent defaults
+                # to #{protocol} (scheme kept) -> not exempt
+                unk_act = unk_acts[i] if i < len(unk_acts) and \
+                    isinstance(unk_acts[i], dict) else {}
+                unk_red = _first_block(unk_act.get("redirect"))
+                if unk_red.get("protocol"):
+                    redirect_https = True
+            elif str(proto).upper() == "HTTPS":
                 redirect_https = True
         cr.attrs = {"protocol": vals.get("protocol"),
                     "redirect_https": redirect_https}

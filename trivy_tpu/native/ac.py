@@ -1,99 +1,52 @@
 """ctypes binding for the C++ Aho-Corasick keyword scanner (ac.cpp).
 
-The shared library is compiled on first use with g++ and cached under
-~/.cache/trivy-tpu/native keyed by a source hash; when no toolchain is
-available the caller falls back to the pure-Python prefilter.
+Build/load scaffolding shared with collect.py via native/build.py; when
+no toolchain is available the caller falls back to the pure-Python
+prefilter.
 """
 
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
-import tempfile
-import threading
 
 import numpy as np
 
-from trivy_tpu.log import logger
-
-_log = logger("native")
+from trivy_tpu.native.build import LazyLibrary
 
 _SRC = os.path.join(os.path.dirname(__file__), "ac.cpp")
-_LOCK = threading.Lock()
-_LIB: ctypes.CDLL | None = None
-_LIB_FAILED = False
 
 
-def _cache_dir() -> str:
-    return os.environ.get(
-        "TRIVY_TPU_NATIVE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "trivy-tpu",
-                     "native"))
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.ac_build.restype = ctypes.c_void_p
+    lib.ac_build.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+    ]
+    lib.ac_scan.restype = ctypes.c_int32
+    lib.ac_scan.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.ac_free.restype = None
+    lib.ac_free.argtypes = [ctypes.c_void_p]
 
 
-def _build_library() -> str | None:
-    with open(_SRC, "rb") as f:
-        src = f.read()
-    digest = hashlib.sha256(src).hexdigest()[:16]
-    out = os.path.join(_cache_dir(), f"libac-{digest}.so")
-    if os.path.exists(out):
-        return out
-    os.makedirs(_cache_dir(), exist_ok=True)
-    tmp = tempfile.mktemp(suffix=".so", dir=_cache_dir())
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except (OSError, subprocess.SubprocessError) as e:
-        stderr = getattr(e, "stderr", b"") or b""
-        _log.warn("native build failed; using python prefilter",
-                  err=str(e), stderr=stderr.decode()[:500])
-        return None
-    os.replace(tmp, out)  # atomic: concurrent builders race safely
-    return out
-
-
-def _load() -> ctypes.CDLL | None:
-    global _LIB, _LIB_FAILED
-    if _LIB is not None or _LIB_FAILED:
-        return _LIB
-    with _LOCK:
-        if _LIB is not None or _LIB_FAILED:
-            return _LIB
-        path = _build_library()
-        if path is None:
-            _LIB_FAILED = True
-            return None
-        lib = ctypes.CDLL(path)
-        lib.ac_build.restype = ctypes.c_void_p
-        lib.ac_build.argtypes = [
-            ctypes.POINTER(ctypes.c_char_p),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int32,
-        ]
-        lib.ac_scan.restype = ctypes.c_int32
-        lib.ac_scan.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_char_p,
-            ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_uint8),
-        ]
-        lib.ac_free.restype = None
-        lib.ac_free.argtypes = [ctypes.c_void_p]
-        _LIB = lib
-        return _LIB
+_LIB = LazyLibrary(_SRC, "libac", _configure)
 
 
 def available() -> bool:
-    return _load() is not None
+    return _LIB.available()
 
 
 class NativeMatcher:
     """Multi-pattern case-insensitive matcher over one byte pass."""
 
     def __init__(self, keywords: list[bytes]):
-        lib = _load()
+        lib = _LIB.load()
         if lib is None:
             raise RuntimeError("native AC library unavailable")
         self._lib = lib

@@ -1,0 +1,71 @@
+// Match-result decode: bit-packed device hit masks -> screened
+// candidate triples (query row, advisory id, rescreen flag).
+//
+// Replaces the numpy chain unpackbits -> nonzero -> fancy-gather ->
+// token-compare in trivy_tpu/detector/engine.py::_collect_unique with
+// one cache-friendly pass. The caller still lexsort-dedupes across
+// sources (main / hot / shards) and applies the rescreen memo — those
+// stay in Python where the memo lives.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 collect.cpp -o libcollect.so
+
+#include <cstdint>
+
+extern "C" {
+
+// Count set bits over the whole mask (capacity for decode_mask).
+int64_t count_bits(const uint32_t* words, int64_t n_words) {
+    int64_t total = 0;
+    for (int64_t i = 0; i < n_words; i++) {
+        total += __builtin_popcount(words[i]);
+    }
+    return total;
+}
+
+// words:    uint32[b][w32] bit-packed hit masks (bit k of word j set =>
+//           row start[b] + j*32 + k is a hit for query b)
+// start:    int64[b] window start row per query
+// n_rows:   total DB rows (bits past the end are ignored)
+// row_adv:  int32[n_rows] advisory id per row
+// row_flags:int32[n_rows]
+// adv_tok:  int64[n_adv] (space,name) token per advisory
+// q_tok:    int64[b] query name token (-2 = unknown name)
+// q_flags:  int32[b] query flags
+// flag_mask: NEEDS_HOST|RESCREEN
+// out_rows/out_ids/out_resc: capacity >= count_bits(...)
+// returns number of screened candidates written
+int64_t decode_mask(const uint32_t* words, int64_t b, int64_t w32,
+                    const int64_t* start, int64_t n_rows,
+                    const int32_t* row_adv, const int32_t* row_flags,
+                    const int64_t* adv_tok,
+                    const int64_t* q_tok, const int32_t* q_flags,
+                    int32_t flag_mask,
+                    int64_t* out_rows, int64_t* out_ids,
+                    uint8_t* out_resc) {
+    int64_t n = 0;
+    for (int64_t q = 0; q < b; q++) {
+        const uint32_t* row = words + q * w32;
+        const int64_t base = start[q];
+        const int64_t qt = q_tok[q];
+        const int32_t qf = q_flags[q];
+        for (int64_t j = 0; j < w32; j++) {
+            uint32_t bits = row[j];
+            while (bits) {
+                const int k = __builtin_ctz(bits);
+                bits &= bits - 1;
+                const int64_t ridx = base + j * 32 + k;
+                if (ridx >= n_rows) continue;
+                const int32_t id = row_adv[ridx];
+                if (adv_tok[id] != qt) continue;  // hash collision
+                out_rows[n] = q;
+                out_ids[n] = id;
+                out_resc[n] =
+                    ((row_flags[ridx] | qf) & flag_mask) != 0;
+                n++;
+            }
+        }
+    }
+    return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,70 @@
+"""ctypes binding for the C++ match-result decoder (collect.cpp).
+
+Build/load scaffolding shared with ac.py via native/build.py; callers
+fall back to the numpy path when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from trivy_tpu.native.build import LazyLibrary
+
+_SRC = os.path.join(os.path.dirname(__file__), "collect.cpp")
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.count_bits.restype = ctypes.c_int64
+    lib.count_bits.argtypes = [u32p, ctypes.c_int64]
+    lib.decode_mask.restype = ctypes.c_int64
+    lib.decode_mask.argtypes = [
+        u32p, ctypes.c_int64, ctypes.c_int64,   # words, b, w32
+        i64p, ctypes.c_int64,                   # start, n_rows
+        i32p, i32p,                             # row_adv, row_flags
+        i64p,                                   # adv_tok
+        i64p, i32p,                             # q_tok, q_flags
+        ctypes.c_int32,                         # flag_mask
+        i64p, i64p, u8p,                        # out rows/ids/resc
+    ]
+
+
+_LIB = LazyLibrary(_SRC, "libcollect", _configure)
+
+
+def available() -> bool:
+    return _LIB.available()
+
+
+def decode_mask(words: np.ndarray, start: np.ndarray, n_rows: int,
+                row_adv: np.ndarray, row_flags: np.ndarray,
+                adv_tok: np.ndarray, q_tok: np.ndarray,
+                q_flags: np.ndarray, flag_mask: int):
+    """-> (rows, ids, resc) screened candidate triples, or None when the
+    native library is unavailable. Shapes: words uint32[B, W32] in the
+    original query order; everything else as in collect.cpp."""
+    lib = _LIB.load()
+    if lib is None:
+        return None
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    b, w32 = words.shape
+    cap = int(lib.count_bits(words.reshape(-1), words.size))
+    rows = np.empty(cap, dtype=np.int64)
+    ids = np.empty(cap, dtype=np.int64)
+    resc = np.empty(cap, dtype=np.uint8)
+    n = int(lib.decode_mask(
+        words.reshape(-1), b, w32,
+        np.ascontiguousarray(start, dtype=np.int64), n_rows,
+        np.ascontiguousarray(row_adv, dtype=np.int32),
+        np.ascontiguousarray(row_flags, dtype=np.int32),
+        np.ascontiguousarray(adv_tok, dtype=np.int64),
+        np.ascontiguousarray(q_tok, dtype=np.int64),
+        np.ascontiguousarray(q_flags, dtype=np.int32),
+        flag_mask, rows, ids, resc))
+    return rows[:n], ids[:n], resc[:n].astype(bool)

@@ -199,17 +199,21 @@ class Pending:
     b: int
     window: int
 
-    def collect(self) -> np.ndarray:
-        """Block and -> bool[B, ceil32(W)] mask in original query order.
-        Bucket padding is sliced off ON DEVICE so only ~the real batch's
-        words cross the (possibly tunneled) link; the slice length rounds
-        up to 128 rows so distinct batch sizes share compiled shapes."""
+    def collect_words(self) -> np.ndarray:
+        """Block and -> uint32[B, W/32] packed hit words in original
+        query order. Bucket padding is sliced off ON DEVICE so only ~the
+        real batch's words cross the (possibly tunneled) link; the slice
+        length rounds up to 128 rows so distinct batch sizes share
+        compiled shapes."""
         cut = min(-(-self.b // 128) * 128, self.words.shape[0])
-        mask_sorted = _unpack_words(
-            np.asarray(self.words[:cut])[: self.b], self.window)
-        mask = np.empty_like(mask_sorted)
-        mask[self.order] = mask_sorted
-        return mask
+        ws = np.asarray(self.words[:cut])[: self.b]
+        out = np.empty_like(ws)
+        out[self.order] = ws
+        return out
+
+    def collect(self) -> np.ndarray:
+        """Block and -> bool[B, ceil32(W)] mask in original query order."""
+        return _unpack_words(self.collect_words(), self.window)
 
 
 def match_dispatch(ddb: DeviceDB, batch: PackageBatch) -> Pending | None:
