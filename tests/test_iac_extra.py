@@ -633,6 +633,14 @@ resource "aws_lb_listener" "h" {
         m = scan_config("tfplan.json", _json.dumps(plan).encode())
         fails = {f.id for f in (m.failures if m else [])}
         assert "AVD-AWS-0054" not in fails
+        # a wholly-unknown default_action encodes as `true`, not a list
+        # (must not crash; no exemption derivable)
+        plan["resource_changes"] = [
+            {"address": "aws_lb_listener.l",
+             "change": {"after_unknown": {"default_action": True}}}]
+        m = scan_config("tfplan.json", _json.dumps(plan).encode())
+        fails = {f.id for f in (m.failures if m else [])}
+        assert "AVD-AWS-0054" in fails
         # without the unknown mark, the same shape still fails
         plan["resource_changes"] = []
         m = scan_config("tfplan.json", _json.dumps(plan).encode())

@@ -1,0 +1,63 @@
+"""Multi-host mesh construction + sharded-engine integration
+(trivy_tpu/ops/multihost.py; virtual 8-device CPU mesh from conftest).
+The DCN tier itself cannot run in one process — these tests pin the
+single-process degenerations and the mesh/axis contracts the multi-host
+path builds on."""
+
+import random
+
+import pytest
+
+from trivy_tpu.ops import multihost
+
+
+def test_crawl_mesh_axes():
+    mesh = multihost.crawl_mesh(n_db=4)
+    assert mesh.axis_names == ("data", "db")
+    assert mesh.devices.shape == (2, 4)
+
+
+def test_crawl_mesh_default_db_axis():
+    import jax
+
+    mesh = multihost.crawl_mesh()
+    assert mesh.devices.shape == (1, jax.local_device_count())
+
+
+def test_crawl_mesh_rejects_non_divisor():
+    with pytest.raises(ValueError, match="must divide"):
+        multihost.crawl_mesh(n_db=3)
+    with pytest.raises(ValueError, match="must divide"):
+        multihost.crawl_mesh(n_db=16)
+
+
+def test_bootstrap_noop_single_process(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert multihost.bootstrap() is False
+
+
+def test_globalize_batch_identity_single_process():
+    import numpy as np
+
+    mesh = multihost.crawl_mesh(n_db=4)
+    arrays = {"h1": np.arange(8, dtype=np.uint32)}
+    out = multihost.globalize_batch(mesh, arrays)
+    assert out["h1"] is arrays["h1"]
+
+
+def test_engine_over_crawl_mesh_zero_diff():
+    """The match engine over a crawl_mesh-built mesh equals the oracle
+    (same contract as the driver's dryrun_multichip)."""
+    from test_match import _random_db, _random_queries
+
+    from trivy_tpu.detector.engine import MatchEngine
+
+    mesh = multihost.crawl_mesh(n_db=4)
+    engine = MatchEngine(_random_db(random.Random(17)), window=32,
+                         mesh=mesh)
+    queries = _random_queries(random.Random(23), n=400)
+    sharded = engine.detect(queries)
+    oracle = engine.oracle_detect(queries)
+    assert [r.adv_indices for r in sharded] == \
+        [r.adv_indices for r in oracle]

@@ -254,6 +254,16 @@ class TestXfsReader:
             # walk survives (bad dir skipped)
             assert dict(fs.walk())
 
+    def test_hostile_dirblklog_rejected(self, xfs_image):
+        """A crafted superblock dirblklog must not size allocations
+        (review r4g): implausible values fail at open."""
+        with open(xfs_image, "r+b") as f:
+            f.seek(192)
+            f.write(bytes([64]))
+        with open(xfs_image, "rb") as fh:
+            with pytest.raises(XfsError, match="dirblklog"):
+                Xfs(fh)
+
     def test_hostile_symlink_size_bounded(self, xfs_image):
         """A symlink claiming a huge size/extent map reads at most
         PATH_MAX-ish bytes (review r4f)."""

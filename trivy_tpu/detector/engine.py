@@ -413,6 +413,15 @@ class MatchEngine:
         # source, rows in original query indices
         parts: list[tuple] = []
 
+        def decode_numpy(mask, start, adv, rfl_col, fl, tok):
+            """numpy fallback decode of one source's bool mask."""
+            rows0, offs0 = np.nonzero(mask)
+            ridx = start[rows0] + offs0
+            ids0 = adv[ridx].astype(np.int64)
+            resc0 = ((rfl_col[ridx] | fl[rows0]) & flag_mask) != 0
+            valid = self._adv_tok[ids0] == tok[rows0]
+            return rows0[valid], ids0[valid], resc0[valid]
+
         def add_part(pending, key_h1, adv, rfl_col, sub=None, qidx=None):
             """Decode one source. sub = sub-batch (hot partition); qidx
             maps its rows back to original query indices."""
@@ -420,23 +429,15 @@ class MatchEngine:
             fl = sub.flags if sub is not None else batch.flags
             tok = q_tok if qidx is None else q_tok[qidx]
             start = np.searchsorted(key_h1, h1).astype(np.int64)
+            decoded = None
             if native is not None:
                 decoded = native.decode_mask(
                     pending.collect_words(), start, len(key_h1),
                     adv, rfl_col, self._adv_tok, tok, fl, flag_mask)
-            else:
-                decoded = None
             if decoded is None:
-                mask = pending.collect()
-                rows0, offs0 = np.nonzero(mask)
-                ridx = start[rows0] + offs0
-                ids0 = adv[ridx].astype(np.int64)
-                resc0 = ((rfl_col[ridx] | fl[rows0]) & flag_mask) != 0
-                valid = self._adv_tok[ids0] == tok[rows0]
-                rows0, ids0, resc0 = \
-                    rows0[valid], ids0[valid], resc0[valid]
-            else:
-                rows0, ids0, resc0 = decoded
+                decoded = decode_numpy(pending.collect(), start, adv,
+                                       rfl_col, fl, tok)
+            rows0, ids0, resc0 = decoded
             if qidx is not None:
                 rows0 = np.asarray(qidx, dtype=np.int64)[rows0]
             parts.append((rows0, ids0, resc0))
@@ -451,13 +452,9 @@ class MatchEngine:
                     break
                 start = np.searchsorted(
                     cdb.row_h1[lo_i:hi_i], batch.h1).astype(np.int64) + lo_i
-                rows_d, offs_d = np.nonzero(masks[d])
-                ridx = start[rows_d] + offs_d
-                ids_d = cdb.row_adv[ridx].astype(np.int64)
-                resc_d = ((cdb.row_flags[ridx] | batch.flags[rows_d])
-                          & flag_mask) != 0
-                valid = self._adv_tok[ids_d] == q_tok[rows_d]
-                parts.append((rows_d[valid], ids_d[valid], resc_d[valid]))
+                parts.append(decode_numpy(
+                    masks[d], start, cdb.row_adv, cdb.row_flags,
+                    batch.flags, q_tok))
         elif ctx["main"] is not None:
             add_part(ctx["main"], cdb.row_h1, cdb.row_adv, cdb.row_flags)
 

@@ -127,8 +127,16 @@ class Xfs:
             if version == 5 else 0
         # v4 keeps ftype in features2 (XFS_SB_VERSION2_FTYPE 0x200)
         features2 = struct.unpack_from(">I", raw, 200)[0]
+        block_size = struct.unpack_from(">I", raw, 4)[0]
+        dirblklog = raw[192]
+        # untrusted images: directory block size drives allocations in
+        # read_dir; real XFS caps it at 64 KiB (mkfs -n size=)
+        if not 512 <= block_size <= 65536:
+            raise XfsError(f"implausible block size {block_size}")
+        if dirblklog > 7 or (block_size << dirblklog) > (1 << 16):
+            raise XfsError(f"implausible dirblklog {dirblklog}")
         return Superblock(
-            block_size=struct.unpack_from(">I", raw, 4)[0],
+            block_size=block_size,
             rootino=struct.unpack_from(">Q", raw, 56)[0],
             agblocks=struct.unpack_from(">I", raw, 84)[0],
             agcount=struct.unpack_from(">I", raw, 88)[0],
@@ -136,7 +144,7 @@ class Xfs:
             inopblock=struct.unpack_from(">H", raw, 106)[0],
             inopblog=raw[123],
             agblklog=raw[124],
-            dirblklog=raw[192],
+            dirblklog=dirblklog,
             version=version,
             ftype=bool(features_incompat & INCOMPAT_FTYPE)
             or bool(version == 4 and features2 & 0x200),

@@ -779,7 +779,11 @@ def _plan_resource(res: dict,
 
         redirect_https = False
         acts = vals.get("default_action") or []
-        unk_acts = unknown.get("default_action") or []
+        # a wholly-unknown attribute encodes as the literal `true` in
+        # after_unknown, not a mirrored list
+        unk_acts = unknown.get("default_action")
+        if not isinstance(unk_acts, list):
+            unk_acts = []
         for i, act in enumerate(acts):
             if not isinstance(act, dict) or act.get("type") != "redirect":
                 continue
