@@ -765,3 +765,133 @@ resource "google_container_cluster" "c2" {
 }
 ''')
         assert "AVD-GCP-0061" not in {f.id for f in m.failures}
+
+
+class TestMiscProviders:
+    """r4: github/digitalocean/openstack/oracle/cloudstack/nifcloud
+    terraform checks (reference pkg/iac/providers small providers)."""
+
+    def _fails(self, tf: bytes) -> set[str]:
+        from trivy_tpu.misconf.scanner import scan_config
+
+        m = scan_config("main.tf", tf)
+        return {f.id for f in (m.failures if m else [])}
+
+    def test_insecure_resources_fail(self):
+        fails = self._fails(b'''
+resource "github_repository" "r" {
+  name = "app"
+  visibility = "public"
+  vulnerability_alerts = false
+}
+resource "github_branch_protection" "b" { pattern = "main" }
+resource "github_actions_environment_secret" "s" {
+  secret_name = "token"
+  plaintext_value = "hunter2"
+}
+resource "digitalocean_firewall" "f" {
+  inbound_rule {
+    protocol = "tcp"
+    source_addresses = ["0.0.0.0/0"]
+  }
+}
+resource "digitalocean_loadbalancer" "lb" {
+  forwarding_rule { entry_protocol = "http" }
+}
+resource "digitalocean_droplet" "d" { image = "ubuntu" }
+resource "digitalocean_spaces_bucket" "sb" { acl = "public-read" }
+resource "openstack_compute_instance_v2" "i" { admin_pass = "pw" }
+resource "openstack_networking_secgroup_rule_v2" "sg" {
+  direction = "ingress"
+  remote_ip_prefix = "0.0.0.0/0"
+}
+resource "opc_compute_ip_address_reservation" "ip" {
+  parent_pool = "x"
+  pool = "public-ippool"
+}
+resource "cloudstack_instance" "c" {
+  user_data = "export DB_PASSWORD=hunter2"
+}
+resource "nifcloud_security_group_rule" "n" {
+  type = "IN"
+  cidr_ip = "0.0.0.0/0"
+}
+resource "nifcloud_load_balancer" "nlb" {
+  load_balancer_protocol = "HTTP"
+}
+''')
+        assert {"AVD-GIT-0001", "AVD-GIT-0002", "AVD-GIT-0003",
+                "AVD-GIT-0004", "AVD-DIG-0001", "AVD-DIG-0003",
+                "AVD-DIG-0004", "AVD-DIG-0006", "AVD-DIG-0007",
+                "AVD-OPNSTK-0001", "AVD-OPNSTK-0002", "AVD-OCI-0001",
+                "AVD-CLDSTK-0001", "AVD-NIF-0001",
+                "AVD-NIF-0002"} <= fails
+
+    def test_hardened_resources_pass(self):
+        fails = self._fails(b'''
+resource "github_repository" "r" {
+  name = "app"
+  visibility = "private"
+  vulnerability_alerts = true
+}
+resource "github_branch_protection" "b" {
+  pattern = "main"
+  require_signed_commits = true
+}
+resource "digitalocean_firewall" "f" {
+  inbound_rule {
+    protocol = "tcp"
+    source_addresses = ["10.0.0.0/8"]
+  }
+}
+resource "digitalocean_loadbalancer" "lb" {
+  redirect_http_to_https = true
+  forwarding_rule { entry_protocol = "http" }
+}
+resource "digitalocean_droplet" "d" {
+  image = "ubuntu"
+  ssh_keys = ["1234"]
+}
+resource "digitalocean_spaces_bucket" "sb" {
+  acl = "private"
+  versioning { enabled = true }
+}
+resource "openstack_networking_secgroup_rule_v2" "sg" {
+  direction = "ingress"
+  remote_ip_prefix = "192.168.0.0/16"
+}
+resource "nifcloud_security_group_rule" "n" {
+  type = "OUT"
+  cidr_ip = "0.0.0.0/0"
+}
+resource "nifcloud_load_balancer" "nlb" {
+  load_balancer_protocol = "HTTPS"
+}
+''')
+        assert not fails & {"AVD-GIT-0001", "AVD-GIT-0002", "AVD-GIT-0003",
+                            "AVD-DIG-0001", "AVD-DIG-0003", "AVD-DIG-0004",
+                            "AVD-DIG-0006", "AVD-DIG-0007",
+                            "AVD-OPNSTK-0002", "AVD-NIF-0001",
+                            "AVD-NIF-0002"}
+
+    def test_unresolved_stays_silent(self):
+        fails = self._fails(b'''
+resource "github_repository" "r" {
+  name = "app"
+  visibility = var.vis
+}
+resource "digitalocean_droplet" "d" {
+  image = "ubuntu"
+  ssh_keys = var.keys
+}
+resource "digitalocean_loadbalancer" "lb" {
+  redirect_http_to_https = var.redir
+  forwarding_rule { entry_protocol = "http" }
+}
+resource "nifcloud_security_group_rule" "n" {
+  type = var.direction
+  cidr_ip = "0.0.0.0/0"
+}
+''')
+        assert not fails & {"AVD-GIT-0001", "AVD-DIG-0004",
+                            "AVD-DIG-0003", "AVD-NIF-0001"}

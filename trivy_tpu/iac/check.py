@@ -76,6 +76,7 @@ def _load_builtins():
             docker,
             gcp,
             kubernetes,
+            providers_misc,
         )
         _loaded = True
 

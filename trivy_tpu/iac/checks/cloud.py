@@ -56,8 +56,10 @@ def _tf_tristate(b: Block, name: str, absent_default):
 def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
     out: list[CloudResource] = []
     from trivy_tpu.iac.checks.gcp import adapt_terraform_gcp
+    from trivy_tpu.iac.checks.providers_misc import adapt_terraform_misc
 
     out.extend(adapt_terraform_gcp(blocks))
+    out.extend(adapt_terraform_misc(blocks))
     res_blocks = [b for b in blocks if b.type == "resource" and
                   len(b.labels) >= 2]
     # companion resources referenced by bucket: aws_s3_bucket_* attach
