@@ -175,3 +175,68 @@ class TestCLIIntegration:
         rows = {r["ID"]: r for r in doc["SummaryControls"]}
         # ADD instead of COPY -> control 4.9 fails
         assert rows["4.9"]["TotalFail"] >= 1
+
+
+class TestNewBuiltinSpecs:
+    def test_all_builtin_specs_parse(self):
+        for name in ("k8s-cis-1.23", "eks-cis-1.4", "rke2-cis-1.24",
+                     "aws-cis-1.4", "aws-cis-1.2"):
+            cs = get_compliance_spec(name)
+            assert cs.spec.id == name
+            assert cs.spec.controls
+            assert cs.scanners() == ["misconfig"]
+
+    def test_k8s_cis_cli_with_node_info(self, tmp_path, capsys):
+        """k8s-cis over manifests incl. a NodeInfo doc: control-plane
+        and node-collector KCV findings land in the right controls."""
+        import json as _json
+
+        from trivy_tpu.cli.main import main
+
+        (tmp_path / "apiserver.yaml").write_text("""
+apiVersion: v1
+kind: Pod
+metadata:
+  name: kube-apiserver
+  namespace: kube-system
+  labels: {component: kube-apiserver, tier: control-plane}
+spec:
+  containers:
+  - name: kube-apiserver
+    image: registry.k8s.io/kube-apiserver:v1.29.0
+    command: [kube-apiserver, --anonymous-auth=true,
+              --authorization-mode=AlwaysAllow]
+""")
+        (tmp_path / "nodeinfo.json").write_text(_json.dumps({
+            "apiVersion": "v1", "kind": "NodeInfo",
+            "nodeName": "worker-1",
+            "info": {"kubeletAnonymousAuthArgumentSet":
+                     {"values": ["true"]}},
+        }))
+        rc = main(["kubernetes", str(tmp_path), "--compliance",
+                   "k8s-cis-1.23", "--format", "json", "--quiet"])
+        assert rc == 0
+        doc = _json.loads(capsys.readouterr().out)
+        fails = {c["ID"]: c["TotalFail"] for c in doc["SummaryControls"]}
+        assert fails["1.2.1"] >= 1   # apiserver anonymous auth
+        assert fails["1.2.7"] >= 1   # AlwaysAllow
+        assert fails["4.2.1"] >= 1   # kubelet anonymous auth (node)
+        assert fails["2.1"] == 0     # etcd control not triggered
+
+    def test_aws_cis_cli_terraform(self, tmp_path, capsys):
+        """aws-cis over a terraform config scan."""
+        import json as _json
+
+        from trivy_tpu.cli.main import main
+
+        (tmp_path / "main.tf").write_text("""
+resource "aws_cloudtrail" "t" { name = "t" }
+resource "aws_ebs_volume" "v" { size = 10 }
+""")
+        rc = main(["config", str(tmp_path), "--compliance", "aws-cis-1.4",
+                   "--format", "json", "--quiet"])
+        assert rc == 0
+        doc = _json.loads(capsys.readouterr().out)
+        fails = {c["ID"]: c["TotalFail"] for c in doc["SummaryControls"]}
+        assert fails["3.1"] >= 1    # multi-region trail
+        assert fails["2.2.1"] >= 1  # ebs encryption

@@ -810,8 +810,9 @@ resource "opc_compute_ip_address_reservation" "ip" {
   pool = "public-ippool"
 }
 resource "cloudstack_instance" "c" {
-  user_data = "export DB_PASSWORD=hunter2"
+  user_data = "ZXhwb3J0IERCX1BBU1NXT1JEPWh1bnRlcjI="
 }
+resource "digitalocean_kubernetes_cluster" "k" { name = "k" }
 resource "nifcloud_security_group_rule" "n" {
   type = "IN"
   cidr_ip = "0.0.0.0/0"
@@ -825,7 +826,8 @@ resource "nifcloud_load_balancer" "nlb" {
                 "AVD-DIG-0004", "AVD-DIG-0006", "AVD-DIG-0007",
                 "AVD-OPNSTK-0001", "AVD-OPNSTK-0002", "AVD-OCI-0001",
                 "AVD-CLDSTK-0001", "AVD-NIF-0001",
-                "AVD-NIF-0002"} <= fails
+                "AVD-NIF-0002", "AVD-DIG-0005",
+                "AVD-DIG-0008"} <= fails
 
     def test_hardened_resources_pass(self):
         fails = self._fails(b'''

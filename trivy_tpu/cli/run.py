@@ -467,6 +467,16 @@ def run_k8s(args) -> int:
         except (SpecError, OSError) as e:
             raise FatalError(f"compliance spec: {e}")
         scanners = set(compliance_spec.scanners()) & valid or {"misconfig"}
+        # KCV controls are produced by the infra/node assessment and the
+        # RBAC-range KSV ids (KSV041-053, the rbac.py rule set) by the
+        # RBAC assessment, not by the per-resource misconfig scan
+        spec_ids = {c.id for ctrl in compliance_spec.spec.controls
+                    for c in ctrl.checks}
+        if any(i.startswith("AVD-KCV-") for i in spec_ids):
+            scanners.add("infra")
+        rbac_ids = {f"AVD-KSV-{n:04d}" for n in range(41, 54)}
+        if spec_ids & rbac_ids:
+            scanners.add("rbac")
 
     engine = None
     if "vuln" in scanners:
@@ -492,7 +502,10 @@ def run_k8s(args) -> int:
             build_compliance_report,
             write_compliance_report,
         )
-        from trivy_tpu.types.report import Result
+        from trivy_tpu.types.report import (
+            DetectedMisconfiguration,
+            Result,
+        )
 
         results: list[Result] = []
         for rr in report.resources:
@@ -503,6 +516,20 @@ def run_k8s(args) -> int:
                     misconfigurations=rr.misconfigurations))
             for img, rep in rr.image_reports:
                 results.extend(rep.results)
+        # infra/node (KCV) and RBAC (KSV) assessments map onto the CIS
+        # control-plane/node controls (reference k8s compliance includes
+        # node-collector output)
+        for f in list(report.infra) + list(report.rbac):
+            num = "".join(ch for ch in f.id if ch.isdigit())
+            prefix = "KCV" if f.id.startswith("KCV") else "KSV"
+            results.append(Result(
+                target=f.resource, result_class="config",
+                type="kubernetes",
+                misconfigurations=[DetectedMisconfiguration(
+                    type="kubernetes", id=f.id,
+                    avd_id=f"AVD-{prefix}-{int(num or 0):04d}",
+                    title=f.title, message=f.message,
+                    severity=f.severity, status="FAIL")]))
         comp = build_compliance_report(results, compliance_spec)
         out = open(args.output, "w") if args.output else None
         try:

@@ -283,9 +283,362 @@ spec:
       severity: HIGH
 """
 
+K8S_CIS = """\
+spec:
+  id: k8s-cis-1.23
+  title: CIS Kubernetes Benchmark v1.23
+  description: CIS Kubernetes Benchmarks
+  version: "1.23"
+  platform: k8s
+  type: cis
+  relatedResources:
+    - https://www.cisecurity.org/benchmark/kubernetes
+  controls:
+    - id: 1.2.1
+      name: Ensure that the --anonymous-auth argument is set to false
+      description: Disable anonymous requests to the API server.
+      checks:
+        - id: AVD-KCV-0001
+      severity: MEDIUM
+    - id: 1.2.7
+      name: Ensure that the --authorization-mode argument is not set to
+        AlwaysAllow
+      description: Do not always authorize all requests.
+      checks:
+        - id: AVD-KCV-0007
+      severity: CRITICAL
+    - id: 1.2.9
+      name: Ensure that the --authorization-mode argument includes RBAC
+      description: Turn on Role Based Access Control.
+      checks:
+        - id: AVD-KCV-0009
+      severity: HIGH
+    - id: 1.2.16
+      name: Ensure that the --insecure-port argument is set to 0
+      description: Do not bind the apiserver to an insecure port.
+      checks:
+        - id: AVD-KCV-0016
+      severity: HIGH
+    - id: 1.2.18
+      name: Ensure that the --profiling argument is set to false
+      description: Disable apiserver profiling.
+      checks:
+        - id: AVD-KCV-0018
+      severity: LOW
+    - id: 1.3.1
+      name: Ensure controller-manager uses per-controller credentials
+      description: Use individual service account credentials for each
+        controller.
+      checks:
+        - id: AVD-KCV-0027
+      severity: MEDIUM
+    - id: 2.1
+      name: Ensure that etcd requires client certificates
+      description: Enable etcd client certificate authentication.
+      checks:
+        - id: AVD-KCV-0042
+      severity: HIGH
+    - id: 2.3
+      name: Ensure that the --auto-tls argument is not set to true
+      description: Do not use self-signed certificates for etcd TLS.
+      checks:
+        - id: AVD-KCV-0043
+      severity: MEDIUM
+    - id: 4.1.1
+      name: Ensure kubelet service file permissions are 644 or more
+        restrictive
+      description: Node collector checks the kubelet service file mode.
+      checks:
+        - id: AVD-KCV-0067
+      severity: HIGH
+    - id: 4.1.5
+      name: Ensure kubelet.conf permissions are 644 or more restrictive
+      description: Node collector checks kubelet.conf file mode.
+      checks:
+        - id: AVD-KCV-0069
+      severity: HIGH
+    - id: 4.1.6
+      name: Ensure kubelet.conf ownership is root:root
+      description: Node collector checks kubelet.conf ownership.
+      checks:
+        - id: AVD-KCV-0070
+      severity: HIGH
+    - id: 4.2.1
+      name: Ensure that the --anonymous-auth argument is set to false
+        (kubelet)
+      description: Disable anonymous requests to the kubelet.
+      checks:
+        - id: AVD-KCV-0077
+      severity: CRITICAL
+    - id: 4.2.2
+      name: Ensure that the kubelet --authorization-mode is not
+        AlwaysAllow
+      description: Do not allow all requests to the kubelet.
+      checks:
+        - id: AVD-KCV-0078
+      severity: CRITICAL
+    - id: 4.2.4
+      name: Ensure that the --read-only-port argument is set to 0
+      description: Disable the kubelet read-only port.
+      checks:
+        - id: AVD-KCV-0080
+      severity: HIGH
+    - id: 4.2.6
+      name: Ensure that the --protect-kernel-defaults argument is true
+      description: Protect tuned kernel parameters from overriding.
+      checks:
+        - id: AVD-KCV-0082
+      severity: HIGH
+    - id: 5.1.1
+      name: Ensure that the cluster-admin role is only used where
+        required
+      description: Avoid binding cluster-admin broadly.
+      checks:
+        - id: AVD-KSV-0051
+      severity: HIGH
+    - id: 5.2.2
+      name: Minimize the admission of privileged containers
+      description: Do not run privileged containers.
+      checks:
+        - id: AVD-KSV-0017
+      severity: HIGH
+    - id: 5.2.5
+      name: Minimize the admission of containers wishing to share the
+        host network namespace
+      description: Do not use hostNetwork.
+      checks:
+        - id: AVD-KSV-0009
+      severity: HIGH
+"""
+
+EKS_CIS = """\
+spec:
+  id: eks-cis-1.4
+  title: AWS EKS CIS Foundations v1.4
+  description: AWS EKS CIS Foundations
+  version: "1.4"
+  platform: eks
+  type: cis
+  relatedResources:
+    - https://www.cisecurity.org/benchmark/kubernetes
+  controls:
+    - id: 3.1.1
+      name: Ensure kubeconfig file permissions are 644 or more
+        restrictive
+      description: Node collector checks worker kubeconfig file mode.
+      checks:
+        - id: AVD-KCV-0073
+      severity: HIGH
+    - id: 3.1.2
+      name: Ensure kubelet kubeconfig ownership is root:root
+      description: Node collector checks worker kubeconfig ownership.
+      checks:
+        - id: AVD-KCV-0074
+      severity: HIGH
+    - id: 3.2.1
+      name: Ensure that the kubelet --anonymous-auth is false
+      description: Disable anonymous kubelet requests.
+      checks:
+        - id: AVD-KCV-0077
+      severity: CRITICAL
+    - id: 3.2.4
+      name: Ensure that the --read-only-port is disabled
+      description: Disable the kubelet read-only port.
+      checks:
+        - id: AVD-KCV-0080
+      severity: HIGH
+    - id: 3.2.6
+      name: Ensure that the --make-iptables-util-chains argument is true
+      description: Let the kubelet manage iptables.
+      checks:
+        - id: AVD-KCV-0083
+      severity: HIGH
+    - id: 4.1.1
+      name: Ensure that the cluster-admin role is only used where
+        required
+      description: Avoid binding cluster-admin broadly.
+      checks:
+        - id: AVD-KSV-0051
+      severity: HIGH
+    - id: 4.2.1
+      name: Minimize the admission of privileged containers
+      description: Do not run privileged containers.
+      checks:
+        - id: AVD-KSV-0017
+      severity: HIGH
+    - id: 5.4.2
+      name: Ensure clusters are created with private endpoint enabled
+        and public access disabled
+      description: EKS cluster endpoint should not be public.
+      checks:
+        - id: AVD-AWS-0040
+      severity: CRITICAL
+"""
+
+RKE2_CIS = """\
+spec:
+  id: rke2-cis-1.24
+  title: RKE2 CIS Benchmark v1.24
+  description: CIS benchmark controls for RKE2 clusters
+  version: "1.24"
+  platform: rke2
+  type: cis
+  relatedResources:
+    - https://www.cisecurity.org/benchmark/kubernetes
+  controls:
+    - id: 1.2.1
+      name: Ensure that the --anonymous-auth argument is set to false
+      description: Disable anonymous requests to the API server.
+      checks:
+        - id: AVD-KCV-0001
+      severity: MEDIUM
+    - id: 1.2.7
+      name: Ensure that the --authorization-mode argument is not set to
+        AlwaysAllow
+      description: Do not always authorize all requests.
+      checks:
+        - id: AVD-KCV-0007
+      severity: CRITICAL
+    - id: 2.1
+      name: Ensure that etcd requires client certificates
+      description: Enable etcd client certificate authentication.
+      checks:
+        - id: AVD-KCV-0042
+      severity: HIGH
+    - id: 4.2.1
+      name: Ensure that the kubelet --anonymous-auth is false
+      description: Disable anonymous kubelet requests.
+      checks:
+        - id: AVD-KCV-0077
+      severity: CRITICAL
+    - id: 4.2.6
+      name: Ensure that the --protect-kernel-defaults argument is true
+      description: Protect tuned kernel parameters from overriding.
+      checks:
+        - id: AVD-KCV-0082
+      severity: HIGH
+    - id: 5.2.2
+      name: Minimize the admission of privileged containers
+      description: Do not run privileged containers.
+      checks:
+        - id: AVD-KSV-0017
+      severity: HIGH
+"""
+
+AWS_CIS_14 = """\
+spec:
+  id: aws-cis-1.4
+  title: AWS CIS Foundations Benchmark v1.4
+  description: AWS CIS Foundations (IaC surface)
+  version: "1.4"
+  platform: aws
+  type: cis
+  relatedResources:
+    - https://www.cisecurity.org/benchmark/amazon_web_services
+  controls:
+    - id: 2.1.3
+      name: Ensure MFA Delete is enabled on S3 buckets
+      description: Versioning protects against accidental deletion.
+      checks:
+        - id: AVD-AWS-0090
+      severity: MEDIUM
+    - id: 2.1.5
+      name: Ensure S3 buckets block public access
+      description: Block public access at the bucket level.
+      checks:
+        - id: AVD-AWS-0086
+      severity: HIGH
+    - id: 2.2.1
+      name: Ensure EBS volume encryption is enabled
+      description: Encrypt EBS volumes at rest.
+      checks:
+        - id: AVD-AWS-0026
+      severity: HIGH
+    - id: 2.3.1
+      name: Ensure RDS storage is encrypted
+      description: Encrypt RDS instances at rest.
+      checks:
+        - id: AVD-AWS-0080
+      severity: HIGH
+    - id: 3.1
+      name: Ensure CloudTrail is enabled in all regions
+      description: Multi-region trails capture global activity.
+      checks:
+        - id: AVD-AWS-0014
+      severity: MEDIUM
+    - id: 3.2
+      name: Ensure CloudTrail log file validation is enabled
+      description: Log validation detects tampering.
+      checks:
+        - id: AVD-AWS-0016
+      severity: HIGH
+    - id: 3.7
+      name: Ensure CloudTrail logs are encrypted with KMS CMKs
+      description: Encrypt trails with customer-managed keys.
+      checks:
+        - id: AVD-AWS-0015
+      severity: HIGH
+    - id: 5.2
+      name: Ensure no security groups allow ingress from 0.0.0.0/0 to
+        administrative ports
+      description: Restrict remote administration ingress.
+      checks:
+        - id: AVD-AWS-0107
+      severity: CRITICAL
+"""
+
+AWS_CIS_12 = """\
+spec:
+  id: aws-cis-1.2
+  title: AWS CIS Foundations Benchmark v1.2
+  description: AWS CIS Foundations (IaC surface)
+  version: "1.2"
+  platform: aws
+  type: cis
+  relatedResources:
+    - https://www.cisecurity.org/benchmark/amazon_web_services
+  controls:
+    - id: 2.1
+      name: Ensure CloudTrail is enabled in all regions
+      description: Multi-region trails capture global activity.
+      checks:
+        - id: AVD-AWS-0014
+      severity: MEDIUM
+    - id: 2.4
+      name: Ensure CloudTrail log file validation is enabled
+      description: Log validation detects tampering.
+      checks:
+        - id: AVD-AWS-0016
+      severity: HIGH
+    - id: 2.7
+      name: Ensure CloudTrail logs are encrypted with KMS CMKs
+      description: Encrypt trails with customer-managed keys.
+      checks:
+        - id: AVD-AWS-0015
+      severity: HIGH
+    - id: 4.1
+      name: Ensure no security groups allow ingress from 0.0.0.0/0 to
+        port 22
+      description: Restrict SSH ingress.
+      checks:
+        - id: AVD-AWS-0107
+      severity: CRITICAL
+    - id: 4.3
+      name: Ensure the default security group restricts all traffic
+      description: Default security groups should deny traffic.
+      checks:
+        - id: AVD-AWS-0104
+      severity: HIGH
+"""
+
 BUILTIN_SPECS: dict[str, str] = {
     "docker-cis-1.6.0": DOCKER_CIS,
     "k8s-nsa-1.0": K8S_NSA,
+    "k8s-cis-1.23": K8S_CIS,
     "k8s-pss-baseline-0.1": K8S_PSS_BASELINE,
     "k8s-pss-restricted-0.1": K8S_PSS_RESTRICTED,
+    "eks-cis-1.4": EKS_CIS,
+    "rke2-cis-1.24": RKE2_CIS,
+    "aws-cis-1.4": AWS_CIS_14,
+    "aws-cis-1.2": AWS_CIS_12,
 }

@@ -137,6 +137,17 @@ def adapt_terraform_misc(blocks: list[Block]) -> list[CloudResource]:
             cr.attrs = {"pool": _tf_value(b.get("pool"))}
         elif t == "cloudstack_instance":
             ud = _tf_value(b.get("user_data"))
+            if isinstance(ud, str):
+                # CloudStack user_data is conventionally base64; decode
+                # when decodable so markers inside are still found
+                # (reference adapters/terraform/cloudstack)
+                import base64 as _b64
+
+                try:
+                    decoded = _b64.b64decode(ud, validate=True)
+                    ud = decoded.decode("utf-8", "replace")
+                except (ValueError, UnicodeDecodeError):
+                    pass
             cr.type = "cloudstack_instance"
             cr.attrs = {"user_data": ud if isinstance(ud, str) else ""}
         elif t in ("nifcloud_security_group_rule",):
@@ -277,6 +288,18 @@ def do_k8s_auto_upgrade(ctx):
     for r in _of_type(ctx, "do_kubernetes"):
         if r.attrs.get("auto_upgrade") is False:
             out.append(r.cause("Cluster does not auto-upgrade"))
+    return out
+
+
+@check("AVD-DIG-0008", "DigitalOcean kubernetes cluster has surge "
+                       "upgrades disabled", severity="MEDIUM",
+       file_types=_TF, provider="digitalocean", service="compute",
+       resolution="Set surge_upgrade = true")
+def do_k8s_surge_upgrade(ctx):
+    out = []
+    for r in _of_type(ctx, "do_kubernetes"):
+        if r.attrs.get("surge_upgrade") is False:
+            out.append(r.cause("Cluster has surge upgrades disabled"))
     return out
 
 
