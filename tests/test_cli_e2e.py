@@ -230,3 +230,36 @@ def test_prefix_server_rejects_unprefixed(env, server_factory):  # noqa: F811
         assert r.read() == b"ok"
     with pytest.raises(urllib.error.HTTPError):
         urllib.request.urlopen(srv.address + "/healthz")
+
+
+def test_sbom_format_includes_packages_without_flag(env, fs_dir, capsys):
+    from trivy_tpu.cli.main import main
+
+    """--format cyclonedx must carry components even without
+    --list-all-pkgs (review r4h: SBOM formats ARE package lists)."""
+    rc = main(["fs", fs_dir, "--format", "cyclonedx",
+               "--cache-dir", str(env / "c1"), "--db-path",
+               str(env / "db"), "--skip-db-update", "--quiet"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    names = {c.get("name") for c in doc.get("components") or []}
+    assert "lodash" in names
+
+
+def test_exit_code_zero_without_findings(env, tmp_path, capsys):
+    from trivy_tpu.cli.main import main
+
+    """--exit-code with packages listed but no findings exits 0
+    (review r4h: findings drive the exit code, not package lists)."""
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    (clean / "package-lock.json").write_text(json.dumps({
+        "name": "app", "lockfileVersion": 3,
+        "packages": {"": {"name": "app"},
+                     "node_modules/left-pad": {"version": "1.3.0"}}}))
+    rc = main(["fs", str(clean), "--format", "json", "--exit-code", "1",
+               "--list-all-pkgs", "--cache-dir", str(env / "c2"),
+               "--db-path", str(env / "db"), "--skip-db-update",
+               "--quiet"])
+    capsys.readouterr()
+    assert rc == 0

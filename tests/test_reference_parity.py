@@ -216,16 +216,31 @@ REPO_CASES = [
 SBOM_CASES = [
     ("centos7-cdx", "sbom", "fixtures/sbom/centos-7-cyclonedx.json",
      "centos-7.json.golden", []),
+    ("centos7-spdx-json", "sbom", "fixtures/sbom/centos-7-spdx.json",
+     "centos-7.json.golden", []),
+    ("centos7-spdx-tv", "sbom", "fixtures/sbom/centos-7-spdx.txt",
+     "centos-7.json.golden", []),
+    ("centos7-intoto", "sbom",
+     "fixtures/sbom/centos-7-cyclonedx.intoto.jsonl",
+     "centos-7.json.golden", []),
+    ("minikube-kbom", "sbom", "fixtures/sbom/minikube-kbom.json",
+     "minikube-kbom.json.golden", []),
     ("fluentd-cdx", "sbom",
      "fixtures/sbom/fluentd-multiple-lockfiles-cyclonedx.json",
      "fluentd-multiple-lockfiles.json.golden", []),
 ]
 
+VEX_CASES = [
+    ("gomod-vex-file", "fs", "fixtures/repo/gomod",
+     "gomod-vex.json.golden",
+     ["--vex", os.path.join(REF, "fixtures/vex/file/openvex.json")]),
+]
+
 
 @pytest.mark.parametrize(
     "case,kind,input_rel,golden,extra",
-    REPO_CASES + SBOM_CASES,
-    ids=[c[0] for c in REPO_CASES + SBOM_CASES])
+    REPO_CASES + SBOM_CASES + VEX_CASES,
+    ids=[c[0] for c in REPO_CASES + SBOM_CASES + VEX_CASES])
 def test_reference_parity(case, kind, input_rel, golden, extra,
                           ref_db_path, tmp_path, capsys, monkeypatch):
     monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2021-08-25T12:20:30+00:00")

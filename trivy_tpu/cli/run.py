@@ -67,6 +67,10 @@ def build_engine(args):
 
 def make_scan_options(args) -> ScanOptions:
     scanners = [ScannerEnum(s) for s in args.scanners.split(",") if s]
+    # SBOM-shaped output formats ARE package lists: force full package
+    # listing (reference flag/report_flags.go forces ListAllPkgs there)
+    if getattr(args, "format", "") in ("cyclonedx", "spdx-json", "github"):
+        args.list_all_pkgs = True
     return ScanOptions(
         pkg_types=args.pkg_types.split(","),
         scanners=scanners,
@@ -305,6 +309,16 @@ def _run_scan_core(args, compliance_spec) -> int:
                   ignore_unfixed=getattr(args, "ignore_unfixed", False),
                   ignore_policy=ignore_policy)
 
+    # packages travel with results internally (VEX reachability, the
+    # dependency tree); they render under --list-all-pkgs, the
+    # dependency tree, and SBOM-shaped formats (which ARE package lists
+    # — the reference forces list-all-pkgs for them)
+    keep_pkgs = (getattr(args, "list_all_pkgs", False)
+                 or getattr(args, "dependency_tree", False))
+    if not keep_pkgs:
+        for res in report.results:
+            res.packages = []
+
     if compliance_spec is not None:
         from trivy_tpu.compliance.report import (
             build_compliance_report,
@@ -325,10 +339,12 @@ def _run_scan_core(args, compliance_spec) -> int:
                      template=args.template, severities=severities,
                      dependency_tree=getattr(args, "dependency_tree", False))
 
-    # exit-code policy (reference pkg/commands/operation/operation.go:118)
+    # exit-code policy (reference pkg/commands/operation/operation.go:118):
+    # FINDINGS drive the exit code; retained package lists do not
     if args.exit_code:
         for res in report.results:
-            if not res.is_empty:
+            if (res.vulnerabilities or res.misconfigurations
+                    or res.secrets or res.licenses):
                 return args.exit_code
     if args.exit_on_eol and report.metadata.os and report.metadata.os.eosl:
         return args.exit_on_eol

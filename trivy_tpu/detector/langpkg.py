@@ -34,7 +34,9 @@ LANG_ECOSYSTEM: dict[str, str] = {
     "swift": "swift",
     "cocoapods": "cocoapods",
     "bitnami": "bitnami",
-    "kubernetes": "kubernetes",
+    # reference driver.go: ftypes.K8sUpstream -> vulnerability.Kubernetes
+    # whose trivy-db bucket prefix is "k8s"
+    "kubernetes": "k8s",
 }
 
 # types supported for SBOM only (reference driver.go:80-85)

@@ -22,7 +22,11 @@ from trivy_tpu.types.artifact import Application
 
 
 def _app(app_type: str, path: str, pkgs) -> AnalysisResult | None:
-    pkgs = [p for p in pkgs if p and not p.empty]
+    # version-less packages are unmatchable noise EXCEPT the graph root
+    # (go.mod main module): VEX product reachability needs it
+    pkgs = [p for p in pkgs
+            if p and (not p.empty
+                      or getattr(p, "relationship", "") == "root")]
     if not pkgs:
         return None
     res = AnalysisResult()

@@ -48,9 +48,24 @@ def parse_go_mod(content: bytes) -> list[Package]:
     )
     for old, _old_v, new, new_v in replaces:
         if old in pkgs and new_v:
-            del pkgs[old]
-            pkgs[new] = _mk(new, new_v)
-    return sorted(pkgs.values(), key=lambda p: p.id)
+            prev = pkgs.pop(old)
+            # the replacement inherits the replaced module's position in
+            # the graph (a replaced direct dep is still a direct dep)
+            pkgs[new] = _mk(new, new_v, indirect=prev.indirect,
+                            relationship=prev.relationship)
+    out = sorted(pkgs.values(), key=lambda p: p.id)
+    # the main module is the graph ROOT (reference golang/mod parser):
+    # VEX products name it (pkg:golang/<module>) with the vulnerable
+    # dependency as subcomponent, so reachability needs the edge. Empty
+    # version keeps it out of vulnerability matching (detect_app skips
+    # empty packages).
+    m = re.search(r"^module\s+(\S+)", text, re.M)
+    if m:
+        root = _mk(m.group(1), "", relationship="root")
+        root.depends_on = [p.id for p in out
+                           if p.relationship == "direct"]
+        out.insert(0, root)
+    return out
 
 
 _BUILDINFO_MAGIC = b"\xff Go buildinf:"

@@ -56,6 +56,8 @@ def detect_sbom_format(path: str) -> str | None:
             head = f.read(8 * 1024 * 1024)
         doc = json.loads(head)
     except (json.JSONDecodeError, UnicodeDecodeError):
+        if head.lstrip().startswith(b"SPDXVersion:"):
+            return "spdx-tv"
         return None
     fmt = _classify_doc(doc)
     if fmt:
@@ -81,6 +83,9 @@ def decode_sbom_bytes(content: bytes) -> tuple[BlobInfo, SBOMMeta]:
 
 def decode_sbom_file(path: str) -> tuple[BlobInfo, SBOMMeta]:
     fmt = detect_sbom_format(path)
+    if fmt == "spdx-tv":
+        with open(path, encoding="utf-8", errors="replace") as f:
+            return _decode_spdx(parse_spdx_tag_value(f.read()))
     with open(path) as f:
         doc = json.load(f)
     if fmt == "attestation":
@@ -256,43 +261,149 @@ def _component_to_package(c: dict):
 # ------------------------------------------------------------ SPDX
 
 
+def parse_spdx_tag_value(text: str) -> dict:
+    """SPDX tag-value -> the JSON-shaped document _decode_spdx consumes
+    (reference supports both encodings; spdx/tvloader equivalent for the
+    subset trivy emits)."""
+    doc: dict = {"spdxVersion": "", "name": "", "packages": [],
+                 "relationships": []}
+    cur: dict | None = None
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, sep, val = line.partition(":")
+        if not sep:
+            continue
+        key, val = key.strip(), val.strip()
+        if key == "SPDXVersion":
+            doc["spdxVersion"] = val
+        elif key == "DocumentName":
+            doc["name"] = val
+        elif key == "PackageName":
+            cur = {"name": val}
+            doc["packages"].append(cur)
+        elif key == "Relationship":
+            parts = val.split()
+            if len(parts) == 3:
+                doc["relationships"].append({
+                    "spdxElementId": parts[0],
+                    "relationshipType": parts[1],
+                    "relatedSpdxElement": parts[2],
+                })
+        elif cur is not None:
+            if key == "SPDXID":
+                cur["SPDXID"] = val
+            elif key == "PackageVersion":
+                cur["versionInfo"] = val
+            elif key == "PackageSourceInfo":
+                cur["sourceInfo"] = val
+            elif key == "PrimaryPackagePurpose":
+                cur["primaryPackagePurpose"] = val
+            elif key == "PackageAttributionText":
+                cur.setdefault("attributionTexts", []).append(val)
+            elif key == "ExternalRef":
+                parts = val.split(None, 2)
+                if len(parts) == 3:
+                    cur.setdefault("externalRefs", []).append({
+                        "referenceCategory": parts[0],
+                        "referenceType": parts[1],
+                        "referenceLocator": parts[2],
+                    })
+    return doc
+
+
+def _split_evr(evr: str) -> tuple[int, str, str]:
+    """'[epoch:]ver[-rel]' -> (epoch, version, release)."""
+    epoch = 0
+    if ":" in evr:
+        e, _, evr = evr.partition(":")
+        if e.isdigit():
+            epoch = int(e)
+    ver, _, rel = evr.rpartition("-") if "-" in evr else (evr, "", "")
+    return epoch, ver or evr, rel
+
+
 def _decode_spdx(doc: dict) -> tuple[BlobInfo, SBOMMeta]:
+    """SPDX document (reference pkg/sbom/spdx/unmarshal.go): element-ID
+    prefixes classify packages (OperatingSystem / Application /
+    Package); the PURL external ref is authoritative for identity,
+    sourceInfo ('built package from: name evr') carries the source
+    package, attributionTexts carry PkgID/layer info."""
     meta = SBOMMeta(artifact_type="spdx", artifact_name=doc.get("name", ""))
     blob = BlobInfo()
     os_info = OS()
     os_pkgs: list[Package] = []
+    apps: dict[str, Application] = {}  # SPDXID -> Application
+    lang_pkgs: dict[str, tuple[str, Package]] = {}
     orphan_by_type: dict[str, Application] = {}
 
     for sp in doc.get("packages") or []:
+        spdx_id = str(sp.get("SPDXID", ""))
         purl_str = ""
         for ref in sp.get("externalRefs") or []:
             if ref.get("referenceType") == "purl":
                 purl_str = ref.get("referenceLocator", "")
                 break
-        if not purl_str:
-            # OS declaration: primaryPackagePurpose OPERATING-SYSTEM
-            if sp.get("primaryPackagePurpose") == "OPERATING-SYSTEM":
-                os_info = OS(
-                    family=sp.get("name", ""), name=sp.get("versionInfo", "")
-                )
+        if spdx_id.startswith("SPDXRef-OperatingSystem") or \
+                sp.get("primaryPackagePurpose") == "OPERATING-SYSTEM":
+            os_info = OS(
+                family=sp.get("name", ""), name=sp.get("versionInfo", "")
+            )
             continue
-        c = {
-            "purl": purl_str,
-            "version": sp.get("versionInfo", ""),
-            "bom-ref": sp.get("SPDXID", ""),
-        }
+        if spdx_id.startswith("SPDXRef-Application"):
+            name = sp.get("name", "")
+            apps[spdx_id] = Application(
+                type=sp.get("versionInfo", "") or name, file_path=name)
+            continue
+        if not purl_str:
+            continue
+        # the purl is authoritative for version identity; versionInfo
+        # renders the full EVR which may disagree with it
+        c = {"purl": purl_str, "bom-ref": spdx_id}
         pkg, kind, type_str = _component_to_package(c)
         if pkg is None:
             continue
+        src = str(sp.get("sourceInfo") or "")
+        if src.startswith("built package from:"):
+            parts = src[len("built package from:"):].strip().rsplit(" ", 1)
+            if len(parts) == 2:
+                pkg.src_name = parts[0]
+                (pkg.src_epoch, pkg.src_version,
+                 pkg.src_release) = _split_evr(parts[1])
+        for text in sp.get("attributionTexts") or []:
+            key, _, val = str(text).partition(": ")
+            if key == "PkgID":
+                pkg.id = val
+            elif key == "LayerDiffID":
+                pkg.layer.diff_id = val
+            elif key == "LayerDigest":
+                pkg.layer.digest = val
         if kind == "os":
             os_pkgs.append(pkg)
         else:
+            lang_pkgs[spdx_id] = (type_str, pkg)
+
+    # relationships place language packages under their Application
+    placed: set[str] = set()
+    for rel in doc.get("relationships") or []:
+        if rel.get("relationshipType") != "CONTAINS":
+            continue
+        owner = str(rel.get("spdxElementId", ""))
+        member = str(rel.get("relatedSpdxElement", ""))
+        if owner in apps and member in lang_pkgs:
+            apps[owner].packages.append(lang_pkgs[member][1])
+            placed.add(member)
+    for ref, (t, pkg) in lang_pkgs.items():
+        if ref not in placed:
             orphan_by_type.setdefault(
-                type_str, Application(type=type_str)
-            ).packages.append(pkg)
+                t, Application(type=t)).packages.append(pkg)
 
     blob.os = os_info
     if os_pkgs:
         blob.package_infos = [PackageInfo(packages=os_pkgs)]
-    blob.applications = [orphan_by_type[t] for t in sorted(orphan_by_type)]
+    applications = [a for a in apps.values() if a.packages]
+    applications += [orphan_by_type[t] for t in sorted(orphan_by_type)]
+    applications.sort(key=lambda a: (a.type, a.file_path))
+    blob.applications = applications
     return blob, meta

@@ -31,6 +31,7 @@ PKG_TARGETS = {
     "node-pkg": "Node.js",
     "jar": "Java",
     "k8s": "Kubernetes",
+    "kubernetes": "Kubernetes",
 }
 
 
@@ -131,9 +132,14 @@ class LocalDriver:
                 type=detail.os.family,
                 vulnerabilities=sorted(vulns, key=lambda v: v.sort_key()),
             )
-            if options.list_all_pkgs:
-                res.packages = detail.packages
-            if not res.is_empty or detail.os.detected:
+            # packages always travel with the result (the VEX
+            # reachability graph needs them); the runner strips them at
+            # render time unless --list-all-pkgs (reference behavior).
+            # Result ROWS still appear only for findings / detected OS /
+            # explicit package listing, as before.
+            res.packages = detail.packages
+            if res.vulnerabilities or detail.os.detected \
+                    or options.list_all_pkgs:
                 results.append(res)
 
         if include_lib:
@@ -149,9 +155,8 @@ class LocalDriver:
                     type=app.type,
                     vulnerabilities=sorted(vulns, key=lambda v: v.sort_key()),
                 )
-                if options.list_all_pkgs:
-                    res.packages = app.packages
-                if not res.is_empty:
+                res.packages = app.packages
+                if res.vulnerabilities or options.list_all_pkgs:
                     results.append(res)
         return results
 

@@ -55,6 +55,9 @@ ECOSYSTEM_SCHEME: dict[str, str] = {
     "swift": "generic",
     "cocoapods": "rubygems",
     "bitnami": "bitnami",
+    # trivy-db names the upstream Kubernetes CVE feed ecosystem "k8s"
+    # (bucket "k8s::Official Kubernetes CVE Feed")
+    "k8s": "generic",
     "kubernetes": "generic",
 }
 
