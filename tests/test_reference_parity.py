@@ -254,6 +254,57 @@ def test_reference_parity(case, kind, input_rel, golden, extra,
     assert mine == want, f"{case}:\n{_diff(mine, want)}"
 
 
+def test_reference_parity_vex_repository(ref_db_path, tmp_path, capsys,
+                                         monkeypatch):
+    """`--vex repo` against the reference's VEX repository fixture
+    (integration_test.go initVEXRepository layout) must match the same
+    golden as the file source."""
+    import shutil
+
+    monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2021-08-25T12:20:30+00:00")
+    from trivy_tpu.cli import run as run_mod
+
+    run_mod._ENGINE_CACHE.clear()
+    cache = tmp_path / "cache"
+    repo_dst = cache / "vex" / "repositories"
+    shutil.copytree(os.path.join(REF, "fixtures/vex/repositories"),
+                    repo_dst)
+    shutil.copy(os.path.join(REF, "fixtures/vex/file/openvex.json"),
+                repo_dst / "default" / "0.1" / "openvex.json")
+    shutil.copy(os.path.join(REF, "fixtures/vex/config/repository.yaml"),
+                cache / "vex" / "repository.yaml")
+    report = _run_cli([
+        "fs", os.path.join(REF, "fixtures/repo/gomod"),
+        "--format", "json", "--db-path", ref_db_path,
+        "--cache-dir", str(cache), "--vex", "repo", "--quiet",
+    ], capsys)
+    mine = _project(report)
+    want = _golden("gomod-vex.json.golden")
+    assert mine == want, _diff(mine, want)
+
+
+def test_reference_parity_convert_cyclonedx(tmp_path, capsys, monkeypatch):
+    """`convert --format cyclonedx` of the reference's npm report golden
+    must produce the reference's CycloneDX golden (components incl.
+    purls/versions and vulnerability affects refs)."""
+    monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2021-08-25T12:20:30+00:00")
+    mine = _run_cli(["convert", "--format", "cyclonedx",
+                     os.path.join(REF, "npm.json.golden"), "--quiet"],
+                    capsys)
+    with open(os.path.join(REF, "npm-cyclonedx.json.golden")) as f:
+        want = json.load(f)
+
+    def proj(doc):
+        comps = {(c.get("purl") or c.get("name"), c.get("version"))
+                 for c in doc.get("components") or []}
+        vulns = {(v.get("id"), a.get("ref", ""))
+                 for v in doc.get("vulnerabilities") or []
+                 for a in v.get("affects") or []}
+        return comps | {("vuln",) + t for t in vulns}
+
+    assert proj(mine) == proj(want)
+
+
 def test_reference_parity_secrets(ref_db_path, tmp_path, capsys,
                                   monkeypatch):
     monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2021-08-25T12:20:30+00:00")

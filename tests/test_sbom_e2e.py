@@ -246,3 +246,25 @@ def test_convert_roundtrip(env, tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "CVE-2019-10744" in out
+
+
+def test_spdx_application_depends_on_edges():
+    """trivy-emitted SPDX links Application->Package via DEPENDS_ON and
+    keeps the lockfile path in sourceInfo (review r4i); decode must
+    preserve both."""
+    import os
+
+    import pytest
+
+    fixture = ("/root/reference/pkg/sbom/spdx/testdata/happy/"
+               "unrelated-bom.json")
+    if not os.path.exists(fixture):
+        pytest.skip("reference checkout not available")
+    from trivy_tpu.sbom.decode import decode_sbom_file
+
+    blob, meta = decode_sbom_file(fixture)
+    apps = {(a.type, a.file_path): [p.name for p in a.packages]
+            for a in blob.applications}
+    assert ("composer", "app/composer/composer.lock") in apps
+    assert set(apps[("composer", "app/composer/composer.lock")]) == {
+        "pear/log", "pear/pear_exception"}

@@ -65,12 +65,16 @@ def build_engine(args):
     return _ENGINE_CACHE[key]
 
 
-def make_scan_options(args) -> ScanOptions:
-    scanners = [ScannerEnum(s) for s in args.scanners.split(",") if s]
-    # SBOM-shaped output formats ARE package lists: force full package
-    # listing (reference flag/report_flags.go forces ListAllPkgs there)
+def normalize_args(args) -> None:
+    """Cross-flag defaults applied once after parsing. SBOM-shaped
+    output formats ARE package lists: force full package listing
+    (reference flag/report_flags.go forces ListAllPkgs there)."""
     if getattr(args, "format", "") in ("cyclonedx", "spdx-json", "github"):
         args.list_all_pkgs = True
+
+
+def make_scan_options(args) -> ScanOptions:
+    scanners = [ScannerEnum(s) for s in args.scanners.split(",") if s]
     return ScanOptions(
         pkg_types=args.pkg_types.split(","),
         scanners=scanners,
@@ -89,6 +93,8 @@ def run_scan(args) -> int:
     from trivy_tpu.scanner.scan import Scanner
 
     from trivy_tpu.fanal.analyzers import secret_analyzer
+
+    normalize_args(args)
 
     secret_analyzer.USE_DEVICE = not getattr(args, "no_tpu", False)
 
@@ -582,7 +588,7 @@ def run_convert(args) -> int:
 def _report_from_json(doc: dict):
     """Rebuild a Report (subset) from its JSON rendering for `convert`."""
     from trivy_tpu.types import report as R
-    from trivy_tpu.types.artifact import OS, PkgIdentifier, Layer
+    from trivy_tpu.types.artifact import OS, Layer, Package, PkgIdentifier
     from trivy_tpu.types.enums import Status
 
     rep = R.Report(
@@ -638,6 +644,28 @@ def _report_from_json(doc: dict):
                     published_date=v.get("PublishedDate", ""),
                     last_modified_date=v.get("LastModifiedDate", ""),
                     vendor_severity=v.get("VendorSeverity", {}) or {},
+                ),
+            ))
+        for p in rdoc.get("Packages") or []:
+            ident = p.get("Identifier") or {}
+            res.packages.append(Package(
+                id=p.get("ID", ""), name=p.get("Name", ""),
+                version=p.get("Version", ""),
+                release=p.get("Release", ""),
+                epoch=p.get("Epoch", 0) or 0,
+                arch=p.get("Arch", ""),
+                src_name=p.get("SrcName", ""),
+                src_version=p.get("SrcVersion", ""),
+                src_release=p.get("SrcRelease", ""),
+                licenses=p.get("Licenses", []) or [],
+                relationship=p.get("Relationship", ""),
+                depends_on=p.get("DependsOn", []) or [],
+                file_path=p.get("FilePath", ""),
+                identifier=PkgIdentifier(
+                    purl=ident.get("PURL", ""), uid=ident.get("UID", "")),
+                layer=Layer(
+                    digest=(p.get("Layer") or {}).get("Digest", ""),
+                    diff_id=(p.get("Layer") or {}).get("DiffID", ""),
                 ),
             ))
         for s in rdoc.get("Secrets") or []:

@@ -352,9 +352,11 @@ def _decode_spdx(doc: dict) -> tuple[BlobInfo, SBOMMeta]:
             )
             continue
         if spdx_id.startswith("SPDXRef-Application"):
+            # trivy-emitted SPDX: package name = application TYPE,
+            # sourceInfo = lockfile path (reference spdx/unmarshal.go)
             name = sp.get("name", "")
             apps[spdx_id] = Application(
-                type=sp.get("versionInfo", "") or name, file_path=name)
+                type=name, file_path=sp.get("sourceInfo") or "")
             continue
         if not purl_str:
             continue
@@ -371,8 +373,14 @@ def _decode_spdx(doc: dict) -> tuple[BlobInfo, SBOMMeta]:
                 pkg.src_name = parts[0]
                 (pkg.src_epoch, pkg.src_version,
                  pkg.src_release) = _split_evr(parts[1])
-        for text in sp.get("attributionTexts") or []:
-            key, _, val = str(text).partition(": ")
+        # current trivy emits PkgID/Layer info as SPDX annotations;
+        # older releases used attributionTexts — read both (reference
+        # unmarshal.go checks annotations first)
+        texts = [str(a.get("comment", ""))
+                 for a in sp.get("annotations") or []]
+        texts += [str(t) for t in sp.get("attributionTexts") or []]
+        for text in texts:
+            key, _, val = text.partition(": ")
             if key == "PkgID":
                 pkg.id = val
             elif key == "LayerDiffID":
@@ -384,11 +392,12 @@ def _decode_spdx(doc: dict) -> tuple[BlobInfo, SBOMMeta]:
         else:
             lang_pkgs[spdx_id] = (type_str, pkg)
 
-    # relationships place language packages under their Application
+    # relationships place language packages under their Application:
+    # trivy links them with DEPENDS_ON (CONTAINS in older releases) —
+    # any edge type counts as membership (reference unmarshal.go
+    # parseRelationships)
     placed: set[str] = set()
     for rel in doc.get("relationships") or []:
-        if rel.get("relationshipType") != "CONTAINS":
-            continue
         owner = str(rel.get("spdxElementId", ""))
         member = str(rel.get("relatedSpdxElement", ""))
         if owner in apps and member in lang_pkgs:

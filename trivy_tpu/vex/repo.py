@@ -69,10 +69,15 @@ class Repository:
             return None
         out = {}
         for p in raw.get("packages") or []:
-            if p.get("id"):
-                out[p["id"]] = {"location": p.get("location", ""),
-                                "format": p.get("format", "openvex"),
-                                "dir": os.path.dirname(path)}
+            # the spec's JSON uses lowercase keys but Go unmarshals
+            # case-insensitively, and published indexes use both
+            pid = p.get("id") or p.get("ID")
+            if pid:
+                out[pid] = {
+                    "location": p.get("location") or p.get("Location", ""),
+                    "format": p.get("format") or p.get("Format", "openvex"),
+                    "dir": os.path.dirname(path),
+                }
         return out
 
 
