@@ -146,10 +146,14 @@ def test_k8s_good_pod():
             resources:
               limits: {cpu: "1", memory: 1Gi}
               requests: {cpu: "0.5", memory: 512Mi}
+            ports:
+            - containerPort: 8080
             securityContext:
               privileged: false
               allowPrivilegeEscalation: false
               runAsNonRoot: true
+              runAsUser: 10001
+              runAsGroup: 10001
               readOnlyRootFilesystem: true
               capabilities:
                 drop: [ALL]

@@ -298,11 +298,12 @@ apiVersion: v1
 kind: Pod
 metadata: {name: p}
 spec:
+  automountServiceAccountToken: true
   securityContext: {runAsGroup: 0}
   containers: [{name: c, image: x:1}]
 """)
         assert "KSV029" in failed
-        assert "KSV036" in failed   # default SA token automounted
+        assert "KSV036" in failed   # explicit token automount
 
     def test_token_opt_out(self):
         failed = self._scan("""
@@ -897,3 +898,54 @@ resource "nifcloud_security_group_rule" "n" {
 ''')
         assert not fails & {"AVD-GIT-0001", "AVD-DIG-0004",
                             "AVD-DIG-0003", "AVD-NIF-0001"}
+
+
+class TestHelmReviewFixesR4:
+    def test_seccomp_annotation_opt_out(self):
+        from trivy_tpu.misconf.scanner import scan_config
+
+        m = scan_config("pod.yaml", b"""
+apiVersion: v1
+kind: Pod
+metadata:
+  name: p
+  annotations:
+    seccomp.security.alpha.kubernetes.io/pod: runtime/default
+spec:
+  containers: [{name: c, image: x:1}]
+""")
+        assert "KSV104" not in {f.id for f in m.failures}
+
+    def test_helm_set_comma_joined(self):
+        from types import SimpleNamespace
+
+        from trivy_tpu.cli.run import _helm_overrides
+
+        args = SimpleNamespace(
+            helm_values=[], helm_set=["a.b=1,c=true", "d=x,y"])
+        out = _helm_overrides(args)
+        assert out == {"a": {"b": 1}, "c": True, "d": "x,y"}
+
+    def test_chart_archive_dot_prefix(self, tmp_path):
+        """tar czf ./chart entries ('./name/Chart.yaml') still scan."""
+        import io
+        import tarfile
+
+        from trivy_tpu.fanal.analyzers.config_analyzer import (
+            _render_chart_archive,
+        )
+
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+            for name, content in [
+                ("./c/Chart.yaml", b"name: c\nversion: 0.1.0\n"),
+                ("./c/values.yaml", b"{}\n"),
+                ("./c/templates/pod.yaml",
+                 b"apiVersion: v1\nkind: Pod\nmetadata: {name: p}\n"
+                 b"spec:\n  containers: [{name: c, image: x:1}]\n"),
+            ]:
+                info = tarfile.TarInfo(name)
+                info.size = len(content)
+                tar.addfile(info, io.BytesIO(content))
+        rendered = dict(_render_chart_archive(buf.getvalue()))
+        assert "templates/pod.yaml" in rendered

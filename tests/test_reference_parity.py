@@ -236,6 +236,49 @@ VEX_CASES = [
      ["--vex", os.path.join(REF, "fixtures/vex/file/openvex.json")]),
 ]
 
+# misconfiguration goldens compare (Target, Type, failing check ID)
+MISCONF_CASES = [
+    ("dockerfile", "fixtures/repo/dockerfile",
+     "dockerfile.json.golden", []),
+    ("dockerfile-pattern", "fixtures/repo/dockerfile_file_pattern",
+     "dockerfile_file_pattern.json.golden",
+     ["--file-patterns", "dockerfile:Customfile"]),
+    ("helm-tarball", "fixtures/repo/helm", "helm.json.golden", []),
+    ("helm-testchart", "fixtures/repo/helm_testchart",
+     "helm_testchart.json.golden", []),
+    ("helm-set", "fixtures/repo/helm_testchart",
+     "helm_testchart.overridden.json.golden",
+     ["--helm-set", "securityContext.runAsUser=0"]),
+    ("helm-values", "fixtures/repo/helm_testchart",
+     "helm_testchart.overridden.json.golden",
+     ["--helm-values",
+      os.path.join(REF, "fixtures/repo/helm_values/values.yaml")]),
+]
+
+
+def _project_misconf(report: dict) -> set[tuple]:
+    return {(r.get("Target"), r.get("Type"), m.get("ID"))
+            for r in report.get("Results") or []
+            for m in r.get("Misconfigurations") or []
+            if m.get("Status") != "PASS"}
+
+
+@pytest.mark.parametrize("case,input_rel,golden,extra", MISCONF_CASES,
+                         ids=[c[0] for c in MISCONF_CASES])
+def test_reference_parity_misconfig(case, input_rel, golden, extra,
+                                    tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2021-08-25T12:20:30+00:00")
+    report = _run_cli([
+        "config", os.path.join(REF, input_rel), "--format", "json",
+        "--cache-dir", str(tmp_path / "cache"), "--quiet", *extra,
+    ], capsys)
+    mine = _project_misconf(report)
+    with open(os.path.join(REF, golden)) as f:
+        want = _project_misconf(json.load(f))
+    assert mine == want, f"{case}: " + "\n".join(
+        f"{'MINE' if d in mine else 'WANT'} {d}"
+        for d in sorted(mine ^ want)[:20])
+
 
 @pytest.mark.parametrize(
     "case,kind,input_rel,golden,extra",

@@ -134,6 +134,14 @@ def _add_check_flags(p) -> None:
                         "checks; repeatable")
     p.add_argument("--include-deprecated-checks", action="store_true",
                    help="also run checks marked deprecated")
+    p.add_argument("--helm-set", action="append", default=[],
+                   dest="helm_set",
+                   help="helm value override path.to.key=value; "
+                        "repeatable")
+    p.add_argument("--helm-values", action="append", default=[],
+                   dest="helm_values",
+                   help="helm values file overriding chart defaults; "
+                        "repeatable")
     p.add_argument("--checks-bundle-repository", default="",
                    help="OCI repository for the check bundle "
                         "(overrides the builtin bundle source)")

@@ -98,6 +98,10 @@ def run_scan(args) -> int:
 
     secret_analyzer.USE_DEVICE = not getattr(args, "no_tpu", False)
 
+    from trivy_tpu.fanal.analyzers import config_analyzer
+
+    config_analyzer.HELM_OVERRIDES = _helm_overrides(args)
+
     # jar sha1->GAV lookups use the java DB when it has been imported
     # (reference pkg/javadb updater singleton)
     from trivy_tpu.db import javadb
@@ -147,6 +151,59 @@ def run_scan(args) -> int:
         if getattr(args, "trace", False):
             trace.render(sys.stderr)
             trace.enable(False)
+
+
+def _coerce_helm_value(v: str):
+    low = v.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low in ("null", "~", ""):
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        return v
+
+
+def _helm_overrides(args) -> dict:
+    """--helm-values files then --helm-set pairs -> one nested override
+    dict (later sources win, mirroring helm's precedence)."""
+    import yaml as _yaml
+
+    from trivy_tpu.iac.helm import _deep_merge
+
+    out: dict = {}
+    for path in getattr(args, "helm_values", []) or []:
+        try:
+            with open(path, encoding="utf-8") as f:
+                out = _deep_merge(out, _yaml.safe_load(f) or {})
+        except (OSError, _yaml.YAMLError) as e:
+            raise FatalError(f"--helm-values {path}: {e}")
+    for flag in getattr(args, "helm_set", []) or []:
+        # helm accepts comma-joined pairs in one flag (a=1,b=2); only
+        # split when every segment is itself a pair, so values with
+        # commas still pass through unchanged
+        segments = flag.split(",")
+        if not all("=" in s for s in segments):
+            segments = [flag]
+        for pair in segments:
+            key, sep, val = pair.partition("=")
+            if not sep or not key:
+                raise FatalError(
+                    f"--helm-set needs key=value, got {pair!r}")
+            node = out
+            parts = key.split(".")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+                if not isinstance(node, dict):
+                    raise FatalError(f"--helm-set {pair!r} conflicts "
+                                     "with a scalar override")
+            node[parts[-1]] = _coerce_helm_value(val)
+    return out
 
 
 def _configure_check_engine(args) -> None:

@@ -213,14 +213,29 @@ class AnalyzerGroup:
                 return False
             return True
 
+        # --file-patterns may name an IaC FILE type (dockerfile:...,
+        # kubernetes:...); those route to the config analyzer with a
+        # detection override (reference: the dockerfile analyzer is its
+        # own type, here one config analyzer owns all IaC types)
+        iac_types = {"dockerfile", "kubernetes", "terraform",
+                     "cloudformation", "terraformplan", "helm",
+                     "azure-arm", "yaml", "json"}
+        iac_type_pats = [(rx, atype) for atype, rxs in patterns.items()
+                         if atype in iac_types for rx in rxs]
+
         def wrap(a):
-            pats = patterns.get(a.type)
-            if not pats:
+            pats = list(patterns.get(a.type) or [])
+            type_pats = iac_type_pats if a.type == "config" else []
+            if type_pats:
+                pats.extend(rx for rx, _t in type_pats)
+            if not pats and not type_pats:
                 return a
             import copy
 
             a2 = copy.copy(a)
             a2.extra_patterns = pats
+            if type_pats:
+                a2.iac_type_patterns = type_pats
             return a2
 
         return cls(

@@ -389,13 +389,19 @@ def seccomp_profile(ctx):
        resolution="Set 'automountServiceAccountToken' to false or mount "
                   "the token only where needed")
 def automount_token(ctx):
+    """Fails on explicit opt-in only: automountServiceAccountToken=true
+    or an explicit token volumeMount (upstream rego semantics — a bare
+    pod with the field unset passes, per the reference helm goldens)."""
     spec = ctx.pod_spec or {}
-    # mounting is acceptable when the pod opts out, or when it explicitly
-    # runs as a dedicated (non-default) service account that needs it
     if spec.get("automountServiceAccountToken") is False:
         return []
-    if spec.get("automountServiceAccountToken") is True or \
-            spec.get("serviceAccountName", "default") == "default":
+    token_path = "/var/run/secrets/kubernetes.io/serviceaccount"
+    mounted = any(
+        str((vm or {}).get("mountPath", "")).rstrip("/") == token_path
+        for c in ctx.containers
+        for vm in c.get("volumeMounts") or []
+    )
+    if spec.get("automountServiceAccountToken") is True or mounted:
         return [Cause(
             message=f"{_name(ctx.resource)} should set "
                     f"'automountServiceAccountToken' to false",
@@ -479,4 +485,150 @@ def selinux_options(ctx):
                 ctx, c,
                 f"Container '{c.get('name', '')}' of {_name(ctx.resource)} "
                 f"should not set custom 'securityContext.seLinuxOptions'"))
+    return out
+
+
+@check("KSV020", "Runs with a low user ID", severity="LOW",
+       file_types=_K, avd_id="AVD-KSV-0020", provider="kubernetes",
+       service="general",
+       resolution="Set 'securityContext.runAsUser' above 10000")
+def low_user_id(ctx):
+    out = []
+    pod_uid = _pod_sc(ctx).get("runAsUser")
+    for c in ctx.containers:
+        uid = _sc(c).get("runAsUser", pod_uid)
+        try:
+            ok = uid is not None and int(uid) > 10000
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should set "
+                f"'securityContext.runAsUser' > 10000"))
+    return out
+
+
+@check("KSV021", "Runs with a low group ID", severity="LOW",
+       file_types=_K, avd_id="AVD-KSV-0021", provider="kubernetes",
+       service="general",
+       resolution="Set 'securityContext.runAsGroup' above 10000")
+def low_group_id(ctx):
+    out = []
+    pod_gid = _pod_sc(ctx).get("runAsGroup")
+    for c in ctx.containers:
+        gid = _sc(c).get("runAsGroup", pod_gid)
+        try:
+            ok = gid is not None and int(gid) > 10000
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should set "
+                f"'securityContext.runAsGroup' > 10000"))
+    return out
+
+
+def _pod_annotations(ctx) -> dict:
+    """Pod-template annotations: spec.template.metadata for workloads,
+    the object's own metadata for bare Pods."""
+    res = ctx.resource or {}
+    tmpl_meta = (((res.get("spec") or {}).get("template") or {})
+                 .get("metadata") or {})
+    meta = tmpl_meta or res.get("metadata") or {}
+    return meta.get("annotations") or {}
+
+
+@check("KSV104", "Seccomp profile not configured", severity="MEDIUM",
+       file_types=_K, avd_id="AVD-KSV-0104", provider="kubernetes",
+       service="general",
+       resolution="Set 'securityContext.seccompProfile.type'")
+def seccomp_unset(ctx):
+    out = []
+    pod_prof = (_pod_sc(ctx).get("seccompProfile") or {}).get("type")
+    annotated = any(
+        str(k).startswith("seccomp.security.alpha.kubernetes.io")
+        for k in _pod_annotations(ctx))
+    for c in ctx.containers:
+        prof = (_sc(c).get("seccompProfile") or {}).get("type", pod_prof)
+        if not prof and not annotated:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should specify a seccomp "
+                f"profile"))
+    return out
+
+
+@check("KSV105", "Container runs as root user (UID 0)", severity="LOW",
+       file_types=_K, avd_id="AVD-KSV-0105", provider="kubernetes",
+       service="general",
+       resolution="Do not set 'securityContext.runAsUser' to 0")
+def run_as_root_uid(ctx):
+    out = []
+    pod_uid = _pod_sc(ctx).get("runAsUser")
+    for c in ctx.containers:
+        uid = _sc(c).get("runAsUser", pod_uid)
+        try:
+            is_root = uid is not None and int(uid) == 0
+        except (TypeError, ValueError):
+            is_root = False
+        if is_root:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} runs with runAsUser 0"))
+    return out
+
+
+@check("KSV106", "Container capabilities beyond NET_BIND_SERVICE",
+       severity="LOW", file_types=_K, avd_id="AVD-KSV-0106",
+       provider="kubernetes", service="general",
+       resolution="Drop ALL capabilities; add only NET_BIND_SERVICE "
+                  "when needed")
+def restricted_capabilities(ctx):
+    out = []
+    for c in ctx.containers:
+        caps = _sc(c).get("capabilities") or {}
+        drop = [str(d).upper() for d in caps.get("drop") or []]
+        add = [str(a).upper() for a in caps.get("add") or []]
+        ok = "ALL" in drop and all(a == "NET_BIND_SERVICE" for a in add)
+        if not ok:
+            out.append(_container_cause(
+                ctx, c,
+                f"Container '{c.get('name', '')}' of "
+                f"{_name(ctx.resource)} should drop ALL capabilities "
+                f"and add only NET_BIND_SERVICE"))
+    return out
+
+
+@check("KSV117", "Container binds to a privileged port", severity="LOW",
+       file_types=_K, avd_id="AVD-KSV-0117", provider="kubernetes",
+       service="general",
+       resolution="Use container ports above 1024")
+def privileged_port(ctx):
+    out = []
+    for c in ctx.containers:
+        for port in c.get("ports") or []:
+            if not isinstance(port, dict):
+                continue
+            for key in ("containerPort", "hostPort"):
+                v = port.get(key)
+                try:
+                    low = v is not None and int(v) < 1024
+                except (TypeError, ValueError):
+                    low = False
+                if low:
+                    out.append(_container_cause(
+                        ctx, c,
+                        f"Container '{c.get('name', '')}' of "
+                        f"{_name(ctx.resource)} binds privileged port "
+                        f"{v}"))
+                    break
+            else:
+                continue
+            break
     return out
