@@ -922,9 +922,17 @@ spec:
         from trivy_tpu.cli.run import _helm_overrides
 
         args = SimpleNamespace(
-            helm_values=[], helm_set=["a.b=1,c=true", "d=x,y"])
+            helm_values=[], helm_set=["a.b=1,c=true", "d=x\\,y"])
         out = _helm_overrides(args)
         assert out == {"a": {"b": 1}, "c": True, "d": "x,y"}
+        # a bare segment without '=' is an error, as in helm
+        import pytest
+
+        from trivy_tpu.cli.run import FatalError
+
+        with pytest.raises(FatalError):
+            _helm_overrides(SimpleNamespace(
+                helm_values=[], helm_set=["a=1,b=x,y"]))
 
     def test_chart_archive_dot_prefix(self, tmp_path):
         """tar czf ./chart entries ('./name/Chart.yaml') still scan."""

@@ -256,6 +256,61 @@ MISCONF_CASES = [
 ]
 
 
+# SBOM-OUTPUT goldens: the report rendered as CycloneDX/SPDX compared
+# on components (group, name, version, purl) and vulnerability ids
+SBOM_OUT_CASES = [
+    ("conda-out-cdx", "rootfs", "fixtures/repo/conda", "cyclonedx",
+     "conda-cyclonedx.json.golden", []),
+    ("conda-out-spdx", "rootfs", "fixtures/repo/conda", "spdx-json",
+     "conda-spdx.json.golden", []),
+    ("conda-env-out-cdx", "fs", "fixtures/repo/conda-environment",
+     "cyclonedx", "conda-environment-cyclonedx.json.golden", []),
+    ("pom-out-cdx", "fs", "fixtures/repo/pom", "cyclonedx",
+     "pom-cyclonedx.json.golden", ["--use-db"]),
+]
+
+
+def _project_sbom_out(doc: dict) -> set[tuple]:
+    out: set[tuple] = set()
+    for c in doc.get("components") or []:
+        out.add(("comp", c.get("group") or "", c.get("name"),
+                 c.get("version"), c.get("purl") or ""))
+    for v in doc.get("vulnerabilities") or []:
+        out.add(("vuln", v.get("id")))
+    for p in doc.get("packages") or []:
+        purl = ""
+        for r in p.get("externalRefs") or []:
+            if r.get("referenceType") == "purl":
+                purl = r["referenceLocator"]
+        name = (p.get("name") or "").replace(REF + "/", "testdata/")
+        out.add(("pkg", name, p.get("versionInfo"), purl))
+    return out
+
+
+@pytest.mark.parametrize("case,cmd,input_rel,fmt,golden,extra",
+                         SBOM_OUT_CASES,
+                         ids=[c[0] for c in SBOM_OUT_CASES])
+def test_reference_parity_sbom_output(case, cmd, input_rel, fmt, golden,
+                                      extra, ref_db_path, tmp_path,
+                                      capsys, monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2021-08-25T12:20:30+00:00")
+    from trivy_tpu.cli import run as run_mod
+
+    run_mod._ENGINE_CACHE.clear()
+    args = [cmd, os.path.join(REF, input_rel), "--format", fmt,
+            "--cache-dir", str(tmp_path / "cache"), "--quiet",
+            "--skip-db-update"]
+    if "--use-db" in extra:
+        args += ["--db-path", ref_db_path]
+    doc = _run_cli(args, capsys)
+    mine = _project_sbom_out(doc)
+    with open(os.path.join(REF, golden)) as f:
+        want = _project_sbom_out(json.load(f))
+    assert mine == want, f"{case}:\n" + "\n".join(
+        f"{'MINE' if d in mine else 'WANT'} {d}"
+        for d in sorted(mine ^ want)[:20])
+
+
 def _project_misconf(report: dict) -> set[tuple]:
     return {(r.get("Target"), r.get("Type"), m.get("ID"))
             for r in report.get("Results") or []

@@ -184,12 +184,10 @@ def _helm_overrides(args) -> dict:
         except (OSError, _yaml.YAMLError) as e:
             raise FatalError(f"--helm-values {path}: {e}")
     for flag in getattr(args, "helm_set", []) or []:
-        # helm accepts comma-joined pairs in one flag (a=1,b=2); only
-        # split when every segment is itself a pair, so values with
-        # commas still pass through unchanged
-        segments = flag.split(",")
-        if not all("=" in s for s in segments):
-            segments = [flag]
+        # helm accepts comma-joined pairs in one flag (a=1,b=2); commas
+        # inside values must be escaped as '\\,' exactly like helm
+        segments = [s.replace("\x00", ",") for s in
+                    flag.replace("\\,", "\x00").split(",")]
         for pair in segments:
             key, sep, val = pair.partition("=")
             if not sep or not key:

@@ -49,7 +49,9 @@ def _strip_helm_hooks(rendered: bytes) -> bytes | None:
     chunks: list[tuple[int, int]] = []
     start = 0
     for i, line in enumerate(lines):
-        if line.strip() == "---":
+        # document separators sit at column 0; an indented literal
+        # '---' inside a block scalar is NOT a separator
+        if line.rstrip("\r\n") == "---":
             chunks.append((start, i))
             start = i + 1
     chunks.append((start, len(lines)))

@@ -34,12 +34,28 @@ def _pkg_ref(pkg) -> str:
 
 
 def _pkg_component(res: Result, pkg) -> dict:
+    name = pkg.name
+    group = ""
+    purl = pkg.identifier.purl
+    # maven GroupID and npm scopes render in the `group` field
+    # (reference sbom/io/encode.go component)
+    if purl and purl.startswith(("pkg:maven/", "pkg:npm/")):
+        try:
+            from trivy_tpu.utils.purl import parse_purl
+
+            p = parse_purl(purl)
+            name = p.name
+            group = p.namespace or ""
+        except ValueError:
+            pass
     comp: dict = {
         "bom-ref": _pkg_ref(pkg),
         "type": "library",
-        "name": pkg.name,
+        "name": name,
         "version": pkg.full_version(),
     }
+    if group:
+        comp["group"] = group
     if pkg.identifier.purl:
         comp["purl"] = pkg.identifier.purl
     props = []
@@ -111,10 +127,17 @@ def render_cyclonedx(report: Report) -> str:
     else:
         os_holder = None
 
+    # language packages not tied to a lock file hang directly off the
+    # root component (reference ftypes.AggregatingTypes + encode.go
+    # encodeResult)
+    from trivy_tpu.fanal.applier import AGGREGATE_TYPES as aggregating
+
     for res in report.results:
         cls = str(res.result_class)
         if cls == "os-pkgs" and os_holder:
             holder_ref = os_holder
+        elif res.packages and (res.type or "") in aggregating:
+            holder_ref = "__root__"
         elif res.packages:
             holder_ref = uuidgen.new()
             components.append({
@@ -157,7 +180,9 @@ def render_cyclonedx(report: Report) -> str:
             entry = {"ref": ref, "dependsOn": edges}
             dep_by_ref[ref] = entry
             dependencies.append(entry)
-        if holder_ref:
+        if holder_ref == "__root__":
+            root_deps.extend(holder_deps)
+        elif holder_ref:
             dependencies.append({"ref": holder_ref,
                                  "dependsOn": sorted(holder_deps)})
 
@@ -202,7 +227,8 @@ def render_cyclonedx(report: Report) -> str:
                 if affect not in entry["affects"]:
                     entry["affects"].append(affect)
 
-    dependencies.append({"ref": root_ref, "dependsOn": sorted(root_deps)})
+    dependencies.append({"ref": root_ref,
+                         "dependsOn": sorted(set(root_deps))})
     doc = {
         "$schema": f"http://cyclonedx.org/schema/bom-{SPEC_VERSION}.schema.json",
         "bomFormat": "CycloneDX",

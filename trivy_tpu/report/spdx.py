@@ -98,12 +98,18 @@ def render_spdx_json(report: Report) -> str:
     else:
         os_holder = None
 
+    # language packages not tied to a lock file attach directly to the
+    # root element (reference ftypes.AggregatingTypes)
+    from trivy_tpu.fanal.applier import AGGREGATE_TYPES as aggregating
+
     for res in report.results:
         cls = str(res.result_class)
         if not res.packages:
             continue
         if cls == "os-pkgs" and os_holder:
             holder = os_holder
+        elif (res.type or "") in aggregating:
+            holder = root_id
         else:
             holder = _spdx_id("Application", res.type or "", res.target)
             packages.append({
