@@ -89,7 +89,9 @@ def test_julia_manifest():
     byname = {p.name: p for p in pkgs}
     assert byname["JSON"].version == "0.21.4"
     assert byname["JSON"].id.startswith("682c06a0")
-    assert "Dates" not in byname  # stdlib, no version
+    # stdlib entries carry the manifest's julia_version (reference
+    # julia/manifest parse.go:24)
+    assert byname["Dates"].version == "1.9.0"
 
 
 def test_julia_manifest_old_flat():

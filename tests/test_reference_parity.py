@@ -234,6 +234,15 @@ VEX_CASES = [
     ("gomod-vex-file", "fs", "fixtures/repo/gomod",
      "gomod-vex.json.golden",
      ["--vex", os.path.join(REF, "fixtures/vex/file/openvex.json")]),
+    ("gomod-skip-files", "fs", "fixtures/repo/gomod",
+     "gomod-skip.json.golden",
+     ["--skip-files",
+      os.path.join(REF, "fixtures/repo/gomod/submod2/go.mod")]),
+    ("gomod-skip-dirs", "fs", "fixtures/repo/gomod",
+     "gomod-skip.json.golden",
+     ["--skip-dirs", os.path.join(REF, "fixtures/repo/gomod/submod2")]),
+    ("composer-vendor", "rootfs", "fixtures/repo/composer-vendor",
+     "composer.vendor.json.golden", []),
 ]
 
 # misconfiguration goldens compare (Target, Type, failing check ID)
@@ -267,6 +276,8 @@ SBOM_OUT_CASES = [
      "cyclonedx", "conda-environment-cyclonedx.json.golden", []),
     ("pom-out-cdx", "fs", "fixtures/repo/pom", "cyclonedx",
      "pom-cyclonedx.json.golden", ["--use-db"]),
+    ("julia-out-spdx", "rootfs", "fixtures/repo/julia", "spdx-json",
+     "julia-spdx.json.golden", []),
 ]
 
 

@@ -36,13 +36,33 @@ class FSArtifact:
     ):
         self.path = path
         self.cache = cache
-        self.walker = FSWalker(skip_files or [], skip_dirs or [])
+        # --skip-files/--skip-dirs accept paths relative to the CWD or
+        # absolute (reference fanal/artifact/local/fs.go buildPathsToSkip
+        # rebases them onto the scan root); the walker matches scan-root-
+        # relative paths
+        self.walker = FSWalker(
+            self._rebase_skips(path, skip_files or []),
+            self._rebase_skips(path, skip_dirs or []))
         self.as_rootfs = as_rootfs
         self.misconfig_only = misconfig_only
         self.parallel = max(parallel, 1)
         self.disabled = set(disabled_analyzers or set())
         self.secret_config = secret_config
         self.file_patterns = file_patterns or []
+
+    @staticmethod
+    def _rebase_skips(root: str, entries: list) -> list:
+        import os as _os
+
+        base = _os.path.abspath(root)
+        out = []
+        for e in entries:
+            ab = _os.path.abspath(e)
+            if ab != base and ab.startswith(base + _os.sep):
+                out.append(_os.path.relpath(ab, base))
+            else:
+                out.append(e)  # already scan-root-relative (or a glob)
+        return out
 
     def _group(self) -> AnalyzerGroup:
         disabled = set(self.disabled)

@@ -159,13 +159,16 @@ TYPE_OSES = {
 }
 TYPE_INDIVIDUAL_PKGS = {
     "gemspec", "node-pkg", "python-pkg", "gobinary", "rustbinary", "jar",
-    "conda-pkg",
+    "conda-pkg", "composer-vendor",
 }
+# exactly the reference's TypeLockfiles (const.go:211-231): cargo,
+# nuget, dotnet-core, packages-props, bun, and julia are NOT in it and
+# stay enabled for rootfs/image scans
 TYPE_LOCKFILES = {
-    "bundler", "npm", "yarn", "pnpm", "bun", "pip", "pipenv", "poetry", "uv",
-    "gomod", "cargo", "composer", "pom", "gradle",
-    "sbt", "nuget", "dotnet-core", "packages-props", "conan", "pub",
-    "hex", "swift", "cocoapods", "conda-environment", "julia", "sbt",
+    "bundler", "npm", "yarn", "pnpm", "pip", "pipenv", "poetry", "uv",
+    "gomod", "composer", "pom", "gradle",
+    "sbt", "conan", "pub",
+    "hex", "swift", "cocoapods", "conda-environment",
 }
 
 

@@ -51,7 +51,7 @@ def _strip_helm_hooks(rendered: bytes) -> bytes | None:
     for i, line in enumerate(lines):
         # document separators sit at column 0; an indented literal
         # '---' inside a block scalar is NOT a separator
-        if line.rstrip("\r\n") == "---":
+        if line.rstrip() == "---" and line[:1] == "-":
             chunks.append((start, i))
             start = i + 1
     chunks.append((start, len(lines)))

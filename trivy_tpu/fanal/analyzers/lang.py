@@ -280,6 +280,26 @@ class JarAnalyzer(Analyzer):
 
 
 @register
+class ComposerVendorAnalyzer(Analyzer):
+    """vendor/composer/installed.json (reference
+    analyzer/language/php/composer vendor analyzer; same entry shape as
+    composer.lock, parsed by the shared parser)."""
+
+    type = "composer-vendor"
+    version = 1
+
+    def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
+        return os.path.basename(path) == "installed.json"
+
+    def analyze(self, inp: AnalysisInput):
+        try:
+            pkgs = misc_lang.parse_composer_lock(inp.read())
+        except ValueError:
+            return None
+        return _app("composer-vendor", inp.path, pkgs)
+
+
+@register
 class CondaPkgAnalyzer(Analyzer):
     type = "conda-pkg"
     version = 1

@@ -44,6 +44,11 @@ def parse_cargo_lock(content: bytes) -> list[Package]:
 
 def parse_composer_lock(content: bytes) -> list[Package]:
     doc = json.loads(content)
+    if isinstance(doc, list):
+        # composer 1.x installed.json is a bare package array
+        doc = {"packages": doc}
+    if not isinstance(doc, dict):
+        return []
     out = []
     for section, dev in (("packages", False), ("packages-dev", True)):
         for meta in doc.get(section) or []:
@@ -411,6 +416,9 @@ def parse_julia_manifest(content: bytes) -> list[Package]:
     except tomllib.TOMLDecodeError:
         return []
     deps = doc.get("deps", doc)  # 1.7+ nests under [deps]
+    # flat (pre-1.7) manifests carry no julia_version: stdlib entries
+    # report version "unknown" (reference julia/manifest parse.go:52-57)
+    julia_version = str(doc.get("julia_version") or "unknown")
     out = []
     for name, entries in deps.items():
         if not isinstance(entries, list):
@@ -418,16 +426,21 @@ def parse_julia_manifest(content: bytes) -> list[Package]:
         for e in entries:
             if not isinstance(e, dict):
                 continue
-            version = e.get("version") or ""
+            # stdlib entries carry no version: the julia runtime
+            # provides them at the manifest's julia_version (reference
+            # julia/manifest parse.go:24)
+            version = str(e.get("version") or julia_version or "")
             uuid = e.get("uuid") or ""
-            # stdlib entries carry no version; the julia runtime provides them
             if not version:
                 continue
-            pkg = _mk(name, str(version))
+            pkg = _mk(name, version)
             if uuid:
-                pkg.id = f"{uuid}@{version}"
+                pkg.id = uuid  # manifests distinguish same-name
+                # packages by uuid (reference uses the uuid as pkg ID)
+                pkg.identifier.purl = (
+                    f"pkg:julia/{name}@{version}?uuid={uuid}")
             out.append(pkg)
-    return sorted(out, key=lambda p: (p.name, p.version))
+    return sorted(out, key=lambda p: (p.name, p.version, p.id))
 
 
 # ------------------------------------------------------------ wordpress

@@ -114,8 +114,9 @@ def render_spdx_json(report: Report) -> str:
             holder = _spdx_id("Application", res.type or "", res.target)
             packages.append({
                 "SPDXID": holder,
-                "name": res.type or res.target,
-                "sourceInfo": f"application: {res.type}" if res.type else "",
+                # reference spdx marshal names application packages
+                # after the lockfile path (the result Target)
+                "name": res.target or res.type,
                 "downloadLocation": "NONE",
                 "copyrightText": "NOASSERTION",
                 "licenseConcluded": "NOASSERTION",
@@ -160,6 +161,11 @@ def render_spdx_json(report: Report) -> str:
                     f"built package from: {pkg.src_name} "
                     f"{pkg.full_src_version()}"
                 )
+            elif cls == "lang-pkgs" and res.target \
+                    and (res.type or "") not in aggregating:
+                # reference encode.go sets SrcFile only for lock-file
+                # results, not aggregated types
+                entry["sourceInfo"] = f"package found in: {res.target}"
             packages.append(entry)
             relationships.append({
                 "spdxElementId": holder,

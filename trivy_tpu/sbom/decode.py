@@ -352,11 +352,17 @@ def _decode_spdx(doc: dict) -> tuple[BlobInfo, SBOMMeta]:
             )
             continue
         if spdx_id.startswith("SPDXRef-Application"):
-            # trivy-emitted SPDX: package name = application TYPE,
-            # sourceInfo = lockfile path (reference spdx/unmarshal.go)
+            # two trivy encodings exist: older docs name the package
+            # after the app TYPE with the lockfile path in sourceInfo;
+            # current docs name it after the lockfile path (type is
+            # inferred from member packages below)
             name = sp.get("name", "")
-            apps[spdx_id] = Application(
-                type=name, file_path=sp.get("sourceInfo") or "")
+            src = str(sp.get("sourceInfo") or "")
+            if src and not src.startswith(("application:",
+                                           "package found in:")):
+                apps[spdx_id] = Application(type=name, file_path=src)
+            else:
+                apps[spdx_id] = Application(type="", file_path=name)
             continue
         if not purl_str:
             continue
@@ -401,7 +407,11 @@ def _decode_spdx(doc: dict) -> tuple[BlobInfo, SBOMMeta]:
         owner = str(rel.get("spdxElementId", ""))
         member = str(rel.get("relatedSpdxElement", ""))
         if owner in apps and member in lang_pkgs:
-            apps[owner].packages.append(lang_pkgs[member][1])
+            app = apps[owner]
+            t, pkg = lang_pkgs[member]
+            if not app.type:
+                app.type = t  # inferred from the member's purl ecosystem
+            app.packages.append(pkg)
             placed.add(member)
     for ref, (t, pkg) in lang_pkgs.items():
         if ref not in placed:
