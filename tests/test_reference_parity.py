@@ -322,6 +322,60 @@ def test_reference_parity_sbom_output(case, cmd, input_rel, fmt, golden,
         for d in sorted(mine ^ want)[:20])
 
 
+def test_reference_parity_license_sbom(ref_db_path, tmp_path, capsys,
+                                       monkeypatch):
+    """License scan over a CycloneDX input vs the reference golden
+    (component licenses decode into packages; aggregated jar results
+    render under the 'Java' target)."""
+    monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2021-08-25T12:20:30+00:00")
+    report = _run_cli([
+        "sbom", os.path.join(REF, "fixtures/sbom/license-cyclonedx.json"),
+        "--scanners", "license", "--format", "json",
+        "--cache-dir", str(tmp_path / "cache"), "--quiet",
+    ], capsys)
+
+    def proj(doc):
+        return {(r.get("Target"), r.get("Class"), l.get("PkgName"),
+                 l.get("Name"), l.get("Category"), l.get("Severity"))
+                for r in doc.get("Results") or []
+                for l in r.get("Licenses") or []}
+
+    with open(os.path.join(REF, "license-cyclonedx.json.golden")) as f:
+        want = proj(json.load(f))
+    assert want and proj(report) == want
+
+
+@pytest.mark.parametrize("case,extra,golden", [
+    ("npm-with-dev", ["--include-dev-deps"], "npm-with-dev.json.golden"),
+    ("npm-no-dev", [], "npm.json.golden"),
+], ids=["npm-with-dev", "npm-no-dev"])
+def test_reference_parity_dev_deps(case, extra, golden, ref_db_path,
+                                   tmp_path, capsys, monkeypatch):
+    """--include-dev-deps toggles npm devDependencies exactly as the
+    reference goldens record (package lists compared)."""
+    monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2021-08-25T12:20:30+00:00")
+    from trivy_tpu.cli import run as run_mod
+
+    run_mod._ENGINE_CACHE.clear()
+    report = _run_cli([
+        "fs", os.path.join(REF, "fixtures/repo/npm"), "--list-all-pkgs",
+        "--db-path", ref_db_path, "--format", "json",
+        "--cache-dir", str(tmp_path / "cache"), "--quiet", *extra,
+    ], capsys)
+
+    def proj(doc):
+        out = _project(doc)
+        for r in doc.get("Results") or []:
+            for p in r.get("Packages") or []:
+                out.add(("pkg", r.get("Target"), p.get("Name"),
+                         p.get("Version"), p.get("Dev", False)))
+        return out
+
+    with open(os.path.join(REF, golden)) as f:
+        want = proj(json.load(f))
+    assert proj(report) == want, case
+
+
 def _project_misconf(report: dict) -> set[tuple]:
     return {(r.get("Target"), r.get("Type"), m.get("ID"))
             for r in report.get("Results") or []

@@ -49,6 +49,9 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--skip-db-update", action="store_true")
     p.add_argument("--offline-scan", action="store_true")
     p.add_argument("--list-all-pkgs", action="store_true")
+    p.add_argument("--include-dev-deps", action="store_true",
+                   help="include development dependencies (supported "
+                        "lockfiles only)")
     p.add_argument("--ignorefile", default=".trivyignore")
     p.add_argument("--ignore-policy", default=None,
                    help="finding ignore policy: .yaml condition DSL or "

@@ -79,6 +79,7 @@ def make_scan_options(args) -> ScanOptions:
         pkg_types=args.pkg_types.split(","),
         scanners=scanners,
         list_all_pkgs=args.list_all_pkgs,
+        include_dev_deps=getattr(args, "include_dev_deps", False),
         sbom_sources=[s for s in
                       getattr(args, "sbom_sources", "").split(",") if s],
         rekor_url=getattr(args, "rekor_url", "https://rekor.sigstore.dev"),

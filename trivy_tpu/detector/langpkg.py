@@ -39,6 +39,18 @@ LANG_ECOSYSTEM: dict[str, str] = {
     "kubernetes": "k8s",
 }
 
+# app type -> human-readable target when no file path
+# (reference pkg/scanner/langpkg/scan.go:17 PkgTargets)
+PKG_TARGETS = {
+    "gemspec": "Ruby",
+    "python-pkg": "Python",
+    "conda-pkg": "Conda",
+    "node-pkg": "Node.js",
+    "jar": "Java",
+    "k8s": "Kubernetes",
+    "kubernetes": "Kubernetes",
+}
+
 # types supported for SBOM only (reference driver.go:80-85)
 SBOM_ONLY = {"conda-pkg", "conda-environment", "julia", "wordpress"}
 

@@ -93,8 +93,11 @@ def scan_licenses(detail, options) -> list[Result]:
                     file_path=app.file_path, name=name, confidence=1.0,
                 ))
         if app_licenses:
+            from trivy_tpu.detector.langpkg import PKG_TARGETS
+
             results.append(Result(
-                target=app.file_path or app.type,
+                target=app.file_path
+                or PKG_TARGETS.get(app.type, app.type),
                 result_class=ResultClass.LICENSE,
                 licenses=app_licenses,
             ))

@@ -253,6 +253,14 @@ def _component_to_package(c: dict):
             pkg.id = prop.get("value", "")
         elif prop.get("name") == "aquasecurity:trivy:FilePath":
             pkg.file_path = prop.get("value", "")
+    for lic in c.get("licenses") or []:
+        if not isinstance(lic, dict):
+            continue
+        inner = lic.get("license") or {}
+        name = inner.get("name") or inner.get("id") or \
+            lic.get("expression")
+        if name:
+            pkg.licenses.append(str(name))
     if not pkg.id:
         pkg.id = f"{pkg.name}@{c.get('version', p.version)}"
     return pkg, kind[0], (kind[1] if kind[0] == "lang" else p.type)

@@ -22,17 +22,8 @@ from trivy_tpu.types.serde import from_dict
 
 _log = logger("local")
 
-# app type -> human-readable target when no file path
-# (reference pkg/scanner/langpkg/scan.go:17 PkgTargets)
-PKG_TARGETS = {
-    "gemspec": "Ruby",
-    "python-pkg": "Python",
-    "conda-pkg": "Conda",
-    "node-pkg": "Node.js",
-    "jar": "Java",
-    "k8s": "Kubernetes",
-    "kubernetes": "Kubernetes",
-}
+from trivy_tpu.detector.langpkg import PKG_TARGETS  # noqa: E402
+# (re-export: historical import site for the target-name table)
 
 
 class LocalDriver:
@@ -50,6 +41,13 @@ class LocalDriver:
             self._merge_artifact_info(detail, artifact_key)
             trace.add_meta(pkgs=len(detail.packages),
                            apps=len(detail.applications))
+        if not options.include_dev_deps:
+            # development dependencies are excluded unless requested
+            # (reference pkg/scanner/local/scan.go:438 excludeDevDeps)
+            for app in detail.applications:
+                if any(getattr(p, "dev", False) for p in app.packages):
+                    app.packages = [p for p in app.packages
+                                    if not getattr(p, "dev", False)]
         if "rekor" in (options.sbom_sources or []):
             from trivy_tpu.fanal.unpackaged import discover_sboms
 
