@@ -376,6 +376,63 @@ def test_reference_parity_dev_deps(case, extra, golden, ref_db_path,
     assert proj(report) == want, case
 
 
+def test_reference_parity_gitlab_template(ref_db_path, tmp_path, capsys,
+                                          monkeypatch):
+    """The reference's published contrib/gitlab.tpl renders unmodified
+    through the Go-template engine; vulnerability entries and the
+    dependency-files envelope match the reference golden."""
+    monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2021-08-25T12:20:30+00:00")
+    from trivy_tpu.cli import run as run_mod
+
+    run_mod._ENGINE_CACHE.clear()
+    doc = _run_cli([
+        "fs", os.path.join(REF, "fixtures/repo/npm"),
+        "--format", "template",
+        "--template", "@/root/reference/contrib/gitlab.tpl",
+        "--db-path", ref_db_path,
+        "--cache-dir", str(tmp_path / "cache"), "--quiet",
+    ], capsys)
+
+    def proj(d):
+        return {
+            (v.get("id"), v.get("severity"), v.get("solution"),
+             v.get("location", {}).get("dependency", {})
+              .get("package", {}).get("name"),
+             v.get("location", {}).get("dependency", {}).get("version"))
+            for v in d.get("vulnerabilities") or []
+        }
+
+    with open(os.path.join(REF, "npm.gitlab.golden")) as f:
+        want = json.load(f)
+    assert proj(doc) == proj(want) and proj(want)
+    assert doc.get("dependency_files") == want.get("dependency_files")
+
+
+def test_reference_parity_asff_template(ref_db_path, tmp_path, capsys,
+                                        monkeypatch):
+    """contrib/asff.tpl over the secrets fixture vs the ASFF golden."""
+    monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2021-08-25T12:20:30+00:00")
+    monkeypatch.setenv("AWS_REGION", "test-region")
+    monkeypatch.setenv("AWS_ACCOUNT_ID", "123456789012")
+    doc = _run_cli([
+        "fs", os.path.join(REF, "fixtures/repo/secrets"),
+        "--scanners", "secret", "--format", "template",
+        "--template", "@/root/reference/contrib/asff.tpl",
+        "--cache-dir", str(tmp_path / "cache"), "--quiet",
+    ], capsys)
+
+    def proj(d):
+        items = d.get("Findings") if isinstance(d, dict) else d
+        return {(f.get("Title"), f.get("Severity", {}).get("Label"),
+                 f.get("Resources", [{}])[0].get("Details", {})
+                  .get("Other", {}).get("Message"))
+                for f in items or []}
+
+    with open(os.path.join(REF, "secrets.asff.golden")) as f:
+        want = json.load(f)
+    assert proj(doc) == proj(want) and proj(want)
+
+
 def _project_misconf(report: dict) -> set[tuple]:
     return {(r.get("Target"), r.get("Type"), m.get("ID"))
             for r in report.get("Results") or []

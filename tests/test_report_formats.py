@@ -277,3 +277,33 @@ def test_dependency_tree_rendering():
     assert "└── express@4.0.0" in text
     # without the flag the tree is absent
     assert "Origin Tree" not in render_table(report)
+
+
+def test_template_sprig_substr_sha_and_date():
+    """Functions the published contrib templates rely on (review r4j):
+    substr/sha1sum plus Go date layouts with fractions and Z offsets."""
+    import datetime
+
+    from trivy_tpu.report.template import _go_date
+
+    assert render_template_str(
+        '{{ substr 0 4 "abcdefg" }}', {}) == "abcd"
+    assert render_template_str(
+        '{{ sha1sum "x" }}', {}).startswith("11f6ad8e")
+    t = datetime.datetime(2021, 8, 25, 12, 20, 30,
+                          tzinfo=datetime.timezone.utc)
+    assert _go_date("2006-01-02T15:04:05.999999999Z07:00", t) == \
+        "2021-08-25T12:20:30Z"
+    t2 = t.replace(microsecond=120000)
+    assert _go_date("2006-01-02T15:04:05.999999999Z07:00", t2) == \
+        "2021-08-25T12:20:30.12Z"
+
+
+def test_template_var_reassignment_persists():
+    """`$x = v` mutates the declaring scope across range iterations
+    (Go semantics; contrib gitlab.tpl depends on it)."""
+    out = render_template_str(
+        '{{ $f := true }}{{ range . }}'
+        '{{ if $f }}F{{ $f = false }}{{ else }},{{ end }}{{ . }}'
+        '{{ end }}', [1, 2, 3])
+    assert out == "F1,2,3"

@@ -3,6 +3,7 @@ classify package/file licenses into categories with severities."""
 
 from __future__ import annotations
 
+from trivy_tpu.detector.langpkg import PKG_TARGETS
 from trivy_tpu.types.enums import ResultClass
 from trivy_tpu.types.report import DetectedLicense, Result
 
@@ -93,8 +94,6 @@ def scan_licenses(detail, options) -> list[Result]:
                     file_path=app.file_path, name=name, confidence=1.0,
                 ))
         if app_licenses:
-            from trivy_tpu.detector.langpkg import PKG_TARGETS
-
             results.append(Result(
                 target=app.file_path
                 or PKG_TARGETS.get(app.type, app.type),

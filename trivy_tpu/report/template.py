@@ -19,11 +19,13 @@ same names the reference documents ("@contrib/junit.tpl").
 
 from __future__ import annotations
 
+import hashlib
 import html
 import json
 import os
 import re
 
+import trivy_tpu
 from trivy_tpu.types.report import Report
 from trivy_tpu.utils import clock
 
@@ -88,8 +90,8 @@ class _Range(_Node):
 
 
 class _Assign(_Node):
-    def __init__(self, var, expr):
-        self.var, self.expr = var, expr
+    def __init__(self, var, expr, declare=True):
+        self.var, self.expr, self.declare = var, expr, declare
 
 
 def _parse(tokens: list[tuple[str, str]], i: int = 0,
@@ -145,9 +147,10 @@ def _parse(tokens: list[tuple[str, str]], i: int = 0,
         elif word == "end":
             raise ValueError("unexpected {{end}}")
         else:
-            m = re.match(r"(\$\w+)\s*:?=\s*(.*)", val, re.S)
+            m = re.match(r"(\$\w+)\s*(:?=)\s*(.*)", val, re.S)
             if m and not val.startswith("$ "):
-                body.append(_Assign(m.group(1), m.group(2)))
+                body.append(_Assign(m.group(1), m.group(3),
+                                    declare=m.group(2) == ":="))
             else:
                 body.append(_Action(val))
             i += 1
@@ -175,6 +178,50 @@ def _esc_xml(s) -> str:
     return (str(s).replace("&", "&amp;").replace("<", "&lt;")
             .replace(">", "&gt;").replace('"', "&quot;")
             .replace("'", "&#39;"))
+
+
+def _go_date(layout, t=None) -> str:
+    """Go reference-time layout -> formatted timestamp (the sprig `date`
+    subset the contrib templates use)."""
+    import datetime as _dt
+
+    if t is None:
+        t = clock.now()
+    if not hasattr(t, "strftime"):
+        return clock.now_rfc3339()
+    fmt = str(layout)
+    # fractional seconds: .999... trims trailing zeros (omitted when
+    # zero), .000... is fixed-width
+    frac = ""
+    m9 = re.search(r"\.(9+)", fmt)
+    m0 = re.search(r"\.(0+)", fmt)
+    if m9:
+        micro = f"{t.microsecond:06d}"[: min(len(m9.group(1)), 6)]
+        micro = micro.rstrip("0")
+        frac = f".{micro}" if micro else ""
+        fmt = fmt.replace(m9.group(0), "\x00FRAC\x00")
+    elif m0:
+        micro = f"{t.microsecond:06d}"[: min(len(m0.group(1)), 6)]
+        frac = f".{micro}"
+        fmt = fmt.replace(m0.group(0), "\x00FRAC\x00")
+    # Z07:00 renders "Z" for UTC, else a colon offset (RFC3339)
+    off = ""
+    if "Z07:00" in fmt:
+        utcoff = t.utcoffset() if t.tzinfo else _dt.timedelta(0)
+        if not utcoff:
+            off = "Z"
+        else:
+            total = int(utcoff.total_seconds())
+            sign = "+" if total >= 0 else "-"
+            total = abs(total)
+            off = f"{sign}{total // 3600:02d}:{total % 3600 // 60:02d}"
+        fmt = fmt.replace("Z07:00", "\x00OFF\x00")
+    for go, py in (("2006", "%Y"), ("01", "%m"), ("02", "%d"),
+                   ("15", "%H"), ("04", "%M"), ("05", "%S"),
+                   ("MST", "%Z"), ("Jan", "%b"), ("Mon", "%a")):
+        fmt = fmt.replace(go, py)
+    out = t.strftime(fmt)
+    return out.replace("\x00FRAC\x00", frac).replace("\x00OFF\x00", off)
 
 
 _FUNCS = {
@@ -209,8 +256,24 @@ _FUNCS = {
     "toJson": lambda v: json.dumps(v),
     "toPrettyJson": lambda v: json.dumps(v, indent=2),
     "now": lambda: clock.now(),
-    "date": lambda fmt, t: clock.now_rfc3339(),
+    "date": lambda fmt, t=None: _go_date(fmt, t),
     "getEnv": lambda k: os.environ.get(str(k), ""),
+    "env": lambda k: os.environ.get(str(k), ""),
+    "appVersion": lambda: trivy_tpu.__version__,
+    "list": lambda *a: list(a),
+    "add": lambda *a: sum(a),
+    "toString": lambda v: str(v),
+    "splitList": lambda sep, s: str(s).split(str(sep)),
+    "trimSuffix": lambda suf, s: str(s).removesuffix(str(suf)),
+    "trimPrefix": lambda pre, s: str(s).removeprefix(str(pre)),
+    "regexMatch": lambda pat, s: bool(re.search(pat, str(s))),
+    "regexFind": lambda pat, s: (
+        (lambda m: m.group(0) if m else "")(re.search(pat, str(s)))),
+    # sprig substr start end string (end < 0 = to the end)
+    "substr": lambda start, end, s: str(s)[int(start):]
+    if int(end) < 0 else str(s)[int(start):int(end)],
+    "sha1sum": lambda s: hashlib.sha1(str(s).encode()).hexdigest(),
+    "sha256sum": lambda s: hashlib.sha256(str(s).encode()).hexdigest(),
     "join": lambda sep, xs: str(sep).join(str(x) for x in xs or []),
     "first": lambda xs: xs[0] if xs else None,
     "last": lambda xs: xs[-1] if xs else None,
@@ -300,7 +363,11 @@ class _Engine:
                 else:
                     out.append(str(v))
             elif isinstance(n, _Assign):
-                scope[n.var] = self.eval_pipeline(n.expr, dot, scope)
+                val = self.eval_pipeline(n.expr, dot, scope)
+                if not n.declare and n.var in scope:
+                    scope[n.var][0] = val
+                else:
+                    scope[n.var] = [val]
             elif isinstance(n, _If):
                 done = False
                 for cond, b in n.branches:
@@ -322,9 +389,9 @@ class _Engine:
                 for i, v in items:
                     inner = dict(scope)
                     if n.ivar:
-                        inner[n.ivar] = i
+                        inner[n.ivar] = [i]
                     if n.vvar:
-                        inner[n.vvar] = v
+                        inner[n.vvar] = [v]
                     out.append(self.render(n.body, v, inner))
         return "".join(out)
 
@@ -406,7 +473,8 @@ class _Engine:
             return None
         if atom.startswith("$"):
             var, _, path = atom.partition(".")
-            base = scope.get(var)
+            cell = scope.get(var)  # every scope entry is a [value] cell
+            base = cell[0] if cell is not None else None
             return _walk(base, path) if path else base
         if atom == ".":
             return dot
@@ -447,7 +515,7 @@ def render_template_str(tpl: str, data) -> str:
 _BUILTIN = {
     "junit.tpl": """<?xml version="1.0" ?>
 <testsuites>
-{{- range .Results }}
+{{- range . }}
     <testsuite tests="{{ len .Vulnerabilities }}" failures="{{ len .Vulnerabilities }}" name="{{ .Target | escapeXML }}" errors="0" skipped="0" time="">
     {{- range .Vulnerabilities }}
         <testcase classname="{{ .PkgName | escapeXML }}-{{ .InstalledVersion | escapeXML }}" name="[{{ .Severity }}] {{ .VulnerabilityID }}" time="">
@@ -459,25 +527,28 @@ _BUILTIN = {
 </testsuites>
 """,
     "gitlab-codequality.tpl": """[
-{{- range $i, $v := .AllVulnerabilities }}
-{{- if gt $i 0 }},{{ end }}
+{{- $first := true }}
+{{- range . }}
+{{- $target := .Target }}
+{{- range $v := .Vulnerabilities }}
+{{- if $first }}{{ $first = false }}{{ else }},{{ end }}
   {
     "type": "issue",
     "check_name": "container_scanning",
     "description": {{ printf "%s - %s" $v.VulnerabilityID $v.Title | toJson }},
     "fingerprint": "{{ $v.VulnerabilityID }}-{{ $v.PkgName }}-{{ $v.InstalledVersion }}",
     "severity": "{{ if eq $v.Severity "CRITICAL" }}critical{{ else if eq $v.Severity "HIGH" }}major{{ else if eq $v.Severity "MEDIUM" }}minor{{ else }}info{{ end }}",
-    "location": { "path": {{ $v.Target | toJson }}, "lines": { "begin": 1 } }
+    "location": { "path": {{ $target | toJson }}, "lines": { "begin": 1 } }
   }
+{{- end }}
 {{- end }}
 ]
 """,
     "html.tpl": """<!DOCTYPE html>
-<html><head><title>trivy-tpu report: {{ .ArtifactName | escapeString }}</title>
+<html><head><title>trivy-tpu report</title>
 <style>table{border-collapse:collapse}td,th{border:1px solid #999;padding:4px 8px}</style>
 </head><body>
-<h1>{{ .ArtifactName | escapeString }}</h1>
-{{- range .Results }}
+{{- range . }}
 <h2>{{ .Target | escapeString }} ({{ .Type }})</h2>
 {{- if .Vulnerabilities }}
 <table><tr><th>ID</th><th>Severity</th><th>Package</th><th>Installed</th><th>Fixed</th><th>Title</th></tr>
@@ -508,12 +579,6 @@ def _augment(report_dict: dict) -> dict:
         res.setdefault("Secrets", [])
         res.setdefault("Type", "")
     report_dict.setdefault("Results", [])
-    # convenience flattening for templates that need (target, vuln) pairs
-    report_dict["AllVulnerabilities"] = [
-        {**v, "Target": res.get("Target", "")}
-        for res in report_dict["Results"]
-        for v in res.get("Vulnerabilities", [])
-    ]
     return report_dict
 
 
@@ -534,4 +599,7 @@ def render_template(report: Report, template: str) -> str:
     elif template + ".tpl" in _BUILTIN:
         tpl = _BUILTIN[template + ".tpl"]
     data = _augment(report.to_dict())
-    return render_template_str(tpl, data)
+    # the template root is the RESULTS slice, exactly like the reference
+    # template writer (report/template.go passes report.Results), so
+    # published trivy templates (contrib/*.tpl) render unmodified
+    return render_template_str(tpl, data.get("Results") or [])
