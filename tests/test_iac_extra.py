@@ -955,5 +955,5 @@ spec:
                 info = tarfile.TarInfo(name)
                 info.size = len(content)
                 tar.addfile(info, io.BytesIO(content))
-        rendered = dict(_render_chart_archive(buf.getvalue()))
+        rendered = dict(_render_chart_archive(buf.getvalue(), None))
         assert "templates/pod.yaml" in rendered

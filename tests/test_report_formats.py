@@ -299,6 +299,16 @@ def test_template_sprig_substr_sha_and_date():
         "2021-08-25T12:20:30.12Z"
 
 
+def test_template_dollar_root():
+    """Go text/template predefines $ as the root value, including inside
+    range blocks where dot has moved (advisor r4)."""
+    data = {"Tag": "v1", "Items": [{"N": "a"}, {"N": "b"}]}
+    out = render_template_str(
+        '{{ range .Items }}{{ .N }}={{ $.Tag }};{{ end }}', data)
+    assert out == "a=v1;b=v1;"
+    assert render_template_str('{{ $ }}', "root") == "root"
+
+
 def test_template_var_reassignment_persists():
     """`$x = v` mutates the declaring scope across range iterations
     (Go semantics; contrib gitlab.tpl depends on it)."""

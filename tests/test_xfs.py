@@ -254,6 +254,65 @@ class TestXfsReader:
             # walk survives (bad dir skipped)
             assert dict(fs.walk())
 
+    def test_hostile_bmbt_cycle_bounded(self, xfs_image):
+        """A cyclic bmbt (interior block pointing to itself with on-disk
+        level kept >= 1) must raise XfsError, not blow the recursion
+        limit (advisor r4): expect_level enforces strictly-decreasing
+        levels and the visited set rejects pointer cycles."""
+        evil_ino = _ino(INODE_TABLE_BLK, 7)
+        bmbt_blk = DATA_BLK + 8
+        # interior bmbt block: level 1, one pointer... to itself
+        blk = bytearray(BS)
+        blk[0:4] = b"BMA3"
+        struct.pack_into(">HH", blk, 4, 1, 1)     # level=1, numrecs=1
+        hdr = 72
+        maxrecs = (BS - hdr) // 16
+        struct.pack_into(">Q", blk, hdr + maxrecs * 8, bmbt_blk)
+        with open(xfs_image, "r+b") as f:
+            f.seek(bmbt_blk * BS)
+            f.write(bytes(blk))
+            # bmdr root: level 2 so the first visit's expect_level (1)
+            # matches the block's level and the recursion hits the cycle
+            # (fork area of a v3 dinode = inode_size - 176 bytes)
+            fork = bytearray(INO_SIZE - 176)
+            struct.pack_into(">HH", fork, 0, 2, 1)
+            root_maxrecs = (len(fork) - 4) // 16
+            struct.pack_into(">Q", fork, 4 + root_maxrecs * 8, bmbt_blk)
+            f.seek(INODE_TABLE_BLK * BS + 7 * INO_SIZE)
+            f.write(_dinode(0o100644, 3, BS, 1, bytes(fork)))
+        with open(xfs_image, "rb") as fh:
+            fs = Xfs(fh)
+            with pytest.raises(XfsError, match="cycle"):
+                fs.read_file(fs.inode(evil_ino))
+        # a level field lying high (root says 2 levels below, block says 1)
+        with open(xfs_image, "r+b") as f:
+            fork = bytearray(INO_SIZE - 176)
+            struct.pack_into(">HH", fork, 0, 3, 1)
+            root_maxrecs = (len(fork) - 4) // 16
+            struct.pack_into(">Q", fork, 4 + root_maxrecs * 8, bmbt_blk)
+            f.seek(INODE_TABLE_BLK * BS + 7 * INO_SIZE)
+            f.write(_dinode(0o100644, 3, BS, 1, bytes(fork)))
+        with open(xfs_image, "rb") as fh:
+            fs = Xfs(fh)
+            with pytest.raises(XfsError, match="level mismatch"):
+                fs.read_file(fs.inode(evil_ino))
+        # a deep level-consistent chain can't recurse past the frame
+        # limit either: implausible root levels are rejected outright
+        with open(xfs_image, "r+b") as f:
+            fork = bytearray(INO_SIZE - 176)
+            struct.pack_into(">HH", fork, 0, 1001, 1)
+            root_maxrecs = (len(fork) - 4) // 16
+            struct.pack_into(">Q", fork, 4 + root_maxrecs * 8, bmbt_blk)
+            f.seek(INODE_TABLE_BLK * BS + 7 * INO_SIZE)
+            f.write(_dinode(0o100644, 3, BS, 1, bytes(fork)))
+        with open(xfs_image, "rb") as fh:
+            fs = Xfs(fh)
+            with pytest.raises(XfsError, match="implausible"):
+                fs.read_file(fs.inode(evil_ino))
+        # the rest of the filesystem still walks (hostile inode skipped)
+        with open(xfs_image, "rb") as fh:
+            assert dict(Xfs(fh).walk())
+
     def test_hostile_dirblklog_rejected(self, xfs_image):
         """A crafted superblock dirblklog must not size allocations
         (review r4g): implausible values fail at open."""

@@ -125,6 +125,7 @@ class ImageArtifact:
         insecure: bool = False,
         username: str = "",
         password: str = "",
+        helm_overrides: dict | None = None,
     ):
         self.target = target
         self.cache = cache
@@ -132,6 +133,7 @@ class ImageArtifact:
         self.parallel = parallel
         self.disabled = set(disabled_analyzers or set())
         self.secret_config = secret_config
+        self.helm_overrides = helm_overrides
         self.file_patterns = file_patterns or []
         self.image_sources = image_sources
         self.insecure = insecure
@@ -140,7 +142,8 @@ class ImageArtifact:
 
     def _group(self) -> AnalyzerGroup:
         group = AnalyzerGroup.build(disabled_types=self.disabled,
-                                    file_patterns=self.file_patterns)
+                                    file_patterns=self.file_patterns,
+                                    helm_overrides=self.helm_overrides)
         for a in group.analyzers + group.post_analyzers:
             if a.type == "secret" and self.secret_config:
                 a.configure(self.secret_config)
@@ -196,7 +199,8 @@ class ImageArtifact:
                 if no_secret_group is None:
                     no_secret_group = AnalyzerGroup.build(
                         disabled_types=self.disabled | {"secret"},
-                        file_patterns=self.file_patterns)
+                        file_patterns=self.file_patterns,
+                        helm_overrides=self.helm_overrides)
                 g = no_secret_group
             self._inspect_layer(g, img, i, diff_id, blob_id)
 

@@ -33,6 +33,7 @@ class FSArtifact:
         disabled_analyzers: set[str] | None = None,
         secret_config: str | None = None,
         file_patterns: list[str] | None = None,
+        helm_overrides: dict | None = None,
     ):
         self.path = path
         self.cache = cache
@@ -48,6 +49,7 @@ class FSArtifact:
         self.parallel = max(parallel, 1)
         self.disabled = set(disabled_analyzers or set())
         self.secret_config = secret_config
+        self.helm_overrides = helm_overrides
         self.file_patterns = file_patterns or []
 
     @staticmethod
@@ -74,7 +76,8 @@ class FSArtifact:
         enabled = {"config"} if self.misconfig_only else None
         group = AnalyzerGroup.build(disabled_types=disabled,
                                     enabled_types=enabled,
-                                    file_patterns=self.file_patterns)
+                                    file_patterns=self.file_patterns,
+                                    helm_overrides=self.helm_overrides)
         for a in group.analyzers + group.post_analyzers:
             if a.type == "secret" and self.secret_config:
                 a.configure(self.secret_config)

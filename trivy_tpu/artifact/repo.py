@@ -20,7 +20,7 @@ class RepoArtifact:
     def __init__(self, target: str, cache, skip_files=None, skip_dirs=None,
                  parallel: int = 5, branch: str = "", tag: str = "",
                  commit: str = "", secret_config: str | None = None,
-                 disabled_analyzers=None):
+                 disabled_analyzers=None, helm_overrides: dict | None = None):
         self.target = target
         self.cache = cache
         self.skip_files = skip_files
@@ -29,6 +29,7 @@ class RepoArtifact:
         self.branch, self.tag, self.commit = branch, tag, commit
         self.secret_config = secret_config
         self.disabled_analyzers = disabled_analyzers
+        self.helm_overrides = helm_overrides
         self._tmp: str | None = None
 
     def _checkout(self) -> str:
@@ -81,6 +82,7 @@ class RepoArtifact:
             skip_dirs=self.skip_dirs, parallel=self.parallel,
             secret_config=self.secret_config,
             disabled_analyzers=self.disabled_analyzers,
+            helm_overrides=self.helm_overrides,
         )
         ref = fs.inspect()
         ref.name = self.target

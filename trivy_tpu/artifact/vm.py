@@ -42,19 +42,22 @@ class VMArtifact:
         secret_config: str | None = None,
         file_patterns: list[str] | None = None,
         aws_client_factory=None,
+        helm_overrides: dict | None = None,
     ):
         self.target = target
         self.cache = cache
         self.parallel = parallel
         self.disabled = set(disabled_analyzers or set())
         self.secret_config = secret_config
+        self.helm_overrides = helm_overrides
         self.file_patterns = file_patterns or []
         # injectable AWS client factory for ebs:/ami: targets (tests)
         self.aws_client_factory = aws_client_factory
 
     def _group(self) -> AnalyzerGroup:
         group = AnalyzerGroup.build(disabled_types=self.disabled,
-                                    file_patterns=self.file_patterns)
+                                    file_patterns=self.file_patterns,
+                                    helm_overrides=self.helm_overrides)
         for a in group.analyzers + group.post_analyzers:
             if a.type == "secret" and self.secret_config:
                 a.configure(self.secret_config)

@@ -99,9 +99,6 @@ def run_scan(args) -> int:
 
     secret_analyzer.USE_DEVICE = not getattr(args, "no_tpu", False)
 
-    from trivy_tpu.fanal.analyzers import config_analyzer
-
-    config_analyzer.HELM_OVERRIDES = _helm_overrides(args)
 
     # jar sha1->GAV lookups use the java DB when it has been imported
     # (reference pkg/javadb updater singleton)
@@ -475,6 +472,7 @@ def _select_scanner(args, cache):
             disabled_analyzers=disabled,
             secret_config=getattr(args, "secret_config", None),
             file_patterns=getattr(args, "file_patterns", []),
+            helm_overrides=_helm_overrides(args),
         ), driver
     if cmd in ("repository", "repo"):
         from trivy_tpu.artifact.repo import RepoArtifact
@@ -488,6 +486,7 @@ def _select_scanner(args, cache):
             branch=getattr(args, "branch", ""),
             tag=getattr(args, "tag", ""),
             commit=getattr(args, "commit", ""),
+            helm_overrides=_helm_overrides(args),
         ), driver
     if cmd == "image":
         from trivy_tpu.artifact.image import ImageArtifact
@@ -509,6 +508,7 @@ def _select_scanner(args, cache):
             insecure=getattr(args, "insecure", False),
             username=getattr(args, "username", ""),
             password=getattr(args, "password", ""),
+            helm_overrides=_helm_overrides(args),
         ), driver
     if cmd == "vm":
         from trivy_tpu.artifact.vm import VMArtifact
@@ -519,6 +519,7 @@ def _select_scanner(args, cache):
             disabled_analyzers=disabled,
             secret_config=getattr(args, "secret_config", None),
             file_patterns=getattr(args, "file_patterns", []),
+            helm_overrides=_helm_overrides(args),
         ), driver
     raise FatalError(f"unsupported scan command {cmd!r}")
 

@@ -306,8 +306,17 @@ class MatchEngine:
         bounded by its own query count."""
         import numpy as np
 
+        def shed_oldest(memo: dict) -> None:
+            # dicts iterate in insertion order: shed the oldest entries
+            # down to half capacity, keeping the hot (recent) half warm
+            # instead of the thundering recompute a wholesale clear
+            # causes on long-lived servers
+            excess = len(memo) - self.crawl_cache_max // 2
+            for k in list(memo)[:excess]:
+                del memo[k]
+
         if len(self._crawl_cache) > self.crawl_cache_max:
-            self._crawl_cache.clear()
+            shed_oldest(self._crawl_cache)
         if len(self._version_tokens) > self.crawl_cache_max:
             # memo keys embed version tokens: the two reset together.
             # .clear() keeps the dict object shared with cdb.encode.
@@ -320,7 +329,7 @@ class MatchEngine:
         for memo in (self._parse_cache, self.cdb._key_cache,
                      self.cdb._hash_cache):
             if len(memo) > self.crawl_cache_max:
-                memo.clear()
+                shed_oldest(memo)
 
     def _rescreen_one(self, adv_idx: int, q: PkgQuery) -> bool:
         """Exact host verdict for one flagged (advisory, query) candidate."""
@@ -417,6 +426,11 @@ class MatchEngine:
             """numpy fallback decode of one source's bool mask."""
             rows0, offs0 = np.nonzero(mask)
             ridx = start[rows0] + offs0
+            # mask bits past the row table (e.g. padding bits of the last
+            # 32-bit word on a malformed mask) are skipped, matching the
+            # native decoder's bound
+            inb = ridx < len(adv)
+            rows0, ridx = rows0[inb], ridx[inb]
             ids0 = adv[ridx].astype(np.int64)
             resc0 = ((rfl_col[ridx] | fl[rows0]) & flag_mask) != 0
             valid = self._adv_tok[ids0] == tok[rows0]

@@ -194,6 +194,7 @@ class AnalyzerGroup:
         disabled_types: set[str] | None = None,
         enabled_types: set[str] | None = None,
         file_patterns: list[str] | None = None,
+        helm_overrides: dict | None = None,
     ) -> "AnalyzerGroup":
         """file_patterns: `analyzer-type:regex` entries (reference
         analyzer.go:321-377 filePatterns) — a file whose path matches the
@@ -231,14 +232,18 @@ class AnalyzerGroup:
             type_pats = iac_type_pats if a.type == "config" else []
             if type_pats:
                 pats.extend(rx for rx, _t in type_pats)
-            if not pats and not type_pats:
+            overrides = helm_overrides if a.type == "config" else None
+            if not pats and not type_pats and not overrides:
                 return a
             import copy
 
             a2 = copy.copy(a)
-            a2.extra_patterns = pats
+            if pats:
+                a2.extra_patterns = pats
             if type_pats:
                 a2.iac_type_patterns = type_pats
+            if overrides:
+                a2.helm_overrides = overrides
             return a2
 
         return cls(

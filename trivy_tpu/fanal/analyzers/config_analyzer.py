@@ -19,10 +19,6 @@ _MAX_CONFIG_SIZE = 5 * 1024 * 1024
 _CANDIDATE_EXT = (".yaml", ".yml", ".json", ".tf", ".tf.json", ".tpl")
 _CHART_ARCHIVE_EXT = (".tgz", ".tar.gz")
 
-# helm value overrides for this scan (--helm-set / --helm-values),
-# set by the runner before the analyzer group runs
-HELM_OVERRIDES: dict = {}
-
 
 def _looks_like_config(path: str) -> bool:
     name = os.path.basename(path).lower()
@@ -82,7 +78,8 @@ def _strip_helm_hooks(rendered: bytes) -> bytes | None:
     return "".join(out_lines).encode()
 
 
-def _render_chart_archive(data: bytes) -> list[tuple[str, bytes]]:
+def _render_chart_archive(data: bytes,
+                          overrides: dict | None) -> list[tuple[str, bytes]]:
     """Packaged helm chart (.tgz) -> rendered (chart-relative path,
     yaml) pairs; empty when the archive holds no chart."""
     import gzip
@@ -123,7 +120,7 @@ def _render_chart_archive(data: bytes) -> list[tuple[str, bytes]]:
             p[len(root) + 1:]: c for p, c in members.items()
             if p.startswith(root + "/")
         }
-        out.extend(render_chart(chart_files, HELM_OVERRIDES or None))
+        out.extend(render_chart(chart_files, overrides))
     return out
 
 
@@ -131,6 +128,10 @@ def _render_chart_archive(data: bytes) -> list[tuple[str, bytes]]:
 class ConfigAnalyzer(PostAnalyzer):
     type = "config"
     version = 1
+    # --helm-set / --helm-values for this scan; set on a per-group copy
+    # by AnalyzerGroup.build (never mutated on the registry singleton, so
+    # concurrent scans in one process cannot leak overrides)
+    helm_overrides: dict | None = None
 
     def required(self, path: str, size: int = 0, mode: int = 0) -> bool:
         if size > _MAX_CONFIG_SIZE:
@@ -163,7 +164,7 @@ class ConfigAnalyzer(PostAnalyzer):
                 or rel.startswith("templates/")
             )
             for rel_path, rendered in render_chart(chart_files,
-                                                   HELM_OVERRIDES or None):
+                                                   self.helm_overrides):
                 rendered = _strip_helm_hooks(rendered)
                 if rendered is None:
                     continue
@@ -183,7 +184,8 @@ class ConfigAnalyzer(PostAnalyzer):
             if not path.lower().endswith(_CHART_ARCHIVE_EXT):
                 continue
             in_chart.add(path)
-            for rel_path, rendered in _render_chart_archive(inp.read()):
+            for rel_path, rendered in _render_chart_archive(
+                    inp.read(), self.helm_overrides):
                 rendered = _strip_helm_hooks(rendered)
                 if rendered is None:
                     continue

@@ -223,18 +223,32 @@ class Xfs:
         level, numrecs = struct.unpack_from(">HH", fork, 0)
         if level == 0:
             raise XfsError("bmdr root with level 0")
+        # real bmbt depth caps at XFS_BTREE_MAXLEVELS (9); a crafted root
+        # level near 2^16 with a level-consistent block chain would
+        # otherwise recurse past Python's frame limit
+        if level > 16:
+            raise XfsError("bmdr root level implausible")
         maxrecs = (len(fork) - 4) // 16
         ptr_base = 4 + maxrecs * 8
+        # untrusted images: visited-set rejects pointer cycles, and
+        # _btree_block enforces the strictly-decreasing level so a crafted
+        # on-disk level field cannot drive unbounded recursion
+        seen: set[int] = set()
         for i in range(numrecs):
             ptr = struct.unpack_from(">Q", fork, ptr_base + i * 8)[0]
-            yield from self._btree_block(ptr, level - 1)
+            yield from self._btree_block(ptr, level - 1, seen)
 
-    def _btree_block(self, fsbno: int,
-                     expect_level: int) -> Iterator[tuple[int, int, int]]:
+    def _btree_block(self, fsbno: int, expect_level: int,
+                     seen: set[int]) -> Iterator[tuple[int, int, int]]:
+        if fsbno in seen:
+            raise XfsError("bmbt pointer cycle")
+        seen.add(fsbno)
         raw = self._read_at(self._fsblock_byte(fsbno), self.sb.block_size)
         if raw[:4] not in BMAP_MAGIC:
             raise XfsError("bad bmbt block magic")
         level, numrecs = struct.unpack_from(">HH", raw, 4)
+        if level != expect_level:
+            raise XfsError("bmbt level mismatch")
         hdr = 72 if raw[:4] == b"BMA3" else 24
         if level == 0:
             for i in range(numrecs):
@@ -247,7 +261,7 @@ class Xfs:
             ptr_base = hdr + maxrecs * 8
             for i in range(numrecs):
                 ptr = struct.unpack_from(">Q", raw, ptr_base + i * 8)[0]
-                yield from self._btree_block(ptr, level - 1)
+                yield from self._btree_block(ptr, level - 1, seen)
 
     # ------------------------------------------------------- file data
 

@@ -507,7 +507,9 @@ def _walk(base, path: str):
 
 def render_template_str(tpl: str, data) -> str:
     nodes, _, _ = _parse(_lex(tpl))
-    return _Engine(data).render(nodes, data, {})
+    # Go text/template predefines $ as the root data value; seed the cell
+    # so $ / $.Field resolve inside range blocks
+    return _Engine(data).render(nodes, data, {"$": [data]})
 
 
 # ------------------------------------------------------------ builtins
