@@ -16,7 +16,7 @@ volume, so device-path regressions are attributable.
 
 Env knobs:
   TRIVY_TPU_DEVICE_WAIT  total seconds to spend acquiring the device
-                         (default 240; probes retry with backoff)
+                         (default 900; probes retry with backoff)
   TRIVY_TPU_BENCH_ADVISORIES  DB size (default 500_000)
   TRIVY_TPU_BENCH_QUERIES     query count (default 240_000)
   TRIVY_TPU_BENCH_NO_PROBE    skip the subprocess device probe
@@ -24,11 +24,36 @@ Env knobs:
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import random
 import sys
 import time
+
+# the probe runs a REAL tiny computation, not just device enumeration:
+# a tunnel that lists the chip but can't execute still counts as wedged
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp; "
+    "d = jax.devices(); "
+    "v = jax.jit(lambda x: (x + 1).sum())(jnp.zeros(64)); "
+    "assert float(v) == 64.0; "
+    "print('PROBE_OK', d[0].platform)"
+)
+
+
+def _reset_device_state(attempt: int) -> None:
+    """Best-effort client-side reset between probe attempts. Each probe
+    is already a fresh subprocess (fresh PJRT client); additionally drop
+    stale libtpu lockfiles a killed probe may have left, so the next
+    attempt doesn't block on a lock owned by a dead pid."""
+    for lock in glob.glob("/tmp/libtpu_lockfile*"):
+        try:
+            os.remove(lock)
+        except OSError:
+            pass
+    # stagger past transient relay restarts: nothing else to reset
+    # client-side (the axon relay lives outside this container)
 
 
 def _ensure_device() -> str:
@@ -36,47 +61,66 @@ def _ensure_device() -> str:
 
     A wedged TPU tunnel hangs jax.devices() forever (the axon plugin
     initializes even under JAX_PLATFORMS=cpu), so the probe runs in a
-    subprocess with a timeout and retries with backoff inside the
-    TRIVY_TPU_DEVICE_WAIT budget. 'wedged' (probe hangs) is reported
-    distinctly from 'absent' (probe returns, no accelerator)."""
+    subprocess with a timeout and retries — at least 5 attempts with
+    escalating per-probe timeouts and backoff — inside the
+    TRIVY_TPU_DEVICE_WAIT budget, with a best-effort device-state reset
+    between attempts. 'wedged' (probe hangs) is reported distinctly
+    from 'absent' (probe returns, no accelerator)."""
     import subprocess
 
     if os.environ.get("TRIVY_TPU_BENCH_NO_PROBE"):
         return "unprobed"
-    budget = float(os.environ.get("TRIVY_TPU_DEVICE_WAIT", "240"))
+    budget = float(os.environ.get("TRIVY_TPU_DEVICE_WAIT", "900"))
     deadline = time.time() + budget
     attempt = 0
     status = "wedged"
+    # clear stale state (e.g. a libtpu lockfile left by a killed run)
+    # BEFORE the first probe, so a recoverable wedge isn't misread as a
+    # definitive no-accelerator answer
+    _reset_device_state(0)
     while True:
         attempt += 1
-        timeout = min(60 + 30 * attempt, max(deadline - time.time(), 30))
+        # escalate: a cold tunnel can take >60s to hand out the grant;
+        # the per-probe timeout never exceeds the remaining budget
+        # (TRIVY_TPU_DEVICE_WAIT stays a real bound)
+        timeout = max(min(45 + 45 * attempt, deadline - time.time(), 300),
+                      5)
+        t0 = time.time()
         try:
             probe = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; d=jax.devices(); "
-                 "print(d[0].platform)"],
+                [sys.executable, "-c", _PROBE_SRC],
                 timeout=timeout, capture_output=True, text=True)
-            if probe.returncode == 0:
-                platform = probe.stdout.strip().splitlines()[-1]
-                if platform in ("cpu",):
+            if probe.returncode == 0 and "PROBE_OK" in probe.stdout:
+                platform = probe.stdout.split()[-1].strip()
+                if platform == "cpu":
                     # probe answered definitively: no accelerator on this
                     # host — retrying won't conjure one
                     status = "absent"
                     break
+                print(f"device probe ok (attempt {attempt}, "
+                      f"{time.time() - t0:.0f}s): {platform}",
+                      file=sys.stderr)
                 return "ok"
             status = "error"
-            break  # jax itself is broken; retry won't fix it either
+            tail = (probe.stderr or "").strip().splitlines()[-3:]
+            print(f"device probe error (attempt {attempt}): "
+                  + " | ".join(tail), file=sys.stderr)
+            # init errors (vs hangs) can still be transient relay
+            # failures — keep retrying inside the budget
         except subprocess.TimeoutExpired:
             # wedged tunnel CAN recover — keep retrying inside the budget
             status = "wedged"
         wait_left = deadline - time.time()
         if wait_left <= 0:
             break
-        backoff = min(15 * attempt, wait_left)
-        print(f"device probe {status} (attempt {attempt}); "
-              f"retrying in {backoff:.0f}s", file=sys.stderr)
+        _reset_device_state(attempt)
+        backoff = max(min(10 * attempt, wait_left, 90), 1)
+        print(f"DEVICE_STATUS={status} (probe attempt {attempt}, "
+              f"timeout {timeout:.0f}s); reset + retry in {backoff:.0f}s",
+              file=sys.stderr)
         time.sleep(backoff)
-    print(f"device init unavailable ({status}); falling back to CPU",
+    print(f"DEVICE_STATUS={status} after {attempt} attempts; "
+          "falling back to CPU — TPU numbers in this run are NOT valid",
           file=sys.stderr)
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -271,6 +315,8 @@ def main():
         "value": round(e2e_rate),
         "unit": "pkg/s",
         "vs_baseline": round(e2e_rate / oracle_rate, 2),
+        "platform": jax.devices()[0].platform,
+        "device_status": device_status,
     }
     detail = {
         "platform": jax.devices()[0].platform,

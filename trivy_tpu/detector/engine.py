@@ -277,6 +277,7 @@ class MatchEngine:
             results.extend(
                 MatchResult(q, cache[k]) for q, k in zip(qs, all_keys))
 
+        touched: dict = {}  # insertion-ordered set of this crawl's keys
         for i in range(0, len(queries), batch_size):
             qs = queries[i: i + batch_size]
             fresh = []
@@ -285,6 +286,7 @@ class MatchEngine:
             for q in qs:
                 k = (q.space, q.name, q.version, q.scheme_name)
                 all_keys.append(k)
+                touched[k] = None
                 if k not in cache and k not in inflight:
                     fresh.append(q)
                     keys.append(k)
@@ -295,6 +297,14 @@ class MatchEngine:
                 flush_one()
         while pend:
             flush_one()
+        # crawl-granularity LRU: one move-to-end pass per crawl keeps
+        # every key this crawl used at the recent end of the dict, so
+        # _enforce_memo_bounds sheds keys from OLD crawls first (per-hit
+        # move-to-end would tax the hot dedupe loop for no extra info —
+        # within a crawl everything needed is resident anyway)
+        if len(cache) > len(touched):
+            for k in touched:
+                cache[k] = cache.pop(k)
         self._enforce_memo_bounds()
         return results
 
@@ -307,10 +317,13 @@ class MatchEngine:
         import numpy as np
 
         def shed_oldest(memo: dict) -> None:
-            # dicts iterate in insertion order: shed the oldest entries
-            # down to half capacity, keeping the hot (recent) half warm
-            # instead of the thundering recompute a wholesale clear
-            # causes on long-lived servers
+            # shed down to half capacity instead of the thundering
+            # recompute a wholesale clear causes on long-lived servers.
+            # _crawl_cache is LRU at crawl granularity (detect_many
+            # moves each crawl's keys to the recent end), so its oldest
+            # entries belong to crawls not seen lately; the sibling
+            # memos shed in first-computed order (good enough at a 2M
+            # cap where shedding is a rare pressure valve)
             excess = len(memo) - self.crawl_cache_max // 2
             for k in list(memo)[:excess]:
                 del memo[k]
