@@ -45,13 +45,27 @@ _PROBE_SRC = (
 def _reset_device_state(attempt: int) -> None:
     """Best-effort client-side reset between probe attempts. Each probe
     is already a fresh subprocess (fresh PJRT client); additionally drop
-    stale libtpu lockfiles a killed probe may have left, so the next
-    attempt doesn't block on a lock owned by a dead pid."""
+    libtpu lockfiles whose flock is NOT currently held (a dead owner
+    releases the flock, so an acquirable lock is stale by definition —
+    a held one belongs to a live process and must not be touched)."""
+    import fcntl
+
     for lock in glob.glob("/tmp/libtpu_lockfile*"):
         try:
-            os.remove(lock)
+            fd = os.open(lock, os.O_RDWR)
         except OSError:
-            pass
+            continue
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            pass  # held by a live process: leave it alone
+        else:
+            try:
+                os.remove(lock)
+            except OSError:
+                pass
+        finally:
+            os.close(fd)
     # stagger past transient relay restarts: nothing else to reset
     # client-side (the axon relay lives outside this container)
 
@@ -90,8 +104,13 @@ def _ensure_device() -> str:
             probe = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC],
                 timeout=timeout, capture_output=True, text=True)
-            if probe.returncode == 0 and "PROBE_OK" in probe.stdout:
-                platform = probe.stdout.split()[-1].strip()
+            ok_lines = [ln for ln in probe.stdout.splitlines()
+                        if ln.startswith("PROBE_OK ")]
+            if probe.returncode == 0 and ok_lines:
+                # parse the token following the sentinel on its own line;
+                # stray stdout noise (library banners) must not be able
+                # to masquerade as a platform name
+                platform = ok_lines[-1].split()[1]
                 if platform == "cpu":
                     # probe answered definitively: no accelerator on this
                     # host — retrying won't conjure one
@@ -102,11 +121,15 @@ def _ensure_device() -> str:
                       file=sys.stderr)
                 return "ok"
             status = "error"
-            tail = (probe.stderr or "").strip().splitlines()[-3:]
+            err = probe.stderr or ""
+            tail = err.strip().splitlines()[-3:]
             print(f"device probe error (attempt {attempt}): "
                   + " | ".join(tail), file=sys.stderr)
-            # init errors (vs hangs) can still be transient relay
-            # failures — keep retrying inside the budget
+            if ("ModuleNotFoundError" in err or "ImportError" in err
+                    or "SyntaxError" in err):
+                break  # jax itself is broken; retrying won't fix it
+            # other init errors can be transient relay failures — keep
+            # retrying inside the budget
         except subprocess.TimeoutExpired:
             # wedged tunnel CAN recover — keep retrying inside the budget
             status = "wedged"

@@ -78,6 +78,7 @@ class SecretConfig:
 class SecretScanner:
     def __init__(self, config: SecretConfig | None = None):
         self._tiers = None
+        self._kw_state = None  # lazy (matcher, rule->kw-index lists)
         config = config or SecretConfig()
         rules = list(BUILTIN_RULES)
         if config.enable_builtin_rules:
@@ -255,6 +256,30 @@ class SecretScanner:
                 out.append(secret)
         return out
 
+    # ----------------------------------------------- keyword prefilter
+
+    def _ensure_kw_matcher(self):
+        """One-pass multi-keyword matcher for the host prefilter
+        (replacing the reference's rules x strings.Contains loop,
+        scanner.go:174-186): C++ Aho-Corasick when the native library
+        builds, None otherwise (callers fall back to bytes.find)."""
+        if self._kw_state is None:
+            kw_ids: dict[bytes, int] = {}
+            rule_kws: list[list[int]] = []
+            for cr in self.rules:
+                rule_kws.append([kw_ids.setdefault(k, len(kw_ids))
+                                 for k in cr.keywords])
+            matcher = None
+            if kw_ids:
+                try:
+                    from trivy_tpu.native.ac import NativeMatcher
+
+                    matcher = NativeMatcher(list(kw_ids))
+                except (RuntimeError, OSError):
+                    matcher = None
+            self._kw_state = (matcher, rule_kws)
+        return self._kw_state
+
     def _scan_files_device(self, eligible) -> list[Secret]:
         from trivy_tpu.ops.secret_nfa import (
             CHUNK, AnchorMatcher, merge_windows,
@@ -364,6 +389,17 @@ class SecretScanner:
                 out.append(cr)
         return out
 
+    def _candidate_rules_fast(self, content: bytes) -> list[CompiledRule]:
+        """candidate_rules via one case-folded Aho-Corasick pass over the
+        raw bytes (no host lowercase copy, no per-keyword substring
+        scans); byte-for-byte the same rule set as candidate_rules."""
+        matcher, rule_kws = self._ensure_kw_matcher()
+        if matcher is None:
+            return self.candidate_rules(content.lower())
+        hits = matcher.scan(content)
+        return [cr for cr, kws in zip(self.rules, rule_kws)
+                if not kws or any(hits[i] for i in kws)]
+
     def scan_file(self, path: str, content: bytes,
                   rules: list[CompiledRule] | None = None) -> Secret | None:
         if self.skip_file(path) or self.path_allowed(path):
@@ -371,7 +407,7 @@ class SecretScanner:
         if b"\x00" in content[:8000]:
             return None  # binary
         if rules is None:
-            rules = self.candidate_rules(content.lower())
+            rules = self._candidate_rules_fast(content)
         findings: list[SecretFinding] = []
         for cr in rules:
             if cr.path_rx is not None and not cr.path_rx.match(path):
