@@ -211,3 +211,37 @@ def test_npm_prerelease_inexact_key_in_subtracted_hull():
     dev = engine.detect([q])[0].adv_indices
     ora = engine.oracle_detect([q])[0].adv_indices
     assert dev == ora
+
+
+def test_native_sort_dedupe_and_group():
+    """Direct contract tests for the packed-key sort/dedupe + CSR
+    grouping (collect.cpp): keep-first on (row, id) ties prefers the
+    exact (resc=0) twin; grouping brackets every query."""
+    import numpy as np
+    import pytest
+
+    from trivy_tpu.native import collect as ncollect
+
+    if not ncollect.available():
+        pytest.skip("g++ toolchain unavailable")
+    rows = np.array([3, 1, 1, 3, 0, 1], dtype=np.int64)
+    ids = np.array([7, 5, 5, 7, 2, 4], dtype=np.int64)
+    resc = np.array([1, 1, 0, 0, 0, 1], dtype=bool)
+    r, i, s = ncollect.sort_dedupe(rows, ids, resc)
+    assert r.tolist() == [0, 1, 1, 3]
+    assert i.tolist() == [2, 4, 5, 7]
+    # (1,5) and (3,7) both had an exact twin: resc False wins
+    assert s.tolist() == [False, True, False, False]
+
+    conf = ~s
+    out_ids, bounds = ncollect.group_confirmed(r, i, conf, 5)
+    assert out_ids.tolist() == [2, 5, 7]
+    assert bounds.tolist() == [0, 1, 2, 2, 3, 3]
+
+    # values past the packed ranges fall back to numpy (None)
+    big = np.array([1 << 22], dtype=np.int64)
+    one = np.array([1], dtype=np.int64)
+    t = np.array([0], dtype=bool)
+    assert ncollect.sort_dedupe(big, one, t) is None
+    assert ncollect.sort_dedupe(one, np.array([1 << 43], dtype=np.int64),
+                                t) is None

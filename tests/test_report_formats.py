@@ -158,7 +158,9 @@ def test_spdx(report):
 
 def test_github(report):
     doc = json.loads(render_github(report))
-    assert doc["detector"]["name"] == "trivy-tpu"
+    # detector identity mirrors the reference writer (snapshot consumers
+    # key on it)
+    assert doc["detector"]["name"] == "trivy"
     mans = doc["manifests"]
     assert "package-lock.json" in mans
     resolved = mans["package-lock.json"]["resolved"]

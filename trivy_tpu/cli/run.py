@@ -658,7 +658,9 @@ def _report_from_json(doc: dict):
     rep.metadata = R.Metadata(
         size=md.get("Size", 0),
         os=OS(family=md.get("OS", {}).get("Family", ""),
-              name=md.get("OS", {}).get("Name", ""))
+              name=md.get("OS", {}).get("Name", ""),
+              eosl=md.get("OS", {}).get("EOSL", False),
+              extended=md.get("OS", {}).get("Extended", False))
         if md.get("OS") else None,
         image_id=md.get("ImageID", ""),
         diff_ids=md.get("DiffIDs", []) or [],
@@ -691,6 +693,12 @@ def _report_from_json(doc: dict):
                     digest=(v.get("Layer") or {}).get("Digest", ""),
                     diff_id=(v.get("Layer") or {}).get("DiffID", ""),
                 ),
+                data_source=R.DataSource(
+                    id=(v.get("DataSource") or {}).get("ID", ""),
+                    base_id=(v.get("DataSource") or {}).get("BaseID", ""),
+                    name=(v.get("DataSource") or {}).get("Name", ""),
+                    url=(v.get("DataSource") or {}).get("URL", ""),
+                ) if v.get("DataSource") else None,
                 info=R.VulnerabilityInfo(
                     title=v.get("Title", ""),
                     description=v.get("Description", ""),

@@ -38,9 +38,18 @@ class PkgQuery:
     name: str
     version: str
     scheme_name: str
+    # dedupe/memo key, built once at construction (the crawl hot loops
+    # key every query; rebuilding the tuple per crawl was measurable at
+    # 240k queries/batch)
+    key: tuple = field(init=False, compare=False, repr=False)
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "key",
+            (self.space, self.name, self.version, self.scheme_name))
 
 
-@dataclass
+@dataclass(slots=True)
 class MatchResult:
     query: PkgQuery
     adv_indices: list[int]  # indices into CompiledDB.advisories
@@ -117,7 +126,7 @@ class MatchEngine:
         uniq: list[PkgQuery] = []
         idx_map = [0] * len(queries)
         for j, q in enumerate(queries):
-            k = (q.space, q.name, q.version, q.scheme_name)
+            k = q.key
             u = key_of.get(k)
             if u is None:
                 u = len(uniq)
@@ -261,38 +270,49 @@ class MatchEngine:
         from collections import deque
 
         cache = self._crawl_cache
-        inflight: set = set()  # dispatched but not yet flushed (FIFO
-        # flushing guarantees they are cached before any later batch
-        # that references them is flushed)
-        results: list[MatchResult] = []
+        # ONE crawl-wide dedupe pass, then the pipeline only ever sees
+        # unique queries: per-original-query Python work collapses to
+        # this loop plus the final fan-out comprehension (the previous
+        # per-batch bookkeeping was ~4 dict/list ops per duplicate and
+        # dominated dense crawls)
+        key_of: dict[tuple, int] = {}
+        uniq: list[PkgQuery] = []
+        idx_map = [0] * len(queries)
+        hits_by_u: list = []
+        fresh: list[PkgQuery] = []
+        fresh_u: list[int] = []
+        for j, q in enumerate(queries):
+            k = q.key
+            u = key_of.get(k)
+            if u is None:
+                u = len(uniq)
+                key_of[k] = u
+                uniq.append(q)
+                h = cache.get(k)
+                hits_by_u.append(h)
+                if h is None:
+                    fresh.append(q)
+                    fresh_u.append(u)
+            idx_map[j] = u
+
+        # dispatch fresh uniques in device-sized chunks; `depth` chunks
+        # stay in flight so device round-trips overlap host collection.
+        # chunk ~ batch_size scaled by the crawl's observed dedupe ratio,
+        # keeping kernel shapes close to the historical per-batch uniques
+        ratio = max(len(queries) // max(len(uniq), 1), 1)
+        chunk = max(batch_size // ratio, 1024)
         pend: deque = deque()
 
         def flush_one():
-            qs, all_keys, keys, ctx = pend.popleft()
-            fresh_hits = self._collect_unique(ctx) if ctx is not None \
-                else []
-            for k, h in zip(keys, fresh_hits):
-                cache[k] = h
-                inflight.discard(k)
-            results.extend(
-                MatchResult(q, cache[k]) for q, k in zip(qs, all_keys))
+            us, qs, ctx = pend.popleft()
+            for u, q, h in zip(us, qs, self._collect_unique(ctx)):
+                hits_by_u[u] = h
+                cache[q.key] = h
 
-        touched: dict = {}  # insertion-ordered set of this crawl's keys
-        for i in range(0, len(queries), batch_size):
-            qs = queries[i: i + batch_size]
-            fresh = []
-            keys = []
-            all_keys = []
-            for q in qs:
-                k = (q.space, q.name, q.version, q.scheme_name)
-                all_keys.append(k)
-                touched[k] = None
-                if k not in cache and k not in inflight:
-                    fresh.append(q)
-                    keys.append(k)
-                    inflight.add(k)
-            ctx = self._dispatch_unique(fresh) if fresh else None
-            pend.append((qs, all_keys, keys, ctx))
+        for i in range(0, len(fresh), chunk):
+            qs = fresh[i: i + chunk]
+            pend.append((fresh_u[i: i + chunk], qs,
+                         self._dispatch_unique(qs)))
             while len(pend) >= depth:
                 flush_one()
         while pend:
@@ -302,11 +322,13 @@ class MatchEngine:
         # _enforce_memo_bounds sheds keys from OLD crawls first (per-hit
         # move-to-end would tax the hot dedupe loop for no extra info —
         # within a crawl everything needed is resident anyway)
-        if len(cache) > len(touched):
-            for k in touched:
+        if len(cache) > len(uniq):
+            for q in uniq:
+                k = q.key
                 cache[k] = cache.pop(k)
         self._enforce_memo_bounds()
-        return results
+        return [MatchResult(q, hits_by_u[u])
+                for q, u in zip(queries, idx_map)]
 
     def _enforce_memo_bounds(self) -> None:
         """RSS bound for long-lived servers over every diversity-keyed
@@ -424,12 +446,13 @@ class MatchEngine:
                     vtok[vk] = t
                 q_vt[j] = t
 
-        native = None
-        if ctx["sharded"] is None:
-            from trivy_tpu.native import collect as ncollect
+        from trivy_tpu.native import collect as ncollect
 
-            if ncollect.available():
-                native = ncollect
+        native = ncollect if ncollect.available() else None
+        # bitmask decode is native only for single-device sources; the
+        # sharded path decodes per shard in numpy (dedupe/grouping below
+        # stay native either way)
+        decode_native = native if ctx["sharded"] is None else None
 
         # each part: token-screened (rows, ids, resc) for one device
         # source, rows in original query indices
@@ -457,8 +480,8 @@ class MatchEngine:
             tok = q_tok if qidx is None else q_tok[qidx]
             start = np.searchsorted(key_h1, h1).astype(np.int64)
             decoded = None
-            if native is not None:
-                decoded = native.decode_mask(
+            if decode_native is not None:
+                decoded = decode_native.decode_mask(
                     pending.collect_words(), start, len(key_h1),
                     adv, rfl_col, self._adv_tok, tok, fl, flag_mask)
             if decoded is None:
@@ -500,12 +523,19 @@ class MatchEngine:
         resc = np.concatenate([p[2] for p in parts])
 
         # dedupe (row, id) keeping the exact (non-rescreen) occurrence
-        # (multi-interval advisories, shard halos, pre-only twin rows)
-        order = np.lexsort((resc, ids, rows))
-        rows, ids, resc = rows[order], ids[order], resc[order]
-        keep = np.ones(len(rows), dtype=bool)
-        keep[1:] = (rows[1:] != rows[:-1]) | (ids[1:] != ids[:-1])
-        rows, ids, resc = rows[keep], ids[keep], resc[keep]
+        # (multi-interval advisories, shard halos, pre-only twin rows);
+        # native packed-key sort when available, np.lexsort fallback
+        deduped = None
+        if native is not None:
+            deduped = native.sort_dedupe(rows, ids, resc)
+        if deduped is not None:
+            rows, ids, resc = deduped
+        else:
+            order = np.lexsort((resc, ids, rows))
+            rows, ids, resc = rows[order], ids[order], resc[order]
+            keep = np.ones(len(rows), dtype=bool)
+            keep[1:] = (rows[1:] != rows[:-1]) | (ids[1:] != ids[:-1])
+            rows, ids, resc = rows[keep], ids[keep], resc[keep]
 
         # exact hits confirm as-is; flagged candidates get the exact
         # comparators (memoized per (advisory, version))
@@ -545,13 +575,20 @@ class MatchEngine:
                                             uverd[miss])
             conf[flagged] |= uverd[inv]
 
-        rows_c, ids_c = rows[conf], ids[conf]
         self.rescreen_stats["candidates"] += len(rows)
-        self.rescreen_stats["confirmed"] += len(rows_c)
-        # rows_c is sorted with ids ascending within each row: slicing on
-        # row boundaries yields the final per-query sorted hit lists
-        # (direct slices — np.split's per-piece wrapper overhead is
-        # measurable at 15k+ pieces per batch)
-        bounds = np.searchsorted(rows_c, np.arange(len(queries) + 1))
-        return [ids_c[bounds[j]: bounds[j + 1]].tolist()
-                for j in range(len(queries))]
+        grouped = None
+        if native is not None:
+            grouped = native.group_confirmed(rows, ids, conf, len(queries))
+        if grouped is not None:
+            ids_c, bounds = grouped
+        else:
+            rows_c, ids_c = rows[conf], ids[conf]
+            bounds = np.searchsorted(rows_c, np.arange(len(queries) + 1))
+        self.rescreen_stats["confirmed"] += len(ids_c)
+        # ids are sorted ascending within each row: slicing on row
+        # boundaries yields the final per-query sorted hit lists (direct
+        # slices — np.split's per-piece wrapper overhead is measurable at
+        # 15k+ pieces per batch)
+        bl = bounds.tolist()
+        idlist = ids_c.tolist()
+        return [idlist[bl[j]: bl[j + 1]] for j in range(len(queries))]

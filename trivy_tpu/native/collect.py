@@ -33,6 +33,13 @@ def _configure(lib: ctypes.CDLL) -> None:
         ctypes.c_int32,                         # flag_mask
         i64p, i64p, u8p,                        # out rows/ids/resc
     ]
+    lib.sort_dedupe.restype = ctypes.c_int64
+    lib.sort_dedupe.argtypes = [i64p, i64p, u8p, ctypes.c_int64]
+    lib.group_confirmed.restype = ctypes.c_int64
+    lib.group_confirmed.argtypes = [
+        i64p, i64p, u8p, ctypes.c_int64, ctypes.c_int64,  # rows/ids/conf/m/nq
+        i64p, i64p,                                       # out ids, bounds
+    ]
 
 
 _LIB = LazyLibrary(_SRC, "libcollect", _configure)
@@ -68,3 +75,38 @@ def decode_mask(words: np.ndarray, start: np.ndarray, n_rows: int,
         np.ascontiguousarray(q_flags, dtype=np.int32),
         flag_mask, rows, ids, resc))
     return rows[:n], ids[:n], resc[:n].astype(bool)
+
+
+def sort_dedupe(rows: np.ndarray, ids: np.ndarray, resc: np.ndarray):
+    """In-place sort by (row, id, resc) + dedupe on (row, id) keeping the
+    exact (non-rescreen) twin. -> (rows, ids, resc) compacted views, or
+    None when the library is unavailable or the values exceed the packed
+    key ranges (rows < 2^21, ids < 2^42)."""
+    lib = _LIB.load()
+    if lib is None or len(rows) == 0:
+        return None
+    if (rows.max() >= (1 << 21) or rows.min() < 0
+            or ids.max() >= (1 << 42) or ids.min() < 0):
+        return None  # caller falls back to np.lexsort
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    ids = np.ascontiguousarray(ids, dtype=np.int64)
+    resc8 = np.ascontiguousarray(resc, dtype=np.uint8)
+    m = int(lib.sort_dedupe(rows, ids, resc8, len(rows)))
+    return rows[:m], ids[:m], resc8[:m].astype(bool)
+
+
+def group_confirmed(rows: np.ndarray, ids: np.ndarray, conf: np.ndarray,
+                    n_queries: int):
+    """-> (out_ids, bounds) CSR of confirmed hits per query, or None when
+    the library is unavailable. rows must be sorted ascending."""
+    lib = _LIB.load()
+    if lib is None:
+        return None
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    ids = np.ascontiguousarray(ids, dtype=np.int64)
+    conf8 = np.ascontiguousarray(conf, dtype=np.uint8)
+    out_ids = np.empty(len(ids), dtype=np.int64)
+    bounds = np.empty(n_queries + 1, dtype=np.int64)
+    n = int(lib.group_confirmed(rows, ids, conf8, len(rows), n_queries,
+                                out_ids, bounds))
+    return out_ids[:n], bounds

@@ -196,9 +196,16 @@ def _go_date(layout, t=None) -> str:
     m9 = re.search(r"\.(9+)", fmt)
     m0 = re.search(r"\.(0+)", fmt)
     if m9:
-        micro = f"{t.microsecond:06d}"[: min(len(m9.group(1)), 6)]
-        micro = micro.rstrip("0")
-        frac = f".{micro}" if micro else ""
+        width = len(m9.group(1))
+        digits = f"{t.microsecond:06d}"
+        if width > 6:
+            # nanosecond layouts pick up the fake clock's sub-µs rest so
+            # goldens rendered with a ns fake clock byte-match
+            digits = (digits + f"{clock.ns_extra():03d}")[:min(width, 9)]
+        else:
+            digits = digits[:width]
+        digits = digits.rstrip("0")
+        frac = f".{digits}" if digits else ""
         fmt = fmt.replace(m9.group(0), "\x00FRAC\x00")
     elif m0:
         micro = f"{t.microsecond:06d}"[: min(len(m0.group(1)), 6)]
@@ -260,6 +267,9 @@ _FUNCS = {
     "getEnv": lambda k: os.environ.get(str(k), ""),
     "env": lambda k: os.environ.get(str(k), ""),
     "appVersion": lambda: trivy_tpu.__version__,
+    # trivy registers sourceID to map a string onto its SourceID type
+    # (report.CustomTemplateFuncMap); the dict form is the string itself
+    "sourceID": lambda s: str(s),
     "list": lambda *a: list(a),
     "add": lambda *a: sum(a),
     "toString": lambda v: str(v),
@@ -345,6 +355,31 @@ def _split_args(expr: str) -> list[str]:
     return out
 
 
+def _go_str(v) -> str:
+    """Go's default %v rendering for template output. The only case that
+    differs from str() is time.Time: Go prints
+    "2006-01-02 15:04:05.999999999 -0700 MST"."""
+    import datetime as _dt
+
+    if isinstance(v, _dt.datetime):
+        frac = ""
+        micro = v.microsecond
+        ns = clock.ns_extra()
+        if micro or ns:
+            frac = f".{micro:06d}{ns:03d}".rstrip("0") if ns \
+                else f".{micro:06d}".rstrip("0")
+        off = v.utcoffset() or _dt.timedelta(0)
+        total = int(off.total_seconds())
+        sign = "+" if total >= 0 else "-"
+        total = abs(total)
+        zone = v.tzname() or "UTC"
+        if zone in ("UTC+00:00", "+00:00"):
+            zone = "UTC"
+        return (f"{v:%Y-%m-%d %H:%M:%S}{frac} "
+                f"{sign}{total // 3600:02d}{total % 3600 // 60:02d} {zone}")
+    return str(v)
+
+
 class _Engine:
     def __init__(self, data):
         self.root = data
@@ -361,7 +396,7 @@ class _Engine:
                 elif v is True or v is False:
                     out.append("true" if v else "false")
                 else:
-                    out.append(str(v))
+                    out.append(_go_str(v))
             elif isinstance(n, _Assign):
                 val = self.eval_pipeline(n.expr, dot, scope)
                 if not n.declare and n.var in scope:
@@ -454,8 +489,30 @@ class _Engine:
         atom = atom.strip()
         if not atom:
             return None
-        if atom.startswith("(") and atom.endswith(")"):
-            return self.eval_pipeline(atom[1:-1], dot, scope)
+        if atom.startswith("("):
+            # find the matching close paren: "(expr)" or "(expr).Field"
+            # (Go: a parenthesized pipeline is an operand and accepts
+            # field chains, e.g. (index .CVSS "nvd").V3Score)
+            depth, q = 0, None
+            close = -1
+            for i, ch in enumerate(atom):
+                if q:
+                    if ch == q:
+                        q = None
+                elif ch in "\"`":
+                    q = ch
+                elif ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                    if depth == 0:
+                        close = i
+                        break
+            if close == len(atom) - 1:
+                return self.eval_pipeline(atom[1:-1], dot, scope)
+            if close > 0 and atom[close + 1] == ".":
+                inner = self.eval_pipeline(atom[1:close], dot, scope)
+                return _walk(inner, atom[close + 2:])
         m = _STR.fullmatch(atom)
         if m:
             s = m.group(1) if m.group(1) is not None else m.group(2)
@@ -576,6 +633,12 @@ def _augment(report_dict: dict) -> dict:
             v.setdefault("Description", "")
             v.setdefault("Severity", "UNKNOWN")
             v.setdefault("FixedVersion", "")
+            # Go's DetectedVulnerability embeds types.Vulnerability as a
+            # named field that json inlines; templates address both forms
+            # (contrib/junit.tpl uses .Vulnerability.Severity). A flat
+            # COPY, not a self-reference: toJson over a vulnerability
+            # must not hit a circular structure.
+            v.setdefault("Vulnerability", dict(v))
         res.setdefault("Vulnerabilities", [])
         res.setdefault("Misconfigurations", [])
         res.setdefault("Secrets", [])
@@ -594,7 +657,9 @@ def render_template(report: Report, template: str) -> str:
         if base in _BUILTIN and not os.path.exists(path):
             tpl = _BUILTIN[base]
         else:
-            with open(path, encoding="utf-8") as f:
+            # newline="" keeps CRLF template bytes intact (Go renders
+            # them verbatim; gitlab-codequality.tpl ships with CRLF)
+            with open(path, encoding="utf-8", newline="") as f:
                 tpl = f.read()
     elif template in _BUILTIN:
         tpl = _BUILTIN[template]
