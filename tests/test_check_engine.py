@@ -226,7 +226,7 @@ class TestDockerfileInput:
             type: dockerfile
             severity: CRITICAL
             deny:
-              - path: Stages[*].Commands[*].Value
+              - path: Stages[*].Commands[*].Value[*]
                 regex: "curl[^|]*\\\\|\\\\s*sh"
                 message: curl | sh detected
         """)

@@ -457,6 +457,37 @@ def test_reference_parity_misconfig(case, input_rel, golden, extra,
         for d in sorted(mine ^ want)[:20])
 
 
+def test_reference_parity_custom_rego_policy(tmp_path, capsys,
+                                             monkeypatch):
+    """The reference's Rego custom-check fixture runs unmodified through
+    the mini-Rego engine and matches dockerfile-custom-policies.json.golden
+    on every custom-check field (repo_test.go "dockerfile with custom
+    policies": --config-check + --check-namespaces user)."""
+    monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2021-08-25T12:20:30+00:00")
+    fixture = os.path.join(REF, "fixtures/repo/custom-policy")
+    report = _run_cli([
+        "config", fixture,
+        "--config-check", os.path.join(fixture, "policy"),
+        "--check-namespaces", "user",
+        "--format", "json", "--cache-dir", str(tmp_path / "cache"),
+        "--quiet",
+    ], capsys)
+
+    def proj(doc):
+        return {(r.get("Target"), m.get("ID"), m.get("Title"),
+                 m.get("Description"), m.get("Message"),
+                 m.get("Namespace"), m.get("Query"), m.get("Severity"))
+                for r in doc.get("Results") or []
+                for m in r.get("Misconfigurations") or []
+                if m.get("Status") == "FAIL"}
+
+    with open(os.path.join(REF, "dockerfile-custom-policies.json.golden"
+                           )) as f:
+        want = proj(json.load(f))
+    mine = proj(report)
+    assert mine == want, f"\nMINE {sorted(mine)}\nWANT {sorted(want)}"
+
+
 @pytest.mark.parametrize(
     "case,kind,input_rel,golden,extra",
     REPO_CASES + SBOM_CASES + VEX_CASES,

@@ -1,0 +1,369 @@
+"""Mini-Rego interpreter tests: language semantics, the reference's own
+ignore-policy examples and custom-policy fixture, and engine wiring
+(reference pkg/iac/rego/scanner.go, pkg/result/filter.go applyPolicy)."""
+
+import os
+
+import pytest
+
+from trivy_tpu.iac.rego import (
+    Evaluator,
+    RegoError,
+    Set,
+    load_rego_checks,
+    parse_module,
+)
+
+REF = "/root/reference"
+
+
+def q(src, query, inp=None, data=None):
+    return Evaluator([parse_module(src)], input=inp,
+                     data=data).query(query)
+
+
+# ------------------------------------------------------------ language
+
+
+class TestLanguage:
+    def test_partial_set_rule(self):
+        out = q("package t\ndeny[m] { m := \"bad\" }", "data.t.deny")
+        assert out.to_json() == ["bad"]
+
+    def test_partial_set_multiple_bodies(self):
+        src = """package t
+deny[m] { m := "a" }
+deny[m] { m := "b" }
+deny[m] { 1 == 2; m := "never" }
+"""
+        assert q(src, "data.t.deny").to_json() == ["a", "b"]
+
+    def test_complete_rule_and_default(self):
+        src = """package t
+default allow = false
+allow { input.x == 1 }
+"""
+        assert q(src, "data.t.allow", {"x": 1}) is True
+        assert q(src, "data.t.allow", {"x": 2}) is False
+        assert q(src, "data.t.allow", {}) is False
+
+    def test_undefined_without_default(self):
+        assert q("package t\nr { input.x == 1 }", "data.t.r", {}) is None
+
+    def test_constant_rules(self):
+        src = """package t
+n := 4
+s := {"a", "b"}
+arr := [1, 2, 3]
+obj := {"k": "v"}
+"""
+        assert q(src, "data.t.n") == 4
+        assert q(src, "data.t.s") == Set(["a", "b"])
+        assert q(src, "data.t.arr") == [1, 2, 3]
+        assert q(src, "data.t.obj") == {"k": "v"}
+
+    def test_iteration_underscore(self):
+        src = """package t
+deny[m] { m := input.items[_].name }
+"""
+        got = q(src, "data.t.deny",
+                {"items": [{"name": "a"}, {"name": "b"}]})
+        assert got.to_json() == ["a", "b"]
+
+    def test_iteration_binds_index(self):
+        src = """package t
+deny[m] { input.xs[i] == "hit"; m := i }
+"""
+        assert q(src, "data.t.deny",
+                 {"xs": ["miss", "hit", "hit"]}).to_json() == [1, 2]
+
+    def test_set_literal_membership_iteration(self):
+        src = """package t
+r { input.sev == {"LOW", "MEDIUM"}[_] }
+"""
+        assert q(src, "data.t.r", {"sev": "LOW"}) is True
+        assert q(src, "data.t.r", {"sev": "HIGH"}) is None
+
+    def test_rule_value_chaining(self):
+        src = """package t
+v = x { x := input.a.b }
+r { v == 10 }
+"""
+        assert q(src, "data.t.r", {"a": {"b": 10}}) is True
+        # missing key -> v undefined -> r undefined (not an error)
+        assert q(src, "data.t.r", {}) is None
+
+    def test_not_on_undefined_and_false(self):
+        src = """package t
+r1 { not input.missing }
+r2 { not input.flag }
+"""
+        assert q(src, "data.t.r1", {}) is True
+        assert q(src, "data.t.r2", {"flag": False}) is True
+        assert q(src, "data.t.r2", {"flag": True}) is None
+
+    def test_set_comprehension_and_count(self):
+        src = """package t
+bad := {"x", "y"}
+n := c { c := count({v | v := input.ids[_]; v == bad[_]}) }
+"""
+        assert q(src, "data.t.n", {"ids": ["x", "z", "y", "x"]}) == 2
+        assert q(src, "data.t.n", {"ids": []}) == 0
+        assert q(src, "data.t.n", {}) == 0   # undefined -> empty
+
+    def test_array_and_object_comprehension(self):
+        src = """package t
+arr := [x * 2 | x := input.ns[_]]
+obj := {k: v | some k, v in input.m}
+"""
+        assert q(src, "data.t.arr", {"ns": [1, 2]}) == [2, 4]
+        assert q(src, "data.t.obj", {"m": {"a": 1}}) == {"a": 1}
+
+    def test_functions(self):
+        src = """package t
+double(x) = y { y := x * 2 }
+r := v { v := double(21) }
+"""
+        assert q(src, "data.t.r") == 42
+
+    def test_function_undefined_arg_fails_body(self):
+        src = """package t
+f(x) = y { y := x }
+r { f(input.missing) == 1 }
+"""
+        assert q(src, "data.t.r", {}) is None
+
+    def test_object_rule(self):
+        src = """package t
+port[name] = p { some name, p in input.svc }
+"""
+        assert q(src, "data.t.port", {"svc": {"http": 80}}) == \
+            {"http": 80}
+
+    def test_arithmetic_and_comparison(self):
+        src = """package t
+r { (input.a + 3) * 2 == 10; input.a < 3; input.a >= 2 }
+"""
+        assert q(src, "data.t.r", {"a": 2}) is True
+        assert q(src, "data.t.r", {"a": 5}) is None
+
+    def test_division_by_zero_is_undefined(self):
+        assert q("package t\nr { 1 / input.z == 1 }", "data.t.r",
+                 {"z": 0}) is None
+
+    def test_in_operator(self):
+        src = """package t
+r1 { input.x in {"a", "b"} }
+r2 { input.x in ["a", "b"] }
+"""
+        assert q(src, "data.t.r1", {"x": "a"}) is True
+        assert q(src, "data.t.r1", {"x": "c"}) is None
+        assert q(src, "data.t.r2", {"x": "b"}) is True
+
+    def test_some_in(self):
+        src = """package t
+deny[m] { some item in input.xs; item.bad; m := item.name }
+"""
+        got = q(src, "data.t.deny", {"xs": [
+            {"name": "a", "bad": True}, {"name": "b", "bad": False}]})
+        assert got.to_json() == ["a"]
+
+    def test_rego_v1_forms(self):
+        src = """package t
+import rego.v1
+default ignore := false
+allowed := {"X-1"}
+ok if input.id in allowed
+ignore if not ok
+deny contains m if { m := "boom"; input.fail }
+"""
+        assert q(src, "data.t.ignore", {"id": "X-1"}) is False
+        assert q(src, "data.t.ignore", {"id": "Y"}) is True
+        assert q(src, "data.t.deny", {"fail": True}).to_json() == ["boom"]
+        assert len(q(src, "data.t.deny", {})) == 0
+
+    def test_unify_binds(self):
+        src = """package t
+r := x { x = input.v }
+"""
+        assert q(src, "data.t.r", {"v": 7}) == 7
+
+    def test_builtins(self):
+        src = """package t
+r1 := v { v := sprintf("%s has %d", ["pkg", 3]) }
+r2 { startswith(input.s, "ab"); endswith(input.s, "yz") }
+r3 := v { v := concat(",", sort({"b", "a"})) }
+r4 := v { v := to_number(input.n) }
+r5 { regex.match("^v[0-9]+", input.tag) }
+"""
+        assert q(src, "data.t.r1") == "pkg has 3"
+        assert q(src, "data.t.r2", {"s": "ab..yz"}) is True
+        assert q(src, "data.t.r3") == "a,b"
+        assert q(src, "data.t.r4", {"n": "12"}) == 12
+        assert q(src, "data.t.r5", {"tag": "v12"}) is True
+
+    def test_data_documents(self):
+        src = """package t
+r { input.name == data.allowed[_] }
+"""
+        assert q(src, "data.t.r", {"name": "x"},
+                 data={"allowed": ["x", "y"]}) is True
+        assert q(src, "data.t.r", {"name": "z"},
+                 data={"allowed": ["x", "y"]}) is None
+
+    def test_cross_module_import(self):
+        lib = """package lib.util
+is_big(x) { x > 10 }
+"""
+        main = """package t
+import data.lib.util
+r { util.is_big(input.n) }
+"""
+        ev = Evaluator([parse_module(lib), parse_module(main)],
+                       input={"n": 11})
+        assert ev.query("data.t.r") is True
+
+    def test_unsupported_constructs_raise(self):
+        with pytest.raises(RegoError):
+            parse_module("package t\nr { x := 1 } else = false { true }")
+        with pytest.raises(RegoError):
+            parse_module(
+                "package t\nr { every x in [1] { x > 0 } }")
+
+    def test_evaluation_budget(self):
+        # unbounded mutual recursion must terminate with an error or
+        # undefined, not hang (cycle guard returns undefined)
+        src = """package t
+a { b }
+b { a }
+"""
+        assert q(src, "data.t.a") is None
+
+
+# ------------------------------------------------- reference fixtures
+
+
+class TestReferenceFixtures:
+    def test_custom_policy_modules(self):
+        pdir = os.path.join(
+            REF, "integration/testdata/fixtures/repo/custom-policy",
+            "policy")
+        checks = load_rego_checks(
+            [os.path.join(pdir, "foo.rego"), os.path.join(pdir,
+                                                          "bar.rego")])
+        assert {c.namespace for c in checks} == {"user.foo", "user.bar"}
+        assert all(c.id == "N/A" and c.severity == "UNKNOWN"
+                   for c in checks)
+
+    def test_ignore_policy_basic(self):
+        from trivy_tpu.result.policy import load_ignore_policy
+
+        pol = load_ignore_policy(
+            os.path.join(REF, "examples/ignore-policies/basic.rego"))
+        assert pol.ignored({"PkgName": "bash"})
+        assert pol.ignored({"PkgName": "x", "Severity": "LOW"})
+        assert not pol.ignored({"PkgName": "x", "Severity": "HIGH"})
+        # not remotely exploitable (both sources agree) -> ignored
+        local = "CVSS:3.1/AV:L/AC:L/PR:N/UI:N/S:U/C:H/I:H/A:H"
+        net = "CVSS:3.1/AV:N/AC:L/PR:N/UI:N/S:U/C:H/I:H/A:H"
+        assert pol.ignored({"PkgName": "x", "Severity": "HIGH", "CVSS": {
+            "nvd": {"V3Vector": local}, "redhat": {"V3Vector": local}}})
+        assert not pol.ignored({
+            "PkgName": "x", "Severity": "HIGH", "CVSS": {
+                "nvd": {"V3Vector": net}, "redhat": {"V3Vector": net}}})
+        assert pol.ignored({"Severity": "HIGH", "CweIDs": ["CWE-352"]})
+        assert pol.ignored({"RuleID": "aws-access-key-id",
+                            "Match": 'AWS_ACCESS_KEY_ID='
+                                     '"********************"'})
+
+    def test_ignore_policy_advanced(self):
+        from trivy_tpu.result.policy import load_ignore_policy
+
+        pol = load_ignore_policy(
+            os.path.join(REF, "examples/ignore-policies/advanced.rego"))
+        hi_priv = "CVSS:3.1/AV:N/AC:L/PR:H/UI:N/S:U/C:H/I:H/A:H"
+        no_priv = "CVSS:3.1/AV:N/AC:L/PR:N/UI:N/S:U/C:H/I:H/A:H"
+        assert pol.ignored({"CVSS": {
+            "nvd": {"V3Vector": hi_priv},
+            "redhat": {"V3Vector": hi_priv}}})
+        assert not pol.ignored({"CVSS": {
+            "nvd": {"V3Vector": no_priv},
+            "redhat": {"V3Vector": no_priv}}})
+        # openssl: LOW sev and no denied CWE -> ignored
+        assert pol.ignored({"PkgName": "openssl", "Severity": "LOW",
+                            "CweIDs": ["CWE-999"]})
+        assert not pol.ignored({"PkgName": "openssl", "Severity": "LOW",
+                                "CweIDs": ["CWE-119"]})
+
+    def test_ignore_policy_whitelist_rego_v1(self):
+        from trivy_tpu.result.policy import load_ignore_policy
+
+        pol = load_ignore_policy(
+            os.path.join(REF, "examples/ignore-policies/whitelist.rego"))
+        assert not pol.ignored({"AVDID": "AVD-AWS-0089"})
+        assert pol.ignored({"AVDID": "AVD-AWS-0042"})
+
+
+# ------------------------------------------------------ engine wiring
+
+
+class TestEngineWiring:
+    def test_load_check_path_rego_dir(self, tmp_path):
+        from trivy_tpu.iac.engine import load_check_path
+
+        lib = tmp_path / "lib.rego"
+        lib.write_text("package lib.ports\nbad := {22, 23}\n")
+        chk = tmp_path / "chk.rego"
+        chk.write_text("""# METADATA
+# title: no telnet
+# custom:
+#   id: USR-100
+#   severity: HIGH
+#   input:
+#     selector:
+#     - type: kubernetes
+package user.telnet
+
+import data.lib.ports
+
+deny[msg] {
+    input.spec.ports[_] == ports.bad[_]
+    msg := "bad port exposed"
+}
+""")
+        checks = load_check_path(str(tmp_path))
+        assert len(checks) == 1     # lib module is not a check
+        c = checks[0]
+        assert (c.id, c.severity, c.title) == ("USR-100", "HIGH",
+                                               "no telnet")
+        assert c.file_types == ("kubernetes", "helm")
+
+        class K8sCtx:        # matches engine.input_doc dispatch
+            resource = {"spec": {"ports": [80, 23]}}
+
+        causes = c.fn(K8sCtx())
+        assert [x.message for x in causes] == ["bad port exposed"]
+
+    def test_rego_allowed_in_data_only_bundles(self, tmp_path):
+        from trivy_tpu.iac.engine import load_check_path
+
+        (tmp_path / "p.rego").write_text(
+            "package user.x\ndeny[m] { m := \"hit\" }\n")
+        (tmp_path / "evil.py").write_text("raise SystemExit(1)\n")
+        checks = load_check_path(str(tmp_path), allow_python=False)
+        assert [c.namespace for c in checks] == ["user.x"]
+
+    def test_legacy_rego_metadata_rule(self, tmp_path):
+        from trivy_tpu.iac.engine import load_check_path
+
+        (tmp_path / "m.rego").write_text("""package user.legacy
+__rego_metadata__ := {
+    "id": "USR-200",
+    "title": "legacy title",
+    "severity": "LOW",
+}
+deny[m] { m := "x" }
+""")
+        c = load_check_path(str(tmp_path))[0]
+        assert (c.id, c.title, c.severity) == ("USR-200", "legacy title",
+                                               "LOW")

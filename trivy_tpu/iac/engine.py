@@ -85,18 +85,11 @@ def input_doc(ctx) -> dict:
                     "Base": st.base,
                     "StartLine": st.start_line,
                     "Commands": [
-                        {
-                            "Cmd": i.cmd.lower(),
-                            "Value": i.value,
-                            "JSON": i.json_array(),
-                            "Flags": list(i.flags),
-                            "StartLine": i.start_line,
-                            "EndLine": i.end_line,
-                        }
+                        _dockerfile_command(i, idx, ctx.path)
                         for i in st.instructions
                     ],
                 }
-                for st in df.stages
+                for idx, st in enumerate(df.stages)
             ],
         }
     if kind == "K8sCtx":
@@ -115,6 +108,50 @@ def input_doc(ctx) -> dict:
             ],
         }
     return {}
+
+
+def _dockerfile_command(i, stage_idx: int, path: str) -> dict:
+    """One Command in the reference's Rego input schema
+    (pkg/iac/providers/dockerfile/dockerfile.go:30-44 — Value is
+    []string: exec-form args split, shell-form run/cmd/entrypoint kept
+    as one string, other instructions whitespace-tokenized)."""
+    cmd = i.cmd.lower()
+    value_src = i.value
+    sub = ""
+    if cmd in ("healthcheck", "onbuild"):
+        head, _, rest = i.value.strip().partition(" ")
+        if head and head.upper() in (
+                "CMD", "NONE", "RUN", "COPY", "ADD", "ENTRYPOINT"):
+            sub, value_src = head.lower(), rest
+    arr = i.json_array() if value_src is i.value else None
+    if arr is None and value_src.strip().startswith("["):
+        import json as _json
+
+        try:
+            parsed = _json.loads(value_src.strip())
+            arr = [str(a) for a in parsed] if isinstance(parsed, list) \
+                else None
+        except ValueError:
+            arr = None
+    if arr is not None:
+        value, is_json = arr, True
+    elif cmd in ("run", "cmd", "entrypoint") or sub:
+        value, is_json = ([value_src] if value_src else []), False
+    else:
+        value, is_json = value_src.split(), False
+    return {
+        "Cmd": cmd,
+        "SubCmd": sub,
+        "Value": value,
+        "JSON": is_json,
+        "Original": " ".join(
+            [i.cmd] + list(i.flags) + ([i.value] if i.value else [])),
+        "Flags": list(i.flags),
+        "Stage": stage_idx,
+        "Path": path,
+        "StartLine": i.start_line,
+        "EndLine": i.end_line,
+    }
 
 
 # ----------------------------------------------------------- path walk
@@ -358,7 +395,8 @@ def load_check_path(path: str, data: dict | None = None,
     beyond what the reference's sandboxed Rego bundles can do."""
     if os.path.isdir(path):
         out = []
-        for root, _dirs, names in os.walk(path):
+        rego_paths = []     # rego modules in one dir load together so
+        for root, _dirs, names in os.walk(path):    # imports resolve
             for n in sorted(names):
                 if n.startswith("."):
                     continue
@@ -366,10 +404,18 @@ def load_check_path(path: str, data: dict | None = None,
                     _log.warn("ignoring python check in data-only bundle",
                               path=os.path.join(root, n))
                     continue
+                if n.endswith(".rego"):
+                    if not n.endswith("_test.rego"):
+                        rego_paths.append(os.path.join(root, n))
+                    continue
                 if n.endswith((".py", ".yaml", ".yml")):
                     out.extend(load_check_path(
                         os.path.join(root, n), data, allow_python))
+        if rego_paths:
+            out.extend(_load_rego(rego_paths, data))
         return out
+    if path.endswith(".rego"):
+        return _load_rego([path], data)
     if path.endswith(".py"):
         if not allow_python:
             raise CheckLoadError(
@@ -378,6 +424,15 @@ def load_check_path(path: str, data: dict | None = None,
     if path.endswith((".yaml", ".yml")):
         return load_yaml_check(path)
     raise CheckLoadError(f"unsupported check file type: {path}")
+
+
+def _load_rego(paths: list[str], data: dict | None) -> list[Check]:
+    from trivy_tpu.iac.rego import RegoError, load_rego_checks
+
+    try:
+        return load_rego_checks(paths, data)
+    except RegoError as e:
+        raise CheckLoadError(str(e))
 
 
 def load_data_paths(paths: list[str]) -> dict:

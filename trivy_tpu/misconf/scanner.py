@@ -157,7 +157,7 @@ def _to_detected(chk: Check, file_type: str, cause: Cause | None,
         type=file_type, id=chk.id, avd_id=chk.avd_id, title=chk.title,
         description=chk.description, message=message,
         namespace=ns,
-        query=f"data.{ns.split('.')[0]}.deny", resolution=chk.resolution,
+        query=f"data.{ns}.deny", resolution=chk.resolution,
         severity=chk.severity, primary_url=chk.url,
         references=[chk.url] if chk.url else [], status=status,
         cause_metadata=md,

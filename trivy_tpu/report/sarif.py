@@ -56,7 +56,8 @@ def _level(severity: str) -> str:
 
 def _escape(s: str) -> str:
     """Go html.EscapeString: <, >, &, ', " (in that charset)."""
-    return html.escape(s or "", quote=True).replace("&#x27;", "&#39;")
+    return html.escape(s or "", quote=True).replace(
+        "&#x27;", "&#39;").replace("&quot;", "&#34;")
 
 
 _REPO_COMPONENT = re.compile(r"^[a-z0-9]+(?:(?:[._]|__|[-]+)[a-z0-9]+)*$")

@@ -46,12 +46,34 @@ class IgnorePolicy:
 
 
 def load_ignore_policy(path: str) -> IgnorePolicy:
+    if path.endswith(".rego"):
+        return _load_rego(path)
     if path.endswith((".yaml", ".yml")):
         return _load_yaml(path)
     if path.endswith(".py"):
         return _load_python(path)
     raise PolicyError(
-        f"unsupported ignore policy {path!r} (want .yaml/.yml or .py)")
+        f"unsupported ignore policy {path!r} (want .rego/.yaml/.py)")
+
+
+def _load_rego(path: str) -> IgnorePolicy:
+    """Reference-compatible Rego ignore policy: `package trivy` with
+    `ignore` rules evaluated per finding (pkg/result/filter.go
+    applyPolicy; examples/ignore-policies/*.rego run unmodified)."""
+    from trivy_tpu.iac.rego import Evaluator, RegoError, parse_module
+
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        module = parse_module(src)
+    except RegoError as exc:
+        raise PolicyError(f"{path}: {exc}")
+    query = "data." + ".".join(module.package) + ".ignore"
+
+    def fn(finding: dict) -> bool:
+        return Evaluator([module], input=finding).query(query) is True
+
+    return IgnorePolicy(fn)
 
 
 def _load_yaml(path: str) -> IgnorePolicy:
