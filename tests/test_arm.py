@@ -1,0 +1,269 @@
+"""ARM template expression evaluator tests (reference
+pkg/iac/scanners/azure/{expressions,functions,resolver,arm}): expression
+grammar, function semantics, copy loops, conditions, nested deployments,
+and end-to-end check firing through expression indirection."""
+
+import json
+
+from trivy_tpu.iac.arm import (
+    UNRESOLVED,
+    Deployment,
+    evaluate_expression,
+    evaluate_template,
+    is_expression,
+    parse_expression,
+    resolve_value,
+)
+
+
+def ev(code, template=None, params=None):
+    return evaluate_expression(code, Deployment(template or {}, params))
+
+
+class TestExpressions:
+    def test_is_expression(self):
+        assert is_expression("[parameters('x')]")
+        assert not is_expression("plain")
+        assert not is_expression("[[escaped]")
+        assert not is_expression(7)
+
+    def test_literals_and_strings(self):
+        assert ev("'hello'") == "hello"
+        assert ev("42") == 42
+        assert ev("'it''s'") == "it's"
+
+    def test_concat_and_nesting(self):
+        assert ev("concat('a', 'b', 'c')") == "abc"
+        assert ev("concat('n-', string(add(1, 2)))") == "n-3"
+        assert ev("concat(createArray(1), createArray(2))") == [1, 2]
+
+    def test_parameters_default_and_supplied(self):
+        tpl = {"parameters": {"sku": {"type": "string",
+                                      "defaultValue": "Standard_LRS"}}}
+        assert ev("parameters('sku')", tpl) == "Standard_LRS"
+        assert ev("parameters('sku')", tpl,
+                  {"sku": "Premium"}) == "Premium"
+        assert ev("parameters('missing')", tpl) is UNRESOLVED
+
+    def test_variables_chain_and_cycle(self):
+        tpl = {
+            "parameters": {"env": {"defaultValue": "prod"}},
+            "variables": {
+                "base": "[parameters('env')]",
+                "full": "[concat(variables('base'), '-store')]",
+                "a": "[variables('b')]", "b": "[variables('a')]",
+            },
+        }
+        assert ev("variables('full')", tpl) == "prod-store"
+        assert ev("variables('a')", tpl) is UNRESOLVED
+
+    def test_property_and_index_access(self):
+        tpl = {"variables": {"obj": {"p": {"q": [10, 20]}}}}
+        assert ev("variables('obj').p.q[1]", tpl) == 20
+        assert ev("variables('obj').nope", tpl) is UNRESOLVED
+        assert ev("createArray('x', 'y')[0]") == "x"
+
+    def test_logic_functions(self):
+        assert ev("if(equals(1, 1), 'y', 'n')") == "y"
+        assert ev("if(equals('a', 'b'), 'y', 'n')") == "n"
+        assert ev("and(true(), not(false()))") is True
+        assert ev("or(false(), false())") is False
+        assert ev("coalesce(null(), 'x')") == "x"
+
+    def test_string_functions(self):
+        assert ev("format('{0}-{1}', 'a', 1)") == "a-1"
+        assert ev("toLower('ABC')") == "abc"
+        assert ev("replace('a-b', '-', '_')") == "a_b"
+        assert ev("substring('abcdef', 1, 3)") == "bcd"
+        assert ev("split('a,b', ',')") == ["a", "b"]
+        assert ev("join(createArray('a', 'b'), '/')") == "a/b"
+        assert ev("startsWith('abc', 'ab')") is True
+        assert ev("length('abcd')") == 4
+        assert ev("empty('')") is True
+
+    def test_numeric_functions(self):
+        assert ev("add(2, 3)") == 5
+        assert ev("mul(4, 5)") == 20
+        assert ev("div(7, 2)") == 3
+        assert ev("mod(7, 2)") == 1
+        assert ev("min(3, 1)") == 1
+        assert ev("div(1, 0)") is UNRESOLVED
+
+    def test_collections(self):
+        assert ev("union(createObject('a', 1), createObject('b', 2))") \
+            == {"a": 1, "b": 2}
+        assert ev("intersection(createArray(1, 2), createArray(2, 3))") \
+            == [2]
+        assert ev("first(createArray(7, 8))") == 7
+        assert ev("take(createArray(1, 2, 3), 2)") == [1, 2]
+        assert ev("contains(createArray('x'), 'x')") is True
+
+    def test_runtime_only_unresolvable(self):
+        assert ev("reference('r').properties.x") is UNRESOLVED
+        assert ev("listKeys('x', '1').keys[0].value") is UNRESOLVED
+        assert ev("newGuid()") is UNRESOLVED
+
+    def test_unique_string_deterministic(self):
+        a = ev("uniqueString('seed')")
+        assert a == ev("uniqueString('seed')")
+        assert len(a) == 13 and a != ev("uniqueString('other')")
+
+    def test_resource_id(self):
+        got = ev("resourceId('Microsoft.Storage/storageAccounts', 'sa')")
+        assert got == "/Microsoft.Storage/storageAccounts/sa"
+
+    def test_bracket_escape_and_plain(self):
+        dep = Deployment({})
+        assert resolve_value("[[literal]", dep) == "[literal]"
+        assert resolve_value("no brackets", dep) == "no brackets"
+
+    def test_parse_error_is_unresolved(self):
+        assert ev("concat('unterminated") is UNRESOLVED
+        assert ev("!!!") is UNRESOLVED
+
+
+class TestTemplateEvaluation:
+    def test_resolution_through_params_and_vars(self):
+        tpl = {
+            "parameters": {"https": {"type": "bool",
+                                     "defaultValue": False}},
+            "variables": {"tls": "TLS1_0"},
+            "resources": [{
+                "type": "Microsoft.Storage/storageAccounts",
+                "name": "[concat('sa', uniqueString('x'))]",
+                "properties": {
+                    "supportsHttpsTrafficOnly": "[parameters('https')]",
+                    "minimumTlsVersion": "[variables('tls')]",
+                },
+            }],
+        }
+        out = evaluate_template(tpl)
+        props = out["resources"][0]["properties"]
+        assert props["supportsHttpsTrafficOnly"] is False
+        assert props["minimumTlsVersion"] == "TLS1_0"
+        assert out["resources"][0]["name"].startswith("sa")
+
+    def test_unresolvable_becomes_none(self):
+        tpl = {"resources": [{
+            "type": "t", "name": "n",
+            "properties": {"x": "[reference('other').properties.v]"},
+        }]}
+        out = evaluate_template(tpl)
+        assert out["resources"][0]["properties"]["x"] is None
+
+    def test_condition_false_drops_resource(self):
+        tpl = {
+            "parameters": {"deployIt": {"defaultValue": False}},
+            "resources": [
+                {"type": "a", "name": "gone",
+                 "condition": "[parameters('deployIt')]"},
+                {"type": "b", "name": "kept", "condition": True},
+                {"type": "c", "name": "unknown-kept",
+                 "condition": "[parameters('nope')]"},
+            ],
+        }
+        names = [r["name"] for r in
+                 evaluate_template(tpl)["resources"]]
+        assert names == ["kept", "unknown-kept"]
+
+    def test_copy_loop_expansion(self):
+        tpl = {"resources": [{
+            "type": "Microsoft.Network/publicIPAddresses",
+            "name": "[concat('ip-', string(copyIndex()))]",
+            "copy": {"name": "ipLoop", "count": 3},
+            "properties": {"idx": "[copyIndex('ipLoop', 10)]"},
+        }]}
+        out = evaluate_template(tpl)["resources"]
+        assert [r["name"] for r in out] == ["ip-0", "ip-1", "ip-2"]
+        assert [r["properties"]["idx"] for r in out] == [10, 11, 12]
+
+    def test_nested_deployment_flattens(self):
+        inner = {
+            "parameters": {"sku": {"type": "string"}},
+            "resources": [{
+                "type": "Microsoft.Storage/storageAccounts",
+                "name": "inner-sa",
+                "properties": {"sku": "[parameters('sku')]"},
+            }],
+        }
+        tpl = {
+            "variables": {"chosen": "Premium_LRS"},
+            "resources": [{
+                "type": "Microsoft.Resources/deployments",
+                "name": "nested",
+                "properties": {
+                    "mode": "Incremental",
+                    "template": inner,
+                    "parameters": {
+                        "sku": {"value": "[variables('chosen')]"}},
+                },
+            }],
+        }
+        out = evaluate_template(tpl)["resources"]
+        assert len(out) == 1
+        assert out[0]["name"] == "inner-sa"
+        assert out[0]["properties"]["sku"] == "Premium_LRS"
+
+
+class TestEndToEndChecks:
+    def _scan(self, doc: dict):
+        from trivy_tpu.iac import detection
+        from trivy_tpu.misconf.scanner import scan_config
+
+        return scan_config("azuredeploy.json",
+                           json.dumps(doc).encode(),
+                           file_type=detection.AZURE_ARM)
+
+    def test_check_fires_through_expression_indirection(self):
+        """A finding that exists ONLY after expression resolution:
+        https-only routed through parameters -> variables."""
+        doc = {
+            "$schema": "https://schema.management.azure.com/schemas/"
+                       "2019-04-01/deploymentTemplate.json#",
+            "contentVersion": "1.0.0.0",
+            "parameters": {"secureTransfer": {"type": "bool",
+                                              "defaultValue": False}},
+            "variables": {"https": "[parameters('secureTransfer')]"},
+            "resources": [{
+                "type": "Microsoft.Storage/storageAccounts",
+                "name": "sa1",
+                "properties": {
+                    "supportsHttpsTrafficOnly": "[variables('https')]",
+                },
+            }],
+        }
+        m = self._scan(doc)
+        assert m is not None
+        assert "AVD-AZU-0008" in {f.id for f in m.failures}
+
+    def test_check_passes_when_expression_resolves_secure(self):
+        doc = {
+            "parameters": {"secureTransfer": {"type": "bool",
+                                              "defaultValue": True}},
+            "resources": [{
+                "type": "Microsoft.Storage/storageAccounts",
+                "name": "sa1",
+                "properties": {
+                    "supportsHttpsTrafficOnly":
+                        "[parameters('secureTransfer')]",
+                },
+            }],
+        }
+        m = self._scan(doc)
+        assert "AVD-AZU-0008" in {s.id for s in m.successes}
+
+    def test_unresolvable_stays_silent(self):
+        """reference() can't be known at scan time -> no false
+        positive (KindUnresolvable semantics)."""
+        doc = {
+            "resources": [{
+                "type": "Microsoft.Storage/storageAccounts",
+                "name": "sa1",
+                "properties": {
+                    "supportsHttpsTrafficOnly":
+                        "[reference('cfg').properties.https]",
+                },
+            }],
+        }
+        m = self._scan(doc)
+        assert "AVD-AZU-0008" not in {f.id for f in m.failures}

@@ -1,0 +1,323 @@
+"""Terraform evaluation parity against the reference's own scanner test
+corpus: the HCL sources below are ported from
+pkg/iac/scanners/terraform/{count_test.go,module_test.go,ignore_test.go}
+(VERDICT r4 directive 7 — eval-depth parity on reference-derived
+fixtures, not self-authored ones).
+
+The reference asserts through a rego check that fires once per
+aws_s3_bucket with an empty name (setup_test.go emptyBucketCheck); here
+the same semantics are asserted on the evaluated blocks / the same
+check-engine ignore path."""
+
+from __future__ import annotations
+
+import pytest
+
+from trivy_tpu.iac.terraform import ModuleLoader, evaluate_module
+
+
+def _eval(files: dict[str, str], root=""):
+    raw = {p: c.encode() for p, c in files.items()}
+    loader = ModuleLoader(raw)
+    return evaluate_module(loader.tf_files(root), root, loader)
+
+
+def _buckets(files, root=""):
+    ev = _eval(files, root)
+    return [b for b in ev.blocks
+            if b.type == "resource"
+            and b.labels[:1] == ["aws_s3_bucket"]]
+
+
+def _empty_name_count(files, root=""):
+    """Reference emptyBucketCheck: one failure per aws_s3_bucket whose
+    `bucket` is empty/unset."""
+    n = 0
+    for b in _buckets(files, root):
+        v = b.get("bucket")
+        if v is None or v == "":
+            n += 1
+    return n
+
+
+# ------------------------------------------------- count_test.go cases
+
+
+COUNT_CASES = [
+    ("unspecified count defaults to 1",
+     'resource "aws_s3_bucket" "test" {}', 1),
+    ("count is literal 1",
+     'resource "aws_s3_bucket" "test" {\n  count = 1\n}', 1),
+    ("count is literal 99",
+     'resource "aws_s3_bucket" "test" {\n  count = 99\n}', 99),
+    ("count is literal 0",
+     'resource "aws_s3_bucket" "test" {\n  count = 0\n}', 0),
+    ("count is 0 from variable", '''
+variable "count" {
+  default = 0
+}
+resource "aws_s3_bucket" "test" {
+  count = var.count
+}
+''', 0),
+    ("count is 1 from variable", '''
+variable "count" {
+  default = 1
+}
+resource "aws_s3_bucket" "test" {
+  count =  var.count
+}
+''', 1),
+    ("count is 1 from variable without default", '''
+variable "count" {
+}
+resource "aws_s3_bucket" "test" {
+  count =  var.count
+}
+''', 1),
+    ("count is 0 from conditional", '''
+variable "enabled" {
+  default = false
+}
+resource "aws_s3_bucket" "test" {
+  count = var.enabled ? 1 : 0
+}
+''', 0),
+    ("count is 1 from conditional", '''
+variable "enabled" {
+  default = true
+}
+resource "aws_s3_bucket" "test" {
+  count = var.enabled ? 1 : 0
+}
+''', 1),
+]
+
+
+@pytest.mark.parametrize("name,source,expected", COUNT_CASES,
+                         ids=[c[0] for c in COUNT_CASES])
+def test_count_semantics(name, source, expected):
+    assert _empty_name_count({"main.tf": source}) == expected
+
+
+def test_count_issue_962_cross_resource_indexed_ref():
+    """count-expanded instances are addressable as res.name[idx] from
+    other expressions (count_test.go "issue 962")."""
+    src = '''
+resource "something" "else" {
+  count = 2
+  ok = true
+}
+
+resource "aws_s3_bucket" "test" {
+  bucket = something.else[0].ok ? "test" : ""
+}
+'''
+    assert _empty_name_count({"main.tf": src}) == 0
+    assert _buckets({"main.tf": src})[0].get("bucket") == "test"
+
+
+def test_count_index_into_variable_list_of_maps():
+    """count.index indexes a typed list(map(string)) variable
+    (count_test.go "Test use of count.index")."""
+    src = '''
+resource "aws_s3_bucket" "test" {
+  count = 1
+  bucket = var.things[count.index]["ok"] ? "test" : ""
+}
+
+variable "things" {
+  description = "A list of maps that creates a number of sg"
+  type = list(map(string))
+
+  default = [
+    {
+      ok = true
+    }
+  ]
+}
+'''
+    assert _empty_name_count({"main.tf": src}) == 0
+
+
+# ------------------------------------------------ module_test.go cases
+
+
+def test_module_data_ref_through_call():
+    """Unknown data-source attr flows into the child without breaking
+    evaluation of its other resources (module_test.go "go-cty
+    compatibility issue")."""
+    files = {
+        "project/main.tf": '''
+data "aws_vpc" "default" {
+  default = true
+}
+
+module "test" {
+  source     = "../modules/problem/"
+  cidr_block = data.aws_vpc.default.cidr_block
+}''',
+        "modules/problem/main.tf": '''variable "cidr_block" {}
+
+variable "open" {
+  default = false
+}
+
+resource "aws_security_group" "this" {
+  name = "Test"
+
+  ingress {
+    description = "HTTPs"
+    from_port   = 443
+    to_port     = 443
+    protocol    = "tcp"
+    self        = ! var.open
+  }
+}
+
+resource "aws_s3_bucket" "test" {}''',
+    }
+    assert _empty_name_count(files, root="project") == 1
+
+
+def test_module_in_sibling_directory():
+    files = {
+        "project/main.tf": '''
+module "something" {
+  source = "../modules/problem"
+}
+''',
+        "modules/problem/main.tf":
+            'resource "aws_s3_bucket" "test" {}',
+    }
+    assert _empty_name_count(files, root="project") == 1
+
+
+def test_module_in_subdirectory():
+    files = {
+        "project/main.tf": '''
+module "something" {
+  source = "./modules/problem"
+}
+''',
+        "project/modules/problem/main.tf":
+            'resource "aws_s3_bucket" "test" {}',
+    }
+    assert _empty_name_count(files, root="project") == 1
+
+
+def test_module_in_parent_directory():
+    files = {
+        "project/main.tf": '''
+module "something" {
+  source = "../problem"
+}
+''',
+        "problem/main.tf": 'resource "aws_s3_bucket" "test" {}',
+    }
+    assert _empty_name_count(files, root="project") == 1
+
+
+def test_module_argument_overrides_child_default():
+    """A value passed at the call site must shadow the child variable's
+    default (module_test.go passing variables through)."""
+    files = {
+        "project/main.tf": '''
+module "something" {
+  source = "../mod"
+  bucket_name = "from-parent"
+}
+''',
+        "mod/main.tf": '''
+variable "bucket_name" {
+  default = ""
+}
+resource "aws_s3_bucket" "test" {
+  bucket = var.bucket_name
+}
+''',
+    }
+    assert _empty_name_count(files, root="project") == 0
+    assert _buckets(files, root="project")[0].get("bucket") == \
+        "from-parent"
+
+
+# ------------------------------------------------ ignore_test.go cases
+# asserted through the check-engine path (scan_terraform_modules), with
+# AVD-AWS-0086/0092-style checks replaced by whichever builtin fires on
+# a public-read ACL — the ignore machinery is what's under test.
+
+
+def _scan_ignore_case(source: str) -> bool:
+    """True iff the public-ACL finding was suppressed."""
+    from trivy_tpu.misconf.scanner import scan_terraform_modules
+
+    res = scan_terraform_modules({"main.tf": source.encode()})
+    for m in res:
+        if any(f.id == "AVD-AWS-0092" for f in m.failures):
+            return False
+    return True
+
+
+PUBLIC_BUCKET = '''resource "aws_s3_bucket" "test" {
+  acl = "public-read"
+}'''
+
+
+IGNORE_CASES = [
+    ("inline rule ignore all checks",
+     'resource "aws_s3_bucket" "test" {\n'
+     '  acl = "public-read" // trivy:ignore:*\n}', True),
+    ("tfsec legacy prefix",
+     'resource "aws_s3_bucket" "test" {\n'
+     '  acl = "public-read" // tfsec:ignore:*\n}', True),
+    ("rule above block ignore all checks",
+     '// trivy:ignore:*\n' + PUBLIC_BUCKET, True),
+    ("rule above block by id",
+     '// trivy:ignore:AVD-AWS-0092\n' + PUBLIC_BUCKET, True),
+    ("rule above block by other id does not ignore",
+     '// trivy:ignore:AVD-AWS-9999\n' + PUBLIC_BUCKET, False),
+    ("rule above block with matching string parameter",
+     '// trivy:ignore:*[acl=public-read]\n' + PUBLIC_BUCKET, True),
+    ("rule above block with non-matching string parameter",
+     '// trivy:ignore:*[acl=private]\n' + PUBLIC_BUCKET, False),
+    ("rule above block with non-existent parameter",
+     '// trivy:ignore:*[nope=1]\n' + PUBLIC_BUCKET, False),
+    ("stacked rules above block",
+     '// trivy:ignore:a\n// trivy:ignore:*\n// trivy:ignore:b\n'
+     + PUBLIC_BUCKET, True),
+    ("stacked rules broken by blank line",
+     '// trivy:ignore:*\n\n// trivy:ignore:b\n' + PUBLIC_BUCKET,
+     False),
+    ("stacked rules without spaces between # comments",
+     '#trivy:ignore:*\n#trivy:ignore:a\n' + PUBLIC_BUCKET, True),
+    ("rule above the finding line",
+     'resource "aws_s3_bucket" "test" {\n'
+     '  # trivy:ignore:AVD-AWS-0092\n  acl = "public-read"\n}', True),
+    ("breached expiration date",
+     'resource "aws_s3_bucket" "test" {\n'
+     '  acl = "public-read" # trivy:ignore:*:exp:2000-01-02\n}',
+     False),
+    ("unbreached expiration date",
+     'resource "aws_s3_bucket" "test" {\n'
+     '  acl = "public-read" # trivy:ignore:*:exp:2221-01-02\n}',
+     True),
+    ("invalid expiration date",
+     'resource "aws_s3_bucket" "test" {\n'
+     '  acl = "public-read" # trivy:ignore:*:exp:2221-13-02\n}',
+     False),
+    ("rule above block with unbreached expiration",
+     '#trivy:ignore:*:exp:2221-01-02\n' + PUBLIC_BUCKET, True),
+    ("workspace mismatch keeps finding",
+     'resource "aws_s3_bucket" "test" {\n'
+     '  acl = "public-read" # trivy:ignore:*:ws:prod\n}', False),
+    ("workspace glob matching default",
+     'resource "aws_s3_bucket" "test" {\n'
+     '  acl = "public-read" # trivy:ignore:*:ws:def*\n}', True),
+]
+
+
+@pytest.mark.parametrize("name,source,suppressed", IGNORE_CASES,
+                         ids=[c[0] for c in IGNORE_CASES])
+def test_ignore_semantics(name, source, suppressed):
+    assert _scan_ignore_case(source) is suppressed

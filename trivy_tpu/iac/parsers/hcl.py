@@ -69,6 +69,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<string>"(?:\$\{[^}]*\}|[^"\\]|\\.)*")
   | (?P<number>-?\d+(?:\.\d+)?)
   | (?P<ident>[A-Za-z_][\w.\-*\[\]"]*)
+  | (?P<op>\|\||&&|==|!=|<=|>=|=>|\?|[+*/%!<>-])
   | (?P<punct>[{}\[\](),=:])
   | (?P<newline>\n)
   | (?P<ws>[ \t\r]+)
@@ -161,7 +162,15 @@ class _Parser:
         nxt = self.peek()
         if nxt.text == "=":
             self.next()
-            value = self.parse_value()
+            start = self.i
+            if self.peek().kind == "op":    # unary !x / -x / ...
+                value = self._capture_expr(start, ())
+            else:
+                value = self.parse_value()
+                if self.peek(skip_nl=False).kind == "op":
+                    # operator continues the expression (a ? b : c,
+                    # x + y, ...): recapture the whole source span
+                    value = self._capture_expr(start, ())
             attrs[name] = Attribute(name, value, first.line)
             return
         # block: ident [labels...] {
@@ -181,6 +190,38 @@ class _Parser:
             else:
                 return  # malformed; bail on this item
 
+    def _capture_expr(self, start_idx: int, terminators) -> Expr:
+        """Re-join raw tokens from start_idx up to the end of the
+        expression (newline / terminator / closing bracket at depth 0)
+        into an Expr for the terraform evaluator — multi-token
+        expressions like `var.enabled ? 1 : 0` or `!var.open` span
+        several tokens the literal-value grammar can't hold."""
+        self.i = start_idx
+        parts = []
+        depth = 0
+        while True:
+            t = self.peek(skip_nl=False)
+            if t.kind == "eof":
+                break
+            if t.kind == "newline":
+                if depth == 0:
+                    break
+                self.next(skip_nl=False)
+                continue
+            if t.text in ("(", "[", "{"):
+                depth += 1
+            elif t.text in (")", "]", "}"):
+                if depth == 0:
+                    break
+                depth -= 1
+            elif depth == 0 and t.text in terminators:
+                break
+            parts.append(self.next(skip_nl=False).text)
+        if not parts:
+            self.next(skip_nl=False)    # always advance: a stuck
+            # caller loop must never re-enter at the same token
+        return Expr(" ".join(parts))
+
     def parse_value(self):
         t = self.peek()
         if t.text == "[":
@@ -193,7 +234,14 @@ class _Parser:
                     break
                 if p.kind == "eof":
                     break
-                items.append(self.parse_value())
+                while self.peek(skip_nl=False).kind == "newline":
+                    self.next(skip_nl=False)  # keep start off newlines:
+                    # _capture_expr stops at depth-0 newlines
+                start = self.i
+                v = self.parse_value()
+                if self.peek(skip_nl=False).kind == "op":
+                    v = self._capture_expr(start, (",",))
+                items.append(v)
                 if self.peek().text == ",":
                     self.next()
             return items
@@ -210,7 +258,13 @@ class _Parser:
                 key = self.next().text.strip('"')
                 if self.peek().text in ("=", ":"):
                     self.next()
-                obj[key] = self.parse_value()
+                while self.peek(skip_nl=False).kind == "newline":
+                    self.next(skip_nl=False)
+                start = self.i
+                v = self.parse_value()
+                if self.peek(skip_nl=False).kind == "op":
+                    v = self._capture_expr(start, (",",))
+                obj[key] = v
                 if self.peek().text == ",":
                     self.next()
             return obj

@@ -677,12 +677,16 @@ def _go_sprintf(fmt: str, args: list) -> str:
             i += 2
             continue
         j = i + 1
-        while j < n and fmt[j] not in "vsdfxXeqt":
+        while j < n and not fmt[j].isalpha():
             j += 1
         if j >= n:
             out.append(fmt[i:])
             break
         verb, flags = fmt[j], fmt[i + 1:j]
+        if verb not in "vsdfxXeqt":
+            out.append(fmt[i:j + 1])    # unknown verb: keep literal,
+            i = j + 1                   # do not consume an argument
+            continue
         a = args[ai] if ai < len(args) else ""
         ai += 1
         if verb == "v":
@@ -1074,8 +1078,8 @@ class Evaluator:
                 env3[rt[1]] = v
                 yield env3
             return
-        if lt[0] == "array" and rt[0] != "array":
-            # destructure [a, b] = expr
+        if lt[0] == "array":
+            # destructure [a, b] = expr (incl. array-literal rhs)
             for v, env2 in self._eval_term(rt, env, mod):
                 if not isinstance(v, list) or len(v) != len(lt[1]):
                     continue

@@ -37,7 +37,7 @@ _log = logger("terraform")
 
 MAX_PASSES = 8
 MAX_MODULE_DEPTH = 6
-MAX_EXPANSION = 64  # count/for_each clone cap per block
+MAX_EXPANSION = 256  # count/for_each clone cap per block
 
 
 class _Unknown:
@@ -441,6 +441,10 @@ def _access(v, key):
         except (ValueError, IndexError, TypeError):
             return UNKNOWN
     if isinstance(v, Block):
+        if isinstance(key, (int, float)) and not isinstance(key, bool):
+            # res.name[N]: the registry holds the pre-expansion
+            # prototype; any instance shares its literal attrs
+            return v
         out = v.get(key, UNKNOWN)
         return out
     return UNKNOWN

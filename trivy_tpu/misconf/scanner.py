@@ -103,13 +103,19 @@ def _contexts(file_type: str, path: str, content: bytes) -> list:
     if file_type == detection.AZURE_ARM:
         import json as _json
 
+        from trivy_tpu.iac.arm import evaluate_template
         from trivy_tpu.iac.checks.azure import adapt_arm
 
         try:
             doc = _json.loads(content)
         except ValueError:
             return []
-        return [CloudCtx(path=path, cloud_resources=adapt_arm(doc))]
+        # resolve [parameters()/variables()/...] expressions, expand
+        # copy loops, flatten nested deployments before adapting
+        # (reference pkg/iac/scanners/azure/arm + resolver)
+        return [CloudCtx(path=path,
+                         cloud_resources=adapt_arm(
+                             evaluate_template(doc)))]
     return []
 
 
@@ -202,8 +208,24 @@ def scan_terraform_modules(
 def _run_checks(ftype: str, path: str, ctxs: list,
                 content: bytes) -> Misconfiguration:
     """Run every active check for `ftype` over the contexts, apply
-    `#trivy:ignore` comments, and collect FAIL/PASS findings."""
+    `#trivy:ignore` / `#tfsec:ignore` comments (incl. parameterized and
+    above-block forms), and collect FAIL/PASS findings."""
+    from trivy_tpu.utils import clock
+
     ignores = parse_ignores(content)
+    today = clock.now().date()
+    # line-range -> resolved attrs, so above-block and parameterized
+    # ignores can bind to the resource a cause sits in
+    spans = [(r.start_line, r.end_line, r.attrs)
+             for ctx in ctxs
+             for r in getattr(ctx, "cloud_resources", ())]
+
+    def _enclosing(c: Cause):
+        for s, e, attrs in spans:
+            if s and s <= c.start_line <= max(e, s):
+                return s, attrs
+        return 0, None
+
     misconf = Misconfiguration(file_type=ftype, file_path=path)
     from trivy_tpu.iac.engine import active
 
@@ -214,11 +236,15 @@ def _run_checks(ftype: str, path: str, ctxs: list,
                 causes.extend(chk.run(ctx))
             except Exception:
                 continue  # a broken check must not kill the scan
-        causes = [
-            c for c in causes
+        kept = []
+        for c in causes:
+            res_start, attrs = _enclosing(c)
             if not is_ignored(ignores, chk.id, chk.avd_id,
-                              c.start_line, c.end_line)
-        ]
+                              c.start_line, c.end_line,
+                              resource_start=res_start, attrs=attrs,
+                              today=today):
+                kept.append(c)
+        causes = kept
         if causes:
             for c in causes:
                 misconf.failures.append(
