@@ -291,6 +291,18 @@ def _contains(coll, item):
     return False
 
 
+def _split(s, d):
+    if not isinstance(s, str):
+        return UNRESOLVED
+    if isinstance(d, list):
+        if not d:
+            return [s]
+        for other in d[1:]:     # split once on ANY delimiter
+            s = s.replace(other, d[0])
+        return s.split(d[0])
+    return s.split(d)
+
+
 def _length(x):
     if isinstance(x, (str, list, dict)):
         return len(x)
@@ -415,10 +427,7 @@ _FUNCS: dict = {
     "substring": lambda s, off, ln=None: (
         s[off:] if ln is None else s[off:off + ln]) if isinstance(
             s, str) else UNRESOLVED,
-    "split": lambda s, d: ([p for seg in ([s.split(x) for x in d] if
-                            isinstance(d, list) else [s.split(d)])
-                            for p in seg]) if isinstance(s, str)
-    else UNRESOLVED,
+    "split": _split,
     "join": lambda arr, d: d.join(_to_str(x) for x in arr)
     if isinstance(arr, list) else UNRESOLVED,
     "startsWith": lambda s, p: s.startswith(p) if _want_str((s, p))
@@ -440,11 +449,17 @@ _FUNCS: dict = {
     "contains": _contains,
     "equals": _equals,
     "not": lambda b: (not b) if isinstance(b, bool) else UNRESOLVED,
-    "and": lambda *bs: all(b is True for b in bs),
-    "or": lambda *bs: any(b is True for b in bs),
-    "if": lambda c, t, f: t if c is True else f,
-    "coalesce": lambda *xs: next((x for x in xs if x is not None
-                                  and x is not UNRESOLVED), None),
+    "and": lambda *bs: (False if any(b is False for b in bs) else
+                        UNRESOLVED if any(b is UNRESOLVED for b in bs)
+                        else all(b is True for b in bs)),
+    "or": lambda *bs: (True if any(b is True for b in bs) else
+                       UNRESOLVED if any(b is UNRESOLVED for b in bs)
+                       else False),
+    "if": lambda c, t, f: (UNRESOLVED if c is UNRESOLVED else
+                           t if c is True else f),
+    "coalesce": lambda *xs: (
+        UNRESOLVED if any(x is UNRESOLVED for x in xs)
+        else next((x for x in xs if x is not None), None)),
     "add": _int2(lambda a, b: a + b),
     "sub": _int2(lambda a, b: a - b),
     "mul": _int2(lambda a, b: a * b),
@@ -571,7 +586,10 @@ def resolve_value(v, dep: Deployment):
     """Recursively resolve a template value: expression strings
     evaluate, `[[` unescapes, containers recurse."""
     if is_expression(v):
-        return resolve_value(evaluate_expression(v[1:-1], dep), dep)
+        # evaluate exactly once: parameters()/variables()/property
+        # access resolve their own raw template subtrees, so the result
+        # is final — a computed "[x]" string must NOT be re-parsed
+        return evaluate_expression(v[1:-1], dep)
     if isinstance(v, str) and v.startswith("[["):
         return v[1:]
     if isinstance(v, dict):

@@ -25,7 +25,8 @@ import fnmatch
 import re
 from dataclasses import dataclass, field
 
-_MARK = re.compile(r"(?:#|//|/\*)\s*(?:trivy|tfsec):ignore:(\S+)", re.I)
+_COMMENT_START = re.compile(r"#|//|/\*")
+_MARK = re.compile(r"(?:trivy|tfsec):ignore:(\S+)", re.I)
 _COMMENT_ONLY = re.compile(r"^\s*(#|//|/\*)")
 
 
@@ -79,11 +80,17 @@ def parse_ignores(content: bytes) -> list[IgnoreRule]:
     lines = content.decode("utf-8", "replace").splitlines()
     out: list[IgnoreRule] = []
     for n, line in enumerate(lines, start=1):
-        for m in _MARK.finditer(line):
+        cm = _COMMENT_START.search(line)
+        if not cm:
+            continue
+        # everything after the comment marker may stack several
+        # `trivy:ignore:` / `tfsec:ignore:` directives on one line
+        offset = cm.start()
+        for m in _MARK.finditer(line[offset:]):
             rec = _parse_segments(m.group(1).strip())
             if rec is None:
                 continue
-            before = line[:m.start()].strip()
+            before = line[:offset].strip()
             if before:                          # trailing a code line
                 rec.target_line = n
             else:       # standalone: chain through stacked comments to

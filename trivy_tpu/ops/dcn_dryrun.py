@@ -1,0 +1,239 @@
+"""Two-process DCN dryrun: the multi-host half of SURVEY §2.10,
+exercised for real with `jax.distributed` — 2 CPU processes × 4 virtual
+devices each, a hybrid (data × db) mesh whose "data" axis spans the
+process boundary (DCN) while "db" stays host-local (ICI), the DB shard
+broadcast (ops/multihost.put_sharded), per-host query globalization
+(make_array_from_process_local_data), one jitted sharded match over the
+global mesh, and a cross-host collective reduction.
+
+Verification per host: the global run's addressable output shards must
+be bit-identical to a single-host run of the same half-batch on a local
+mesh (which tests/test_match.py ties to the python oracle), and the
+jitted global hit-count must equal the sum both hosts report.
+
+Run the launcher (spawns both workers, writes the artifact):
+
+    python -m trivy_tpu.ops.dcn_dryrun [--out MULTICHIP_DCN.json]
+
+(reference counterpart: the NCCL/MPI-style multi-node scan fan-out the
+Go scanner delegates to its client/server split, pkg/rpc + SURVEY §2.10)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+N_PROCESSES = 2
+N_LOCAL_DEVICES = 4
+N_QUERIES_PER_HOST = 257        # deliberately not a lane multiple
+DB_ADVISORIES = 3000
+
+
+# ------------------------------------------------------------------ worker
+
+
+def _worker(process_id: int, coordinator: str) -> None:
+    import numpy as np
+
+    # jax may be pre-imported by a sitecustomize with a hardware
+    # platform pinned; env vars are too late for that, so force the
+    # virtual-CPU platform via config BEFORE any backend/distributed
+    # initialization (same dance as __graft_entry__.dryrun_multichip)
+    os.environ.setdefault("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in \
+            os.environ["XLA_FLAGS"]:
+        os.environ["XLA_FLAGS"] += \
+            f" --xla_force_host_platform_device_count={N_LOCAL_DEVICES}"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from trivy_tpu.ops import multihost
+
+    ok = multihost.bootstrap(coordinator, N_PROCESSES, process_id)
+    assert ok, "jax.distributed bootstrap did not come up"
+
+    import jax.numpy as jnp
+
+    assert jax.process_count() == N_PROCESSES
+    assert jax.local_device_count() == N_LOCAL_DEVICES
+
+    # hybrid mesh: "db" on the 4 local devices, "data" across the 2
+    # hosts — nothing but the query stream crosses DCN
+    mesh = multihost.crawl_mesh(n_db=N_LOCAL_DEVICES)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == \
+        {"data": N_PROCESSES, "db": N_LOCAL_DEVICES}
+
+    from trivy_tpu.ops.match import (
+        ShardedDB,
+        _sharded_match,
+        _sorted_padded,
+        _words,
+    )
+    from trivy_tpu.tensorize.compile import compile_db
+    from trivy_tpu.tensorize.synth import synth_queries, synth_trivy_db
+
+    # identical DB on both hosts (same seed), broadcast as shards
+    db = synth_trivy_db(n_advisories=DB_ADVISORIES)
+    cdb = compile_db(db)
+    sdb = multihost.sharded_db(cdb, mesh)
+
+    # every host sees the full query list but contributes only its own
+    # half to the global batch
+    all_queries = synth_queries(db, N_QUERIES_PER_HOST * N_PROCESSES)
+    lo = process_id * N_QUERIES_PER_HOST
+    mine = all_queries[lo:lo + N_QUERIES_PER_HOST]
+    batch = cdb.encode_packages(
+        [(q.space, q.name, q.version, q.scheme_name) for q in mine])
+
+    # per-host padding to a common local bucket, then globalize
+    from trivy_tpu.ops.match import _bucket
+
+    local_bucket = _bucket(len(batch.h1))
+    order, h1, h2, rank, flags = _sorted_padded(batch, local_bucket)
+    globals_ = multihost.globalize_batch(mesh, {
+        "h1": h1, "h2": h2, "rank": rank, "flags": flags,
+    })
+
+    out = _sharded_match(
+        sdb.h1, sdb.table,
+        globals_["h1"], globals_["h2"], globals_["rank"],
+        globals_["flags"],
+        window=sdb.window, mesh=mesh,
+    )
+    out.block_until_ready()
+
+    # ---- per-host result gather: addressable shards of my data block
+    n_words = _words(sdb.window)
+    local_out = np.zeros((N_LOCAL_DEVICES, local_bucket, n_words),
+                         dtype=np.uint32)
+    row0 = process_id * local_bucket
+    for shard in out.addressable_shards:
+        d_sl, b_sl, w_sl = shard.index
+        b_start = b_sl.start or 0
+        local_out[d_sl, b_start - row0:(b_sl.stop or out.shape[1])
+                  - row0, w_sl] = np.asarray(shard.data)
+
+    # ---- reference: same half-batch on a host-local mesh (the path
+    # test_match.py proves oracle-identical)
+    from jax.sharding import Mesh
+
+    local_mesh = Mesh(
+        np.array(jax.local_devices()).reshape(1, N_LOCAL_DEVICES),
+        ("data", "db"))
+    local_sdb = ShardedDB.from_compiled(cdb, local_mesh)
+    ref = _sharded_match(
+        local_sdb.h1, local_sdb.table,
+        jnp.asarray(h1), jnp.asarray(h2), jnp.asarray(rank),
+        jnp.asarray(flags),
+        window=sdb.window, mesh=local_mesh,
+    )
+    ref_np = np.asarray(ref)
+    diff = int((local_out != ref_np).sum())
+
+    # ---- DCN collective: a jitted global reduction both hosts must
+    # agree on (the all-reduce rides the process boundary)
+    local_bits = int(np.unpackbits(
+        local_out.view(np.uint8)).sum())
+    global_bits = int(jax.jit(
+        lambda x: jnp.sum(jnp.asarray(
+            jax.lax.population_count(x.astype(jnp.uint32)),
+            jnp.int64)))(out))
+
+    print(json.dumps({
+        "process": process_id,
+        "mesh": {"data": N_PROCESSES, "db": N_LOCAL_DEVICES},
+        "db_rows": int(cdb.n_rows),
+        "queries": len(mine),
+        "diff_vs_local_mesh": diff,
+        "local_hit_bits": local_bits,
+        "global_hit_bits": global_bits,
+    }), flush=True)
+    assert diff == 0, f"process {process_id}: {diff} mismatched words"
+
+
+# ---------------------------------------------------------------- launcher
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run(out_path: str | None = None, timeout: int = 600) -> dict:
+    """Spawn both workers, verify, and (optionally) write the artifact.
+    Returns the combined result document."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env_base = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS":
+            f"--xla_force_host_platform_device_count={N_LOCAL_DEVICES}",
+        "JAX_ENABLE_X64": "1",
+    }
+    procs = []
+    for pid in range(N_PROCESSES):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "trivy_tpu.ops.dcn_dryrun",
+             "--worker", str(pid), coordinator],
+            env=env_base, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        ))
+    results, errs = [], []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            errs.append("timeout")
+        for line in out.splitlines():
+            if line.startswith("{"):
+                results.append(json.loads(line))
+        if p.returncode != 0:
+            errs.append(err[-2000:])
+    doc = {
+        "n_processes": N_PROCESSES,
+        "n_local_devices": N_LOCAL_DEVICES,
+        "workers": results,
+        "ok": not errs and len(results) == N_PROCESSES,
+        "errors": errs,
+    }
+    if doc["ok"]:
+        g = {r["global_hit_bits"] for r in results}
+        local_sum = sum(r["local_hit_bits"] for r in results)
+        doc["ok"] = (
+            len(g) == 1
+            and g == {local_sum}
+            and all(r["diff_vs_local_mesh"] == 0 for r in results)
+            and local_sum > 0
+        )
+        if not doc["ok"]:
+            doc["errors"].append(
+                f"cross-host mismatch: global={sorted(g)} "
+                f"local_sum={local_sum}")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+    return doc
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) >= 3 and argv[0] == "--worker":
+        _worker(int(argv[1]), argv[2])
+        return 0
+    out = "MULTICHIP_DCN.json"
+    if len(argv) >= 2 and argv[0] == "--out":
+        out = argv[1]
+    doc = run(out_path=out)
+    print(json.dumps(doc, indent=2))
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -257,9 +257,12 @@ class ShardedDB:
     shard_len: int
     shard_base: int  # global row stride between shard starts
 
-    @classmethod
-    def from_compiled(cls, cdb: CompiledDB, mesh: Mesh) -> "ShardedDB":
-        n_db = mesh.shape["db"]
+    @staticmethod
+    def host_shards(cdb: CompiledDB, n_db: int):
+        """Halo-padded per-shard host arrays: (h1s [D,S], tables
+        [D,S,L], shard_len, shard_base). Shared by the single-process
+        device_put path and the multi-process DCN placement
+        (ops/multihost.put_sharded)."""
         w = cdb.window
         n = cdb.n_rows
         base = -(-max(n, 1) // n_db)
@@ -285,11 +288,24 @@ class ShardedDB:
                         shard(cdb.row_flags, 0)[d])
             for d in range(n_db)
         ])
+        return h1s, tables, shard_len, base
+
+    @classmethod
+    def from_compiled(cls, cdb: CompiledDB, mesh: Mesh,
+                      put=None) -> "ShardedDB":
+        """`put(arr, mesh, spec)` overrides placement — the DCN path
+        passes ops/multihost.put_sharded; default is plain device_put
+        (single-process)."""
+        if put is None:
+            def put(arr, mesh_, spec):
+                return jax.device_put(arr, NamedSharding(mesh_, spec))
+        n_db = mesh.shape["db"]
+        h1s, tables, shard_len, base = cls.host_shards(cdb, n_db)
         return cls(
-            h1=jax.device_put(h1s, NamedSharding(mesh, P("db", None))),
-            table=jax.device_put(
-                tables, NamedSharding(mesh, P("db", None, None))),
-            mesh=mesh, window=w, shard_len=shard_len, shard_base=base,
+            h1=put(h1s, mesh, P("db", None)),
+            table=put(tables, mesh, P("db", None, None)),
+            mesh=mesh, window=cdb.window, shard_len=shard_len,
+            shard_base=base,
         )
 
 

@@ -118,11 +118,47 @@ def crawl_mesh(n_db: int | None = None, devices=None):
         return Mesh(devs.reshape(data_local, n_db), ("data", "db"))
     from jax.experimental import mesh_utils
 
-    devices = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(data_local, n_db),
-        dcn_mesh_shape=(n_proc, 1),  # data spans hosts, db stays local
-    )
+    try:
+        devices = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(data_local, n_db),
+            dcn_mesh_shape=(n_proc, 1),  # data spans hosts, db local
+        )
+    except ValueError:
+        # no slice topology (e.g. multi-process CPU in the DCN dryrun):
+        # lay the mesh out by hand with the same property — each host's
+        # devices form whole rows, so "db" never crosses DCN
+        per_proc: dict[int, list] = {}
+        for d in sorted(jax.devices(), key=lambda d: (d.process_index,
+                                                      d.id)):
+            per_proc.setdefault(d.process_index, []).append(d)
+        rows = [np.array(ds).reshape(data_local, n_db)
+                for _p, ds in sorted(per_proc.items())]
+        devices = np.concatenate(rows, axis=0)
     return Mesh(devices, ("data", "db"))
+
+
+def put_sharded(arr: np.ndarray, mesh, spec):
+    """Place a host-identical numpy array onto the mesh with `spec`.
+    Works across processes (DCN): every host holds the full array and
+    each contributes only the shards it is addressable for
+    (make_array_from_callback) — the multi-host form of the DB shard
+    broadcast. Single-process this is equivalent to device_put."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    s = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, s)
+    return jax.make_array_from_callback(
+        arr.shape, s, lambda idx: arr[idx])
+
+
+def sharded_db(cdb, mesh):
+    """ShardedDB placed DCN-aware: shards over "db" (local/ICI),
+    replicated over "data" (across hosts)."""
+    from trivy_tpu.ops.match import ShardedDB
+
+    return ShardedDB.from_compiled(cdb, mesh, put=put_sharded)
 
 
 def globalize_batch(mesh, arrays: dict):
