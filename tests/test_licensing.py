@@ -273,3 +273,58 @@ class TestNgramClassifier:
             b"this software within Corp premises only."))
         assert lf is not None
         assert any(f.name == "Corp-1.0" for f in lf.findings)
+
+
+class TestFullTextCorpus:
+    """--license-full against real license bodies (VERDICT r4 directive
+    10b: the embedded SPDX corpus, licensing/corpus.py, must classify
+    actual LICENSE files, not just tagged excerpts)."""
+
+    def test_every_corpus_text_self_classifies(self):
+        from trivy_tpu.licensing.classifier import classify
+        from trivy_tpu.licensing.corpus import TEXTS
+
+        assert len(TEXTS) >= 12
+        for name, text in TEXTS.items():
+            lf = classify("LICENSE", text)
+            assert lf is not None, name
+            assert lf.findings[0].name == name, (
+                name, [(f.name, f.confidence) for f in lf.findings])
+            assert lf.findings[0].confidence >= 0.99
+
+    def test_reflowed_text_with_copyright_header(self):
+        """Real LICENSE files differ from the template by reflowed
+        lines and project-specific copyright headers; the trigram
+        matcher must tolerate both."""
+        import re
+
+        from trivy_tpu.licensing.classifier import classify
+        from trivy_tpu.licensing.corpus import TEXTS
+
+        body = TEXTS["MIT"]
+        reflowed = "Copyright (c) 2023 Example Industries, Inc.\n\n" + \
+            re.sub(r"\s+", " ", body)
+        lf = classify("LICENSE.txt", reflowed.encode())
+        assert lf is not None
+        assert lf.findings[0].name == "MIT"
+
+    def test_gnu_family_not_cross_reported(self):
+        """A GPL-3.0 body mentions its siblings (LGPL/AGPL sections);
+        only the actual license may be reported."""
+        from trivy_tpu.licensing.classifier import classify
+        from trivy_tpu.licensing.corpus import TEXTS
+
+        gnu = {"GPL-2.0", "GPL-3.0", "LGPL-2.1", "LGPL-3.0",
+               "AGPL-3.0"}
+        for name in ("GPL-2.0", "GPL-3.0", "LGPL-2.1", "LGPL-3.0"):
+            lf = classify("COPYING", TEXTS[name])
+            got = {f.name for f in lf.findings} & gnu
+            assert got == {name}, (name, got)
+
+    def test_unrelated_text_not_classified(self):
+        from trivy_tpu.licensing.classifier import classify
+
+        assert classify("README.md",
+                        b"This project does things. Install with "
+                        b"pip. MIT-ish vibes but no license text.") \
+            is None

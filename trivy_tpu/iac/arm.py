@@ -350,6 +350,17 @@ def _subscription():
     }
 
 
+def _coalesce(*xs):
+    """Left-to-right: first definite non-null wins; UNRESOLVED only
+    when an unknown is hit before any definite value."""
+    for x in xs:
+        if x is UNRESOLVED:
+            return UNRESOLVED
+        if x is not None:
+            return x
+    return None
+
+
 def _int2(f):
     def g(*args):
         nums = []
@@ -457,9 +468,7 @@ _FUNCS: dict = {
                        else False),
     "if": lambda c, t, f: (UNRESOLVED if c is UNRESOLVED else
                            t if c is True else f),
-    "coalesce": lambda *xs: (
-        UNRESOLVED if any(x is UNRESOLVED for x in xs)
-        else next((x for x in xs if x is not None), None)),
+    "coalesce": _coalesce,
     "add": _int2(lambda a, b: a + b),
     "sub": _int2(lambda a, b: a - b),
     "mul": _int2(lambda a, b: a * b),

@@ -131,9 +131,11 @@ _FINGERPRINTS: dict[str, list[str]] = {
         "this product includes software developed by the openssl project",
     ],
     "Artistic-2.0": [
+        # NB: the "everyone is permitted to copy and distribute verbatim
+        # copies" sentence is shared with every GNU license preamble and
+        # must not be used as a fingerprint
         "the artistic license 2 0",
-        "everyone is permitted to copy and distribute verbatim copies of "
-        "this license document but changing it is not allowed",
+        "aggregating or linking the package",
     ],
     "OFL-1.1": [
         "sil open font license version 1 1",
@@ -162,24 +164,49 @@ def _ngrams(text: str) -> set[tuple[str, ...]]:
             for i in range(len(words) - _NGRAM + 1)}
 
 
-_GRAM_SETS: dict[str, set] = {}
+_GRAM_SETS: dict[str, list[set]] = {}
+
+# extra whole-text variants per license (the embedded SPDX corpus and
+# any user-supplied bodies); each variant matches independently so a
+# short distinctive excerpt and a full license body never dilute each
+# other's confidence denominator
+_EXTRA_VARIANTS: dict[str, list[str]] = {}
+_corpus_loaded = False
 
 
-def _gram_set(name: str) -> set:
-    """Compiled word-trigram set of a license's excerpt corpus."""
+def _load_corpus() -> None:
+    global _corpus_loaded
+    if _corpus_loaded:
+        return
+    _corpus_loaded = True
+    from trivy_tpu.licensing.corpus import TEXTS
+
+    for name, text in TEXTS.items():
+        _EXTRA_VARIANTS.setdefault(name, []).append(
+            _normalize_text(text))
+        _GRAM_SETS.pop(name, None)
+
+
+def _gram_sets(name: str):
+    """Compiled word-trigram variants: (excerpt union | None,
+    [whole-text gram sets]). Confidence is the max over variants, with
+    whole-text matches tracked separately (they outrank excerpt hits in
+    the family disambiguation below)."""
     grams = _GRAM_SETS.get(name)
     if grams is None:
-        grams = set()
+        excerpt = set()
         for phrase in _FINGERPRINTS.get(name, ()):
-            grams |= _ngrams(phrase)
+            excerpt |= _ngrams(phrase)
+        grams = (excerpt or None,
+                 [_ngrams(t) for t in _EXTRA_VARIANTS.get(name, ())])
         _GRAM_SETS[name] = grams
     return grams
 
 
 def add_license_text(name: str, text: str) -> None:
     """Extend the matcher with a license body (user corpus)."""
-    _FINGERPRINTS.setdefault(name, []).append(
-        _NORM_RE.sub(" ", text.lower()).strip())
+    _EXTRA_VARIANTS.setdefault(name, []).append(_normalize_text(text))
+    _FINGERPRINTS.setdefault(name, [])
     _GRAM_SETS.pop(name, None)
 
 
@@ -216,19 +243,44 @@ def classify(file_path: str, content: bytes | str,
         match_type = TYPE_HEADER
 
     norm = _normalize_text(raw)
+    full_conf: dict[str, float] = {}
     if norm:
+        _load_corpus()
         doc_grams = _ngrams(norm)
-        for name in _FINGERPRINTS:
+        for name in set(_FINGERPRINTS) | set(_EXTRA_VARIANTS):
             if name in seen:
                 continue
-            grams = _gram_set(name)
-            if not grams:
-                continue
-            conf = len(grams & doc_grams) / len(grams)
+            excerpt, fulls = _gram_sets(name)
+            conf_ex = (len(excerpt & doc_grams) / len(excerpt)
+                       if excerpt else 0.0)
+            conf_full = max((len(g & doc_grams) / len(g)
+                             for g in fulls if g), default=0.0)
+            conf = max(conf_ex, conf_full)
             if conf >= confidence_level:
                 seen.add(name)
+                full_conf[name] = conf_full
                 findings.append(_finding(name, round(conf, 2)))
                 match_type = TYPE_FILE
+
+    # the GNU family shares preamble/boilerplate: a near-exact match of
+    # one member (full-text variant >= 0.95) outranks partial matches of
+    # its siblings
+    gnu = {"GPL-2.0", "GPL-3.0", "LGPL-2.1", "LGPL-3.0", "AGPL-3.0"}
+    fam = [f for f in findings if f.name in gnu]
+    if len(fam) > 1:
+        best_full = max(full_conf.get(f.name, 0.0) for f in fam)
+        if best_full >= 0.95:
+            # a near-exact whole-text match outranks siblings that only
+            # hit shared preamble/excerpt phrases
+            for f in fam:
+                if full_conf.get(f.name, 0.0) < best_full:
+                    findings.remove(f)
+        else:
+            best = max(f.confidence for f in fam)
+            if best >= 0.95:
+                for f in fam:
+                    if f.confidence < best:
+                        findings.remove(f)
 
     # BSD-2 fingerprint is a subset of BSD-3; prefer the more specific hit
     names = {f.name for f in findings}

@@ -194,7 +194,10 @@ def run(out_path: str | None = None, timeout: int = 600) -> dict:
             errs.append("timeout")
         for line in out.splitlines():
             if line.startswith("{"):
-                results.append(json.loads(line))
+                try:
+                    results.append(json.loads(line))
+                except json.JSONDecodeError:
+                    errs.append(f"unparseable worker line: {line[:200]}")
         if p.returncode != 0:
             errs.append(err[-2000:])
     doc = {
