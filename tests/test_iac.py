@@ -62,7 +62,9 @@ def test_dockerfile_checks():
     m = scan_config("Dockerfile", DOCKERFILE.encode())
     assert m is not None and m.file_type == "dockerfile"
     failed = {f.id for f in m.failures}
-    assert {"DS001", "DS002", "DS004", "DS005", "DS010", "DS016",
+    # multiple ENTRYPOINT is DS007 (DS016 covers multiple CMD, the
+    # upstream split)
+    assert {"DS001", "DS002", "DS004", "DS005", "DS007", "DS010",
             "DS017", "DS025"} <= failed
     passed = {s.id for s in m.successes}
     assert "DS024" in passed  # no dist-upgrade used

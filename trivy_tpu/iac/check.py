@@ -42,6 +42,11 @@ _REGISTRY: dict[str, Check] = {}
 
 
 def register(check: Check) -> Check:
+    prev = _REGISTRY.get(check.id)
+    if prev is not None and prev.fn is not check.fn:
+        raise ValueError(
+            f"duplicate check id {check.id!r}: already registered "
+            f"as {prev.title!r}")
     _REGISTRY[check.id] = check
     return check
 
@@ -71,10 +76,13 @@ def _load_builtins():
         if _loaded:
             return
         from trivy_tpu.iac.checks import (  # noqa: F401
+            aws_ext,
             azure,
+            azure_ext,
             cloud,
             docker,
             gcp,
+            gcp_ext,
             kubernetes,
             providers_misc,
         )

@@ -7,7 +7,9 @@ from __future__ import annotations
 from trivy_tpu.iac.check import check
 from trivy_tpu.iac.checks.cloud import CloudResource
 
-_ARM = ("azure-arm",)
+# azurerm terraform blocks adapt into the same resource types
+# (azure_ext.adapt_terraform_azure), so these checks cover both inputs
+_ARM = ("azure-arm", "terraform", "terraformplan")
 
 
 def adapt_arm(doc: dict) -> list[CloudResource]:
@@ -160,6 +162,6 @@ def vm_password_auth(ctx):
 def kv_purge_protection(ctx):
     out = []
     for r in _of_type(ctx, "key_vault"):
-        if not r.attrs.get("purge_protection"):
+        if r.attrs.get("purge_protection") is False:
             out.append(r.cause("Key vault purge protection not enabled"))
     return out

@@ -55,10 +55,16 @@ def _tf_tristate(b: Block, name: str, absent_default):
 
 def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
     out: list[CloudResource] = []
+    from trivy_tpu.iac.checks.aws_ext import adapt_terraform_aws_ext
+    from trivy_tpu.iac.checks.azure_ext import adapt_terraform_azure
     from trivy_tpu.iac.checks.gcp import adapt_terraform_gcp
+    from trivy_tpu.iac.checks.gcp_ext import adapt_terraform_gcp_ext
     from trivy_tpu.iac.checks.providers_misc import adapt_terraform_misc
 
+    out.extend(adapt_terraform_aws_ext(blocks))
+    out.extend(adapt_terraform_azure(blocks))
     out.extend(adapt_terraform_gcp(blocks))
+    out.extend(adapt_terraform_gcp_ext(blocks))
     out.extend(adapt_terraform_misc(blocks))
     res_blocks = [b for b in blocks if b.type == "resource" and
                   len(b.labels) >= 2]
@@ -66,6 +72,7 @@ def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
     # settings to buckets declared separately (tf >= 4 style)
     sse_for: set[str] = set()
     pab_true_for: set[str] = set()
+    pab_flags_for: dict[str, dict] = {}
     for b in res_blocks:
         t = b.labels[0]
         if t == "aws_s3_bucket_server_side_encryption_configuration":
@@ -76,13 +83,16 @@ def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
             elif isinstance(ref, str):
                 sse_for.add(ref)
         if t == "aws_s3_bucket_public_access_block":
-            vals = [_tf_value(b.get(k)) for k in (
+            # absent flag -> the provider default false (a definite
+            # failing value); present-but-unresolved -> None = unknown
+            flags = {k: _tf_tristate(b, k, False) for k in (
                 "block_public_acls", "block_public_policy",
-                "ignore_public_acls", "restrict_public_buckets")]
-            if all(v is True for v in vals):
-                ref = b.get("bucket")
-                key = (ref.text.split(".")[-2] if isinstance(ref, Expr)
-                       and "." in ref.text else str(ref))
+                "ignore_public_acls", "restrict_public_buckets")}
+            ref = b.get("bucket")
+            key = (ref.text.split(".")[-2] if isinstance(ref, Expr)
+                   and "." in ref.text else str(ref))
+            pab_flags_for[key] = flags
+            if all(v is True for v in flags.values()):
                 pab_true_for.add(key)
 
     for b in res_blocks:
@@ -100,6 +110,9 @@ def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
                       else False),
                 "public_access_block": name in pab_true_for
                 or str(_tf_value(b.get("bucket"))) in pab_true_for,
+                "pab_flags": pab_flags_for.get(
+                    name, pab_flags_for.get(
+                        str(_tf_value(b.get("bucket"))))),
                 "logging": b.child("logging") is not None,
                 "versioning": _bool_attr(b.child("versioning"), "enabled"),
             }
@@ -277,7 +290,10 @@ def _cfn_tristate(props: dict, key: str, default):
 
 
 def adapt_cloudformation(resources: dict[str, dict]) -> list[CloudResource]:
+    from trivy_tpu.iac.checks.aws_ext import adapt_cloudformation_aws_ext
+
     out: list[CloudResource] = []
+    out.extend(adapt_cloudformation_aws_ext(resources))
     for name, res in resources.items():
         rtype = str(res.get("Type", ""))
         props = res.get("Properties") or {}
@@ -289,12 +305,23 @@ def adapt_cloudformation(resources: dict[str, dict]) -> list[CloudResource]:
             pab_vals = [cfn_scalar(pab.get(k)) for k in (
                 "BlockPublicAcls", "BlockPublicPolicy",
                 "IgnorePublicAcls", "RestrictPublicBuckets")]
+            pab_flags = {
+                snake: cfn_scalar(pab.get(camel)) in (True, "true",
+                                                      "True")
+                for snake, camel in (
+                    ("block_public_acls", "BlockPublicAcls"),
+                    ("block_public_policy", "BlockPublicPolicy"),
+                    ("ignore_public_acls", "IgnorePublicAcls"),
+                    ("restrict_public_buckets",
+                     "RestrictPublicBuckets"))
+            } if pab else None
             cr.attrs = {
                 "acl": cfn_scalar(props.get("AccessControl")),
                 "encrypted": bool(props.get("BucketEncryption")),
                 "public_access_block": all(
                     v in (True, "true", "True") for v in pab_vals
                 ) and bool(pab),
+                "pab_flags": pab_flags,
                 "logging": bool(props.get("LoggingConfiguration")),
                 "versioning": cfn_scalar(
                     (props.get("VersioningConfiguration") or {})
@@ -433,6 +460,50 @@ def s3_public_access(ctx):
         if not r.attrs.get("public_access_block"):
             out.append(r.cause(
                 "No public access block so not blocking public acls"))
+    return out
+
+
+def _s3_pab_flag_check(flag: str, label: str):
+    def fn(ctx):
+        out = []
+        for r in _of_type(ctx, "s3_bucket"):
+            flags = r.attrs.get("pab_flags")
+            if flags is None:       # no PAB at all -> 0094's finding
+                continue
+            v = flags.get(flag)
+            if v is False:
+                out.append(r.cause(
+                    f"Public access block does not {label}"))
+        return out
+    return fn
+
+
+check("AVD-AWS-0087", "S3 bucket does not block public policies",
+      severity="HIGH", file_types=_C, provider="aws", service="s3",
+      resolution="Set block_public_policy = true")(
+    _s3_pab_flag_check("block_public_policy",
+                       "block public bucket policies"))
+check("AVD-AWS-0091", "S3 bucket does not ignore public ACLs",
+      severity="HIGH", file_types=_C, provider="aws", service="s3",
+      resolution="Set ignore_public_acls = true")(
+    _s3_pab_flag_check("ignore_public_acls", "ignore public ACLs"))
+check("AVD-AWS-0093", "S3 bucket does not restrict public buckets",
+      severity="HIGH", file_types=_C, provider="aws", service="s3",
+      resolution="Set restrict_public_buckets = true")(
+    _s3_pab_flag_check("restrict_public_buckets",
+                       "restrict public bucket policies"))
+
+
+@check("AVD-AWS-0094", "S3 bucket has no public access block",
+       severity="LOW", file_types=_C, provider="aws", service="s3",
+       resolution="Define an aws_s3_bucket_public_access_block")
+def s3_no_pab(ctx):
+    out = []
+    for r in _of_type(ctx, "s3_bucket"):
+        if r.attrs.get("pab_flags") is None \
+                and not r.attrs.get("public_access_block"):
+            out.append(r.cause(
+                "Bucket does not have a public access block"))
     return out
 
 

@@ -147,17 +147,17 @@ def run_cd(ctx):
     return out
 
 
-@check("DS016", "Multiple ENTRYPOINT instructions", severity="CRITICAL",
+@check("DS016", "Multiple CMD instructions", severity="CRITICAL",
        file_types=_D, avd_id="AVD-DS-0016", provider="dockerfile",
        service="general",
-       resolution="Keep one ENTRYPOINT per stage")
-def multiple_entrypoints(ctx):
+       resolution="Keep one CMD per stage")
+def multiple_cmds(ctx):
     out = []
     for stage in ctx.dockerfile.stages:
-        eps = ctx.dockerfile.by_cmd("ENTRYPOINT", stage)
-        for extra in eps[:-1]:
+        cmds = ctx.dockerfile.by_cmd("CMD", stage)
+        for extra in cmds[:-1]:
             out.append(_cause(
-                extra, "There are multiple ENTRYPOINT instructions; only "
+                extra, "There are multiple CMD instructions; only "
                        "the last one takes effect", stage))
     return out
 
@@ -256,3 +256,191 @@ def apt_no_recommends(ctx):
                 instr, f"'--no-install-recommends' flag is missed: "
                        f"'{cmd}'"))
     return out
+
+
+# --------------------------------------------- breadth wave (r5): the
+# remaining published DS rules (reference trivy-checks checks/docker)
+
+
+@check("DS006", "COPY --from references its own FROM alias",
+       severity="CRITICAL", file_types=_D, avd_id="AVD-DS-0006",
+       provider="dockerfile", service="general",
+       resolution="Reference a previous stage in COPY --from")
+def copy_from_own_alias(ctx):
+    out = []
+    for stage in ctx.dockerfile.stages:
+        for instr in ctx.dockerfile.by_cmd("COPY", stage):
+            for flag in instr.flags:
+                if flag.startswith("--from=") and \
+                        flag[7:] == stage.name:
+                    out.append(_cause(
+                        instr, f"COPY '--from' references the current "
+                               f"stage '{stage.name}'", stage))
+    return out
+
+
+@check("DS007", "Multiple ENTRYPOINT instructions in a stage",
+       severity="CRITICAL", file_types=_D, avd_id="AVD-DS-0007",
+       provider="dockerfile", service="general",
+       resolution="Keep only one ENTRYPOINT per stage")
+def multiple_entrypoints_ds007(ctx):
+    out = []
+    for stage in ctx.dockerfile.stages:
+        eps = ctx.dockerfile.by_cmd("ENTRYPOINT", stage)
+        if len(eps) > 1:
+            out.append(_cause(
+                eps[-1], f"There are {len(eps)} duplicate ENTRYPOINT "
+                         f"instructions", stage))
+    return out
+
+
+@check("DS008", "Exposed port is out of range", severity="CRITICAL",
+       file_types=_D, avd_id="AVD-DS-0008", provider="dockerfile",
+       service="general", resolution="Use ports between 0 and 65535")
+def port_out_of_range(ctx):
+    out = []
+    for stage in ctx.dockerfile.stages:
+        for instr in ctx.dockerfile.by_cmd("EXPOSE", stage):
+            for port in instr.value.split():
+                num = port.split("/")[0]
+                if num.isdigit() and not 0 <= int(num) <= 65535:
+                    out.append(_cause(
+                        instr, f"'EXPOSE' port {num} is out of range",
+                        stage))
+    return out
+
+
+@check("DS009", "WORKDIR path is relative", severity="HIGH",
+       file_types=_D, avd_id="AVD-DS-0009", provider="dockerfile",
+       service="general", resolution="Use absolute WORKDIR paths")
+def workdir_relative(ctx):
+    out = []
+    for stage in ctx.dockerfile.stages:
+        for instr in ctx.dockerfile.by_cmd("WORKDIR", stage):
+            path = instr.value.strip().strip('"').strip("'")
+            if path and not path.startswith(("/", "$", "%")) \
+                    and ":" not in path[:3]:    # windows C:\ paths
+                out.append(_cause(
+                    instr, f"WORKDIR path '{path}' should be absolute",
+                    stage))
+    return out
+
+
+@check("DS011", "COPY with multiple sources needs a directory "
+       "destination", severity="CRITICAL", file_types=_D,
+       avd_id="AVD-DS-0011", provider="dockerfile", service="general",
+       resolution="End the destination with / when copying multiple "
+                  "sources")
+def copy_multiple_sources(ctx):
+    out = []
+    for stage in ctx.dockerfile.stages:
+        for instr in ctx.dockerfile.by_cmd("COPY", stage):
+            arr = instr.json_array()
+            parts = arr if arr is not None else instr.value.split()
+            parts = [p for p in parts
+                     if not p.startswith("--")]   # strip flags
+            if len(parts) > 2 and not parts[-1].endswith("/") \
+                    and not parts[-1] in (".", "./"):
+                out.append(_cause(
+                    instr, f"When copying multiple sources the "
+                           f"destination '{parts[-1]}' must be a "
+                           f"directory (end with /)", stage))
+    return out
+
+
+@check("DS014", "RUN uses both wget and curl", severity="LOW",
+       file_types=_D, avd_id="AVD-DS-0014", provider="dockerfile",
+       service="general",
+       resolution="Standardize on either wget or curl")
+def wget_and_curl(ctx):
+    out = []
+    for stage in ctx.dockerfile.stages:
+        tools = set()
+        first = None
+        for instr, cmd in _run_commands(ctx.dockerfile, stage):
+            tok = cmd.split()[:1]
+            if tok and tok[0] in ("wget", "curl"):
+                tools.add(tok[0])
+                first = first or instr
+        if {"wget", "curl"} <= tools and first is not None:
+            out.append(_cause(
+                first, "Both wget and curl are used — pick one",
+                stage))
+    return out
+
+
+@check("DS015", "yum install without 'yum clean all'", severity="HIGH",
+       file_types=_D, avd_id="AVD-DS-0015", provider="dockerfile",
+       service="general",
+       resolution="Add 'yum clean all' after yum install")
+def yum_clean_missing(ctx):
+    out = []
+    for stage in ctx.dockerfile.stages:
+        for instr in ctx.dockerfile.by_cmd("RUN", stage):
+            text = instr.value
+            if re.search(r"\byum\b[^|;&]*\binstall\b", text) and \
+                    "clean all" not in text:
+                out.append(_cause(
+                    instr, "'yum install' without a following "
+                           "'yum clean all'", stage))
+    return out
+
+
+@check("DS019", "zypper install without 'zypper clean'",
+       severity="HIGH", file_types=_D, avd_id="AVD-DS-0019",
+       provider="dockerfile", service="general",
+       resolution="Add 'zypper clean' after zypper use")
+def zypper_clean_missing(ctx):
+    out = []
+    for stage in ctx.dockerfile.stages:
+        for instr in ctx.dockerfile.by_cmd("RUN", stage):
+            text = instr.value
+            if re.search(r"\bzypper\b[^|;&]*\b(install|in|remove|rm|"
+                         r"source-install|si|patch)\b", text) and \
+                    not re.search(r"\bzypper\s+(clean|cc)\b", text):
+                out.append(_cause(
+                    instr, "'zypper' use without a following "
+                           "'zypper clean'", stage))
+    return out
+
+
+@check("DS020", "'zypper dist-upgrade' used", severity="HIGH",
+       file_types=_D, avd_id="AVD-DS-0020", provider="dockerfile",
+       service="general",
+       resolution="Do not run full distribution upgrades in images")
+def zypper_dist_upgrade(ctx):
+    out = []
+    for stage in ctx.dockerfile.stages:
+        for instr, cmd in _run_commands(ctx.dockerfile, stage):
+            if re.search(r"\bzypper\s+(dist-upgrade|dup)\b", cmd):
+                out.append(_cause(
+                    instr, "'zypper dist-upgrade' should not be used",
+                    stage))
+    return out
+
+
+@check("DS022", "Deprecated MAINTAINER used", severity="LOW",
+       file_types=_D, avd_id="AVD-DS-0022", provider="dockerfile",
+       service="general",
+       resolution="Use a LABEL maintainer= instead")
+def maintainer_deprecated(ctx):
+    out = []
+    for stage in ctx.dockerfile.stages:
+        for instr in ctx.dockerfile.by_cmd("MAINTAINER", stage):
+            out.append(_cause(
+                instr, "MAINTAINER is deprecated, use "
+                       "'LABEL maintainer=...'", stage))
+    return out
+
+
+@check("DS023", "Multiple HEALTHCHECK instructions", severity="MEDIUM",
+       file_types=_D, avd_id="AVD-DS-0023", provider="dockerfile",
+       service="general",
+       resolution="Keep a single HEALTHCHECK")
+def multiple_healthchecks(ctx):
+    hcs = ctx.dockerfile.by_cmd("HEALTHCHECK")
+    if len(hcs) > 1:
+        return [_cause(hcs[-1],
+                       f"There are {len(hcs)} HEALTHCHECK "
+                       f"instructions")]
+    return []

@@ -632,3 +632,221 @@ def privileged_port(ctx):
                 continue
             break
     return out
+
+
+# --------------------------------------------- breadth wave (r5): more
+# published KSV workload + RBAC rules (reference trivy-checks
+# checks/kubernetes/{workload,rbac})
+
+
+@check("KSV007", "hostAliases is set", severity="LOW", file_types=_K,
+       avd_id="AVD-KSV-0007", provider="kubernetes", service="general",
+       resolution="Do not set spec.hostAliases")
+def host_aliases(ctx):
+    spec = ctx.pod_spec or {}
+    if spec.get("hostAliases"):
+        return [Cause(message=f"{_name(ctx.resource)} should not set "
+                              f"spec.template.spec.hostAliases",
+                      resource=_name(ctx.resource),
+                      start_line=get_line(ctx.resource))]
+    return []
+
+
+@check("KSV022", "Non-default capabilities added", severity="MEDIUM",
+       file_types=_K, avd_id="AVD-KSV-0022", provider="kubernetes",
+       service="general",
+       resolution="Remove capabilities.add entries")
+def added_capabilities(ctx):
+    out = []
+    for c in ctx.containers:
+        add = (_sc(c).get("capabilities") or {}).get("add") or []
+        extra = [a for a in add
+                 if str(a).upper() not in ("NET_BIND_SERVICE",)]
+        if extra:
+            out.append(_container_cause(
+                ctx, c, f"Container '{c.get('name', '')}' adds "
+                        f"capabilities {sorted(map(str, extra))}"))
+    return out
+
+
+@check("KSV026", "Unsafe sysctls set", severity="MEDIUM",
+       file_types=_K, avd_id="AVD-KSV-0026", provider="kubernetes",
+       service="general",
+       resolution="Remove sysctls outside the safe set")
+def unsafe_sysctls(ctx):
+    safe = {"kernel.shm_rmid_forced", "net.ipv4.ip_local_port_range",
+            "net.ipv4.ip_unprivileged_port_start",
+            "net.ipv4.tcp_syncookies", "net.ipv4.ping_group_range"}
+    spec = ctx.pod_spec or {}
+    sysctls = (spec.get("securityContext") or {}).get("sysctls") or []
+    out = []
+    for s in sysctls:
+        nm = s.get("name") if isinstance(s, dict) else None
+        if nm and nm not in safe:
+            out.append(Cause(
+                message=f"{_name(ctx.resource)} sets unsafe sysctl "
+                        f"'{nm}'",
+                resource=_name(ctx.resource),
+                start_line=get_line(ctx.resource)))
+    return out
+
+
+@check("KSV027", "Non-default /proc mount", severity="MEDIUM",
+       file_types=_K, avd_id="AVD-KSV-0027", provider="kubernetes",
+       service="general", resolution="Remove procMount")
+def proc_mount(ctx):
+    out = []
+    for c in ctx.containers:
+        pm = _sc(c).get("procMount")
+        if pm and str(pm) != "Default":
+            out.append(_container_cause(
+                ctx, c, f"Container '{c.get('name', '')}' uses a "
+                        f"non-default procMount '{pm}'"))
+    return out
+
+
+@check("KSV028", "Non-ephemeral volume types used", severity="LOW",
+       file_types=_K, avd_id="AVD-KSV-0028", provider="kubernetes",
+       service="general",
+       resolution="Use only configMap/secret/emptyDir/projected/"
+                  "downwardAPI/csi/ephemeral/pvc volumes")
+def volume_types(ctx):
+    allowed = {"configMap", "secret", "emptyDir", "projected",
+               "downwardAPI", "csi", "ephemeral",
+               "persistentVolumeClaim", "name"}
+    spec = ctx.pod_spec or {}
+    out = []
+    for v in spec.get("volumes") or []:
+        if not isinstance(v, dict):
+            continue
+        bad = [k for k in v
+               if k not in allowed and not k.startswith("__")]
+        if bad:
+            out.append(Cause(
+                message=f"{_name(ctx.resource)} uses restricted volume "
+                        f"type(s) {sorted(bad)}",
+                resource=_name(ctx.resource),
+                start_line=get_line(v) or get_line(ctx.resource)))
+    return out
+
+
+@check("KSV102", "Helm Tiller is deployed", severity="CRITICAL",
+       file_types=_K, avd_id="AVD-KSV-0102", provider="kubernetes",
+       service="general", resolution="Migrate to Helm v3")
+def tiller_deployed(ctx):
+    out = []
+    for c in ctx.containers:
+        img = str(c.get("image", ""))
+        repo = img.split("/")[-1].split(":")[0].split("@")[0]
+        if repo == "tiller":
+            out.append(_container_cause(
+                ctx, c, f"Container '{c.get('name', '')}' runs the "
+                        f"Tiller image '{img}'"))
+    return out
+
+
+def _role_rules(ctx):
+    if ctx.resource.get("kind") not in ("Role", "ClusterRole"):
+        return []
+    return [r for r in ctx.resource.get("rules") or []
+            if isinstance(r, dict)]
+
+
+def _rbac_cause(ctx, msg):
+    return Cause(message=msg, resource=_name(ctx.resource),
+                 start_line=get_line(ctx.resource))
+
+
+@check("KSV041", "Role permits managing secrets", severity="CRITICAL",
+       file_types=_K, avd_id="AVD-KSV-0041", provider="kubernetes",
+       service="rbac", resolution="Remove secrets write verbs")
+def rbac_manage_secrets(ctx):
+    out = []
+    for r in _role_rules(ctx):
+        if "secrets" in (r.get("resources") or []) and \
+                any(v in (r.get("verbs") or [])
+                    for v in ("create", "update", "patch", "delete",
+                              "deletecollection", "impersonate", "*")):
+            out.append(_rbac_cause(
+                ctx, f"{_name(ctx.resource)} permits managing secrets"))
+    return out
+
+
+@check("KSV042", "Role permits deleting pod logs", severity="MEDIUM",
+       file_types=_K, avd_id="AVD-KSV-0042", provider="kubernetes",
+       service="rbac", resolution="Remove pods/log delete verbs")
+def rbac_delete_pod_logs(ctx):
+    out = []
+    for r in _role_rules(ctx):
+        if "pods/log" in (r.get("resources") or []) and \
+                any(v in (r.get("verbs") or [])
+                    for v in ("delete", "deletecollection", "*")):
+            out.append(_rbac_cause(
+                ctx,
+                f"{_name(ctx.resource)} permits deleting pod logs"))
+    return out
+
+
+@check("KSV045", "Role uses wildcard verbs", severity="CRITICAL",
+       file_types=_K, avd_id="AVD-KSV-0045", provider="kubernetes",
+       service="rbac", resolution="Enumerate the needed verbs")
+def rbac_wildcard_verbs(ctx):
+    out = []
+    for r in _role_rules(ctx):
+        if "*" in (r.get("verbs") or []) and \
+                (r.get("resources") or []) != ["*"]:
+            out.append(_rbac_cause(
+                ctx, f"{_name(ctx.resource)} uses a wildcard verb"))
+    return out
+
+
+@check("KSV046", "Role permits managing all resources",
+       severity="CRITICAL", file_types=_K, avd_id="AVD-KSV-0046",
+       provider="kubernetes", service="rbac",
+       resolution="Scope the role to specific resources")
+def rbac_all_resources(ctx):
+    out = []
+    for r in _role_rules(ctx):
+        if "*" in (r.get("resources") or []) and \
+                "*" in (r.get("verbs") or []):
+            out.append(_rbac_cause(
+                ctx, f"{_name(ctx.resource)} permits managing all "
+                     f"resources"))
+    return out
+
+
+@check("KSV049", "Role permits managing configmaps",
+       severity="MEDIUM", file_types=_K, avd_id="AVD-KSV-0049",
+       provider="kubernetes", service="rbac",
+       resolution="Limit configmap write access")
+def rbac_manage_configmaps(ctx):
+    out = []
+    for r in _role_rules(ctx):
+        if "configmaps" in (r.get("resources") or []) and \
+                any(v in (r.get("verbs") or [])
+                    for v in ("create", "update", "patch", "delete",
+                              "deletecollection", "*")):
+            out.append(_rbac_cause(
+                ctx,
+                f"{_name(ctx.resource)} permits managing configmaps"))
+    return out
+
+
+@check("KSV050", "Role permits managing RBAC resources",
+       severity="CRITICAL", file_types=_K, avd_id="AVD-KSV-0050",
+       provider="kubernetes", service="rbac",
+       resolution="Restrict RBAC management permissions")
+def rbac_manage_rbac(ctx):
+    rbac_resources = {"roles", "clusterroles", "rolebindings",
+                      "clusterrolebindings"}
+    out = []
+    for r in _role_rules(ctx):
+        if rbac_resources & set(r.get("resources") or []) and \
+                any(v in (r.get("verbs") or [])
+                    for v in ("create", "update", "patch", "delete",
+                              "deletecollection", "bind", "escalate",
+                              "*")):
+            out.append(_rbac_cause(
+                ctx, f"{_name(ctx.resource)} permits managing RBAC "
+                     f"resources"))
+    return out
