@@ -78,6 +78,15 @@ def _tf_apigw_stage(b):
     }
 
 
+def _tf_apigw_v2_stage(b):
+    access_log = b.child("access_log_settings")
+    return "apigateway_stage", {
+        "access_logging": access_log is not None,
+        "xray": None,       # X-Ray tracing is a REST (v1) stage knob
+        "cache_encrypted": None,
+    }
+
+
 def _tf_apigw_method_settings(b):
     s = b.child("settings")
     return "apigateway_method_settings", {
@@ -339,11 +348,23 @@ def _tf_es_domain(b):
 
 def _tf_lb(b):
     internal = _tri(b, "internal", False)
+    lb_type = _v(b.get("load_balancer_type")) or "application"
     return "lb", {
         "internal": internal,
+        # drop_invalid_header_fields only exists on ALBs; other LB
+        # kinds must stay silent on AVD-AWS-0052
         "drop_invalid_headers": _tri(
-            b, "drop_invalid_header_fields", False),
-        "lb_type": _v(b.get("load_balancer_type")) or "application",
+            b, "drop_invalid_header_fields", False)
+        if lb_type == "application" else None,
+        "lb_type": lb_type,
+    }
+
+
+def _tf_classic_elb(b):
+    return "lb", {
+        "internal": _tri(b, "internal", False),
+        "drop_invalid_headers": None,   # not a classic-ELB setting
+        "lb_type": "classic",
     }
 
 
@@ -520,7 +541,7 @@ def _tf_workspaces(b):
 
 _TF = {
     "aws_api_gateway_stage": _tf_apigw_stage,
-    "aws_apigatewayv2_stage": _tf_apigw_stage,
+    "aws_apigatewayv2_stage": _tf_apigw_v2_stage,
     "aws_api_gateway_method_settings": _tf_apigw_method_settings,
     "aws_api_gateway_domain_name": _tf_apigw_domain,
     "aws_athena_workgroup": _tf_athena_workgroup,
@@ -547,7 +568,7 @@ _TF = {
     "aws_opensearch_domain": _tf_es_domain,
     "aws_lb": _tf_lb,
     "aws_alb": _tf_lb,
-    "aws_elb": _tf_lb,
+    "aws_elb": _tf_classic_elb,
     "aws_lb_listener": _tf_lb_listener_ext,
     "aws_alb_listener": _tf_lb_listener_ext,
     "aws_emr_security_configuration": _tf_emr_security_config,
@@ -592,6 +613,14 @@ def _cfn_apigw_stage(p):
         "access_logging": bool(p.get("AccessLogSetting")
                                or p.get("AccessLogSettings")),
         "xray": _cfn_tri(p, "TracingEnabled", False),
+        "cache_encrypted": None,
+    }
+
+
+def _cfn_apigw_v2_stage(p):
+    return "apigateway_stage", {
+        "access_logging": bool(p.get("AccessLogSettings")),
+        "xray": None,       # not a v2 property
         "cache_encrypted": None,
     }
 
@@ -702,7 +731,8 @@ def _cfn_es(p):
 
 
 def _cfn_lb(p):
-    scheme = cfn_scalar(p.get("Scheme")) or "internal"
+    # CFN default Scheme for ELBv2 is internet-facing
+    scheme = cfn_scalar(p.get("Scheme")) or "internet-facing"
     attrs = {cfn_scalar(a.get("Key")): cfn_scalar(a.get("Value"))
              for a in p.get("LoadBalancerAttributes") or []
              if isinstance(a, dict)}
@@ -821,7 +851,7 @@ def _cfn_workspaces(p):
 
 _CFN = {
     "AWS::ApiGateway::Stage": _cfn_apigw_stage,
-    "AWS::ApiGatewayV2::Stage": _cfn_apigw_stage,
+    "AWS::ApiGatewayV2::Stage": _cfn_apigw_v2_stage,
     "AWS::CloudFront::Distribution": _cfn_cloudfront,
     "AWS::Logs::LogGroup": _cfn_cw_log_group,
     "AWS::CodeBuild::Project": _cfn_codebuild,

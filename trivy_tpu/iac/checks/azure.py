@@ -59,7 +59,10 @@ def adapt_arm(doc: dict) -> list[CloudResource]:
         elif rtype == "Microsoft.KeyVault/vaults":
             cr.type = "key_vault"
             cr.attrs = {
-                "purge_protection": props.get("enablePurgeProtection"),
+                # absent -> the Azure default (disabled), a definite
+                # failing value; ARM expressions resolve to None=unknown
+                "purge_protection": props.get("enablePurgeProtection",
+                                              False),
                 "soft_delete_days":
                     props.get("softDeleteRetentionInDays"),
             }

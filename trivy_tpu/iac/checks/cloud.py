@@ -40,17 +40,11 @@ class CloudResource:
 # ------------------------------------------------------------ terraform
 
 
-def _tf_value(v):
-    return None if isinstance(v, Expr) else v
-
-
-def _tf_tristate(b: Block, name: str, absent_default):
-    """Attribute absent -> the terraform default (a definite value);
-    present but unresolved (var./local. reference) -> None = unknown,
-    so checks stay silent instead of false-positive."""
-    if name not in b.attrs:
-        return absent_default
-    return _tf_value(b.attrs[name].value)
+# single source of truth for unresolved-value semantics: spec.py
+from trivy_tpu.iac.checks.spec import (  # noqa: E402
+    tf_value as _tf_value,
+    tri as _tf_tristate,
+)
 
 
 def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:

@@ -166,7 +166,7 @@ _TF = {
     "google_sql_database_instance": _tf_sql_ext,
 }
 
-_YEAR = 365 * 24 * 3600
+_MAX_ROTATION_S = 90 * 24 * 3600   # published AVD rule: 90 days
 
 SPECS = [
     ("AVD-GCP-0046", "BigQuery dataset is publicly accessible",
@@ -238,13 +238,13 @@ SPECS = [
          if a["role"] in ("roles/owner", "roles/editor",
                           "roles/viewer") else False),
      "Use fine-grained predefined or custom roles"),
-    ("AVD-GCP-0065", "KMS key is not rotated at least yearly", "HIGH",
+    ("AVD-GCP-0065", "KMS key is not rotated every 90 days", "HIGH",
      "gcp_kms_key", "kms",
      lambda a: None if a.get("rotation_seconds") is None else (
-         "Rotation period exceeds one year (or is unset)"
+         "Rotation period exceeds 90 days (or is unset)"
          if a["rotation_seconds"] == 0 or
-         a["rotation_seconds"] > _YEAR else False),
-     "Set rotation_period <= 1 year"),
+         a["rotation_seconds"] > _MAX_ROTATION_S else False),
+     "Set rotation_period <= 90 days (7776000s)"),
     ("AVD-GCP-0024", "Cloud SQL has no automated backups", "MEDIUM",
      "gcp_sql_ext", "sql",
      _fail_if("backups", (False,),
