@@ -344,3 +344,85 @@ class TestPluginIndexAndOCI:
         finally:
             srv.shutdown()
             srv.server_close()
+
+
+class TestModuleTrustManifest:
+    """ADR 0001: the default cache-dir location executes only modules
+    recorded in the operator trust store by `module install`; planted
+    or tampered files are skipped. The store lives OUTSIDE the module
+    dir so a cache-writing attacker cannot forge it."""
+
+    MOD = ("name = 'probe'\nversion = 1\n"
+           "def post_scan(results, options):\n    return results\n")
+
+    @pytest.fixture(autouse=True)
+    def _isolated_store(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TRIVY_TPU_TRUST_STORE",
+                           str(tmp_path / "trust" / "modules.trust"))
+
+    def test_manifest_in_module_dir_is_not_honored(self, tmp_path):
+        """A forged manifest written INTO the modules dir (the
+        attacker-writable surface) must not grant trust."""
+        import hashlib
+
+        mdir = tmp_path / "modules"
+        mdir.mkdir()
+        (mdir / "planted.py").write_text(self.MOD)
+        digest = hashlib.sha256(self.MOD.encode()).hexdigest()
+        (mdir / "TRUSTED").write_text(
+            f"{digest} {mdir / 'planted.py'}\n")
+        mgr = ModuleManager(str(mdir), require_manifest=True)
+        try:
+            assert mgr.load() == 0
+        finally:
+            mgr.unload()
+
+    def test_planted_module_is_not_loaded(self, tmp_path):
+        mdir = tmp_path / "modules"
+        mdir.mkdir()
+        (mdir / "planted.py").write_text(self.MOD)
+        mgr = ModuleManager(str(mdir), require_manifest=True)
+        try:
+            assert mgr.load() == 0
+        finally:
+            mgr.unload()
+
+    def test_installed_module_loads_until_tampered(self, tmp_path):
+        mdir = tmp_path / "modules"
+        mdir.mkdir()
+        (mdir / "good.py").write_text(self.MOD)
+        ModuleManager.record_trust(str(mdir), "good.py")
+        mgr = ModuleManager(str(mdir), require_manifest=True)
+        try:
+            assert mgr.load() == 1
+        finally:
+            mgr.unload()
+        # on-disk tamper after install -> hash mismatch -> skipped
+        (mdir / "good.py").write_text(self.MOD + "# changed\n")
+        mgr2 = ModuleManager(str(mdir), require_manifest=True)
+        try:
+            assert mgr2.load() == 0
+        finally:
+            mgr2.unload()
+
+    def test_revoke_trust(self, tmp_path):
+        mdir = tmp_path / "modules"
+        mdir.mkdir()
+        (mdir / "good.py").write_text(self.MOD)
+        ModuleManager.record_trust(str(mdir), "good.py")
+        ModuleManager.revoke_trust(str(mdir), "good.py")
+        mgr = ModuleManager(str(mdir), require_manifest=True)
+        try:
+            assert mgr.load() == 0
+        finally:
+            mgr.unload()
+
+    def test_explicit_dir_loads_without_manifest(self, tmp_path):
+        mdir = tmp_path / "dev-modules"
+        mdir.mkdir()
+        (mdir / "dev.py").write_text(self.MOD)
+        mgr = ModuleManager(str(mdir))     # explicit dir: intent
+        try:
+            assert mgr.load() == 1
+        finally:
+            mgr.unload()

@@ -128,9 +128,12 @@ def run_scan(args) -> int:
     if getattr(args, "trace", False):
         trace.enable(True)
         trace.reset()
+    explicit_dir = getattr(args, "module_dir", None)
     mod_mgr = ModuleManager(
-        getattr(args, "module_dir", None)
-        or os.path.join(args.cache_dir, "modules"))
+        explicit_dir or os.path.join(args.cache_dir, "modules"),
+        # the shared cache dir is not consent to execute: only
+        # manifest-trusted modules load from it (ADR 0001)
+        require_manifest=explicit_dir is None)
     mod_mgr.load()
 
     from trivy_tpu.iac import engine as check_engine
@@ -964,6 +967,9 @@ def run_module(args) -> int:
         os.makedirs(mod_dir, exist_ok=True)
         dest = os.path.join(mod_dir, os.path.basename(args.source))
         shutil.copyfile(args.source, dest)
+        from trivy_tpu.module.manager import ModuleManager
+
+        ModuleManager.record_trust(mod_dir, os.path.basename(dest))
         _log.info("installed module", path=dest)
         return 0
     if sub == "uninstall":
@@ -972,6 +978,9 @@ def run_module(args) -> int:
         if not os.path.exists(path):
             raise FatalError(f"module {args.name!r} is not installed")
         os.unlink(path)
+        from trivy_tpu.module.manager import ModuleManager
+
+        ModuleManager.revoke_trust(mod_dir, name)
         return 0
     if sub == "list":
         if os.path.isdir(mod_dir):

@@ -348,11 +348,12 @@ def _tf_es_domain(b):
 
 def _tf_lb(b):
     internal = _tri(b, "internal", False)
-    lb_type = _v(b.get("load_balancer_type")) or "application"
+    # absent -> provider default "application"; unresolved -> None
+    lb_type = _tri(b, "load_balancer_type", "application")
     return "lb", {
         "internal": internal,
-        # drop_invalid_header_fields only exists on ALBs; other LB
-        # kinds must stay silent on AVD-AWS-0052
+        # drop_invalid_header_fields only exists on ALBs; other (or
+        # unknown) LB kinds must stay silent on AVD-AWS-0052
         "drop_invalid_headers": _tri(
             b, "drop_invalid_header_fields", False)
         if lb_type == "application" else None,

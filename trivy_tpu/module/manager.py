@@ -23,8 +23,42 @@ the same two registries the reference wires modules into
 
 from __future__ import annotations
 
+import hashlib
 import importlib.util
 import os
+import types
+
+def trust_store_path() -> str:
+    """Operator-owned manifest location. Deliberately OUTSIDE the
+    cache/modules directory: the threat model is an attacker who can
+    write the shared cache, so a manifest living next to the modules
+    would be forgeable (docs/adr/0001-module-sandboxing.md). Override
+    with TRIVY_TPU_TRUST_STORE (tests, unusual homes)."""
+    env = os.environ.get("TRIVY_TPU_TRUST_STORE")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".config",
+                        "trivy-tpu", "modules.trust")
+
+
+def _read_manifest(path: str) -> dict[str, str]:
+    """module absolute path -> expected sha256. Lines are
+    '<sha256> <path>' where the path may contain spaces."""
+    out: dict[str, str] = {}
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip("\n").split(" ", 1)
+                if len(parts) == 2 and parts[0] and parts[1]:
+                    out[parts[1]] = parts[0]
+    return out
+
+
+def _write_manifest(path: str, entries: dict[str, str]) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        for name in sorted(entries):
+            f.write(f"{entries[name]} {name}\n")
 
 from trivy_tpu.fanal.analyzer import (
     AnalysisResult,
@@ -79,8 +113,15 @@ class ModuleManager:
     (the reference keeps one wazero runtime per scan — here the
     registries are process-global, so tests must unload)."""
 
-    def __init__(self, module_dir: str):
+    def __init__(self, module_dir: str, require_manifest: bool = False):
+        """require_manifest=True (the default cache-dir location) loads
+        only modules recorded with a matching sha256 in the TRUSTED
+        manifest written by `module install` — the cache directory is
+        writable by more than the operator, so presence there is not
+        consent to execute (docs/adr/0001-module-sandboxing.md). An
+        explicit --module-dir is operator intent and loads as-is."""
         self.module_dir = module_dir
+        self.require_manifest = require_manifest
         self.modules: list = []
         self._analyzers: list[_ModuleAnalyzer] = []
         self._hooks: list = []
@@ -88,12 +129,27 @@ class ModuleManager:
     def load(self) -> int:
         if not os.path.isdir(self.module_dir):
             return 0
+        trusted = _read_manifest(trust_store_path()) \
+            if self.require_manifest else None
         for fname in sorted(os.listdir(self.module_dir)):
             if not fname.endswith(".py") or fname.startswith("_"):
                 continue
             path = os.path.join(self.module_dir, fname)
             try:
-                mod = self._load_file(path)
+                # read ONCE: the same bytes are hashed and executed, so
+                # a file swapped mid-scan cannot pass the hash check
+                # with different code (no TOCTOU window)
+                with open(path, "rb") as f:
+                    source = f.read()
+                if trusted is not None:
+                    digest = hashlib.sha256(source).hexdigest()
+                    if trusted.get(os.path.abspath(path)) != digest:
+                        _log.warn(
+                            "skipping untrusted module (not recorded "
+                            "in the trust store; use `module install`)",
+                            path=path, store=trust_store_path())
+                        continue
+                mod = self._load_bytes(path, source)
             except Exception as e:
                 _log.warn("module load failed", path=path, err=str(e))
                 continue
@@ -111,6 +167,36 @@ class ModuleManager:
             _log.info("loaded module", name=mod.name,
                       version=getattr(mod, "version", 1))
         return len(self.modules)
+
+    @staticmethod
+    def record_trust(module_dir: str, filename: str) -> None:
+        """Record a module's sha256 in the operator trust store
+        (called by `module install`)."""
+        store = trust_store_path()
+        entries = _read_manifest(store)
+        path = os.path.abspath(os.path.join(module_dir, filename))
+        with open(path, "rb") as f:
+            entries[path] = hashlib.sha256(f.read()).hexdigest()
+        _write_manifest(store, entries)
+
+    @staticmethod
+    def revoke_trust(module_dir: str, filename: str) -> None:
+        store = trust_store_path()
+        entries = _read_manifest(store)
+        path = os.path.abspath(os.path.join(module_dir, filename))
+        if entries.pop(path, None) is not None:
+            _write_manifest(store, entries)
+
+    @staticmethod
+    def _load_bytes(path: str, source: bytes):
+        """Execute already-read module bytes (the ones that were
+        hashed) in a fresh module namespace."""
+        name = "trivy_tpu_module_" + \
+            os.path.splitext(os.path.basename(path))[0]
+        mod = types.ModuleType(name)
+        mod.__file__ = path
+        exec(compile(source, path, "exec"), mod.__dict__)
+        return mod
 
     @staticmethod
     def _load_file(path: str):
