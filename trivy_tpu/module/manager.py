@@ -198,14 +198,6 @@ class ModuleManager:
         exec(compile(source, path, "exec"), mod.__dict__)
         return mod
 
-    @staticmethod
-    def _load_file(path: str):
-        name = "trivy_tpu_module_" + \
-            os.path.splitext(os.path.basename(path))[0]
-        spec = importlib.util.spec_from_file_location(name, path)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        return mod
 
     @staticmethod
     def _wrap_post_scan(mod):
