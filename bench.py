@@ -261,6 +261,12 @@ def _run_supervised(device_status: str) -> int:
         rc=1 from an oracle diff) forwards its line and returncode."""
         env = {**os.environ, "TRIVY_TPU_BENCH_CHILD": "1",
                "TRIVY_TPU_BENCH_DEVICE_STATUS": status, **extra_env}
+        if extra_env.get("TRIVY_TPU_FORCE_CPU"):
+            # the sitecustomize registers the tunnel PJRT plugin whenever
+            # this var is set, and jax initializes every registered
+            # plugin even under JAX_PLATFORMS=cpu — a wedged tunnel
+            # would hang the CPU fallback child too
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
@@ -290,10 +296,14 @@ def _run_supervised(device_status: str) -> int:
     rc = attempt(first_env, device_status)
     if rc is not None:
         return rc
-    # the accelerator wedged mid-run: rerun on CPU so the driver still
-    # gets a (clearly-labelled) result line
-    rc = attempt({"JAX_PLATFORMS": "cpu", "TRIVY_TPU_FORCE_CPU": "1"},
-                 "wedged_mid_run")
+    rc = None
+    if not first_env.get("TRIVY_TPU_FORCE_CPU"):
+        # the accelerator wedged mid-run: rerun on CPU so the driver
+        # still gets a (clearly-labelled) result line. A first attempt
+        # that was ALREADY CPU-forced failed deterministically — an
+        # identical rerun would only double the wall time.
+        rc = attempt({"JAX_PLATFORMS": "cpu", "TRIVY_TPU_FORCE_CPU": "1"},
+                     "wedged_mid_run")
     if rc is None:
         # even the CPU rerun died: emit SOMETHING rather than nothing
         print(json.dumps({

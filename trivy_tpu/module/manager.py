@@ -24,7 +24,6 @@ the same two registries the reference wires modules into
 from __future__ import annotations
 
 import hashlib
-import importlib.util
 import os
 import types
 
@@ -197,7 +196,6 @@ class ModuleManager:
         mod.__file__ = path
         exec(compile(source, path, "exec"), mod.__dict__)
         return mod
-
 
     @staticmethod
     def _wrap_post_scan(mod):

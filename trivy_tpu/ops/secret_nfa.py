@@ -372,6 +372,107 @@ class AnchorBank:
         return len(self.overflow_rows)
 
 
+class ConvAnchorBank:
+    """MXU formulation of the anchor screen (no class budget, no
+    overflow rows): anchor evaluation as a K-tap one-dimensional
+    convolution over one-hot bytes.
+
+    Position i hits rule r iff every active tap j satisfies
+    byte[i+j] in class(r, j).  With U[i, c] the byte->class indicator
+    (multi-hot: a byte may sit in many classes) and taps[j, c, r] a
+    one-hot selector of class(r, j), the conv sum
+        S[i, r] = sum_j U[i + j, :] . taps[j, :, r]
+    counts satisfied taps, so S[i, r] == n_active[r] is EXACT AND
+    semantics: products are 0/1 (exact in bf16), sums accumulate in
+    f32 and never exceed K_ANCHOR.  Both contractions (one-hot ->
+    classes, classes -> rules) are matmuls, which is the whole point:
+    the reference scans bytes serially per rule on the CPU
+    (pkg/fanal/secret/scanner.go:377-463); here the screen is dense
+    linear algebra the systolic array was built for."""
+
+    def __init__(self, rows: list[list[np.ndarray]]):
+        self.n = len(rows)
+        self.rw = max(1, -(-self.n // 32))
+        self.overflow_rows: set[int] = set()  # conv taps have no budget
+        cls_ids: dict[bytes, int] = {}
+        masks: list[np.ndarray] = []
+        tap_cls = np.zeros((self.n, K_ANCHOR), dtype=np.int32)
+        tap_act = np.zeros((self.n, K_ANCHOR), dtype=bool)
+        for r, classes in enumerate(rows):
+            for j, m in enumerate(classes[:K_ANCHOR]):
+                key = np.packbits(m).tobytes()
+                if key not in cls_ids:
+                    cls_ids[key] = len(cls_ids)
+                    masks.append(m)
+                tap_cls[r, j] = cls_ids[key]
+                tap_act[r, j] = True
+        nc = len(cls_ids)
+        # pad contraction dims to the 128-lane register width
+        self.nc = -(-max(nc, 1) // 128) * 128
+        self.r_pad = -(-max(self.n, 1) // 128) * 128
+        self.classtab = np.zeros((256, self.nc), dtype=np.float32)
+        for i, m in enumerate(masks):
+            self.classtab[m, i] = 1.0
+        self.taps = np.zeros((K_ANCHOR, self.nc, self.r_pad),
+                             dtype=np.float32)
+        for r in range(self.n):
+            for j in range(K_ANCHOR):
+                if tap_act[r, j]:
+                    self.taps[j, tap_cls[r, j], r] = 1.0
+        self.n_active = np.full(self.r_pad, np.float32(1e9))  # pad: never
+        self.n_active[: self.n] = tap_act.sum(axis=1).astype(np.float32)
+
+    @property
+    def overflowed(self) -> int:
+        return 0
+
+
+CONV_TILE = 2048  # positions scored per scan step (bounds activations)
+
+
+@functools.lru_cache(maxsize=8)
+def _conv_anchor_kernel(nc: int, r_pad: int, rw: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def run(chunks, classtab, taps, n_active):
+        """chunks: uint8[C, CHUNK] -> uint32[C, rw] packed per-chunk
+        rule-hit bitmap (same contract as _anchor_kernel)."""
+        c = chunks.shape[0]
+        # widen + pad with the out-of-alphabet sentinel 256: its one-hot
+        # row is all-zero, so padded positions fail every class — the
+        # same semantics as _anchor_kernel's zero-padded predicate words
+        ext = jnp.pad(chunks.astype(jnp.int32), ((0, 0), (0, K_ANCHOR - 1)),
+                      constant_values=256)
+        alphabet = jnp.arange(256, dtype=jnp.int32)
+        ct = classtab.astype(jnp.bfloat16)
+        tp = taps.astype(jnp.bfloat16)
+
+        def tile(hit_acc, t):
+            sl = lax.dynamic_slice(
+                ext, (0, t * CONV_TILE), (c, CONV_TILE + K_ANCHOR - 1))
+            oh = (sl[..., None] == alphabet).astype(jnp.bfloat16)
+            u = jnp.einsum("cpb,bn->cpn", oh, ct,
+                           preferred_element_type=jnp.bfloat16)
+            s = jnp.zeros((c, CONV_TILE, r_pad), dtype=jnp.float32)
+            for j in range(K_ANCHOR):
+                s = s + jnp.einsum(
+                    "cpn,nr->cpr", u[:, j: j + CONV_TILE, :], tp[j],
+                    preferred_element_type=jnp.float32)
+            hit = (s >= n_active[None, None, :]).any(axis=1)  # [C, R]
+            return hit_acc | hit, None
+
+        init = jnp.zeros((c, r_pad), dtype=bool)
+        hit, _ = lax.scan(tile, init, jnp.arange(CHUNK // CONV_TILE))
+        hb = hit[:, : rw * 32].reshape(c, rw, 32).astype(jnp.uint32)
+        weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+        return jnp.sum(hb * weights[None, None, :], axis=-1)
+
+    return run
+
+
 @functools.lru_cache(maxsize=8)
 def _anchor_kernel(n_rules: int, n_words: int, rw: int):
     import jax
@@ -440,26 +541,57 @@ def chunk_files(contents: list[bytes], overlap: int = K_ANCHOR - 1,
     return np.stack(arrs), np.array(owners), np.array(starts)
 
 
+def make_anchor_bank(rows: list[list[np.ndarray]]):
+    """Backend-specialized bank: the MXU conv formulation on
+    accelerators, the VPU bitset formulation on the CPU fallback (where
+    a [*, 256] one-hot matmul per byte would be pure waste)."""
+    try:
+        import jax
+
+        accel = jax.default_backend() not in ("cpu",)
+    except Exception:
+        accel = False
+    return ConvAnchorBank(rows) if accel else AnchorBank(rows)
+
+
 class AnchorMatcher:
     """Runs the anchor bank over a file batch and maps chunk-level hits
     back to per-file windows / presence bits."""
 
-    def __init__(self, bank: AnchorBank, batch_chunks: int = 512):
+    def __init__(self, bank, batch_chunks: int | None = None):
         self.bank = bank
+        if batch_chunks is None:
+            # the conv kernel's activations are tile-bounded, so its
+            # dispatch batch is tuned for MXU occupancy, not memory
+            batch_chunks = 128 if isinstance(bank, ConvAnchorBank) else 512
         self.batch_chunks = batch_chunks
+
+    def _dispatch(self, batch: np.ndarray):
+        """Enqueue one padded [batch_chunks, CHUNK] batch -> uint32
+        words (async jax array)."""
+        import jax.numpy as jnp
+
+        bank = self.bank
+        if isinstance(bank, ConvAnchorBank):
+            if not hasattr(self, "_dev"):
+                self._dev = (jnp.asarray(bank.classtab),
+                             jnp.asarray(bank.taps),
+                             jnp.asarray(bank.n_active))
+            run = _conv_anchor_kernel(bank.nc, bank.r_pad, bank.rw)
+            return run(jnp.asarray(batch), *self._dev)
+        if not hasattr(self, "_dev"):
+            self._dev = (jnp.asarray(bank.table),
+                         jnp.asarray(bank.bit_word),
+                         jnp.asarray(bank.bit_idx),
+                         jnp.asarray(bank.active))
+        run = _anchor_kernel(bank.n, bank.words, bank.rw)
+        return run(jnp.asarray(batch), *self._dev)
 
     def chunk_hits(self, contents: list[bytes]):
         """-> (hits bool[n_chunks, n_rows], owners, starts). Device
         dispatches are pipelined (async) and synced once at the end."""
-        import jax.numpy as jnp
-
         bank = self.bank
         chunks, owners, starts = chunk_files(contents)
-        run = _anchor_kernel(bank.n, bank.words, bank.rw)
-        table = jnp.asarray(bank.table)
-        bw = jnp.asarray(bank.bit_word)
-        bi = jnp.asarray(bank.bit_idx)
-        act = jnp.asarray(bank.active)
         outs = []
         for s0 in range(0, len(chunks), self.batch_chunks):
             batch = chunks[s0: s0 + self.batch_chunks]
@@ -468,7 +600,7 @@ class AnchorMatcher:
                 batch = np.concatenate([
                     batch,
                     np.zeros((self.batch_chunks - real, CHUNK), np.uint8)])
-            outs.append((run(jnp.asarray(batch), table, bw, bi, act), real))
+            outs.append((self._dispatch(batch), real))
         if not outs:
             return (np.zeros((0, bank.n), dtype=bool), owners, starts)
         words = np.concatenate(

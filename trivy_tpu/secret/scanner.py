@@ -160,11 +160,11 @@ class SecretScanner:
         if self._tiers is not None:
             return
         from trivy_tpu.ops.secret_nfa import (
-            AnchorBank,
             choose_anchor,
             compile_class_sequence,
             has_anchor,
             literal_anchor,
+            make_anchor_bank,
             regex_width,
             required_literal,
         )
@@ -200,7 +200,7 @@ class SecretScanner:
                     kw_ids[k] = len(anchor_rules) + len(kw_ids)
                     rows.append(literal_anchor(k))
 
-        bank = AnchorBank(rows) if rows else None
+        bank = make_anchor_bank(rows) if rows else None
         # keywords whose device bit is EXACT (not a truncated/overflowed
         # superset): a set bit alone proves presence; others need a host
         # substring confirm to preserve reference prefilter semantics
