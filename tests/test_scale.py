@@ -31,11 +31,14 @@ def test_db_shape_is_realistic(engine):
     st = engine.cdb.stats
     assert st["advisories"] >= N_ADV * 0.85
     assert st["fallback_names"] >= 10, "no hot names — skew too weak"
-    assert st["hot_rows"] > 0
-    assert engine.cdb.hot_window > engine.cdb.window
-    # every evicted advisory is present in the hot partition exactly once
+    assert st["hot_rows"] + st["tall_rows"] > 0
+    assert max(engine.cdb.hot_window,
+               engine.cdb.tall_window) > engine.cdb.window
+    # every evicted advisory is present in exactly one hot tier
     n_fb_advs = sum(len(v) for v in engine.cdb.host_fallback.values())
-    assert len(np.unique(engine.cdb.hot_adv)) == n_fb_advs
+    tiers = [t for t in (engine.cdb.hot_adv, engine.cdb.tall_adv)
+             if t is not None]
+    assert len(np.unique(np.concatenate(tiers))) == n_fb_advs
 
 
 def test_parity_at_scale(engine):
@@ -73,7 +76,8 @@ def test_hot_partition_beats_host_fallback(engine):
     # count discriminates device pre-screening (few candidates) from the
     # old host fallback (every advisory a candidate)
     qs = [PkgQuery(s, n, "8.90.0-1", _scheme_for(engine, s)) for s, n in hot]
-    assert engine._ddb_hot is not None, "hot partition not on device"
+    assert engine._ddb_hot is not None or engine._ddb_tall is not None, \
+        "hot partitions not on device"
     before = dict(engine.rescreen_stats)
     res = engine.detect(qs)
     orc = engine.oracle_detect(qs)
@@ -127,3 +131,42 @@ def test_window_eviction_boundary():
     assert [r.adv_indices for r in dev] == [r.adv_indices for r in orc]
     assert len(dev[0].adv_indices) == 14  # fixed 1.5..1.19 not yet applied
     assert dev[2].adv_indices == []  # above every fix
+
+
+def test_hot_tier_split_mid_vs_tall():
+    """Names above the window but within HOT_MID_WINDOW land in the mid
+    tier; giant groups land in the tall tier with its own window — and
+    both tiers match on device with oracle parity (reference hot loop:
+    pkg/detector/ospkg/detect.go:66)."""
+    from trivy_tpu.db import Advisory, AdvisoryDB
+    from trivy_tpu.detector.engine import PkgQuery
+    from trivy_tpu.tensorize.compile import HOT_MID_WINDOW
+
+    db = AdvisoryDB()
+    for i in range(20):  # mid tier: window < 20 <= HOT_MID_WINDOW
+        db.put_advisory("debian 12", "mid", Advisory(
+            vulnerability_id=f"CVE-M-{i}", fixed_version=f"1.{i}.0-1"))
+    for i in range(HOT_MID_WINDOW + 10):  # tall tier
+        db.put_advisory("debian 12", "tall", Advisory(
+            vulnerability_id=f"CVE-T-{i}", fixed_version=f"1.{i}.0-1"))
+    for i in range(3):
+        db.put_advisory("debian 12", "cool", Advisory(
+            vulnerability_id=f"CVE-C-{i}", fixed_version=f"2.{i}.0-1"))
+    eng = MatchEngine(db, window=8)
+    assert eng.cdb.stats["hot_rows"] == 20
+    assert eng.cdb.stats["tall_rows"] == HOT_MID_WINDOW + 10
+    assert ("debian 12", "tall") in eng.cdb.tall_names
+    assert ("debian 12", "mid") not in eng.cdb.tall_names
+    assert eng.cdb.tall_window >= HOT_MID_WINDOW + 10
+    assert eng.cdb.hot_window < eng.cdb.tall_window
+    assert eng._ddb_hot is not None and eng._ddb_tall is not None
+
+    qs = [PkgQuery("debian 12", "mid", "1.5.0-1", "deb"),
+          PkgQuery("debian 12", "tall", "1.100.0-1", "deb"),
+          PkgQuery("debian 12", "cool", "2.1.0-1", "deb"),
+          PkgQuery("debian 12", "tall", "0.1.0-1", "deb")]
+    dev = eng.detect(qs)
+    orc = eng.oracle_detect(qs)
+    assert [r.adv_indices for r in dev] == [r.adv_indices for r in orc]
+    assert len(dev[0].adv_indices) == 14  # fixes 1.6..1.19 still open
+    assert len(dev[1].adv_indices) == HOT_MID_WINDOW + 10 - 101

@@ -97,7 +97,13 @@ def run_scan(args) -> int:
 
     normalize_args(args)
 
-    secret_analyzer.USE_DEVICE = not getattr(args, "no_tpu", False)
+    # --no-tpu forces the host path; the default is "hybrid" (device
+    # screen + concurrent host AC — the fastest measured configuration;
+    # it degrades to host-only without an accelerator backend). Set per
+    # invocation so an earlier --no-tpu run in the same process doesn't
+    # stick.
+    secret_analyzer.USE_DEVICE = (
+        False if getattr(args, "no_tpu", False) else "hybrid")
 
 
     # jar sha1->GAV lookups use the java DB when it has been imported

@@ -93,6 +93,7 @@ class MatchEngine:
         self._crawl_cache: dict[tuple, list[int]] = {}
         self.crawl_cache_max = 2_000_000
         self._ddb_hot = None
+        self._ddb_tall = None
         self._name_tokens: dict[tuple[str, str], int] | None = None
         self._adv_tok = None
         if use_device:
@@ -107,9 +108,11 @@ class MatchEngine:
                 self._sdb = m.ShardedDB.from_compiled(self.cdb, mesh)
             else:
                 self._ddb = m.DeviceDB.from_compiled(self.cdb)
-            # hot names ("linux"-class) match on device against their own
-            # partition; small (few names), so replicated not sharded
+            # hot names match on device against their own partitions
+            # (mid tier + tall "linux"-class tier); small (few names),
+            # so replicated not sharded
             self._ddb_hot = m.DeviceDB.hot_from_compiled(self.cdb)
+            self._ddb_tall = m.DeviceDB.tall_from_compiled(self.cdb)
 
     # ------------------------------------------------------------ helpers
 
@@ -388,22 +391,31 @@ class MatchEngine:
             [(q.space, q.name, q.version, q.scheme_name) for q in queries]
         )
         ctx = {"queries": queries, "batch": batch,
-               "main": None, "sharded": None, "hot": None}
+               "main": None, "sharded": None, "hot": None, "tall": None}
         if self._sdb is not None:
             ctx["sharded"] = m.sharded_dispatch(self._sdb, batch)
         elif self._ddb is not None:
             ctx["main"] = m.match_dispatch(self._ddb, batch)
-        hot_idx = [
-            j for j, q in enumerate(queries)
-            if (q.space, q.name) in cdb.host_fallback
-        ]
-        if hot_idx and self._ddb_hot is not None:
+        tall_names = cdb.tall_names
+        hot_idx = []
+        tall_idx = []
+        for j, q in enumerate(queries):
+            key = (q.space, q.name)
+            if key in cdb.host_fallback:
+                (tall_idx if key in tall_names else hot_idx).append(j)
+
+        def sub_dispatch(idx, ddb):
             sub = m.PackageBatch(
-                h1=batch.h1[hot_idx], h2=batch.h2[hot_idx],
-                rank=batch.rank[hot_idx], flags=batch.flags[hot_idx],
-                queries=[batch.queries[j] for j in hot_idx],
+                h1=batch.h1[idx], h2=batch.h2[idx],
+                rank=batch.rank[idx], flags=batch.flags[idx],
+                queries=[batch.queries[j] for j in idx],
             )
-            ctx["hot"] = (hot_idx, m.match_dispatch(self._ddb_hot, sub), sub)
+            return (idx, m.match_dispatch(ddb, sub), sub)
+
+        if hot_idx and self._ddb_hot is not None:
+            ctx["hot"] = sub_dispatch(hot_idx, self._ddb_hot)
+        if tall_idx and self._ddb_tall is not None:
+            ctx["tall"] = sub_dispatch(tall_idx, self._ddb_tall)
         return ctx
 
     def _detect_unique(self, queries: list[PkgQuery]) -> list[list[int]]:
@@ -508,12 +520,16 @@ class MatchEngine:
         elif ctx["main"] is not None:
             add_part(ctx["main"], cdb.row_h1, cdb.row_adv, cdb.row_flags)
 
-        # hot-name queries additionally run against the hot partition
-        # (transfer is |hot queries| x hot_window bits, tiny after dedupe)
+        # hot-name queries additionally run against their tier's
+        # partition (transfer is |tier queries| x tier_window bits)
         if ctx["hot"] is not None:
             hot_idx, hot_pending, sub = ctx["hot"]
             add_part(hot_pending, cdb.hot_h1, cdb.hot_adv, cdb.hot_flags,
                      sub=sub, qidx=hot_idx)
+        if ctx["tall"] is not None:
+            tall_idx, tall_pending, sub = ctx["tall"]
+            add_part(tall_pending, cdb.tall_h1, cdb.tall_adv,
+                     cdb.tall_flags, sub=sub, qidx=tall_idx)
 
         parts = [p for p in parts if len(p[0])]
         if not parts:

@@ -65,6 +65,27 @@ def _bucket(n: int) -> int:
     return 128 << (-(-n // 128) - 1).bit_length()
 
 
+def trim_and_prefetch(arr, b: int, axis: int = 0):
+    """Slice bucket padding off a dispatched result ON DEVICE (rounded
+    up to 128 rows so distinct batch sizes share compiled shapes) and
+    start the host copy immediately: the device link may be a tunnel
+    with a ~70 ms fixed cost per fetch, so transfers must overlap later
+    batches' compute, not serialize at collect time. Single copy of the
+    rounding + prefetch rule for every dispatch path (main, sharded,
+    secret chunks)."""
+    cut = min(-(-b // 128) * 128, arr.shape[axis])
+    if cut < arr.shape[axis]:
+        idx = tuple(
+            slice(None) if d != axis else slice(cut)
+            for d in range(arr.ndim))
+        arr = arr[idx]
+    try:
+        arr.copy_to_host_async()
+    except AttributeError:
+        pass
+    return arr
+
+
 def _pack_table(h1, h2, lo, hi, flags) -> np.ndarray:
     """-> int32[N, TABLE_LANES] interleaved row table (one gather serves
     all fields). h1/h2 are bitcast; equality compares are unaffected."""
@@ -107,9 +128,10 @@ class DeviceDB:
     @classmethod
     def hot_from_compiled(cls, cdb: CompiledDB,
                           device=None) -> "DeviceDB | None":
-        """Hot partition (names whose row group exceeds the main window)
-        as its own DeviceDB with the hot window — matched by the same
-        kernel, only for queries that route to a hot name."""
+        """Hot mid-tier partition (names whose row group exceeds the
+        main window but fits HOT_MID_WINDOW) as its own DeviceDB —
+        matched by the same kernel, only for queries routed to a hot
+        name."""
         if cdb.hot_h1 is None or len(cdb.hot_h1) == 0:
             return None
         put = functools.partial(jax.device_put, device=device)
@@ -119,6 +141,24 @@ class DeviceDB:
                                   cdb.hot_hi, cdb.hot_flags)),
             n_rows=len(cdb.hot_h1),
             window=cdb.hot_window,
+        )
+
+    @classmethod
+    def tall_from_compiled(cls, cdb: CompiledDB,
+                           device=None) -> "DeviceDB | None":
+        """Tall tier ("linux"-class giant name groups): its large window
+        is paid only by queries for those few names, keeping the mid
+        tier's per-query result bytes ~6x smaller on the (possibly
+        tunneled) link."""
+        if cdb.tall_h1 is None or len(cdb.tall_h1) == 0:
+            return None
+        put = functools.partial(jax.device_put, device=device)
+        return cls(
+            h1=put(cdb.tall_h1),
+            table=put(_pack_table(cdb.tall_h1, cdb.tall_h2, cdb.tall_lo,
+                                  cdb.tall_hi, cdb.tall_flags)),
+            n_rows=len(cdb.tall_h1),
+            window=cdb.tall_window,
         )
 
 
@@ -192,21 +232,21 @@ def _sorted_padded(batch: PackageBatch, bucket: int):
 class Pending:
     """An in-flight device match: the jax array is a future — dispatches
     are async, so a crawl can enqueue several batches before paying the
-    (possibly tunneled) device round-trip once, overlapped."""
+    (possibly tunneled) device round-trip once, overlapped. The bucket
+    padding is sliced off and the host copy STARTED at dispatch time:
+    the measured tunnel link carries a ~70 ms fixed cost per fetch, so
+    transfers must overlap later batches' compute, not serialize at
+    collect time."""
 
-    words: jax.Array  # uint32[bucket, W/32]
+    words: jax.Array  # uint32[cut, W/32] — already bucket-trimmed
     order: np.ndarray
     b: int
     window: int
 
     def collect_words(self) -> np.ndarray:
         """Block and -> uint32[B, W/32] packed hit words in original
-        query order. Bucket padding is sliced off ON DEVICE so only ~the
-        real batch's words cross the (possibly tunneled) link; the slice
-        length rounds up to 128 rows so distinct batch sizes share
-        compiled shapes."""
-        cut = min(-(-self.b // 128) * 128, self.words.shape[0])
-        ws = np.asarray(self.words[:cut])[: self.b]
+        query order."""
+        ws = np.asarray(self.words)[: self.b]
         out = np.empty_like(ws)
         out[self.order] = ws
         return out
@@ -230,6 +270,7 @@ def match_dispatch(ddb: DeviceDB, batch: PackageBatch) -> Pending | None:
         jnp.asarray(rank), jnp.asarray(flags),
         window=ddb.window,
     )
+    words = trim_and_prefetch(words, b)
     return Pending(words=words, order=order, b=b, window=ddb.window)
 
 
@@ -340,7 +381,7 @@ def _sharded_match(row_h1, table, pkg_h1, pkg_h2, pkg_rank, pkg_flags,
 class ShardedPending:
     """In-flight sharded match (see Pending)."""
 
-    out: jax.Array  # uint32[n_db, bucket, W/32]
+    out: jax.Array  # uint32[n_db, cut, W/32] — already bucket-trimmed
     order: np.ndarray
     b: int
     window: int
@@ -350,8 +391,7 @@ class ShardedPending:
         """Block and -> bool[n_db, B, ceil32(W)] per-shard masks in the
         original query order."""
         w = _words(self.window) * 32
-        cut = min(-(-self.b // 128) * 128, self.out.shape[1])
-        out = np.asarray(self.out[:, :cut])[:, : self.b]
+        out = np.asarray(self.out)[:, : self.b]
         masks = np.empty((self.n_db, self.b, w), dtype=bool)
         for d in range(self.n_db):
             m = _unpack_words(out[d], self.window)
@@ -377,6 +417,7 @@ def sharded_dispatch(sdb: ShardedDB,
         jax.device_put(rank, spec), jax.device_put(flags, spec),
         window=sdb.window, mesh=sdb.mesh,
     )
+    out = trim_and_prefetch(out, b, axis=1)
     return ShardedPending(out=out, order=order, b=b,
                           window=sdb.window, n_db=n_db)
 

@@ -541,17 +541,96 @@ def chunk_files(contents: list[bytes], overlap: int = K_ANCHOR - 1,
     return np.stack(arrs), np.array(owners), np.array(starts)
 
 
-def make_anchor_bank(rows: list[list[np.ndarray]]):
-    """Backend-specialized bank: the MXU conv formulation on
-    accelerators, the VPU bitset formulation on the CPU fallback (where
-    a [*, 256] one-hot matmul per byte would be pure waste)."""
+def chunk_files_packed(contents: list[bytes], overlap: int = K_ANCHOR - 1):
+    """Chunking with small-file packing: files shorter than the chunk
+    share chunks, separated by `overlap` zero bytes so an anchor window
+    (span <= K_ANCHOR) starting inside one file can never reach the next
+    file's bytes. Cuts device bytes ~30-40% on many-small-file corpora
+    (a kernel tree averages ~20 KiB/file, so one-file-per-chunk wastes
+    nearly half of every final 16 KiB chunk as zero padding).
+
+    -> (chunks uint8[N, CHUNK], segments), segments[c] = list of
+    (file_idx, file_off, chunk_off, seg_len) spans laid out in chunk c.
+    A chunk-level rule hit is attributed to EVERY segment of the chunk
+    (the bitmap has chunk resolution); the host regex confirms inside
+    per-file windows, so over-attribution costs host work, never
+    correctness."""
+    arrs: list[np.ndarray] = []
+    segments: list[list[tuple[int, int, int, int]]] = []
+    gap = overlap
+    step = CHUNK - overlap
+
+    pack_buf = np.zeros(CHUNK, dtype=np.uint8)
+    pack_pos = 0
+    pack_segs: list[tuple[int, int, int, int]] = []
+
+    def flush_pack():
+        nonlocal pack_pos, pack_buf, pack_segs
+        if pack_segs:
+            arrs.append(pack_buf)
+            segments.append(pack_segs)
+            pack_buf = np.zeros(CHUNK, dtype=np.uint8)
+            pack_pos = 0
+            pack_segs = []
+
+    def pack(fi: int, file_off: int, piece: bytes) -> None:
+        nonlocal pack_pos
+        need = len(piece) + (gap if pack_pos else 0)
+        if pack_pos + need > CHUNK:
+            flush_pack()
+        if pack_pos:
+            pack_pos += gap  # zero separator
+        if piece:
+            pack_buf[pack_pos: pack_pos + len(piece)] = (
+                np.frombuffer(piece, dtype=np.uint8))
+        pack_segs.append((fi, file_off, pack_pos, len(piece)))
+        pack_pos += len(piece)
+
+    for fi, content in enumerate(contents):
+        pos = 0
+        # full chunks stream as-is; the sub-chunk tail (and any whole
+        # small file) goes through the pack buffer — consecutive chunks
+        # of one file overlap by `overlap` bytes so anchors straddling
+        # a cut are still seen in full by some chunk
+        while len(content) - pos >= CHUNK:
+            arr = np.frombuffer(
+                content[pos: pos + CHUNK], dtype=np.uint8).copy()
+            arrs.append(arr)
+            segments.append([(fi, pos, 0, CHUNK)])
+            pos += step
+        pack(fi, pos, content[pos:])
+    flush_pack()
+    if not arrs:
+        return np.zeros((0, CHUNK), dtype=np.uint8), []
+    return np.stack(arrs), segments
+
+
+def accel_backend() -> bool:
+    """True when jax's default backend is an accelerator. Single copy so
+    hybrid routing (secret/scanner.py) and bank selection can never
+    disagree about what counts as an accelerator."""
     try:
         import jax
 
-        accel = jax.default_backend() not in ("cpu",)
-    except Exception:
-        accel = False
-    return ConvAnchorBank(rows) if accel else AnchorBank(rows)
+        return jax.default_backend() not in ("cpu",)
+    except Exception:  # noqa: BLE001 — no jax = no device path
+        return False
+
+
+def make_anchor_bank(rows: list[list[np.ndarray]]):
+    """Bank selection, measured on real v5e silicon (round 5): the VPU
+    bitset formulation sustains ~123 MB/s compute vs ~50 MB/s for the
+    MXU conv bank (the conv's one-hot/score intermediates are HBM-bound
+    at [C, TILE, nc] bf16 + [C, TILE, r_pad] f32 per tap), so the bitset
+    bank wins on every backend WHEN it fits the class budget. The conv
+    bank keeps its role as the no-budget fallback: rows that overflow
+    MAX_CLASS_WORDS degrade to always-hit (whole-file host regex), and
+    once that happens the conv bank's unlimited class space is worth its
+    slower screen."""
+    bank = AnchorBank(rows)
+    if bank.overflowed == 0:
+        return bank
+    return ConvAnchorBank(rows) if accel_backend() else bank
 
 
 class AnchorMatcher:
@@ -561,9 +640,12 @@ class AnchorMatcher:
     def __init__(self, bank, batch_chunks: int | None = None):
         self.bank = bank
         if batch_chunks is None:
+            # measured on v5e (round 5): the bitset kernel holds ~86 MB/s
+            # at 256-512 chunks/dispatch and collapses to ~30 at 1024
+            # (pred intermediates outgrow what fits close to the VPU);
             # the conv kernel's activations are tile-bounded, so its
             # dispatch batch is tuned for MXU occupancy, not memory
-            batch_chunks = 128 if isinstance(bank, ConvAnchorBank) else 512
+            batch_chunks = 128 if isinstance(bank, ConvAnchorBank) else 256
         self.batch_chunks = batch_chunks
 
     def _dispatch(self, batch: np.ndarray):
@@ -587,11 +669,28 @@ class AnchorMatcher:
         run = _anchor_kernel(bank.n, bank.words, bank.rw)
         return run(jnp.asarray(batch), *self._dev)
 
+    def chunk_hits_packed(self, contents: list[bytes]):
+        """Like chunk_hits but with small-file packing: -> (hits
+        bool[n_chunks, n_rows], segments) where segments[c] lists the
+        (file_idx, file_off, chunk_off, seg_len) spans of chunk c."""
+        chunks, segments = chunk_files_packed(contents)
+        return self._run_chunks(chunks), segments
+
     def chunk_hits(self, contents: list[bytes]):
         """-> (hits bool[n_chunks, n_rows], owners, starts). Device
         dispatches are pipelined (async) and synced once at the end."""
-        bank = self.bank
         chunks, owners, starts = chunk_files(contents)
+        return self._run_chunks(chunks), owners, starts
+
+    def dispatch_chunks(self, chunks: np.ndarray) -> list:
+        """Enqueue every batch without blocking -> opaque pending list.
+        The device computes (and its results stream host-ward) while the
+        caller does other work — collect_chunks blocks only on whatever
+        is still in flight."""
+        # jax-dependent import, deferred: the host-only helpers in this
+        # module must stay importable without a working jax install
+        from trivy_tpu.ops.match import trim_and_prefetch
+
         outs = []
         for s0 in range(0, len(chunks), self.batch_chunks):
             batch = chunks[s0: s0 + self.batch_chunks]
@@ -600,16 +699,24 @@ class AnchorMatcher:
                 batch = np.concatenate([
                     batch,
                     np.zeros((self.batch_chunks - real, CHUNK), np.uint8)])
-            outs.append((self._dispatch(batch), real))
+            outs.append((trim_and_prefetch(self._dispatch(batch), real),
+                         real))
+        return outs
+
+    def collect_chunks(self, outs: list) -> np.ndarray:
+        bank = self.bank
         if not outs:
-            return (np.zeros((0, bank.n), dtype=bool), owners, starts)
+            return np.zeros((0, bank.n), dtype=bool)
         words = np.concatenate(
             [np.asarray(o)[:real] for o, real in outs])  # [NC, rw]
         bits = np.unpackbits(
             np.ascontiguousarray(words).view(np.uint8).reshape(
                 words.shape[0], -1),
             axis=1, bitorder="little")[:, : bank.n]
-        return bits.astype(bool), owners, starts
+        return bits.astype(bool)
+
+    def _run_chunks(self, chunks: np.ndarray) -> np.ndarray:
+        return self.collect_chunks(self.dispatch_chunks(chunks))
 
 
 def merge_windows(wins: list[tuple[int, int]]) -> list[tuple[int, int]]:

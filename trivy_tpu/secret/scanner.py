@@ -13,6 +13,7 @@ device pass; only files with keyword hits reach the host regex engine.
 
 from __future__ import annotations
 
+import os
 import re
 from dataclasses import dataclass, field
 
@@ -242,11 +243,90 @@ class SecretScanner:
         if not use_device:
             return self._scan_files_host(eligible)
         self._ensure_tiers()
+        if use_device == "hybrid":
+            if self._accel_backend():
+                return self._scan_files_hybrid(eligible)
+            # no accelerator: the "device" share would run on the jax
+            # CPU backend, strictly slower than the native-AC host path
+            return self._scan_files_host(eligible)
         try:
             return self._scan_files_device(eligible)
         except Exception as e:  # no device / compile issue -> host
             _log.debug("device secret path failed, using host", err=str(e))
             return self._scan_files_host(eligible)
+
+    # device share of a hybrid scan: measured v5e-over-tunnel device
+    # screen ~50 MB/s vs ~125 MB/s native-AC host -> ~0.3 of the bytes
+    # go to the device while the host thread chews the rest concurrently
+    HYBRID_DEVICE_SHARE = 0.3
+
+    @staticmethod
+    def _accel_backend() -> bool:
+        from trivy_tpu.ops.secret_nfa import accel_backend
+
+        return accel_backend()
+
+    def _scan_files_hybrid(self, eligible) -> list[Secret]:
+        """Split the corpus by bytes between the device screen and the
+        host AC path, DISPATCH-FIRST: every device batch is enqueued
+        (async, non-blocking) before the host share is scanned, so the
+        chip computes and its results stream back while the host CPU
+        chews its own share — no threads, no GIL contention (a
+        two-thread version measured 2x slower on both sides). Wall-clock
+        beats host-only whenever the device share finishes within the
+        host's scan time — the honest way a tunneled single-chip
+        sidecar speeds up a CPU-bound scan."""
+        total = sum(len(c) for (_i, _p, c) in eligible) or 1
+        try:
+            share = float(os.environ.get(
+                "TRIVY_TPU_SECRET_DEVICE_SHARE",
+                self.HYBRID_DEVICE_SHARE))
+        except ValueError:
+            _log.warn("invalid TRIVY_TPU_SECRET_DEVICE_SHARE; using default")
+            share = self.HYBRID_DEVICE_SHARE
+        budget = total * max(min(share, 1.0), 0.0)
+        dev_part: list = []
+        host_part: list = []
+        acc = 0
+        for item in eligible:
+            if acc < budget:
+                dev_part.append(item)
+                acc += len(item[2])
+            else:
+                host_part.append(item)
+        pre = None
+        try:
+            pre = self._dispatch_device(dev_part)
+        except Exception as e:  # noqa: BLE001 — host fallback below
+            _log.debug("hybrid device dispatch failed, using host",
+                       err=str(e))
+        host_res = self._scan_files_host(host_part)
+        if pre is not None:
+            try:
+                dev_res = self._scan_files_device(dev_part,
+                                                  prefetched=pre)
+            except Exception as e:  # noqa: BLE001
+                _log.debug("hybrid device collect failed, using host",
+                           err=str(e))
+                dev_res = self._scan_files_host(dev_part)
+        else:
+            dev_res = self._scan_files_host(dev_part)
+        by_path = {s.file_path: s for part in (dev_res, host_res)
+                   for s in part}
+        return [by_path[p] for (_i, p, _c) in eligible if p in by_path]
+
+    def _dispatch_device(self, eligible):
+        """Chunk + enqueue the device screen for a file set without
+        blocking. -> (matcher, pendings, segments) for _scan_files_device."""
+        from trivy_tpu.ops.secret_nfa import AnchorMatcher, chunk_files_packed
+
+        t = self._tiers
+        if t["bank"] is None or not eligible:
+            return None
+        matcher = AnchorMatcher(t["bank"])
+        chunks, segments = chunk_files_packed(
+            [c for (_i, _p, c) in eligible])
+        return matcher, matcher.dispatch_chunks(chunks), segments
 
     def _scan_files_host(self, eligible) -> list[Secret]:
         out = []
@@ -280,7 +360,7 @@ class SecretScanner:
             self._kw_state = (matcher, rule_kws)
         return self._kw_state
 
-    def _scan_files_device(self, eligible) -> list[Secret]:
+    def _scan_files_device(self, eligible, prefetched=None) -> list[Secret]:
         from trivy_tpu.ops.secret_nfa import (
             CHUNK, AnchorMatcher, merge_windows,
         )
@@ -293,20 +373,51 @@ class SecretScanner:
         nf = len(contents)
         windows: list[dict[int, list]] = [dict() for _ in range(nf)]
         kw_present_f = np.zeros((nf, len(kw_ids)), dtype=bool)
+        # a keyword bit from a chunk SHARED by several files proves
+        # presence only at chunk resolution — those files must confirm
+        # on host even for exact (short, unoverflowed) keywords
+        kw_solo_f = np.zeros((nf, len(kw_ids)), dtype=bool)
         if t["bank"] is not None:
-            hits, owners, starts = AnchorMatcher(t["bank"]).chunk_hits(
-                contents)
-            ci, ri = np.nonzero(hits)
+            if prefetched is not None:
+                matcher, pendings, segments = prefetched
+                hits = matcher.collect_chunks(pendings)
+            else:
+                hits, segments = AnchorMatcher(
+                    t["bank"]).chunk_hits_packed(contents)
+            # flatten segments once; keyword rows hit densely (common
+            # words fire in nearly every chunk), so their per-file OR is
+            # a sorted reduceat, not a Python loop — only the sparse
+            # anchor-rule hits take the window-building loop below
+            seg_chunk, seg_file, seg_solo = (
+                np.array([c for c, segs in enumerate(segments)
+                          for _ in segs], dtype=np.int64),
+                np.array([s[0] for segs in segments for s in segs],
+                         dtype=np.int64),
+                np.array([len(segs) == 1 for segs in segments
+                          for _ in segs], dtype=bool),
+            )
+            if len(seg_chunk) and len(kw_ids):
+                order = np.argsort(seg_file, kind="stable")
+                sf = seg_file[order]
+                kw_rows = hits[seg_chunk[order], n_a:]
+                bounds = np.searchsorted(sf, np.arange(nf + 1))
+                # files without segments (skipped/empty) reduce over an
+                # empty span: reduceat can't express that, so mask after
+                has_seg = bounds[:-1] < bounds[1:]
+                starts_i = np.minimum(bounds[:-1], max(len(sf) - 1, 0))
+                kw_present_f[:] = np.maximum.reduceat(
+                    kw_rows, starts_i, axis=0) & has_seg[:, None]
+                kw_solo_f[:] = np.maximum.reduceat(
+                    kw_rows & seg_solo[order][:, None], starts_i,
+                    axis=0) & has_seg[:, None]
+            ci, ri = np.nonzero(hits[:, :n_a])
             for c, r in zip(ci.tolist(), ri.tolist()):
-                fi = int(owners[c])
-                if r < n_a:
+                for fi, file_off, _chunk_off, seg_len in segments[c]:
                     cr, pad_lo, pad_hi, _kind = anchor_rules[r]
-                    base = int(starts[c])
-                    lo = max(base - pad_lo, 0)
-                    hi = min(base + CHUNK + pad_hi, len(contents[fi]))
+                    lo = max(file_off - pad_lo, 0)
+                    hi = min(file_off + seg_len + pad_hi,
+                             len(contents[fi]))
                     windows[fi].setdefault(r, []).append((lo, hi))
-                else:
-                    kw_present_f[fi, r - n_a] = True
 
         kw_exact = t["kw_exact"]
         out = []
@@ -328,7 +439,7 @@ class SecretScanner:
                 for k in cr.keywords:
                     if not kw_present_f[fi, kw_ids[k] - n_a]:
                         continue
-                    if kw_exact[k]:
+                    if kw_exact[k] and kw_solo_f[fi, kw_ids[k] - n_a]:
                         return True
                     if low is None:
                         low = content.lower()

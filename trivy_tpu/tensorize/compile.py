@@ -143,6 +143,22 @@ class CompiledDB:
     hot_flags: np.ndarray | None = None
     hot_adv: np.ndarray | None = None
     hot_window: int = 0
+    # tall tier: the few truly giant name groups ("linux"-class, group >
+    # HOT_MID_WINDOW rows). Splitting them out keeps the mid tier's
+    # window — and with it the per-query result transfer (B x window
+    # bits) and gather volume — ~6x smaller; only queries for a tall
+    # name pay the tall window. The result link may be a ~5 MB/s tunnel,
+    # so result bytes are the scarce resource.
+    tall_h1: np.ndarray | None = None
+    tall_h2: np.ndarray | None = None
+    tall_lo: np.ndarray | None = None
+    tall_hi: np.ndarray | None = None
+    tall_flags: np.ndarray | None = None
+    tall_adv: np.ndarray | None = None
+    tall_window: int = 0
+    # (space, name) routing set for the tall tier (subset of
+    # host_fallback's keys)
+    tall_names: set = field(default_factory=set)
     stats: dict = field(default_factory=dict)
     # encode memo caches (same packages recur across a registry crawl)
     _hash_cache: dict = field(default_factory=dict, repr=False)
@@ -308,6 +324,9 @@ def _subtract(vuln: list, secure: list, scheme) -> list:
 
 
 MAX_AUTO_WINDOW = 512
+# hot-tier split point: name groups above this go to the "tall"
+# partition so mid-tier queries don't pay giant-group windows
+HOT_MID_WINDOW = 256
 
 
 def compile_db(db: AdvisoryDB, window: int | None = None) -> CompiledDB:
@@ -462,19 +481,38 @@ def compile_db(db: AdvisoryDB, window: int | None = None) -> CompiledDB:
         return a_h1, a_h2, a_lo, a_hi, a_flags, a_adv
 
     row_h1, row_h2, row_lo, row_hi, row_flags, row_adv = fill(kept)
-    hot_arrays = fill(hot) if hot else None
+    # tier the hot rows: mid groups (<= HOT_MID_WINDOW) vs the few
+    # giant "tall" groups, so a mid-name query never pays the tall
+    # group's window in gather volume or result bytes
+    mid: list[dict] = []
+    tall: list[dict] = []
+    tall_names: set = set()
+    for r in hot:
+        if counts[r["h1"]] > HOT_MID_WINDOW:
+            tall.append(r)
+            tall_names.add((r["space"], r["name"]))
+        else:
+            mid.append(r)
+    hot_arrays = fill(mid) if mid else None
     hot_window = 0
-    if hot:
-        hot_max = max(Counter(r["h1"] for r in hot).values())
+    if mid:
+        hot_max = max(Counter(r["h1"] for r in mid).values())
         hot_window = -(-hot_max // 8) * 8
+    tall_arrays = fill(tall) if tall else None
+    tall_window = 0
+    if tall:
+        tall_max = max(Counter(r["h1"] for r in tall).values())
+        tall_window = -(-tall_max // 8) * 8
 
     stats = {
         "rows": len(kept),
         "advisories": len(advisories),
         "host_rows": n_host_rows,
         "fallback_names": len(host_fallback),
-        "hot_rows": len(hot),
+        "hot_rows": len(mid),
         "hot_window": hot_window,
+        "tall_rows": len(tall),
+        "tall_window": tall_window,
         "boundary_keys": {s: len(b) for s, b in boundaries.items()},
     }
     _log.info("compiled advisory DB", **stats)
@@ -489,5 +527,13 @@ def compile_db(db: AdvisoryDB, window: int | None = None) -> CompiledDB:
         hot_hi=hot_arrays[3] if hot_arrays else None,
         hot_flags=hot_arrays[4] if hot_arrays else None,
         hot_adv=hot_arrays[5] if hot_arrays else None,
-        hot_window=hot_window, stats=stats,
+        hot_window=hot_window,
+        tall_h1=tall_arrays[0] if tall_arrays else None,
+        tall_h2=tall_arrays[1] if tall_arrays else None,
+        tall_lo=tall_arrays[2] if tall_arrays else None,
+        tall_hi=tall_arrays[3] if tall_arrays else None,
+        tall_flags=tall_arrays[4] if tall_arrays else None,
+        tall_adv=tall_arrays[5] if tall_arrays else None,
+        tall_window=tall_window, tall_names=tall_names,
+        stats=stats,
     )
