@@ -364,3 +364,64 @@ class TestKeywordTruncationParity:
                     for s in secrets for f in s.findings}
         assert norm(dev) == norm(host)
         assert norm(dev) == {("full.txt", "long-kw")}
+
+
+class TestHybridMode:
+    """The shipped default (USE_DEVICE="hybrid") splits the corpus:
+    device batches dispatch first, the host scans the rest, results
+    merge by path. On the CPU test backend the accelerator guard routes
+    hybrid to host-only, so these tests force the split path."""
+
+    def _norm(self, secrets):
+        return {(s.file_path, f.rule_id, f.start_line, f.match)
+                for s in secrets for f in s.findings}
+
+    def test_hybrid_split_matches_host(self, monkeypatch):
+        scanner = SecretScanner()
+        monkeypatch.setattr(SecretScanner, "_accel_backend",
+                            staticmethod(lambda: True))
+        corpus = _corpus(seed=11)
+        hyb = scanner.scan_files(corpus, use_device="hybrid")
+        host = scanner.scan_files(corpus, use_device=False)
+        assert self._norm(hyb) == self._norm(host)
+        assert self._norm(hyb), "corpus produced no findings at all"
+
+    def test_hybrid_share_env_and_bounds(self, monkeypatch):
+        scanner = SecretScanner()
+        monkeypatch.setattr(SecretScanner, "_accel_backend",
+                            staticmethod(lambda: True))
+        corpus = _corpus(seed=12)
+        host = scanner.scan_files(corpus, use_device=False)
+        # whole corpus to the device partition
+        monkeypatch.setenv("TRIVY_TPU_SECRET_DEVICE_SHARE", "1.0")
+        assert self._norm(scanner.scan_files(
+            corpus, use_device="hybrid")) == self._norm(host)
+        # malformed share degrades to the default, not a crash
+        monkeypatch.setenv("TRIVY_TPU_SECRET_DEVICE_SHARE", "0.3x")
+        assert self._norm(scanner.scan_files(
+            corpus, use_device="hybrid")) == self._norm(host)
+
+    def test_hybrid_device_failure_falls_back_to_host(self, monkeypatch):
+        scanner = SecretScanner()
+        monkeypatch.setattr(SecretScanner, "_accel_backend",
+                            staticmethod(lambda: True))
+
+        def boom(self_, part, prefetched=None):
+            raise RuntimeError("device gone")
+
+        monkeypatch.setattr(SecretScanner, "_scan_files_device", boom)
+        corpus = _corpus(seed=13)
+        hyb = scanner.scan_files(corpus, use_device="hybrid")
+        host = scanner.scan_files(corpus, use_device=False)
+        assert self._norm(hyb) == self._norm(host)
+
+    def test_hybrid_without_accel_uses_host_path(self, monkeypatch):
+        scanner = SecretScanner()
+        monkeypatch.setattr(SecretScanner, "_accel_backend",
+                            staticmethod(lambda: False))
+        called = []
+        monkeypatch.setattr(
+            SecretScanner, "_scan_files_hybrid",
+            lambda self_, e: called.append(1) or [])
+        scanner.scan_files(_corpus(seed=14), use_device="hybrid")
+        assert not called, "hybrid path must not run without accelerator"

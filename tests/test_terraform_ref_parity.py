@@ -321,3 +321,276 @@ IGNORE_CASES = [
                          ids=[c[0] for c in IGNORE_CASES])
 def test_ignore_semantics(name, source, suppressed):
     assert _scan_ignore_case(source) is suppressed
+
+
+# --------------------------------------- parser_test.go value cases
+
+
+def _resource(files, rtype, root=""):
+    ev = _eval(files, root)
+    return [b for b in ev.blocks
+            if b.type == "resource" and b.labels[:1] == [rtype]]
+
+
+def test_templated_slice_value():
+    """Test_TemplatedSliceValue (parser_test.go:340)."""
+    (b,) = _resource({"test.tf": '''
+variable "x" {
+  default = "hello"
+}
+resource "something" "blah" {
+  value = ["first", "${var.x}-${var.x}", "last"]
+}
+'''}, rtype="something")
+    assert b.get("value") == ["first", "hello-hello", "last"]
+
+
+def test_slice_of_vars_and_var_slice():
+    """Test_SliceOfVars + Test_VarSlice (parser_test.go:384,429)."""
+    (b,) = _resource({"test.tf": '''
+variable "x" { default = "1" }
+variable "y" { default = "2" }
+resource "something" "blah" {
+  value = [var.x, var.y]
+}
+'''}, rtype="something")
+    assert b.get("value") == ["1", "2"]
+    (b,) = _resource({"test.tf": '''
+variable "x" { default = ["a", "b", "c"] }
+resource "something" "blah" {
+  value = var.x
+}
+'''}, rtype="something")
+    assert b.get("value") == ["a", "b", "c"]
+
+
+def test_local_slice_nested_and_concat():
+    """Test_LocalSliceNested + Test_FunctionCall (parser_test.go:473,521)."""
+    (b,) = _resource({"test.tf": '''
+variable "x" { default = "a" }
+locals { y = [var.x, "b", "c"] }
+resource "something" "blah" {
+  value = local.y
+}
+'''}, rtype="something")
+    assert b.get("value") == ["a", "b", "c"]
+    (b,) = _resource({"test.tf": '''
+variable "x" { default = ["a", "b"] }
+resource "something" "blah" {
+  value = concat(var.x, ["c"])
+}
+'''}, rtype="something")
+    assert b.get("value") == ["a", "b", "c"]
+
+
+def test_null_default_value_for_var():
+    """Test_NullDefaultValueForVar (parser_test.go:566)."""
+    (b,) = _resource({"test.tf": '''
+variable "bucket_name" {
+  type    = string
+  default = null
+}
+resource "aws_s3_bucket" "default" {
+  bucket = var.bucket_name != null ? var.bucket_name : "default"
+}
+'''}, rtype="aws_s3_bucket")
+    assert b.get("bucket") == "default"
+
+
+def test_multiple_instances_nested_attr():
+    """Test_MultipleInstancesOfSameResource (parser_test.go:597): both
+    sse configurations keep their own nested kms key reference."""
+    blocks = _resource({"test.tf": '''
+resource "aws_kms_key" "key1" { description = "Key #1" }
+resource "aws_kms_key" "key2" { description = "Key #2" }
+resource "aws_s3_bucket" "this" { bucket = "test" }
+resource "aws_s3_bucket_server_side_encryption_configuration" "this1" {
+  bucket = aws_s3_bucket.this.id
+  rule {
+    apply_server_side_encryption_by_default {
+      kms_master_key_id = aws_kms_key.key1.description
+      sse_algorithm     = "aws:kms"
+    }
+  }
+}
+resource "aws_s3_bucket_server_side_encryption_configuration" "this2" {
+  bucket = aws_s3_bucket.this.id
+  rule {
+    apply_server_side_encryption_by_default {
+      kms_master_key_id = aws_kms_key.key2.description
+      sse_algorithm     = "aws:kms"
+    }
+  }
+}
+'''}, rtype="aws_s3_bucket_server_side_encryption_configuration")
+    assert len(blocks) == 2
+    got = set()
+    for b in blocks:
+        rule = b.child("rule")
+        inner = rule.child("apply_server_side_encryption_by_default")
+        got.add(inner.get("kms_master_key_id"))
+    assert got == {"Key #1", "Key #2"}
+
+
+@pytest.mark.parametrize("src,expected", [
+    # TestDynamicBlocks table (parser_test.go:1370)
+    ('resource "test_resource" "test" {\n'
+     '  dynamic "foo" {\n    for_each = [80, 443]\n'
+     '    content {\n      bar = foo.value\n    }\n  }\n}', [80, 443]),
+    ('resource "test_resource" "test" {\n'
+     '  dynamic "foo" {\n    for_each = tolist([80, 443])\n'
+     '    content {\n      bar = foo.value\n    }\n  }\n}', [80, 443]),
+    ('resource "test_resource" "test" {\n'
+     '  dynamic "foo" {\n    for_each = toset([80, 443])\n'
+     '    content {\n      bar = foo.value\n    }\n  }\n}', [80, 443]),
+    ('resource "test_resource" "test" {\n'
+     '  dynamic "foo" {\n    for_each = tolist([true])\n'
+     '    content {\n      bar = foo.value\n    }\n  }\n}', [True]),
+    ('resource "test_resource" "test" {\n'
+     '  dynamic "foo" {\n    for_each = []\n'
+     '    content {}\n  }\n}', []),
+    ('variable "test_var" {\n  default = [{ enabled = true }]\n}\n'
+     'resource "test_resource" "test" {\n'
+     '  dynamic "foo" {\n    for_each = var.test_var\n'
+     '    content {\n      bar = foo.value.enabled\n    }\n  }\n}',
+     [True]),
+])
+def test_dynamic_blocks(src, expected):
+    (b,) = _resource({"test.tf": src}, rtype="test_resource")
+    foos = b.children("foo")
+    vals = [f.get("bar") for f in foos if "bar" in f.attrs]
+    assert vals == expected
+
+
+def test_dynamic_block_iterator_override():
+    """`iterator =` renames the content-scope variable (hcl dynblock)."""
+    (b,) = _resource({"test.tf": '''
+resource "test_resource" "test" {
+  dynamic "setting" {
+    for_each = ["a", "b"]
+    iterator = it
+    content {
+      name = it.value
+      idx  = it.key
+    }
+  }
+}
+'''}, rtype="test_resource")
+    settings = b.children("setting")
+    assert [(s.get("name"), s.get("idx")) for s in settings] == [
+        ("a", 0), ("b", 1)]
+
+
+def test_nested_dynamic_block():
+    """TestNestedDynamicBlock (parser_test.go:1616): 2 x 2 expansion
+    with both iterators visible in the innermost content."""
+    (b,) = _resource({"test.tf": '''
+resource "test_resource" "test" {
+  dynamic "foo" {
+    for_each = ["1", "1"]
+    content {
+      dynamic "bar" {
+        for_each = [true, true]
+        content {
+          baz = foo.value
+          qux = bar.value
+        }
+      }
+    }
+  }
+}
+'''}, rtype="test_resource")
+    foos = b.children("foo")
+    assert len(foos) == 2
+    nested = [inner for f in foos for inner in f.children("bar")]
+    assert len(nested) == 4
+    assert all(n.get("baz") == "1" and n.get("qux") is True
+               for n in nested)
+
+
+def test_dynamic_block_map_for_each():
+    """Map for_each: .key/.value pairs (reference dynblock semantics)."""
+    (b,) = _resource({"test.tf": '''
+resource "test_resource" "test" {
+  dynamic "tag" {
+    for_each = { Name = "x", Env = "prod" }
+    content {
+      k = tag.key
+      v = tag.value
+    }
+  }
+}
+'''}, rtype="test_resource")
+    tags = {t.get("k"): t.get("v") for t in b.children("tag")}
+    assert tags == {"Name": "x", "Env": "prod"}
+
+
+def test_dynamic_block_unknown_for_each_stays_silent():
+    """Unresolvable for_each -> one instance with unknown iterator refs
+    (the evaluator's unresolved-value policy: silent, never wrong)."""
+    (b,) = _resource({"test.tf": '''
+resource "test_resource" "test" {
+  dynamic "foo" {
+    for_each = var.undeclared
+    content {
+      bar = foo.value
+    }
+  }
+}
+'''}, rtype="test_resource")
+    foos = b.children("foo")
+    assert len(foos) == 1
+    from trivy_tpu.iac.parsers.hcl import Expr
+    assert isinstance(foos[0].attrs["bar"].value, Expr)
+
+
+def test_for_each_ref_to_locals_and_var_default():
+    """Test_ForEachRefToLocals + Test_ForEachRefToVariableWithDefault
+    (parser_test.go:690,726)."""
+    for src in (
+        'locals {\n  buckets = toset(["foo", "bar"])\n}\n'
+        'resource "aws_s3_bucket" "this" {\n'
+        '  for_each = local.buckets\n  bucket   = each.key\n}',
+        'variable "buckets" {\n  type    = set(string)\n'
+        '  default = ["foo", "bar"]\n}\n'
+        'resource "aws_s3_bucket" "this" {\n'
+        '  for_each = var.buckets\n  bucket   = each.key\n}',
+    ):
+        blocks = _resource({"main.tf": src}, rtype="aws_s3_bucket")
+        assert len(blocks) == 2
+        assert {b.get("bucket") for b in blocks} == {"foo", "bar"}
+
+
+@pytest.mark.parametrize("fe,ref,expected", [
+    ('toset(local.buckets)', 'each.key', "bucket1"),     # set: key==value
+    ('toset(local.buckets)', 'each.value', "bucket1"),
+    ('local.bucket_map', 'each.key', "bucket1key"),
+    ('local.bucket_map', 'each.value', "bucket1value"),
+])
+def test_for_each_key_value_semantics(fe, ref, expected):
+    """TestForEach (parser_test.go:913): set for_each exposes key ==
+    value; map for_each exposes the pair."""
+    src = ('locals {\n  buckets = ["bucket1"]\n'
+           '  bucket_map = { bucket1key = "bucket1value" }\n}\n'
+           'resource "aws_s3_bucket" "this" {\n'
+           f'  for_each = {fe}\n  bucket = {ref}\n}}')
+    (b,) = _resource({"main.tf": src}, rtype="aws_s3_bucket")
+    assert b.get("bucket") == expected
+
+
+def test_dynamic_block_set_key_equals_value():
+    """hcl dynblock: set for_each exposes key == value (not the index);
+    list for_each exposes key == index."""
+    (b,) = _resource({"test.tf": '''
+resource "test_resource" "test" {
+  dynamic "tag" {
+    for_each = toset(["a", "b"])
+    content {
+      k = tag.key
+      v = tag.value
+    }
+  }
+}
+'''}, rtype="test_resource")
+    assert [(t.get("k"), t.get("v")) for t in b.children("tag")] == [
+        ("a", "a"), ("b", "b")]

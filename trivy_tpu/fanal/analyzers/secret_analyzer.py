@@ -25,8 +25,10 @@ _SKIP_FILES = {"go.sum", "package-lock.json", "yarn.lock", "pnpm-lock.yaml",
                "Pipfile.lock", "poetry.lock", "Cargo.lock", "composer.lock"}
 
 # module-level toggle set by the CLI (--no-tpu). "hybrid" splits the
-# corpus between the device screen and a concurrent host-AC thread —
-# the fastest wall-clock configuration measured on tunneled v5e
+# corpus: device batches dispatch first (async), then the host AC path
+# scans the rest while the chip computes — the fastest wall-clock
+# configuration measured on tunneled v5e (threads were 2x slower; see
+# SecretScanner._scan_files_hybrid)
 USE_DEVICE = "hybrid"
 
 

@@ -68,7 +68,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<heredoc><<-?\s*(?P<hd_tag>\w+)\n)
   | (?P<string>"(?:\$\{[^}]*\}|[^"\\]|\\.)*")
   | (?P<number>-?\d+(?:\.\d+)?)
-  | (?P<ident>[A-Za-z_][\w.\-*\[\]"]*)
+  | (?P<ident>[A-Za-z_][\w.\-*]*(?:\[[^\]\n]*\][\w.\-*]*)*)
   | (?P<op>\|\||&&|==|!=|<=|>=|=>|\?|[+*/%!<>-])
   | (?P<punct>[{}\[\](),=:])
   | (?P<newline>\n)

@@ -117,6 +117,14 @@ def _to_str(v) -> str:
     return str(v)
 
 
+class TfSet(list):
+    """List subclass marking a terraform set value: iteration contexts
+    that distinguish sets from lists (dynamic-block for_each exposes
+    key == value for sets but key == index for lists/tuples) check for
+    this marker. Everywhere else it behaves as the plain list the rest
+    of the evaluator expects."""
+
+
 def _guard(fn):
     """Wrap a function so UNKNOWN arguments yield UNKNOWN."""
 
@@ -168,7 +176,7 @@ FUNCTIONS: dict[str, object] = {
     "tobool": _guard(lambda v: v if isinstance(v, bool)
                      else str(v).lower() == "true"),
     "tolist": _guard(list),
-    "toset": _guard(lambda xs: list(dict.fromkeys(xs))),
+    "toset": _guard(lambda xs: TfSet(dict.fromkeys(xs))),
     "max": _guard(max),
     "min": _guard(min),
     "abs": _guard(abs),
@@ -499,6 +507,10 @@ class Scope:
     data: dict = field(default_factory=dict)  # "type.name" -> Block
     each: tuple | None = None  # (key, value)
     count_index: int | None = None
+    # dynamic-block iterators in scope: name -> (key, value). The name
+    # defaults to the dynamic block's label, overridable via `iterator`
+    # (reference: hcl dynblock expansion in pkg/iac/scanners/terraform)
+    iterators: dict = field(default_factory=dict)
 
     def resolve(self, parts: list[str]):
         head = parts[0]
@@ -525,6 +537,13 @@ class Scope:
             if self.count_index is None or parts[1:2] != ["index"]:
                 return UNKNOWN
             return self.count_index
+        if head in self.iterators:
+            if len(parts) < 2:
+                return UNKNOWN
+            k, v = self.iterators[head]
+            return _walk(k if parts[1] == "key"
+                         else v if parts[1] == "value"
+                         else UNKNOWN, parts[2:])
         if head == "data":
             if len(parts) < 3:
                 return UNKNOWN
@@ -636,7 +655,57 @@ def _eval_block(blk: Block, scope: Scope) -> Block:
     for name, attr in blk.attrs.items():
         out.attrs[name] = Attribute(name, _eval_value(attr.value, scope),
                                     attr.line)
-    out.blocks = [_eval_block(b, scope) for b in blk.blocks]
+    kids: list[Block] = []
+    for b in blk.blocks:
+        if b.type == "dynamic" and len(b.labels) == 1:
+            kids.extend(_expand_dynamic(b, scope))
+        else:
+            kids.append(_eval_block(b, scope))
+    out.blocks = kids
+    return out
+
+
+def _expand_dynamic(b: Block, scope: Scope) -> list[Block]:
+    """`dynamic "L" { for_each = ...; content { ... } }` -> one block of
+    type L per collection element, with the iterator (label or the
+    `iterator` attr) resolving .key/.value inside content (reference:
+    hcl dynblock expansion used by pkg/iac/scanners/terraform). An
+    unresolvable for_each yields ONE instance whose iterator refs stay
+    unknown — checks stay silent rather than wrong, matching the
+    evaluator's general unresolved-value policy."""
+    content = b.child("content")
+    if content is None:
+        return []
+    label = b.labels[0]
+    it_attr = b.attrs.get("iterator")
+    it_name = label
+    if it_attr is not None:
+        v = it_attr.value
+        # a bare identifier parses as an Expr; its text is the name
+        it_name = v if isinstance(v, str) else (
+            v.text if isinstance(v, Expr) else label)
+    coll = UNKNOWN
+    if "for_each" in b.attrs:
+        coll = _eval_value(b.attrs["for_each"].value, scope)
+    if isinstance(coll, dict):
+        items = list(coll.items())
+    elif isinstance(coll, TfSet):
+        items = [(x, x) for x in coll]  # set: key == value (hcl dynblock)
+    elif isinstance(coll, (list, tuple)):
+        items = list(enumerate(coll))  # list/tuple: key == index
+    else:
+        items = None  # unknown
+    proto = Block(type=label, labels=[], attrs=content.attrs,
+                  blocks=content.blocks, start_line=b.start_line,
+                  end_line=b.end_line)
+    proto.src_path = getattr(b, "src_path", "")
+    if items is None:
+        return [_eval_block(proto, scope)]
+    out = []
+    for k, v in items[:MAX_EXPANSION]:
+        s = Scope(**{**scope.__dict__,
+                     "iterators": {**scope.iterators, it_name: (k, v)}})
+        out.append(_eval_block(proto, s))
     return out
 
 

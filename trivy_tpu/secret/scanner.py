@@ -257,7 +257,9 @@ class SecretScanner:
 
     # device share of a hybrid scan: measured v5e-over-tunnel device
     # screen ~50 MB/s vs ~125 MB/s native-AC host -> ~0.3 of the bytes
-    # go to the device while the host thread chews the rest concurrently
+    # dispatch to the device up front; the host scans the rest while the
+    # chip computes (dispatch-first, single thread — see
+    # _scan_files_hybrid)
     HYBRID_DEVICE_SHARE = 0.3
 
     @staticmethod
@@ -401,15 +403,19 @@ class SecretScanner:
                 sf = seg_file[order]
                 kw_rows = hits[seg_chunk[order], n_a:]
                 bounds = np.searchsorted(sf, np.arange(nf + 1))
-                # files without segments (skipped/empty) reduce over an
-                # empty span: reduceat can't express that, so mask after
-                has_seg = bounds[:-1] < bounds[1:]
-                starts_i = np.minimum(bounds[:-1], max(len(sf) - 1, 0))
-                kw_present_f[:] = np.maximum.reduceat(
-                    kw_rows, starts_i, axis=0) & has_seg[:, None]
-                kw_solo_f[:] = np.maximum.reduceat(
-                    kw_rows & seg_solo[order][:, None], starts_i,
-                    axis=0) & has_seg[:, None]
+                # reduce only over files that HAVE segments: their starts
+                # are strictly increasing and each span runs to the next
+                # occupied file's start, so every file's reduction covers
+                # exactly its own segments (clamping empty files' starts
+                # instead would let a trailing segment-less file truncate
+                # its predecessor's span)
+                occ = np.nonzero(bounds[:-1] < bounds[1:])[0]
+                if len(occ):
+                    kw_present_f[occ] = np.maximum.reduceat(
+                        kw_rows, bounds[:-1][occ], axis=0)
+                    kw_solo_f[occ] = np.maximum.reduceat(
+                        kw_rows & seg_solo[order][:, None],
+                        bounds[:-1][occ], axis=0)
             ci, ri = np.nonzero(hits[:, :n_a])
             for c, r in zip(ci.tolist(), ri.tolist()):
                 for fi, file_off, _chunk_off, seg_len in segments[c]:
