@@ -1,0 +1,266 @@
+"""Adapter parity on the reference's own adapter-test fixtures: the HCL
+sources below are lifted from
+/root/reference/pkg/iac/adapters/terraform/aws/*/adapt_test.go ("defined"
+and "defaults" cases). The reference asserts typed provider structs; here
+the same facts are asserted through this repo's scan path (adapters ->
+checks): a fact the reference records as secure must keep the matching
+check silent, and the zero-value default case must trip it."""
+
+from __future__ import annotations
+
+from trivy_tpu.misconf.scanner import scan_terraform_modules
+
+
+def tf_fails(src: str) -> set[str]:
+    out = set()
+    for m in scan_terraform_modules({"main.tf": src.encode()}):
+        out |= {f.id for f in m.failures}
+    return out
+
+
+# ec2/adapt_test.go Test_Adapt "defined": tokens required, endpoint
+# disabled, root block encrypted
+EC2_DEFINED = '''
+resource "aws_instance" "example" {
+  ami = "ami-7f89a64f"
+  instance_type = "t1.micro"
+  root_block_device {
+    encrypted = true
+  }
+  metadata_options {
+    http_tokens = "required"
+    http_endpoint = "disabled"
+  }
+}
+'''
+
+EC2_DEFAULTS = '''
+resource "aws_instance" "example" {
+}
+'''
+
+
+def test_ec2_instance_defined_vs_defaults():
+    ok = tf_fails(EC2_DEFINED)
+    # IMDSv2 enforced + encrypted root: the matching checks stay silent
+    assert "AVD-AWS-0028" not in ok  # enforce-http-token-imds
+    assert "AVD-AWS-0131" not in ok  # encrypted root block device
+    bad = tf_fails(EC2_DEFAULTS)
+    assert "AVD-AWS-0028" in bad
+    assert "AVD-AWS-0131" in bad
+
+
+# cloudtrail/adapt_test.go "configured": multi-region, validation, CMK;
+# note enable_logging = false in the reference fixture
+TRAIL_DEFINED = '''
+resource "aws_cloudtrail" "example" {
+  name = "example"
+  is_multi_region_trail = true
+  enable_log_file_validation = true
+  kms_key_id = "kms-key"
+  s3_bucket_name = "abcdefgh"
+  cloud_watch_logs_group_arn = "abc"
+  enable_logging = false
+}
+'''
+
+TRAIL_DEFAULTS = '''
+resource "aws_cloudtrail" "example" {
+}
+'''
+
+
+def test_cloudtrail_defined_vs_defaults():
+    ok = tf_fails(TRAIL_DEFINED)
+    for cid in ("AVD-AWS-0014",   # multi-region
+                "AVD-AWS-0016",   # log file validation
+                "AVD-AWS-0015"):  # CMK encryption
+        assert cid not in ok, cid
+    bad = tf_fails(TRAIL_DEFAULTS)
+    for cid in ("AVD-AWS-0014", "AVD-AWS-0016", "AVD-AWS-0015"):
+        assert cid in bad, cid
+
+
+# rds/adapt_test.go "defined": storage encrypted + retention 7 on the
+# cluster; instance: retention 5, performance insights with CMK
+RDS_DEFINED = '''
+resource "aws_rds_cluster" "example" {
+  engine                  = "aurora-mysql"
+  availability_zones      = ["us-west-2a", "us-west-2b", "us-west-2c"]
+  backup_retention_period = 7
+  kms_key_id  = "kms_key_1"
+  storage_encrypted = true
+  replication_source_identifier = "arn-of-a-source-db-cluster"
+  deletion_protection = true
+}
+
+resource "aws_db_instance" "example" {
+  publicly_accessible = false
+  backup_retention_period = 5
+  skip_final_snapshot  = true
+  performance_insights_enabled = true
+  performance_insights_kms_key_id = "performance_key_1"
+  storage_encrypted = true
+  kms_key_id = "kms_key_2"
+}
+'''
+
+RDS_DEFAULTS = '''
+resource "aws_rds_cluster" "example" {
+}
+resource "aws_db_instance" "example" {
+}
+'''
+
+
+def test_rds_defined_vs_defaults():
+    ok = tf_fails(RDS_DEFINED)
+    assert "AVD-AWS-0079" not in ok   # instance storage encrypted
+    assert "AVD-AWS-0077" not in ok   # retention > 0 (cluster + instance)
+    bad = tf_fails(RDS_DEFAULTS)
+    assert "AVD-AWS-0079" in bad
+    assert "AVD-AWS-0077" in bad
+
+
+# elasticache/adapt_test.go: replication group with both encryption
+# toggles vs the empty default
+ELASTICACHE_DEFINED = '''
+resource "aws_elasticache_replication_group" "example" {
+  replication_group_id = "foo"
+  replication_group_description = "my foo cluster"
+  transit_encryption_enabled = true
+  at_rest_encryption_enabled = true
+}
+'''
+
+ELASTICACHE_DEFAULTS = '''
+resource "aws_elasticache_replication_group" "example" {
+}
+'''
+
+
+def test_elasticache_defined_vs_defaults():
+    ok = tf_fails(ELASTICACHE_DEFINED)
+    bad = tf_fails(ELASTICACHE_DEFAULTS)
+    assert "AVD-AWS-0045" not in ok  # at-rest encryption set
+    assert "AVD-AWS-0051" not in ok  # in-transit encryption set
+    assert "AVD-AWS-0050" in ok      # no snapshot retention configured
+    assert {"AVD-AWS-0045", "AVD-AWS-0051"} <= bad
+
+
+# efs/adapt_test.go: encrypted file system vs default
+EFS_DEFINED = '''
+resource "aws_efs_file_system" "example" {
+  name       = "bar"
+  encrypted  = true
+  kms_key_id = "my_kms_key"
+}
+'''
+
+EFS_DEFAULTS = '''
+resource "aws_efs_file_system" "example" {
+}
+'''
+
+
+def test_efs_defined_vs_defaults():
+    assert "AVD-AWS-0037" not in tf_fails(EFS_DEFINED)
+    assert "AVD-AWS-0037" in tf_fails(EFS_DEFAULTS)
+
+
+# eks/adapt_test.go "configured": secrets encryption, full logging,
+# private endpoint with restricted CIDR vs the empty default
+EKS_DEFINED = '''
+variable "cluster_arn" { default = "arn:aws:iam::123:role/x" }
+resource "aws_eks_cluster" "example" {
+  encryption_config {
+    resources = [ "secrets" ]
+    provider {
+      key_arn = "key-arn"
+    }
+  }
+  enabled_cluster_log_types = ["api", "authenticator", "audit", "scheduler", "controllerManager"]
+  name = "good_example_cluster"
+  role_arn = var.cluster_arn
+  vpc_config {
+    endpoint_public_access = false
+    public_access_cidrs = ["10.2.0.0/8"]
+  }
+}
+'''
+
+EKS_DEFAULTS = '''
+resource "aws_eks_cluster" "example" {
+}
+'''
+
+
+def test_eks_defined_vs_defaults():
+    ok = tf_fails(EKS_DEFINED)
+    bad = tf_fails(EKS_DEFAULTS)
+    assert "AVD-AWS-0039" not in ok  # secrets encryption configured
+    assert "AVD-AWS-0040" not in ok  # public endpoint disabled
+    assert "AVD-AWS-0038" not in ok  # control-plane logging enabled
+    assert {"AVD-AWS-0038", "AVD-AWS-0039", "AVD-AWS-0040"} <= bad
+
+
+# msk/adapt_test.go "configured": TLS client broker + logging vs default
+MSK_DEFINED = '''
+resource "aws_msk_cluster" "example" {
+  cluster_name = "example"
+  encryption_info {
+    encryption_in_transit {
+      client_broker = "TLS"
+      in_cluster = true
+    }
+    encryption_at_rest_kms_key_arn = "foo-bar-key"
+  }
+  logging_info {
+    broker_logs {
+      cloudwatch_logs {
+        enabled   = true
+        log_group = "test"
+      }
+    }
+  }
+}
+'''
+
+MSK_DEFAULTS = '''
+resource "aws_msk_cluster" "example" {
+}
+'''
+
+
+def test_msk_defined_vs_defaults():
+    ok = tf_fails(MSK_DEFINED)
+    bad = tf_fails(MSK_DEFAULTS)
+    assert "AVD-AWS-0073" not in ok  # client-broker TLS
+    assert "AVD-AWS-0074" not in ok  # broker logging enabled
+    assert "AVD-AWS-0179" not in ok  # at-rest CMK set
+    assert {"AVD-AWS-0073", "AVD-AWS-0074", "AVD-AWS-0179"} <= bad
+
+
+# ec2/adapt.go ebs encryption-by-default: overrides every instance /
+# launch-config device to encrypted, even a bare one
+EBS_DEFAULT_ENC = '''
+resource "aws_ebs_encryption_by_default" "x" {
+  enabled = true
+}
+resource "aws_instance" "example" {
+}
+resource "aws_launch_configuration" "lc" {
+  image_id = "ami-1"
+}
+'''
+
+
+def test_ebs_encryption_by_default_overrides():
+    ok = tf_fails(EBS_DEFAULT_ENC)
+    assert "AVD-AWS-0131" not in ok
+    assert "AVD-AWS-0008" not in ok
+    # without the account default, both fire
+    bare = tf_fails('resource "aws_instance" "example" {}\n'
+                    'resource "aws_launch_configuration" "lc" {\n'
+                    '  image_id = "ami-1"\n}')
+    assert {"AVD-AWS-0131", "AVD-AWS-0008"} <= bare

@@ -52,15 +52,25 @@ def _cfn_tri(props: dict, key: str, default):
 def adapt_terraform_aws_ext(blocks: list[Block]) -> list:
     from trivy_tpu.iac.checks.cloud import CloudResource
 
-    out = []
     res = [b for b in blocks if b.type == "resource" and
            len(b.labels) >= 2]
+    # account-level default EBS encryption overrides every instance /
+    # launch-config block device to encrypted (reference adapters/
+    # terraform/aws/ec2/{adapt,autoscaling}.go: `enabled` NotEqual(false)
+    # — so unset or unresolved counts as enabled)
+    ebs_default_enc = any(
+        _tri(b, "enabled", True) is not False
+        for b in res if b.labels[0] == "aws_ebs_encryption_by_default")
+    out = []
     for b in res:
         t, name = b.labels[0], b.labels[1]
         fn = _TF.get(t)
         if fn is None:
             continue
         rtype, attrs = fn(b)
+        if ebs_default_enc and rtype in ("ec2_instance_ext",
+                                         "launch_config"):
+            attrs["unencrypted_block_device"] = False
         out.append(CloudResource(
             type=rtype, name=f"{t}.{name}", attrs=attrs,
             start_line=b.start_line, end_line=b.end_line))
@@ -188,9 +198,15 @@ def _tf_dynamodb(b):
 
 
 def _tf_launch_config(b):
-    devs = b.children("root_block_device") + b.children(
-        "ebs_block_device")
+    # the reference materializes a root device with encrypted=false even
+    # when the block is absent (adapters/terraform/aws/ec2/
+    # autoscaling.go adaptLaunchConfiguration) — a bare launch
+    # configuration counts as unencrypted
+    roots = b.children("root_block_device")
+    devs = roots + b.children("ebs_block_device")
     encs = [_tri(d, "encrypted", False) for d in devs]
+    if not roots:
+        encs.append(False)
     return "launch_config", {
         "unencrypted_block_device": True if any(e is False for e in encs)
         else (None if any(e is None for e in encs) else False),
@@ -212,9 +228,14 @@ def _tf_launch_template(b):
 
 
 def _tf_instance_ext(b):
-    devs = b.children("root_block_device") + b.children(
-        "ebs_block_device")
+    # the reference adapter materializes a root device even when the
+    # block is absent, with encrypted=false (adapters/terraform/aws/
+    # ec2/adapt.go) — so a bare aws_instance counts as unencrypted
+    roots = b.children("root_block_device")
+    devs = roots + b.children("ebs_block_device")
     encs = [_tri(d, "encrypted", False) for d in devs]
+    if not roots:
+        encs.append(False)
     return "ec2_instance_ext", {
         "unencrypted_block_device": True if any(e is False for e in encs)
         else (None if any(e is None for e in encs) else False),
