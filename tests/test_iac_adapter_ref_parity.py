@@ -264,3 +264,122 @@ def test_ebs_encryption_by_default_overrides():
                     'resource "aws_launch_configuration" "lc" {\n'
                     '  image_id = "ami-1"\n}')
     assert {"AVD-AWS-0131", "AVD-AWS-0008"} <= bare
+
+
+# azure/storage/adapt_test.go "defined": deny-default network rules,
+# https only, TLS1_2, queue logging, no public network access
+AZ_STORAGE_DEFINED = '''
+resource "azurerm_storage_account" "example" {
+  name                     = "storageaccountname"
+  network_rules {
+    default_action             = "Deny"
+    bypass                     = ["Metrics", "AzureServices"]
+  }
+  enable_https_traffic_only = true
+  queue_properties  {
+    logging {
+      delete                = true
+      read                  = true
+      write                 = true
+      version               = "1.0"
+      retention_policy_days = 10
+    }
+  }
+  min_tls_version          = "TLS1_2"
+  public_network_access_enabled = false
+}
+'''
+
+AZ_STORAGE_WEAK = '''
+resource "azurerm_storage_account" "example" {
+  min_tls_version = "TLS1_0"
+  enable_https_traffic_only = false
+}
+'''
+
+
+def test_azure_storage_defined_vs_weak():
+    ok = tf_fails(AZ_STORAGE_DEFINED)
+    weak = tf_fails(AZ_STORAGE_WEAK)
+    assert "AVD-AZU-0008" not in ok   # https enforced
+    assert "AVD-AZU-0009" not in ok   # queue logging configured
+    assert {"AVD-AZU-0008", "AVD-AZU-0009"} <= weak
+    # TLS1_0 must trip the minimum-TLS check only on the weak fixture
+    tls = {c for c in weak - ok if c in ("AVD-AZU-0011", "AVD-AZU-0012")}
+    assert tls, (ok, weak)
+
+
+# google/compute/instances_test.go: shielded VM + CMK boot disk +
+# no public IP vs serial port + IP forwarding + public IP
+GCP_INSTANCE_DEFINED = '''
+resource "google_compute_instance" "example" {
+  name = "test"
+  boot_disk {
+    device_name = "boot-disk"
+    kms_key_self_link = "something"
+  }
+  shielded_instance_config {
+    enable_integrity_monitoring = true
+    enable_vtpm = true
+    enable_secure_boot = true
+  }
+  network_interface {
+    network = "default"
+  }
+  metadata = {
+    enable-oslogin = true
+    block-project-ssh-keys = true
+  }
+}
+'''
+
+GCP_INSTANCE_WEAK = '''
+resource "google_compute_instance" "example" {
+  name = "test"
+  network_interface {
+    access_config {
+    }
+  }
+  can_ip_forward = true
+  metadata = {
+    serial-port-enable = true
+  }
+}
+'''
+
+
+def test_gcp_instance_defined_vs_weak():
+    ok = tf_fails(GCP_INSTANCE_DEFINED)
+    weak = tf_fails(GCP_INSTANCE_WEAK)
+    assert "AVD-GCP-0032" not in ok   # serial port off
+    assert "AVD-GCP-0043" not in ok   # no IP forwarding
+    assert {"AVD-GCP-0032", "AVD-GCP-0043"} <= weak
+
+
+def test_ebs_encryption_by_default_scopes_across_files():
+    """The account default suppresses device findings from sibling .tf
+    files too (reference scopes the lookup across all modules,
+    adapt.go modules.GetResourcesByType)."""
+    files = {
+        "account.tf": b'resource "aws_ebs_encryption_by_default" "x" {\n'
+                      b'  enabled = true\n}\n',
+        "main.tf": b'resource "aws_instance" "example" {}\n',
+    }
+    fails = set()
+    for m in scan_terraform_modules(files):
+        fails |= {f.id for f in m.failures}
+    assert "AVD-AWS-0131" not in fails
+
+
+def test_ebs_encryption_by_default_does_not_leak_across_roots():
+    """An account default in one root module must not suppress findings
+    in an unrelated sibling root (reference scopes to one root tree)."""
+    files = {
+        "stackA/main.tf": b'resource "aws_ebs_encryption_by_default" '
+                          b'"x" {\n  enabled = true\n}\n',
+        "stackB/main.tf": b'resource "aws_instance" "i" {}\n',
+    }
+    fails = set()
+    for m in scan_terraform_modules(files):
+        fails |= {f.id for f in m.failures}
+    assert "AVD-AWS-0131" in fails

@@ -594,3 +594,28 @@ resource "test_resource" "test" {
 '''}, rtype="test_resource")
     assert [(t.get("k"), t.get("v")) for t in b.children("tag")] == [
         ("a", "a"), ("b", "b")]
+
+
+def test_data_source_count_and_for_each():
+    """TestDataSourceWithCountMetaArgument +
+    TestDataSourceWithForEachMetaArgument (parser_test.go:854,887)."""
+    ev = _eval({"main.tf": '''
+data "http" "example" {
+  count = 2
+  url = "https://example.com/${count.index}"
+}
+'''})
+    datas = [b for b in ev.blocks if b.type == "data"]
+    assert [d.get("url") for d in datas] == [
+        "https://example.com/0", "https://example.com/1"]
+    ev = _eval({"main.tf": '''
+data "aws_iam_policy_document" "this" {
+  for_each = toset(["a", "b"])
+  statement {
+    sid = each.key
+  }
+}
+'''})
+    datas = [b for b in ev.blocks if b.type == "data"]
+    assert len(datas) == 2
+    assert {d.child("statement").get("sid") for d in datas} == {"a", "b"}

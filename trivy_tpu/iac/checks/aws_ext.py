@@ -49,7 +49,8 @@ def _cfn_tri(props: dict, key: str, default):
 # ------------------------------------------------------------- terraform
 
 
-def adapt_terraform_aws_ext(blocks: list[Block]) -> list:
+def adapt_terraform_aws_ext(blocks: list[Block],
+                            scan_blocks: list[Block] | None = None) -> list:
     from trivy_tpu.iac.checks.cloud import CloudResource
 
     res = [b for b in blocks if b.type == "resource" and
@@ -57,10 +58,15 @@ def adapt_terraform_aws_ext(blocks: list[Block]) -> list:
     # account-level default EBS encryption overrides every instance /
     # launch-config block device to encrypted (reference adapters/
     # terraform/aws/ec2/{adapt,autoscaling}.go: `enabled` NotEqual(false)
-    # — so unset or unresolved counts as enabled)
+    # — so unset or unresolved counts as enabled). The reference scopes
+    # the lookup across ALL modules of the scan
+    # (modules.GetResourcesByType), so the flag is computed over
+    # scan_blocks when the caller has wider context than this file
     ebs_default_enc = any(
         _tri(b, "enabled", True) is not False
-        for b in res if b.labels[0] == "aws_ebs_encryption_by_default")
+        for b in (scan_blocks if scan_blocks is not None else blocks)
+        if b.type == "resource"
+        and b.labels[:1] == ["aws_ebs_encryption_by_default"])
     out = []
     for b in res:
         t, name = b.labels[0], b.labels[1]
@@ -197,21 +203,26 @@ def _tf_dynamodb(b):
     }
 
 
-def _tf_launch_config(b):
-    # the reference materializes a root device with encrypted=false even
-    # when the block is absent (adapters/terraform/aws/ec2/
-    # autoscaling.go adaptLaunchConfiguration) — a bare launch
-    # configuration counts as unencrypted
+def _block_device_attrs(b) -> dict:
+    """Shared aws_instance / aws_launch_configuration block-device
+    adaptation: the reference materializes a root device with
+    encrypted=false even when the block is absent (adapters/terraform/
+    aws/ec2/{adapt,autoscaling}.go) — a bare resource counts as
+    unencrypted."""
     roots = b.children("root_block_device")
     devs = roots + b.children("ebs_block_device")
     encs = [_tri(d, "encrypted", False) for d in devs]
     if not roots:
         encs.append(False)
-    return "launch_config", {
+    return {
         "unencrypted_block_device": True if any(e is False for e in encs)
         else (None if any(e is None for e in encs) else False),
         "user_data": _v(b.get("user_data")),
     }
+
+
+def _tf_launch_config(b):
+    return "launch_config", _block_device_attrs(b)
 
 
 def _tf_launch_template(b):
@@ -228,19 +239,7 @@ def _tf_launch_template(b):
 
 
 def _tf_instance_ext(b):
-    # the reference adapter materializes a root device even when the
-    # block is absent, with encrypted=false (adapters/terraform/aws/
-    # ec2/adapt.go) — so a bare aws_instance counts as unencrypted
-    roots = b.children("root_block_device")
-    devs = roots + b.children("ebs_block_device")
-    encs = [_tri(d, "encrypted", False) for d in devs]
-    if not roots:
-        encs.append(False)
-    return "ec2_instance_ext", {
-        "unencrypted_block_device": True if any(e is False for e in encs)
-        else (None if any(e is None for e in encs) else False),
-        "user_data": _v(b.get("user_data")),
-    }
+    return "ec2_instance_ext", _block_device_attrs(b)
 
 
 def _tf_nacl_rule(b):

@@ -47,7 +47,13 @@ from trivy_tpu.iac.checks.spec import (  # noqa: E402
 )
 
 
-def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
+def adapt_terraform(blocks: list[Block],
+                    scan_blocks: list[Block] | None = None
+                    ) -> list[CloudResource]:
+    """scan_blocks: every evaluated block of the scan (all files, all
+    modules) for adapters whose reference counterpart reads scan-wide
+    context (e.g. aws_ebs_encryption_by_default); defaults to
+    `blocks`."""
     out: list[CloudResource] = []
     from trivy_tpu.iac.checks.aws_ext import adapt_terraform_aws_ext
     from trivy_tpu.iac.checks.azure_ext import adapt_terraform_azure
@@ -55,7 +61,7 @@ def adapt_terraform(blocks: list[Block]) -> list[CloudResource]:
     from trivy_tpu.iac.checks.gcp_ext import adapt_terraform_gcp_ext
     from trivy_tpu.iac.checks.providers_misc import adapt_terraform_misc
 
-    out.extend(adapt_terraform_aws_ext(blocks))
+    out.extend(adapt_terraform_aws_ext(blocks, scan_blocks))
     out.extend(adapt_terraform_azure(blocks))
     out.extend(adapt_terraform_gcp(blocks))
     out.extend(adapt_terraform_gcp_ext(blocks))

@@ -190,15 +190,26 @@ def scan_terraform_modules(
         return []
     loader = ModuleLoader(tf_files)
     per_file: dict[str, list] = {}
+    # scan-wide adapter context is scoped to the ROOT module tree that
+    # produced each block (reference modules.GetResourcesByType spans
+    # one root + its children, not sibling roots — an account default
+    # in stack A must not suppress findings in unrelated stack B)
+    root_blocks: dict[str, list] = {}
+    path_roots: dict[str, set] = {}
     for d in module_dirs(tf_files, loader=loader):
         ev = evaluate_module(loader.tf_files(d), d, loader)
+        root_blocks[d] = ev.blocks
         for blk in ev.blocks:
             per_file.setdefault(blk.src_path, []).append(blk)
+            path_roots.setdefault(blk.src_path, set()).add(d)
     out: list[Misconfiguration] = []
     for path in sorted(per_file):
         content = files.get(path, b"")
+        scan_blocks = [b for d in sorted(path_roots.get(path, ()))
+                       for b in root_blocks[d]]
         ctxs = [CloudCtx(path=path,
-                         cloud_resources=adapt_terraform(per_file[path]))]
+                         cloud_resources=adapt_terraform(
+                             per_file[path], scan_blocks=scan_blocks))]
         misconf = _run_checks(detection.TERRAFORM, path, ctxs, content)
         if misconf.failures or misconf.successes:
             out.append(misconf)

@@ -129,9 +129,9 @@ class DeviceDB:
     def hot_from_compiled(cls, cdb: CompiledDB,
                           device=None) -> "DeviceDB | None":
         """Hot mid-tier partition (names whose row group exceeds the
-        main window but fits HOT_MID_WINDOW) as its own DeviceDB —
-        matched by the same kernel, only for queries routed to a hot
-        name."""
+        main window but fits the adaptive mid/tall split) as its own
+        DeviceDB — matched by the same kernel, only for queries routed
+        to a hot name."""
         if cdb.hot_h1 is None or len(cdb.hot_h1) == 0:
             return None
         put = functools.partial(jax.device_put, device=device)

@@ -143,8 +143,9 @@ class CompiledDB:
     hot_flags: np.ndarray | None = None
     hot_adv: np.ndarray | None = None
     hot_window: int = 0
-    # tall tier: the few truly giant name groups ("linux"-class, group >
-    # HOT_MID_WINDOW rows). Splitting them out keeps the mid tier's
+    # tall tier: the few truly giant name groups ("linux"-class, group
+    # above the adaptive mid/tall split — between HOT_MID_WINDOW and 4x
+    # it, see compile_db). Splitting them out keeps the mid tier's
     # window — and with it the per-query result transfer (B x window
     # bits) and gather volume — ~6x smaller; only queries for a tall
     # name pay the tall window. The result link may be a ~5 MB/s tunnel,
@@ -481,14 +482,24 @@ def compile_db(db: AdvisoryDB, window: int | None = None) -> CompiledDB:
         return a_h1, a_h2, a_lo, a_hi, a_flags, a_adv
 
     row_h1, row_h2, row_lo, row_hi, row_flags, row_adv = fill(kept)
-    # tier the hot rows: mid groups (<= HOT_MID_WINDOW) vs the few
-    # giant "tall" groups, so a mid-name query never pays the tall
-    # group's window in gather volume or result bytes
+    # tier the hot rows: mid groups vs the giant "tall" groups, so a
+    # mid-name query never pays the tallest group's window in gather
+    # volume or result bytes. The split adapts to the distribution —
+    # the (lower) median hot-group size, floored at HOT_MID_WINDOW —
+    # so roughly half the hot groups pay <= median instead of max;
+    # capped at 4x HOT_MID_WINDOW so one giant group at the median can
+    # never drag small hot groups onto a huge window
+    group_sizes = sorted(counts[h1] for h1 in {r["h1"] for r in hot})
+    split = HOT_MID_WINDOW
+    if group_sizes:
+        split = min(max(HOT_MID_WINDOW,
+                        group_sizes[(len(group_sizes) - 1) // 2]),
+                    4 * HOT_MID_WINDOW)
     mid: list[dict] = []
     tall: list[dict] = []
     tall_names: set = set()
     for r in hot:
-        if counts[r["h1"]] > HOT_MID_WINDOW:
+        if counts[r["h1"]] > split:
             tall.append(r)
             tall_names.add((r["space"], r["name"]))
         else:
