@@ -383,3 +383,26 @@ def test_ebs_encryption_by_default_does_not_leak_across_roots():
     for m in scan_terraform_modules(files):
         fails |= {f.id for f in m.failures}
     assert "AVD-AWS-0131" in fails
+
+
+def test_ebs_default_does_not_leak_into_shared_module():
+    """A module shared by two roots is evaluated per root: stack A's
+    account default must not suppress findings for stack B's
+    instantiation of the same shared module (review repro)."""
+    files = {
+        "stackA/main.tf":
+            b'module "s" { source = "../modules/shared" }\n'
+            b'resource "aws_ebs_encryption_by_default" "x" {\n'
+            b'  enabled = true\n}\n',
+        "stackB/main.tf": b'module "s" { source = "../modules/shared" }\n',
+        "modules/shared/main.tf": b'resource "aws_instance" "i" {}\n',
+    }
+    by_path = {m.file_path: m for m in scan_terraform_modules(files)}
+    shared = by_path.get("modules/shared/main.tf")
+    assert shared is not None
+    ids = {f.id for f in shared.failures}
+    # stack B's instantiation has no account default -> finding stands
+    assert "AVD-AWS-0131" in ids
+    # and it is reported once, not once per root
+    assert sum(1 for f in shared.failures
+               if f.id == "AVD-AWS-0131") == 1

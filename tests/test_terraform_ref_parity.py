@@ -619,3 +619,61 @@ data "aws_iam_policy_document" "this" {
     datas = [b for b in ev.blocks if b.type == "data"]
     assert len(datas) == 2
     assert {d.child("statement").get("sid") for d in datas} == {"a", "b"}
+
+
+def test_module_refers_to_output_of_another_module():
+    """TestModuleRefersToOutputOfAnotherModule (parser_test.go:1662):
+    cross-module output feeding a dynamic block in a sibling module."""
+    ev = _eval({
+        "main.tf": '''
+module "module2" {
+  source = "./modules/foo"
+}
+module "module1" {
+  source = "./modules/bar"
+  test_var = module.module2.test_out
+}
+''',
+        "modules/foo/main.tf": '''
+output "test_out" {
+  value = "test_value"
+}
+''',
+        "modules/bar/main.tf": '''
+variable "test_var" {}
+resource "test_resource" "this" {
+  dynamic "dynamic_block" {
+    for_each = [var.test_var]
+    content {
+      some_attr = dynamic_block.value
+    }
+  }
+}
+''',
+    })
+    res = [b for b in ev.blocks
+           if b.type == "resource" and b.labels[:1] == ["test_resource"]]
+    assert len(res) == 1
+    inner = res[0].child("dynamic_block")
+    assert inner is not None and inner.get("some_attr") == "test_value"
+
+
+def test_extract_set_value_dedupes():
+    """TestExtractSetValue (parser_test.go:1771): toset dedupes while
+    keeping order."""
+    (b,) = _resource({"main.tf": '''
+resource "test" "set-value" {
+  value = toset(["x", "y", "x"])
+}
+'''}, rtype="test")
+    assert list(b.get("value")) == ["x", "y"]
+
+
+def test_count_meta_argument_zero_and_two():
+    """TestCountMetaArgument (parser_test.go:1280)."""
+    assert len(_resource(
+        {"main.tf": 'resource "test" "this" {\n  count = 0\n}'},
+        rtype="test")) == 0
+    assert len(_resource(
+        {"main.tf": 'resource "test" "this" {\n  count = 2\n}'},
+        rtype="test")) == 2

@@ -284,18 +284,27 @@ class MatchEngine:
         hits_by_u: list = []
         fresh: list[PkgQuery] = []
         fresh_u: list[int] = []
+        # registry crawls repeat the SAME PkgQuery instances across
+        # images (shared base-image package lists), so an id() memo in
+        # front of the tuple-key dict answers duplicates with one
+        # int-key get instead of a tuple hash — ids are stable for the
+        # call because `queries` keeps every object alive
+        id_of: dict[int, int] = {}
         for j, q in enumerate(queries):
-            k = q.key
-            u = key_of.get(k)
+            u = id_of.get(id(q))
             if u is None:
-                u = len(uniq)
-                key_of[k] = u
-                uniq.append(q)
-                h = cache.get(k)
-                hits_by_u.append(h)
-                if h is None:
-                    fresh.append(q)
-                    fresh_u.append(u)
+                k = q.key
+                u = key_of.get(k)
+                if u is None:
+                    u = len(uniq)
+                    key_of[k] = u
+                    uniq.append(q)
+                    h = cache.get(k)
+                    hits_by_u.append(h)
+                    if h is None:
+                        fresh.append(q)
+                        fresh_u.append(u)
+                id_of[id(q)] = u
             idx_map[j] = u
 
         # dispatch fresh uniques in device-sized chunks; `depth` chunks

@@ -189,28 +189,29 @@ def scan_terraform_modules(
     if not tf_files:
         return []
     loader = ModuleLoader(tf_files)
-    per_file: dict[str, list] = {}
-    # scan-wide adapter context is scoped to the ROOT module tree that
-    # produced each block (reference modules.GetResourcesByType spans
-    # one root + its children, not sibling roots — an account default
-    # in stack A must not suppress findings in unrelated stack B)
-    root_blocks: dict[str, list] = {}
-    path_roots: dict[str, set] = {}
+    # adapter context is scoped to the ROOT module tree that produced
+    # each block (reference modules.GetResourcesByType spans one root +
+    # its children, not sibling roots — an account default in stack A
+    # must not suppress findings in unrelated stack B). A file shared
+    # by several roots gets one context PER instantiating root, each
+    # carrying only that root's blocks; _run_checks dedupes identical
+    # causes across them.
+    per_file_ctxs: dict[str, list] = {}
     for d in module_dirs(tf_files, loader=loader):
         ev = evaluate_module(loader.tf_files(d), d, loader)
-        root_blocks[d] = ev.blocks
+        by_file: dict[str, list] = {}
         for blk in ev.blocks:
-            per_file.setdefault(blk.src_path, []).append(blk)
-            path_roots.setdefault(blk.src_path, set()).add(d)
+            by_file.setdefault(blk.src_path, []).append(blk)
+        for path, blks in by_file.items():
+            per_file_ctxs.setdefault(path, []).append(CloudCtx(
+                path=path,
+                cloud_resources=adapt_terraform(
+                    blks, scan_blocks=ev.blocks)))
     out: list[Misconfiguration] = []
-    for path in sorted(per_file):
+    for path in sorted(per_file_ctxs):
         content = files.get(path, b"")
-        scan_blocks = [b for d in sorted(path_roots.get(path, ()))
-                       for b in root_blocks[d]]
-        ctxs = [CloudCtx(path=path,
-                         cloud_resources=adapt_terraform(
-                             per_file[path], scan_blocks=scan_blocks))]
-        misconf = _run_checks(detection.TERRAFORM, path, ctxs, content)
+        misconf = _run_checks(detection.TERRAFORM, path,
+                              per_file_ctxs[path], content)
         if misconf.failures or misconf.successes:
             out.append(misconf)
     return out
@@ -248,7 +249,14 @@ def _run_checks(ftype: str, path: str, ctxs: list,
             except Exception:
                 continue  # a broken check must not kill the scan
         kept = []
+        seen: set[tuple] = set()
         for c in causes:
+            # a file shared by several root modules is checked once per
+            # instantiating root: identical causes collapse to one
+            key = (c.message, c.resource, c.start_line, c.end_line)
+            if key in seen:
+                continue
+            seen.add(key)
             res_start, attrs = _enclosing(c)
             if not is_ignored(ignores, chk.id, chk.avd_id,
                               c.start_line, c.end_line,
