@@ -406,3 +406,60 @@ def test_ebs_default_does_not_leak_into_shared_module():
     # and it is reported once, not once per root
     assert sum(1 for f in shared.failures
                if f.id == "AVD-AWS-0131") == 1
+
+
+# ------------------------------------------------ cloudformation side
+
+
+import json as _json
+
+from trivy_tpu.iac import detection
+from trivy_tpu.misconf.scanner import scan_config
+
+
+def cfn_fails(doc: dict) -> set[str]:
+    m = scan_config("template.json", _json.dumps(doc).encode(),
+                    file_type=detection.CLOUDFORMATION)
+    return {f.id for f in m.failures} if m else set()
+
+
+def test_cfn_ec2_instance_block_devices_and_imds():
+    """AWS::EC2::Instance (reference adapters/cloudformation/aws/ec2/
+    instance.go): no BlockDeviceMappings materializes an unencrypted
+    root; CFN cannot set HttpTokens so IMDSv1 always flags."""
+    bare = cfn_fails({"Resources": {"I": {
+        "Type": "AWS::EC2::Instance", "Properties": {}}}})
+    assert "AVD-AWS-0131" in bare
+    assert "AVD-AWS-0028" in bare
+    encrypted = cfn_fails({"Resources": {"I": {
+        "Type": "AWS::EC2::Instance", "Properties": {
+            "BlockDeviceMappings": [
+                {"DeviceName": "/dev/sda1", "Ebs": {"Encrypted": True}}
+            ]}}}})
+    assert "AVD-AWS-0131" not in encrypted
+    assert "AVD-AWS-0028" in encrypted  # not expressible in CFN
+
+
+def test_cfn_elasticache_replication_group():
+    """AWS::ElastiCache::ReplicationGroup (reference adapters/
+    cloudformation/aws/elasticache/replication_group.go)."""
+    bad = cfn_fails({"Resources": {"R": {
+        "Type": "AWS::ElastiCache::ReplicationGroup", "Properties": {}}}})
+    assert {"AVD-AWS-0045", "AVD-AWS-0051"} <= bad
+    good = cfn_fails({"Resources": {"R": {
+        "Type": "AWS::ElastiCache::ReplicationGroup", "Properties": {
+            "TransitEncryptionEnabled": True,
+            "AtRestEncryptionEnabled": True,
+            "SnapshotRetentionLimit": 5}}}})
+    assert "AVD-AWS-0045" not in good
+    assert "AVD-AWS-0051" not in good
+
+
+def test_cfn_elasticache_explicit_zero_retention_flags():
+    """SnapshotRetentionLimit: 0 means backups disabled — the retention
+    check must fire exactly as it does when the property is absent
+    (review repro: bool coercion used to swallow the explicit 0)."""
+    explicit = cfn_fails({"Resources": {"R": {
+        "Type": "AWS::ElastiCache::ReplicationGroup", "Properties": {
+            "SnapshotRetentionLimit": 0}}}})
+    assert "AVD-AWS-0050" in explicit

@@ -622,10 +622,14 @@ def adapt_cloudformation_aws_ext(resources: dict[str, dict]) -> list:
         if fn is None:
             continue
         props = res.get("Properties") or {}
-        ct, attrs = fn(props)
-        out.append(CloudResource(
-            type=ct, name=name, attrs=attrs,
-            start_line=get_line(res), end_line=get_end_line(res)))
+        adapted = fn(props)
+        # an adapter may emit one (rtype, attrs) pair or several
+        if isinstance(adapted, tuple):
+            adapted = [adapted]
+        for ct, attrs in adapted:
+            out.append(CloudResource(
+                type=ct, name=name, attrs=attrs,
+                start_line=get_line(res), end_line=get_end_line(res)))
     return out
 
 
@@ -870,7 +874,66 @@ def _cfn_workspaces(p):
     }
 
 
+def _cfn_ec2_instance(p):
+    """AWS::EC2::Instance (reference adapters/cloudformation/aws/ec2/
+    instance.go): CloudFormation cannot express metadata options, so
+    IMDS stays at the provider default (optional tokens — the check
+    fires); the first BlockDeviceMappings entry is the root device and
+    a missing list materializes an unencrypted root."""
+    devs = p.get("BlockDeviceMappings")
+    encs = []
+    if isinstance(devs, list):
+        for d in devs:
+            if isinstance(d, dict):
+                ebs = d.get("Ebs") or {}
+                encs.append(_cfn_tri(ebs if isinstance(ebs, dict) else {},
+                                     "Encrypted", False))
+    if not encs:
+        encs.append(False)  # materialized unencrypted root
+    unenc = (True if any(e is False for e in encs)
+             else (None if any(e is None for e in encs) else False))
+    # CloudFormation cannot express metadata options (the reference pins
+    # HttpTokens to the "optional" default), so the IMDS check fires on
+    # every CFN instance — the companion ec2_instance resource is what
+    # that check walks
+    return [
+        ("ec2_instance_ext", {"unencrypted_block_device": unenc}),
+        ("ec2_instance", {"http_tokens": None}),
+    ]
+
+
+def _cfn_num(p: dict, key: str, default):
+    """Numeric CFN property: absent -> default, unresolved -> None —
+    without _cfn_tri's bool coercion (0 must stay 0, not become False
+    and slip past numeric checks' bool guards)."""
+    v = p.get(key)
+    if v is None:
+        return default
+    if isinstance(v, dict):
+        v = cfn_scalar(v)
+        if v is None:
+            return None
+    if isinstance(v, bool):
+        return None
+    if isinstance(v, str):
+        try:
+            return float(v)
+        except ValueError:
+            return None
+    return v if isinstance(v, (int, float)) else None
+
+
+def _cfn_elasticache_group(p):
+    return "elasticache_group", {
+        "at_rest": _cfn_tri(p, "AtRestEncryptionEnabled", False),
+        "in_transit": _cfn_tri(p, "TransitEncryptionEnabled", False),
+        "backup_retention": _cfn_num(p, "SnapshotRetentionLimit", 0),
+    }
+
+
 _CFN = {
+    "AWS::EC2::Instance": _cfn_ec2_instance,
+    "AWS::ElastiCache::ReplicationGroup": _cfn_elasticache_group,
     "AWS::ApiGateway::Stage": _cfn_apigw_stage,
     "AWS::ApiGatewayV2::Stage": _cfn_apigw_v2_stage,
     "AWS::CloudFront::Distribution": _cfn_cloudfront,
