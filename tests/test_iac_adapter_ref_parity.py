@@ -144,8 +144,26 @@ def test_elasticache_defined_vs_defaults():
     bad = tf_fails(ELASTICACHE_DEFAULTS)
     assert "AVD-AWS-0045" not in ok  # at-rest encryption set
     assert "AVD-AWS-0051" not in ok  # in-transit encryption set
-    assert "AVD-AWS-0050" in ok      # no snapshot retention configured
+    # retention is a CLUSTER concern: replication groups never produce
+    # the backup-retention finding (reference adaptReplicationGroup
+    # reads only the encryption flags)
+    assert "AVD-AWS-0050" not in ok
+    assert "AVD-AWS-0050" not in bad
     assert {"AVD-AWS-0045", "AVD-AWS-0051"} <= bad
+
+
+def test_elasticache_cluster_retention():
+    """aws_elasticache_cluster (reference adaptCluster): redis with no
+    snapshot retention flags; memcached is exempt."""
+    bad = tf_fails('resource "aws_elasticache_cluster" "c" {\n'
+                   '  engine = "redis"\n}')
+    assert "AVD-AWS-0050" in bad
+    ok = tf_fails('resource "aws_elasticache_cluster" "c" {\n'
+                  '  engine = "redis"\n  snapshot_retention_limit = 5\n}')
+    assert "AVD-AWS-0050" not in ok
+    memc = tf_fails('resource "aws_elasticache_cluster" "c" {\n'
+                    '  engine = "memcached"\n}')
+    assert "AVD-AWS-0050" not in memc
 
 
 # efs/adapt_test.go: encrypted file system vs default
@@ -442,24 +460,58 @@ def test_cfn_ec2_instance_block_devices_and_imds():
 
 def test_cfn_elasticache_replication_group():
     """AWS::ElastiCache::ReplicationGroup (reference adapters/
-    cloudformation/aws/elasticache/replication_group.go)."""
+    cloudformation/aws/elasticache/replication_group.go): encryption
+    flags only — no retention finding on replication groups."""
     bad = cfn_fails({"Resources": {"R": {
         "Type": "AWS::ElastiCache::ReplicationGroup", "Properties": {}}}})
     assert {"AVD-AWS-0045", "AVD-AWS-0051"} <= bad
+    assert "AVD-AWS-0050" not in bad
     good = cfn_fails({"Resources": {"R": {
         "Type": "AWS::ElastiCache::ReplicationGroup", "Properties": {
             "TransitEncryptionEnabled": True,
-            "AtRestEncryptionEnabled": True,
-            "SnapshotRetentionLimit": 5}}}})
+            "AtRestEncryptionEnabled": True}}}})
     assert "AVD-AWS-0045" not in good
     assert "AVD-AWS-0051" not in good
 
 
-def test_cfn_elasticache_explicit_zero_retention_flags():
-    """SnapshotRetentionLimit: 0 means backups disabled — the retention
-    check must fire exactly as it does when the property is absent
-    (review repro: bool coercion used to swallow the explicit 0)."""
-    explicit = cfn_fails({"Resources": {"R": {
-        "Type": "AWS::ElastiCache::ReplicationGroup", "Properties": {
-            "SnapshotRetentionLimit": 0}}}})
-    assert "AVD-AWS-0050" in explicit
+def test_cfn_cache_cluster_retention():
+    """AWS::ElastiCache::CacheCluster (reference adapters/
+    cloudformation/aws/elasticache/cluster.go): retention findings live
+    on clusters; an explicit 0 flags just like an absent property
+    (numeric extraction must not coerce 0 to False)."""
+    for props in ({}, {"SnapshotRetentionLimit": 0}):
+        bad = cfn_fails({"Resources": {"C": {
+            "Type": "AWS::ElastiCache::CacheCluster",
+            "Properties": {"Engine": "redis", **props}}}})
+        assert "AVD-AWS-0050" in bad, props
+    ok = cfn_fails({"Resources": {"C": {
+        "Type": "AWS::ElastiCache::CacheCluster",
+        "Properties": {"Engine": "redis",
+                       "SnapshotRetentionLimit": 5}}}})
+    assert "AVD-AWS-0050" not in ok
+
+
+def test_cfn_instance_inherits_hardened_launch_template():
+    """An instance whose LaunchTemplate resolves adopts the template's
+    IMDS and block-device config (reference findRelatedLaunchTemplate)."""
+    doc = {"Resources": {
+        "LT": {"Type": "AWS::EC2::LaunchTemplate", "Properties": {
+            "LaunchTemplateName": "hardened",
+            "LaunchTemplateData": {
+                "MetadataOptions": {"HttpTokens": "required"},
+                "BlockDeviceMappings": [
+                    {"Ebs": {"Encrypted": True}}],
+            }}},
+        "I": {"Type": "AWS::EC2::Instance", "Properties": {
+            "LaunchTemplate": {"LaunchTemplateName": "hardened"}}},
+    }}
+    ids = cfn_fails(doc)
+    assert "AVD-AWS-0028" not in ids
+    assert "AVD-AWS-0131" not in ids
+    # by logical id, and by the canonical {"Ref": ...} form too
+    for ltid in ("LT", {"Ref": "LT"}):
+        doc["Resources"]["I"]["Properties"]["LaunchTemplate"] = {
+            "LaunchTemplateId": ltid}
+        ids = cfn_fails(doc)
+        assert "AVD-AWS-0028" not in ids, ltid
+        assert "AVD-AWS-0131" not in ids, ltid

@@ -212,10 +212,12 @@ AWS_TF_CASES = [
      'resource "aws_elasticache_replication_group" "g" {\n'
      '  transit_encryption_enabled = true\n}'),
     ("AVD-AWS-0050",
-     'resource "aws_elasticache_replication_group" "g" {\n'
-     '  snapshot_retention_limit = 0\n}',
-     'resource "aws_elasticache_replication_group" "g" {\n'
-     '  snapshot_retention_limit = 5\n}'),
+     # retention is a CLUSTER concern (reference adaptCluster);
+     # replication groups never produce this finding
+     'resource "aws_elasticache_cluster" "c" {\n'
+     '  engine = "redis"\n  snapshot_retention_limit = 0\n}',
+     'resource "aws_elasticache_cluster" "c" {\n'
+     '  engine = "redis"\n  snapshot_retention_limit = 5\n}'),
     ("AVD-AWS-0048",
      'resource "aws_elasticsearch_domain" "d" {}',
      'resource "aws_elasticsearch_domain" "d" {\n'

@@ -677,3 +677,41 @@ def test_count_meta_argument_zero_and_two():
     assert len(_resource(
         {"main.tf": 'resource "test" "this" {\n  count = 2\n}'},
         rtype="test")) == 2
+
+
+def test_passing_null_to_child_module_keeps_null():
+    """Test_PassingNullToChildModule_DoesNotEraseType
+    (parser_test.go:2089): `test_var = null` reaches the child as a real
+    null, so `var.test_var != null ? 1 : 2` picks 2."""
+    ev = _eval({
+        "main.tf": '''
+module "test" {
+  source   = "./modules/test"
+  test_var = null
+}
+''',
+        "modules/test/main.tf": '''
+variable "test_var" {}
+resource "foo" "this" {
+  bar = var.test_var != null ? 1 : 2
+}
+''',
+    })
+    (b,) = [x for x in ev.blocks
+            if x.type == "resource" and x.labels[:1] == ["foo"]]
+    assert b.get("bar") == 2
+
+
+def test_attr_ref_to_null_variable():
+    """TestAttrRefToNullVariable (parser_test.go:2165): a null default
+    resolves to a real null value, not unknown."""
+    (b,) = _resource({"main.tf": '''
+variable "name" {
+  type    = string
+  default = null
+}
+resource "aws_s3_bucket" "example" {
+  bucket = var.name
+}
+'''}, rtype="aws_s3_bucket")
+    assert b.get("bucket") is None

@@ -333,10 +333,12 @@ def _tf_eks_ext(b):
 
 
 def _tf_elasticache_redis(b):
+    # encryption flags only: the reference adapts snapshot retention
+    # for clusters, not replication groups (adapters/terraform/aws/
+    # elasticache/adapt.go adaptReplicationGroup)
     return "elasticache_group", {
         "at_rest": _tri(b, "at_rest_encryption_enabled", False),
         "in_transit": _tri(b, "transit_encryption_enabled", False),
-        "backup_retention": _tri(b, "snapshot_retention_limit", 0),
     }
 
 
@@ -619,10 +621,16 @@ def adapt_cloudformation_aws_ext(resources: dict[str, dict]) -> list:
     for name, res in resources.items():
         rtype = str(res.get("Type", ""))
         fn = _CFN.get(rtype)
-        if fn is None:
+        ctx_fn = _CFN_CTX.get(rtype)
+        if fn is None and ctx_fn is None:
             continue
         props = res.get("Properties") or {}
-        adapted = fn(props)
+        if ctx_fn is not None:
+            # context adapters also see the full resource map (e.g. to
+            # resolve launch-template references)
+            adapted = ctx_fn(props, resources)
+        else:
+            adapted = fn(props)
         # an adapter may emit one (rtype, attrs) pair or several
         if isinstance(adapted, tuple):
             adapted = [adapted]
@@ -874,13 +882,7 @@ def _cfn_workspaces(p):
     }
 
 
-def _cfn_ec2_instance(p):
-    """AWS::EC2::Instance (reference adapters/cloudformation/aws/ec2/
-    instance.go): CloudFormation cannot express metadata options, so
-    IMDS stays at the provider default (optional tokens — the check
-    fires); the first BlockDeviceMappings entry is the root device and
-    a missing list materializes an unencrypted root."""
-    devs = p.get("BlockDeviceMappings")
+def _cfn_device_encs(devs) -> list:
     encs = []
     if isinstance(devs, list):
         for d in devs:
@@ -888,17 +890,69 @@ def _cfn_ec2_instance(p):
                 ebs = d.get("Ebs") or {}
                 encs.append(_cfn_tri(ebs if isinstance(ebs, dict) else {},
                                      "Encrypted", False))
+    return encs
+
+
+def _cfn_find_launch_template(lt: dict, resources: dict) -> dict | None:
+    """Resolve Properties.LaunchTemplate -> the referenced
+    AWS::EC2::LaunchTemplate's LaunchTemplateData (reference
+    findRelatedLaunchTemplate: by LaunchTemplateName string match, else
+    by LaunchTemplateId as a logical id; unresolvable refs fall
+    through)."""
+    name = lt.get("LaunchTemplateName")
+    if isinstance(name, str):
+        for res in resources.values():
+            if str(res.get("Type", "")) != "AWS::EC2::LaunchTemplate":
+                continue
+            props = res.get("Properties") or {}
+            if props.get("LaunchTemplateName") == name:
+                data = props.get("LaunchTemplateData")
+                return data if isinstance(data, dict) else {}
+    ltid = lt.get("LaunchTemplateId")
+    if isinstance(ltid, dict):
+        # canonical same-template reference: {"Ref": "LogicalId"}
+        ref = ltid.get("Ref")
+        ltid = ref if isinstance(ref, str) else None
+    if isinstance(ltid, str) and ltid in resources:
+        res = resources[ltid]
+        if str(res.get("Type", "")) == "AWS::EC2::LaunchTemplate":
+            props = res.get("Properties") or {}
+            data = props.get("LaunchTemplateData")
+            return data if isinstance(data, dict) else {}
+    return None
+
+
+def _cfn_ec2_instance(p, resources=None):
+    """AWS::EC2::Instance (reference adapters/cloudformation/aws/ec2/
+    instance.go): an instance config comes from its launch template
+    when one resolves; otherwise CloudFormation cannot express metadata
+    options, so IMDS stays at the provider default (optional tokens —
+    the check fires), and the first BlockDeviceMappings entry is the
+    root device with a missing list materializing an unencrypted
+    root."""
+    tokens = None  # None = not configured -> IMDS check fires
+    lt = p.get("LaunchTemplate")
+    data = None
+    if isinstance(lt, dict) and resources:
+        data = _cfn_find_launch_template(lt, resources)
+    if data is not None:
+        # the reference replaces the instance wholesale with the
+        # template's adaptation (instance = launchTemplate.Instance)
+        opts = data.get("MetadataOptions")
+        if isinstance(opts, dict):
+            tokens = _cfn_tri(opts, "HttpTokens", "optional")
+        else:
+            tokens = "optional"
+        encs = _cfn_device_encs(data.get("BlockDeviceMappings"))
+    else:
+        encs = _cfn_device_encs(p.get("BlockDeviceMappings"))
     if not encs:
         encs.append(False)  # materialized unencrypted root
     unenc = (True if any(e is False for e in encs)
              else (None if any(e is None for e in encs) else False))
-    # CloudFormation cannot express metadata options (the reference pins
-    # HttpTokens to the "optional" default), so the IMDS check fires on
-    # every CFN instance — the companion ec2_instance resource is what
-    # that check walks
     return [
         ("ec2_instance_ext", {"unencrypted_block_device": unenc}),
-        ("ec2_instance", {"http_tokens": None}),
+        ("ec2_instance", {"http_tokens": tokens}),
     ]
 
 
@@ -924,16 +978,32 @@ def _cfn_num(p: dict, key: str, default):
 
 
 def _cfn_elasticache_group(p):
+    # the reference adapts only the two encryption flags for
+    # replication groups (adapters/cloudformation/aws/elasticache/
+    # replication_group.go); snapshot retention is a CLUSTER concern
     return "elasticache_group", {
         "at_rest": _cfn_tri(p, "AtRestEncryptionEnabled", False),
         "in_transit": _cfn_tri(p, "TransitEncryptionEnabled", False),
+    }
+
+
+def _cfn_elasticache_cluster(p):
+    """AWS::ElastiCache::CacheCluster (reference adapters/
+    cloudformation/aws/elasticache/cluster.go)."""
+    return "elasticache_cluster", {
+        "engine": cfn_scalar(p.get("Engine")),
         "backup_retention": _cfn_num(p, "SnapshotRetentionLimit", 0),
     }
 
 
-_CFN = {
+# adapters that need the whole resource map (cross-resource resolution)
+_CFN_CTX = {
     "AWS::EC2::Instance": _cfn_ec2_instance,
+}
+
+_CFN = {
     "AWS::ElastiCache::ReplicationGroup": _cfn_elasticache_group,
+    "AWS::ElastiCache::CacheCluster": _cfn_elasticache_cluster,
     "AWS::ApiGateway::Stage": _cfn_apigw_stage,
     "AWS::ApiGatewayV2::Stage": _cfn_apigw_v2_stage,
     "AWS::CloudFront::Distribution": _cfn_cloudfront,
