@@ -248,6 +248,169 @@ def _native_collect_active() -> bool:
     return ncollect.available()
 
 
+# --------------------------------------------------- micro validation
+
+_MICRO_PREP = r'''
+import numpy as np, jax
+jax.config.update("jax_platforms", "cpu")
+from trivy_tpu.tensorize.synth import synth_trivy_db, synth_queries
+from trivy_tpu.tensorize.compile import compile_db
+from trivy_tpu.ops import match as m
+from trivy_tpu.ops import secret_nfa as sn
+from trivy_tpu.secret.scanner import SecretScanner
+
+db = synth_trivy_db(n_advisories=120000)
+cdb = compile_db(db)
+qs = synth_queries(db, 8192, seed=7)
+pb = cdb.encode_packages([(q.space, q.name, q.version, q.scheme_name)
+                          for q in qs])
+ddb = m.DeviceDB.from_compiled(cdb)
+words = m.match_dispatch(ddb, pb).collect_words()
+sc = SecretScanner(); sc._ensure_tiers()
+bank = sc._tiers["bank"]
+rng = np.random.default_rng(3)
+chunks = rng.integers(9, 126, size=(256, sn.CHUNK)).astype(np.uint8)
+run = sn._anchor_kernel(bank.n, bank.words, bank.rw)
+sec = np.asarray(run(chunks, bank.table, bank.bit_word, bank.bit_idx,
+                     bank.active))
+np.savez(r"%(npz)s", row_h1=cdb.row_h1, table=np.asarray(ddb.table),
+         h1=pb.h1, h2=pb.h2, rank=pb.rank, flags=pb.flags,
+         window=np.int64(cdb.window), expect_words=words,
+         chunks=chunks, sec_expect=sec, b_table=bank.table,
+         b_word=bank.bit_word, b_idx=bank.bit_idx, b_act=bank.active,
+         b_n=np.int64(bank.n), b_words=np.int64(bank.words),
+         b_rw=np.int64(bank.rw))
+print("PREP_OK")
+'''
+
+_MICRO_ATTEMPT = r'''
+import json, time, numpy as np
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+assert d.platform != "cpu", d
+z = np.load(r"%(npz)s")
+from trivy_tpu.ops import match as m
+from trivy_tpu.ops import secret_nfa as sn
+from trivy_tpu.ops.match import DeviceDB
+from trivy_tpu.tensorize.compile import PackageBatch
+
+window = int(z["window"])
+ddb = DeviceDB(h1=jax.device_put(z["row_h1"]),
+               table=jax.device_put(z["table"]),
+               n_rows=len(z["row_h1"]), window=window)
+pb = PackageBatch(h1=z["h1"], h2=z["h2"], rank=z["rank"],
+                  flags=z["flags"], queries=[None] * len(z["h1"]))
+w0 = m.match_dispatch(ddb, pb).collect_words()  # warm/compile
+t0 = time.time()
+pends = [m.match_dispatch(ddb, pb) for _ in range(4)]
+outs = [p.collect_words() for p in pends]
+per_batch = (time.time() - t0) / 4
+ok = (np.array_equal(w0, z["expect_words"])
+      and all(np.array_equal(o, z["expect_words"]) for o in outs))
+base = {
+    "kind": "tpu_micro_validation", "platform": d.platform,
+    "device": str(d), "n_queries": int(len(z["h1"])),
+    "db_rows": int(len(z["row_h1"])), "window": window,
+    "match_bitexact_vs_cpu": bool(ok),
+    "match_pipelined_ms_per_batch": round(per_batch * 1e3, 1),
+    "match_pkg_per_s_pipelined": round(len(z["h1"]) / per_batch),
+}
+print(json.dumps(dict(base, partial="match_only")), flush=True)
+run = sn._anchor_kernel(int(z["b_n"]), int(z["b_words"]), int(z["b_rw"]))
+args = (jnp.asarray(z["chunks"]), jnp.asarray(z["b_table"]),
+        jnp.asarray(z["b_word"]), jnp.asarray(z["b_idx"]),
+        jnp.asarray(z["b_act"]))
+sw = np.asarray(run(*args))
+t0 = time.time()
+outs2 = [run(*args) for _ in range(4)]
+for o in outs2:
+    try:
+        o.copy_to_host_async()
+    except AttributeError:
+        pass
+res2 = [np.asarray(o) for o in outs2]
+sec_s = (time.time() - t0) / 4
+sec_ok = (np.array_equal(sw, z["sec_expect"])
+          and all(np.array_equal(r, z["sec_expect"]) for r in res2))
+base["secret_bitexact_vs_cpu"] = bool(sec_ok)
+base["secret_device_mb_per_s_pipelined"] = round(
+    z["chunks"].size / 1e6 / sec_s, 1)
+print(json.dumps(base))
+'''
+
+
+def _micro_validation(budget_s: float) -> dict | None:
+    """Flapping-tunnel fallback evidence: when the full bench cannot
+    hold the accelerator, hunt (within budget) for a short window and
+    run the match + anchor kernels on silicon against CPU-precomputed
+    expected outputs (pure int kernels are bit-exact across backends).
+    Returns the validation dict, possibly partial, or None."""
+    import subprocess
+    import tempfile
+
+    fd, npz = tempfile.mkstemp(prefix="trivy_tpu_micro_", suffix=".npz")
+    os.close(fd)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _MICRO_PREP % {"npz": npz}],
+            env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return None
+    if "PREP_OK" not in (r.stdout or ""):
+        return None
+    deadline = time.time() + budget_s
+    best: dict | None = None
+    try:
+        return _micro_hunt(npz, deadline)
+    finally:
+        try:
+            os.remove(npz)
+        except OSError:
+            pass
+
+
+def _micro_hunt(npz: str, deadline: float) -> dict | None:
+    import subprocess
+
+    best: dict | None = None
+    while time.time() < deadline:
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC], timeout=35,
+                capture_output=True, text=True)
+            alive = probe.returncode == 0 and any(
+                ln.startswith("PROBE_OK ") and not ln.endswith(" cpu")
+                for ln in probe.stdout.splitlines())
+        except subprocess.TimeoutExpired:
+            alive = False
+        if alive:
+            stdout = ""
+            try:
+                at = subprocess.run(
+                    [sys.executable, "-c",
+                     _MICRO_ATTEMPT % {"npz": npz}],
+                    capture_output=True, text=True,
+                    timeout=min(300, max(deadline - time.time(), 60)))
+                stdout = at.stdout or ""
+            except subprocess.TimeoutExpired as e:
+                stdout = e.stdout or b""
+                if isinstance(stdout, bytes):
+                    stdout = stdout.decode("utf-8", "replace")
+            for ln in reversed([ln for ln in stdout.splitlines()
+                                if ln.startswith("{")]):
+                try:
+                    best = json.loads(ln)
+                    break
+                except ValueError:
+                    continue  # truncated write when the window closed
+            if best is not None and "secret_bitexact_vs_cpu" in best:
+                return best  # full validation
+        time.sleep(10)
+    return best
+
+
 def _run_supervised(device_status: str) -> int:
     """Run the measured body in a CHILD process with a hard deadline.
 
@@ -265,10 +428,13 @@ def _run_supervised(device_status: str) -> int:
     run_timeout = float(os.environ.get("TRIVY_TPU_BENCH_RUN_TIMEOUT",
                                        "1500"))
 
+    got_tpu = False
+
     def attempt(extra_env: dict, status: str) -> int | None:
         """None = no usable result (timeout, crash, or no metric line)
         -> caller falls through to the CPU rerun. A clean child (even
         rc=1 from an oracle diff) forwards its line and returncode."""
+        nonlocal got_tpu
         env = {**os.environ, "TRIVY_TPU_BENCH_CHILD": "1",
                "TRIVY_TPU_BENCH_DEVICE_STATUS": status, **extra_env}
         if extra_env.get("TRIVY_TPU_FORCE_CPU"):
@@ -293,6 +459,7 @@ def _run_supervised(device_status: str) -> int:
             print(f"BENCH_STATUS=child_died rc={proc.returncode}",
                   file=sys.stderr)
             return None
+        got_tpu = '"platform": "tpu"' in proc.stdout
         sys.stdout.write(proc.stdout)
         sys.stdout.flush()
         return proc.returncode
@@ -304,10 +471,7 @@ def _run_supervised(device_status: str) -> int:
         # sitecustomize platform pin; only the config route works)
         first_env = {"JAX_PLATFORMS": "cpu", "TRIVY_TPU_FORCE_CPU": "1"}
     rc = attempt(first_env, device_status)
-    if rc is not None:
-        return rc
-    rc = None
-    if not first_env.get("TRIVY_TPU_FORCE_CPU"):
+    if rc is None and not first_env.get("TRIVY_TPU_FORCE_CPU"):
         # the accelerator wedged mid-run: rerun on CPU so the driver
         # still gets a (clearly-labelled) result line. A first attempt
         # that was ALREADY CPU-forced failed deterministically — an
@@ -321,7 +485,22 @@ def _run_supervised(device_status: str) -> int:
             "unit": "pkg/s", "vs_baseline": 0, "platform": "none",
             "device_status": "bench_failed",
         }))
-        return 1
+        sys.stdout.flush()
+        rc = 1
+    if not got_tpu and device_status != "absent":
+        # the full run never held the accelerator (the result line
+        # above is CPU-labelled — initial wedge OR mid-run drop): a
+        # flapping tunnel may still offer short windows — hunt for one
+        # and attach bit-exact kernel evidence from real silicon. Runs
+        # AFTER the result line so a supervisor kill cannot cost the
+        # driver its metric. "absent" means the probe answered
+        # definitively that this host has no accelerator — hunting
+        # would be pure waste there.
+        budget = float(os.environ.get("TRIVY_TPU_MICRO_WAIT", "600"))
+        micro = _micro_validation(budget)
+        if micro is not None:
+            print("TPU_MICRO_VALIDATION " + json.dumps(micro),
+                  file=sys.stderr)
     return rc
 
 
