@@ -70,6 +70,23 @@ def _reset_device_state(attempt: int) -> None:
     # client-side (the axon relay lives outside this container)
 
 
+# snapshot of the accelerator-relevant env BEFORE _ensure_device's
+# CPU-fallback mutation, so the micro hunt's subprocesses can still
+# reach the tunnel after the parent pinned itself to CPU
+_ACCEL_ENV_KEYS = ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
+_ORIG_ACCEL_ENV = {k: os.environ.get(k) for k in _ACCEL_ENV_KEYS}
+
+
+def _accel_env() -> dict:
+    env = {**os.environ}
+    for k, v in _ORIG_ACCEL_ENV.items():
+        if v is None:
+            env.pop(k, None)
+        else:
+            env[k] = v
+    return env
+
+
 def _ensure_device() -> str:
     """Acquire a usable jax backend; returns a status string.
 
@@ -285,7 +302,15 @@ print("PREP_OK")
 
 _MICRO_ATTEMPT = r'''
 import json, time, numpy as np
-import jax, jax.numpy as jnp
+import jax
+# persistent compile cache: a window that closes mid-attempt still
+# banks its kernel compilations, so the next window skips straight to
+# execution (first TPU compiles cost tens of seconds over a tunnel —
+# possibly longer than a flapping window stays open)
+jax.config.update("jax_compilation_cache_dir",
+                  r"%(npz)s" + ".jaxcache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+import jax.numpy as jnp
 d = jax.devices()[0]
 assert d.platform != "cpu", d
 z = np.load(r"%(npz)s")
@@ -350,35 +375,43 @@ def _micro_validation(budget_s: float) -> dict | None:
 
     fd, npz = tempfile.mkstemp(prefix="trivy_tpu_micro_", suffix=".npz")
     os.close(fd)
+    # the budget covers prep + hunt so the post-result phase stays
+    # bounded by TRIVY_TPU_MICRO_WAIT for the driver's supervisor
+    deadline = time.time() + budget_s
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env.pop("PALLAS_AXON_POOL_IPS", None)
     try:
         r = subprocess.run(
             [sys.executable, "-c", _MICRO_PREP % {"npz": npz}],
-            env=env, capture_output=True, text=True, timeout=600)
+            env=env, capture_output=True, text=True,
+            timeout=max(deadline - time.time(), 30))
     except subprocess.TimeoutExpired:
         return None
     if "PREP_OK" not in (r.stdout or ""):
         return None
-    deadline = time.time() + budget_s
-    best: dict | None = None
     try:
         return _micro_hunt(npz, deadline)
     finally:
+        import shutil
+
         try:
             os.remove(npz)
         except OSError:
             pass
+        shutil.rmtree(npz + ".jaxcache", ignore_errors=True)
 
 
 def _micro_hunt(npz: str, deadline: float) -> dict | None:
     import subprocess
 
     best: dict | None = None
+    # the parent may have pinned itself to CPU after a failed probe —
+    # the hunt's children need the ORIGINAL accelerator env
+    env = _accel_env()
     while time.time() < deadline:
         try:
             probe = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC], timeout=35,
+                [sys.executable, "-c", _PROBE_SRC], timeout=35, env=env,
                 capture_output=True, text=True)
             alive = probe.returncode == 0 and any(
                 ln.startswith("PROBE_OK ") and not ln.endswith(" cpu")
@@ -391,7 +424,7 @@ def _micro_hunt(npz: str, deadline: float) -> dict | None:
                 at = subprocess.run(
                     [sys.executable, "-c",
                      _MICRO_ATTEMPT % {"npz": npz}],
-                    capture_output=True, text=True,
+                    capture_output=True, text=True, env=env,
                     timeout=min(300, max(deadline - time.time(), 60)))
                 stdout = at.stdout or ""
             except subprocess.TimeoutExpired as e:
@@ -459,7 +492,9 @@ def _run_supervised(device_status: str) -> int:
             print(f"BENCH_STATUS=child_died rc={proc.returncode}",
                   file=sys.stderr)
             return None
-        got_tpu = '"platform": "tpu"' in proc.stdout
+        got_tpu = ('"platform":' in proc.stdout
+                   and '"platform": "cpu"' not in proc.stdout
+                   and '"platform": "none"' not in proc.stdout)
         sys.stdout.write(proc.stdout)
         sys.stdout.flush()
         return proc.returncode
@@ -487,15 +522,16 @@ def _run_supervised(device_status: str) -> int:
         }))
         sys.stdout.flush()
         rc = 1
-    if not got_tpu and device_status != "absent":
+    if not got_tpu and device_status in ("wedged", "error", "ok"):
         # the full run never held the accelerator (the result line
-        # above is CPU-labelled — initial wedge OR mid-run drop): a
-        # flapping tunnel may still offer short windows — hunt for one
-        # and attach bit-exact kernel evidence from real silicon. Runs
-        # AFTER the result line so a supervisor kill cannot cost the
-        # driver its metric. "absent" means the probe answered
-        # definitively that this host has no accelerator — hunting
-        # would be pure waste there.
+        # above is CPU-labelled — initial wedge OR mid-run drop, where
+        # the probe had said "ok"): a flapping tunnel may still offer
+        # short windows — hunt for one and attach bit-exact kernel
+        # evidence from real silicon. Runs AFTER the result line so a
+        # supervisor kill cannot cost the driver its metric. "absent"
+        # (no accelerator on this host) and "unprobed"
+        # (TRIVY_TPU_BENCH_NO_PROBE — the operator opted out of device
+        # probing) skip the hunt.
         budget = float(os.environ.get("TRIVY_TPU_MICRO_WAIT", "600"))
         micro = _micro_validation(budget)
         if micro is not None:
