@@ -425,3 +425,12 @@ class TestHybridMode:
             lambda self_, e: called.append(1) or [])
         scanner.scan_files(_corpus(seed=14), use_device="hybrid")
         assert not called, "hybrid path must not run without accelerator"
+
+
+def test_secret_analyzer_version_tracks_kernel():
+    """Cache invalidation soundness (SURVEY hard part 4): the secret
+    analyzer's cache-key version moves with the anchor kernel's."""
+    from trivy_tpu.fanal.analyzers.secret_analyzer import SecretAnalyzer
+    from trivy_tpu.ops.secret_nfa import KERNEL_VERSION
+
+    assert SecretAnalyzer.version == 1000 + KERNEL_VERSION

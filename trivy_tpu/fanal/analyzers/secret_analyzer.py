@@ -14,6 +14,7 @@ from trivy_tpu.fanal.analyzer import (
     register_post,
 )
 from trivy_tpu.log import logger
+from trivy_tpu.ops.secret_nfa import KERNEL_VERSION
 from trivy_tpu.secret.scanner import SecretConfig, SecretScanner
 
 _log = logger("secret")
@@ -32,10 +33,19 @@ _SKIP_FILES = {"go.sum", "package-lock.json", "yarn.lock", "pnpm-lock.yaml",
 USE_DEVICE = "hybrid"
 
 
+# bump on host-side semantic changes (rules, scanner behavior); the
+# kernel component below covers device-screen changes
+_ANALYZER_BASE = 1
+
+
 @register_post
 class SecretAnalyzer(PostAnalyzer):
     type = "secret"
-    version = 1
+    # the cache key must change when EITHER the host scanner or the
+    # device screen's semantics do (reference invalidates on analyzer
+    # version, cache/key.go; here the "analyzer" includes the anchor
+    # kernel — SURVEY hard part 4)
+    version = _ANALYZER_BASE * 1000 + KERNEL_VERSION
 
     def __init__(self, config_path: str | None = None):
         self._scanner = None

@@ -45,6 +45,13 @@ CHUNK = 16384
 K_ANCHOR = 8
 MAX_CLASS_WORDS = 4  # up to 128 distinct byte classes per bank
 
+# bump on any change to what the screen can MATCH (anchor extraction,
+# kernel semantics, chunking/packing) — the secret analyzer folds this
+# into its cache-key version so cached blob results from an older
+# screen are re-scanned (SURVEY §7 hard part 4: "analyzer version"
+# must include kernel versions for invalidation to stay sound)
+KERNEL_VERSION = 3
+
 
 # ----------------------------------------------------- class sequences
 
