@@ -515,3 +515,59 @@ def test_cfn_instance_inherits_hardened_launch_template():
         ids = cfn_fails(doc)
         assert "AVD-AWS-0028" not in ids, ltid
         assert "AVD-AWS-0131" not in ids, ltid
+
+
+def test_cfn_eks_defined_vs_defaults():
+    """AWS::EKS::Cluster CFN fixtures (reference adapters/
+    cloudformation/aws/eks): secrets encryption + logging + private
+    endpoint flip the same checks as the terraform side."""
+    bad = cfn_fails({"Resources": {"E": {
+        "Type": "AWS::EKS::Cluster", "Properties": {}}}})
+    good = cfn_fails({"Resources": {"E": {
+        "Type": "AWS::EKS::Cluster", "Properties": {
+            "EncryptionConfig": [{"Resources": ["secrets"],
+                                  "Provider": {"KeyArn": "k"}}],
+            "Logging": {"ClusterLogging": {"EnabledTypes": [
+                {"Type": "api"}, {"Type": "audit"}]}},
+            "ResourcesVpcConfig": {"EndpointPublicAccess": False}}}}})
+    assert {"AVD-AWS-0038", "AVD-AWS-0039", "AVD-AWS-0040"} <= bad
+    for cid in ("AVD-AWS-0038", "AVD-AWS-0039", "AVD-AWS-0040"):
+        assert cid not in good, cid
+
+
+def test_cfn_msk_defined_vs_defaults():
+    """AWS::MSK::Cluster CFN fixtures (reference adapters/
+    cloudformation/aws/msk/cluster.go)."""
+    bad = cfn_fails({"Resources": {"M": {
+        "Type": "AWS::MSK::Cluster", "Properties": {
+            "EncryptionInfo": {"EncryptionInTransit": {
+                "ClientBroker": "TLS_PLAINTEXT"}}}}}})
+    defaults = cfn_fails({"Resources": {"M": {
+        "Type": "AWS::MSK::Cluster", "Properties": {}}}})
+    good = cfn_fails({"Resources": {"M": {
+        "Type": "AWS::MSK::Cluster", "Properties": {
+            "EncryptionInfo": {
+                "EncryptionInTransit": {"ClientBroker": "TLS",
+                                        "InCluster": True},
+                "EncryptionAtRest": {"DataVolumeKMSKeyId": "key"}},
+            "LoggingInfo": {"BrokerLogs": {
+                "CloudWatchLogs": {"Enabled": True}}}}}}})
+    assert "AVD-AWS-0074" in bad      # plaintext client traffic
+    assert {"AVD-AWS-0073", "AVD-AWS-0179"} <= defaults
+    for cid in ("AVD-AWS-0074", "AVD-AWS-0073", "AVD-AWS-0179"):
+        assert cid not in good, cid
+
+
+def test_cfn_rds_instance_defined_vs_defaults():
+    """AWS::RDS::DBInstance CFN fixtures (reference adapters/
+    cloudformation/aws/rds)."""
+    bad = cfn_fails({"Resources": {"D": {
+        "Type": "AWS::RDS::DBInstance", "Properties": {}}}})
+    good = cfn_fails({"Resources": {"D": {
+        "Type": "AWS::RDS::DBInstance", "Properties": {
+            "StorageEncrypted": True, "BackupRetentionPeriod": 5,
+            "PubliclyAccessible": False}}}})
+    assert {"AVD-AWS-0077", "AVD-AWS-0080"} <= bad
+    assert "AVD-AWS-0077" not in good  # retention set
+    assert "AVD-AWS-0080" not in good  # storage encrypted
+    assert "AVD-AWS-0082" not in good  # not publicly accessible
