@@ -571,3 +571,49 @@ def test_cfn_rds_instance_defined_vs_defaults():
     assert "AVD-AWS-0077" not in good  # retention set
     assert "AVD-AWS-0080" not in good  # storage encrypted
     assert "AVD-AWS-0082" not in good  # not publicly accessible
+
+
+def _cfn_one(rtype: str, props: dict) -> set[str]:
+    return cfn_fails({"Resources": {"X": {"Type": rtype,
+                                          "Properties": props}}})
+
+
+def test_cfn_redshift_defined_vs_defaults():
+    """AWS::Redshift::Cluster (reference adapters/cloudformation/aws/
+    redshift): encryption + CMK + private + subnet group."""
+    bad = _cfn_one("AWS::Redshift::Cluster", {})
+    good = _cfn_one("AWS::Redshift::Cluster", {
+        "Encrypted": True, "KmsKeyId": "k", "PubliclyAccessible": False,
+        "ClusterSubnetGroupName": "sg"})
+    assert {"AVD-AWS-0083", "AVD-AWS-0084", "AVD-AWS-0085"} <= bad
+    for cid in ("AVD-AWS-0083", "AVD-AWS-0084", "AVD-AWS-0085",
+                "AVD-AWS-0127"):
+        assert cid not in good, cid
+    # CMK check applies only to encrypted clusters on the default key
+    default_key = _cfn_one("AWS::Redshift::Cluster", {"Encrypted": True})
+    assert "AVD-AWS-0127" in default_key
+
+
+def test_cfn_dynamodb_defined_vs_defaults():
+    """AWS::DynamoDB::Table (reference adapters/cloudformation/aws/
+    dynamodb): CMK SSE + point-in-time recovery."""
+    bad = _cfn_one("AWS::DynamoDB::Table", {})
+    good = _cfn_one("AWS::DynamoDB::Table", {
+        "SSESpecification": {"SSEEnabled": True, "KMSMasterKeyId": "k"},
+        "PointInTimeRecoverySpecification":
+            {"PointInTimeRecoveryEnabled": True}})
+    assert {"AVD-AWS-0024", "AVD-AWS-0025"} <= bad
+    assert "AVD-AWS-0024" not in good
+    assert "AVD-AWS-0025" not in good
+
+
+def test_cfn_workspaces_defined_vs_defaults():
+    """AWS::WorkSpaces::Workspace (reference adapters/cloudformation/
+    aws/workspaces): root + user volume encryption."""
+    bad = _cfn_one("AWS::WorkSpaces::Workspace", {})
+    good = _cfn_one("AWS::WorkSpaces::Workspace", {
+        "RootVolumeEncryptionEnabled": True,
+        "UserVolumeEncryptionEnabled": True})
+    assert {"AVD-AWS-0109", "AVD-AWS-0110"} <= bad
+    assert "AVD-AWS-0109" not in good
+    assert "AVD-AWS-0110" not in good
