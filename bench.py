@@ -302,14 +302,10 @@ print("PREP_OK")
 
 _MICRO_ATTEMPT = r'''
 import json, time, numpy as np
+# NOTE: do NOT enable jax's persistent compilation cache here — setting
+# jax_compilation_cache_dir makes init hang on the tunneled stack even
+# when the link is healthy (measured round 5)
 import jax
-# persistent compile cache: a window that closes mid-attempt still
-# banks its kernel compilations, so the next window skips straight to
-# execution (first TPU compiles cost tens of seconds over a tunnel —
-# possibly longer than a flapping window stays open)
-jax.config.update("jax_compilation_cache_dir",
-                  r"%(npz)s" + ".jaxcache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 import jax.numpy as jnp
 d = jax.devices()[0]
 assert d.platform != "cpu", d
@@ -392,13 +388,10 @@ def _micro_validation(budget_s: float) -> dict | None:
     try:
         return _micro_hunt(npz, deadline)
     finally:
-        import shutil
-
         try:
             os.remove(npz)
         except OSError:
             pass
-        shutil.rmtree(npz + ".jaxcache", ignore_errors=True)
 
 
 def _micro_hunt(npz: str, deadline: float) -> dict | None:
