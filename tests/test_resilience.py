@@ -1,0 +1,652 @@
+"""Resilience fault matrix (tier-1-safe, CPU-only, deterministic):
+fault-spec grammar, breaker state machine (injectable clock), retry
+jitter, Retry-After honoring, deadline budgets + server shed, /readyz,
+degraded fallback scans with zero CVE-match diff, engine device-lost
+degradation, and pipeline error aggregation."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trivy_tpu.cache.cache import MemoryCache
+from trivy_tpu.db import Advisory, AdvisoryDB
+from trivy_tpu.db.model import VulnerabilityMeta
+from trivy_tpu.detector.engine import MatchEngine, PkgQuery
+from trivy_tpu.resilience import faults
+from trivy_tpu.resilience.breaker import BreakerOpen, CircuitBreaker
+from trivy_tpu.resilience.fallback import FallbackCache, FallbackDriver
+from trivy_tpu.resilience.retry import (
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    deadline_scope,
+    parse_retry_after,
+)
+from trivy_tpu.rpc.client import RemoteCache, RemoteDriver, RPCError
+from trivy_tpu.rpc.server import Server
+from trivy_tpu.scanner.local import LocalDriver
+from trivy_tpu.types.scan import ScanOptions
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _fast_retry(attempts: int = 3) -> RetryPolicy:
+    return RetryPolicy(attempts=attempts, base_s=0.001, cap_s=0.005,
+                       seed=7, sleep=lambda s: None)
+
+
+def _db() -> AdvisoryDB:
+    db = AdvisoryDB()
+    db.put_advisory("npm::ghsa", "lodash", Advisory(
+        vulnerability_id="CVE-2019-10744",
+        vulnerable_versions=["<4.17.12"],
+    ))
+    db.put_meta(VulnerabilityMeta.from_json("CVE-2019-10744", {
+        "Title": "prototype pollution", "Severity": "CRITICAL",
+    }))
+    return db
+
+
+def _blob() -> dict:
+    return {
+        "schema_version": 2,
+        "applications": [{
+            "type": "npm",
+            "file_path": "package-lock.json",
+            "packages": [{
+                "id": "lodash@4.17.4", "name": "lodash",
+                "version": "4.17.4",
+                "identifier": {"purl": "pkg:npm/lodash@4.17.4"},
+            }],
+        }],
+    }
+
+
+@pytest.fixture()
+def server():
+    engine = MatchEngine(_db(), use_device=False)
+    srv = Server(engine, MemoryCache(), host="localhost", port=0)
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+# ------------------------------------------------------------ fault spec
+
+
+def test_fault_spec_parsing():
+    plan = faults.FaultPlan.from_spec(
+        "rpc.scan:drop@2; rpc:delay=0.5@3+; engine:device-lost@1;"
+        "rpc.cache:error=502@1-2")
+    drop, delay, lost, err = plan.rules
+    assert (drop.site, drop.action, drop.start, drop.stop) == \
+        ("rpc.scan", "drop", 2, 2)
+    assert (delay.action, delay.param, delay.start, delay.stop) == \
+        ("delay", 0.5, 3, None)
+    assert (lost.action, lost.start) == ("device-lost", 1)
+    assert (err.action, err.param, err.start, err.stop) == \
+        ("error", 502.0, 1, 2)
+
+
+def test_fault_spec_selectors_fire_deterministically():
+    plan = faults.FaultPlan.from_spec("rpc.scan:drop@2")
+    assert plan.fire("rpc.scan") == []          # call 1
+    assert len(plan.fire("rpc.scan")) == 1      # call 2
+    assert plan.fire("rpc.scan") == []          # call 3
+    # site prefix matching: rpc.cache.* does not match rpc.scan rules
+    assert plan.fire("rpc.cache.PutBlob") == []
+
+
+def test_fault_spec_probability_is_seeded():
+    def hits(seed):
+        plan = faults.FaultPlan.from_spec(f"seed={seed};rpc:drop@p0.5")
+        return [bool(plan.fire("rpc.scan")) for _ in range(32)]
+
+    assert hits(7) == hits(7)       # same seed -> same trace
+    assert hits(7) != hits(8)       # different seed -> different trace
+    assert any(hits(7)) and not all(hits(7))
+
+
+def test_fault_spec_errors():
+    for bad in ("rpc.scan", "rpc:explode", "rpc:drop@p2", "rpc:drop@3-1",
+                "seed=x;rpc:drop"):
+        with pytest.raises(faults.FaultSpecError):
+            faults.FaultPlan.from_spec(bad)
+
+
+def test_env_spec_activation(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "rpc.scan:drop@1")
+    plan = faults.active()
+    assert plan is not None and plan.rules[0].action == "drop"
+    faults.validate_env()                   # well-formed: no error
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert faults.active() is None
+    faults.validate_env()                   # unset: no-op
+
+
+def test_env_spec_validated_eagerly(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "rpc:eror=503")  # operator typo
+    with pytest.raises(faults.FaultSpecError):
+        faults.validate_env()               # startup, not mid-scan
+
+
+# ------------------------------------------------------------ breaker
+
+
+def test_breaker_state_machine():
+    clk = FakeClock()
+    br = CircuitBreaker(failure_threshold=3, recovery_s=10.0, clock=clk,
+                        name="t")
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"
+    br.record_failure()                     # 3rd consecutive -> open
+    assert br.state == "open" and not br.allow()
+    assert br.retry_in() == pytest.approx(10.0)
+
+    clk.advance(9.9)
+    assert not br.allow()                   # still open
+    clk.advance(0.2)
+    assert br.state == "half-open"
+    assert br.allow()                       # one trial admitted
+    assert not br.allow()                   # second trial shed
+    br.record_failure()                     # trial failed -> open again
+    assert br.state == "open"
+
+    clk.advance(10.1)
+    assert br.allow()                       # half-open trial
+    br.record_success()                     # trial passed -> closed
+    assert br.state == "closed" and br.allow()
+
+    # success resets the consecutive-failure count
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"
+
+
+def test_breaker_call_raises_when_open():
+    clk = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, recovery_s=5.0, clock=clk)
+    with pytest.raises(ValueError):
+        br.call(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    with pytest.raises(BreakerOpen):
+        br.call(lambda: "ok")
+    clk.advance(5.0)
+    assert br.call(lambda: "ok") == "ok"
+    assert br.state == "closed"
+
+
+# ------------------------------------------------------------ retry/deadline
+
+
+def test_retry_policy_decorrelated_jitter_bounds():
+    pol = RetryPolicy(attempts=5, base_s=0.1, cap_s=2.0, seed=42)
+    a = [next_d for _, next_d in zip(range(50), pol.delays())]
+    b = [next_d for _, next_d in zip(range(50), pol.delays())]
+    assert a == b                            # seeded -> deterministic
+    assert all(0.1 <= d <= 2.0 for d in a)
+    assert len(set(a)) > 10                  # actually jittered
+
+
+def test_parse_retry_after():
+    assert parse_retry_after("3") == 3.0
+    assert parse_retry_after("0.5") == 0.5
+    assert parse_retry_after(None) is None
+    assert parse_retry_after("garbage") is None
+
+
+def test_deadline_budget_and_scope():
+    clk = FakeClock()
+    d = Deadline.after(2.0, clock=clk)
+    assert d.remaining() == pytest.approx(2.0) and not d.expired
+    clk.advance(2.5)
+    assert d.expired
+    with pytest.raises(DeadlineExceeded) as ei:
+        d.check("detect")
+    assert ei.value.budget_s == 2.0
+    assert "2.000s" in str(ei.value) and "detect" in str(ei.value)
+
+    from trivy_tpu.resilience.retry import checkpoint, current_deadline
+
+    assert current_deadline() is None
+    checkpoint("noop")  # no ambient deadline -> no-op
+    with deadline_scope(d):
+        assert current_deadline() is d
+        with deadline_scope(None):          # fallback path lifts budget
+            assert current_deadline() is None
+            checkpoint("lifted")
+        with pytest.raises(DeadlineExceeded):
+            checkpoint("scoped")
+    assert current_deadline() is None
+
+
+# ------------------------------------------------------------ client faults
+
+
+def test_injected_5xx_retries_then_succeeds(server):
+    faults.install_spec("rpc.cache:error=503@1")
+    cache = RemoteCache(server.address, retry=_fast_retry())
+    cache.put_blob("sha256:b", _blob())     # attempt 1 injected 503, 2 ok
+    missing_artifact, missing = cache.missing_blobs("sha256:a", ["sha256:b"])
+    assert missing == []
+
+
+def test_injected_drop_exhausts_retries(server):
+    faults.install_spec("rpc.scan:drop")
+    driver = RemoteDriver(server.address, retry=_fast_retry(attempts=2))
+    with pytest.raises(RPCError) as ei:
+        driver.scan("a", "sha256:a", ["sha256:b"], ScanOptions())
+    assert "after 2 attempts" in str(ei.value)
+
+
+def test_injected_timeout_path(server):
+    faults.install_spec("rpc.scan:timeout@1")
+    server.service.cache.put_blob("sha256:b", _blob())
+    driver = RemoteDriver(server.address, retry=_fast_retry())
+    results, _ = driver.scan("a", "sha256:a", ["sha256:b"], ScanOptions())
+    assert [v.vulnerability_id for v in results[0].vulnerabilities] == \
+        ["CVE-2019-10744"]
+
+
+def test_injected_corrupt_response(server):
+    faults.install_spec("rpc.scan:corrupt@1")
+    server.service.cache.put_blob("sha256:b", _blob())
+    driver = RemoteDriver(server.address, retry=_fast_retry())
+    with pytest.raises(Exception):          # decode fails on mangled bytes
+        driver.scan("a", "sha256:a", ["sha256:b"], ScanOptions())
+
+
+def test_retry_after_is_honored():
+    """A 503 with Retry-After must floor the next backoff sleep."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    calls = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            calls.append(self.path)
+            if len(calls) == 1:
+                body = b'{"error":"busy"}'
+                self.send_response(503)
+                self.send_header("Retry-After", "0.25")
+            else:
+                body = b'{"missing_artifact": false}'
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("localhost", 0), H)
+    import threading
+
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        sleeps = []
+        pol = RetryPolicy(attempts=3, base_s=0.001, cap_s=0.005, seed=1,
+                          sleep=sleeps.append)
+        host, port = httpd.server_address[:2]
+        cache = RemoteCache(f"http://{host}:{port}", retry=pol)
+        missing_artifact, _ = cache.missing_blobs("sha256:a", [])
+        assert not missing_artifact
+        assert len(calls) == 2
+        # jitter caps at 5ms, so the 250ms floor must come from the header
+        assert sleeps and sleeps[0] >= 0.25
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ------------------------------------------------------------ deadline/server
+
+
+def test_deadline_exhausted_client_surfaces_budget(server):
+    clk = FakeClock()
+    d = Deadline.after(1.0, clock=clk)
+    clk.advance(2.0)
+    driver = RemoteDriver(server.address, retry=_fast_retry())
+    with deadline_scope(d):
+        with pytest.raises(DeadlineExceeded) as ei:
+            driver.scan("a", "sha256:a", ["sha256:b"], ScanOptions())
+    assert "1.000s" in str(ei.value)        # the budget is in the error
+
+
+def test_scan_sheds_during_db_swap_lock(server):
+    """Acceptance: 1 s deadline against a server holding the DB-swap
+    write lock -> prompt 503/Retry-After, surfaced as a deadline error;
+    no indefinite block."""
+    server.service.cache.put_blob("sha256:b", _blob())
+    server.service.lock.acquire_write()     # simulate a stuck DB swap
+    try:
+        driver = RemoteDriver(server.address, retry=_fast_retry())
+        start = time.monotonic()
+        with deadline_scope(Deadline.after(1.0)):
+            with pytest.raises((DeadlineExceeded, RPCError)) as ei:
+                driver.scan("a", "sha256:a", ["sha256:b"], ScanOptions())
+        elapsed = time.monotonic() - start
+        assert elapsed < 5.0                # promptly, not indefinitely
+        assert "deadline" in str(ei.value).lower() \
+            or "busy" in str(ei.value).lower()
+        assert server.service.metrics.scans_shed_total >= 1
+    finally:
+        server.service.lock.release_write()
+
+    # after the swap releases, the same scan succeeds
+    driver = RemoteDriver(server.address, retry=_fast_retry())
+    results, _ = driver.scan("a", "sha256:a", ["sha256:b"], ScanOptions())
+    assert results[0].vulnerabilities
+
+
+def test_mid_scan_deadline_checkpoint_sheds(server):
+    """An already-expired budget reaching the server sheds before any
+    engine work (503, not a hang or a 500)."""
+    server.service.cache.put_blob("sha256:b", _blob())
+    clk = FakeClock()
+    d = Deadline.after(0.5, clock=clk)
+    clk.advance(1.0)
+    # bypass the client-side early check by posting the header directly
+    from trivy_tpu.rpc import wire
+    from trivy_tpu.rpc.server import SCAN_PATH
+
+    body = wire.scan_request("a", "sha256:a", ["sha256:b"], ScanOptions())
+    req = urllib.request.Request(
+        server.address + SCAN_PATH, data=body,
+        headers={"Content-Type": "application/json",
+                 "X-Trivy-Tpu-Wire": "internal",
+                 "X-Trivy-Deadline": "0.000"},
+        method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 503
+    assert ei.value.headers.get("Retry-After")
+
+
+# ------------------------------------------------------------ readyz
+
+
+def test_readyz_liveness_vs_readiness(server):
+    with urllib.request.urlopen(server.address + "/healthz") as r:
+        assert r.read() == b"ok"
+    with urllib.request.urlopen(server.address + "/readyz") as r:
+        assert r.read() == b"ok"
+
+    server.service.lock.acquire_write()     # DB swap holds the write lock
+    try:
+        # liveness stays green; readiness goes 503 + Retry-After
+        with urllib.request.urlopen(server.address + "/healthz") as r:
+            assert r.read() == b"ok"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(server.address + "/readyz")
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After")
+        assert "swap" in json.loads(ei.value.read())["error"]
+    finally:
+        server.service.lock.release_write()
+
+    with urllib.request.urlopen(server.address + "/readyz") as r:
+        assert r.read() == b"ok"
+
+
+def test_readyz_before_engine_loaded():
+    srv = Server(None, MemoryCache(), host="localhost", port=0)
+    srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.address + "/readyz")
+        assert ei.value.code == 503
+        assert "engine" in json.loads(ei.value.read())["error"]
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------ fallback
+
+
+def _vuln_json(results) -> str:
+    return json.dumps([r.to_dict() for r in results], sort_keys=True)
+
+
+def test_fallback_driver_degrades_and_matches_local(server):
+    """Acceptance: with TRIVY_TPU_FAULTS killing the remote endpoint,
+    FallbackDriver completes locally with a byte-identical vulnerability
+    set and records why it degraded."""
+    faults.install_spec("rpc.scan:drop")    # every remote scan dies
+    breaker = CircuitBreaker(failure_threshold=3, recovery_s=30.0)
+    local_cache = MemoryCache()
+    cache = FallbackCache(RemoteCache(server.address, retry=_fast_retry()),
+                          local_cache, breaker=breaker)
+    cache.put_blob("sha256:b", _blob())     # mirrored local + remote
+
+    engine = MatchEngine(_db(), use_device=False)
+    driver = FallbackDriver(
+        RemoteDriver(server.address, retry=_fast_retry(attempts=2)),
+        lambda: LocalDriver(engine, cache), breaker=breaker)
+    results, os_found = driver.scan(
+        "myapp", "", ["sha256:b"], ScanOptions())
+    assert driver.degraded_reason and "remote scan failed" \
+        in driver.degraded_reason
+
+    pure = LocalDriver(MatchEngine(_db(), use_device=False), local_cache)
+    pure_results, _ = pure.scan("myapp", "", ["sha256:b"], ScanOptions())
+    assert _vuln_json(results) == _vuln_json(pure_results)  # zero diff
+
+
+def test_fallback_driver_open_breaker_skips_remote(server):
+    clk = FakeClock()
+    breaker = CircuitBreaker(failure_threshold=1, recovery_s=60.0,
+                             clock=clk)
+    breaker.record_failure()                # open
+    cache = MemoryCache()
+    cache.put_blob("sha256:b", _blob())
+
+    calls = []
+
+    class NeverDriver:
+        def scan(self, *a):
+            calls.append(a)
+            raise AssertionError("must not reach the remote")
+
+    driver = FallbackDriver(
+        NeverDriver(),
+        lambda: LocalDriver(MatchEngine(_db(), use_device=False), cache),
+        breaker=breaker)
+    results, _ = driver.scan("myapp", "", ["sha256:b"], ScanOptions())
+    assert not calls
+    assert "circuit breaker open" in driver.degraded_reason
+    assert results[0].vulnerabilities
+
+
+def test_fallback_driver_deadline_exhausted_goes_local():
+    cache = MemoryCache()
+    cache.put_blob("sha256:b", _blob())
+
+    class NeverDriver:
+        def scan(self, *a):
+            raise AssertionError("must not reach the remote")
+
+    driver = FallbackDriver(
+        NeverDriver(),
+        lambda: LocalDriver(MatchEngine(_db(), use_device=False), cache))
+    clk = FakeClock()
+    d = Deadline.after(1.0, clock=clk)
+    clk.advance(2.0)
+    with deadline_scope(d):                 # budget already gone
+        results, _ = driver.scan("myapp", "", ["sha256:b"], ScanOptions())
+    assert "deadline budget" in driver.degraded_reason
+    assert results[0].vulnerabilities       # local completion guarantee
+    # a caller-side budget says nothing about remote health
+    assert driver.breaker.state == "closed"
+
+
+def test_fallback_mid_dispatch_deadline_does_not_trip_breaker():
+    cache = MemoryCache()
+    cache.put_blob("sha256:b", _blob())
+
+    class DeadlineDriver:
+        def scan(self, *a):
+            raise DeadlineExceeded("deadline of 1.000s exhausted",
+                                   budget_s=1.0)
+
+    driver = FallbackDriver(
+        DeadlineDriver(),
+        lambda: LocalDriver(MatchEngine(_db(), use_device=False), cache))
+    results, _ = driver.scan("myapp", "", ["sha256:b"], ScanOptions())
+    assert "exhausted" in driver.degraded_reason
+    assert results[0].vulnerabilities
+    assert driver.breaker.state == "closed"  # no failure recorded
+
+
+def test_degraded_report_stamped_and_zero_cve_diff(server):
+    """End-to-end through Scanner: Report.metadata carries the degraded
+    marker and the vulnerability set byte-matches the pure-local scan."""
+    from trivy_tpu.artifact.base import ArtifactReference
+    from trivy_tpu.scanner.scan import Scanner
+
+    class StubArtifact:
+        def __init__(self, cache):
+            self.cache = cache
+
+        def inspect(self):
+            self.cache.put_blob("sha256:b", _blob())
+            return ArtifactReference(
+                name="myapp", type="container_image", id="sha256:a",
+                blob_ids=["sha256:b"])
+
+        def clean(self, ref):
+            pass
+
+    faults.install_spec("rpc.scan:drop")
+    breaker = CircuitBreaker(failure_threshold=3, recovery_s=30.0)
+    local_cache = MemoryCache()
+    cache = FallbackCache(RemoteCache(server.address, retry=_fast_retry()),
+                          local_cache, breaker=breaker)
+    driver = FallbackDriver(
+        RemoteDriver(server.address, retry=_fast_retry(attempts=2)),
+        lambda: LocalDriver(MatchEngine(_db(), use_device=False), cache),
+        breaker=breaker)
+    degraded = Scanner(driver, StubArtifact(cache)).scan_artifact(
+        ScanOptions())
+    assert degraded.metadata.degraded
+    assert "Degraded" in degraded.to_dict()["Metadata"]
+
+    faults.reset()
+    pure = Scanner(
+        LocalDriver(MatchEngine(_db(), use_device=False), local_cache),
+        StubArtifact(local_cache)).scan_artifact(ScanOptions())
+    assert not pure.metadata.degraded
+    assert "Metadata" not in pure.to_dict() or \
+        "Degraded" not in pure.to_dict().get("Metadata", {})
+    assert _vuln_json(degraded.results) == _vuln_json(pure.results)
+
+
+# ------------------------------------------------------------ engine faults
+
+
+def test_engine_device_lost_degrades_to_oracle():
+    faults.install_spec("engine:device-lost@1")
+    engine = MatchEngine(_db(), use_device=True)
+    oracle = MatchEngine(_db(), use_device=False)
+    queries = [PkgQuery(space="npm::", name="lodash", version="4.17.4",
+                        scheme_name="npm")]
+    got = engine.detect(queries)
+    want = oracle.detect(queries)
+    assert [sorted(r.adv_indices) for r in got] == \
+        [sorted(r.adv_indices) for r in want]
+    assert engine.device_lost and not engine.use_device
+    # subsequent batches stay on the (degraded) host path and still match
+    got2 = engine.detect(queries)
+    assert [sorted(r.adv_indices) for r in got2] == \
+        [sorted(r.adv_indices) for r in want]
+
+
+def test_engine_device_lost_in_detect_many():
+    faults.install_spec("engine:device-lost@1")
+    engine = MatchEngine(_db(), use_device=True)
+    oracle = MatchEngine(_db(), use_device=False)
+    queries = [PkgQuery(space="npm::", name="lodash", version=v,
+                        scheme_name="npm")
+               for v in ("4.17.4", "4.17.12", "1.0.0")]
+    got = engine.detect_many(queries, batch_size=2)
+    want = oracle.detect_many(queries, batch_size=2)
+    assert [sorted(r.adv_indices) for r in got] == \
+        [sorted(r.adv_indices) for r in want]
+    assert engine.device_lost
+
+
+# ------------------------------------------------------------ pipeline
+
+
+def test_pipeline_aggregates_all_errors():
+    from trivy_tpu.utils.pipeline import PipelineError, run_pipeline
+
+    def fn(i):
+        if i in (1, 3):
+            raise ValueError(f"bad {i}")
+        return i * 10
+
+    delivered = []
+    with pytest.raises(PipelineError) as ei:
+        run_pipeline(range(5), fn, on_result=delivered.append, workers=3)
+    assert delivered == [0, 20, 40]          # failed slots skipped
+    assert [i for i, _ in ei.value.failures] == [1, 3]
+    msg = str(ei.value)
+    assert "2/5" in msg and "bad 1" in msg and "bad 3" in msg
+
+
+def test_pipeline_sequential_path_fails_fast_with_same_type():
+    from trivy_tpu.utils.pipeline import PipelineError, run_pipeline
+
+    ran, delivered = [], []
+
+    def fn(i):
+        ran.append(i)
+        if i == 2:
+            raise ValueError("boom")
+        return i
+
+    with pytest.raises(PipelineError) as ei:
+        run_pipeline([1, 2, 3], fn, on_result=delivered.append, workers=1)
+    assert [i for i, _ in ei.value.failures] == [1]
+    assert ran == [1, 2]            # fail-fast: item 3 never runs
+    assert delivered == [1]         # successes before the failure deliver
+
+    assert run_pipeline([2, 3], lambda i: i, workers=1) == [2, 3]
+
+
+def test_pipeline_success_unchanged():
+    from trivy_tpu.utils.pipeline import run_pipeline
+
+    out = []
+    assert run_pipeline(range(6), lambda i: i * 2, on_result=out.append,
+                        workers=3) == [0, 2, 4, 6, 8, 10]
+    assert out == [0, 2, 4, 6, 8, 10]

@@ -73,6 +73,17 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--timeout", default="5m",
                    help="per-scan deadline (e.g. 300s, 5m, 1h; "
                         "reference --timeout default 5m)")
+    p.add_argument("--scan-timeout", default=None,
+                   help="per-scan deadline BUDGET propagated through the "
+                        "scan spine and to the server via the "
+                        "X-Trivy-Deadline header; the server sheds work "
+                        "it cannot finish in time (503 + Retry-After). "
+                        "Go-style duration; unset = no budget")
+    p.add_argument("--fallback", action="store_true",
+                   help="with --server: degrade to a local scan when the "
+                        "remote is unavailable (circuit breaker) or the "
+                        "deadline budget runs out; degraded reports "
+                        "carry Metadata.Degraded")
     p.add_argument("--parallel", type=int, default=5,
                    help="number of parallel analysis workers")
     p.add_argument("--server", default=None,

@@ -17,6 +17,16 @@ class FatalError(Exception):
     pass
 
 
+def _validate_fault_spec() -> None:
+    """Fail fast on a malformed TRIVY_TPU_FAULTS before any scan work."""
+    from trivy_tpu.resilience import faults
+
+    try:
+        faults.validate_env()
+    except faults.FaultSpecError as e:
+        raise FatalError(f"TRIVY_TPU_FAULTS: {e}")
+
+
 def _severities(arg: str | None) -> list[Severity] | None:
     if not arg:
         return None
@@ -96,6 +106,7 @@ def run_scan(args) -> int:
     from trivy_tpu.fanal.analyzers import secret_analyzer
 
     normalize_args(args)
+    _validate_fault_spec()
 
     # --no-tpu forces the host path; the default is "hybrid" (device
     # screen + concurrent host AC — the fastest measured configuration;
@@ -266,18 +277,31 @@ def _parse_duration(spec: str | None) -> float:
     return total
 
 
-def _scan_with_timeout(scanner, options, timeout_s: float):
+def _scan_with_timeout(scanner, options, timeout_s: float,
+                       budget_s: float | None = None):
     """Per-scan deadline (reference artifact/run.go:338 ctx timeout).
     The scan runs in a worker thread; on deadline the CLI fails with the
     reference's DeadlineExceeded advice (the worker, being a daemon
-    thread, cannot outlive the process)."""
+    thread, cannot outlive the process). `budget_s` (--scan-timeout)
+    additionally arms the cooperative deadline budget that propagates
+    through the scan spine and to the server via X-Trivy-Deadline —
+    the scope is entered inside the worker because it is thread-local."""
     import threading
 
     box: dict = {}
 
     def work():
         try:
-            box["report"] = scanner.scan_artifact(options)
+            if budget_s:
+                from trivy_tpu.resilience.retry import (
+                    Deadline,
+                    deadline_scope,
+                )
+
+                with deadline_scope(Deadline.after(budget_s)):
+                    box["report"] = scanner.scan_artifact(options)
+            else:
+                box["report"] = scanner.scan_artifact(options)
         except BaseException as exc:  # re-raised on the main thread
             box["error"] = exc
 
@@ -324,9 +348,19 @@ def _run_scan_core(args, compliance_spec) -> int:
             f"unknown cache backend {backend!r} (fs, memory, redis://...)")
     artifact, driver = _select_scanner(args, cache)
     scanner = Scanner(driver, artifact)
-    report = _scan_with_timeout(
-        scanner, make_scan_options(args),
-        _parse_duration(getattr(args, "timeout", None)))
+    budget_spec = getattr(args, "scan_timeout", None)
+    budget_s = _parse_duration(budget_spec) if budget_spec else None
+    from trivy_tpu.resilience.retry import DeadlineExceeded
+
+    try:
+        report = _scan_with_timeout(
+            scanner, make_scan_options(args),
+            _parse_duration(getattr(args, "timeout", None)),
+            budget_s=budget_s)
+    except DeadlineExceeded as e:
+        raise FatalError(
+            f"scan deadline exceeded: {e} (increase --scan-timeout, or "
+            "add --fallback in client mode to degrade to a local scan)")
 
     # VEX suppression runs before severity/ignore filtering
     # (reference pkg/result/filter.go:37 -> pkg/vex/vex.go:65).
@@ -429,6 +463,28 @@ def _select_scanner(args, cache):
         # analysis runs client-side but blobs land in the SERVER's cache
         # (reference pkg/commands/artifact/scanner.go remote scanners)
         cache = RemoteCache(args.server, token=args.token)
+        if getattr(args, "fallback", False):
+            # --fallback: blobs mirror into a local cache and the scan
+            # degrades to a locally-built engine when the breaker opens
+            # or the deadline budget runs out (docs/resilience.md)
+            from trivy_tpu.cache.cache import MemoryCache
+            from trivy_tpu.resilience.breaker import CircuitBreaker
+            from trivy_tpu.resilience.fallback import (
+                FallbackCache,
+                FallbackDriver,
+            )
+
+            breaker = CircuitBreaker(failure_threshold=3, recovery_s=30.0,
+                                     name="rpc")
+            cache = FallbackCache(cache, MemoryCache(), breaker=breaker)
+            local_cache = cache
+
+            def _local_driver():
+                from trivy_tpu.scanner.local import LocalDriver
+
+                return LocalDriver(build_engine(args), local_cache)
+
+            driver = FallbackDriver(driver, _local_driver, breaker=breaker)
     else:
         from trivy_tpu.scanner.local import LocalDriver
 
@@ -675,6 +731,7 @@ def _report_from_json(doc: dict):
         diff_ids=md.get("DiffIDs", []) or [],
         repo_tags=md.get("RepoTags", []) or [],
         repo_digests=md.get("RepoDigests", []) or [],
+        degraded=md.get("Degraded", ""),
     )
     for rdoc in doc.get("Results") or []:
         res = R.Result(
@@ -774,6 +831,7 @@ def run_server(args) -> int:
     from trivy_tpu.cache.cache import FSCache
     from trivy_tpu.rpc.server import serve
 
+    _validate_fault_spec()
     engine = new_engine(args)
     host, _, port = args.listen.partition(":")
     serve(engine, host=host or "localhost", port=int(port or 4954),

@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 from trivy_tpu.db.store import AdvisoryDB
 from trivy_tpu.detector.exact import AdvisoryChecker
 from trivy_tpu.log import logger
+from trivy_tpu.resilience import faults
 from trivy_tpu.tensorize.compile import CompiledDB, compile_db, space_of_bucket
 from trivy_tpu.utils.hashing import join_key
 from trivy_tpu.versioning import get_scheme
@@ -73,6 +74,11 @@ class MatchEngine:
         self._ddb = None
         self._sdb = None
         self.rescreen_stats = {"candidates": 0, "confirmed": 0}
+        # set when an (injected or real) device loss degraded this
+        # engine to the host oracle mid-flight
+        self.device_lost = False
+        # lazy (space, name) -> advisory-indices index for the oracle path
+        self._oracle_index: dict | None = None
         # lazy per-advisory compiled checkers + parsed-version memo
         self._checkers: dict[int, AdvisoryChecker] = {}
         self._parse_cache: dict[tuple[str, str], object] = {}
@@ -198,17 +204,25 @@ class MatchEngine:
 
     # ------------------------------------------------------------ oracle
 
+    def _oracle_name_index(self) -> dict:
+        """name -> advisory indices, from the compiled flat list so
+        indices are comparable across paths. Built once per engine (the
+        DB is immutable; hot-swaps create a new engine) — a server
+        degraded to the oracle path must not rebuild it per batch."""
+        if self._oracle_index is None:
+            index: dict[tuple[str, str], list[int]] = {}
+            for i, (bucket, name, _adv) in enumerate(self.cdb.advisories):
+                resolved = space_of_bucket(bucket)
+                if resolved is None:
+                    continue
+                index.setdefault((resolved[0], name), []).append(i)
+            self._oracle_index = index
+        return self._oracle_index
+
     def oracle_detect(self, queries: list[PkgQuery]) -> list[MatchResult]:
         """Pure-host exact detection over the uncompiled DB (the reference
         loop shape: bucket get per name, compare per advisory)."""
-        # name -> advisory indices, from the compiled flat list so indices
-        # are comparable across paths
-        index: dict[tuple[str, str], list[int]] = {}
-        for i, (bucket, name, _adv) in enumerate(self.cdb.advisories):
-            resolved = space_of_bucket(bucket)
-            if resolved is None:
-                continue
-            index.setdefault((resolved[0], name), []).append(i)
+        index = self._oracle_name_index()
         out = []
         for q in queries:
             hits = []
@@ -226,6 +240,10 @@ class MatchEngine:
                 if ch.check_parsed(ver):
                     hits.append(i)
             out.append(MatchResult(q, sorted(hits)))
+        # the oracle path is also the long-lived degraded-server path
+        # (device lost / --no-tpu): its memos need the same RSS bound
+        # the device path gets
+        self._enforce_memo_bounds()
         return out
 
     # ------------------------------------------------------------ device
@@ -241,14 +259,19 @@ class MatchEngine:
         if not self.use_device:
             return self.oracle_detect(queries)
 
-        uniq, idx_map = self.dedupe_queries(queries)
-        if len(uniq) < len(queries):
-            uniq_hits = self._detect_unique(uniq)
-            out = [MatchResult(q, uniq_hits[idx_map[j]])
-                   for j, q in enumerate(queries)]
-        else:
-            hits = self._detect_unique(queries)
-            out = [MatchResult(q, h) for q, h in zip(queries, hits)]
+        try:
+            faults.check_device("engine")
+            uniq, idx_map = self.dedupe_queries(queries)
+            if len(uniq) < len(queries):
+                uniq_hits = self._detect_unique(uniq)
+                out = [MatchResult(q, uniq_hits[idx_map[j]])
+                       for j, q in enumerate(queries)]
+            else:
+                hits = self._detect_unique(queries)
+                out = [MatchResult(q, h) for q, h in zip(queries, hits)]
+        except faults.DeviceLost as exc:
+            self._degrade_device(exc)
+            return self.oracle_detect(queries)
         # the RPC server's production scan path goes through detect(),
         # not detect_many(): bound the memos here too
         self._enforce_memo_bounds()
@@ -270,6 +293,29 @@ class MatchEngine:
             for i in range(0, len(queries), batch_size):
                 out.extend(self.oracle_detect(queries[i: i + batch_size]))
             return out
+        try:
+            faults.check_device("engine")
+            return self._detect_many_device(queries, batch_size, depth)
+        except faults.DeviceLost as exc:
+            self._degrade_device(exc)
+            out = []
+            for i in range(0, len(queries), batch_size):
+                out.extend(self.oracle_detect(queries[i: i + batch_size]))
+            return out
+
+    def _degrade_device(self, exc: Exception) -> None:
+        """Device lost mid-crawl: flip this engine to the host oracle
+        permanently (the compiled host copies answer every query the
+        kernel would — zero match diff, just slower) and flag it so the
+        operator can see the degradation in logs/metrics."""
+        _log.warn("accelerator lost; degrading match engine to host "
+                  "oracle", err=str(exc))
+        self.use_device = False
+        self.device_lost = True
+
+    def _detect_many_device(self, queries: list[PkgQuery],
+                            batch_size: int, depth: int
+                            ) -> list[MatchResult]:
         from collections import deque
 
         cache = self._crawl_cache

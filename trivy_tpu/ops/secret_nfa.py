@@ -36,8 +36,13 @@ from __future__ import annotations
 
 import functools
 import re
-import re._constants as sre_c
-import re._parser as sre_parse
+
+try:  # the private regex internals moved under re.* in Python 3.11
+    import re._constants as sre_c
+    import re._parser as sre_parse
+except ImportError:  # Python <= 3.10: same modules, top-level names
+    import sre_constants as sre_c
+    import sre_parse
 
 import numpy as np
 

@@ -1,0 +1,28 @@
+"""Resilience layer: deterministic fault injection, retry policy with
+decorrelated jitter, per-scan deadline budgets, circuit breaking, and the
+degraded local fallback driver (docs/resilience.md).
+
+Everything in this package is stdlib-only so it can be imported from the
+RPC hot path, the match engine, and tests without pulling in jax.
+"""
+
+from trivy_tpu.resilience.breaker import BreakerOpen, CircuitBreaker
+from trivy_tpu.resilience.retry import (
+    DEADLINE_HEADER,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    current_deadline,
+    deadline_scope,
+)
+
+__all__ = [
+    "BreakerOpen",
+    "CircuitBreaker",
+    "DEADLINE_HEADER",
+    "Deadline",
+    "DeadlineExceeded",
+    "RetryPolicy",
+    "current_deadline",
+    "deadline_scope",
+]

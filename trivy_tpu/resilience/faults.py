@@ -1,0 +1,248 @@
+"""Deterministic, config-driven fault injector for the scan spine.
+
+A fault plan is a list of rules compiled from a compact spec string —
+either installed programmatically (tests) or read from the
+`TRIVY_TPU_FAULTS` environment variable (operators / CI fault matrices).
+Instrumented call sites ask `fire(site)` which rules apply to the current
+call; the injector itself never touches the network or the device, it
+only tells the call site what to simulate.
+
+Spec grammar (rules joined by ";" or ","):
+
+    rule     := site ":" action [ "=" param ] [ "@" selector ]
+    site     := "rpc" | "rpc.scan" | "rpc.cache" | "rpc.cache.PutBlob"
+                | "engine" | ...        (dotted, prefix-matched)
+    action   := "drop" | "timeout" | "delay" | "error" | "corrupt"
+                | "device-lost"
+    selector := N        fire on the Nth matching call only (1-based)
+              | N "+"    fire on the Nth and every later call
+              | N "-" M  fire on calls N..M inclusive
+              | "p" F    fire with probability F (seeded, deterministic)
+              | (none)   fire on every matching call
+    seed     := "seed=" INT   (plan-wide RNG seed for "p" selectors)
+
+Examples:
+
+    TRIVY_TPU_FAULTS="rpc.scan:drop"             # remote scans never land
+    TRIVY_TPU_FAULTS="rpc:error=503@1-2"         # first two RPCs get a 503
+    TRIVY_TPU_FAULTS="rpc.scan:delay=0.2@3+"     # slow from the 3rd scan on
+    TRIVY_TPU_FAULTS="seed=7;rpc:drop@p0.3"      # 30% drop, deterministic
+    TRIVY_TPU_FAULTS="engine:device-lost@1"      # TPU dies on first batch
+
+Each rule keeps its own call counter, so selectors are deterministic per
+rule regardless of how many rules share a site.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import threading
+from dataclasses import dataclass, field
+
+ENV_VAR = "TRIVY_TPU_FAULTS"
+
+ACTIONS = {"drop", "timeout", "delay", "error", "corrupt", "device-lost"}
+
+
+class FaultError(Exception):
+    """Base class for injected faults."""
+
+
+class DeviceLost(FaultError):
+    """Injected accelerator loss (site ``engine``)."""
+
+
+class InjectedHTTPError(FaultError):
+    """Injected HTTP error response (site ``rpc*``, action ``error``)."""
+
+    def __init__(self, code: int):
+        super().__init__(f"injected HTTP {code}")
+        self.code = code
+
+
+class FaultSpecError(ValueError):
+    """The fault spec string does not parse."""
+
+
+_RULE_RX = re.compile(
+    r"(?P<site>[A-Za-z0-9_.]+):(?P<action>[a-z-]+)"
+    r"(?:=(?P<param>[0-9.]+))?"
+    r"(?:@(?P<sel>[0-9p.+-]+))?$"
+)
+
+
+@dataclass
+class Rule:
+    site: str
+    action: str
+    param: float | None = None
+    start: int = 1
+    stop: int | None = None  # inclusive; None = open-ended
+    prob: float | None = None
+    calls: int = field(default=0, compare=False)
+
+    def fires(self, n: int, rng: random.Random) -> bool:
+        if self.prob is not None:
+            return rng.random() < self.prob
+        if n < self.start:
+            return False
+        return self.stop is None or n <= self.stop
+
+    def matches(self, site: str) -> bool:
+        return site == self.site or site.startswith(self.site + ".")
+
+
+def _parse_selector(sel: str | None) -> tuple[int, int | None, float | None]:
+    """-> (start, stop, prob)."""
+    if sel is None:
+        return 1, None, None
+    if sel.startswith("p"):
+        try:
+            prob = float(sel[1:])
+        except ValueError:
+            raise FaultSpecError(f"bad probability selector {sel!r}")
+        if not 0.0 <= prob <= 1.0:
+            raise FaultSpecError(f"probability out of [0,1]: {sel!r}")
+        return 1, None, prob
+    if sel.endswith("+"):
+        return int(sel[:-1]), None, None
+    if "-" in sel:
+        lo, _, hi = sel.partition("-")
+        start, stop = int(lo), int(hi)
+        if stop < start:
+            raise FaultSpecError(f"empty selector range {sel!r}")
+        return start, stop, None
+    n = int(sel)
+    return n, n, None
+
+
+class FaultPlan:
+    """A compiled fault spec; thread-safe (call counters live under one
+    lock so concurrent RPC workers see a consistent ordinal per rule)."""
+
+    def __init__(self, rules: list[Rule], seed: int = 0):
+        self.rules = list(rules)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        rules: list[Rule] = []
+        seed = 0
+        for tok in re.split(r"[;,]", spec):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if tok.startswith("seed="):
+                try:
+                    seed = int(tok[5:])
+                except ValueError:
+                    raise FaultSpecError(f"bad seed {tok!r}")
+                continue
+            m = _RULE_RX.match(tok)
+            if not m:
+                raise FaultSpecError(f"bad fault rule {tok!r}")
+            action = m.group("action")
+            if action not in ACTIONS:
+                raise FaultSpecError(
+                    f"unknown fault action {action!r} "
+                    f"(valid: {', '.join(sorted(ACTIONS))})")
+            try:
+                start, stop, prob = _parse_selector(m.group("sel"))
+            except ValueError as exc:
+                raise FaultSpecError(f"bad selector in {tok!r}: {exc}")
+            param = m.group("param")
+            rules.append(Rule(
+                site=m.group("site"), action=action,
+                param=float(param) if param is not None else None,
+                start=start, stop=stop, prob=prob,
+            ))
+        return cls(rules, seed=seed)
+
+    def fire(self, site: str) -> list[Rule]:
+        """Which rules apply to this call at `site`? Increments the call
+        counter of every matching rule, firing or not."""
+        out: list[Rule] = []
+        with self._lock:
+            for r in self.rules:
+                if r.matches(site):
+                    r.calls += 1
+                    if r.fires(r.calls, self._rng):
+                        out.append(r)
+        return out
+
+
+# ------------------------------------------------------------ module state
+
+_installed: FaultPlan | None = None
+_env_cache: tuple[str, FaultPlan] | None = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Activate a plan for the whole process (tests)."""
+    global _installed
+    _installed = plan
+    return plan
+
+
+def install_spec(spec: str) -> FaultPlan:
+    return install(FaultPlan.from_spec(spec))
+
+
+def reset() -> None:
+    global _installed, _env_cache
+    _installed = None
+    _env_cache = None
+
+
+def active() -> FaultPlan | None:
+    """The installed plan, else one compiled from TRIVY_TPU_FAULTS."""
+    if _installed is not None:
+        return _installed
+    spec = os.environ.get(ENV_VAR, "")
+    if not spec:
+        return None
+    global _env_cache
+    if _env_cache is None or _env_cache[0] != spec:
+        _env_cache = (spec, FaultPlan.from_spec(spec))
+    return _env_cache[1]
+
+
+def fire(site: str) -> list[Rule]:
+    plan = active()
+    return plan.fire(site) if plan is not None else []
+
+
+def validate_env() -> None:
+    """Compile the TRIVY_TPU_FAULTS spec now so an operator typo fails
+    at process startup with a clean FaultSpecError naming the bad rule,
+    not mid-scan at the first instrumented call site."""
+    spec = os.environ.get(ENV_VAR, "")
+    if spec:
+        FaultPlan.from_spec(spec)
+
+
+# ------------------------------------------------------------ site helpers
+
+def rpc_site(path: str) -> str:
+    """Map an RPC URL path onto a dotted fault site."""
+    tail = path.rsplit("/", 1)[-1]
+    if "/trivy.cache." in path:
+        return f"rpc.cache.{tail}"
+    if tail == "Scan":
+        return "rpc.scan"
+    return f"rpc.{tail}"
+
+
+def check_device(site: str = "engine") -> None:
+    """Raise DeviceLost when a device-lost rule fires for `site`."""
+    for r in fire(site):
+        if r.action == "device-lost":
+            raise DeviceLost(f"injected device loss at {site}")
+
+
+def corrupt_bytes(raw: bytes) -> bytes:
+    """Deterministically mangle a response body so decoding fails."""
+    return b"\xff\x00corrupted\x00" + raw[: len(raw) // 2]

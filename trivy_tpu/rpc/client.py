@@ -3,25 +3,37 @@
 
 RemoteDriver implements the scanner Driver seam over HTTP; RemoteCache
 implements the ArtifactCache write interface so analysis results land in
-the server's cache. Both retry transient failures with backoff
-(reference pkg/rpc/retry.go).
+the server's cache. Transient failures retry under a RetryPolicy with
+decorrelated jitter; 503 responses honor Retry-After; the ambient
+per-scan deadline budget (resilience.retry.deadline_scope) rides the
+X-Trivy-Deadline header and bounds both the per-request socket timeout
+and the total retry loop. Fault-injection rules (resilience.faults)
+are consulted before every request so degraded-network behavior is
+testable deterministically.
 """
 
 from __future__ import annotations
 
 import json
-import time
+import random
 import urllib.error
 import urllib.request
 
 from trivy_tpu.log import logger
+from trivy_tpu.resilience import faults
+from trivy_tpu.resilience.retry import (
+    DEADLINE_HEADER,
+    DeadlineExceeded,
+    RetryPolicy,
+    current_deadline,
+    parse_retry_after,
+)
 from trivy_tpu.rpc import wire
 from trivy_tpu.rpc.server import CACHE_PREFIX, SCAN_PATH
 
 _log = logger("rpc.client")
 
-RETRIES = 3
-BACKOFF_S = 0.5
+DEFAULT_RETRY = RetryPolicy(attempts=3, base_s=0.5, cap_s=10.0)
 
 
 class RPCError(Exception):
@@ -30,11 +42,14 @@ class RPCError(Exception):
 
 class _Conn:
     def __init__(self, url: str, token: str | None = None,
-                 custom_headers: dict | None = None, timeout: float = 300.0):
+                 custom_headers: dict | None = None, timeout: float = 300.0,
+                 retry: RetryPolicy | None = None):
         self.base = url.rstrip("/")
         self.token = token
         self.custom_headers = custom_headers or {}
         self.timeout = timeout
+        self.retry = retry or DEFAULT_RETRY
+        self._rng = random.Random(self.retry.seed)
 
     def post(self, path: str, body: bytes) -> bytes:
         # the extended-fidelity internal encoding is marked so the server
@@ -44,24 +59,81 @@ class _Conn:
                    **self.custom_headers}
         if self.token:
             headers["Trivy-Token"] = self.token
+        policy = self.retry
+        deadline = current_deadline()
+        delays = policy.delays(self._rng)
+        site = faults.rpc_site(path)
         last_err: Exception | None = None
-        for attempt in range(RETRIES):
-            req = urllib.request.Request(
-                self.base + path, data=body, headers=headers, method="POST"
-            )
+        for attempt in range(policy.attempts):
+            if deadline is not None and deadline.expired:
+                raise DeadlineExceeded(
+                    f"rpc to {self.base}{path}: deadline of "
+                    f"{deadline.budget_s:.3f}s exhausted"
+                    + (f" (last error: {last_err})" if last_err else ""),
+                    budget_s=deadline.budget_s)
+            hdrs = dict(headers)
+            if deadline is not None:
+                hdrs[DEADLINE_HEADER] = deadline.header_value()
+            retry_after: float | None = None
+            corrupt = False
             try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                    return r.read()
+                for rule in faults.fire(site):
+                    if rule.action == "delay":
+                        policy.sleep(rule.param or 0.0)
+                    elif rule.action == "drop":
+                        raise urllib.error.URLError(
+                            ConnectionRefusedError("injected drop"))
+                    elif rule.action == "timeout":
+                        raise TimeoutError("injected timeout")
+                    elif rule.action == "error":
+                        raise faults.InjectedHTTPError(
+                            int(rule.param or 503))
+                    elif rule.action == "corrupt":
+                        corrupt = True
+                req = urllib.request.Request(
+                    self.base + path, data=body, headers=hdrs, method="POST"
+                )
+                timeout = self.timeout
+                if deadline is not None:
+                    # small grace past the budget: a deadline-aware
+                    # server sheds AT the deadline and replies 503 +
+                    # Retry-After — waiting a moment longer turns a
+                    # blind socket timeout into that definite answer
+                    timeout = max(0.001, min(
+                        timeout, deadline.remaining() + 0.5))
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    raw = r.read()
+                return faults.corrupt_bytes(raw) if corrupt else raw
+            except faults.InjectedHTTPError as exc:
+                if exc.code < 500:
+                    raise RPCError(f"{exc.code}: {exc}") from exc
+                last_err = RPCError(f"{exc.code}: {exc}")
             except urllib.error.HTTPError as exc:
                 detail = exc.read().decode("utf-8", "replace")[:500]
                 if exc.code < 500:  # 4xx is deterministic — don't retry
                     raise RPCError(f"{exc.code}: {detail}") from exc
                 last_err = RPCError(f"{exc.code}: {detail}")
+                if exc.code == 503 and policy.respect_retry_after:
+                    retry_after = parse_retry_after(
+                        exc.headers.get("Retry-After"))
             except (urllib.error.URLError, OSError, TimeoutError) as exc:
                 last_err = exc
-            if attempt < RETRIES - 1:
-                time.sleep(BACKOFF_S * (2 ** attempt))
-        raise RPCError(f"rpc to {self.base}{path} failed: {last_err}")
+            if attempt < policy.attempts - 1:
+                delay = next(delays)
+                if retry_after is not None:
+                    # the server told us when it expects to recover;
+                    # never retry earlier than that
+                    delay = max(delay, retry_after)
+                if deadline is not None and deadline.remaining() <= delay:
+                    raise DeadlineExceeded(
+                        f"rpc to {self.base}{path}: deadline of "
+                        f"{deadline.budget_s:.3f}s leaves no room to retry "
+                        f"(last error: {last_err})",
+                        budget_s=deadline.budget_s)
+                policy.sleep(delay)
+        raise RPCError(
+            f"rpc to {self.base}{path} failed after {policy.attempts} "
+            f"attempts: {last_err}")
 
 
 class RemoteDriver:
@@ -69,8 +141,9 @@ class RemoteDriver:
     (reference pkg/rpc/client/client.go:48-73)."""
 
     def __init__(self, url: str, token: str | None = None,
-                 custom_headers: dict | None = None):
-        self.conn = _Conn(url, token, custom_headers)
+                 custom_headers: dict | None = None,
+                 retry: RetryPolicy | None = None):
+        self.conn = _Conn(url, token, custom_headers, retry=retry)
 
     def scan(self, target, artifact_key, blob_keys, options):
         body = wire.scan_request(target, artifact_key, blob_keys, options)
@@ -83,8 +156,9 @@ class RemoteCache:
     blobs are written into the SERVER's cache; reads happen server-side."""
 
     def __init__(self, url: str, token: str | None = None,
-                 custom_headers: dict | None = None):
-        self.conn = _Conn(url, token, custom_headers)
+                 custom_headers: dict | None = None,
+                 retry: RetryPolicy | None = None):
+        self.conn = _Conn(url, token, custom_headers, retry=retry)
 
     def put_artifact(self, artifact_id: str, info) -> None:
         self.conn.post(CACHE_PREFIX + "PutArtifact", wire.encode(

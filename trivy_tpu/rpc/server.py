@@ -25,12 +25,26 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import trivy_tpu
 from trivy_tpu.log import logger
+from trivy_tpu.resilience.retry import (
+    DEADLINE_HEADER,
+    Deadline,
+    DeadlineExceeded,
+    deadline_scope,
+)
 from trivy_tpu.rpc import wire
 
 _log = logger("server")
 
 SCAN_PATH = "/twirp/trivy.scanner.v1.Scanner/Scan"
 CACHE_PREFIX = "/twirp/trivy.cache.v1.Cache/"
+
+
+class Overloaded(Exception):
+    """The server sheds this request instead of blocking (503)."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
 class _RWLock:
@@ -42,13 +56,25 @@ class _RWLock:
         self._writing = False
         self._writers_waiting = 0
 
-    def acquire_read(self):
+    def acquire_read(self, timeout: float | None = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             # writer preference: new readers queue behind a waiting
             # writer so the DB swap cannot starve under scan load
             while self._writing or self._writers_waiting:
-                self._cond.wait()
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
             self._readers += 1
+            return True
+
+    @property
+    def write_busy(self) -> bool:
+        """A writer holds or is waiting for the lock (DB swap underway)."""
+        with self._cond:
+            return self._writing or bool(self._writers_waiting)
 
     def release_read(self):
         with self._cond:
@@ -84,6 +110,7 @@ class Metrics:
         self.scan_seconds_sum = 0.0
         self.findings_total = 0
         self.db_reloads_total = 0
+        self.scans_shed_total = 0
 
     def record(self, seconds: float, findings: int = 0,
                error: bool = False) -> None:
@@ -103,6 +130,7 @@ class Metrics:
                  round(self.scan_seconds_sum, 6)),
                 ("trivy_tpu_findings_total", self.findings_total),
                 ("trivy_tpu_db_reloads_total", self.db_reloads_total),
+                ("trivy_tpu_scans_shed_total", self.scans_shed_total),
             ]
         out = []
         for name, value in rows:
@@ -152,21 +180,56 @@ class ScanService:
         except (OSError, ValueError):
             return ()
 
-    def scan(self, target, artifact_key, blob_keys, options):
+    def ready(self) -> tuple[bool, str]:
+        """Readiness (distinct from liveness): not ready while the
+        advisory-DB swap holds/awaits the write lock or before an
+        engine is loaded. /healthz stays a pure liveness probe."""
+        if self.engine is None:
+            return False, "engine not loaded"
+        if self.lock.write_busy:
+            return False, "advisory-DB swap in progress"
+        return True, "ok"
+
+    def scan(self, target, artifact_key, blob_keys, options,
+             deadline: Deadline | None = None):
         import time
 
         from trivy_tpu.scanner.local import LocalDriver
 
-        self.lock.acquire_read()
+        timeout = None
+        if deadline is not None:
+            timeout = deadline.remaining()
+            if timeout <= 0:
+                with self.metrics._lock:
+                    self.metrics.scans_shed_total += 1
+                raise Overloaded("deadline budget exhausted before scan "
+                                 "start", retry_after=1.0)
+        if not self.lock.acquire_read(timeout=timeout):
+            # a DB swap holds the write lock and the caller's budget ran
+            # out waiting: shed instead of blocking behind the swap
+            with self.metrics._lock:
+                self.metrics.scans_shed_total += 1
+            raise Overloaded(
+                "server busy (advisory-DB swap in progress); deadline "
+                f"budget of {deadline.budget_s:.3f}s exhausted waiting",
+                retry_after=1.0)
         start = time.perf_counter()
         try:
             driver = LocalDriver(self.engine, self.cache)
-            results, os_found = driver.scan(
-                target, artifact_key, blob_keys, options)
+            with deadline_scope(deadline):
+                results, os_found = driver.scan(
+                    target, artifact_key, blob_keys, options)
             self.metrics.record(
                 time.perf_counter() - start,
                 findings=sum(len(r.vulnerabilities) for r in results))
             return results, os_found
+        except DeadlineExceeded:
+            # mid-scan deadline checkpoints fired. Sheds count ONLY in
+            # scans_shed_total (consistent with the pre-lock shed path):
+            # a caller-imposed budget running out is not a scan error
+            with self.metrics._lock:
+                self.metrics.scans_shed_total += 1
+            raise
         except Exception:
             self.metrics.record(time.perf_counter() - start, error=True)
             raise
@@ -218,12 +281,22 @@ def _make_handler(service: ScanService, token: str | None,
             return ok
 
         def _reply(self, code: int, body: bytes,
-                   ctype: str = "application/json"):
+                   ctype: str = "application/json",
+                   extra_headers: dict | None = None):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (extra_headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
+
+        def _shed(self, msg: str, retry_after: float):
+            """503 + Retry-After: the reply a well-behaved client backs
+            off on instead of hammering a busy server."""
+            self._reply(
+                503, json.dumps({"error": msg}).encode(),
+                extra_headers={"Retry-After": f"{max(retry_after, 0.0):g}"})
 
         def _error(self, code: int, msg: str):
             self._reply(code, json.dumps({"error": msg}).encode())
@@ -236,6 +309,12 @@ def _make_handler(service: ScanService, token: str | None,
         def do_GET(self):
             if self.path == "/healthz":
                 self._reply(200, b"ok", "text/plain")
+            elif self.path == "/readyz":
+                ok, why = service.ready()
+                if ok:
+                    self._reply(200, b"ok", "text/plain")
+                else:
+                    self._shed(f"not ready: {why}", retry_after=1.0)
             elif self.path == "/version":
                 self._reply(200, json.dumps(
                     {"Version": trivy_tpu.__version__}).encode())
@@ -283,7 +362,19 @@ def _make_handler(service: ScanService, token: str | None,
 
         def _handle_scan(self, body: bytes):
             target, akey, blobs, options = wire.decode_scan_request(body)
-            results, os_found = service.scan(target, akey, blobs, options)
+            deadline = Deadline.from_header(
+                self.headers.get(DEADLINE_HEADER))
+            try:
+                results, os_found = service.scan(
+                    target, akey, blobs, options, deadline=deadline)
+            except Overloaded as exc:
+                _log.warn("scan shed", err=str(exc))
+                self._shed(str(exc), exc.retry_after)
+                return
+            except DeadlineExceeded as exc:
+                _log.warn("scan shed mid-flight", err=str(exc))
+                self._shed(str(exc), 1.0)
+                return
             self._reply(200, wire.scan_response(results, os_found))
 
         def _handle_cache(self, method: str, body: bytes):

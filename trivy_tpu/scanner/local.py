@@ -33,9 +33,15 @@ class LocalDriver:
         self.post_hooks = post_hooks or []
 
     def scan(self, target, artifact_key, blob_keys, options: ScanOptions):
+        from trivy_tpu.resilience.retry import checkpoint
         from trivy_tpu.scanner import post
         from trivy_tpu.utils import trace
 
+        # phase-boundary deadline checkpoints: under an ambient deadline
+        # budget (server header / --scan-timeout) a scan that cannot
+        # finish sheds promptly between phases instead of burning device
+        # time nobody will wait for
+        checkpoint("apply_layers")
         with trace.span("apply_layers"):
             detail = self._apply_layers(blob_keys)
             self._merge_artifact_info(detail, artifact_key)
@@ -51,10 +57,13 @@ class LocalDriver:
         if "rekor" in (options.sbom_sources or []):
             from trivy_tpu.fanal.unpackaged import discover_sboms
 
+            checkpoint("rekor_sbom_discovery")
             with trace.span("rekor_sbom_discovery"):
                 discover_sboms(detail, options.rekor_url)
+        checkpoint("detect")
         with trace.span("detect"):
             results = self._scan_detail(target, detail, options)
+        checkpoint("post_hooks")
         with trace.span("post_hooks"):
             for hook in self.post_hooks:
                 results = hook(results, options)

@@ -368,6 +368,11 @@ class Metadata(JSONMixin):
     repo_tags: list[str] = field(default_factory=list)
     repo_digests: list[str] = field(default_factory=list)
     image_config: dict = field(default_factory=dict)
+    # non-empty when the scan degraded to a fallback path (circuit
+    # breaker open / deadline exhausted / remote failure); the value is
+    # the human-readable reason. Consumers use it to tell a fallback
+    # scan from a primary one (docs/resilience.md).
+    degraded: str = ""
 
     def to_dict(self) -> dict:
         out: dict[str, Any] = {}
@@ -385,6 +390,8 @@ class Metadata(JSONMixin):
             out["RepoDigests"] = self.repo_digests
         if self.image_config:
             out["ImageConfig"] = self.image_config
+        if self.degraded:
+            out["Degraded"] = self.degraded
         return out
 
 

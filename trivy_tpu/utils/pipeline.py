@@ -15,48 +15,77 @@ R = TypeVar("R")
 DEFAULT_WORKERS = 5  # reference pkg/parallel/pipeline.go:10
 
 
+class PipelineError(Exception):
+    """Aggregate of every failed pipeline slot, index-matched to the
+    input order — no worker error is silently dropped."""
+
+    def __init__(self, failures: list[tuple[int, Exception]], total: int):
+        self.failures = failures
+        detail = "; ".join(f"item {i}: {e}" for i, e in failures[:8])
+        if len(failures) > 8:
+            detail += f"; ... {len(failures) - 8} more"
+        super().__init__(
+            f"{len(failures)}/{total} pipeline items failed: {detail}")
+
+
 def run_pipeline(items: Iterable[T], fn: Callable[[T], R],
                  on_result: Callable[[R], None] | None = None,
                  workers: int = DEFAULT_WORKERS) -> list[R]:
     """Run fn over items with a bounded worker pool; results are returned
     in input order. on_result (if given) is called serially, in order —
-    the reference's onItem callback contract."""
-    items = list(items)
-    if workers <= 1 or len(items) <= 1:
-        out = [fn(it) for it in items]
-        if on_result:
-            for r in out:
-                on_result(r)
-        return out
+    the reference's onItem callback contract.
 
+    Worker errors do not vanish: on_result is skipped for failed slots
+    and all failures surface together as one index-matched
+    PipelineError after the successful slots' callbacks have been
+    delivered. In parallel mode every item still runs (the pool drains
+    the queue regardless); sequential mode stays fail-fast."""
+    items = list(items)
     results: list = [None] * len(items)
     errors: list = [None] * len(items)
-    q: queue.Queue = queue.Queue()
-    for i, it in enumerate(items):
-        q.put((i, it))
+    ran = len(items)  # slots actually attempted (sequential fail-fast)
 
-    def worker():
-        while True:
-            try:
-                i, it = q.get_nowait()
-            except queue.Empty:
-                return
+    if workers <= 1 or len(items) <= 1:
+        # sequential mode keeps fail-fast (no worker pool is draining
+        # anyway): stop at the first error instead of burning the
+        # remaining items' cost, but surface it as the same aggregate
+        # exception type the parallel path raises
+        for i, it in enumerate(items):
             try:
                 results[i] = fn(it)
-            except Exception as e:  # surfaced after join, index-matched
+            except Exception as e:
                 errors[i] = e
-            finally:
-                q.task_done()
+                ran = i + 1
+                break
+    else:
+        q: queue.Queue = queue.Queue()
+        for i, it in enumerate(items):
+            q.put((i, it))
 
-    threads = [threading.Thread(target=worker, daemon=True)
-               for _ in range(min(workers, len(items)))]
-    for t in threads:
-        t.start()
-    q.join()
-    for e in errors:
-        if e is not None:
-            raise e
+        def worker():
+            while True:
+                try:
+                    i, it = q.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    results[i] = fn(it)
+                except Exception as e:  # surfaced after join, index-matched
+                    errors[i] = e
+                finally:
+                    q.task_done()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(min(workers, len(items)))]
+        for t in threads:
+            t.start()
+        q.join()
+
     if on_result:
-        for r in results:
-            on_result(r)
+        for i in range(ran):
+            if errors[i] is None:  # failed/unran slots explicitly skipped
+                on_result(results[i])
+    failures = [(i, e) for i, e in enumerate(errors) if e is not None]
+    if failures:
+        raise PipelineError(failures, len(items))
     return results
