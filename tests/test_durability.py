@@ -1,0 +1,850 @@
+"""Crash-safety / state-durability matrix (tier-1-safe, CPU-only,
+deterministic — docs/durability.md):
+
+- atomic-write primitives + checksum framing + stale-tmp sweep
+- FSCache: corrupt-entry self-healing, collision-free keys + legacy
+  shim, TOCTOU-free deletes
+- verified OCI layer fetch (digest/size), generation install crash
+  points (kill during extract / promote), last-good resolution
+- server DB hot-swap validation: corrupt candidate rejected,
+  quarantined, rolled back to last-good; /readyz reflects it
+- graceful drain: readyz flips, new scans shed, in-flight ones finish
+- scan journal: replay, torn tail, digest-sealed done records
+- fleet scans: --journal/--resume with byte-identical merged reports,
+  including the subprocess SIGKILL-mid-fleet smoke test
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trivy_tpu.cache import cache as cache_mod
+from trivy_tpu.cache.cache import FSCache, MemoryCache
+from trivy_tpu.db import Advisory, AdvisoryDB, generations
+from trivy_tpu.db.model import VulnerabilityMeta
+from trivy_tpu.db.oci import OCIError, verify_layer
+from trivy_tpu.detector.engine import MatchEngine
+from trivy_tpu.durability import atomic
+from trivy_tpu.durability.journal import JournalError, ScanJournal
+from trivy_tpu.resilience import faults
+from trivy_tpu.rpc.server import Server
+from trivy_tpu.types.scan import ScanOptions
+
+pytestmark = [pytest.mark.fault, pytest.mark.durability]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _db(updated_at: str = "2024-01-01T00:00:00Z") -> AdvisoryDB:
+    db = AdvisoryDB()
+    db.put_advisory("npm::ghsa", "lodash", Advisory(
+        vulnerability_id="CVE-2019-10744",
+        vulnerable_versions=["<4.17.12"],
+    ))
+    db.put_meta(VulnerabilityMeta.from_json("CVE-2019-10744", {
+        "Title": "prototype pollution", "Severity": "CRITICAL",
+    }))
+    db.meta.updated_at = updated_at
+    return db
+
+
+def _blob() -> dict:
+    return {
+        "schema_version": 2,
+        "applications": [{
+            "type": "npm",
+            "file_path": "package-lock.json",
+            "packages": [{
+                "id": "lodash@4.17.4", "name": "lodash",
+                "version": "4.17.4",
+                "identifier": {"purl": "pkg:npm/lodash@4.17.4"},
+            }],
+        }],
+    }
+
+
+# ------------------------------------------------------------ atomic
+
+
+def test_atomic_write_and_frame_roundtrip(tmp_path):
+    p = str(tmp_path / "f.json")
+    atomic.atomic_write(p, atomic.frame(b'{"a": 1}'))
+    with open(p, "rb") as f:
+        assert json.loads(atomic.unframe(f.read())) == {"a": 1}
+    # legacy payloads without a footer pass through unframed
+    assert atomic.unframe(b'{"bare": true}') == b'{"bare": true}'
+    with pytest.raises(atomic.CorruptEntry):
+        atomic.unframe(b"body" + atomic.CHECKSUM_MARK + b"0" * 64)
+
+
+def test_atomic_write_kill_before_rename_keeps_old(tmp_path):
+    """A crash after the tmp fsync but before the rename must leave the
+    previous version intact and only a sweepable tmp behind."""
+    p = str(tmp_path / "f.json")
+    atomic.atomic_write(p, b"old")
+    faults.set_kill_mode("raise")
+    faults.install_spec("site.commit:kill@1")
+    with pytest.raises(faults.InjectedKill):
+        atomic.atomic_write(p, b"new", fault_site="site")
+    with open(p, "rb") as f:
+        assert f.read() == b"old"
+    # the age gate protects a live writer's fresh tmp from a concurrent
+    # sweep; an aged-out orphan is collected
+    assert atomic.sweep_stale_tmp(str(tmp_path)) == 0
+    assert atomic.sweep_stale_tmp(str(tmp_path), min_age_s=0.0) == 1
+    faults.reset()
+    atomic.atomic_write(p, b"new", fault_site="site")
+    with open(p, "rb") as f:
+        assert f.read() == b"new"
+
+
+# ------------------------------------------------------------ cache
+
+
+def test_fscache_corrupt_entry_evicted_and_counted(tmp_path):
+    faults.install_spec("cache.write:bitflip@1")
+    c = FSCache(str(tmp_path))
+    before = cache_mod.corrupt_evictions()
+    c.put_blob("sha256:b", _blob())       # lands with one bit flipped
+    assert c.get_blob("sha256:b") == {}   # detected -> evicted -> miss
+    assert cache_mod.corrupt_evictions() == before + 1
+    assert not os.path.exists(c._path("blob", "sha256:b"))  # evicted
+    # the miss self-heals: a rewrite (no fault) serves normally
+    faults.reset()
+    c.put_blob("sha256:b", _blob())
+    assert c.get_blob("sha256:b") == _blob()
+
+
+def test_fscache_torn_write_is_a_miss_not_a_crash(tmp_path):
+    faults.install_spec("cache.write:torn-write@1")
+    c = FSCache(str(tmp_path))
+    before = cache_mod.corrupt_evictions()
+    c.put_blob("sha256:t", _blob())
+    assert c.get_blob("sha256:t") == {}   # no json.JSONDecodeError
+    assert cache_mod.corrupt_evictions() == before + 1
+    missing_artifact, missing = c.missing_blobs("sha256:a", ["sha256:t"])
+    # the torn entry was evicted on read, so it is missing again
+    assert missing == ["sha256:t"]
+
+
+def test_fscache_missing_blobs_detects_corruption_before_scan(tmp_path):
+    """A corrupt blob must read as MISSING at the missing_blobs
+    checkpoint — so the layer is re-analyzed NOW instead of the scan
+    dying later on a get_blob miss it was told would hit."""
+    c = FSCache(str(tmp_path))
+    c.put_blob("sha256:c", _blob())
+    missing_artifact, missing = c.missing_blobs("x", ["sha256:c"])
+    assert missing == []                  # intact -> present
+    with open(c._path("blob", "sha256:c"), "r+b") as f:  # rot one byte
+        f.seek(10)
+        f.write(b"\xff")
+    missing_artifact, missing = c.missing_blobs("x", ["sha256:c"])
+    assert missing == ["sha256:c"]        # corrupt -> re-analyze
+
+
+def test_fscache_kill_during_write_preserves_previous_entry(tmp_path):
+    c = FSCache(str(tmp_path))
+    c.put_blob("sha256:k", {"v": 1})
+    faults.set_kill_mode("raise")
+    faults.install_spec("cache.write.commit:kill@1")
+    with pytest.raises(faults.InjectedKill):
+        c.put_blob("sha256:k", {"v": 2})
+    faults.reset()
+    # "next start": a fresh FSCache still serves the previous durable
+    # value; the orphan tmp is invisible garbage until it ages out of
+    # the sweep's protection window
+    c2 = FSCache(str(tmp_path))
+    assert c2.get_blob("sha256:k") == {"v": 1}
+    blob_dir = os.path.join(c2.root, "blob")
+    assert [n for n in os.listdir(blob_dir) if ".tmp-" in n]
+    assert atomic.sweep_stale_tmp(blob_dir, min_age_s=0.0) == 1
+    assert c2.get_blob("sha256:k") == {"v": 1}
+
+
+def test_fscache_key_mangling_collision_fixed(tmp_path):
+    """'a/b' and 'a:b' used to share one file; now they must not."""
+    c = FSCache(str(tmp_path))
+    c.put_blob("a/b", {"who": "slash"})
+    c.put_blob("a:b", {"who": "colon"})
+    assert c.get_blob("a/b") == {"who": "slash"}
+    assert c.get_blob("a:b") == {"who": "colon"}
+    assert c._path("blob", "a/b") != c._path("blob", "a:b")
+
+
+def test_fscache_legacy_entries_still_readable_and_migrate(tmp_path):
+    c = FSCache(str(tmp_path))
+    legacy = c._legacy_path("blob", "sha256:old")
+    with open(legacy, "w") as f:
+        json.dump({"legacy": True}, f)    # pre-durability writer
+    assert c._path("blob", "sha256:old") != legacy
+    missing_artifact, missing = c.missing_blobs("x", ["sha256:old"])
+    assert missing == []                  # shim sees the legacy file
+    assert c.get_blob("sha256:old") == {"legacy": True}
+    # migrated: new (checksummed) path exists, legacy is gone
+    assert os.path.exists(c._path("blob", "sha256:old"))
+    assert not os.path.exists(legacy)
+    assert c.get_blob("sha256:old") == {"legacy": True}
+
+
+def test_fscache_delete_toctou_race_is_silent(tmp_path):
+    """Concurrent scanners deleting the same blobs must not crash each
+    other (the old exists()-then-unlink raced)."""
+    c = FSCache(str(tmp_path))
+    c.put_blob("sha256:r", _blob())
+    real_unlink = os.unlink
+
+    def racing_unlink(path):
+        real_unlink(path)                 # the "other scanner" wins…
+        real_unlink(path)                 # …then our unlink races: ENOENT
+
+    import unittest.mock as mock
+
+    with mock.patch("trivy_tpu.cache.cache.os.unlink",
+                    side_effect=racing_unlink):
+        c.delete_blobs(["sha256:r"])      # must not raise
+    c.delete_blobs(["sha256:never-existed"])
+    c.clear()
+    c.clear()                             # idempotent
+
+
+# ------------------------------------------------------------ oci verify
+
+
+def test_verify_layer_digest_and_size():
+    data = b"advisory-layer-bytes"
+    good = {"digest": "sha256:" + hashlib.sha256(data).hexdigest(),
+            "size": len(data)}
+    verify_layer(good, data)              # no raise
+    with pytest.raises(OCIError, match="digest mismatch"):
+        verify_layer(dict(good, digest="sha256:" + "0" * 64), data)
+    with pytest.raises(OCIError, match="size mismatch"):
+        verify_layer(dict(good, size=len(data) + 1), data)
+    with pytest.raises(OCIError, match="no digest"):
+        verify_layer({"size": len(data)}, data)
+    with pytest.raises(OCIError, match="digest mismatch"):
+        # no declared size: the torn payload must still die on digest
+        verify_layer({"digest": good["digest"]}, data + b"torn")
+
+
+def _db_layer_tgz(updated_at: str) -> bytes:
+    """A valid advisory-DB artifact layer (tar.gz of a saved DB)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        _db(updated_at).save(d)
+        payload = io.BytesIO()
+        with tarfile.open(fileobj=payload, mode="w") as tf:
+            for name in sorted(os.listdir(d)):
+                tf.add(os.path.join(d, name), arcname=name)
+        return gzip.compress(payload.getvalue())
+
+
+def _fake_fetch(monkeypatch, data: bytes):
+    digest = "sha256:" + hashlib.sha256(data).hexdigest()
+    from trivy_tpu.db import oci
+
+    monkeypatch.setattr(
+        oci, "_fetch_layer", lambda *a, **k: (data, digest))
+    return digest
+
+
+def test_install_artifact_generation_layout(tmp_path, monkeypatch):
+    from trivy_tpu.db.oci import install_artifact
+
+    root = str(tmp_path / "db")
+    digest = _fake_fetch(monkeypatch, _db_layer_tgz("2024-01-01T00:00:00Z"))
+    gen = install_artifact("reg.io/db:2", root)
+    assert gen == os.path.join(root, "generations",
+                               generations.gen_name(digest))
+    assert os.path.realpath(generations.resolve(root)) == \
+        os.path.realpath(gen)
+    db = AdvisoryDB.load(root)            # reads through last-good
+    assert db.stats()["advisories"] == 1
+    # reinstall of the same digest is an idempotent promote
+    assert install_artifact("reg.io/db:2", root) == gen
+
+
+def test_install_artifact_kill_during_extract_recovers(tmp_path,
+                                                       monkeypatch):
+    """Acceptance: SIGKILL during DB extract — next start has no
+    last-good damage, and a re-install completes."""
+    from trivy_tpu.db.oci import install_artifact
+
+    root = str(tmp_path / "db")
+    _fake_fetch(monkeypatch, _db_layer_tgz("2024-01-01T00:00:00Z"))
+    faults.set_kill_mode("raise")
+    faults.install_spec("db.install.extract:kill@1")
+    with pytest.raises(faults.InjectedKill):
+        install_artifact("reg.io/db:2", root)
+    assert generations.current_generation(root) is None
+    with pytest.raises(FileNotFoundError):
+        AdvisoryDB.load(root)             # nothing half-installed served
+    leftovers = os.listdir(generations.generations_root(root))
+    assert leftovers and all(".tmp-" in n for n in leftovers)
+    faults.reset()
+    gen = install_artifact("reg.io/db:2", root)   # sweeps + completes
+    assert generations.current_generation(root) == os.path.realpath(gen)
+    assert not [n for n in os.listdir(generations.generations_root(root))
+                if ".tmp-" in n]
+    assert AdvisoryDB.load(root).stats()["advisories"] == 1
+
+
+def test_install_artifact_kill_before_promote_serves_old(tmp_path,
+                                                         monkeypatch):
+    """Acceptance: SIGKILL during the DB swap (between generation
+    rename and last-good promotion) — the old DB keeps being served,
+    re-install promotes the already-staged generation."""
+    from trivy_tpu.db.oci import install_artifact
+
+    root = str(tmp_path / "db")
+    _fake_fetch(monkeypatch, _db_layer_tgz("2024-01-01T00:00:00Z"))
+    old_gen = install_artifact("reg.io/db:2", root)
+
+    _fake_fetch(monkeypatch, _db_layer_tgz("2024-02-02T00:00:00Z"))
+    faults.set_kill_mode("raise")
+    faults.install_spec("db.install.promote:kill@1")
+    with pytest.raises(faults.InjectedKill):
+        install_artifact("reg.io/db:2", root)
+    # crash window: new generation staged, last-good still the old one
+    assert generations.current_generation(root) == os.path.realpath(old_gen)
+    assert AdvisoryDB.load(root).meta.updated_at == "2024-01-01T00:00:00Z"
+    faults.reset()
+    new_gen = install_artifact("reg.io/db:2", root)
+    assert new_gen != old_gen
+    assert generations.current_generation(root) == os.path.realpath(new_gen)
+    assert AdvisoryDB.load(root).meta.updated_at == "2024-02-02T00:00:00Z"
+
+
+def test_install_artifact_rejects_invalid_db_before_promote(tmp_path,
+                                                            monkeypatch):
+    """last-good must only ever point at a validated generation: a
+    digest-correct but empty DB is refused at install time (local scans
+    have no server-side validation to save them)."""
+    import tempfile
+
+    from trivy_tpu.db.oci import install_artifact
+
+    root = str(tmp_path / "db")
+    with tempfile.TemporaryDirectory() as d:
+        empty = AdvisoryDB()
+        empty.meta.updated_at = "2024-01-01T00:00:00Z"
+        empty.save(d)
+        payload = io.BytesIO()
+        with tarfile.open(fileobj=payload, mode="w") as tf:
+            for n in sorted(os.listdir(d)):
+                tf.add(os.path.join(d, n), arcname=n)
+        data = gzip.compress(payload.getvalue())
+    _fake_fetch(monkeypatch, data)
+    with pytest.raises(OCIError, match="failed validation"):
+        install_artifact("reg.io/db:2", root)
+    assert generations.current_generation(root) is None
+    assert generations.list_generations(root) == []  # staging cleaned
+
+
+def test_install_artifact_refuses_quarantined_digest(tmp_path,
+                                                     monkeypatch):
+    """A digest the server quarantined must not be silently
+    reinstalled by the next scheduled download."""
+    from trivy_tpu.db.oci import install_artifact
+
+    root = str(tmp_path / "db")
+    digest = _fake_fetch(monkeypatch, _db_layer_tgz("2024-01-01T00:00:00Z"))
+    gen = install_artifact("reg.io/db:2", root)
+    generations.quarantine(root, gen)
+    with pytest.raises(OCIError, match="previously quarantined"):
+        install_artifact("reg.io/db:2", root)
+    assert generations.current_generation(root) is None
+
+
+def test_db_import_supersedes_downloaded_generation(tmp_path,
+                                                    monkeypatch):
+    """`db import` after `db download` must take effect: the last-good
+    link is dropped so readers load the imported (flat) DB."""
+    import argparse
+
+    from trivy_tpu.cli.run import run_db
+    from trivy_tpu.db.oci import install_artifact
+
+    root = str(tmp_path / "db")
+    _fake_fetch(monkeypatch, _db_layer_tgz("2024-01-01T00:00:00Z"))
+    install_artifact("reg.io/db:2", root)
+    assert AdvisoryDB.load(root).meta.updated_at == "2024-01-01T00:00:00Z"
+
+    imported = _db("2024-05-05T00:00:00Z")
+    src = tmp_path / "imported"
+    imported.save(str(src))
+    args = argparse.Namespace(db_command="import", source=str(src),
+                              db_path=root, cache_dir=str(tmp_path))
+    assert run_db(args) == 0
+    assert not os.path.islink(generations.last_good_path(root))
+    assert AdvisoryDB.load(root).meta.updated_at == "2024-05-05T00:00:00Z"
+
+
+def test_torn_download_never_lands(tmp_path):
+    """A torn blob (fault at the db.download site) fails digest
+    verification inside _fetch_layer before any extraction."""
+    import trivy_tpu.db.oci as oci
+
+    class FakeClient:
+        def __init__(self, *a, **k):
+            pass
+
+        def manifest(self, repo, ref):
+            data = b"x" * 100
+            return {"layers": [{
+                "mediaType": oci.DB_MEDIA_TYPE,
+                "digest": "sha256:" + hashlib.sha256(data).hexdigest(),
+                "size": len(data)}]}, "sha256:m"
+
+        def blob(self, repo, digest):
+            return b"x" * 100
+
+    faults.install_spec("db.download:torn-write@1")
+    import unittest.mock as mock
+
+    with mock.patch.object(oci, "RegistryClient", FakeClient):
+        with pytest.raises(OCIError, match="size mismatch"):
+            oci.download_artifact("reg.io/db:2", str(tmp_path / "out"),
+                                  media_type=oci.DB_MEDIA_TYPE)
+    assert not os.path.exists(tmp_path / "out")
+
+
+# ------------------------------------------------------------ server swap
+
+
+def _generation_root(tmp_path, updated_at="2024-01-01T00:00:00Z"):
+    """db_root with one good generation promoted to last-good."""
+    root = str(tmp_path / "db")
+    gen = os.path.join(generations.generations_root(root), "sha256-aaa")
+    os.makedirs(gen)
+    _db(updated_at).save(gen)
+    generations.promote(root, gen)
+    return root, gen
+
+
+def test_server_rejects_corrupt_db_candidate_rolls_back(tmp_path):
+    """Acceptance: a torn/corrupt DB generation is never served — the
+    server stays on last-good, quarantines the bad generation, and
+    /readyz reflects the state."""
+    root, good_gen = _generation_root(tmp_path)
+    engine = MatchEngine(AdvisoryDB.load(root), use_device=False)
+    srv = Server(engine, MemoryCache(), host="localhost", port=0,
+                 db_path=root)
+    srv.start()
+    try:
+        svc = srv.service
+        # a corrupt candidate generation gets promoted (as a crashed or
+        # buggy downloader might)
+        bad_gen = os.path.join(generations.generations_root(root),
+                               "sha256-bbb")
+        os.makedirs(bad_gen)
+        with open(os.path.join(bad_gen, "trivy_tpu.db.json.gz"), "wb") as f:
+            f.write(b"\x1f\x8bthis is not gzip data")
+        with open(os.path.join(bad_gen, "metadata.json"), "w") as f:
+            json.dump({"Version": 2, "UpdatedAt": "2024-02-02T00:00:00Z"},
+                      f)
+        generations.promote(root, bad_gen)
+
+        old_engine = svc.engine
+        assert svc.maybe_reload_db() is False
+        assert svc.engine is old_engine           # still serving last-good
+        assert svc.metrics.db_reload_failures_total == 1
+        assert not os.path.isdir(bad_gen)         # quarantined
+        assert any(generations.QUARANTINE_SUFFIX in n for n in
+                   os.listdir(generations.generations_root(root)))
+        assert generations.current_generation(root) == \
+            os.path.realpath(good_gen)            # last-good restored
+        with urllib.request.urlopen(srv.address + "/readyz") as r:
+            body = r.read().decode()
+        assert "last-good" in body                # ready, and says why
+        with urllib.request.urlopen(srv.address + "/metrics") as r:
+            assert b"trivy_tpu_db_reload_failures_total 1" in r.read()
+
+        # scans still match against the last-good DB
+        svc.cache.put_blob("sha256:b", _blob())
+        results, _ = svc.scan("a", "", ["sha256:b"], ScanOptions())
+        assert [v.vulnerability_id for v in results[0].vulnerabilities] \
+            == ["CVE-2019-10744"]
+
+        # a later GOOD candidate still hot-swaps (rejection isn't sticky)
+        good2 = os.path.join(generations.generations_root(root),
+                             "sha256-ccc")
+        os.makedirs(good2)
+        _db("2024-03-03T00:00:00Z").save(good2)
+        generations.promote(root, good2)
+        assert svc.maybe_reload_db() is True
+        assert svc.engine is not old_engine
+        assert svc.db_degraded == ""
+        with urllib.request.urlopen(srv.address + "/readyz") as r:
+            assert r.read() == b"ok"
+    finally:
+        srv.shutdown()
+
+
+def test_server_rejects_empty_db_candidate(tmp_path):
+    root, _good = _generation_root(tmp_path)
+    engine = MatchEngine(AdvisoryDB.load(root), use_device=False)
+    srv = Server(engine, MemoryCache(), host="localhost", port=0,
+                 db_path=root)
+    try:
+        empty = os.path.join(generations.generations_root(root),
+                             "sha256-empty")
+        os.makedirs(empty)
+        e = AdvisoryDB()
+        e.meta.updated_at = "2024-02-02T00:00:00Z"
+        e.save(empty)
+        generations.promote(root, empty)
+        assert srv.service.maybe_reload_db() is False
+        assert "empty" in srv.service.db_degraded
+        assert srv.service.metrics.db_reload_failures_total == 1
+    finally:
+        srv.httpd.server_close()
+
+
+# ------------------------------------------------------------ drain
+
+
+class _GateCache(MemoryCache):
+    """get_blob blocks until released — holds a scan in flight."""
+
+    def __init__(self):
+        super().__init__()
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def get_blob(self, blob_id):
+        self.entered.set()
+        assert self.release.wait(10), "gate never released"
+        return super().get_blob(blob_id)
+
+
+def test_graceful_drain_contract():
+    """Acceptance: drain flips /readyz immediately, sheds new scans
+    with Retry-After, lets in-flight scans finish under the budget, and
+    counts them in trivy_tpu_drained_scans_total."""
+    cache = _GateCache()
+    cache.put_blob("sha256:b", _blob())
+    engine = MatchEngine(_db(), use_device=False)
+    srv = Server(engine, cache, host="localhost", port=0)
+    srv.start()
+    try:
+        box = {}
+
+        def inflight():
+            try:
+                box["results"] = srv.service.scan(
+                    "a", "", ["sha256:b"], ScanOptions())
+            except Exception as e:  # pragma: no cover - failure detail
+                box["error"] = e
+
+        t = threading.Thread(target=inflight, daemon=True)
+        t.start()
+        assert cache.entered.wait(10)
+
+        srv.service.start_drain()
+        # readiness flips at once; liveness stays green
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.address + "/readyz")
+        assert ei.value.code == 503
+        assert "draining" in json.loads(ei.value.read())["error"]
+        assert ei.value.headers.get("Retry-After")
+        with urllib.request.urlopen(srv.address + "/healthz") as r:
+            assert r.read() == b"ok"
+
+        # new scans shed instead of joining a dying server
+        from trivy_tpu.rpc import wire
+        from trivy_tpu.rpc.server import SCAN_PATH
+
+        req = urllib.request.Request(
+            srv.address + SCAN_PATH,
+            data=wire.scan_request("a", "", ["sha256:b"], ScanOptions()),
+            headers={"Content-Type": "application/json",
+                     "X-Trivy-Tpu-Wire": "internal"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After")
+
+        # drain budget too small: the in-flight scan is reported, not
+        # silently abandoned
+        assert srv.service.await_drained(0.05) == 1
+
+        # release the gate: the scan completes inside a real budget
+        cache.release.set()
+        assert srv.service.await_drained(10.0) == 0
+        t.join(10)
+        assert "results" in box
+        assert srv.service.metrics.drained_scans_total == 1
+        assert srv.service.metrics.scans_shed_total >= 1
+        with urllib.request.urlopen(srv.address + "/metrics") as r:
+            assert b"trivy_tpu_drained_scans_total 1" in r.read()
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------ journal
+
+
+def test_journal_create_resume_roundtrip(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    j = ScanJournal.create(p, "image", ["t1", "t2"], "sha256:fp")
+    j.mark_running("t1")
+    j.mark_done("t1", {"ArtifactName": "t1", "Results": []})
+    j.mark_running("t2")
+    j.mark_failed("t2", "boom")
+    j.close()
+    r = ScanJournal.resume(p)
+    assert r.targets == ["t1", "t2"]
+    assert r.command == "image" and r.fingerprint == "sha256:fp"
+    assert list(r.done) == ["t1"]
+    assert r.done["t1"]["ArtifactName"] == "t1"
+    assert r.failed == {"t2": "boom"}
+    # a done after a failure clears the failure
+    r.mark_done("t2", {"ArtifactName": "t2", "Results": []})
+    r.close()
+    r2 = ScanJournal.resume(p)
+    assert sorted(r2.done) == ["t1", "t2"] and not r2.failed
+
+
+def test_journal_torn_tail_tolerated_and_truncated(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    j = ScanJournal.create(p, "fs", ["t1", "t2"], "fp")
+    j.mark_done("t1", {"Results": []})
+    j.close()
+    with open(p, "ab") as f:              # the crash's torn final append
+        f.write(b'{"kind":"done","target":"t2","dig')
+    r = ScanJournal.resume(p)
+    assert list(r.done) == ["t1"]         # torn record never happened
+    # the fragment is truncated away, so a post-resume append starts a
+    # clean line and survives ANOTHER crash+resume intact
+    r.mark_done("t2", {"Results": []})
+    r.close()
+    r2 = ScanJournal.resume(p)
+    assert sorted(r2.done) == ["t1", "t2"]
+    r2.close()
+
+
+def test_journal_torn_done_record_reruns_artifact(tmp_path):
+    # torn-write fault on the 4th append (header, pending, running, DONE)
+    faults.install_spec("journal.append:torn-write@4")
+    p = str(tmp_path / "j.jsonl")
+    j = ScanJournal.create(p, "fs", ["t1"], "fp")
+    j.mark_running("t1")
+    j.mark_done("t1", {"Results": []})
+    j.close()
+    faults.reset()
+    r = ScanJournal.resume(p)
+    assert r.done == {}                   # not durable -> re-run
+
+
+def test_journal_bitflipped_done_record_fails_digest(tmp_path):
+    faults.install_spec("journal.append:bitflip@4")
+    p = str(tmp_path / "j.jsonl")
+    j = ScanJournal.create(p, "fs", ["t1"], "fp")
+    j.mark_running("t1")
+    j.mark_done("t1", {"Results": [], "ArtifactName": "t1"})
+    j.close()
+    faults.reset()
+    r = ScanJournal.resume(p)
+    assert r.done == {}                   # digest seal caught the flip
+
+
+def test_journal_refuses_duplicate_create_and_missing(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    ScanJournal.create(p, "fs", ["t"], "fp").close()
+    with pytest.raises(JournalError, match="already exists"):
+        ScanJournal.create(p, "fs", ["t"], "fp")
+    with pytest.raises(JournalError):
+        ScanJournal.resume(str(tmp_path / "nope.jsonl"))
+
+
+# ------------------------------------------------------------ fleet CLI
+
+
+PACKAGE_LOCK = json.dumps({
+    "name": "a", "lockfileVersion": 2, "requires": True,
+    "packages": {"": {"name": "a"},
+                 "node_modules/lodash": {"version": "4.17.4"}},
+})
+
+
+@pytest.fixture()
+def fleet_env(tmp_path, monkeypatch):
+    """Two fs targets + a fixture DB + deterministic clock/uuid."""
+    monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2024-01-01T00:00:00+00:00")
+    monkeypatch.setenv("TRIVY_TPU_DETERMINISTIC_UUID", "1")
+    p1 = tmp_path / "p1"
+    p2 = tmp_path / "p2"
+    p1.mkdir()
+    p2.mkdir()
+    (p1 / "package-lock.json").write_text(PACKAGE_LOCK)
+    (p2 / "requirements.txt").write_text("requests==2.19.0\n")
+    _db().save(str(tmp_path / "db"))
+    (tmp_path / "targets.txt").write_text(f"{p1}\n{p2}\n")
+    from trivy_tpu.cli import run as run_mod
+    from trivy_tpu.utils import uuid as uuid_util
+
+    run_mod._ENGINE_CACHE.clear()
+    uuid_util.reset()
+    return tmp_path
+
+
+def _fleet_args(env, extra):
+    return ["fs", str(env / "p1"), "--targets", str(env / "targets.txt"),
+            "--format", "json", "--db-path", str(env / "db"),
+            "--cache-dir", str(env / "cache"), "--no-tpu", "--quiet",
+            "--scanners", "vuln"] + extra
+
+
+def test_fleet_scan_and_noop_resume_byte_identical(fleet_env):
+    from trivy_tpu.cli.main import main
+
+    env = fleet_env
+    rc = main(_fleet_args(env, ["--journal", str(env / "j.jsonl"),
+                                "--output", str(env / "out.json")]))
+    assert rc == 0
+    doc = json.loads((env / "out.json").read_text())
+    assert doc["ArtifactType"] == "fleet" and len(doc["Reports"]) == 2
+    assert [r["ArtifactName"] for r in doc["Reports"]] == \
+        [str(env / "p1"), str(env / "p2")]
+    assert any(v["VulnerabilityID"] == "CVE-2019-10744"
+               for r in doc["Reports"][0]["Results"]
+               for v in r.get("Vulnerabilities") or [])
+
+    rc = main(_fleet_args(env, ["--resume", str(env / "j.jsonl"),
+                                "--output", str(env / "out2.json")]))
+    assert rc == 0
+    assert (env / "out.json").read_bytes() == (env / "out2.json").read_bytes()
+    # the no-op resume re-scanned nothing: one done record per target
+    dones = [json.loads(ln)["target"] for ln in
+             (env / "j.jsonl").read_text().splitlines()
+             if json.loads(ln)["kind"] == "done"]
+    assert sorted(dones) == sorted([str(env / "p1"), str(env / "p2")])
+
+
+def test_fleet_resume_refuses_changed_options(fleet_env):
+    from trivy_tpu.cli.main import main
+
+    env = fleet_env
+    assert main(_fleet_args(env, ["--journal", str(env / "j.jsonl"),
+                                  "--output", str(env / "out.json")])) == 0
+    rc = main(_fleet_args(env, ["--resume", str(env / "j.jsonl"),
+                                "--output", str(env / "out2.json"),
+                                "--severity", "LOW"]))
+    assert rc == 1                        # fingerprint mismatch -> refuse
+
+
+def test_fleet_failed_target_journaled_and_retried(fleet_env):
+    """A failed artifact is journaled as failed (not silently dropped)
+    and re-runs on --resume once fixed."""
+    from trivy_tpu.cli.main import main
+
+    env = fleet_env
+    bom = {
+        "bomFormat": "CycloneDX", "specVersion": "1.5", "version": 1,
+        "metadata": {"component": {"bom-ref": "root", "type": "container",
+                                   "name": "fleet-bom"}},
+        "components": [{
+            "bom-ref": "p1", "type": "library", "name": "lodash",
+            "version": "4.17.4", "purl": "pkg:npm/lodash@4.17.4",
+        }],
+    }
+    (env / "bom1.json").write_text(json.dumps(bom))
+    (env / "targets.txt").write_text(
+        f"{env / 'bom1.json'}\n{env / 'missing.json'}\n")
+
+    def sbom_args(extra):
+        return (["sbom", str(env / "bom1.json"),
+                 "--targets", str(env / "targets.txt"),
+                 "--format", "json", "--db-path", str(env / "db"),
+                 "--cache-dir", str(env / "cache"), "--no-tpu",
+                 "--quiet", "--scanners", "vuln"] + extra)
+
+    rc = main(sbom_args(["--journal", str(env / "j.jsonl"),
+                         "--output", str(env / "out.json")]))
+    assert rc == 1                        # aggregate failure surfaces
+    j = ScanJournal.resume(str(env / "j.jsonl"))
+    assert str(env / "bom1.json") in j.done
+    assert str(env / "missing.json") in j.failed
+    j.close()
+    # fix the target, resume: only the failed one re-runs
+    (env / "missing.json").write_text(json.dumps(bom))
+    rc = main(sbom_args(["--resume", str(env / "j.jsonl"),
+                         "--output", str(env / "out.json")]))
+    assert rc == 0
+    doc = json.loads((env / "out.json").read_text())
+    assert len(doc["Reports"]) == 2
+
+
+@pytest.mark.durability
+def test_fleet_sigkill_and_resume_smoke(fleet_env):
+    """Acceptance (CI smoke): a subprocess fleet scan SIGKILLed
+    mid-fleet by the `kill` fault resumes to a merged report
+    byte-identical to an uninterrupted run's."""
+    from trivy_tpu.cli.main import main
+
+    env = fleet_env
+    sub_env = dict(
+        os.environ,
+        TRIVY_TPU_FAULTS="fleet.scan:kill@2",
+        TRIVY_TPU_FAKE_TIME="2024-01-01T00:00:00+00:00",
+        TRIVY_TPU_DETERMINISTIC_UUID="1",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + [p for p in (os.environ.get("PYTHONPATH") or "").split(
+                os.pathsep) if p]),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "trivy_tpu.cli.main"]
+        + _fleet_args(env, ["--journal", str(env / "j.jsonl"),
+                            "--output", str(env / "out.json")]),
+        env=sub_env, capture_output=True, timeout=120)
+    assert proc.returncode == -9, proc.stderr.decode()  # SIGKILLed
+
+    # the journal survived the kill: target 1 durable, target 2 was
+    # in flight (running, no done)
+    kinds = [json.loads(ln) for ln in
+             (env / "j.jsonl").read_text().splitlines()]
+    assert [k["kind"] for k in kinds] == \
+        ["header", "pending", "pending", "running", "done", "running"]
+    assert kinds[4]["target"] == str(env / "p1")
+
+    # resume (no faults): completes the fleet without re-scanning p1
+    rc = main(_fleet_args(env, ["--resume", str(env / "j.jsonl"),
+                                "--output", str(env / "resumed.json")]))
+    assert rc == 0
+    dones = [k["target"] for k in (json.loads(ln) for ln in
+             (env / "j.jsonl").read_text().splitlines())
+             if k["kind"] == "done"]
+    assert dones.count(str(env / "p1")) == 1   # never re-scanned
+
+    # golden: the same fleet uninterrupted, fresh journal
+    from trivy_tpu.cli import run as run_mod
+    from trivy_tpu.utils import uuid as uuid_util
+
+    run_mod._ENGINE_CACHE.clear()
+    uuid_util.reset()
+    rc = main(_fleet_args(env, ["--journal", str(env / "golden.jsonl"),
+                                "--output", str(env / "golden.json")]))
+    assert rc == 0
+    assert (env / "resumed.json").read_bytes() == \
+        (env / "golden.json").read_bytes()

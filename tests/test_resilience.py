@@ -191,6 +191,52 @@ def test_breaker_state_machine():
     assert br.state == "closed"
 
 
+def test_breaker_half_open_concurrent_probes():
+    """Half-open under concurrent load: exactly one probe is admitted,
+    the losers fail fast (no pile-up on a recovering dependency)."""
+    import threading
+
+    clk = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, recovery_s=5.0, clock=clk,
+                        name="half-open")
+    br.record_failure()                     # open
+    clk.advance(5.0)                        # -> half-open on next tick
+
+    n = 8
+    barrier = threading.Barrier(n)
+    admitted, shed_fast = [], []
+
+    def probe():
+        barrier.wait()
+        start = time.monotonic()
+        if br.allow():
+            admitted.append(threading.get_ident())
+        else:
+            shed_fast.append(time.monotonic() - start)
+
+    threads = [threading.Thread(target=probe) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5)
+    assert len(admitted) == 1               # one trial slot, ever
+    assert len(shed_fast) == n - 1
+    assert all(dt < 1.0 for dt in shed_fast)  # losers fail fast, no wait
+    # losers keep being shed until the winner settles
+    assert not br.allow()
+    with pytest.raises(BreakerOpen):
+        br.call(lambda: "ok")
+
+    # winner's failure re-opens (timer restart): still nobody admitted
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    clk.advance(5.0)
+    assert br.allow()                       # fresh half-open, one slot
+    br.record_success()                     # winner settles -> closed
+    assert br.state == "closed"
+    assert all(br.allow() for _ in range(4))  # everyone flows again
+
+
 def test_breaker_call_raises_when_open():
     clk = FakeClock()
     br = CircuitBreaker(failure_threshold=1, recovery_s=5.0, clock=clk)

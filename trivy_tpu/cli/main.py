@@ -86,6 +86,22 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
                         "carry Metadata.Degraded")
     p.add_argument("--parallel", type=int, default=5,
                    help="number of parallel analysis workers")
+    p.add_argument("--targets", default=None, metavar="FILE",
+                   help="fleet mode: file of extra targets (one per "
+                        "line, # comments) scanned alongside the "
+                        "positional target; emits one merged JSON "
+                        "report (docs/durability.md)")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="fleet mode: write an append-only scan journal "
+                        "(fsynced per-artifact checkpoints) enabling "
+                        "--resume after a crash")
+    p.add_argument("--resume", default=None, metavar="JOURNAL",
+                   help="resume an interrupted fleet scan from its "
+                        "journal: completed artifacts are skipped, "
+                        "in-flight ones re-run; the merged report is "
+                        "byte-identical to an uninterrupted run")
+    p.add_argument("--fleet-parallel", type=int, default=1,
+                   help="fleet mode: artifacts scanned concurrently")
     p.add_argument("--server", default=None,
                    help="scan server URL (client mode)")
     p.add_argument("--token", default=None, help="server auth token")
@@ -258,6 +274,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--token", default=None)
     p.add_argument("--db-path", default=None)
     p.add_argument("--no-tpu", action="store_true")
+    p.add_argument("--drain-timeout", default="30s",
+                   help="graceful-drain budget on SIGTERM: /readyz goes "
+                        "503 immediately, in-flight scans get this long "
+                        "to finish, the rest are shed with Retry-After "
+                        "(go-style duration)")
 
     p = sub.add_parser("db", help="advisory DB operations", allow_abbrev=False)
     _add_global_flags(p)

@@ -97,12 +97,6 @@ def make_scan_options(args) -> ScanOptions:
 
 
 def run_scan(args) -> int:
-    from trivy_tpu.cache.cache import FSCache
-    from trivy_tpu.result.filter import filter_report
-    from trivy_tpu.result.ignore import load_ignore_file
-    from trivy_tpu.report.writer import write_report
-    from trivy_tpu.scanner.scan import Scanner
-
     from trivy_tpu.fanal.analyzers import secret_analyzer
 
     normalize_args(args)
@@ -317,19 +311,16 @@ def _scan_with_timeout(scanner, options, timeout_s: float,
     return box["report"]
 
 
-def _run_scan_core(args, compliance_spec) -> int:
+def _build_cache(args):
+    """Cache backend selection shared by single-target and fleet scans."""
     from trivy_tpu.cache.cache import FSCache
-    from trivy_tpu.result.filter import filter_report
-    from trivy_tpu.result.ignore import load_ignore_file
-    from trivy_tpu.report.writer import write_report
-    from trivy_tpu.scanner.scan import Scanner
 
     backend = getattr(args, "cache_backend", "fs") or "fs"
     if backend.startswith(("redis://", "rediss://")):
         from trivy_tpu.cache.redis import RedisCache, RedisError
 
         try:
-            cache = RedisCache(
+            return RedisCache(
                 backend, ca_cert=getattr(args, "redis_ca", ""),
                 cert=getattr(args, "redis_cert", ""),
                 key=getattr(args, "redis_key", ""),
@@ -337,23 +328,28 @@ def _run_scan_core(args, compliance_spec) -> int:
                 insecure=getattr(args, "redis_insecure", False))
         except (OSError, RedisError) as e:
             raise FatalError(f"redis cache backend: {e}")
-    elif backend == "memory":
+    if backend == "memory":
         from trivy_tpu.cache.cache import MemoryCache
 
-        cache = MemoryCache()
-    elif backend == "fs":
-        cache = FSCache(args.cache_dir)
-    else:
-        raise FatalError(
-            f"unknown cache backend {backend!r} (fs, memory, redis://...)")
+        return MemoryCache()
+    if backend == "fs":
+        return FSCache(args.cache_dir)
+    raise FatalError(
+        f"unknown cache backend {backend!r} (fs, memory, redis://...)")
+
+
+def _scan_target(args, cache):
+    """Build the scanner for args.target and run it under the timeout /
+    budget flags -> raw (unfiltered) Report."""
+    from trivy_tpu.resilience.retry import DeadlineExceeded
+    from trivy_tpu.scanner.scan import Scanner
+
     artifact, driver = _select_scanner(args, cache)
     scanner = Scanner(driver, artifact)
     budget_spec = getattr(args, "scan_timeout", None)
     budget_s = _parse_duration(budget_spec) if budget_spec else None
-    from trivy_tpu.resilience.retry import DeadlineExceeded
-
     try:
-        report = _scan_with_timeout(
+        return _scan_with_timeout(
             scanner, make_scan_options(args),
             _parse_duration(getattr(args, "timeout", None)),
             budget_s=budget_s)
@@ -361,6 +357,66 @@ def _run_scan_core(args, compliance_spec) -> int:
         raise FatalError(
             f"scan deadline exceeded: {e} (increase --scan-timeout, or "
             "add --fallback in client mode to degrade to a local scan)")
+
+
+def _run_scan_core(args, compliance_spec) -> int:
+    from trivy_tpu.report.writer import write_report
+
+    if getattr(args, "resume", None) or getattr(args, "targets", None):
+        # fleet mode: many artifacts, one journal, one merged report
+        from trivy_tpu.cli.fleet import run_fleet
+
+        if compliance_spec is not None:
+            raise FatalError("--compliance is not supported with fleet "
+                             "scans (--targets/--resume)")
+        return run_fleet(args)
+
+    cache = _build_cache(args)
+    report = _scan_target(args, cache)
+    severities = _postprocess_report(args, report)
+
+    if compliance_spec is not None:
+        from trivy_tpu.compliance.report import (
+            build_compliance_report,
+            write_compliance_report,
+        )
+
+        comp = build_compliance_report(report.results, compliance_spec)
+        out = open(args.output, "w") if args.output else None
+        try:
+            write_compliance_report(
+                comp, fmt="json" if args.format == "json" else "table",
+                report=getattr(args, "report", "summary"), output=out)
+        finally:
+            if out:
+                out.close()
+    else:
+        write_report(report, fmt=args.format, output=args.output,
+                     template=args.template, severities=severities,
+                     dependency_tree=getattr(args, "dependency_tree", False))
+    return _exit_code(args, report)
+
+
+def _exit_code(args, report) -> int:
+    # exit-code policy (reference pkg/commands/operation/operation.go:118):
+    # FINDINGS drive the exit code; retained package lists do not
+    if args.exit_code:
+        for res in report.results:
+            if (res.vulnerabilities or res.misconfigurations
+                    or res.secrets or res.licenses):
+                return args.exit_code
+    if args.exit_on_eol and report.metadata.os and report.metadata.os.eosl:
+        return args.exit_on_eol
+    return 0
+
+
+def _postprocess_report(args, report):
+    """Result shaping between scan and render: VEX suppression,
+    severity/status/ignore filtering, package stripping. Shared by the
+    single-target path and each fleet artifact. Returns the parsed
+    severity list (the table renderer wants it again)."""
+    from trivy_tpu.result.filter import filter_report
+    from trivy_tpu.result.ignore import load_ignore_file
 
     # VEX suppression runs before severity/ignore filtering
     # (reference pkg/result/filter.go:37 -> pkg/vex/vex.go:65).
@@ -420,37 +476,7 @@ def _run_scan_core(args, compliance_spec) -> int:
     if not keep_pkgs:
         for res in report.results:
             res.packages = []
-
-    if compliance_spec is not None:
-        from trivy_tpu.compliance.report import (
-            build_compliance_report,
-            write_compliance_report,
-        )
-
-        comp = build_compliance_report(report.results, compliance_spec)
-        out = open(args.output, "w") if args.output else None
-        try:
-            write_compliance_report(
-                comp, fmt="json" if args.format == "json" else "table",
-                report=getattr(args, "report", "summary"), output=out)
-        finally:
-            if out:
-                out.close()
-    else:
-        write_report(report, fmt=args.format, output=args.output,
-                     template=args.template, severities=severities,
-                     dependency_tree=getattr(args, "dependency_tree", False))
-
-    # exit-code policy (reference pkg/commands/operation/operation.go:118):
-    # FINDINGS drive the exit code; retained package lists do not
-    if args.exit_code:
-        for res in report.results:
-            if (res.vulnerabilities or res.misconfigurations
-                    or res.secrets or res.licenses):
-                return args.exit_code
-    if args.exit_on_eol and report.metadata.os and report.metadata.os.eosl:
-        return args.exit_on_eol
-    return 0
+    return severities
 
 
 def _select_scanner(args, cache):
@@ -836,7 +862,9 @@ def run_server(args) -> int:
     host, _, port = args.listen.partition(":")
     serve(engine, host=host or "localhost", port=int(port or 4954),
           token=args.token, cache=FSCache(args.cache_dir),
-          db_path=_db_path(args))
+          db_path=_db_path(args),
+          drain_timeout=_parse_duration(
+              getattr(args, "drain_timeout", None) or "30s"))
     return 0
 
 
@@ -853,6 +881,16 @@ def run_db(args) -> int:
             db = try_load(args.source) or _import_json(args.source)
         path = getattr(args, "db_path", None) or os.path.join(args.cache_dir, "db")
         db.save(path)
+        # an explicit import is the new truth: drop the last-good link
+        # a previous `db download` left, or every reader would resolve
+        # through it and silently keep serving the old generation
+        from trivy_tpu.db import generations as _gens
+
+        lg = _gens.last_good_path(path)
+        if os.path.islink(lg):
+            os.unlink(lg)
+            _log.info("imported DB supersedes downloaded generation; "
+                      "last-good link removed", path=path)
         _log.info("imported advisory DB", path=path, **db.stats())
         return 0
     if args.db_command == "stats":
@@ -863,17 +901,19 @@ def run_db(args) -> int:
         print(_json.dumps(db.stats(), indent=2))
         return 0
     if args.db_command == "download":
-        from trivy_tpu.db.oci import DB_MEDIA_TYPE, OCIError, download_artifact
+        from trivy_tpu.db.oci import DB_MEDIA_TYPE, OCIError, install_artifact
 
         dest = getattr(args, "db_path", None) or os.path.join(
             args.cache_dir, "db")
         try:
-            names = download_artifact(
+            # crash-safe generation install: verified blob, staged
+            # extraction, atomic last-good promotion (docs/durability.md)
+            gen = install_artifact(
                 args.db_repository, dest, media_type=DB_MEDIA_TYPE,
                 insecure=getattr(args, "insecure", False))
         except OCIError as e:
             raise FatalError(str(e))
-        _log.info("advisory DB downloaded", path=dest, files=len(names))
+        _log.info("advisory DB downloaded", path=dest, generation=gen)
         return 0
     if args.db_command == "import-java":
         import gzip

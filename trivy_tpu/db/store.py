@@ -25,6 +25,26 @@ from trivy_tpu.db.model import Advisory, VulnerabilityMeta
 SCHEMA_VERSION = 2
 
 
+def validate_db(db: "AdvisoryDB") -> str | None:
+    """Is a DB fit to serve? Returns a rejection reason or None. Used
+    by both the install path (before a generation is promoted) and the
+    server's hot-swap (before the engine swaps): the DB must carry a
+    schema this build understands and actually contain advisories —
+    serving an empty DB silently zeroes every CVE match, the worst
+    possible failure mode."""
+    if db.meta.version > SCHEMA_VERSION:
+        return (f"unsupported schema version {db.meta.version} "
+                f"(this build reads <= {SCHEMA_VERSION})")
+    try:
+        s = db.stats()
+    except Exception as exc:  # stats must be computable
+        return f"stats unreadable: {exc}"
+    if not s.get("advisories") and not s.get("metadata") \
+            and not db.redhat_entries:
+        return "candidate DB is empty"
+    return None
+
+
 @dataclass
 class Metadata:
     version: int = SCHEMA_VERSION
@@ -111,6 +131,8 @@ class AdvisoryDB:
     # ------------------------------------------------------------ io
 
     def save(self, path: str, compress: bool = True) -> None:
+        from trivy_tpu.durability import atomic
+
         os.makedirs(path, exist_ok=True)
         blob = {
             "buckets": {
@@ -130,17 +152,24 @@ class AdvisoryDB:
             blob["redhat_cpe"] = self.redhat_cpe
         data = json.dumps(blob, separators=(",", ":")).encode()
         fname = os.path.join(path, "trivy_tpu.db.json")
+        # atomic + fsynced: a crash mid-save leaves the previous DB (or
+        # nothing), never a torn one a reader would half-parse
         if compress:
-            with gzip.open(fname + ".gz", "wb") as f:
-                f.write(data)
+            atomic.atomic_write(fname + ".gz", gzip.compress(data),
+                                fault_site="db.save")
         else:
-            with open(fname, "wb") as f:
-                f.write(data)
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(self.meta.to_json(), f)
+            atomic.atomic_write(fname, data, fault_site="db.save")
+        atomic.atomic_write(os.path.join(path, "metadata.json"),
+                            json.dumps(self.meta.to_json()).encode(),
+                            fault_site="db.save.metadata")
 
     @classmethod
     def load(cls, path: str) -> "AdvisoryDB":
+        from trivy_tpu.db import generations
+
+        # a generation-managed root (verified OCI downloads) is read
+        # through its last-good link; flat layouts load as before
+        path = generations.resolve(path)
         db = cls()
         fname = os.path.join(path, "trivy_tpu.db.json")
         if os.path.exists(fname + ".gz"):

@@ -1,0 +1,41 @@
+"""Durability layer: crash-safe state for every durable path in the
+scanner (docs/durability.md).
+
+- `atomic` — tmp+fsync+rename writes, sha256 checksum framing, stale-tmp
+  sweeping, whole-tree fsync for staged directories
+- `journal` — append-only JSONL fleet-scan journal with torn-tail
+  tolerant replay (`trivy-tpu <kind> --targets … --journal/--resume`)
+
+Stdlib-only so it can be imported from the cache, the DB lifecycle, the
+server, and tests without pulling in jax.
+"""
+
+from trivy_tpu.durability.atomic import (
+    CorruptEntry,
+    atomic_write,
+    frame,
+    fsync_dir,
+    fsync_tree,
+    sweep_stale_tmp,
+    unframe,
+)
+from trivy_tpu.durability.journal import (
+    JournalError,
+    ScanJournal,
+    options_fingerprint,
+    report_digest,
+)
+
+__all__ = [
+    "CorruptEntry",
+    "JournalError",
+    "ScanJournal",
+    "atomic_write",
+    "frame",
+    "fsync_dir",
+    "fsync_tree",
+    "options_fingerprint",
+    "report_digest",
+    "sweep_stale_tmp",
+    "unframe",
+]

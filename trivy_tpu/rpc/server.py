@@ -19,6 +19,7 @@ the swap).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -110,7 +111,9 @@ class Metrics:
         self.scan_seconds_sum = 0.0
         self.findings_total = 0
         self.db_reloads_total = 0
+        self.db_reload_failures_total = 0
         self.scans_shed_total = 0
+        self.drained_scans_total = 0
 
     def record(self, seconds: float, findings: int = 0,
                error: bool = False) -> None:
@@ -122,6 +125,8 @@ class Metrics:
                 self.scan_errors_total += 1
 
     def render(self) -> bytes:
+        from trivy_tpu.cache import cache as cache_mod
+
         with self._lock:
             rows = [
                 ("trivy_tpu_scans_total", self.scans_total),
@@ -130,7 +135,12 @@ class Metrics:
                  round(self.scan_seconds_sum, 6)),
                 ("trivy_tpu_findings_total", self.findings_total),
                 ("trivy_tpu_db_reloads_total", self.db_reloads_total),
+                ("trivy_tpu_db_reload_failures_total",
+                 self.db_reload_failures_total),
                 ("trivy_tpu_scans_shed_total", self.scans_shed_total),
+                ("trivy_tpu_drained_scans_total", self.drained_scans_total),
+                ("trivy_tpu_cache_corrupt_total",
+                 cache_mod.corrupt_evictions()),
             ]
         out = []
         for name, value in rows:
@@ -149,18 +159,52 @@ class ScanService:
         self.db_path = db_path
         self._db_state = self._db_identity()
         self.metrics = Metrics()
+        # durable-lifecycle state: the generation the live engine was
+        # loaded from (rollback target), the identity of the last
+        # candidate we rejected (avoid a reload/reject loop), and a
+        # human-readable note for /readyz when serving last-good
+        self._active_db_dir = self._resolved_db_dir()
+        self._rejected_db_state: tuple = ()
+        self.db_degraded: str = ""
+        # drain state: SIGTERM flips draining; in-flight scans finish
+        # under the drain budget, new scans shed with Retry-After
+        self._drain_cond = threading.Condition()
+        self._inflight = 0
+        self.draining = False
+
+    def _resolved_db_dir(self) -> str | None:
+        """Real directory the DB would load from right now (a generation
+        dir when last-good is installed, else the flat root)."""
+        if not self.db_path:
+            return None
+        from trivy_tpu.db import generations
+
+        return os.path.realpath(generations.resolve(self.db_path))
+
+    def _is_generation(self, path: str | None) -> bool:
+        if not path or not self.db_path:
+            return False
+        from trivy_tpu.db import generations
+
+        root = os.path.realpath(generations.generations_root(self.db_path))
+        return path.startswith(root + os.sep)
 
     def _db_identity(self) -> tuple:
         """DB identity for hot-swap decisions: the metadata document
         (UpdatedAt/Version — reference pkg/db/db.go:97 NeedsUpdate reads
         metadata, not file timestamps) plus an mtime fallback for DBs
-        written without metadata."""
+        written without metadata. Reads through the last-good link when
+        the root is generation-managed, so promoting a new generation is
+        what makes the identity change."""
         import json
         import os
 
         if not self.db_path:
             return ()
-        meta_path = os.path.join(self.db_path, "metadata.json")
+        from trivy_tpu.db import generations
+
+        resolved = generations.resolve(self.db_path)
+        meta_path = os.path.join(resolved, "metadata.json")
         try:
             with open(meta_path, encoding="utf-8") as f:
                 md = json.load(f)
@@ -174,24 +218,73 @@ class ScanService:
             pass
         try:
             return (max(
-                os.path.getmtime(os.path.join(self.db_path, f))
-                for f in os.listdir(self.db_path)
+                os.path.getmtime(os.path.join(resolved, f))
+                for f in os.listdir(resolved)
             ),)
         except (OSError, ValueError):
             return ()
 
     def ready(self) -> tuple[bool, str]:
-        """Readiness (distinct from liveness): not ready while the
-        advisory-DB swap holds/awaits the write lock or before an
-        engine is loaded. /healthz stays a pure liveness probe."""
+        """Readiness (distinct from liveness): not ready while draining,
+        while the advisory-DB swap holds/awaits the write lock, or
+        before an engine is loaded. /healthz stays a pure liveness
+        probe. A rejected DB candidate does NOT unready the server — it
+        keeps serving last-good and says so."""
+        if self.draining:
+            return False, "draining"
         if self.engine is None:
             return False, "engine not loaded"
         if self.lock.write_busy:
             return False, "advisory-DB swap in progress"
+        if self.db_degraded:
+            return True, f"ok (serving last-good: {self.db_degraded})"
         return True, "ok"
+
+    def begin_scan(self) -> None:
+        """Admission control: refused while draining (503 + Retry-After
+        so a rolling restart's clients go elsewhere); otherwise counts
+        the scan as in-flight until end_scan."""
+        with self._drain_cond:
+            if self.draining:
+                with self.metrics._lock:
+                    self.metrics.scans_shed_total += 1
+                raise Overloaded("server draining (shutting down)",
+                                 retry_after=2.0)
+            self._inflight += 1
+
+    def end_scan(self) -> None:
+        with self._drain_cond:
+            self._inflight -= 1
+            if self.draining:
+                # an in-flight scan carried to completion during drain
+                with self.metrics._lock:
+                    self.metrics.drained_scans_total += 1
+            self._drain_cond.notify_all()
+
+    def start_drain(self) -> None:
+        with self._drain_cond:
+            self.draining = True
+
+    def await_drained(self, timeout: float) -> int:
+        """Block until in-flight scans complete or `timeout` elapses;
+        returns how many were still running (shed by process exit)."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        with self._drain_cond:
+            while self._inflight and time.monotonic() < deadline:
+                self._drain_cond.wait(deadline - time.monotonic())
+            return self._inflight
 
     def scan(self, target, artifact_key, blob_keys, options,
              deadline: Deadline | None = None):
+        self.begin_scan()
+        try:
+            return self._scan_admitted(target, artifact_key, blob_keys,
+                                       options, deadline)
+        finally:
+            self.end_scan()
+
+    def _scan_admitted(self, target, artifact_key, blob_keys, options,
+                       deadline: Deadline | None = None):
         import time
 
         from trivy_tpu.scanner.local import LocalDriver
@@ -236,22 +329,71 @@ class ScanService:
         finally:
             self.lock.release_read()
 
+    @staticmethod
+    def _validate_db(db) -> str | None:
+        """Shared fitness check (db.store.validate_db): loadable schema
+        + non-empty. The caller catches parse failures itself."""
+        from trivy_tpu.db.store import validate_db
+
+        return validate_db(db)
+
     def maybe_reload_db(self) -> bool:
         """Hot-swap the engine when the DB *metadata* changed (a new
-        UpdatedAt/Version), not merely a file timestamp."""
+        UpdatedAt/Version), not merely a file timestamp.
+
+        The swap is guarded: the candidate is loaded and validated
+        BEFORE the write lock is taken. A candidate that fails to load
+        or validate is never served — the server keeps the engine it
+        has (last-good), quarantines the corrupt generation when the
+        root is generation-managed, and remembers the rejected identity
+        so the reload worker doesn't retry the same bad bytes forever."""
         state = self._db_identity()
-        if not self.db_path or not state or state == self._db_state:
+        if not self.db_path or not state or state == self._db_state \
+                or state == self._rejected_db_state:
             return False
+        from trivy_tpu.db import generations
         from trivy_tpu.db.store import AdvisoryDB
         from trivy_tpu.detector.engine import MatchEngine
 
-        _log.info("advisory DB changed; reloading", path=self.db_path)
-        db = AdvisoryDB.load(self.db_path)
-        new_engine = MatchEngine(db, use_device=self.engine.use_device)
+        resolved = self._resolved_db_dir()
+        _log.info("advisory DB changed; reloading", path=resolved)
+        problem = None
+        db = new_engine = None
+        try:
+            db = AdvisoryDB.load(self.db_path)
+            problem = self._validate_db(db)
+            if problem is None:
+                new_engine = MatchEngine(db, use_device=self.engine.use_device)
+        except Exception as exc:
+            problem = f"unloadable: {exc}"
+        if problem is not None:
+            self._rejected_db_state = state
+            self.db_degraded = f"DB candidate rejected ({problem})"
+            with self.metrics._lock:
+                self.metrics.db_reload_failures_total += 1
+            _log.warn("advisory DB candidate rejected; serving last-good",
+                      path=resolved, reason=problem)
+            if self._is_generation(resolved) \
+                    and resolved != self._active_db_dir:
+                # generation layout: put the bad generation out of
+                # reach and repoint last-good at the one we serve
+                generations.quarantine(self.db_path, resolved)
+                if self._is_generation(self._active_db_dir) \
+                        and os.path.isdir(self._active_db_dir):
+                    generations.promote(self.db_path, self._active_db_dir)
+                # the rollback restored the old identity; clear the
+                # rejection latch so a FUTURE good candidate (new
+                # generation, new identity) still triggers a reload
+                self._rejected_db_state = ()
+                self._db_state = self._db_identity()
+            return False
         self.lock.acquire_write()  # quiesce in-flight scans
         try:
             self.engine = new_engine
             self._db_state = state
+            self._active_db_dir = resolved
+            self._rejected_db_state = ()
+            self.db_degraded = ""
         finally:
             self.lock.release_write()
         with self.metrics._lock:
@@ -312,7 +454,7 @@ def _make_handler(service: ScanService, token: str | None,
             elif self.path == "/readyz":
                 ok, why = service.ready()
                 if ok:
-                    self._reply(200, b"ok", "text/plain")
+                    self._reply(200, why.encode(), "text/plain")
                 else:
                     self._shed(f"not ready: {why}", retry_after=1.0)
             elif self.path == "/version":
@@ -444,15 +586,40 @@ class Server:
             except Exception as exc:
                 _log.warn("db reload failed", err=str(exc))
 
-    def shutdown(self):
+    def drain(self, timeout: float) -> int:
+        """Graceful drain (docs/durability.md): flip /readyz to 503
+        immediately so balancers stop routing here, let in-flight scans
+        finish under the `timeout` budget, shed whatever is left.
+        Returns the number of scans still running when the budget ran
+        out (0 = fully drained)."""
+        self.service.start_drain()
+        _log.info("draining", timeout_s=timeout)
+        left = self.service.await_drained(timeout)
+        if left:
+            _log.warn("drain budget exhausted; shedding in-flight scans",
+                      remaining=left)
+        else:
+            _log.info("drained", completed=self.service.metrics
+                      .drained_scans_total)
+        return left
+
+    def shutdown(self, drain_timeout: float | None = None):
+        if drain_timeout is not None:
+            self.drain(drain_timeout)  # idempotent if already draining
         self._stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
 
 
 def serve(engine, host="localhost", port=4954, token=None, cache=None,
-          db_path=None, db_reload_interval=3600.0):
-    """Blocking entry point for `trivy-tpu server`."""
+          db_path=None, db_reload_interval=3600.0, drain_timeout=30.0):
+    """Blocking entry point for `trivy-tpu server`.
+
+    SIGTERM triggers a graceful drain: /readyz goes 503 at once,
+    in-flight scans get `drain_timeout` seconds to finish, then the
+    process exits (remaining work is shed with Retry-After)."""
+    import signal
+
     if cache is None:
         from trivy_tpu.cache.cache import MemoryCache
 
@@ -460,8 +627,28 @@ def serve(engine, host="localhost", port=4954, token=None, cache=None,
     srv = Server(engine, cache, host=host, port=port, token=token,
                  db_path=db_path, db_reload_interval=db_reload_interval)
     srv.start()
+    stop = threading.Event()
+
+    def _on_term(*_):
+        # flip readiness in the handler itself so balancers see the 503
+        # the instant the TERM lands, not up to a poll-tick later (the
+        # handler runs on the main thread, which never holds the drain
+        # lock here — no self-deadlock)
+        srv.service.start_drain()
+        stop.set()
+
     try:
-        while True:
-            time.sleep(3600)
+        # only the main thread may install handlers; embedded callers
+        # (tests) drive srv.drain()/shutdown() directly instead
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass
+    try:
+        while not stop.wait(1.0):
+            pass
     except KeyboardInterrupt:
+        # interactive Ctrl-C: stop now — the drain budget is for
+        # orchestrated rollouts (SIGTERM), not a foreground operator
         srv.shutdown()
+        return
+    srv.shutdown(drain_timeout=drain_timeout)

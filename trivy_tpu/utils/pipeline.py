@@ -30,10 +30,18 @@ class PipelineError(Exception):
 
 def run_pipeline(items: Iterable[T], fn: Callable[[T], R],
                  on_result: Callable[[R], None] | None = None,
-                 workers: int = DEFAULT_WORKERS) -> list[R]:
+                 workers: int = DEFAULT_WORKERS,
+                 on_start: Callable[[int, T], None] | None = None) -> list[R]:
     """Run fn over items with a bounded worker pool; results are returned
     in input order. on_result (if given) is called serially, in order —
     the reference's onItem callback contract.
+
+    on_start (if given) fires from the worker the moment it picks an
+    item up, BEFORE fn — the hook the fleet-scan journal uses to write
+    its `running` checkpoint (so a kill mid-item is distinguishable
+    from a kill before the item started). It may be called concurrently
+    across workers; the callback must be thread-safe. An on_start error
+    counts as the item's failure and fn is skipped.
 
     Worker errors do not vanish: on_result is skipped for failed slots
     and all failures surface together as one index-matched
@@ -52,6 +60,8 @@ def run_pipeline(items: Iterable[T], fn: Callable[[T], R],
         # exception type the parallel path raises
         for i, it in enumerate(items):
             try:
+                if on_start:
+                    on_start(i, it)
                 results[i] = fn(it)
             except Exception as e:
                 errors[i] = e
@@ -69,8 +79,14 @@ def run_pipeline(items: Iterable[T], fn: Callable[[T], R],
                 except queue.Empty:
                     return
                 try:
+                    if on_start:
+                        on_start(i, it)
                     results[i] = fn(it)
-                except Exception as e:  # surfaced after join, index-matched
+                # BaseException too (InjectedKill, SystemExit from fn):
+                # letting it kill the worker thread would strand queued
+                # items and hang q.join() forever — in a pool, every
+                # failure must land in a slot, not take the pool down
+                except BaseException as e:  # noqa: B036
                     errors[i] = e
                 finally:
                     q.task_done()

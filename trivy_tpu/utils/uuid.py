@@ -20,3 +20,14 @@ def new() -> str:
 def reset() -> None:
     global _counter
     _counter = 0
+
+
+def set_lane(lane: int) -> None:
+    """Jump the deterministic counter to a per-slot lane (no-op effect
+    outside TRIVY_TPU_DETERMINISTIC_UUID=1, where uuids are random
+    anyway). Fleet scans pin each artifact to lane = its fleet index,
+    so a resumed run hands every artifact the same uuid stream as an
+    uninterrupted one — a prerequisite for byte-identical reports when
+    blob ids are uuid-keyed (fs artifacts)."""
+    global _counter
+    _counter = lane * 1_000_000
