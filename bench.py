@@ -20,6 +20,14 @@ Env knobs:
   TRIVY_TPU_BENCH_ADVISORIES  DB size (default 500_000)
   TRIVY_TPU_BENCH_QUERIES     query count (default 240_000)
   TRIVY_TPU_BENCH_NO_PROBE    skip the subprocess device probe
+
+Flags:
+  --phase-json FILE  dump per-phase timings (db_build / compile / warmup
+                     / crawl_e2e / stage_breakdown / realistic_crawl /
+                     secret_path / oracle_baseline) as JSON, sourced
+                     from the observability tracer's spans — the same
+                     spans --trace renders — so future BENCH_*.json
+                     entries carry a breakdown.
 """
 
 from __future__ import annotations
@@ -533,11 +541,29 @@ def _run_supervised(device_status: str) -> int:
     return rc
 
 
+def _phase_json_path() -> str | None:
+    """--phase-json FILE, surviving the supervised re-exec via env (the
+    parent re-invokes this file without argv)."""
+    if "--phase-json" in sys.argv:
+        i = sys.argv.index("--phase-json")
+        if i + 1 >= len(sys.argv):
+            print("--phase-json needs a FILE argument", file=sys.stderr)
+            sys.exit(2)
+        os.environ["TRIVY_TPU_BENCH_PHASE_JSON"] = sys.argv[i + 1]
+    return os.environ.get("TRIVY_TPU_BENCH_PHASE_JSON") or None
+
+
 def main():
+    phase_json = _phase_json_path()
     if not os.environ.get("TRIVY_TPU_BENCH_CHILD"):
         return _run_supervised(_ensure_device())
     device_status = os.environ.get("TRIVY_TPU_BENCH_DEVICE_STATUS",
                                    "unknown")
+    from trivy_tpu.obs import tracing as _trace
+
+    if phase_json:
+        _trace.enable(True)
+        _trace.reset()
 
     import jax
 
@@ -553,12 +579,14 @@ def main():
     n_q = int(os.environ.get("TRIVY_TPU_BENCH_QUERIES", "240000"))
 
     t0 = time.time()
-    db = synth_trivy_db(n_advisories=n_adv)
-    queries = build_queries(db, n_q)
+    with _trace.span("db_build", advisories=n_adv):
+        db = synth_trivy_db(n_advisories=n_adv)
+        queries = build_queries(db, n_q)
     build_s = time.time() - t0
 
     t0 = time.time()
-    engine = MatchEngine(db)
+    with _trace.span("compile"):
+        engine = MatchEngine(db)
     compile_s = time.time() - t0
     cdb = engine.cdb
 
@@ -576,21 +604,25 @@ def main():
     # is cleared afterwards so the measured crawl is warm-jit/cold-cache
     # — steady state for a long-lived scan server.
     batch = 131072
-    engine.detect(queries[:batch])
-    tail = n_q % batch or batch
-    engine.detect(queries[-tail:])
-    engine.detect_many(queries[:batch], batch)
-    engine._crawl_cache.clear()
+    with _trace.span("warmup"):
+        engine.detect(queries[:batch])
+        tail = n_q % batch or batch
+        engine.detect(queries[-tail:])
+        engine.detect_many(queries[:batch], batch)
+        engine._crawl_cache.clear()
 
     # --- end-to-end crawl (Zipf stress shape) ----------------------------
     t0 = time.time()
-    total_matches = run_crawl(engine, queries, batch)
+    with _trace.span("crawl_e2e", queries=n_q):
+        total_matches = run_crawl(engine, queries, batch)
     e2e_s = time.time() - t0
     e2e_rate = n_q / e2e_s
 
     # --- stage breakdown on one deduped batch ----------------------------
     from trivy_tpu.ops import match as m
 
+    stage_span = _trace.span("stage_breakdown")
+    stage_span.__enter__()
     uniq = MatchEngine.dedupe_queries(queries[:batch])[0]
     t0 = time.time()
     pb = cdb.encode_packages(
@@ -641,16 +673,20 @@ def main():
     engine._detect_unique(uniq)
     host_s = max(time.time() - t0 - encode_s - device_s, 0.0)
 
+    stage_span.__exit__(None, None, None)
+
     # --- realistic-density crawl (trivy-db-like ~1-5 matches/query) ------
-    real_q = build_queries(db, n_q, hot_frac=0.01, miss_frac=0.35, seed=29)
-    engine_r = MatchEngine(db)
-    engine_r.detect(real_q[:batch])  # warm
-    engine_r.detect(real_q[-tail:])
-    engine_r.detect_many(real_q[:batch], batch)
-    engine_r._crawl_cache.clear()
-    t0 = time.time()
-    real_matches = run_crawl(engine_r, real_q, batch)
-    real_s = time.time() - t0
+    with _trace.span("realistic_crawl"):
+        real_q = build_queries(db, n_q, hot_frac=0.01, miss_frac=0.35,
+                               seed=29)
+        engine_r = MatchEngine(db)
+        engine_r.detect(real_q[:batch])  # warm
+        engine_r.detect(real_q[-tail:])
+        engine_r.detect_many(real_q[:batch], batch)
+        engine_r._crawl_cache.clear()
+        t0 = time.time()
+        real_matches = run_crawl(engine_r, real_q, batch)
+        real_s = time.time() - t0
     realistic = {
         "pkg_per_s": round(n_q / real_s),
         "matches_per_query": round(real_matches / n_q, 2),
@@ -658,20 +694,22 @@ def main():
     }
 
     # --- secret path (BASELINE config #3: kernel-tree shape) -------------
-    secret_detail = bench_secrets()
+    with _trace.span("secret_path"):
+        secret_detail = bench_secrets()
 
     # --- oracle baseline (reference-shaped loop) -------------------------
-    sub = queries[: min(50_000, n_q)]
-    t0 = time.time()
-    oracle_res = engine.oracle_detect(sub)
-    oracle_s = time.time() - t0
-    oracle_rate = len(sub) / oracle_s
+    with _trace.span("oracle_baseline"):
+        sub = queries[: min(50_000, n_q)]
+        t0 = time.time()
+        oracle_res = engine.oracle_detect(sub)
+        oracle_s = time.time() - t0
+        oracle_rate = len(sub) / oracle_s
 
-    dev_res = engine.detect(sub)
-    diffs = sum(
-        1 for a, b in zip(oracle_res, dev_res)
-        if a.adv_indices != b.adv_indices
-    )
+        dev_res = engine.detect(sub)
+        diffs = sum(
+            1 for a, b in zip(oracle_res, dev_res)
+            if a.adv_indices != b.adv_indices
+        )
 
     result = {
         "metric": "vuln_match_throughput",
@@ -713,6 +751,17 @@ def main():
         "realistic": realistic,
         "secret": secret_detail,
     }
+    if phase_json:
+        with open(phase_json, "w", encoding="utf-8") as f:
+            json.dump({
+                "phases": _trace.timings(),
+                "unit": "s",
+                "source": "obs.tracing spans",
+                "platform": jax.devices()[0].platform,
+            }, f, indent=2)
+            f.write("\n")
+        _trace.enable(False)
+        _trace.reset()
     print(json.dumps(detail), file=sys.stderr)
     print(json.dumps(result))
     return 0 if diffs == 0 else 1

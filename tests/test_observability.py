@@ -169,3 +169,597 @@ class TestUnpackagedDiscovery:
         finally:
             srv.shutdown()
             srv.server_close()
+
+
+# --------------------------------------------------------------------------
+# Observability spine (docs/observability.md): metrics registry, contextvars
+# tracer, RPC trace stitching, log correlation.
+# --------------------------------------------------------------------------
+
+import os
+import threading as _threading
+import time as _time
+
+import trivy_tpu.obs.tracing as tracing
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs.metrics import (
+    CardinalityError,
+    MetricError,
+    Registry,
+)
+
+obs = pytest.mark.obs
+
+
+@obs
+class TestMetricsRegistry:
+    def test_concurrent_increments(self):
+        reg = Registry()
+        c = reg.counter("t_total", "h", labels=("k",))
+        n_threads, n_incs = 8, 2500
+
+        def work():
+            for _ in range(n_incs):
+                c.inc(k="x")
+
+        threads = [_threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value(k="x") == n_threads * n_incs
+
+    def test_cardinality_guard(self):
+        reg = Registry()
+        c = reg.counter("t_total", "h", labels=("k",), max_series=4)
+        for i in range(4):
+            c.inc(k=f"v{i}")
+        with pytest.raises(CardinalityError):
+            c.inc(k="v-one-too-many")
+        # existing series keep working after the refusal
+        c.inc(k="v0")
+        assert c.value(k="v0") == 2
+
+    def test_label_set_must_match_declaration(self):
+        reg = Registry()
+        c = reg.counter("t_total", "h", labels=("k",))
+        with pytest.raises(MetricError):
+            c.inc(wrong="x")
+        with pytest.raises(MetricError):
+            c.inc()  # missing label
+
+    def test_reregistration_type_clash(self):
+        reg = Registry()
+        reg.counter("t_total", "h")
+        assert reg.counter("t_total", "h") is reg.get("t_total")
+        with pytest.raises(MetricError):
+            reg.gauge("t_total", "h")
+        with pytest.raises(MetricError):
+            reg.counter("t_total", "h", labels=("k",))
+
+    def test_counters_only_go_up(self):
+        reg = Registry()
+        c = reg.counter("t_total", "h")
+        with pytest.raises(MetricError):
+            c.inc(-1)
+
+    def test_histogram_bucket_boundaries(self):
+        reg = Registry()
+        h = reg.histogram("t_seconds", "h", buckets=(1.0, 2.0))
+        # le semantics: a value exactly on a bound lands IN that bucket
+        h.observe(1.0)
+        h.observe(2.0)
+        h.observe(2.0001)
+        h.observe(0.0)
+        cum, total, count = h.snapshot()
+        assert cum == [2, 3, 4]  # le=1: {1.0, 0.0}; le=2: +2.0; +Inf: all
+        assert count == 4
+        assert abs(total - 5.0001) < 1e-9
+
+    def test_exposition_golden(self):
+        reg = Registry()
+        c = reg.counter("app_requests_total", "Requests served",
+                        labels=("code",))
+        c.inc(code="200")
+        c.inc(2, code="503")
+        g = reg.gauge("app_temperature", "Ambient")
+        g.set(3.5)
+        h = reg.histogram("app_latency_seconds", "Latency",
+                          buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.75)
+        assert reg.render().decode() == (
+            "# HELP app_requests_total Requests served\n"
+            "# TYPE app_requests_total counter\n"
+            'app_requests_total{code="200"} 1\n'
+            'app_requests_total{code="503"} 2\n'
+            "# HELP app_temperature Ambient\n"
+            "# TYPE app_temperature gauge\n"
+            "app_temperature 3.5\n"
+            "# HELP app_latency_seconds Latency\n"
+            "# TYPE app_latency_seconds histogram\n"
+            'app_latency_seconds_bucket{le="0.1"} 1\n'
+            'app_latency_seconds_bucket{le="1"} 2\n'
+            'app_latency_seconds_bucket{le="+Inf"} 2\n'
+            "app_latency_seconds_sum 0.8\n"
+            "app_latency_seconds_count 2\n"
+        )
+
+    def test_gauge_callback(self):
+        reg = Registry()
+        g = reg.gauge("app_age_seconds", "h")
+        g.set_function(lambda: 42.0)
+        assert g.value() == 42.0
+        assert "app_age_seconds 42" in reg.render().decode()
+
+
+@obs
+class TestMetricNameStability:
+    """Golden test: every pre-existing trivy_tpu_* series name must keep
+    rendering byte-identically — renames break dashboards silently."""
+
+    LEGACY = (
+        "trivy_tpu_scans_total",
+        "trivy_tpu_scan_errors_total",
+        "trivy_tpu_scan_seconds_sum",
+        "trivy_tpu_findings_total",
+        "trivy_tpu_db_reloads_total",
+        "trivy_tpu_db_reload_failures_total",
+        "trivy_tpu_scans_shed_total",
+        "trivy_tpu_drained_scans_total",
+        "trivy_tpu_cache_corrupt_total",
+    )
+
+    def test_no_renames(self):
+        from trivy_tpu.rpc.server import Metrics
+
+        text = Metrics().render().decode()
+        for name in self.LEGACY:
+            assert f"# TYPE {name} counter" in text, name
+            # the zero sample renders even before the first event
+            assert any(ln.startswith(f"{name} ")
+                       for ln in text.splitlines()), name
+
+    def test_new_histograms_and_gauges_registered(self):
+        from trivy_tpu.rpc.server import Metrics
+
+        text = Metrics().render().decode()
+        for name, kind in (
+            ("trivy_tpu_scan_phase_seconds", "histogram"),
+            ("trivy_tpu_rpc_client_seconds", "histogram"),
+            ("trivy_tpu_db_reload_seconds", "histogram"),
+            ("trivy_tpu_breaker_state", "gauge"),
+            ("trivy_tpu_db_generation_age_seconds", "gauge"),
+        ):
+            assert f"# TYPE {name} {kind}" in text, name
+
+    def test_every_series_has_help_and_type(self):
+        from trivy_tpu.rpc.server import Metrics
+
+        lines = Metrics().render().decode().splitlines()
+        documented = {ln.split()[2] for ln in lines
+                      if ln.startswith("# TYPE")}
+        helped = {ln.split()[2] for ln in lines
+                  if ln.startswith("# HELP")}
+        assert documented == helped
+        for ln in lines:
+            if ln.startswith("#") or not ln:
+                continue
+            base = ln.split("{")[0].split()[0]
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix) and \
+                        base[: -len(suffix)] in documented:
+                    base = base[: -len(suffix)]
+                    break
+            assert base in documented, ln
+
+
+@obs
+class TestContextTracer:
+    def setup_method(self):
+        trace.enable(True)
+        trace.reset()
+
+    def teardown_method(self):
+        trace.enable(False)
+        trace.reset()
+
+    def test_worker_spans_attach_to_submitting_scan(self):
+        """Regression: spans opened inside run_pipeline workers used to
+        become orphaned roots (thread-local stacks)."""
+        from trivy_tpu.utils.pipeline import run_pipeline
+
+        with trace.span("scan") as root:
+            def work(i):
+                with trace.span("item", i=i):
+                    pass
+                return i
+
+            run_pipeline(list(range(6)), work, workers=3)
+        assert len(tracing.spans()) == 7  # 1 root + 6 items
+        roots = [s for s in tracing.spans() if not s.parent_id]
+        assert roots == [root]
+        for s in tracing.spans():
+            assert s.trace_id == root.trace_id
+
+    def test_ids_and_parentage(self):
+        with trace.span("outer") as outer:
+            with trace.span("inner") as inner:
+                pass
+        assert len(outer.trace_id) == 32 and len(outer.span_id) == 16
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_id == outer.span_id
+        assert outer.parent_id == ""
+
+    def test_reset_is_cross_thread_and_idempotent(self):
+        release = _threading.Event()
+        opened = _threading.Event()
+
+        def straggler():
+            with trace.span("straggler"):
+                opened.set()
+                release.wait(5)
+
+        t = _threading.Thread(target=straggler)
+        t.start()
+        opened.wait(5)
+        trace.reset()  # from another thread, span still open
+        release.set()
+        t.join()
+        # the straggler closed after the reset: generation guard drops it
+        assert trace.render() == ""
+        trace.enable(False)
+        trace.reset()  # idempotent when disabled
+        trace.reset()
+
+    def test_scan_scope_and_log_fields(self):
+        assert tracing.log_fields() is None
+        with trace.scan_scope() as sid:
+            with trace.span("s") as s:
+                fields = tracing.log_fields()
+                assert fields == {"trace_id": s.trace_id,
+                                  "span_id": s.span_id,
+                                  "scan_id": sid}
+            # scope keeps an existing id unless forced
+            with trace.scan_scope() as again:
+                assert again == sid
+            with trace.scan_scope(force=True) as fresh:
+                assert fresh != sid
+        assert tracing.log_fields() is None
+
+    def test_slow_span_logged_when_tracing_disabled(self, capsys):
+        from trivy_tpu import log
+
+        trace.enable(False)
+        trace.set_slow_span_ms(0.0)
+        try:
+            log.init()
+            with trace.span("sluggish"):
+                _time.sleep(0.002)
+            err = capsys.readouterr().err
+            assert "slow span: sluggish" in err
+            assert "ms=" in err
+        finally:
+            trace.set_slow_span_ms(None)
+            log.init()
+        # and nothing was collected: tracing stayed off
+        assert trace.render() == ""
+
+    def test_trace_header_roundtrip(self):
+        with trace.span("client") as s:
+            headers = {}
+            tracing.inject_headers(headers)
+            link = tracing.parse_trace_header(
+                headers[tracing.TRACE_HEADER])
+            assert link == (s.trace_id, s.span_id)
+        assert tracing.parse_trace_header(None) is None
+        assert tracing.parse_trace_header("garbage") is None
+        assert tracing.parse_trace_header("zz-yy") is None
+
+
+@obs
+class TestRPCTraceStitching:
+    """A client/server scan renders as ONE stitched tree: the server's
+    phases nest under the client's RPC span with a shared trace id, and
+    the Chrome export carries both sides."""
+
+    @pytest.fixture()
+    def scan_server(self):
+        from trivy_tpu.cache.cache import MemoryCache
+        from trivy_tpu.db import Advisory, AdvisoryDB
+        from trivy_tpu.db.model import VulnerabilityMeta
+        from trivy_tpu.detector.engine import MatchEngine
+        from trivy_tpu.rpc.server import Server
+
+        db = AdvisoryDB()
+        db.put_advisory("npm::ghsa", "lodash", Advisory(
+            vulnerability_id="CVE-2019-10744",
+            vulnerable_versions=["<4.17.12"]))
+        db.put_meta(VulnerabilityMeta.from_json("CVE-2019-10744", {
+            "Title": "prototype pollution", "Severity": "CRITICAL"}))
+        srv = Server(MatchEngine(db, use_device=False), MemoryCache(),
+                     host="localhost", port=0)
+        srv.start()
+        yield srv
+        srv.shutdown()
+
+    def _scan(self, srv):
+        from trivy_tpu.rpc.client import RemoteCache, RemoteDriver
+        from trivy_tpu.types.scan import ScanOptions
+
+        cache = RemoteCache(srv.address)
+        driver = RemoteDriver(srv.address)
+        # blob upload + scan both happen inside the scan span, exactly
+        # as client mode does (upload rides artifact.inspect)
+        with trace.span("scan_artifact"):
+            cache.put_blob("sha256:b", {
+                "schema_version": 2,
+                "applications": [{
+                    "type": "npm", "file_path": "package-lock.json",
+                    "packages": [{
+                        "id": "lodash@4.17.4", "name": "lodash",
+                        "version": "4.17.4",
+                        "identifier": {"purl": "pkg:npm/lodash@4.17.4"},
+                    }],
+                }],
+            })
+            results, _ = driver.scan(
+                "img", "", ["sha256:b"],
+                ScanOptions(pkg_types=["library"], scanners=["vuln"]))
+        return results
+
+    def test_one_stitched_tree(self, scan_server):
+        trace.enable(True)
+        trace.reset()
+        try:
+            results = self._scan(scan_server)
+            assert any(r.vulnerabilities for r in results)
+            text = trace.render()
+            lines = text.splitlines()
+            assert lines[0].startswith("scan_artifact")
+            # server phases render nested (deeper) under the client span
+            rpc_depth = next(len(ln) - len(ln.lstrip()) for ln in lines
+                             if ln.lstrip().startswith("rpc.Scan"))
+            srv_depth = next(len(ln) - len(ln.lstrip()) for ln in lines
+                             if ln.lstrip().startswith("server.scan"))
+            det_depth = next(len(ln) - len(ln.lstrip()) for ln in lines
+                             if ln.lstrip().startswith("detect"))
+            assert srv_depth > rpc_depth
+            assert det_depth > srv_depth
+            # ONE tree, one shared trace id across both sides
+            tops, _extra = tracing._stitched_roots()
+            assert len(tops) == 1
+            assert len({s.trace_id for s in tracing.spans()}) == 1
+        finally:
+            trace.enable(False)
+            trace.reset()
+
+    def test_chrome_export_spans_both_sides(self, scan_server, tmp_path):
+        trace.enable(True)
+        trace.reset()
+        try:
+            self._scan(scan_server)
+            out = tmp_path / "trace.json"
+            n = trace.export_chrome(str(out))
+            doc = json.loads(out.read_text())
+            events = doc["traceEvents"]
+            assert len(events) == n > 0
+            by_name = {e["name"]: e for e in events}
+            for required in ("scan_artifact", "rpc.Scan", "server.scan",
+                             "apply_layers", "detect"):
+                assert required in by_name, required
+            assert by_name["rpc.Scan"]["args"]["trace_id"] == \
+                by_name["server.scan"]["args"]["trace_id"]
+            assert by_name["server.scan"]["args"]["parent_id"] == \
+                by_name["rpc.Scan"]["args"]["span_id"]
+            for e in events:
+                assert e["ph"] == "X"
+                assert e["dur"] >= 0
+        finally:
+            trace.enable(False)
+            trace.reset()
+
+
+@obs
+class TestLogCorrelation:
+    def teardown_method(self):
+        from trivy_tpu import log
+
+        log.init()
+
+    def test_json_log_lines_carry_trace_ids(self, capsys):
+        from trivy_tpu import log
+
+        trace.enable(True)
+        trace.reset()
+        try:
+            log.init(fmt="json")
+            with trace.scan_scope() as sid:
+                with trace.span("s") as s:
+                    log.logger("test").info("hello", k=7)
+            err = capsys.readouterr().err
+            line = next(ln for ln in err.splitlines() if ln.startswith("{"))
+            doc = json.loads(line)
+            assert doc["msg"] == "hello"
+            assert doc["logger"] == "test"
+            assert doc["k"] == 7
+            assert doc["trace_id"] == s.trace_id
+            assert doc["span_id"] == s.span_id
+            assert doc["scan_id"] == sid
+        finally:
+            trace.enable(False)
+            trace.reset()
+
+    def test_text_log_lines_carry_trace_ids(self, capsys):
+        from trivy_tpu import log
+
+        trace.enable(True)
+        trace.reset()
+        try:
+            log.init()
+            with trace.span("s") as s:
+                log.logger("test").info("hello")
+            err = capsys.readouterr().err
+            assert f"trace_id={s.trace_id}" in err
+            assert f"span_id={s.span_id}" in err
+        finally:
+            trace.enable(False)
+            trace.reset()
+
+    def test_log_lines_match_export(self, capsys, tmp_path):
+        """Acceptance: JSON log ids from a traced scan join the
+        exported Chrome trace."""
+        from trivy_tpu import log
+
+        trace.enable(True)
+        trace.reset()
+        try:
+            log.init(fmt="json")
+            with trace.scan_scope():
+                with trace.span("scan_artifact"):
+                    log.logger("scanner").info("scanning")
+            out = tmp_path / "t.json"
+            trace.export_chrome(str(out))
+            err = capsys.readouterr().err
+            logged = json.loads(next(
+                ln for ln in err.splitlines() if ln.startswith("{")))
+            events = json.loads(out.read_text())["traceEvents"]
+            assert any(
+                e["args"]["trace_id"] == logged["trace_id"]
+                and e["args"]["span_id"] == logged["span_id"]
+                for e in events)
+            assert logged["scan_id"]
+        finally:
+            trace.enable(False)
+            trace.reset()
+
+
+@obs
+class TestCliTraceSmoke:
+    """Tier-1-safe smoke: a local scan with --trace --trace-export
+    produces parseable Chrome JSON with the expected phase spans."""
+
+    def test_scan_trace_export(self, tmp_path, capsys):
+        from trivy_tpu.cli.main import main
+
+        (tmp_path / "r").mkdir()
+        (tmp_path / "r" / "requirements.txt").write_text("flask==1.0\n")
+        export = tmp_path / "trace.json"
+        rc = main(["filesystem", str(tmp_path / "r"), "--format", "json",
+                   "--cache-dir", str(tmp_path / "cache"),
+                   "--scanners", "vuln", "--quiet", "--trace",
+                   "--trace-export", str(export),
+                   "--output", str(tmp_path / "out.json")])
+        assert rc == 0
+        assert "scan_artifact" in capsys.readouterr().err
+        doc = json.loads(export.read_text())
+        names = {e["name"] for e in doc["traceEvents"]}
+        for required in ("scan_artifact", "inspect", "apply_layers",
+                         "detect", "report"):
+            assert required in names, required
+        trace_ids = {e["args"]["trace_id"] for e in doc["traceEvents"]}
+        assert len(trace_ids) == 1
+
+    def test_export_without_trace_flag(self, tmp_path, capsys):
+        """--trace-export alone collects spans without the stderr tree."""
+        from trivy_tpu.cli.main import main
+
+        (tmp_path / "r").mkdir()
+        (tmp_path / "r" / "requirements.txt").write_text("flask==1.0\n")
+        export = tmp_path / "trace.json"
+        rc = main(["filesystem", str(tmp_path / "r"), "--format", "json",
+                   "--cache-dir", str(tmp_path / "cache"),
+                   "--scanners", "vuln", "--quiet",
+                   "--trace-export", str(export),
+                   "--output", str(tmp_path / "out.json")])
+        assert rc == 0
+        assert "-- trace" not in capsys.readouterr().err
+        assert json.loads(export.read_text())["traceEvents"]
+
+    def test_phase_histogram_observed(self, tmp_path):
+        from trivy_tpu.cli.main import main
+
+        before = obs_metrics.SCAN_PHASE_SECONDS.snapshot(phase="detect")[2]
+        (tmp_path / "r").mkdir()
+        (tmp_path / "r" / "requirements.txt").write_text("flask==1.0\n")
+        rc = main(["filesystem", str(tmp_path / "r"), "--format", "json",
+                   "--cache-dir", str(tmp_path / "cache"),
+                   "--scanners", "vuln", "--quiet",
+                   "--output", str(tmp_path / "out.json")])
+        assert rc == 0
+        after = obs_metrics.SCAN_PHASE_SECONDS.snapshot(phase="detect")[2]
+        assert after == before + 1
+
+
+@obs
+@pytest.mark.slow
+class TestDisabledOverheadGuard:
+    """Tracing/metrics off must not measurably slow a local scan:
+    compare the real (instrumented-but-disabled) scan against one with
+    the instrumentation seams stubbed out to no-ops (<2% median
+    delta, with headroom for CI noise handled by best-of-N)."""
+
+    def _corpus(self, tmp_path):
+        root = tmp_path / "corpus"
+        root.mkdir()
+        for i in range(20):
+            (root / f"requirements-{i}.txt").write_text(
+                "".join(f"pkg{j}=={j}.0\n" for j in range(40)))
+        return root
+
+    def test_disabled_overhead_under_2pct(self, tmp_path):
+        import contextlib
+        import statistics
+
+        from trivy_tpu import obs as obs_pkg
+        from trivy_tpu.cli.main import main
+
+        root = self._corpus(tmp_path)
+
+        def scan():
+            # one shared warm cache dir: every measured run takes the
+            # same (cache-hit) path, so timings compare like-for-like
+            rc = main(["filesystem", str(root), "--format", "json",
+                       "--cache-dir", str(tmp_path / "cache"),
+                       "--scanners", "vuln", "--quiet",
+                       "--output", os.devnull])
+            assert rc == 0
+
+        @contextlib.contextmanager
+        def null_phase(span_name, phase=None, **meta):
+            yield None
+
+        def stubbed():
+            orig_phase, orig_span = obs_pkg.phase, tracing.span
+            obs_pkg.phase = null_phase
+            tracing.span = lambda name, **meta: contextlib.nullcontext()
+            try:
+                yield
+            finally:
+                obs_pkg.phase, tracing.span = orig_phase, orig_span
+
+        stubbed = contextlib.contextmanager(stubbed)
+
+        def timed():
+            t0 = _time.perf_counter()
+            scan()
+            return _time.perf_counter() - t0
+
+        scan()  # warm imports, engine cache, blob cache
+        scan()
+        real_times, stub_times = [], []
+        for i in range(16):  # interleaved pairs, ALTERNATING order —
+            if i % 2 == 0:   # same-order pairs bias toward whichever
+                real_times.append(timed())  # variant runs second
+                with stubbed():
+                    stub_times.append(timed())
+            else:
+                with stubbed():
+                    stub_times.append(timed())
+                real_times.append(timed())
+        real = statistics.median(real_times)
+        stub = statistics.median(stub_times)
+        # the disabled fast path may even win; only a real slowdown
+        # fails (2 ms absolute floor keeps scheduler jitter from
+        # flaking on loaded CI boxes)
+        assert real <= stub * 1.02 + 0.002, (real, stub)

@@ -21,25 +21,21 @@ from dataclasses import asdict
 
 from trivy_tpu.durability import atomic
 from trivy_tpu.log import logger
+from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.types.artifact import ArtifactInfo, BlobInfo
 
 _log = logger("cache")
 
-# corrupt-entry evictions across every FSCache in the process; exported
-# at /metrics as trivy_tpu_cache_corrupt_total
-_corrupt_lock = threading.Lock()
-_corrupt_total = 0
-
 
 def corrupt_evictions() -> int:
-    with _corrupt_lock:
-        return _corrupt_total
+    """Corrupt-entry evictions across every FSCache in the process
+    (the trivy_tpu_cache_corrupt_total counter, kept as a function for
+    historical callers)."""
+    return int(obs_metrics.CACHE_CORRUPT.value())
 
 
 def _count_corrupt_eviction() -> None:
-    global _corrupt_total
-    with _corrupt_lock:
-        _corrupt_total += 1
+    obs_metrics.CACHE_CORRUPT.inc()
 
 
 def cache_key(

@@ -33,6 +33,7 @@ from trivy_tpu.cli.run import (
 from trivy_tpu.durability import ScanJournal, atomic_write, options_fingerprint
 from trivy_tpu.durability.journal import JournalError
 from trivy_tpu.log import logger
+from trivy_tpu.obs import tracing
 from trivy_tpu.resilience import faults
 from trivy_tpu.utils import clock
 from trivy_tpu.utils import uuid as uuid_util
@@ -134,13 +135,19 @@ def run_fleet(args) -> int:
             # a fleet line that names an existing file is a tar archive,
             # anything else a registry reference
             a.input = target if os.path.exists(target) else None
-        try:
-            report = _scan_target(a, cache)
-            _postprocess_report(a, report)
-        except Exception as e:
-            if journal:
-                journal.mark_failed(target, f"{type(e).__name__}: {e}")
-            raise
+        # each lane gets its own span (attached to the fleet root via
+        # the pipeline's context adoption) and its own scan id, which
+        # the artifact's log lines and inner spans inherit
+        with tracing.scan_scope(force=True), \
+                tracing.span("fleet.artifact", target=target,
+                             lane=lane[target]):
+            try:
+                report = _scan_target(a, cache)
+                _postprocess_report(a, report)
+            except Exception as e:
+                if journal:
+                    journal.mark_failed(target, f"{type(e).__name__}: {e}")
+                raise
         doc = report.to_dict()
         if journal:
             journal.mark_done(target, doc)  # fsynced before we move on
@@ -153,7 +160,9 @@ def run_fleet(args) -> int:
 
     workers = max(1, int(getattr(args, "fleet_parallel", 1) or 1))
     try:
-        run_pipeline(todo, scan_one, workers=workers, on_start=on_start)
+        with tracing.span("fleet", artifacts=len(todo), workers=workers):
+            run_pipeline(todo, scan_one, workers=workers,
+                         on_start=on_start)
     except PipelineError as e:
         hint = (f"; completed work is journaled — re-run with "
                 f"--resume {journal.path} to retry" if journal else "")

@@ -14,6 +14,11 @@ from trivy_tpu import log
 def _add_global_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--debug", action="store_true", help="debug logging")
     p.add_argument("--quiet", "-q", action="store_true", help="suppress logs")
+    p.add_argument("--log-format", default="text",
+                   choices=("text", "json"),
+                   help="log line format; json emits one object per "
+                        "line with trace_id/span_id/scan_id correlation "
+                        "fields (fleet runs, log pipelines)")
     p.add_argument("--config", "-c", default=None,
                    help="config file (default trivy-tpu.yaml if present)")
     p.add_argument("--generate-default-config", action="store_true",
@@ -126,6 +131,11 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
                    help="print a stage-timing trace after the scan "
                         "(set TRIVY_TPU_JAX_TRACE_DIR for a device "
                         "profile)")
+    p.add_argument("--trace-export", default=None, metavar="FILE",
+                   help="write the collected spans as Chrome "
+                        "trace-event JSON (open in Perfetto / "
+                        "chrome://tracing); implies span collection "
+                        "even without --trace")
     p.add_argument("--module-dir", default=None,
                    help="directory of scan-module extensions "
                         "(default <cache>/modules)")
@@ -423,7 +433,8 @@ def main(argv: list[str] | None = None) -> int:
         print(str(e), file=sys.stderr)
         return 1
     log.init(debug=getattr(args, "debug", False),
-             quiet=getattr(args, "quiet", False))
+             quiet=getattr(args, "quiet", False),
+             fmt=getattr(args, "log_format", "text"))
 
     if args.command in (None, "version"):
         if args.command is None:

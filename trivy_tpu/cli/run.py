@@ -134,9 +134,11 @@ def run_scan(args) -> int:
     # module extensions: custom analyzers + post-scan hooks
     # (reference pkg/module manager wired into the runner)
     from trivy_tpu.module import ModuleManager
-    from trivy_tpu.utils import trace
+    from trivy_tpu.obs import tracing as trace
 
-    if getattr(args, "trace", False):
+    trace_export = getattr(args, "trace_export", None)
+    tracing_on = getattr(args, "trace", False) or bool(trace_export)
+    if tracing_on:
         trace.enable(True)
         trace.reset()
     explicit_dir = getattr(args, "module_dir", None)
@@ -150,19 +152,34 @@ def run_scan(args) -> int:
     from trivy_tpu.iac import engine as check_engine
 
     try:
-        # custom misconfig checks: builtin bundle + --config-check paths,
-        # gated by --check-namespaces (reference pkg/iac/rego +
-        # pkg/policy); skipped entirely when misconfig isn't scanned
-        if "misconfig" in (args.scanners or "").split(",") \
-                or args.command == "config":
-            _configure_check_engine(args)
-        return _run_scan_core(args, compliance_spec)
+        # one root span covers the whole command (scan + report), so a
+        # traced run exports a single tree under a single trace id
+        with trace.span("scan", command=args.command):
+            # custom misconfig checks: builtin bundle + --config-check
+            # paths, gated by --check-namespaces (reference pkg/iac/rego
+            # + pkg/policy); skipped entirely when misconfig isn't
+            # scanned
+            if "misconfig" in (args.scanners or "").split(",") \
+                    or args.command == "config":
+                _configure_check_engine(args)
+            return _run_scan_core(args, compliance_spec)
     finally:
         check_engine.reset()
         mod_mgr.unload()
-        if getattr(args, "trace", False):
-            trace.render(sys.stderr)
-            trace.enable(False)
+        if tracing_on:
+            try:
+                if getattr(args, "trace", False):
+                    trace.render(sys.stderr)
+                if trace_export:
+                    n = trace.export_chrome(trace_export)
+                    _log.info("trace exported", path=trace_export, spans=n)
+            except OSError as e:
+                # a bad export path must not eat the finished scan's
+                # exit status (and enable(False) below must still run)
+                _log.error("trace export failed", path=trace_export,
+                           err=str(e))
+            finally:
+                trace.enable(False)
 
 
 def _coerce_helm_value(v: str):
@@ -282,20 +299,27 @@ def _scan_with_timeout(scanner, options, timeout_s: float,
     the scope is entered inside the worker because it is thread-local."""
     import threading
 
+    from trivy_tpu.obs import tracing
+
     box: dict = {}
+    # the worker thread starts from an empty contextvars context:
+    # adopt the submitting thread's span/scan id so a fleet lane's scan
+    # spans stay attached to the lane's span instead of orphaning
+    trace_ctx = tracing.capture()
 
     def work():
         try:
-            if budget_s:
-                from trivy_tpu.resilience.retry import (
-                    Deadline,
-                    deadline_scope,
-                )
+            with tracing.adopt(trace_ctx):
+                if budget_s:
+                    from trivy_tpu.resilience.retry import (
+                        Deadline,
+                        deadline_scope,
+                    )
 
-                with deadline_scope(Deadline.after(budget_s)):
+                    with deadline_scope(Deadline.after(budget_s)):
+                        box["report"] = scanner.scan_artifact(options)
+                else:
                     box["report"] = scanner.scan_artifact(options)
-            else:
-                box["report"] = scanner.scan_artifact(options)
         except BaseException as exc:  # re-raised on the main thread
             box["error"] = exc
 
@@ -391,9 +415,13 @@ def _run_scan_core(args, compliance_spec) -> int:
             if out:
                 out.close()
     else:
-        write_report(report, fmt=args.format, output=args.output,
-                     template=args.template, severities=severities,
-                     dependency_tree=getattr(args, "dependency_tree", False))
+        from trivy_tpu import obs
+
+        with obs.phase("report"):
+            write_report(report, fmt=args.format, output=args.output,
+                         template=args.template, severities=severities,
+                         dependency_tree=getattr(args, "dependency_tree",
+                                                 False))
     return _exit_code(args, report)
 
 

@@ -310,6 +310,9 @@ class MatchEngine:
         operator can see the degradation in logs/metrics."""
         _log.warn("accelerator lost; degrading match engine to host "
                   "oracle", err=str(exc))
+        from trivy_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.DEGRADED_TOTAL.inc(component="engine")
         self.use_device = False
         self.device_lost = True
 

@@ -1,0 +1,370 @@
+"""Labeled metrics registry with Prometheus text exposition.
+
+One `Registry` holds `Counter` / `Gauge` / `Histogram` metrics, each with
+a fixed label-name tuple and a bounded number of label-value series (a
+runaway label set raises `CardinalityError` instead of silently eating
+RSS). All mutation and the exposition render share ONE re-entrant lock
+per registry, so `render()` is a consistent snapshot — concurrent scans
+cannot produce torn reads of related counters, and multi-field updates
+(e.g. the server's scans_total + scan_seconds_sum) can be grouped under
+`registry.locked()`.
+
+Two scopes exist by convention:
+
+- `REGISTRY` (module-level): process-wide spine metrics — scan-phase
+  latency, RPC client round-trips, retries, breaker state, degraded
+  activations, fault-injector fires, cache corruption.
+- per-service registries: the RPC server's `Metrics` keeps its own so a
+  fresh `Server` starts from zero (tests spin several per process); its
+  /metrics response concatenates both scopes.
+
+The exposition writer emits `# HELP` / `# TYPE` for every registered
+metric (even before the first sample) in the Prometheus text format
+0.0.4 the `/metrics` endpoint advertises.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+# Fixed default latency buckets (seconds): micro-phases up to the
+# 60 s north-star crawl budget.
+DEFAULT_BUCKETS: tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0,
+)
+
+DEFAULT_MAX_SERIES = 256
+
+
+class MetricError(ValueError):
+    """Metric misuse: bad labels, type clash, duplicate registration."""
+
+
+class CardinalityError(MetricError):
+    """A metric grew more label-value series than its bound allows."""
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integral floats render as integers,
+    the rest in shortest-round-trip-ish form (0.75 -> "0.75")."""
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return format(v, ".10g")
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _labels_text(names: tuple[str, ...], values: tuple[str, ...],
+                 extra: tuple[tuple[str, str], ...] = ()) -> str:
+    pairs = [f'{n}="{_escape(v)}"' for n, v in zip(names, values)]
+    pairs += [f'{n}="{_escape(v)}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class _Metric:
+    """Common series bookkeeping; subclasses define the sample shape."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "Registry", name: str, help_text: str,
+                 labels: tuple[str, ...], max_series: int):
+        self.registry = registry
+        self.name = name
+        self.help = help_text
+        self.label_names = labels
+        self.max_series = max_series
+        self._series: dict[tuple[str, ...], object] = {}
+
+    def _materialize_unlabeled(self) -> None:
+        # an unlabeled metric exposes its zero sample immediately (the
+        # hand-rolled server Metrics always rendered "name 0"); labeled
+        # metrics stay empty until a label set is first used
+        if not self.label_names:
+            self._series[()] = self._new_state()
+
+    def _key(self, labels: dict) -> tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise MetricError(
+                f"{self.name}: got labels {sorted(labels)!r}, declared "
+                f"{sorted(self.label_names)!r}")
+        return tuple(str(labels[n]) for n in self.label_names)
+
+    def _slot(self, labels: dict) -> tuple[str, ...]:
+        """Get-or-create the series state for a label set; returns the
+        series key (the one label-validation pass per update). Caller
+        holds the registry lock."""
+        key = self._key(labels)
+        if key not in self._series:
+            if len(self._series) >= self.max_series:
+                raise CardinalityError(
+                    f"{self.name}: more than {self.max_series} label "
+                    f"sets (runaway label values? latest: {key!r})")
+            self._series[key] = self._new_state()
+        return key
+
+    def _new_state(self):
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        with self.registry._lock:
+            self._series.clear()
+
+    # rendering -------------------------------------------------------
+
+    def _render(self, out: list[str]) -> None:
+        out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        for key in sorted(self._series):
+            self._render_series(out, key, self._series[key])
+
+    def _render_series(self, out: list[str], key, state) -> None:
+        out.append(
+            f"{self.name}{_labels_text(self.label_names, key)} "
+            f"{_fmt(state)}")
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_state(self) -> float:
+        return 0.0
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise MetricError(f"{self.name}: counters only go up")
+        with self.registry._lock:
+            self._series[self._slot(labels)] += amount
+
+    def value(self, **labels) -> float:
+        with self.registry._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._fn: Callable[[], float] | None = None
+
+    def _new_state(self) -> float:
+        return 0.0
+
+    def set(self, value: float, **labels) -> None:
+        with self.registry._lock:
+            self._series[self._slot(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        with self.registry._lock:
+            self._series[self._slot(labels)] += amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        """Evaluate `fn` at render time (unlabeled gauges only) — for
+        values derived from ambient state, e.g. DB generation age."""
+        if self.label_names:
+            raise MetricError(
+                f"{self.name}: set_function needs an unlabeled gauge")
+        self._fn = fn
+
+    def value(self, **labels) -> float:
+        with self.registry._lock:
+            if self._fn is not None:
+                return float(self._fn())
+            return float(self._series.get(self._key(labels), 0.0))
+
+    def _render(self, out: list[str]) -> None:
+        if self._fn is not None:
+            try:
+                val = float(self._fn())
+            except Exception:
+                return  # a broken callback must not break /metrics
+            out.append(f"# HELP {self.name} {self.help}")
+            out.append(f"# TYPE {self.name} {self.kind}")
+            out.append(f"{self.name} {_fmt(val)}")
+            return
+        super()._render(out)
+
+
+class _HistState:
+    __slots__ = ("counts", "total", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets  # cumulative at render, raw here
+        self.total = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, registry, name, help_text, labels, max_series,
+                 buckets: Iterable[float] = DEFAULT_BUCKETS):
+        super().__init__(registry, name, help_text, labels, max_series)
+        bounds = sorted(float(b) for b in buckets)
+        if not bounds:
+            raise MetricError(f"{name}: histogram needs >= 1 bucket")
+        if len(set(bounds)) != len(bounds):
+            raise MetricError(f"{name}: duplicate bucket bounds")
+        self.buckets = tuple(bounds)
+
+    def _new_state(self) -> _HistState:
+        return _HistState(len(self.buckets) + 1)  # +1 for +Inf
+
+    def observe(self, value: float, **labels) -> None:
+        value = float(value)
+        with self.registry._lock:
+            state: _HistState = self._series[self._slot(labels)]  # type: ignore[assignment]
+            i = 0
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    break
+            else:
+                i = len(self.buckets)
+            state.counts[i] += 1
+            state.total += value
+            state.count += 1
+
+    def snapshot(self, **labels) -> tuple[list[int], float, int]:
+        """(cumulative bucket counts incl. +Inf, sum, count)."""
+        with self.registry._lock:
+            state = self._series.get(self._key(labels))
+            if state is None:
+                return [0] * (len(self.buckets) + 1), 0.0, 0
+            cum, running = [], 0
+            for c in state.counts:
+                running += c
+                cum.append(running)
+            return cum, state.total, state.count
+
+    def _render_series(self, out: list[str], key,
+                       state: _HistState) -> None:
+        running = 0
+        for bound, c in zip(self.buckets, state.counts):
+            running += c
+            out.append(
+                f"{self.name}_bucket"
+                f"{_labels_text(self.label_names, key, (('le', _fmt(bound)),))}"
+                f" {running}")
+        running += state.counts[-1]
+        out.append(
+            f"{self.name}_bucket"
+            f"{_labels_text(self.label_names, key, (('le', '+Inf'),))}"
+            f" {running}")
+        lbl = _labels_text(self.label_names, key)
+        out.append(f"{self.name}_sum{lbl} {_fmt(state.total)}")
+        out.append(f"{self.name}_count{lbl} {state.count}")
+
+
+class Registry:
+    """A named, typed metric namespace with one lock for everything."""
+
+    def __init__(self):
+        # RLock: multi-metric updates group under locked() while each
+        # single inc stays safe on its own
+        self._lock = threading.RLock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def locked(self):
+        """Hold the registry lock across several updates so a concurrent
+        render can't observe them half-applied."""
+        return self._lock
+
+    def _register(self, cls, name: str, help_text: str,
+                  labels: tuple[str, ...], max_series: int,
+                  **kwargs) -> _Metric:
+        labels = tuple(labels)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if (type(existing) is not cls
+                        or existing.label_names != labels):
+                    raise MetricError(
+                        f"metric {name!r} re-registered with a different "
+                        "type or label set")
+                return existing
+            m = cls(self, name, help_text, labels, max_series, **kwargs)
+            m._materialize_unlabeled()
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_text: str,
+                labels: tuple[str, ...] = (),
+                max_series: int = DEFAULT_MAX_SERIES) -> Counter:
+        return self._register(Counter, name, help_text, labels, max_series)
+
+    def gauge(self, name: str, help_text: str,
+              labels: tuple[str, ...] = (),
+              max_series: int = DEFAULT_MAX_SERIES) -> Gauge:
+        return self._register(Gauge, name, help_text, labels, max_series)
+
+    def histogram(self, name: str, help_text: str,
+                  labels: tuple[str, ...] = (),
+                  buckets: Iterable[float] = DEFAULT_BUCKETS,
+                  max_series: int = DEFAULT_MAX_SERIES) -> Histogram:
+        return self._register(Histogram, name, help_text, labels,
+                              max_series, buckets=buckets)
+
+    def get(self, name: str) -> _Metric | None:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> bytes:
+        """Prometheus text exposition 0.0.4, generated under ONE lock
+        acquisition: the response is a consistent point-in-time snapshot
+        even while scans are incrementing counters concurrently."""
+        out: list[str] = []
+        with self._lock:
+            for name in self._metrics:  # registration order is stable
+                self._metrics[name]._render(out)
+        return ("\n".join(out) + "\n").encode()
+
+
+# ---------------------------------------------------------------- spine
+
+REGISTRY = Registry()
+
+SCAN_PHASE_SECONDS = REGISTRY.histogram(
+    "trivy_tpu_scan_phase_seconds",
+    "Wall-clock seconds per scan phase (inspect/cache/detect/secret/report)",
+    labels=("phase",))
+RPC_CLIENT_SECONDS = REGISTRY.histogram(
+    "trivy_tpu_rpc_client_seconds",
+    "RPC client round-trip seconds per attempt, by twirp method",
+    labels=("method",))
+RETRY_ATTEMPTS = REGISTRY.counter(
+    "trivy_tpu_retry_attempts_total",
+    "RPC retry attempts (excludes each call's first attempt)",
+    labels=("method",))
+DEGRADED_TOTAL = REGISTRY.counter(
+    "trivy_tpu_degraded_total",
+    "Degraded-mode activations by component "
+    "(driver=local fallback scan, cache=local-only mirror, "
+    "engine=host-oracle after device loss)",
+    labels=("component",))
+FAULT_FIRES = REGISTRY.counter(
+    "trivy_tpu_fault_injections_total",
+    "Fault-injector rule firings by configured site and action",
+    labels=("site", "action"))
+BREAKER_STATE = REGISTRY.gauge(
+    "trivy_tpu_breaker_state",
+    "Circuit breaker state (0=closed, 1=half-open, 2=open)",
+    labels=("name",))
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "trivy_tpu_breaker_transitions_total",
+    "Circuit breaker transitions into each state",
+    labels=("name", "state"))
+CACHE_CORRUPT = REGISTRY.counter(
+    "trivy_tpu_cache_corrupt_total",
+    "Corrupt cache entries evicted (self-healing reads)")

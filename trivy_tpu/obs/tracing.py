@@ -1,0 +1,450 @@
+"""Context-propagating distributed tracing for the scan spine.
+
+Rebuilt from the thread-local `utils/trace.py` seed on `contextvars`:
+
+- Spans carry 128-bit trace ids and 64-bit span ids; children inherit
+  the trace id and record their parent's span id, so worker threads
+  (`utils/pipeline.py` adopts the submitting context) and fleet lanes
+  attach to the submitting scan's span instead of becoming orphaned
+  roots.
+- The current (trace_id, span_id) propagates over the RPC boundary via
+  the `X-Trivy-Trace` header: the client injects it per request, the
+  server adopts it as the parent of its handler span, and because ids
+  are shared, a remote scan renders as ONE stitched tree (`render()`
+  grafts any collected root under the collected span it names as
+  parent — in-process client/server tests see the full picture; across
+  processes the ids still join via logs and exports).
+- `export_chrome(path)` writes Chrome trace-event JSON ("traceEvents"
+  with `ph: "X"` complete events) viewable in Perfetto / chrome://tracing.
+- A scan id (one per scan_artifact / fleet artifact) rides a second
+  contextvar; `log_fields()` hands trace_id/span_id/scan_id to log.py
+  so every log line joins the trace.
+- `TRIVY_TPU_SLOW_SPAN_MS` logs any span exceeding the threshold even
+  when tracing is off (spans then time themselves but collect nothing).
+
+Enabled via --trace / --trace-export (CLI) or TRIVY_TPU_TRACE=1; the
+JAX profiler dump is written when TRIVY_TPU_JAX_TRACE_DIR is set.
+
+Usage:
+    with trace.span("scan"):
+        with trace.span("inspect"): ...
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+TRACE_HEADER = "X-Trivy-Trace"
+
+_enabled = os.environ.get("TRIVY_TPU_TRACE", "") not in ("", "0", "false")
+
+
+def _env_slow_ms() -> float | None:
+    raw = os.environ.get("TRIVY_TPU_SLOW_SPAN_MS", "")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+_slow_ms: float | None = _env_slow_ms()
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_slow_span_ms(ms: float | None) -> None:
+    """Override the TRIVY_TPU_SLOW_SPAN_MS threshold (None disables)."""
+    global _slow_ms
+    _slow_ms = ms
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str = ""
+    span_id: str = ""
+    parent_id: str = ""
+    start: float = 0.0      # perf_counter, for elapsed
+    start_ts: float = 0.0   # epoch seconds, for exports
+    elapsed: float = 0.0
+    tid: int = 0
+    children: list["Span"] = field(default_factory=list)
+    meta: dict = field(default_factory=dict)
+
+
+def _new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def _new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+# current span + scan id are contextvars: worker threads start from an
+# empty context, so nothing leaks between threads, and adopt()/attach()
+# copy a captured context in explicitly where propagation is wanted
+_current: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
+    "trivy_tpu_current_span", default=None)
+_scan_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "trivy_tpu_scan_id", default="")
+# remote parentage adopted from an incoming X-Trivy-Trace header: the
+# next root span opened in this context joins the caller's trace
+_remote_link: contextvars.ContextVar[tuple[str, str] | None] = \
+    contextvars.ContextVar("trivy_tpu_remote_link", default=None)
+
+# finished root spans; generation guards reset() against spans still
+# closing on other threads (their append is simply dropped)
+_roots: list[Span] = []
+_roots_lock = threading.Lock()
+_generation = 0
+
+
+class _Noop:
+    """Reusable no-op context manager: the disabled-tracing fast path
+    allocates nothing per span."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _Noop()
+
+
+def span(name: str, **meta):
+    if not _enabled and _slow_ms is None:
+        return _NOOP
+    return _span_cm(name, meta)
+
+
+@contextlib.contextmanager
+def _span_cm(name: str, meta: dict):
+    collect = _enabled
+    slow = _slow_ms
+    s = Span(name=name, meta=meta, tid=threading.get_ident())
+    token = None
+    is_root = False
+    gen = _generation
+    if collect:
+        parent = _current.get()
+        if parent is not None:
+            s.trace_id = parent.trace_id
+            s.parent_id = parent.span_id
+            parent.children.append(s)  # GIL-atomic append
+        else:
+            is_root = True
+            link = _remote_link.get()
+            if link is not None:
+                # adopted remote parentage: still collected as a local
+                # root; render() stitches it under the caller's span
+                # when that span was collected in this process
+                s.trace_id, s.parent_id = link
+            else:
+                s.trace_id = _new_trace_id()
+        s.span_id = _new_span_id()
+        token = _current.set(s)
+    s.start_ts = time.time()
+    s.start = time.perf_counter()
+    try:
+        yield s
+    finally:
+        s.elapsed = time.perf_counter() - s.start
+        if collect:
+            _current.reset(token)
+            if is_root:
+                with _roots_lock:
+                    if gen == _generation:  # reset() since open: drop
+                        _roots.append(s)
+        if slow is not None and s.elapsed * 1000.0 >= slow:
+            _log_slow(s)
+
+
+def _log_slow(s: Span) -> None:
+    from trivy_tpu.log import logger  # lazy: log.py lazily imports us
+
+    kv = {"ms": round(s.elapsed * 1000.0, 1)}
+    if s.trace_id:
+        kv["trace_id"] = s.trace_id
+        kv["span_id"] = s.span_id
+    logger("trace").warn(f"slow span: {s.name}", **kv)
+
+
+def add_meta(**meta) -> None:
+    s = _current.get()
+    if _enabled and s is not None:
+        s.meta.update(meta)
+
+
+def current() -> Span | None:
+    """The innermost open span of this context (None when tracing is
+    off or no span is open)."""
+    return _current.get()
+
+
+def current_scan_id() -> str:
+    return _scan_id.get()
+
+
+def log_fields() -> dict | None:
+    """trace_id/span_id/scan_id for log correlation (only the fields
+    that are set; None when there is nothing to report)."""
+    s = _current.get()
+    sid = _scan_id.get()
+    if s is None and not sid:
+        return None
+    out: dict = {}
+    if s is not None:
+        out["trace_id"] = s.trace_id
+        out["span_id"] = s.span_id
+    if sid:
+        out["scan_id"] = sid
+    return out
+
+
+# ------------------------------------------------------- cross-thread
+
+def capture():
+    """Snapshot the ambient trace context (current span + scan id) in
+    the submitting thread; hand the result to adopt() inside a worker
+    thread so its spans attach to the submitting scan instead of
+    becoming orphaned roots. Cheap: two contextvar reads."""
+    s = _current.get()
+    sid = _scan_id.get()
+    link = _remote_link.get()
+    if s is None and not sid and link is None:
+        return None
+    return (s, sid, link)
+
+
+@contextlib.contextmanager
+def adopt(captured):
+    """Install a capture()d context in this thread for the duration."""
+    if captured is None:
+        yield
+        return
+    s, sid, link = captured
+    tokens = []
+    if s is not None:
+        tokens.append((_current, _current.set(s)))
+    if sid:
+        tokens.append((_scan_id, _scan_id.set(sid)))
+    if link is not None:
+        tokens.append((_remote_link, _remote_link.set(link)))
+    try:
+        yield
+    finally:
+        for var, token in reversed(tokens):
+            var.reset(token)
+
+
+@contextlib.contextmanager
+def scan_scope(scan_id: str | None = None, force: bool = False):
+    """Make a scan id ambient for log correlation. An id already in
+    scope is kept unless `force` (fleet lanes force one per artifact;
+    the scanner then inherits it)."""
+    if scan_id is None:
+        if _scan_id.get() and not force:
+            yield _scan_id.get()
+            return
+        from trivy_tpu.utils import uuid as uuid_util
+
+        scan_id = uuid_util.new()
+    token = _scan_id.set(scan_id)
+    try:
+        yield scan_id
+    finally:
+        _scan_id.reset(token)
+
+
+# --------------------------------------------------------- RPC boundary
+
+def inject_headers(headers: dict) -> None:
+    """Client side: stamp the current span's identity into the outgoing
+    request so the server's spans join this trace."""
+    s = _current.get()
+    if _enabled and s is not None:
+        headers[TRACE_HEADER] = f"{s.trace_id}-{s.span_id}"
+
+
+def parse_trace_header(value: str | None) -> tuple[str, str] | None:
+    """'<32-hex trace>-<16-hex span>' -> (trace_id, parent_span_id)."""
+    if not value:
+        return None
+    trace_id, sep, span_id = value.partition("-")
+    if not sep or not trace_id or not span_id:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    return trace_id, span_id
+
+
+@contextlib.contextmanager
+def server_span(name: str, header: str | None, **meta):
+    """Server side: open a handler span whose parent is the caller's
+    span from the X-Trivy-Trace header (fresh root when absent)."""
+    link = parse_trace_header(header)
+    token = _remote_link.set(link) if link is not None else None
+    try:
+        with span(name, **meta) as s:
+            yield s
+    finally:
+        if token is not None:
+            _remote_link.reset(token)
+
+
+# ------------------------------------------------------------ lifecycle
+
+def reset() -> None:
+    """Drop every collected span, process-wide. Safe to call from any
+    thread while spans are open elsewhere (their eventual close is
+    discarded by the generation guard) and idempotent when tracing is
+    disabled."""
+    global _generation
+    with _roots_lock:
+        _generation += 1
+        _roots.clear()
+
+
+def _stitched_roots() -> tuple[list[Span], dict[str, list[Span]]]:
+    """Snapshot of collected roots, with roots that name a collected
+    span as parent grafted under it (the client RPC span adopts the
+    server handler span). Non-destructive: the graft lives in the
+    returned extra-children map, not in Span.children."""
+    with _roots_lock:
+        roots = list(_roots)
+    by_id: dict[str, Span] = {}
+
+    def index(s: Span):
+        by_id[s.span_id] = s
+        for c in s.children:
+            index(c)
+
+    for r in roots:
+        index(r)
+    extra: dict[str, list[Span]] = {}
+    top: list[Span] = []
+    for r in roots:
+        parent = by_id.get(r.parent_id) if r.parent_id else None
+        if parent is not None and parent is not r:
+            extra.setdefault(parent.span_id, []).append(r)
+        else:
+            top.append(r)
+    return top, extra
+
+
+def render(out=None) -> str:
+    """Render collected spans as an indented tree with timings."""
+    lines: list[str] = []
+    top, extra = _stitched_roots()
+
+    def walk(s: Span, depth: int):
+        extras = "".join(f" {k}={v}" for k, v in s.meta.items())
+        lines.append(f"{'  ' * depth}{s.name:<{28 - 2 * depth}} "
+                     f"{s.elapsed * 1000:9.1f} ms{extras}")
+        for c in s.children:
+            walk(c, depth + 1)
+        for c in extra.get(s.span_id, ()):
+            walk(c, depth + 1)
+
+    for root in top:
+        walk(root, 0)
+    text = "\n".join(lines)
+    if out is not None and text:
+        out.write("-- trace " + "-" * 42 + "\n" + text + "\n")
+    return text
+
+
+def spans() -> list[Span]:
+    """Flat list of every collected span (roots first, then children)."""
+    out: list[Span] = []
+
+    def walk(s: Span):
+        out.append(s)
+        for c in s.children:
+            walk(c)
+
+    with _roots_lock:
+        roots = list(_roots)
+    for r in roots:
+        walk(r)
+    return out
+
+
+def timings() -> dict[str, float]:
+    """Aggregate elapsed seconds per span name across the collection —
+    the per-phase breakdown bench.py --phase-json dumps."""
+    agg: dict[str, float] = {}
+    for s in spans():
+        agg[s.name] = agg.get(s.name, 0.0) + s.elapsed
+    return {k: round(v, 6) for k, v in agg.items()}
+
+
+def chrome_events() -> list[dict]:
+    """Chrome trace-event 'complete' (ph=X) events for every collected
+    span; timestamps in microseconds since epoch."""
+    events = []
+    for s in spans():
+        args = {"trace_id": s.trace_id, "span_id": s.span_id}
+        if s.parent_id:
+            args["parent_id"] = s.parent_id
+        args.update({k: str(v) for k, v in s.meta.items()})
+        events.append({
+            "name": s.name,
+            "ph": "X",
+            "ts": round(s.start_ts * 1e6, 1),
+            "dur": round(s.elapsed * 1e6, 1),
+            "pid": os.getpid(),
+            "tid": s.tid,
+            "cat": "trivy_tpu",
+            "args": args,
+        })
+    return events
+
+
+def export_chrome(path: str) -> int:
+    """Write the collected spans as Chrome trace-event JSON (open in
+    Perfetto / chrome://tracing). Returns the number of events."""
+    events = chrome_events()
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    return len(events)
+
+
+@contextlib.contextmanager
+def jax_profile():
+    """Capture a JAX profiler trace when TRIVY_TPU_JAX_TRACE_DIR is set
+    (viewable with tensorboard/xprof)."""
+    trace_dir = os.environ.get("TRIVY_TPU_JAX_TRACE_DIR", "")
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -18,9 +18,13 @@ import threading
 import time
 from typing import Callable
 
+from trivy_tpu.obs import metrics as obs_metrics
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half-open"
+
+_STATE_VALUE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
 
 
 class BreakerOpen(Exception):
@@ -49,6 +53,16 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._trials = 0
+        obs_metrics.BREAKER_STATE.set(0, name=name)
+
+    def _set_state(self, state: str) -> None:
+        # lock held by caller; publishes the trivy_tpu_breaker_state
+        # gauge + transition counter on every actual state change
+        if state == self._state:
+            return
+        self._state = state
+        obs_metrics.BREAKER_STATE.set(_STATE_VALUE[state], name=self.name)
+        obs_metrics.BREAKER_TRANSITIONS.inc(name=self.name, state=state)
 
     # ------------------------------------------------------------ state
 
@@ -72,12 +86,12 @@ class CircuitBreaker:
         # lock held by caller
         if self._state == OPEN and \
                 self._clock() - self._opened_at >= self.recovery_s:
-            self._state = HALF_OPEN
+            self._set_state(HALF_OPEN)
             self._trials = 0
 
     def _trip(self) -> None:
         # lock held by caller
-        self._state = OPEN
+        self._set_state(OPEN)
         self._opened_at = self._clock()
         self._failures = 0
         self._trials = 0
@@ -100,7 +114,7 @@ class CircuitBreaker:
     def record_success(self) -> None:
         with self._lock:
             self._tick()
-            self._state = CLOSED
+            self._set_state(CLOSED)
             self._failures = 0
             self._trials = 0
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Callable
 
 from trivy_tpu.log import logger
+from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.resilience.breaker import CircuitBreaker
 from trivy_tpu.resilience.retry import (
     DeadlineExceeded,
@@ -67,6 +68,7 @@ class FallbackDriver:
                 self.breaker.record_success()
                 return out
         _log.warn("degrading to local scan", reason=reason)
+        obs_metrics.DEGRADED_TOTAL.inc(component="driver")
         # the fallback is the completion guarantee: it runs with the
         # budget lifted (a deadlined local scan would shed at the next
         # checkpoint and the caller would get nothing at all)
@@ -117,6 +119,7 @@ class FallbackCache:
         except Exception as exc:
             if self.breaker is not None:
                 self.breaker.record_failure()
+            obs_metrics.DEGRADED_TOTAL.inc(component="cache")
             if not self._warned:
                 self._warned = True
                 _log.warn("remote cache unavailable; mirroring locally",

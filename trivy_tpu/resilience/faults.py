@@ -189,7 +189,9 @@ class FaultPlan:
 
     def fire(self, site: str) -> list[Rule]:
         """Which rules apply to this call at `site`? Increments the call
-        counter of every matching rule, firing or not."""
+        counter of every matching rule, firing or not. Every firing is
+        counted in trivy_tpu_fault_injections_total{site,action} so a
+        fault-matrix run's metrics show exactly what was injected."""
         out: list[Rule] = []
         with self._lock:
             for r in self.rules:
@@ -197,6 +199,11 @@ class FaultPlan:
                     r.calls += 1
                     if r.fires(r.calls, self._rng):
                         out.append(r)
+        if out:
+            from trivy_tpu.obs import metrics as obs_metrics
+
+            for r in out:
+                obs_metrics.FAULT_FIRES.inc(site=r.site, action=r.action)
         return out
 
 

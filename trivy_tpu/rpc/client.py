@@ -16,10 +16,13 @@ from __future__ import annotations
 
 import json
 import random
+import time
 import urllib.error
 import urllib.request
 
 from trivy_tpu.log import logger
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import tracing
 from trivy_tpu.resilience import faults
 from trivy_tpu.resilience.retry import (
     DEADLINE_HEADER,
@@ -52,6 +55,14 @@ class _Conn:
         self._rng = random.Random(self.retry.seed)
 
     def post(self, path: str, body: bytes) -> bytes:
+        # one client span covers the whole retried call; the trace
+        # identity rides X-Trivy-Trace so the server's handler span
+        # becomes this span's child (docs/observability.md)
+        method = path.rsplit("/", 1)[-1]
+        with tracing.span(f"rpc.{method}", url=self.base):
+            return self._post_attempts(path, method, body)
+
+    def _post_attempts(self, path: str, method: str, body: bytes) -> bytes:
         # the extended-fidelity internal encoding is marked so the server
         # can tell it apart from reference Twirp clients on the same paths
         headers = {"Content-Type": "application/json",
@@ -59,6 +70,7 @@ class _Conn:
                    **self.custom_headers}
         if self.token:
             headers["Trivy-Token"] = self.token
+        tracing.inject_headers(headers)
         policy = self.retry
         deadline = current_deadline()
         delays = policy.delays(self._rng)
@@ -101,8 +113,14 @@ class _Conn:
                     # blind socket timeout into that definite answer
                     timeout = max(0.001, min(
                         timeout, deadline.remaining() + 0.5))
-                with urllib.request.urlopen(req, timeout=timeout) as r:
-                    raw = r.read()
+                rt_start = time.perf_counter()
+                try:
+                    with urllib.request.urlopen(req, timeout=timeout) as r:
+                        raw = r.read()
+                finally:
+                    # per-attempt round-trip latency, errors included
+                    obs_metrics.RPC_CLIENT_SECONDS.observe(
+                        time.perf_counter() - rt_start, method=method)
                 return faults.corrupt_bytes(raw) if corrupt else raw
             except faults.InjectedHTTPError as exc:
                 if exc.code < 500:
@@ -130,6 +148,7 @@ class _Conn:
                         f"{deadline.budget_s:.3f}s leaves no room to retry "
                         f"(last error: {last_err})",
                         budget_s=deadline.budget_s)
+                obs_metrics.RETRY_ATTEMPTS.inc(method=method)
                 policy.sleep(delay)
         raise RPCError(
             f"rpc to {self.base}{path} failed after {policy.attempts} "

@@ -26,6 +26,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import trivy_tpu
 from trivy_tpu.log import logger
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import tracing
 from trivy_tpu.resilience.retry import (
     DEADLINE_HEADER,
     Deadline,
@@ -100,53 +102,96 @@ class _RWLock:
 
 
 class Metrics:
-    """Scan-server counters exposed at /metrics in Prometheus text
-    format (SURVEY §5: greenfield for the TPU sidecar — scans/sec,
-    findings, hot-swap count)."""
+    """Scan-server metrics exposed at /metrics in Prometheus text format
+    (SURVEY §5: greenfield for the TPU sidecar).
+
+    Backed by an obs.metrics.Registry private to this server instance
+    (fresh Server => zeroed counters, as tests expect) — every
+    pre-existing trivy_tpu_* series name is byte-stable, enforced by a
+    golden test. render() appends the process-wide spine registry
+    (scan-phase / RPC histograms, breaker state, cache corruption,
+    fault fires), each rendered under one lock snapshot so concurrent
+    scans cannot produce torn counter reads.
+
+    The legacy integer attributes (scans_total, ...) remain readable as
+    properties; writers go through the typed metric handles."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self.scans_total = 0
-        self.scan_errors_total = 0
-        self.scan_seconds_sum = 0.0
-        self.findings_total = 0
-        self.db_reloads_total = 0
-        self.db_reload_failures_total = 0
-        self.scans_shed_total = 0
-        self.drained_scans_total = 0
+        self.registry = obs_metrics.Registry()
+        reg = self.registry
+        self.scans = reg.counter(
+            "trivy_tpu_scans_total", "Scan RPCs handled")
+        self.scan_errors = reg.counter(
+            "trivy_tpu_scan_errors_total", "Scan RPCs that errored")
+        self.scan_seconds = reg.counter(
+            "trivy_tpu_scan_seconds_sum",
+            "Total seconds spent in scan RPCs")
+        self.findings = reg.counter(
+            "trivy_tpu_findings_total", "Vulnerabilities reported")
+        self.db_reloads = reg.counter(
+            "trivy_tpu_db_reloads_total", "Advisory-DB hot swaps served")
+        self.db_reload_failures = reg.counter(
+            "trivy_tpu_db_reload_failures_total",
+            "Advisory-DB candidates rejected (serving last-good)")
+        self.scans_shed = reg.counter(
+            "trivy_tpu_scans_shed_total",
+            "Scans shed with 503 (drain, DB swap, deadline)")
+        self.drained_scans = reg.counter(
+            "trivy_tpu_drained_scans_total",
+            "In-flight scans carried to completion during drain")
+        self.db_reload_seconds = reg.histogram(
+            "trivy_tpu_db_reload_seconds",
+            "Advisory-DB reload attempt duration (load+validate+swap)")
+        self.db_generation_age = reg.gauge(
+            "trivy_tpu_db_generation_age_seconds",
+            "Seconds since the served DB generation was loaded")
+
+    # legacy integer views (tests and operators read these directly)
+
+    @property
+    def scans_total(self) -> int:
+        return int(self.scans.value())
+
+    @property
+    def scan_errors_total(self) -> int:
+        return int(self.scan_errors.value())
+
+    @property
+    def scan_seconds_sum(self) -> float:
+        return self.scan_seconds.value()
+
+    @property
+    def findings_total(self) -> int:
+        return int(self.findings.value())
+
+    @property
+    def db_reloads_total(self) -> int:
+        return int(self.db_reloads.value())
+
+    @property
+    def db_reload_failures_total(self) -> int:
+        return int(self.db_reload_failures.value())
+
+    @property
+    def scans_shed_total(self) -> int:
+        return int(self.scans_shed.value())
+
+    @property
+    def drained_scans_total(self) -> int:
+        return int(self.drained_scans.value())
 
     def record(self, seconds: float, findings: int = 0,
                error: bool = False) -> None:
-        with self._lock:
-            self.scans_total += 1
-            self.scan_seconds_sum += seconds
-            self.findings_total += findings
+        with self.registry.locked():  # one snapshot-consistent update
+            self.scans.inc()
+            self.scan_seconds.inc(round(seconds, 6))
+            if findings:
+                self.findings.inc(findings)
             if error:
-                self.scan_errors_total += 1
+                self.scan_errors.inc()
 
     def render(self) -> bytes:
-        from trivy_tpu.cache import cache as cache_mod
-
-        with self._lock:
-            rows = [
-                ("trivy_tpu_scans_total", self.scans_total),
-                ("trivy_tpu_scan_errors_total", self.scan_errors_total),
-                ("trivy_tpu_scan_seconds_sum",
-                 round(self.scan_seconds_sum, 6)),
-                ("trivy_tpu_findings_total", self.findings_total),
-                ("trivy_tpu_db_reloads_total", self.db_reloads_total),
-                ("trivy_tpu_db_reload_failures_total",
-                 self.db_reload_failures_total),
-                ("trivy_tpu_scans_shed_total", self.scans_shed_total),
-                ("trivy_tpu_drained_scans_total", self.drained_scans_total),
-                ("trivy_tpu_cache_corrupt_total",
-                 cache_mod.corrupt_evictions()),
-            ]
-        out = []
-        for name, value in rows:
-            out.append(f"# TYPE {name} counter")
-            out.append(f"{name} {value}")
-        return ("\n".join(out) + "\n").encode()
+        return self.registry.render() + obs_metrics.REGISTRY.render()
 
 
 class ScanService:
@@ -159,6 +204,11 @@ class ScanService:
         self.db_path = db_path
         self._db_state = self._db_identity()
         self.metrics = Metrics()
+        # generation age: seconds since the served DB was (re)loaded,
+        # evaluated at /metrics render time
+        self._db_loaded_at = time.time()
+        self.metrics.db_generation_age.set_function(
+            lambda: time.time() - self._db_loaded_at)
         # durable-lifecycle state: the generation the live engine was
         # loaded from (rollback target), the identity of the last
         # candidate we rejected (avoid a reload/reject loop), and a
@@ -246,8 +296,7 @@ class ScanService:
         the scan as in-flight until end_scan."""
         with self._drain_cond:
             if self.draining:
-                with self.metrics._lock:
-                    self.metrics.scans_shed_total += 1
+                self.metrics.scans_shed.inc()
                 raise Overloaded("server draining (shutting down)",
                                  retry_after=2.0)
             self._inflight += 1
@@ -257,8 +306,7 @@ class ScanService:
             self._inflight -= 1
             if self.draining:
                 # an in-flight scan carried to completion during drain
-                with self.metrics._lock:
-                    self.metrics.drained_scans_total += 1
+                self.metrics.drained_scans.inc()
             self._drain_cond.notify_all()
 
     def start_drain(self) -> None:
@@ -293,15 +341,13 @@ class ScanService:
         if deadline is not None:
             timeout = deadline.remaining()
             if timeout <= 0:
-                with self.metrics._lock:
-                    self.metrics.scans_shed_total += 1
+                self.metrics.scans_shed.inc()
                 raise Overloaded("deadline budget exhausted before scan "
                                  "start", retry_after=1.0)
         if not self.lock.acquire_read(timeout=timeout):
             # a DB swap holds the write lock and the caller's budget ran
             # out waiting: shed instead of blocking behind the swap
-            with self.metrics._lock:
-                self.metrics.scans_shed_total += 1
+            self.metrics.scans_shed.inc()
             raise Overloaded(
                 "server busy (advisory-DB swap in progress); deadline "
                 f"budget of {deadline.budget_s:.3f}s exhausted waiting",
@@ -320,8 +366,7 @@ class ScanService:
             # mid-scan deadline checkpoints fired. Sheds count ONLY in
             # scans_shed_total (consistent with the pre-lock shed path):
             # a caller-imposed budget running out is not a scan error
-            with self.metrics._lock:
-                self.metrics.scans_shed_total += 1
+            self.metrics.scans_shed.inc()
             raise
         except Exception:
             self.metrics.record(time.perf_counter() - start, error=True)
@@ -357,6 +402,7 @@ class ScanService:
 
         resolved = self._resolved_db_dir()
         _log.info("advisory DB changed; reloading", path=resolved)
+        reload_start = time.perf_counter()
         problem = None
         db = new_engine = None
         try:
@@ -369,8 +415,9 @@ class ScanService:
         if problem is not None:
             self._rejected_db_state = state
             self.db_degraded = f"DB candidate rejected ({problem})"
-            with self.metrics._lock:
-                self.metrics.db_reload_failures_total += 1
+            self.metrics.db_reload_failures.inc()
+            self.metrics.db_reload_seconds.observe(
+                time.perf_counter() - reload_start)
             _log.warn("advisory DB candidate rejected; serving last-good",
                       path=resolved, reason=problem)
             if self._is_generation(resolved) \
@@ -394,10 +441,12 @@ class ScanService:
             self._active_db_dir = resolved
             self._rejected_db_state = ()
             self.db_degraded = ""
+            self._db_loaded_at = time.time()
         finally:
             self.lock.release_write()
-        with self.metrics._lock:
-            self.metrics.db_reloads_total += 1
+        self.metrics.db_reloads.inc()
+        self.metrics.db_reload_seconds.observe(
+            time.perf_counter() - reload_start)
         _log.info("advisory DB hot-swapped", **db.stats())
         return True
 
@@ -506,17 +555,23 @@ def _make_handler(service: ScanService, token: str | None,
             target, akey, blobs, options = wire.decode_scan_request(body)
             deadline = Deadline.from_header(
                 self.headers.get(DEADLINE_HEADER))
-            try:
-                results, os_found = service.scan(
-                    target, akey, blobs, options, deadline=deadline)
-            except Overloaded as exc:
-                _log.warn("scan shed", err=str(exc))
-                self._shed(str(exc), exc.retry_after)
-                return
-            except DeadlineExceeded as exc:
-                _log.warn("scan shed mid-flight", err=str(exc))
-                self._shed(str(exc), 1.0)
-                return
+            # adopt the caller's trace identity (X-Trivy-Trace) so the
+            # server-side phases nest under the client's RPC span — a
+            # remote scan renders as one stitched tree
+            with tracing.server_span(
+                    "server.scan", self.headers.get(tracing.TRACE_HEADER),
+                    target=target):
+                try:
+                    results, os_found = service.scan(
+                        target, akey, blobs, options, deadline=deadline)
+                except Overloaded as exc:
+                    _log.warn("scan shed", err=str(exc))
+                    self._shed(str(exc), exc.retry_after)
+                    return
+                except DeadlineExceeded as exc:
+                    _log.warn("scan shed mid-flight", err=str(exc))
+                    self._shed(str(exc), 1.0)
+                    return
             self._reply(200, wire.scan_response(results, os_found))
 
         def _handle_cache(self, method: str, body: bytes):

@@ -33,16 +33,19 @@ class LocalDriver:
         self.post_hooks = post_hooks or []
 
     def scan(self, target, artifact_key, blob_keys, options: ScanOptions):
+        from trivy_tpu import obs
+        from trivy_tpu.obs import tracing as trace
         from trivy_tpu.resilience.retry import checkpoint
         from trivy_tpu.scanner import post
-        from trivy_tpu.utils import trace
 
         # phase-boundary deadline checkpoints: under an ambient deadline
         # budget (server header / --scan-timeout) a scan that cannot
         # finish sheds promptly between phases instead of burning device
         # time nobody will wait for
         checkpoint("apply_layers")
-        with trace.span("apply_layers"):
+        # blob reads + squash are the "cache" phase of the latency
+        # histogram; the span keeps its historical name
+        with obs.phase("apply_layers", phase="cache"):
             detail = self._apply_layers(blob_keys)
             self._merge_artifact_info(detail, artifact_key)
             trace.add_meta(pkgs=len(detail.packages),
@@ -61,7 +64,7 @@ class LocalDriver:
             with trace.span("rekor_sbom_discovery"):
                 discover_sboms(detail, options.rekor_url)
         checkpoint("detect")
-        with trace.span("detect"):
+        with obs.phase("detect"):
             results = self._scan_detail(target, detail, options)
         checkpoint("post_hooks")
         with trace.span("post_hooks"):
@@ -107,11 +110,14 @@ class LocalDriver:
     def _scan_detail(
         self, target: str, detail: ArtifactDetail, options: ScanOptions
     ) -> list[Result]:
+        from trivy_tpu import obs
+
         results: list[Result] = []
         if ScannerEnum.VULN in options.scanners:
             results.extend(self._scan_vulns(target, detail, options))
         if ScannerEnum.SECRET in options.scanners:
-            results.extend(self._secret_results(detail))
+            with obs.phase("secret_results", phase="secret"):
+                results.extend(self._secret_results(detail))
         if ScannerEnum.LICENSE in options.scanners:
             results.extend(self._license_results(detail, options))
         results.extend(self._misconfig_results(detail))

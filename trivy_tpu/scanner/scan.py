@@ -37,10 +37,13 @@ class Scanner:
         self.artifact = artifact
 
     def scan_artifact(self, options: ScanOptions) -> Report:
-        from trivy_tpu.utils import trace
+        from trivy_tpu import obs
+        from trivy_tpu.obs import tracing as trace
 
-        with trace.span("scan_artifact"):
-            with trace.span("inspect"):
+        # every scan gets an ambient scan id (kept when a fleet lane
+        # already set one) that log records carry next to trace ids
+        with trace.scan_scope(), trace.span("scan_artifact"):
+            with obs.phase("inspect"):
                 ref = self.artifact.inspect()
                 trace.add_meta(blobs=len(ref.blob_ids))
             try:

@@ -47,7 +47,14 @@ def run_pipeline(items: Iterable[T], fn: Callable[[T], R],
     and all failures surface together as one index-matched
     PipelineError after the successful slots' callbacks have been
     delivered. In parallel mode every item still runs (the pool drains
-    the queue regardless); sequential mode stays fail-fast."""
+    the queue regardless); sequential mode stays fail-fast.
+
+    Observability: the submitting thread's trace context (current span,
+    scan id) is captured once and adopted inside every worker, so spans
+    opened by fn attach to the submitting scan's span instead of
+    becoming orphaned roots (docs/observability.md)."""
+    from trivy_tpu.obs import tracing
+
     items = list(items)
     results: list = [None] * len(items)
     errors: list = [None] * len(items)
@@ -71,25 +78,31 @@ def run_pipeline(items: Iterable[T], fn: Callable[[T], R],
         q: queue.Queue = queue.Queue()
         for i, it in enumerate(items):
             q.put((i, it))
+        # captured in the submitting thread, adopted per worker: a new
+        # thread starts from an empty contextvars context, which is how
+        # worker spans used to orphan into separate roots
+        trace_ctx = tracing.capture()
 
         def worker():
-            while True:
-                try:
-                    i, it = q.get_nowait()
-                except queue.Empty:
-                    return
-                try:
-                    if on_start:
-                        on_start(i, it)
-                    results[i] = fn(it)
-                # BaseException too (InjectedKill, SystemExit from fn):
-                # letting it kill the worker thread would strand queued
-                # items and hang q.join() forever — in a pool, every
-                # failure must land in a slot, not take the pool down
-                except BaseException as e:  # noqa: B036
-                    errors[i] = e
-                finally:
-                    q.task_done()
+            with tracing.adopt(trace_ctx):
+                while True:
+                    try:
+                        i, it = q.get_nowait()
+                    except queue.Empty:
+                        return
+                    try:
+                        if on_start:
+                            on_start(i, it)
+                        results[i] = fn(it)
+                    # BaseException too (InjectedKill, SystemExit from
+                    # fn): letting it kill the worker thread would
+                    # strand queued items and hang q.join() forever —
+                    # in a pool, every failure must land in a slot, not
+                    # take the pool down
+                    except BaseException as e:  # noqa: B036
+                        errors[i] = e
+                    finally:
+                        q.task_done()
 
         threads = [threading.Thread(target=worker, daemon=True)
                    for _ in range(min(workers, len(items)))]

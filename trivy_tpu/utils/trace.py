@@ -1,120 +1,32 @@
-"""Step tracing (SURVEY §5: the reference has no real tracing — only
-Rego eval traces — so this is greenfield for the TPU build): named
-spans with wall-clock timings, rendered as a tree at scan end, plus an
-optional JAX profiler capture of the device portion.
+"""Compatibility shim: the tracer moved to `trivy_tpu.obs.tracing`
+(contextvars-based spans with trace/span ids, cross-thread and
+cross-RPC parentage, Chrome trace export — see docs/observability.md).
 
-Usage:
-    with trace.span("scan"):
-        with trace.span("inspect"): ...
-Enabled via --trace (CLI) or TRIVY_TPU_TRACE=1; the JAX profiler dump
-is written when TRIVY_TPU_JAX_TRACE_DIR is set.
+Every historical call site (`from trivy_tpu.utils import trace`) keeps
+working; new code should import `trivy_tpu.obs.tracing` directly.
 """
 
-from __future__ import annotations
-
-import contextlib
-import os
-import threading
-import time
-from dataclasses import dataclass, field
-
-_local = threading.local()
-
-_enabled = os.environ.get("TRIVY_TPU_TRACE", "") not in ("", "0", "false")
-
-
-def enable(on: bool = True) -> None:
-    global _enabled
-    _enabled = on
-
-
-def enabled() -> bool:
-    return _enabled
-
-
-@dataclass
-class Span:
-    name: str
-    start: float = 0.0
-    elapsed: float = 0.0
-    children: list["Span"] = field(default_factory=list)
-    meta: dict = field(default_factory=dict)
-
-
-def _stack() -> list[Span]:
-    if not hasattr(_local, "stack"):
-        _local.stack = []
-    return _local.stack
-
-
-_roots: list[Span] = []
-_roots_lock = threading.Lock()
-
-
-@contextlib.contextmanager
-def span(name: str, **meta):
-    if not _enabled:
-        yield None
-        return
-    s = Span(name=name, start=time.perf_counter(), meta=dict(meta))
-    stack = _stack()
-    if stack:
-        stack[-1].children.append(s)
-    else:
-        with _roots_lock:
-            _roots.append(s)
-    stack.append(s)
-    try:
-        yield s
-    finally:
-        s.elapsed = time.perf_counter() - s.start
-        stack.pop()
-
-
-def add_meta(**meta) -> None:
-    stack = _stack()
-    if _enabled and stack:
-        stack[-1].meta.update(meta)
-
-
-def reset() -> None:
-    with _roots_lock:
-        _roots.clear()
-    _local.stack = []
-
-
-def render(out=None) -> str:
-    """Render collected spans as an indented tree with timings."""
-    lines: list[str] = []
-
-    def walk(s: Span, depth: int):
-        extras = "".join(f" {k}={v}" for k, v in s.meta.items())
-        lines.append(f"{'  ' * depth}{s.name:<{28 - 2 * depth}} "
-                     f"{s.elapsed * 1000:9.1f} ms{extras}")
-        for c in s.children:
-            walk(c, depth + 1)
-
-    with _roots_lock:
-        for root in _roots:
-            walk(root, 0)
-    text = "\n".join(lines)
-    if out is not None and text:
-        out.write("-- trace " + "-" * 42 + "\n" + text + "\n")
-    return text
-
-
-@contextlib.contextmanager
-def jax_profile():
-    """Capture a JAX profiler trace when TRIVY_TPU_JAX_TRACE_DIR is set
-    (viewable with tensorboard/xprof)."""
-    trace_dir = os.environ.get("TRIVY_TPU_JAX_TRACE_DIR", "")
-    if not trace_dir:
-        yield
-        return
-    import jax
-
-    jax.profiler.start_trace(trace_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+from trivy_tpu.obs.tracing import (  # noqa: F401
+    TRACE_HEADER,
+    Span,
+    add_meta,
+    adopt,
+    capture,
+    chrome_events,
+    current,
+    current_scan_id,
+    enable,
+    enabled,
+    export_chrome,
+    inject_headers,
+    jax_profile,
+    parse_trace_header,
+    render,
+    reset,
+    scan_scope,
+    server_span,
+    set_slow_span_ms,
+    span,
+    spans,
+    timings,
+)
