@@ -590,6 +590,37 @@ def main():
     compile_s = time.time() - t0
     cdb = engine.cdb
 
+    # --- persistent compiled-DB cache: cold save + warm-start load -------
+    # the north-star pain point: every process start paid db_compile_s
+    # re-tensorizing an unchanged DB. Save the synthetic DB to disk,
+    # compile-and-cache once, then time a fresh warm-start engine that
+    # hits the cache (tensorize/cache.py).
+    compile_cache_detail = {}
+    with _trace.span("compile_cache"):
+        import shutil
+        import tempfile
+
+        from trivy_tpu.obs import metrics as _obs_metrics
+
+        cache_dir = tempfile.mkdtemp(prefix="trivy_tpu_bench_db_")
+        try:
+            db.save(cache_dir, compress=False)
+            t0 = time.time()
+            MatchEngine(db, db_path=cache_dir, use_device=False)
+            cold_s = time.time() - t0  # compile + cache save
+            t0 = time.time()
+            MatchEngine(db, db_path=cache_dir, use_device=False)
+            warm_s = time.time() - t0  # cache hit
+            compile_cache_detail = {
+                "cold_compile_save_s": round(cold_s, 2),
+                "warm_start_s": round(warm_s, 2),
+                "speedup": round(cold_s / warm_s, 1) if warm_s else 0.0,
+                "hits": int(_obs_metrics.COMPILE_CACHE_HITS.value()),
+                "misses": int(_obs_metrics.COMPILE_CACHE_MISSES.value()),
+            }
+        finally:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
     # resident DB bytes: sorted h1 key column + interleaved [N, 8] table,
     # for both the main and hot partitions
     from trivy_tpu.ops.match import TABLE_LANES, _words
@@ -673,6 +704,72 @@ def main():
     engine._detect_unique(uniq)
     host_s = max(time.time() - t0 - encode_s - device_s, 0.0)
 
+    # --- pipelined executor vs the serial stage sum ----------------------
+    # K same-shaped batches of fresh uniques stream through detect_many's
+    # pipelined executor (crawl cache cleared so every chunk dispatches;
+    # jit/interns/rescreen memo warm = the steady state of a long-lived
+    # scan server). pipelined_batch_s is the executor's wall normalized
+    # to the stage-batch size; serial_stage_sum_s re-measures the three
+    # synchronous stages interleaved with the pipelined runs — the
+    # acceptance ratio shows how much of the serial stages the overlap
+    # actually hides.
+    from trivy_tpu.tensorize.synth import synth_queries
+
+    pipe = {}
+    if ddb is not None:
+        import statistics
+
+        k_batches = 6
+        stream: list = []
+        for k in range(k_batches):
+            stream.extend(synth_queries(db, len(uniq), seed=900 + k))
+
+        def sync_stage_sum() -> float:
+            """One synchronous pass of the three stages on the stage-
+            breakdown batch. _detect_unique already contains the encode
+            and the device round-trip, so its wall IS the serial
+            encode+device+host sum (the stage_*_s fields above measure
+            the same wall, attributed by subtraction)."""
+            t1 = time.time()
+            engine._detect_unique(uniq)
+            return time.time() - t1
+
+        with _trace.span("pipeline_steady", batches=k_batches):
+            engine.detect_many(stream, batch_size=len(uniq))  # warm memos
+            pres = None
+            sums, walls = [], []
+            # serial and pipelined sampled INTERLEAVED so both sides see
+            # the same machine-load window (shared CI boxes drift by 2x
+            # within a run); medians of 3 rounds each
+            for _round in range(3):
+                sums.append(sync_stage_sum())
+                engine._crawl_cache.clear()
+                res = engine.detect_many(stream, batch_size=len(uniq))
+                pres = pres or res
+                st = engine.last_pipeline_stats or {}
+                # executor wall normalized to the stage-batch size so
+                # internal chunking cannot game the comparison
+                walls.append(st.get("wall_s", 0.0)
+                             / (len(stream) / len(uniq)))
+        st = engine.last_pipeline_stats or {}
+        serial_sum = statistics.median(sums)
+        batch_lat = statistics.median(walls)
+        pipe = {
+            "pipelined_batch_s": round(batch_lat, 3),
+            "serial_stage_sum_s": round(serial_sum, 3),
+            "pipeline_vs_serial": round(batch_lat / serial_sum, 2)
+            if serial_sum else 0.0,
+            "pipeline_occupancy": round(st.get("occupancy", 0.0), 3),
+            "pipeline_workers": st.get("workers", 0),
+            "pipeline_chunks": st.get("chunks", 0),
+            "pipeline_cores": os.cpu_count(),
+        }
+        # the pipelined path must stay byte-identical to the oracle
+        osub = engine.oracle_detect(stream[:20000])
+        pipe["pipeline_diff_vs_oracle"] = sum(
+            1 for a, b in zip(pres, osub)
+            if a.adv_indices != b.adv_indices)
+
     stage_span.__exit__(None, None, None)
 
     # --- realistic-density crawl (trivy-db-like ~1-5 matches/query) ------
@@ -750,7 +847,11 @@ def main():
         "rescreen": engine.rescreen_stats,
         "realistic": realistic,
         "secret": secret_detail,
+        "pipeline": pipe,
+        "compile_cache": compile_cache_detail,
     }
+    if pipe:
+        detail["pipeline_occupancy"] = pipe.get("pipeline_occupancy", 0.0)
     if phase_json:
         with open(phase_json, "w", encoding="utf-8") as f:
             json.dump({

@@ -403,6 +403,61 @@ def test_ebs_encryption_by_default_does_not_leak_across_roots():
     assert "AVD-AWS-0131" in fails
 
 
+def test_ebs_default_launch_config_scoped_per_module():
+    """launch-config lookups are per MODULE (reference autoscaling.go
+    module.GetResourcesByType inside the per-module loop): a root-module
+    account default must NOT suppress a child module's launch-config
+    finding — while the instance lookup stays scan-wide (adapt.go
+    modules.GetResourcesByType), so the child's instance IS covered."""
+    files = {
+        "main.tf":
+            b'module "c" { source = "./child" }\n'
+            b'resource "aws_ebs_encryption_by_default" "x" {\n'
+            b'  enabled = true\n}\n',
+        "child/main.tf":
+            b'resource "aws_launch_configuration" "lc" {\n'
+            b'  image_id = "ami-1"\n}\n'
+            b'resource "aws_instance" "i" {}\n',
+    }
+    fails = set()
+    for m in scan_terraform_modules(files):
+        fails |= {f.id for f in m.failures}
+    assert "AVD-AWS-0008" in fails      # child launch config still flags
+    assert "AVD-AWS-0131" not in fails  # instance lookup is scan-wide
+    # a default declared IN the child module suppresses its own
+    # launch-config finding
+    files["child/main.tf"] += (
+        b'resource "aws_ebs_encryption_by_default" "y" {\n'
+        b'  enabled = true\n}\n')
+    fails = set()
+    for m in scan_terraform_modules(files):
+        fails |= {f.id for f in m.failures}
+    assert "AVD-AWS-0008" not in fails
+
+
+def test_ebs_default_scoped_per_module_instance():
+    """Two instantiations of the SAME module source are distinct module
+    instances (reference iterates modules, not source dirs): a default
+    enabled in instance A must not suppress instance B's launch-config
+    finding when B's input disables it."""
+    files = {
+        "main.tf":
+            b'module "a" { source = "./m"\n  on = true }\n'
+            b'module "b" { source = "./m"\n  on = false }\n',
+        "m/main.tf":
+            b'variable "on" {}\n'
+            b'resource "aws_ebs_encryption_by_default" "x" {\n'
+            b'  enabled = var.on\n}\n'
+            b'resource "aws_launch_configuration" "lc" {\n'
+            b'  image_id = "ami-1"\n}\n',
+    }
+    fails = set()
+    for m in scan_terraform_modules(files):
+        fails |= {f.id for f in m.failures}
+    # instance b (enabled = false) still reports its launch config
+    assert "AVD-AWS-0008" in fails
+
+
 def test_ebs_default_does_not_leak_into_shared_module():
     """A module shared by two roots is evaluated per root: stack A's
     account default must not suppress findings for stack B's
@@ -493,7 +548,12 @@ def test_cfn_cache_cluster_retention():
 
 def test_cfn_instance_inherits_hardened_launch_template():
     """An instance whose LaunchTemplate resolves adopts the template's
-    IMDS and block-device config (reference findRelatedLaunchTemplate)."""
+    IMDS config (reference findRelatedLaunchTemplate) — but NOT its
+    LaunchTemplateData.BlockDeviceMappings: the reference's
+    adaptLaunchTemplate reads mappings from top-level Properties (where
+    templates never carry them) and then overlays the instance's own
+    mappings, so an instance with none of its own still materializes an
+    unencrypted root (AVD-AWS-0131 fires)."""
     doc = {"Resources": {
         "LT": {"Type": "AWS::EC2::LaunchTemplate", "Properties": {
             "LaunchTemplateName": "hardened",
@@ -507,14 +567,23 @@ def test_cfn_instance_inherits_hardened_launch_template():
     }}
     ids = cfn_fails(doc)
     assert "AVD-AWS-0028" not in ids
-    assert "AVD-AWS-0131" not in ids
+    assert "AVD-AWS-0131" in ids  # template mappings do NOT transfer
     # by logical id, and by the canonical {"Ref": ...} form too
     for ltid in ("LT", {"Ref": "LT"}):
         doc["Resources"]["I"]["Properties"]["LaunchTemplate"] = {
             "LaunchTemplateId": ltid}
         ids = cfn_fails(doc)
         assert "AVD-AWS-0028" not in ids, ltid
-        assert "AVD-AWS-0131" not in ids, ltid
+        assert "AVD-AWS-0131" in ids, ltid
+    # the instance's OWN first mapping overrides the root device: an
+    # encrypted own mapping plus a resolved template must NOT flag
+    doc["Resources"]["I"]["Properties"] = {
+        "LaunchTemplate": {"LaunchTemplateName": "hardened"},
+        "BlockDeviceMappings": [{"Ebs": {"Encrypted": True}}],
+    }
+    ids = cfn_fails(doc)
+    assert "AVD-AWS-0028" not in ids
+    assert "AVD-AWS-0131" not in ids
 
 
 def test_cfn_eks_defined_vs_defaults():

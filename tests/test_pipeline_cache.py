@@ -1,0 +1,396 @@
+"""Pipelined match executor + persistent compiled-DB cache.
+
+- the pipelined crawl path must return byte-identical matches to the
+  serial path and the host oracle, including under injected faults on
+  the device stage (drop / delay / device-lost);
+- compiled-DB cache entries must hit on an unchanged digest, miss on
+  changed params/bytes, and self-heal from corruption (quarantine +
+  recompile) with zero scan-result diff;
+- the new obs instrumentation (pipeline spans, occupancy gauge) must
+  cost nothing measurable when tracing is disabled.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from trivy_tpu.db import Advisory, AdvisoryDB
+from trivy_tpu.detector.engine import MatchEngine, PkgQuery
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.resilience import faults
+
+pytestmark = [pytest.mark.fault]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _rich_db(n_names: int = 40, n_adv: int = 6) -> AdvisoryDB:
+    rng = random.Random(99)
+    db = AdvisoryDB()
+    for eco, scheme_suffix in [("npm", ""), ("pip", "")]:
+        bucket = f"{eco}::ghsa"
+        for i in range(n_names):
+            for j in range(rng.randint(1, n_adv)):
+                lo = f"{rng.randint(0, 2)}.{rng.randint(0, 9)}.0"
+                hi = f"{rng.randint(3, 6)}.{rng.randint(0, 9)}.0"
+                db.put_advisory(bucket, f"{eco}-pkg-{i}", Advisory(
+                    vulnerability_id=f"CVE-25-{i:03d}{j}",
+                    vulnerable_versions=[f">={lo}, <{hi}"],
+                ))
+    for i in range(n_names):
+        db.put_advisory("alpine 3.10", f"os-pkg-{i}", Advisory(
+            vulnerability_id=f"CVE-24-{i:04d}",
+            fixed_version=f"{rng.randint(1, 4)}.{rng.randint(0, 9)}.0-r0",
+        ))
+    db.meta.updated_at = "2026-01-01T00:00:00Z"
+    return db
+
+
+def _many_queries(n: int = 3400, seed: int = 3) -> list[PkgQuery]:
+    """> 3 pipeline chunks (chunk floor is 1024) of DISTINCT queries so
+    the pipelined executor actually engages."""
+    rng = random.Random(seed)
+    out = []
+    for k in range(n):
+        if k % 3 == 0:
+            out.append(PkgQuery(
+                "alpine 3.10", f"os-pkg-{rng.randint(0, 50)}",
+                f"{k % 7}.{k % 10}.{k % 89}-r0", "apk"))
+        elif k % 3 == 1:
+            v = f"{k % 5}.{k % 10}.{k % 97}"
+            if k % 11 == 0:
+                v += "-beta.1"  # pre-release -> rescreen path
+            out.append(PkgQuery(
+                "npm::", f"npm-pkg-{rng.randint(0, 50)}", v, "npm"))
+        else:
+            out.append(PkgQuery(
+                "pip::", f"pip-pkg-{rng.randint(0, 50)}",
+                f"{k % 4}.{k % 10}.{k % 83}", "pep440"))
+    return out
+
+
+def _hits(results):
+    return [r.adv_indices for r in results]
+
+
+# ------------------------------------------------------- pipelined crawl
+
+
+def test_pipelined_matches_serial_and_oracle(monkeypatch):
+    db = _rich_db()
+    queries = _many_queries()
+
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE", "0")
+    serial = MatchEngine(db, window=16)
+    got_serial = serial.detect_many(queries, batch_size=1024, depth=3)
+
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE", "1")
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE_WORKERS", "2")
+    piped = MatchEngine(db, window=16)
+    got_piped = piped.detect_many(queries, batch_size=1024, depth=3)
+
+    assert piped.last_pipeline_stats is not None, \
+        "pipelined executor did not engage"
+    assert piped.last_pipeline_stats["chunks"] >= 3
+    assert _hits(got_serial) == _hits(got_piped)
+    oracle = serial.oracle_detect(queries)
+    assert _hits(got_piped) == _hits(oracle)
+
+
+def test_pipeline_occupancy_gauge_and_stats(monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE", "1")
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE_WORKERS", "1")
+    engine = MatchEngine(_rich_db(), window=16)
+    engine.detect_many(_many_queries(), batch_size=1024, depth=2)
+    st = engine.last_pipeline_stats
+    assert st is not None
+    for key in ("wall_s", "encode_busy_s", "crunch_busy_s",
+                "finalize_busy_s", "chunks", "workers", "occupancy"):
+        assert key in st, key
+    assert 0.0 < st["occupancy"] <= 1.0
+    assert obs_metrics.PIPELINE_OCCUPANCY.value() == pytest.approx(
+        st["occupancy"])
+
+
+@pytest.mark.parametrize("spec", [
+    "engine.device:drop@2",        # one in-flight result lost, recomputed
+    "engine.device:drop",          # every result lost
+    "engine.device:delay=0.01@1-3",
+])
+def test_pipelined_byte_identical_under_device_faults(monkeypatch, spec):
+    db = _rich_db()
+    queries = _many_queries(seed=7)
+    oracle = MatchEngine(db, window=16, use_device=False)
+    want = _hits(oracle.detect_many(queries, batch_size=1024))
+
+    # serial path under the same fault spec
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE", "0")
+    faults.install_spec(spec)
+    serial = MatchEngine(db, window=16)
+    got_serial = _hits(serial.detect_many(queries, batch_size=1024,
+                                          depth=3))
+
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE", "1")
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE_WORKERS", "2")
+    faults.install_spec(spec)
+    piped = MatchEngine(db, window=16)
+    got_piped = _hits(piped.detect_many(queries, batch_size=1024,
+                                        depth=3))
+
+    assert got_serial == want
+    assert got_piped == want
+
+
+def test_pipelined_device_lost_degrades_to_oracle(monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE", "1")
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE_WORKERS", "1")
+    db = _rich_db()
+    queries = _many_queries(seed=11)
+    oracle = MatchEngine(db, window=16, use_device=False)
+    want = _hits(oracle.detect_many(queries, batch_size=1024))
+
+    # the loss fires mid-crawl (3rd chunk dispatch), after results have
+    # already been collected — the whole crawl must still be exact
+    faults.install_spec("engine:device-lost@4")
+    engine = MatchEngine(db, window=16)
+    got = _hits(engine.detect_many(queries, batch_size=1024, depth=2))
+    assert got == want
+    assert engine.device_lost and not engine.use_device
+
+
+def test_pipeline_spans_attach_to_crawl(monkeypatch):
+    """pipeline.* spans from worker lanes must nest under the caller's
+    span tree (capture/adopt), not become orphan roots."""
+    from trivy_tpu.obs import tracing
+
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE", "1")
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE_WORKERS", "1")
+    engine = MatchEngine(_rich_db(), window=16)
+    tracing.enable(True)
+    tracing.reset()
+    try:
+        with tracing.span("crawl-root"):
+            engine.detect_many(_many_queries(seed=13), batch_size=1024,
+                               depth=2)
+        spans = tracing.spans()
+        names = {s.name for s in spans}
+        assert {"pipeline.encode", "pipeline.crunch",
+                "pipeline.finalize"} <= names, names
+        roots = [s for s in spans if not s.parent_id]
+        assert len(roots) == 1 and roots[0].name == "crawl-root"
+    finally:
+        tracing.enable(False)
+        tracing.reset()
+
+
+def test_new_metrics_disabled_overhead_interleaved(monkeypatch):
+    """The pipeline instrumentation (spans + occupancy gauge) must be
+    free when tracing is off: interleaved alternating-order medians of
+    the real path vs a stubbed-out path."""
+    import contextlib
+    import statistics
+    import time as _time
+
+    from trivy_tpu.obs import tracing
+
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE", "1")
+    monkeypatch.setenv("TRIVY_TPU_PIPELINE_WORKERS", "1")
+    engine = MatchEngine(_rich_db(), window=16)
+    queries = _many_queries(seed=17)
+    engine.detect_many(queries, batch_size=1024, depth=2)  # warm
+
+    def run():
+        engine._crawl_cache.clear()
+        t0 = _time.perf_counter()
+        engine.detect_many(queries, batch_size=1024, depth=2)
+        return _time.perf_counter() - t0
+
+    @contextlib.contextmanager
+    def stubbed():
+        orig_span = tracing.span
+        orig_set = obs_metrics.PIPELINE_OCCUPANCY.set
+        tracing.span = lambda name, **meta: contextlib.nullcontext()
+        obs_metrics.PIPELINE_OCCUPANCY.set = lambda *a, **k: None
+        try:
+            yield
+        finally:
+            tracing.span = orig_span
+            obs_metrics.PIPELINE_OCCUPANCY.set = orig_set
+
+    real, stub = [], []
+    for i in range(10):  # alternating order so neither variant always
+        if i % 2 == 0:   # runs on a warm cache second
+            real.append(run())
+            with stubbed():
+                stub.append(run())
+        else:
+            with stubbed():
+                stub.append(run())
+            real.append(run())
+    r, s = statistics.median(real), statistics.median(stub)
+    # the instrumented path may not be measurably slower (5 ms absolute
+    # floor keeps scheduler jitter from flaking loaded CI boxes)
+    assert r <= s * 1.05 + 0.005, (r, s)
+
+
+def test_concurrent_detect_on_shared_engine():
+    """The RPC server runs concurrent scans on ONE engine under a read
+    lock: first-seen names/versions interning from several threads must
+    not mispair dense ids with their rank/flags columns (intern lock +
+    publish-last ordering). Every thread's results must equal the
+    oracle's."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    db = _rich_db()
+    engine = MatchEngine(db, window=16)
+    oracle = MatchEngine(db, window=16, use_device=False)
+    batches = [_many_queries(n=700, seed=100 + t) for t in range(6)]
+    want = [_hits(oracle.detect_many(b, batch_size=4096))
+            for b in batches]
+    with ThreadPoolExecutor(4) as ex:
+        got = list(ex.map(lambda b: _hits(engine.detect(b)), batches))
+    assert got == want
+
+
+# --------------------------------------------------- compiled-DB cache
+
+
+def _saved_db(tmp_path):
+    db = _rich_db()
+    root = str(tmp_path / "db")
+    db.save(root)
+    return root
+
+
+def test_compile_cache_hit_and_zero_diff(tmp_path):
+    from trivy_tpu.tensorize import cache as ccache
+
+    root = _saved_db(tmp_path)
+    misses0 = obs_metrics.COMPILE_CACHE_MISSES.value()
+    hits0 = obs_metrics.COMPILE_CACHE_HITS.value()
+
+    db1 = AdvisoryDB.load(root)
+    e1 = MatchEngine(db1, window=16, db_path=root)
+    assert obs_metrics.COMPILE_CACHE_MISSES.value() == misses0 + 1
+    entry = ccache.entry_path(root, ccache.db_digest(root), 16)
+    assert os.path.exists(entry)
+
+    db2 = AdvisoryDB.load(root)
+    e2 = MatchEngine(db2, window=16, db_path=root)
+    assert obs_metrics.COMPILE_CACHE_HITS.value() == hits0 + 1
+    assert e2.cdb.stats.get("compile_cache") == "hit"
+
+    queries = _many_queries(seed=23)[:600]
+    want = _hits(e1.oracle_detect(queries))
+    assert _hits(e1.detect(queries)) == want
+    assert _hits(e2.detect(queries)) == want
+    # the cached tensors are bit-identical to a fresh compile
+    np.testing.assert_array_equal(e1.cdb.row_h1, e2.cdb.row_h1)
+    np.testing.assert_array_equal(e1.cdb.row_lo, e2.cdb.row_lo)
+    np.testing.assert_array_equal(e1.cdb.row_adv, e2.cdb.row_adv)
+    assert e1.cdb.window == e2.cdb.window
+    assert e1.cdb.host_fallback == e2.cdb.host_fallback
+
+
+def test_compile_cache_params_and_digest_key(tmp_path):
+    from trivy_tpu.tensorize import cache as ccache
+
+    root = _saved_db(tmp_path)
+    db = AdvisoryDB.load(root)
+    MatchEngine(db, window=16, db_path=root)
+    hits0 = obs_metrics.COMPILE_CACHE_HITS.value()
+    # a different window is a different entry: no cross-param hit
+    MatchEngine(db, window=32, db_path=root)
+    assert obs_metrics.COMPILE_CACHE_HITS.value() == hits0
+    # changing the DB bytes changes the digest: the old entry is not
+    # served for the new DB
+    db.put_advisory("npm::ghsa", "npm-pkg-0", Advisory(
+        vulnerability_id="CVE-25-NEW",
+        vulnerable_versions=["<9.9.9"],
+    ))
+    db.save(root)
+    db3 = AdvisoryDB.load(root)
+    e3 = MatchEngine(db3, window=16, db_path=root)
+    assert obs_metrics.COMPILE_CACHE_HITS.value() == hits0
+    q = PkgQuery("npm::", "npm-pkg-0", "1.0.0", "npm")
+    assert _hits(e3.detect([q])) == _hits(e3.oracle_detect([q]))
+
+
+@pytest.mark.durability
+def test_compile_cache_corrupt_entry_quarantined(tmp_path):
+    from trivy_tpu.tensorize import cache as ccache
+
+    root = _saved_db(tmp_path)
+    db = AdvisoryDB.load(root)
+    MatchEngine(db, window=16, db_path=root)
+    entry = ccache.entry_path(root, ccache.db_digest(root), 16)
+    with open(entry, "rb") as f:
+        raw = f.read()
+    # bitflip in the tensor payload: the sha256 frame must catch it
+    mid = len(raw) // 2
+    with open(entry, "wb") as f:
+        f.write(raw[:mid] + bytes([raw[mid] ^ 0x01]) + raw[mid + 1:])
+
+    db2 = AdvisoryDB.load(root)
+    e2 = MatchEngine(db2, window=16, db_path=root)
+    names = os.listdir(os.path.dirname(entry))
+    assert any(ccache.QUARANTINE_SUFFIX in n for n in names), names
+    # the corrupt bytes were replaced by a clean recompile + re-save
+    assert os.path.exists(entry)
+    queries = _many_queries(seed=29)[:400]
+    assert _hits(e2.detect(queries)) == _hits(e2.oracle_detect(queries))
+
+    # truncation (torn tail) is caught the same way
+    with open(entry, "rb") as f:
+        raw = f.read()
+    with open(entry, "wb") as f:
+        f.write(raw[: len(raw) // 3])
+    e3 = MatchEngine(AdvisoryDB.load(root), window=16, db_path=root)
+    assert _hits(e3.detect(queries)) == _hits(e3.oracle_detect(queries))
+
+
+@pytest.mark.durability
+def test_compile_cache_torn_write_fault_self_heals(tmp_path):
+    """A torn cache WRITE (injected at the durability layer) must never
+    poison later runs: the reader rejects the entry and recompiles."""
+    root = _saved_db(tmp_path)
+    faults.install_spec("compile_cache.save:torn-write=0.5@1")
+    MatchEngine(AdvisoryDB.load(root), window=16, db_path=root)
+    faults.reset()
+    e2 = MatchEngine(AdvisoryDB.load(root), window=16, db_path=root)
+    queries = _many_queries(seed=31)[:400]
+    assert _hits(e2.detect(queries)) == _hits(e2.oracle_detect(queries))
+
+
+def test_compile_cache_disabled_by_env(tmp_path, monkeypatch):
+    from trivy_tpu.tensorize import cache as ccache
+
+    monkeypatch.setenv("TRIVY_TPU_COMPILE_CACHE", "0")
+    root = _saved_db(tmp_path)
+    MatchEngine(AdvisoryDB.load(root), window=16, db_path=root)
+    assert not os.path.exists(ccache.cache_root(root))
+
+
+def test_compile_cache_auto_window_entry(tmp_path):
+    """window=None (auto) entries round-trip the RESOLVED window and
+    hot/tall partitions."""
+    root = _saved_db(tmp_path)
+    db = AdvisoryDB.load(root)
+    e1 = MatchEngine(db, db_path=root)
+    e2 = MatchEngine(AdvisoryDB.load(root), db_path=root)
+    assert e2.cdb.stats.get("compile_cache") == "hit"
+    assert e1.cdb.window == e2.cdb.window
+    assert e1.cdb.hot_window == e2.cdb.hot_window
+    assert e1.cdb.tall_window == e2.cdb.tall_window
+    assert e1.cdb.tall_names == e2.cdb.tall_names
+    queries = _many_queries(seed=37)[:400]
+    assert _hits(e2.detect(queries)) == _hits(e2.oracle_detect(queries))

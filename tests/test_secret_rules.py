@@ -117,6 +117,18 @@ def test_detects(rule_id, content):
     )
 
 
+def test_scan_files_rejects_unknown_mode_string():
+    """use_device is tri-state (False | True | "hybrid"); any other
+    string is a config error, not a silent non-hybrid device scan."""
+    sc = SecretScanner()
+    batch = [("app/cfg.txt", b"x = 1")]
+    with pytest.raises(ValueError, match="hybrid"):
+        sc.scan_files(batch, use_device="device")
+    # the three documented modes all accept
+    for mode in (True, False, "hybrid"):
+        sc.scan_files(batch, use_device=mode)
+
+
 def test_allow_paths():
     sc = SecretScanner()
     tok = b"x = ghp_" + b"a" * 36

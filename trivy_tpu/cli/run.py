@@ -60,11 +60,16 @@ _ENGINE_CACHE: dict = {}
 
 def new_engine(args):
     """Fresh MatchEngine (no process cache — callers that hot-swap the
-    engine, like the server, must not leave the old one pinned)."""
+    engine, like the server, must not leave the old one pinned). The
+    on-disk DB path is threaded through so a warm start with an
+    unchanged DB loads the persistent compiled-tensor cache instead of
+    recompiling (tensorize.cache)."""
     from trivy_tpu.detector.engine import MatchEngine
 
     db = _load_db(args)
-    return MatchEngine(db, use_device=not getattr(args, "no_tpu", False))
+    db_path = _db_path(args)
+    return MatchEngine(db, use_device=not getattr(args, "no_tpu", False),
+                       db_path=db_path if db.buckets else None)
 
 
 def build_engine(args):

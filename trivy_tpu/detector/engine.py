@@ -66,9 +66,35 @@ class MatchEngine:
         window: int | None = None,
         mesh=None,
         use_device: bool = True,
+        db_path: str | None = None,
     ):
+        """`db_path`: the on-disk root `db` was loaded from. When given,
+        the compiled tensor set is loaded from / saved to the persistent
+        compiled-DB cache keyed by the DB digest + compile params
+        (tensorize.cache) — a warm process start with an unchanged DB
+        skips the multi-second recompile entirely."""
         self.db = db
-        self.cdb: CompiledDB = compile_db(db, window=window)
+        self.cdb: CompiledDB | None = None
+        if db_path:
+            from trivy_tpu.tensorize import cache as compile_cache
+
+            digest = compile_cache.db_digest(db_path) \
+                if compile_cache.enabled() else None
+            if digest is not None:
+                # the loaded DB's metadata document cross-checks that
+                # the entry was compiled from THIS db, not from another
+                # generation the digest may have moved to meanwhile
+                db_meta = db.meta.to_json()
+                self.cdb = compile_cache.load_compiled(
+                    db_path, db, window=window, digest=digest,
+                    db_meta=db_meta)
+                if self.cdb is None:
+                    self.cdb = compile_db(db, window=window)
+                    compile_cache.save_compiled(
+                        db_path, self.cdb, window=window, digest=digest,
+                        db_meta=db_meta)
+        if self.cdb is None:
+            self.cdb = compile_db(db, window=window)
         self.mesh = mesh
         self.use_device = use_device
         self._ddb = None
@@ -84,14 +110,31 @@ class MatchEngine:
         self._parse_cache: dict[tuple[str, str], object] = {}
         # (adv_idx, version-token) -> bool rescreen verdict memo, kept as
         # parallel sorted numpy arrays so a whole batch of flagged
-        # candidates resolves with one vectorized searchsorted instead of
-        # a per-candidate dict probe (the dict loop was 85% of warm host
-        # time on real TPU). Versions intern to dense int tokens.
+        # candidates resolves with vectorized searchsorted instead of a
+        # per-candidate dict probe (the dict loop was 85% of warm host
+        # time on real TPU). Versions intern to dense int tokens. Two
+        # tiers: a big immutable sorted main array plus a small sorted
+        # overlay that absorbs new verdicts cheaply (np.insert is O(n)
+        # in the ARRAY BEING GROWN — inserting into the multi-million-
+        # entry main per batch was a full copy per batch; the overlay
+        # merges into main only when it tops _MEMO_MERGE entries).
+        import threading
+
         import numpy as _np
 
         self._version_tokens: dict[tuple[str, str], int] = {}
-        self._memo_keys = _np.empty(0, dtype=_np.int64)
-        self._memo_vals = _np.empty(0, dtype=bool)
+        # each tier is an immutable (keys, vals) pair swapped atomically
+        # under _memo_lock — pipelined collect workers read a consistent
+        # snapshot without holding the lock
+        self._memo_main = (_np.empty(0, dtype=_np.int64),
+                           _np.empty(0, dtype=bool))
+        self._memo_over = (_np.empty(0, dtype=_np.int64),
+                           _np.empty(0, dtype=bool))
+        self._memo_lock = threading.Lock()
+        # bumped whenever the version-token space resets: a batch
+        # encoded under an older generation must not absorb its (stale
+        # token-id) verdicts into the fresh memo
+        self._memo_gen = 0
         # full per-query result memo for detect_many crawls: images share
         # most of their packages, so across a registry crawl nearly every
         # query after the first batches is a repeat. Bounded so a
@@ -100,6 +143,9 @@ class MatchEngine:
         self.crawl_cache_max = 2_000_000
         self._ddb_hot = None
         self._ddb_tall = None
+        # stage accounting of the most recent pipelined crawl (wall,
+        # per-stage busy seconds, occupancy) — bench + diagnostics
+        self.last_pipeline_stats: dict | None = None
         self._name_tokens: dict[tuple[str, str], int] | None = None
         self._adv_tok = None
         if use_device:
@@ -316,6 +362,56 @@ class MatchEngine:
         self.use_device = False
         self.device_lost = True
 
+    # pipelined-executor tuning: collect workers overlap the host
+    # compress/rescreen of earlier batches with the encode+dispatch of
+    # later ones (TRIVY_TPU_PIPELINE=0 forces the serial legacy path,
+    # TRIVY_TPU_PIPELINE_WORKERS overrides the collect-worker count)
+    @staticmethod
+    def _pipeline_workers() -> int:
+        import os
+
+        if os.environ.get("TRIVY_TPU_PIPELINE", "1") == "0":
+            return 0
+        w = os.environ.get("TRIVY_TPU_PIPELINE_WORKERS")
+        if w:
+            try:
+                return max(int(w), 0)
+            except ValueError:
+                _log.warn("bad TRIVY_TPU_PIPELINE_WORKERS; using default",
+                          value=w)
+        # coordinator lane + 2 crunch lanes measures fastest even on a
+        # 2-core host (the crunch lanes are mostly GIL-free native and
+        # numpy kernels, so they timeshare with XLA's pool instead of
+        # fighting the interpreter); past 2 their GIL-held tails stop
+        # scaling
+        return min(2, os.cpu_count() or 1)
+
+    def _check_device_stage(self, ctx: dict, queries: list[PkgQuery]):
+        """Fault hook for the in-flight device stage (site
+        ``engine.device``): ``delay`` sleeps (a slow/tunneled link),
+        ``drop`` discards the in-flight result and re-dispatches the
+        batch synchronously (a lost result is recomputed — the match
+        set stays byte-identical), ``device-lost`` raises so the crawl
+        degrades to the host oracle."""
+        import time as _time
+
+        redo = False
+        for r in faults.fire("engine.device"):
+            if r.action == "delay":
+                _time.sleep(r.param if r.param is not None else 0.05)
+            elif r.action == "drop":
+                redo = True
+            elif r.action == "device-lost":
+                raise faults.DeviceLost(
+                    "injected device loss at engine.device")
+        if redo:
+            # safe from a crunch lane: these queries were encoded once
+            # already, so every name/version is interned and the
+            # re-encode is pure dict gets + gathers (no intern-table
+            # mutation racing the coordinator)
+            ctx = self._dispatch_unique(queries)
+        return ctx
+
     def _detect_many_device(self, queries: list[PkgQuery],
                             batch_size: int, depth: int
                             ) -> list[MatchResult]:
@@ -362,22 +458,32 @@ class MatchEngine:
         # keeping kernel shapes close to the historical per-batch uniques
         ratio = max(len(queries) // max(len(uniq), 1), 1)
         chunk = max(batch_size // ratio, 1024)
-        pend: deque = deque()
+        workers = self._pipeline_workers()
+        if workers and len(fresh) > chunk and depth > 1:
+            # finer-grained chunks overlap better (less head/tail idle
+            # per lane, smaller sort/working sets); the jit bucket
+            # floor keeps kernel shapes shared across both sizes
+            self._run_pipelined(fresh, fresh_u, hits_by_u,
+                                max(chunk // 2, 1024), depth, workers)
+        else:
+            pend: deque = deque()
 
-        def flush_one():
-            us, qs, ctx = pend.popleft()
-            for u, q, h in zip(us, qs, self._collect_unique(ctx)):
-                hits_by_u[u] = h
-                cache[q.key] = h
+            def flush_one():
+                us, qs, ctx = pend.popleft()
+                ctx = self._check_device_stage(ctx, qs)
+                for u, q, h in zip(us, qs, self._collect_unique(ctx)):
+                    hits_by_u[u] = h
+                    cache[q.key] = h
 
-        for i in range(0, len(fresh), chunk):
-            qs = fresh[i: i + chunk]
-            pend.append((fresh_u[i: i + chunk], qs,
-                         self._dispatch_unique(qs)))
-            while len(pend) >= depth:
+            for i in range(0, len(fresh), chunk):
+                qs = fresh[i: i + chunk]
+                faults.check_device("engine")
+                pend.append((fresh_u[i: i + chunk], qs,
+                             self._dispatch_unique(qs)))
+                while len(pend) >= depth:
+                    flush_one()
+            while pend:
                 flush_one()
-        while pend:
-            flush_one()
         # crawl-granularity LRU: one move-to-end pass per crawl keeps
         # every key this crawl used at the recent end of the dict, so
         # _enforce_memo_bounds sheds keys from OLD crawls first (per-hit
@@ -390,6 +496,113 @@ class MatchEngine:
         self._enforce_memo_bounds()
         return [MatchResult(q, hits_by_u[u])
                 for q, u in zip(queries, idx_map)]
+
+    def _run_pipelined(self, fresh: list[PkgQuery], fresh_u: list[int],
+                       hits_by_u: list, chunk: int, depth: int,
+                       workers: int) -> None:
+        """Double-buffered pipelined executor over the fresh unique
+        queries (docs/performance.md), lanes split by GIL affinity so
+        a 2-core host genuinely overlaps:
+
+        - coordinator lane (this thread): encode + device dispatch of
+          chunk N+1 (Python dict/array work), then materialize + crawl-
+          cache write of chunk N-1 (Python list building);
+        - crunch lane(s) (`workers` threads): decode/token-screen/sort-
+          dedupe/rescreen of chunk N — native + numpy kernels that drop
+          the GIL, so they run concurrently with the coordinator;
+        - the device computes chunk N's masks in the background between
+          its dispatch and the crunch lane's first collect touch (jax
+          dispatch is async).
+
+        Stage state is thread-partitioned: the coordinator owns the
+        intern tables, the jit bucket floor (dispatch order stays
+        deterministic) and the crawl cache; crunch lanes share only the
+        lock-guarded rescreen memo. DeviceLost from any lane propagates
+        so detect_many degrades the whole crawl to the host oracle —
+        byte-identical results, just slower."""
+        import threading
+        import time as _time
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        from trivy_tpu.obs import metrics as obs_metrics
+        from trivy_tpu.obs import tracing
+
+        cache = self._crawl_cache
+        busy = {"encode": 0.0, "crunch": 0.0, "finalize": 0.0}
+        busy_lock = threading.Lock()
+        trace_ctx = tracing.capture()
+
+        def crunch_stage(ctx, qs):
+            with tracing.adopt(trace_ctx):
+                t0 = _time.perf_counter()
+                ctx = self._check_device_stage(ctx, qs)
+                with tracing.span("pipeline.crunch", queries=len(qs)):
+                    ids_c, bounds = self._crunch(ctx)
+                    # tolist here (single C calls) so the coordinator's
+                    # finalize only pays the per-query slicing
+                    crunched = (ids_c.tolist(), bounds.tolist())
+                with busy_lock:
+                    busy["crunch"] += _time.perf_counter() - t0
+                return crunched
+
+        wall0 = _time.perf_counter()
+        crunch_ex = ThreadPoolExecutor(workers,
+                                       thread_name_prefix="ttpu-crunch")
+        pend: deque = deque()  # (us, qs, crunch future)
+
+        def drain_one():
+            us, qs, cf = pend.popleft()
+            crunched = cf.result()
+            t0 = _time.perf_counter()
+            with tracing.span("pipeline.finalize", queries=len(qs)):
+                hits = self._materialize(crunched, len(qs))
+                for u, h in zip(us, hits):
+                    hits_by_u[u] = h
+                # one C-level bulk insert instead of a per-key loop
+                cache.update(zip((q.key for q in qs), hits))
+            busy["finalize"] += _time.perf_counter() - t0
+
+        try:
+            for i in range(0, len(fresh), chunk):
+                qs = fresh[i: i + chunk]
+                t0 = _time.perf_counter()
+                faults.check_device("engine")
+                with tracing.span("pipeline.encode", queries=len(qs)):
+                    ctx = self._dispatch_unique(qs)
+                busy["encode"] += _time.perf_counter() - t0
+                pend.append((fresh_u[i: i + chunk], qs,
+                             crunch_ex.submit(crunch_stage, ctx, qs)))
+                # drain finished chunks eagerly (keeps this lane busy
+                # materializing while the crunch lane works), and block
+                # once `depth` chunks are in flight
+                while pend and (len(pend) >= depth or pend[0][2].done()):
+                    drain_one()
+            while pend:
+                drain_one()
+        finally:
+            # on an error path (DeviceLost, injected kill) undrained
+            # futures must not leak "exception never retrieved" noise
+            crunch_ex.shutdown(wait=False, cancel_futures=True)
+            for _us, _qs, f in list(pend):
+                if f.done():
+                    f.exception()
+        wall = max(_time.perf_counter() - wall0, 1e-9)
+        lanes = 1 + workers
+        occupancy = min(
+            (busy["encode"] + busy["crunch"] + busy["finalize"])
+            / (lanes * wall), 1.0)
+        self.last_pipeline_stats = {
+            "wall_s": wall,
+            "encode_busy_s": busy["encode"],
+            "crunch_busy_s": busy["crunch"],
+            "finalize_busy_s": busy["finalize"],
+            "chunks": -(-len(fresh) // chunk),
+            "chunk": chunk,
+            "workers": workers,
+            "occupancy": occupancy,
+        }
+        obs_metrics.PIPELINE_OCCUPANCY.set(occupancy)
 
     def _enforce_memo_bounds(self) -> None:
         """RSS bound for long-lived servers over every diversity-keyed
@@ -415,17 +628,70 @@ class MatchEngine:
             shed_oldest(self._crawl_cache)
         if len(self._version_tokens) > self.crawl_cache_max:
             # memo keys embed version tokens: the two reset together.
-            # .clear() keeps the dict object shared with cdb.encode.
-            self._version_tokens.clear()
-            self._memo_keys = np.empty(0, dtype=np.int64)
-            self._memo_vals = np.empty(0, dtype=bool)
-        # the sibling memos grow with the same scan diversity (parsed
-        # versions, encoded keys, name hashes); _checkers/_name_tokens are
-        # bounded by the fixed DB size and need no cap
-        for memo in (self._parse_cache, self.cdb._key_cache,
-                     self.cdb._hash_cache):
-            if len(memo) > self.crawl_cache_max:
-                shed_oldest(memo)
+            # reset_intern keeps the dict object shared with cdb.encode
+            # while dropping the parallel rank/flags columns so fresh
+            # ids never alias stale column rows. Both locks are held —
+            # a concurrent scan on the shared server engine may be mid-
+            # encode or mid-absorb — and the memo generation is bumped
+            # so in-flight batches encoded under the old token space
+            # cannot absorb stale-token verdicts afterwards.
+            with self._memo_lock, self.cdb._intern_lock:
+                self.cdb.reset_intern()
+                self._version_tokens.clear()
+                empty = (np.empty(0, dtype=np.int64),
+                         np.empty(0, dtype=bool))
+                self._memo_main = empty
+                self._memo_over = empty
+                self._memo_gen += 1
+        elif len(self.cdb._names) > self.crawl_cache_max:
+            # name interning grows with scan diversity too (misses
+            # intern as well); names re-fill on demand. Version ids
+            # must NOT reset here — the rescreen memo keys embed them
+            # and only the branch above resets both together.
+            with self.cdb._intern_lock:
+                self.cdb.reset_name_intern()
+        # the sibling memos grow with the same scan diversity;
+        # _checkers/_name_tokens are bounded by the fixed DB size and
+        # need no cap
+        if len(self._parse_cache) > self.crawl_cache_max:
+            shed_oldest(self._parse_cache)
+
+    # overlay size at which it folds into the main memo (one O(main)
+    # np.insert per merge instead of one per batch)
+    _MEMO_MERGE = 8192
+
+    def _memo_absorb(self, new_keys, new_vals) -> None:
+        """Fold freshly computed rescreen verdicts into the overlay
+        tier; fold the overlay into main when it tops _MEMO_MERGE.
+        Caller holds _memo_lock. Keys are deduped against both tiers
+        (a concurrent collect worker may have absorbed the same pair
+        between our lookup and this lock — verdicts are deterministic,
+        so dropping the duplicate is always safe)."""
+        import numpy as np
+
+        def known(mk, keys):
+            if not len(mk) or not len(keys):
+                return np.zeros(len(keys), dtype=bool)
+            pos = np.minimum(np.searchsorted(mk, keys), len(mk) - 1)
+            return mk[pos] == keys
+
+        fresh = ~(known(self._memo_main[0], new_keys)
+                  | known(self._memo_over[0], new_keys))
+        new_keys, new_vals = new_keys[fresh], new_vals[fresh]
+        mk2, mv2 = self._memo_over
+        if len(new_keys):
+            ins = np.searchsorted(mk2, new_keys)
+            mk2 = np.insert(mk2, ins, new_keys)
+            mv2 = np.insert(mv2, ins, new_vals)
+        if len(mk2) >= self._MEMO_MERGE:
+            mk, mv = self._memo_main
+            ins = np.searchsorted(mk, mk2)
+            self._memo_main = (np.insert(mk, ins, mk2),
+                               np.insert(mv, ins, mv2))
+            self._memo_over = (np.empty(0, dtype=np.int64),
+                               np.empty(0, dtype=bool))
+        else:
+            self._memo_over = (mk2, mv2)
 
     def _rescreen_one(self, adv_idx: int, q: PkgQuery) -> bool:
         """Exact host verdict for one flagged (advisory, query) candidate."""
@@ -445,22 +711,34 @@ class MatchEngine:
         from trivy_tpu.ops import match as m
 
         cdb = self.cdb
-        batch = cdb.encode_packages(
-            [(q.space, q.name, q.version, q.scheme_name) for q in queries]
-        )
+        # q.key is the (space, name, version, scheme_name) tuple built
+        # once at PkgQuery construction — no per-dispatch tuple rebuild
+        batch = cdb.encode_packages([q.key for q in queries])
         ctx = {"queries": queries, "batch": batch,
+               "memo_gen": self._memo_gen,
                "main": None, "sharded": None, "hot": None, "tall": None}
         if self._sdb is not None:
             ctx["sharded"] = m.sharded_dispatch(self._sdb, batch)
         elif self._ddb is not None:
             ctx["main"] = m.match_dispatch(self._ddb, batch)
-        tall_names = cdb.tall_names
-        hot_idx = []
-        tall_idx = []
-        for j, q in enumerate(queries):
-            key = (q.space, q.name)
-            if key in cdb.host_fallback:
-                (tall_idx if key in tall_names else hot_idx).append(j)
+        # hot/tall tier routing comes gathered from the name intern
+        # table (batch.route) — no per-query dict probe; the dict walk
+        # below only serves batches encoded outside the engine
+        import numpy as np
+
+        if batch.route is not None:
+            hot_idx = np.nonzero(batch.route == 1)[0]
+            tall_idx = np.nonzero(batch.route == 2)[0]
+        else:
+            tall_names = cdb.tall_names
+            hot_l: list[int] = []
+            tall_l: list[int] = []
+            for j, q in enumerate(queries):
+                key = (q.space, q.name)
+                if key in cdb.host_fallback:
+                    (tall_l if key in tall_names else hot_l).append(j)
+            hot_idx = np.asarray(hot_l, dtype=np.int64)
+            tall_idx = np.asarray(tall_l, dtype=np.int64)
 
         def sub_dispatch(idx, ddb):
             sub = m.PackageBatch(
@@ -470,25 +748,35 @@ class MatchEngine:
             )
             return (idx, m.match_dispatch(ddb, sub), sub)
 
-        if hot_idx and self._ddb_hot is not None:
+        if len(hot_idx) and self._ddb_hot is not None:
             ctx["hot"] = sub_dispatch(hot_idx, self._ddb_hot)
-        if tall_idx and self._ddb_tall is not None:
+        if len(tall_idx) and self._ddb_tall is not None:
             ctx["tall"] = sub_dispatch(tall_idx, self._ddb_tall)
         return ctx
 
     def _detect_unique(self, queries: list[PkgQuery]) -> list[list[int]]:
-        return self._collect_unique(self._dispatch_unique(queries))
+        ctx = self._check_device_stage(self._dispatch_unique(queries),
+                                       queries)
+        return self._collect_unique(ctx)
 
     def _collect_unique(self, ctx: dict) -> list[list[int]]:
-        """-> sorted advisory-index list per (unique) query.
+        """-> sorted advisory-index list per (unique) query."""
+        return self._materialize(self._crunch(ctx), len(ctx["queries"]))
+
+    def _crunch(self, ctx: dict):
+        """Array-level result collection: -> (ids_c, bounds) CSR pair
+        (confirmed advisory ids, per-query slice bounds).
 
         The kernel returns bit-packed hit masks; the host maps set bits to
         row indices with its own searchsorted over the resident numpy
         copies, screens hash collisions with one vectorized token compare,
-        and confirms exact hits with no per-hit Python at all (np.split on
-        row boundaries). Only flagged rescreen candidates — needs-host
-        versions and npm pre-release queries — reach the per-advisory
-        Python comparators, behind an (advisory, version) verdict memo."""
+        and confirms exact hits with no per-hit Python at all. Only
+        flagged rescreen candidates — needs-host versions and npm
+        pre-release queries — reach the per-advisory Python comparators,
+        behind an (advisory, version) verdict memo. Nearly all of this
+        runs in native code or numpy kernels that drop the GIL, which is
+        what lets the pipelined executor overlap it with the encode and
+        materialize lanes on a separate thread."""
         import numpy as np
 
         from trivy_tpu.ops import match as m
@@ -499,22 +787,25 @@ class MatchEngine:
         flag_mask = m.FLAG_NEEDS_HOST | m.FLAG_RESCREEN
 
         # query tokens (interned during encode_packages; the fallback
-        # loop only runs for batches encoded without token dicts)
+        # loop only runs for batches encoded without token dicts). New
+        # versions intern through the cdb tables so the shared
+        # version-token dict never desyncs from its rank/flags columns.
         self._ensure_tokens()
         q_tok, q_vt = batch.ntok, batch.vtok
         if q_tok is None or q_vt is None:
             ntok = self._name_tokens
-            vtok = self._version_tokens
             q_tok = np.empty(len(queries), dtype=np.int64)
             q_vt = np.empty(len(queries), dtype=np.int64)
-            for j, q in enumerate(queries):
-                q_tok[j] = ntok.get((q.space, q.name), -2)
-                vk = (q.scheme_name, q.version)
-                t = vtok.get(vk)
-                if t is None:
-                    t = len(vtok)
-                    vtok[vk] = t
-                q_vt[j] = t
+            with cdb._intern_lock:
+                cdb._ensure_intern()
+                vtok = cdb._vers
+                for j, q in enumerate(queries):
+                    q_tok[j] = ntok.get((q.space, q.name), -2)
+                    vk = (q.scheme_name, q.version)
+                    t = vtok.get(vk)
+                    if t is None:
+                        t = cdb._intern_version(vk)
+                    q_vt[j] = t
 
         from trivy_tpu.native import collect as ncollect
 
@@ -591,7 +882,9 @@ class MatchEngine:
 
         parts = [p for p in parts if len(p[0])]
         if not parts:
-            return [[] for _ in queries]
+            # empty CSR: every query gets an empty hit list
+            return (np.empty(0, dtype=np.int64),
+                    np.zeros(len(queries) + 1, dtype=np.int64))
         rows = np.concatenate([p[0] for p in parts])
         ids = np.concatenate([p[1] for p in parts])
         resc = np.concatenate([p[2] for p in parts])
@@ -620,36 +913,50 @@ class MatchEngine:
         flagged = np.nonzero(resc)[0]
         if len(flagged):
             fkeys = (ids[flagged] << np.int64(32)) | q_vt[rows[flagged]]
-            ukeys, inv = np.unique(fkeys, return_inverse=True)
-            mk = self._memo_keys
-            uverd = np.zeros(len(ukeys), dtype=bool)
-            if len(mk):
-                pos = np.searchsorted(mk, ukeys)
+            fverd = np.zeros(len(fkeys), dtype=bool)
+            hit = np.zeros(len(fkeys), dtype=bool)
+            # two-tier sorted lookup straight over the raw candidate
+            # keys: big main memo + small overlay, each probed with ONE
+            # vectorized searchsorted (each tier is an atomically-
+            # swapped immutable pair, so the lockless read sees a
+            # consistent keys/vals snapshot). The warm path never
+            # np.uniques — deduplication only pays off for the misses.
+            for mk, mv in (self._memo_main, self._memo_over):
+                if not len(mk):
+                    continue
+                pos = np.searchsorted(mk, fkeys)
                 pos_c = np.minimum(pos, len(mk) - 1)
-                hit = mk[pos_c] == ukeys
-                uverd[hit] = self._memo_vals[pos_c[hit]]
-            else:
-                hit = np.zeros(len(ukeys), dtype=bool)
-            miss = np.nonzero(~hit)[0]
-            if len(miss):
-                # representative flagged candidate per missing pair
-                # (reversed assignment keeps the first occurrence)
-                first = np.empty(len(ukeys), dtype=np.int64)
-                first[inv[::-1]] = flagged[::-1]
-                for u in miss.tolist():
-                    k = int(first[u])
+                h = mk[pos_c] == fkeys
+                fverd[h] = mv[pos_c[h]]
+                hit |= h
+            miss_f = np.nonzero(~hit)[0]
+            if len(miss_f):
+                ukeys, first_rel = np.unique(fkeys[miss_f],
+                                             return_index=True)
+                first = flagged[miss_f[first_rel]]
+                uverd = np.empty(len(ukeys), dtype=bool)
+                # exact verdicts compute OUTSIDE the memo lock (they
+                # are deterministic — a concurrent lane computing the
+                # same pair just produces a duplicate the absorb drops)
+                # so cold batches don't serialize every crunch lane on
+                # the Python comparators
+                for u, k in enumerate(first.tolist()):
                     uverd[u] = self._rescreen_one(
                         int(ids[k]), queries[rows[k]])
-                # both sides are sorted (ukeys from np.unique, memo kept
-                # sorted): one searchsorted + insert is a linear merge
-                new_keys = ukeys[miss]
-                ins = np.searchsorted(mk, new_keys)
-                self._memo_keys = np.insert(mk, ins, new_keys)
-                self._memo_vals = np.insert(self._memo_vals, ins,
-                                            uverd[miss])
-            conf[flagged] |= uverd[inv]
+                with self._memo_lock:
+                    if ctx.get("memo_gen") == self._memo_gen:
+                        self._memo_absorb(ukeys, uverd)
+                    # else: the token space reset since this batch was
+                    # encoded — its keys embed stale version ids and
+                    # must not enter the fresh memo (the local verdicts
+                    # above are still exact and used for this batch)
+                # scatter the fresh verdicts back over the missing keys
+                fverd[miss_f] = uverd[
+                    np.searchsorted(ukeys, fkeys[miss_f])]
+            conf[flagged] |= fverd
 
-        self.rescreen_stats["candidates"] += len(rows)
+        with self._memo_lock:  # collect workers run concurrently
+            self.rescreen_stats["candidates"] += len(rows)
         grouped = None
         if native is not None:
             grouped = native.group_confirmed(rows, ids, conf, len(queries))
@@ -658,11 +965,23 @@ class MatchEngine:
         else:
             rows_c, ids_c = rows[conf], ids[conf]
             bounds = np.searchsorted(rows_c, np.arange(len(queries) + 1))
-        self.rescreen_stats["confirmed"] += len(ids_c)
-        # ids are sorted ascending within each row: slicing on row
-        # boundaries yields the final per-query sorted hit lists (direct
-        # slices — np.split's per-piece wrapper overhead is measurable at
-        # 15k+ pieces per batch)
-        bl = bounds.tolist()
-        idlist = ids_c.tolist()
-        return [idlist[bl[j]: bl[j + 1]] for j in range(len(queries))]
+        with self._memo_lock:
+            self.rescreen_stats["confirmed"] += len(ids_c)
+        return ids_c, bounds
+
+    @staticmethod
+    def _materialize(crunched, n_queries: int) -> list[list[int]]:
+        """(ids_c, bounds) -> per-query sorted hit lists. The only
+        Python-object-heavy step of collection, split out so the
+        pipelined executor can run it on the coordinator lane while the
+        crunch lanes work on the next chunk. ids are sorted ascending
+        within each row: slicing on row boundaries yields the final
+        per-query sorted hit lists (direct slices — np.split's
+        per-piece wrapper overhead is measurable at 15k+ pieces per
+        batch). Accepts the arrays pre-converted to Python lists (the
+        pipelined crunch lane does the tolist — a single C call — so
+        the coordinator only pays the slicing)."""
+        ids_c, bounds = crunched
+        bl = bounds if isinstance(bounds, list) else bounds.tolist()
+        idlist = ids_c if isinstance(ids_c, list) else ids_c.tolist()
+        return [idlist[bl[j]: bl[j + 1]] for j in range(n_queries)]

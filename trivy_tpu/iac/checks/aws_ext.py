@@ -53,20 +53,36 @@ def adapt_terraform_aws_ext(blocks: list[Block],
                             scan_blocks: list[Block] | None = None) -> list:
     from trivy_tpu.iac.checks.cloud import CloudResource
 
+    import os as _os
+
     res = [b for b in blocks if b.type == "resource" and
            len(b.labels) >= 2]
     # account-level default EBS encryption overrides every instance /
     # launch-config block device to encrypted (reference adapters/
     # terraform/aws/ec2/{adapt,autoscaling}.go: `enabled` NotEqual(false)
-    # — so unset or unresolved counts as enabled). The reference scopes
-    # the lookup across ALL modules of the scan
-    # (modules.GetResourcesByType), so the flag is computed over
-    # scan_blocks when the caller has wider context than this file
+    # — so unset or unresolved counts as enabled). Scoping differs by
+    # resource kind, mirroring the reference exactly:
+    # - ec2_instance_ext: the lookup spans ALL modules of the scan
+    #   (adapt.go modules.GetResourcesByType), so the flag is computed
+    #   over scan_blocks when the caller has wider context;
+    # - launch_config: autoscaling.go runs module.GetResourcesByType
+    #   inside its per-module loop — a default declared in the root
+    #   module must NOT suppress launch-config findings in a child
+    #   module. A module INSTANCE is identified by its module_id path
+    #   (stamped by the terraform evaluator; two instantiations of the
+    #   same source dir stay distinct) plus its source directory.
+    def _module_key(b):
+        return (getattr(b, "module_id", ""),
+                _os.path.dirname(b.src_path))
+
+    wide = scan_blocks if scan_blocks is not None else blocks
+    ebs_defaults = [b for b in wide if b.type == "resource"
+                    and b.labels[:1] == ["aws_ebs_encryption_by_default"]]
     ebs_default_enc = any(
-        _tri(b, "enabled", True) is not False
-        for b in (scan_blocks if scan_blocks is not None else blocks)
-        if b.type == "resource"
-        and b.labels[:1] == ["aws_ebs_encryption_by_default"])
+        _tri(b, "enabled", True) is not False for b in ebs_defaults)
+    ebs_default_dirs = {
+        _module_key(b) for b in ebs_defaults
+        if _tri(b, "enabled", True) is not False}
     out = []
     for b in res:
         t, name = b.labels[0], b.labels[1]
@@ -74,8 +90,10 @@ def adapt_terraform_aws_ext(blocks: list[Block],
         if fn is None:
             continue
         rtype, attrs = fn(b)
-        if ebs_default_enc and rtype in ("ec2_instance_ext",
-                                         "launch_config"):
+        if rtype == "ec2_instance_ext" and ebs_default_enc:
+            attrs["unencrypted_block_device"] = False
+        elif rtype == "launch_config" and \
+                _module_key(b) in ebs_default_dirs:
             attrs["unencrypted_block_device"] = False
         out.append(CloudResource(
             type=rtype, name=f"{t}.{name}", attrs=attrs,
@@ -929,7 +947,15 @@ def _cfn_ec2_instance(p, resources=None):
     options, so IMDS stays at the provider default (optional tokens —
     the check fires), and the first BlockDeviceMappings entry is the
     root device with a missing list materializing an unencrypted
-    root."""
+    root.
+
+    After a template resolve the reference OVERLAYS the instance's own
+    BlockDeviceMappings on top of the replacement (the first entry
+    overrides the root device) — and its adaptLaunchTemplate reads
+    BlockDeviceMappings from top-level Properties, not
+    LaunchTemplateData, so a template effectively contributes only
+    MetadataOptions: an instance with no mappings of its own still
+    materializes an unencrypted root."""
     tokens = None  # None = not configured -> IMDS check fires
     lt = p.get("LaunchTemplate")
     data = None
@@ -937,15 +963,16 @@ def _cfn_ec2_instance(p, resources=None):
         data = _cfn_find_launch_template(lt, resources)
     if data is not None:
         # the reference replaces the instance wholesale with the
-        # template's adaptation (instance = launchTemplate.Instance)
+        # template's adaptation (instance = launchTemplate.Instance)...
         opts = data.get("MetadataOptions")
         if isinstance(opts, dict):
             tokens = _cfn_tri(opts, "HttpTokens", "optional")
         else:
             tokens = "optional"
-        encs = _cfn_device_encs(data.get("BlockDeviceMappings"))
-    else:
-        encs = _cfn_device_encs(p.get("BlockDeviceMappings"))
+    # ...then always applies the instance's own BlockDeviceMappings
+    # (instance.go overlay loop) — the template side carries none (see
+    # docstring), so the instance's list is the only block-device source
+    encs = _cfn_device_encs(p.get("BlockDeviceMappings"))
     if not encs:
         encs.append(False)  # materialized unencrypted root
     unenc = (True if any(e is False for e in encs)

@@ -42,6 +42,11 @@ class Block:
     start_line: int = 0
     end_line: int = 0
     src_path: str = ""             # set by the terraform evaluator
+    # module-instance path ("a.b" = module "b" inside module "a"; "" =
+    # root), set by the terraform evaluator — distinguishes two
+    # instantiations of the SAME source directory for checks whose
+    # reference scopes per module instance
+    module_id: str = ""
 
     def get(self, name: str, default=None):
         a = self.attrs.get(name)

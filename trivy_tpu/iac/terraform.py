@@ -814,8 +814,15 @@ def evaluate_module(files: dict[str, bytes], dirname: str,
                 changed = True
         if not changed:
             break
-    child_blocks = [blk for _k, c in child_cache.values()
-                    for blk in c.blocks]
+    child_blocks = []
+    for name, (_k, c) in child_cache.items():
+        for blk in c.blocks:
+            # stamp the module-instance path (fresh per evaluation —
+            # c.blocks are this child evaluation's own clones), so two
+            # instantiations of one source dir stay distinguishable
+            blk.module_id = f"{name}.{blk.module_id}" \
+                if blk.module_id else name
+            child_blocks.append(blk)
 
     # outputs
     outputs: dict = {}

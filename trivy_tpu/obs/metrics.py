@@ -368,3 +368,16 @@ BREAKER_TRANSITIONS = REGISTRY.counter(
 CACHE_CORRUPT = REGISTRY.counter(
     "trivy_tpu_cache_corrupt_total",
     "Corrupt cache entries evicted (self-healing reads)")
+COMPILE_CACHE_HITS = REGISTRY.counter(
+    "trivy_tpu_compile_cache_hits_total",
+    "Compiled advisory-DB tensor sets loaded from the persistent cache "
+    "(warm start skipped a full recompile)")
+COMPILE_CACHE_MISSES = REGISTRY.counter(
+    "trivy_tpu_compile_cache_misses_total",
+    "Compiled-DB cache lookups that fell back to a full recompile "
+    "(absent, parameter mismatch, or corrupt-quarantined entry)")
+PIPELINE_OCCUPANCY = REGISTRY.gauge(
+    "trivy_tpu_pipeline_occupancy",
+    "Fraction of the last pipelined crawl's wall-clock x stages the "
+    "executor's stages were busy (1.0 = encode/device/rescreen fully "
+    "overlapped; ~1/3 = serial)")

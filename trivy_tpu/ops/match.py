@@ -81,7 +81,12 @@ def trim_and_prefetch(arr, b: int, axis: int = 0):
         arr = arr[idx]
     try:
         arr.copy_to_host_async()
-    except AttributeError:
+    except Exception:
+        # the async-copy hint is a pure optimization: sharded arrays on
+        # some jax versions raise RuntimeError/NotImplementedError (not
+        # just AttributeError) for non-fully-replicated layouts, and a
+        # failed hint must degrade to the collect-time copy, never kill
+        # the dispatch
         pass
     return arr
 

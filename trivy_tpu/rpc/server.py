@@ -409,7 +409,12 @@ class ScanService:
             db = AdvisoryDB.load(self.db_path)
             problem = self._validate_db(db)
             if problem is None:
-                new_engine = MatchEngine(db, use_device=self.engine.use_device)
+                # db_path routes the reload through the persistent
+                # compiled-DB cache: a generation already compiled by a
+                # sibling process (or a rollback to last-good) swaps in
+                # without paying the full tensorize cost again
+                new_engine = MatchEngine(db, use_device=self.engine.use_device,
+                                         db_path=self.db_path)
         except Exception as exc:
             problem = f"unloadable: {exc}"
         if problem is not None:

@@ -16,6 +16,7 @@ from __future__ import annotations
 import os
 import re
 from dataclasses import dataclass, field
+from typing import Literal
 
 import numpy as np
 
@@ -228,11 +229,29 @@ class SecretScanner:
             keywords=len(kw_ids))
 
     def scan_files(self, batch: list[tuple[str, bytes]],
-                   use_device: bool = True) -> list[Secret]:
+                   use_device: bool | Literal["hybrid"] = True
+                   ) -> list[Secret]:
         """Batched scan: device NFA + literal-window passes over all
         files at once, host regex only inside candidate windows; rules
         that can't window-verify keep the whole-file host path
-        (the TPU replacement for the reference's per-file loop)."""
+        (the TPU replacement for the reference's per-file loop).
+
+        `use_device` is tri-state:
+
+        - ``False``    pure-host path (native AC + whole-file regex);
+        - ``True``     device tiers (NFA + literal windows), host
+                       regex only inside candidate windows;
+        - ``"hybrid"`` byte-split corpus: a device share dispatched
+                       async up front, the host AC path scanning the
+                       rest concurrently — the production default
+                       (degrades to host-only without an accelerator).
+
+        Any other string is a config error and raises ValueError
+        instead of silently taking the non-hybrid device path."""
+        if isinstance(use_device, str) and use_device != "hybrid":
+            raise ValueError(
+                f"use_device={use_device!r}: expected True, False or "
+                "'hybrid'")
         eligible = [
             (i, path, content) for i, (path, content) in enumerate(batch)
             if not self.skip_file(path) and not self.path_allowed(path)

@@ -24,6 +24,7 @@ Names with more than `window` rows are evicted to a host-side fallback map
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -112,6 +113,32 @@ class PackageBatch:
     # per-query Python pass during result collection)
     ntok: np.ndarray | None = None  # int64[B]
     vtok: np.ndarray | None = None  # int64[B]
+    # hot/tall tier routing per query (0=main, 1=hot, 2=tall), gathered
+    # from the name intern table so dispatch never probes dicts per item
+    route: np.ndarray | None = None  # int8[B]
+
+
+class _Grow:
+    """Append-only numpy array with doubling growth: dense-id intern
+    tables gather per batch with ONE fancy index instead of a dict
+    probe per query."""
+
+    __slots__ = ("a", "n")
+
+    def __init__(self, dtype, cap: int = 256):
+        self.a = np.empty(cap, dtype=dtype)
+        self.n = 0
+
+    def append(self, v) -> None:
+        if self.n == len(self.a):
+            grown = np.empty(len(self.a) * 2, dtype=self.a.dtype)
+            grown[: self.n] = self.a
+            self.a = grown
+        self.a[self.n] = v
+        self.n += 1
+
+    def view(self) -> np.ndarray:
+        return self.a[: self.n]
 
 
 @dataclass
@@ -161,12 +188,32 @@ class CompiledDB:
     # host_fallback's keys)
     tall_names: set = field(default_factory=set)
     stats: dict = field(default_factory=dict)
-    # encode memo caches (same packages recur across a registry crawl)
-    _hash_cache: dict = field(default_factory=dict, repr=False)
-    _key_cache: dict = field(default_factory=dict, repr=False)
-    # token dicts injected by the match engine (see PackageBatch.ntok)
+    # token dicts injected by the match engine (see PackageBatch.ntok).
+    # version_tokens doubles as the version intern map: its values ARE
+    # the dense ids indexing _ver_rank/_ver_flags below. Inject BEFORE
+    # the first encode (MatchEngine.__init__ does) — later injection
+    # would leave already-interned entries without engine tokens.
     name_tokens: dict | None = field(default=None, repr=False)
     version_tokens: dict | None = field(default=None, repr=False)
+    # intern tables (lazy): (space, name) -> dense name id with parallel
+    # h1/h2/token/route columns; (scheme, version) -> dense version id
+    # with parallel rank/flags columns. Batch encode then collapses to
+    # one dict get per DISTINCT component plus numpy gathers — the
+    # per-query hashing/keying/ranking of the old encode loop runs only
+    # for first-seen names/versions.
+    _names: dict = field(default_factory=dict, repr=False)
+    _name_h1: "_Grow | None" = field(default=None, repr=False)
+    _name_h2: "_Grow | None" = field(default=None, repr=False)
+    _name_tok: "_Grow | None" = field(default=None, repr=False)
+    _name_route: "_Grow | None" = field(default=None, repr=False)
+    _vers: dict = field(default_factory=dict, repr=False)
+    _ver_rank: "_Grow | None" = field(default=None, repr=False)
+    _ver_flags: "_Grow | None" = field(default=None, repr=False)
+    # guards intern-table mutation: the RPC server runs CONCURRENT
+    # scans on one shared engine (read-locked, not exclusive), so two
+    # first-seen components must not race the dense-id assignment
+    _intern_lock: object = field(default_factory=threading.Lock,
+                                 repr=False)
 
     @property
     def n_rows(self) -> int:
@@ -177,61 +224,135 @@ class CompiledDB:
         table (see module docstring)."""
         return _rank_of(self.boundaries.get(scheme_name), key)
 
-    def encode_packages(self, queries: list) -> PackageBatch:
-        """queries: [(space, name, version, scheme_name)] -> PackageBatch.
+    def reset_name_intern(self) -> None:
+        """Drop the (space, name) intern table (memo-bound shedding;
+        names re-intern on demand with identical results). Version ids
+        are untouched — they are embedded in the engine's rescreen memo
+        keys and may only reset together with that memo."""
+        self._names = {}
+        self._name_h1 = self._name_h2 = None
+        self._name_tok = self._name_route = None
 
-        Hot path: hashes and version keys are memoized (the same packages
-        recur across artifacts in a crawl) and ranks are computed with ONE
-        vectorized searchsorted per scheme, not per query."""
-        n = len(queries)
-        h1 = np.zeros(n, dtype=np.uint32)
-        h2 = np.zeros(n, dtype=np.uint32)
-        rank = np.zeros(n, dtype=np.int32)
-        flags = np.zeros(n, dtype=np.int32)
-        ntoks = self.name_tokens
-        vtoks = self.version_tokens
-        ntok = np.empty(n, dtype=np.int64) if ntoks is not None else None
-        vtok = np.empty(n, dtype=np.int64) if vtoks is not None else None
+    def reset_intern(self) -> None:
+        """Drop BOTH intern tables. version_tokens may be the engine's
+        `_version_tokens` dict — the caller that clears it (together
+        with its rescreen memo, whose keys embed the version ids) must
+        call this so the parallel rank/flags columns reset with it."""
+        self.reset_name_intern()
+        self._vers = {} if self.version_tokens is None \
+            else self.version_tokens
+        self._ver_rank = self._ver_flags = None
 
-        # per-scheme gather for batched ranking
-        by_scheme: dict[str, tuple[list[int], list[bytes]]] = {}
-        for i, (space, name, version, scheme_name) in enumerate(queries):
-            hk = self._hash_cache.get((space, name))
-            if hk is None:
-                hk = join_key(space, name)
-                self._hash_cache[(space, name)] = hk
-            h1[i], h2[i] = hk
-            if ntok is not None:
-                ntok[i] = ntoks.get((space, name), -2)
-            ck = (scheme_name, version)
-            if vtok is not None:
-                t = vtoks.get(ck)
-                if t is None:
-                    t = len(vtoks)
-                    vtoks[ck] = t
-                vtok[i] = t
-            ke = self._key_cache.get(ck)
-            if ke is None:
-                ke = versioning.get_scheme(scheme_name).key(version)
-                self._key_cache[ck] = ke
-            key, exact = ke
-            if not exact:
-                flags[i] |= FLAG_NEEDS_HOST
-            elif scheme_name == "npm" and "-" in version:
-                # npm pre-release rule: interval hits are a superset for
-                # pre-release versions -> exact host rescreen
-                flags[i] |= FLAG_RESCREEN
-            idxs, keys = by_scheme.setdefault(scheme_name, ([], []))
-            idxs.append(i)
+    def _ensure_intern(self) -> None:
+        if self._name_h1 is None:
+            self._name_h1 = _Grow(np.uint32)
+            self._name_h2 = _Grow(np.uint32)
+            self._name_tok = _Grow(np.int64)
+            self._name_route = _Grow(np.int8)
+            self._names = {}
+        if self._ver_rank is None:
+            self._ver_rank = _Grow(np.int32)
+            self._ver_flags = _Grow(np.int32)
+            # the engine's version-token dict IS the intern map when
+            # injected, so collect-side memo keys and intern ids agree
+            self._vers = self.version_tokens \
+                if self.version_tokens is not None else {}
+            self._vers.clear()
+
+    def _intern_name(self, key: tuple[str, str]) -> int:
+        j = len(self._names)
+        self._names[key] = j
+        h1, h2 = join_key(*key)
+        self._name_h1.append(h1)
+        self._name_h2.append(h2)
+        self._name_tok.append(
+            self.name_tokens.get(key, -2)
+            if self.name_tokens is not None else -2)
+        route = 0
+        if key in self.host_fallback:
+            route = 2 if key in self.tall_names else 1
+        self._name_route.append(route)
+        return j
+
+    def _intern_version(self, ck: tuple[str, str],
+                        staged: dict | None = None) -> int:
+        scheme_name, version = ck
+        t = len(self._vers)
+        self._vers[ck] = t
+        key, exact = versioning.get_scheme(scheme_name).key(version)
+        fl = 0
+        if not exact:
+            fl = FLAG_NEEDS_HOST
+        elif scheme_name == "npm" and "-" in version:
+            # npm pre-release rule: interval hits are a superset for
+            # pre-release versions -> exact host rescreen
+            fl = FLAG_RESCREEN
+        self._ver_flags.append(fl)
+        if staged is None:
+            self._ver_rank.append(
+                _rank_of(self.boundaries.get(scheme_name), key))
+        else:
+            # cold-batch path: rank placeholder now, ONE vectorized
+            # searchsorted per scheme once the whole batch is interned
+            self._ver_rank.append(0)
+            ids, keys = staged.setdefault(scheme_name, ([], []))
+            ids.append(t)
             keys.append(key)
+        return t
 
-        for scheme_name, (idxs, keys) in by_scheme.items():
+    def _flush_staged_ranks(self, staged: dict) -> None:
+        ranks = self._ver_rank.view()
+        for scheme_name, (ids, keys) in staged.items():
             bounds = self.boundaries.get(scheme_name)
             if bounds is None or len(bounds) == 0:
                 continue
-            rank[np.array(idxs)] = _ranks_of(bounds, keys)
-        return PackageBatch(h1, h2, rank, flags, queries,
-                            ntok=ntok, vtok=vtok)
+            ranks[np.asarray(ids, dtype=np.int64)] = _ranks_of(bounds, keys)
+
+    def encode_packages(self, queries: list) -> PackageBatch:
+        """queries: [(space, name, version, scheme_name)] -> PackageBatch.
+
+        Hot path: names and versions intern to dense ids with parallel
+        numpy columns (hash, engine token, tier route; scaled rank,
+        flags), so a batch encodes as one dict get per component plus
+        pure array gathers — hashing, version keying and the rank
+        searchsorted run only for first-seen names/versions, not per
+        query per batch."""
+        n = len(queries)
+        nid = np.empty(n, dtype=np.int64)
+        vid = np.empty(n, dtype=np.int64)
+        staged: dict = {}
+        # the whole intern pass runs under the lock: concurrent server
+        # scans on one shared engine must not race dense-id assignment,
+        # and a staged (not-yet-ranked) version must not be observable
+        # by another encode before _flush_staged_ranks finalizes it
+        with self._intern_lock:
+            self._ensure_intern()
+            names = self._names
+            vers = self._vers
+            for i, q in enumerate(queries):
+                space, name, version, scheme_name = q
+                j = names.get((space, name))
+                if j is None:
+                    j = self._intern_name((space, name))
+                nid[i] = j
+                t = vers.get((scheme_name, version))
+                if t is None:
+                    t = self._intern_version((scheme_name, version),
+                                             staged)
+                vid[i] = t
+            if staged:
+                self._flush_staged_ranks(staged)
+        return PackageBatch(
+            h1=self._name_h1.view()[nid],
+            h2=self._name_h2.view()[nid],
+            rank=self._ver_rank.view()[vid],
+            flags=self._ver_flags.view()[vid],
+            queries=queries,
+            ntok=(self._name_tok.view()[nid]
+                  if self.name_tokens is not None else None),
+            vtok=(vid if self.version_tokens is not None else None),
+            route=self._name_route.view()[nid],
+        )
 
 
 def _advisory_intervals(
@@ -328,6 +449,26 @@ MAX_AUTO_WINDOW = 512
 # hot-tier split point: name groups above this go to the "tall"
 # partition so mid-tier queries don't pay giant-group windows
 HOT_MID_WINDOW = 256
+
+
+def flat_advisories(db: AdvisoryDB) -> list[tuple[str, str, Advisory]]:
+    """The flat (bucket, name, Advisory) list every CompiledDB indexes
+    into, in the DEFINED iteration order (bucket insertion order, names
+    in insertion order, non-matchable buckets skipped).
+
+    This order is the contract between `compile_db` and the persistent
+    compiled-DB cache: a cached tensor set stores advisory *indices*, so
+    the loader rebuilds this list from the (re-)loaded DB and the
+    indices line up by construction."""
+    out: list[tuple[str, str, Advisory]] = []
+    for bucket, pkgs in db.buckets.items():
+        if space_of_bucket(bucket) is None:
+            _log.debug("bucket not matchable, skipping", bucket=bucket)
+            continue
+        for name, advs in pkgs.items():
+            for adv in advs:
+                out.append((bucket, name, adv))
+    return out
 
 
 def compile_db(db: AdvisoryDB, window: int | None = None) -> CompiledDB:
