@@ -267,6 +267,147 @@ def bench_secrets(n_files: int = 1500) -> dict:
     }
 
 
+def _hist_p50_ms(hist, baseline=None) -> float:
+    """Approximate p50 from a histogram snapshot (first bucket bound
+    whose cumulative count crosses half), in milliseconds. `baseline`
+    = an earlier (cum, count) pair to subtract, so warm-up
+    observations in a process-global histogram don't skew the
+    steady-state number."""
+    cum, _total, count = hist.snapshot()
+    base_cum, base_count = baseline if baseline is not None \
+        else ([0] * len(cum), 0)
+    count -= base_count
+    if count <= 0:
+        return 0.0
+    half = (count + 1) / 2
+    for bound, c, b in zip(hist.buckets, cum, base_cum):
+        if c - b >= half:
+            return round(bound * 1e3, 3)
+    return round(hist.buckets[-1] * 1e3, 3)
+
+
+def bench_serving(engine, db) -> dict:
+    """Concurrent-serving throughput: M threaded clients scanning
+    against a LIVE scan server, match scheduler on vs off (the ISSUE-5
+    tentpole number). Rounds are interleaved on/off so shared-box load
+    drift cancels; medians of 3 rounds each. Artifacts are npm apps of
+    mixed sizes built from the synthetic DB's own package pool, so the
+    fairness path (big images coalesced with small ones) is exercised,
+    not just the happy path."""
+    import statistics
+    import threading
+
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.obs import metrics as obs_metrics
+    from trivy_tpu.rpc.client import RemoteDriver
+    from trivy_tpu.rpc.server import Server
+    from trivy_tpu.tensorize.synth import synth_queries
+    from trivy_tpu.types.scan import ScanOptions
+
+    n_clients = int(os.environ.get("TRIVY_TPU_BENCH_SCHED_CLIENTS", "8"))
+    per_client = int(os.environ.get("TRIVY_TPU_BENCH_SCHED_SCANS", "6"))
+    rounds = 3
+    pool = [q for q in synth_queries(db, 40_000, seed=77)
+            if q.space == "npm::"]
+    if not pool:
+        return {}
+    rng = random.Random(5)
+    # mixed sizes exercise fairness; kept modest because the per-scan
+    # blob decode + squash (identical in both modes) dominates past
+    # ~1k packages and would drown the detect-phase signal
+    sizes = [25, 80, 240, 800]
+    cache = MemoryCache()
+    artifacts = []
+    for i in range(n_clients * 2):
+        n = sizes[i % len(sizes)]
+        pkgs = []
+        for j in range(n):
+            q = pool[rng.randrange(len(pool))]
+            pkgs.append({"id": f"{q.name}@{q.version}", "name": q.name,
+                         "version": q.version})
+        key = f"sha256:sched{i}"
+        cache.put_blob(key, {"schema_version": 2, "applications": [{
+            "type": "npm", "file_path": f"img{i}/package-lock.json",
+            "packages": pkgs}]})
+        artifacts.append((f"img{i}", key))
+
+    # BOTH sides force their kill-switch state: an ambient
+    # TRIVY_TPU_SCHED=0 left over in the operator's shell must not
+    # silently turn the comparison into off-vs-off
+    prev_sched = os.environ.get("TRIVY_TPU_SCHED")
+    try:
+        os.environ["TRIVY_TPU_SCHED"] = "1"
+        srv_on = Server(engine, cache, host="localhost", port=0)
+        os.environ["TRIVY_TPU_SCHED"] = "0"
+        srv_off = Server(engine, cache, host="localhost", port=0)
+    finally:
+        if prev_sched is None:
+            os.environ.pop("TRIVY_TPU_SCHED", None)
+        else:
+            os.environ["TRIVY_TPU_SCHED"] = prev_sched
+    assert srv_on.service.scheduler is not None
+    assert srv_off.service.scheduler is None
+    srv_on.start()
+    srv_off.start()
+
+    def run_round(srv) -> float:
+        errs: list[Exception] = []
+
+        def worker(ci: int):
+            try:
+                driver = RemoteDriver(srv.address)
+                for k in range(per_client):
+                    target, key = artifacts[(ci * per_client + k)
+                                            % len(artifacts)]
+                    driver.scan(target, "", [key], ScanOptions())
+                driver.close()
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errs.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(ci,))
+                   for ci in range(n_clients)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        return n_clients * per_client / (time.time() - t0)
+
+    try:
+        # warm both servers (jit shapes, crawl cache) outside timing;
+        # the wait-histogram baseline keeps warm-up stalls out of the
+        # reported steady-state p50
+        run_round(srv_on)
+        run_round(srv_off)
+        wcum, _wtot, wcount = obs_metrics.SCHED_WAIT_SECONDS.snapshot()
+        wait_base = (wcum, wcount)
+        on_rates, off_rates = [], []
+        for _ in range(rounds):
+            on_rates.append(run_round(srv_on))
+            off_rates.append(run_round(srv_off))
+        on_med = statistics.median(on_rates)
+        off_med = statistics.median(off_rates)
+        sched = srv_on.service.scheduler
+        return {
+            "clients": n_clients,
+            "scans_per_client": per_client,
+            "on_images_per_s": round(on_med, 1),
+            "off_images_per_s": round(off_med, 1),
+            "speedup": round(on_med / off_med, 2) if off_med else 0.0,
+            "p50_wait_ms": _hist_p50_ms(obs_metrics.SCHED_WAIT_SECONDS,
+                                        wait_base),
+            "shed": srv_on.service.metrics.scans_shed_total
+            + srv_off.service.metrics.scans_shed_total,
+            "batches": sched.stats["batches"] if sched else 0,
+            "max_coalesced": sched.stats["coalesced"] if sched else 0,
+        }
+    finally:
+        srv_on.shutdown()
+        srv_off.shutdown()
+
+
 def _native_collect_active() -> bool:
     from trivy_tpu.native import collect as ncollect
 
@@ -790,6 +931,12 @@ def main():
         "images_equiv_per_s": round(n_q / real_s / 120, 1),
     }
 
+    # --- concurrent serving: match scheduler on vs off -------------------
+    # M threaded clients against a live server; the scheduler coalesces
+    # their detect batches into shared micro-batches (ISSUE 5 tentpole)
+    with _trace.span("serving_sched"):
+        sched_detail = bench_serving(engine, db)
+
     # --- secret path (BASELINE config #3: kernel-tree shape) -------------
     with _trace.span("secret_path"):
         secret_detail = bench_secrets()
@@ -849,6 +996,7 @@ def main():
         "secret": secret_detail,
         "pipeline": pipe,
         "compile_cache": compile_cache_detail,
+        "sched": sched_detail,
     }
     if pipe:
         detail["pipeline_occupancy"] = pipe.get("pipeline_occupancy", 0.0)

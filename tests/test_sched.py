@@ -1,0 +1,679 @@
+"""Cross-request continuous batching (trivy_tpu/sched) + its PR-5
+satellites: concurrent-server zero-diff, queued-deadline shed, fault
+injection, fairness, keep-alive client transport, gzip wire
+negotiation, secret hybrid probe."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from trivy_tpu.cache.cache import MemoryCache
+from trivy_tpu.db import Advisory, AdvisoryDB
+from trivy_tpu.detector.engine import MatchEngine, PkgQuery
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import tracing
+from trivy_tpu.resilience import faults
+from trivy_tpu.resilience.retry import Deadline, deadline_scope
+from trivy_tpu.rpc import wire
+from trivy_tpu.rpc.client import RemoteCache, RemoteDriver
+from trivy_tpu.rpc.server import Overloaded, ScanService, Server
+from trivy_tpu.sched.scheduler import MatchScheduler
+from trivy_tpu.types.scan import ScanOptions
+
+pytestmark = pytest.mark.sched
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+N_PKGS = 24
+
+
+def _db() -> AdvisoryDB:
+    db = AdvisoryDB()
+    for i in range(N_PKGS):
+        db.put_advisory("npm::ghsa", f"pkg{i}", Advisory(
+            vulnerability_id=f"CVE-2024-{1000 + i}",
+            vulnerable_versions=[f"<{(i % 5) + 1}.0.0"],
+        ))
+    for i in range(8):
+        db.put_advisory("pip::ghsa", f"mod{i}", Advisory(
+            vulnerability_id=f"CVE-2024-{2000 + i}",
+            vulnerable_versions=[f"<{(i % 3) + 1}.2.0"],
+        ))
+    return db
+
+
+def _queries(n: int, seed: int = 0) -> list[PkgQuery]:
+    rng = random.Random(seed)
+    return [PkgQuery("npm::", f"pkg{rng.randrange(N_PKGS)}",
+                     f"{rng.randrange(7)}.1.0", "npm") for _ in range(n)]
+
+
+def _blob(rng: random.Random, n_pkgs: int) -> dict:
+    apps = []
+    for app_type, eco_prefix, pool in (("npm", "pkg", N_PKGS),
+                                       ("pip", "mod", 8)):
+        pkgs = []
+        for j in range(max(n_pkgs // 2, 1)):
+            k = rng.randrange(pool)
+            v = f"{rng.randrange(6)}.1.0"
+            name = f"{eco_prefix}{k}"
+            pkgs.append({"id": f"{name}@{v}", "name": name, "version": v})
+        apps.append({"type": app_type,
+                     "file_path": f"{app_type}/lock.json",
+                     "packages": pkgs})
+    return {"schema_version": 2, "applications": apps}
+
+
+def _scan_bytes(service: ScanService, target: str, key: str) -> bytes:
+    results, os_found = service.scan(target, "", [key], ScanOptions())
+    return wire.scan_response(results, os_found)
+
+
+def _custom_sched(svc: ScanService, engine, **kw) -> MatchScheduler:
+    """Swap the service's default scheduler for one with test knobs."""
+    if svc.scheduler is not None:
+        svc.scheduler.close()
+    svc.scheduler = MatchScheduler(lambda: svc.engine,
+                                   on_shed=svc.metrics.scans_shed.inc,
+                                   **kw)
+    return svc.scheduler
+
+
+# ------------------------------------------------------------- tentpole
+
+
+def test_engine_submit_fans_out_per_request():
+    engine = MatchEngine(_db(), use_device=False)
+    lists = [_queries(7, seed=1), _queries(0, seed=2), _queries(13, seed=3)]
+    fanned = engine.submit(lists)
+    assert [len(part) for part in fanned] == [7, 0, 13]
+    for qs, part in zip(lists, fanned):
+        want = engine.detect(qs)
+        assert [r.adv_indices for r in part] == \
+            [r.adv_indices for r in want]
+        assert [r.query for r in part] == qs
+
+
+def test_concurrent_server_zero_diff(monkeypatch):
+    """M threads x random artifact sizes through a live ScanService
+    with the scheduler on == byte-identical to the sequential
+    per-request path (TRIVY_TPU_SCHED=0)."""
+    engine = MatchEngine(_db(), use_device=False)
+    cache = MemoryCache()
+    rng = random.Random(3)
+    artifacts = []
+    for i, size in enumerate([4, 30, 120, 7, 300, 18, 64, 2, 150, 45]):
+        key = f"sha256:a{i}"
+        cache.put_blob(key, _blob(rng, size))
+        artifacts.append((f"img{i}", key))
+
+    monkeypatch.setenv("TRIVY_TPU_SCHED", "0")
+    seq_service = ScanService(engine, cache)
+    assert seq_service.scheduler is None  # kill switch honored
+    want = {t: _scan_bytes(seq_service, t, k) for t, k in artifacts}
+
+    monkeypatch.delenv("TRIVY_TPU_SCHED")
+    service = ScanService(engine, cache)
+    assert service.scheduler is not None
+    # small batches + wide window force coalescing AND chunk
+    # interleaving across the concurrent scans
+    _custom_sched(service, engine, window_ms=5.0, max_rows=64,
+                  chunk_rows=16)
+    got: dict[str, bytes] = {}
+    errs: list[Exception] = []
+
+    def worker(tid: int):
+        try:
+            order = artifacts[tid:] + artifacts[:tid]
+            for target, key in order:
+                b = _scan_bytes(service, target, key)
+                prev = got.setdefault(f"{tid}:{target}", b)
+                assert prev == b
+        except Exception as exc:  # noqa: BLE001 — re-raised below
+            errs.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(tid,))
+               for tid in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    for tid in range(8):
+        for target, _k in artifacts:
+            assert got[f"{tid}:{target}"] == want[target]
+    assert service.scheduler.stats["batches"] >= 1
+    assert service.scheduler.stats["coalesced"] >= 2
+    service.scheduler.close()
+
+
+@pytest.mark.fault
+def test_concurrent_zero_diff_under_faults(monkeypatch):
+    """Zero diff holds under sched.submit drop/delay faults and a
+    mid-batch device loss (engine degrades to the host oracle)."""
+    engine = MatchEngine(_db(), use_device=True)
+    host = MatchEngine(_db(), use_device=False)
+    cache = MemoryCache()
+    rng = random.Random(11)
+    artifacts = []
+    for i, size in enumerate([6, 80, 20, 150, 3, 40]):
+        key = f"sha256:f{i}"
+        cache.put_blob(key, _blob(rng, size))
+        artifacts.append((f"img{i}", key))
+
+    monkeypatch.setenv("TRIVY_TPU_SCHED", "0")
+    seq = ScanService(host, cache)
+    want = {t: _scan_bytes(seq, t, k) for t, k in artifacts}
+    monkeypatch.delenv("TRIVY_TPU_SCHED")
+
+    faults.install_spec(
+        "sched.submit:delay=0.001@2;sched.submit:drop@3;"
+        "engine:device-lost@2")
+    service = ScanService(engine, cache)
+    _custom_sched(service, engine, window_ms=4.0, max_rows=48,
+                  chunk_rows=16)
+    errs: list[Exception] = []
+    got: dict[str, bytes] = {}
+
+    def worker(tid: int):
+        try:
+            for target, key in artifacts:
+                got[f"{tid}:{target}"] = _scan_bytes(service, target, key)
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(tid,))
+               for tid in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    assert engine.device_lost  # the mid-batch loss really happened
+    for k, b in got.items():
+        assert b == want[k.split(":", 1)[1]], k
+    service.scheduler.close()
+
+
+def test_queued_deadline_expiry_sheds():
+    """A request whose budget expires while queued is shed with
+    Retry-After (503 upstream), never silently dropped."""
+    engine = MatchEngine(_db(), use_device=False)
+    shed = []
+    sched = MatchScheduler(lambda: engine, window_ms=2000.0,
+                           on_shed=lambda: shed.append(1))
+    try:
+        with deadline_scope(Deadline(0.05)):
+            with pytest.raises(Overloaded) as ei:
+                sched.submit(_queries(8))
+        assert ei.value.retry_after > 0
+        assert "expired while queued" in str(ei.value)
+        assert shed == [1]
+    finally:
+        sched.close()
+
+
+def test_service_counts_queued_shed_once():
+    engine = MatchEngine(_db(), use_device=False)
+    cache = MemoryCache()
+    cache.put_blob("sha256:s", _blob(random.Random(1), 10))
+    service = ScanService(engine, cache)
+    _custom_sched(service, engine, window_ms=2000.0)
+    try:
+        with pytest.raises(Overloaded):
+            service.scan("img", "", ["sha256:s"], ScanOptions(),
+                         deadline=Deadline(0.15))
+        assert service.metrics.scans_shed_total == 1
+        assert service.metrics.scan_errors_total == 0
+    finally:
+        service.scheduler.close()
+
+
+def test_queue_admission_control():
+    engine = MatchEngine(_db(), use_device=False)
+    sched = MatchScheduler(lambda: engine, window_ms=1000.0, max_queue=1)
+    out: list = []
+    t = threading.Thread(
+        target=lambda: out.append(sched.submit(_queries(4))))
+    t.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not sched._waiting and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert sched._waiting, "first submission never queued"
+        with pytest.raises(Overloaded) as ei:
+            sched.submit(_queries(4))
+        assert "overloaded" in str(ei.value)
+        assert sched.stats["sheds"] == 1
+    finally:
+        # close() drains the queued-and-admitted request first
+        sched.close()
+        t.join(5)
+    assert out and len(out[0]) == 4
+
+
+@pytest.mark.fault
+def test_sched_submit_error_fault_sheds():
+    engine = MatchEngine(_db(), use_device=False)
+    faults.install_spec("sched.submit:error@1")
+    sched = MatchScheduler(lambda: engine, window_ms=1.0)
+    try:
+        with pytest.raises(Overloaded):
+            sched.submit(_queries(3))
+        # next submission is clean
+        assert len(sched.submit(_queries(3))) == 3
+    finally:
+        sched.close()
+
+
+@pytest.mark.fault
+def test_sched_submit_drop_bypasses_scheduler():
+    engine = MatchEngine(_db(), use_device=False)
+    faults.install_spec("sched.submit:drop")
+    sched = MatchScheduler(lambda: engine, window_ms=1.0)
+    try:
+        qs = _queries(9)
+        got = sched.submit(qs)
+        want = engine.detect(qs)
+        assert [r.adv_indices for r in got] == \
+            [r.adv_indices for r in want]
+        assert sched.stats["batches"] == 0  # never entered the queue
+    finally:
+        sched.close()
+
+
+class _ManualSched(MatchScheduler):
+    """Scheduler whose background thread idles: tests drive
+    _compose/_dispatch by hand for deterministic batch composition."""
+
+    def _run(self):
+        while not self._stopping:
+            time.sleep(0.02)
+
+
+def test_fairness_small_request_not_starved():
+    """Chunk interleaving: a small request queued behind a huge one is
+    fully dispatched in the huge request's FIRST batch, not after the
+    whole 400-row image has streamed through."""
+    engine = MatchEngine(_db(), use_device=False)
+    sched = _ManualSched(lambda: engine, window_ms=30.0, max_rows=32,
+                         chunk_rows=8)
+    try:
+        p_big = sched._enqueue(_queries(400, seed=1))
+        p_small = sched._enqueue(_queries(6, seed=2))
+        parts, rows = sched._compose()
+        # queued rows >= max_rows: the window closes immediately and the
+        # first batch interleaves chunks of BOTH requests
+        assert rows == 32
+        assert {id(p) for p, _lo, _hi in parts} == {id(p_big),
+                                                    id(p_small)}
+        assert p_small.queued_rows == 0  # fully dispatched in batch 1
+        assert p_big.queued_rows > 0     # still streaming
+        sched._dispatch(parts, rows)
+        assert p_small.done.is_set() and p_small.error is None
+        batches = 1
+        while not p_big.done.is_set():
+            parts, rows = sched._compose()
+            sched._dispatch(parts, rows)
+            batches += 1
+        assert batches >= 400 // 32
+        # demuxed results byte-match the private detect path
+        want = engine.detect(p_small.queries)
+        assert [r.adv_indices for r in p_small.results] == \
+            [r.adv_indices for r in want]
+    finally:
+        sched.close()
+
+
+def test_batch_failure_isolated_per_request():
+    """One request's poison queries must fail only that request: a
+    failed shared batch re-dispatches each coalesced slice privately
+    (per-request-path error parity)."""
+    engine = MatchEngine(_db(), use_device=False)
+    poison = _queries(5, seed=9)
+    good = _queries(6, seed=4)
+
+    class FlakyEngine:
+        def submit(self, lists):
+            raise RuntimeError("batch boom")
+
+        def detect(self, qs):
+            if qs and qs[0] is poison[0]:
+                raise RuntimeError("poison slice")
+            return engine.detect(qs)
+
+    sched = MatchScheduler(lambda: FlakyEngine(), window_ms=100.0)
+    results: dict = {}
+    errs: dict = {}
+
+    def run(name, qs):
+        try:
+            results[name] = sched.submit(qs)
+        except Exception as exc:  # noqa: BLE001
+            errs[name] = exc
+
+    t1 = threading.Thread(target=run, args=("good", good))
+    t2 = threading.Thread(target=run, args=("poison", poison))
+    try:
+        t1.start()
+        t2.start()
+        t1.join(30)
+        t2.join(30)
+        assert "poison" in errs and "poison slice" in str(errs["poison"])
+        assert "good" not in errs
+        want = engine.detect(good)
+        assert [r.adv_indices for r in results["good"]] == \
+            [r.adv_indices for r in want]
+    finally:
+        sched.close()
+
+
+def test_lone_scan_skips_coalesce_window():
+    """With one in-flight scan (busy_fn <= 1) the coalesce window is
+    skipped: a huge window must not delay a lone submission."""
+    engine = MatchEngine(_db(), use_device=False)
+    sched = MatchScheduler(lambda: engine, window_ms=5000.0,
+                           busy_fn=lambda: 1)
+    try:
+        t0 = time.monotonic()
+        out = sched.submit(_queries(5))
+        assert len(out) == 5
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        sched.close()
+
+
+@pytest.mark.obs
+def test_sched_spans_keep_request_parentage():
+    """sched.enqueue lives in the request's own trace; sched.batch runs
+    on the scheduler thread but attaches to the (oldest) submitting
+    request's trace — one stitched tree, no orphaned roots."""
+    engine = MatchEngine(_db(), use_device=False)
+    sched = MatchScheduler(lambda: engine, window_ms=1.0)
+    tracing.enable(True)
+    tracing.reset()
+    try:
+        with tracing.span("scan") as root:
+            sched.submit(_queries(5))
+        spans = tracing.spans()
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s.name, []).append(s)
+        assert "sched.enqueue" in by_name
+        assert all(s.trace_id == root.trace_id
+                   for s in by_name["sched.enqueue"])
+        assert "sched.batch" in by_name
+        assert all(s.trace_id == root.trace_id
+                   for s in by_name["sched.batch"])
+    finally:
+        tracing.enable(False)
+        tracing.reset()
+        sched.close()
+
+
+def test_sched_metrics_observed():
+    engine = MatchEngine(_db(), use_device=False)
+    _cum, _tot, rows_before = obs_metrics.SCHED_BATCH_ROWS.snapshot()
+    _cum, _tot, co_before = obs_metrics.SCHED_COALESCED.snapshot()
+    sched = MatchScheduler(lambda: engine, window_ms=1.0)
+    try:
+        sched.submit(_queries(12))
+    finally:
+        sched.close()
+    assert obs_metrics.SCHED_BATCH_ROWS.snapshot()[2] > rows_before
+    assert obs_metrics.SCHED_COALESCED.snapshot()[2] > co_before
+    assert obs_metrics.SCHED_WAIT_SECONDS.snapshot()[2] > 0
+
+
+def test_drain_finishes_admitted_work_and_refuses_new():
+    """Drain semantics: a scan admitted (and queued in the scheduler)
+    before drain completes; a scan arriving after drain sheds."""
+    engine = MatchEngine(_db(), use_device=False)
+    cache = MemoryCache()
+    cache.put_blob("sha256:d", _blob(random.Random(2), 12))
+    service = ScanService(engine, cache)
+    _custom_sched(service, engine, window_ms=150.0)
+    out: list = []
+    errs: list = []
+
+    def admitted():
+        try:
+            out.append(_scan_bytes(service, "img", "sha256:d"))
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    t = threading.Thread(target=admitted)
+    try:
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while not service._inflight and time.monotonic() < deadline:
+            time.sleep(0.002)
+        service.start_drain()
+        with pytest.raises(Overloaded):
+            service.scan("img2", "", ["sha256:d"], ScanOptions())
+        t.join(30)
+        assert out and not errs
+        assert service.await_drained(5.0) == 0
+    finally:
+        service.scheduler.close()
+
+
+# ------------------------------------------------------------ satellites
+
+
+def _lodash_db() -> AdvisoryDB:
+    db = AdvisoryDB()
+    db.put_advisory("npm::ghsa", "lodash", Advisory(
+        vulnerability_id="CVE-2019-10744",
+        vulnerable_versions=["<4.17.12"],
+    ))
+    return db
+
+
+@pytest.fixture()
+def live_server():
+    engine = MatchEngine(_lodash_db(), use_device=False)
+    srv = Server(engine, MemoryCache(), host="localhost", port=0)
+    srv.start()
+    srv.service.cache.put_blob("sha256:b", {
+        "schema_version": 2,
+        "applications": [{
+            "type": "npm", "file_path": "package-lock.json",
+            "packages": [{"id": "lodash@4.17.4", "name": "lodash",
+                          "version": "4.17.4"}],
+        }],
+    })
+    srv.service.cache.put_artifact("sha256:a", {"schema_version": 2})
+    yield srv
+    srv.shutdown()
+
+
+def test_client_keepalive_reuses_and_recovers(live_server):
+    cache = RemoteCache(live_server.address)
+    cache.missing_blobs("sha256:a", ["sha256:b"])
+    sock_conn = cache.conn._tls.conn
+    assert sock_conn is not None and sock_conn.sock is not None
+    cache.missing_blobs("sha256:a", ["sha256:b"])
+    # the same persistent connection carried both calls
+    assert cache.conn._tls.conn is sock_conn
+    # stale keep-alive (server closed it idle): transparently rebuilt
+    sock_conn.sock.close()
+    missing_artifact, missing = cache.missing_blobs(
+        "sha256:a", ["sha256:b"])
+    assert not missing_artifact and missing == []
+    assert cache.conn._tls.conn is not sock_conn
+    cache.close()
+
+
+def test_conn_pool_shared_across_default_clients(live_server):
+    """Default-configured RemoteDriver/RemoteCache against one server
+    share a pooled _Conn (and so the per-thread keep-alive socket):
+    fleet lanes amortize TCP connect per lane, not per artifact."""
+    from trivy_tpu.resilience.retry import RetryPolicy
+
+    cache = RemoteCache(live_server.address)
+    driver = RemoteDriver(live_server.address)
+    assert cache.conn is driver.conn
+    cache.missing_blobs("sha256:a", ["sha256:b"])
+    sock_conn = cache.conn._tls.conn
+    driver.scan("app", "sha256:a", ["sha256:b"], ScanOptions())
+    assert driver.conn._tls.conn is sock_conn  # one socket, both clients
+    # a custom retry policy opts out of the pool (test isolation)
+    private = RemoteCache(live_server.address,
+                          retry=RetryPolicy(attempts=1))
+    assert private.conn is not cache.conn
+    cache.close()
+    # pooled connections survive close(): next use auto-reopens
+    ma, _missing = cache.missing_blobs("sha256:a", ["sha256:b"])
+    assert not ma
+
+
+def test_gzip_negotiation_round_trip(live_server, monkeypatch):
+    monkeypatch.setattr(wire, "GZIP_MIN_BYTES", 16)
+    driver = RemoteDriver(live_server.address)
+    # first call: plain request, learns the capability, gzip response
+    r1, os1 = driver.scan("app", "sha256:a", ["sha256:b"], ScanOptions())
+    assert driver.conn._server_gzip
+    # second call: request body travels gzipped too
+    r2, os2 = driver.scan("app", "sha256:a", ["sha256:b"], ScanOptions())
+    assert wire.scan_response(r1, os1) == wire.scan_response(r2, os2)
+    assert [v.vulnerability_id for v in r2[0].vulnerabilities] == \
+        ["CVE-2019-10744"]
+    driver.close()
+
+
+def test_gzip_old_client_stays_plain(live_server, monkeypatch):
+    """A header-less client keeps the exact plain wire bytes."""
+    import json
+    import urllib.request
+
+    monkeypatch.setattr(wire, "GZIP_MIN_BYTES", 16)
+    body = wire.encode({"artifact_id": "sha256:a",
+                        "blob_ids": ["sha256:b"]})
+    req = urllib.request.Request(
+        live_server.address + "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+        data=body, method="POST",
+        headers={"Content-Type": "application/json",
+                 "X-Trivy-Tpu-Wire": "internal"})
+    with urllib.request.urlopen(req) as r:
+        assert r.headers.get("Content-Encoding") is None
+        doc = json.loads(r.read())
+    assert doc["missing_artifact"] is False
+
+
+def test_twirp_reference_client_shed_gets_503(live_server):
+    """A reference Twirp client (no internal-wire header) hitting a
+    shedding server gets 503 + Retry-After, not a generic 500 — it
+    must be able to back off."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    live_server.service.start_drain()
+    body = _json.dumps({"target": "a", "artifact_id": "",
+                        "blob_ids": []}).encode()
+    req = urllib.request.Request(
+        live_server.address + "/twirp/trivy.scanner.v1.Scanner/Scan",
+        data=body, headers={"Content-Type": "application/json"},
+        method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 503
+    assert ei.value.headers.get("Retry-After")
+
+
+def test_gzip_bytes_deterministic_roundtrip():
+    payload = b'{"k": "v"}' * 1000
+    z1, z2 = wire.gzip_bytes(payload), wire.gzip_bytes(payload)
+    assert z1 == z2 and len(z1) < len(payload)
+    assert wire.gunzip_bytes(z1) == payload
+    with pytest.raises(OSError):
+        wire.gunzip_bytes(z1[:10])
+
+
+def test_secret_hybrid_probe_decides_and_caches(monkeypatch):
+    from trivy_tpu.secret import scanner as sec
+
+    monkeypatch.delenv("TRIVY_TPU_SECRET_PROBE", raising=False)
+    corpus = [("a.txt", b'token = "ghp_' + b"k3J9" * 9 + b'"\n')]
+    calls = {"hybrid": 0, "device": 0}
+    orig_host = sec.SecretScanner._scan_files_host
+
+    class Slow(sec.SecretScanner):
+        @staticmethod
+        def _accel_backend():
+            return True
+
+        def _scan_files_device(self, eligible, prefetched=None):
+            calls["device"] += 1
+            time.sleep(0.5)
+            return []
+
+        def _scan_files_host(self, eligible):
+            # the probe corpus times deterministically fast; real
+            # scans delegate so findings stay exact
+            if eligible and str(eligible[0][1]).startswith("probe/"):
+                return []
+            return orig_host(self, eligible)
+
+        def _scan_files_hybrid(self, eligible):
+            calls["hybrid"] += 1
+            return orig_host(self, eligible)
+
+    sec.reset_hybrid_probe()
+    try:
+        slow = Slow()
+        out = slow.scan_files(corpus, use_device="hybrid")
+        # measurably slower device -> host path, finding intact
+        assert sec._HYBRID_PROBE["device"] is False
+        assert calls["hybrid"] == 0
+        assert sum(len(s.findings) for s in out) == 1
+        # one-shot: a second scan reuses the cached verdict
+        before = calls["device"]
+        slow.scan_files(corpus, use_device="hybrid")
+        assert calls["device"] == before
+
+        class Fast(Slow):
+            def _scan_files_device(self, eligible, prefetched=None):
+                calls["device"] += 1
+                return []
+
+        sec.reset_hybrid_probe()
+        Fast().scan_files(corpus, use_device="hybrid")
+        assert sec._HYBRID_PROBE["device"] is True
+        assert calls["hybrid"] == 1
+
+        class Broken(Slow):
+            def _scan_files_device(self, eligible, prefetched=None):
+                raise RuntimeError("no device")
+
+        sec.reset_hybrid_probe()
+        out = Broken().scan_files(corpus, use_device="hybrid")
+        # unavailable -> host, still correct findings
+        assert sec._HYBRID_PROBE["device"] is False
+        assert sum(len(s.findings) for s in out) == 1
+    finally:
+        sec.reset_hybrid_probe()
+
+
+def test_secret_probe_env_kill_switch(monkeypatch):
+    from trivy_tpu.secret import scanner as sec
+
+    monkeypatch.setenv("TRIVY_TPU_SECRET_PROBE", "0")
+    sec.reset_hybrid_probe()
+
+    class S(sec.SecretScanner):
+        pass
+
+    assert S()._hybrid_device_ok() is True
+    assert sec._HYBRID_PROBE is None  # probe never ran

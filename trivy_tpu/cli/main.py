@@ -289,6 +289,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "503 immediately, in-flight scans get this long "
                         "to finish, the rest are shed with Retry-After "
                         "(go-style duration)")
+    from trivy_tpu.sched.scheduler import (
+        DEFAULT_MAX_ROWS,
+        DEFAULT_WINDOW_MS,
+    )
+
+    p.add_argument("--sched-window-ms", type=float,
+                   default=DEFAULT_WINDOW_MS,
+                   help="match-scheduler coalesce window: max "
+                        "milliseconds a scan's detect batch waits to "
+                        "share a device micro-batch with concurrent "
+                        "requests (TRIVY_TPU_SCHED=0 disables the "
+                        "scheduler entirely — exact per-request path)")
+    p.add_argument("--sched-max-rows", type=int,
+                   default=DEFAULT_MAX_ROWS,
+                   help="match-scheduler target micro-batch size in "
+                        "package-query rows; larger requests are "
+                        "chunk-interleaved across batches so small "
+                        "scans are never starved")
 
     p = sub.add_parser("db", help="advisory DB operations", allow_abbrev=False)
     _add_global_flags(p)

@@ -897,7 +897,9 @@ def run_server(args) -> int:
           token=args.token, cache=FSCache(args.cache_dir),
           db_path=_db_path(args),
           drain_timeout=_parse_duration(
-              getattr(args, "drain_timeout", None) or "30s"))
+              getattr(args, "drain_timeout", None) or "30s"),
+          sched_window_ms=getattr(args, "sched_window_ms", None),
+          sched_max_rows=getattr(args, "sched_max_rows", None))
     return 0
 
 

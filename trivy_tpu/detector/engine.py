@@ -303,6 +303,14 @@ class MatchEngine:
         if not queries:
             return []
         if not self.use_device:
+            # the oracle path dedupes too: the degraded server and the
+            # scheduler's coalesced cross-request batches would
+            # otherwise pay one full oracle pass per duplicate
+            uniq, idx_map = self.dedupe_queries(queries)
+            if len(uniq) < len(queries):
+                u = self.oracle_detect(uniq)
+                return [MatchResult(q, u[idx_map[j]].adv_indices)
+                        for j, q in enumerate(queries)]
             return self.oracle_detect(queries)
 
         try:
@@ -317,10 +325,39 @@ class MatchEngine:
                 out = [MatchResult(q, h) for q, h in zip(queries, hits)]
         except faults.DeviceLost as exc:
             self._degrade_device(exc)
-            return self.oracle_detect(queries)
+            # re-enter through the (now) host branch so the fallback
+            # pass dedupes like every other detect call
+            return self.detect(queries)
         # the RPC server's production scan path goes through detect(),
         # not detect_many(): bound the memos here too
         self._enforce_memo_bounds()
+        return out
+
+    def submit(self, query_lists: list[list[PkgQuery]]
+               ) -> list[list[MatchResult]]:
+        """Batched entry point for the cross-request match scheduler
+        (trivy_tpu/sched): ONE dedupe + device dispatch over the union
+        of several requests' query lists, fanned back out per request.
+
+        Byte-identical to per-request detect() calls by construction —
+        dedupe, memo-generation handling and device-lost degradation
+        are all shared with detect(), whose per-query answers do not
+        depend on batch composition. The win is structural: N
+        concurrent requests cost one saturated dispatch instead of N
+        small contending ones, and cross-request duplicate queries
+        (fleets share base-image package lists) collapse before the
+        kernel ever sees them."""
+        flat: list[PkgQuery] = []
+        for qs in query_lists:
+            flat.extend(qs)
+        # detect() dedupes the union itself on both backends, so the
+        # cross-request duplicates collapse before any real work
+        res = self.detect(flat)
+        out: list[list[MatchResult]] = []
+        i = 0
+        for qs in query_lists:
+            out.append(res[i: i + len(qs)])
+            i += len(qs)
         return out
 
     def detect_many(self, queries: list[PkgQuery], batch_size: int = 65536,

@@ -381,3 +381,19 @@ PIPELINE_OCCUPANCY = REGISTRY.gauge(
     "Fraction of the last pipelined crawl's wall-clock x stages the "
     "executor's stages were busy (1.0 = encode/device/rescreen fully "
     "overlapped; ~1/3 = serial)")
+SCHED_BATCH_ROWS = REGISTRY.histogram(
+    "trivy_tpu_sched_batch_rows",
+    "Package-query rows per coalesced match-scheduler micro-batch",
+    buckets=(64, 256, 1024, 4096, 16384, 65536, 262144))
+SCHED_COALESCED = REGISTRY.histogram(
+    "trivy_tpu_sched_coalesced_requests",
+    "Distinct scan requests coalesced into one scheduler micro-batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
+SCHED_QUEUE_DEPTH = REGISTRY.gauge(
+    "trivy_tpu_sched_queue_depth",
+    "Scan requests waiting in the match-scheduler submission queue")
+SCHED_WAIT_SECONDS = REGISTRY.histogram(
+    "trivy_tpu_sched_wait_seconds",
+    "Queue wait from scheduler submission to first micro-batch dispatch",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 1.0, 5.0))

@@ -12,7 +12,8 @@ Spec grammar (rules joined by ";" or ","):
     rule     := site ":" action [ "=" param ] [ "@" selector ]
     site     := "rpc" | "rpc.scan" | "rpc.cache" | "rpc.cache.PutBlob"
                 | "engine" | "cache.write" | "db.install" | "fleet.scan"
-                | "journal.append" | ...  (dotted, prefix-matched)
+                | "journal.append" | "sched.submit"
+                | ...  (dotted, prefix-matched)
     action   := "drop" | "timeout" | "delay" | "error" | "corrupt"
                 | "device-lost" | "kill" | "torn-write" | "bitflip"
     selector := N        fire on the Nth matching call only (1-based)

@@ -3,22 +3,30 @@
 
 RemoteDriver implements the scanner Driver seam over HTTP; RemoteCache
 implements the ArtifactCache write interface so analysis results land in
-the server's cache. Transient failures retry under a RetryPolicy with
-decorrelated jitter; 503 responses honor Retry-After; the ambient
-per-scan deadline budget (resilience.retry.deadline_scope) rides the
-X-Trivy-Deadline header and bounds both the per-request socket timeout
-and the total retry loop. Fault-injection rules (resilience.faults)
-are consulted before every request so degraded-network behavior is
+the server's cache. Transport is a persistent keep-alive
+http.client.HTTPConnection per thread (fleet lanes each hold their own
+socket), so a fleet run pays TCP connect + handshake once per lane
+instead of once per scan; a stale keep-alive socket (server closed it
+idle) is rebuilt transparently. Transient failures retry under a
+RetryPolicy with decorrelated jitter; 503 responses honor Retry-After;
+the ambient per-scan deadline budget (resilience.retry.deadline_scope)
+rides the X-Trivy-Deadline header and bounds both the per-request
+socket timeout and the total retry loop. Large bodies gzip under the
+wire.py negotiation. Fault-injection rules (resilience.faults) are
+consulted before every request so degraded-network behavior is
 testable deterministically.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import random
+import threading
 import time
 import urllib.error
 import urllib.request
+from urllib.parse import urlsplit
 
 from trivy_tpu.log import logger
 from trivy_tpu.obs import metrics as obs_metrics
@@ -48,11 +56,144 @@ class _Conn:
                  custom_headers: dict | None = None, timeout: float = 300.0,
                  retry: RetryPolicy | None = None):
         self.base = url.rstrip("/")
+        parts = urlsplit(self.base if "//" in self.base
+                         else "http://" + self.base)
+        self._https = parts.scheme == "https"
+        self._netloc = parts.netloc
+        self._path_prefix = parts.path.rstrip("/")
         self.token = token
         self.custom_headers = custom_headers or {}
         self.timeout = timeout
         self.retry = retry or DEFAULT_RETRY
         self._rng = random.Random(self.retry.seed)
+        # one persistent keep-alive connection PER THREAD: fleet lanes
+        # never share a socket (http.client is not thread-safe), and
+        # each lane amortizes its TCP connect across its whole run
+        self._tls = threading.local()
+        self._all_conns: set = set()
+        self._conns_lock = threading.Lock()
+        # sticky capability learned from the first response's
+        # X-Trivy-Gzip header: only then are REQUEST bodies gzipped
+        # (an old server must never see a gzip request body)
+        self._server_gzip = False
+        # http_proxy/https_proxy/no_proxy targets go through urllib
+        # (which implements proxy routing); keep-alive sockets are for
+        # direct connections only
+        self._via_proxy = self._proxy_configured()
+
+    def _proxy_configured(self) -> bool:
+        proxies = urllib.request.getproxies()
+        scheme = "https" if self._https else "http"
+        if scheme not in proxies:
+            return False
+        host = self._netloc.rsplit("@", 1)[-1]
+        try:
+            return not urllib.request.proxy_bypass(host)
+        except OSError:
+            return True
+
+    # ------------------------------------------------------- transport
+
+    def _connection(self, timeout: float) -> http.client.HTTPConnection:
+        c = getattr(self._tls, "conn", None)
+        if c is None:
+            cls = (http.client.HTTPSConnection if self._https
+                   else http.client.HTTPConnection)
+            c = cls(self._netloc, timeout=timeout)
+            self._tls.conn = c
+        # (re-)register every handout: a thread whose socket close()
+        # severed may auto-reopen this conn object, and a later close()
+        # must still find it
+        with self._conns_lock:
+            self._all_conns.add(c)
+        c.timeout = timeout
+        if c.sock is not None:
+            try:
+                c.sock.settimeout(timeout)
+            except OSError:
+                # the socket died under us (closed fd): rebuild fresh
+                self._drop_connection()
+                return self._connection(timeout)
+        return c
+
+    def _drop_connection(self) -> None:
+        c = getattr(self._tls, "conn", None)
+        if c is not None:
+            self._tls.conn = None
+            with self._conns_lock:
+                self._all_conns.discard(c)
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        """Close every thread's keep-alive socket (best effort). A
+        pooled connection stays usable: the next request auto-reopens
+        and re-registers its socket."""
+        with self._conns_lock:
+            conns, self._all_conns = list(self._all_conns), set()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._tls.conn = None
+
+    def _request_once(self, path: str, body: bytes, headers: dict,
+                      timeout: float):
+        """One HTTP round trip on this thread's keep-alive connection.
+        -> (status, response headers, body bytes). A stale keep-alive
+        (the server closed the idle socket between requests) is rebuilt
+        and resent ONCE transparently, so the retry policy only ever
+        sees real failures; timeouts are never transparently resent
+        (the deadline budget owns those)."""
+        if self._via_proxy:
+            return self._request_via_urllib(path, body, headers, timeout)
+        reused = getattr(self._tls, "conn", None) is not None \
+            and getattr(self._tls.conn, "sock", None) is not None
+        conn = self._connection(timeout)
+        url_path = self._path_prefix + path
+        try:
+            conn.request("POST", url_path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        except TimeoutError:
+            self._drop_connection()
+            raise
+        except (http.client.HTTPException, ConnectionError, OSError):
+            self._drop_connection()
+            if not reused:
+                raise
+            conn = self._connection(timeout)
+            try:
+                conn.request("POST", url_path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self._drop_connection()
+                raise
+        if resp.will_close:
+            # the server asked for Connection: close; the next request
+            # auto-reopens (http.client auto_open), nothing to do
+            pass
+        return resp.status, resp.headers, data
+
+    def _request_via_urllib(self, path: str, body: bytes, headers: dict,
+                            timeout: float):
+        """Proxy-routed fallback (no keep-alive): urllib implements the
+        http_proxy/https_proxy/no_proxy handling this client must keep
+        honoring. Same (status, headers, body) contract."""
+        req = urllib.request.Request(
+            self.base + path, data=body, headers=headers, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, r.headers, r.read()
+        except urllib.error.HTTPError as exc:
+            with exc:
+                return exc.code, exc.headers, exc.read()
+
+    # ------------------------------------------------------------ post
 
     def post(self, path: str, body: bytes) -> bytes:
         # one client span covers the whole retried call; the trace
@@ -67,6 +208,7 @@ class _Conn:
         # can tell it apart from reference Twirp clients on the same paths
         headers = {"Content-Type": "application/json",
                    "X-Trivy-Tpu-Wire": "internal",
+                   "Accept-Encoding": "gzip",
                    **self.custom_headers}
         if self.token:
             headers["Trivy-Token"] = self.token
@@ -86,6 +228,10 @@ class _Conn:
             hdrs = dict(headers)
             if deadline is not None:
                 hdrs[DEADLINE_HEADER] = deadline.header_value()
+            send_body = body
+            if self._server_gzip and len(body) >= wire.GZIP_MIN_BYTES:
+                send_body = wire.gzip_bytes(body)
+                hdrs["Content-Encoding"] = "gzip"
             retry_after: float | None = None
             corrupt = False
             try:
@@ -102,9 +248,6 @@ class _Conn:
                             int(rule.param or 503))
                     elif rule.action == "corrupt":
                         corrupt = True
-                req = urllib.request.Request(
-                    self.base + path, data=body, headers=hdrs, method="POST"
-                )
                 timeout = self.timeout
                 if deadline is not None:
                     # small grace past the budget: a deadline-aware
@@ -115,26 +258,49 @@ class _Conn:
                         timeout, deadline.remaining() + 0.5))
                 rt_start = time.perf_counter()
                 try:
-                    with urllib.request.urlopen(req, timeout=timeout) as r:
-                        raw = r.read()
+                    status, rhdrs, raw = self._request_once(
+                        path, send_body, hdrs, timeout)
                 finally:
                     # per-attempt round-trip latency, errors included
                     obs_metrics.RPC_CLIENT_SECONDS.observe(
                         time.perf_counter() - rt_start, method=method)
-                return faults.corrupt_bytes(raw) if corrupt else raw
+                if rhdrs.get(wire.GZIP_CAPABLE_HEADER):
+                    self._server_gzip = True
+                if "gzip" in (rhdrs.get("Content-Encoding")
+                              or "").lower():
+                    raw = wire.gunzip_bytes(raw)
+                if status >= 300:
+                    # non-2xx is an error, named by status: 3xx included
+                    # (a redirecting ingress is a config problem this
+                    # client won't chase) and deterministic like 4xx —
+                    # only 5xx retries
+                    detail = raw.decode("utf-8", "replace")[:500]
+                    if hdrs.get("Content-Encoding") == "gzip" \
+                            and not rhdrs.get(wire.GZIP_CAPABLE_HEADER):
+                        # ANY error (4xx or 5xx) to our gzip request
+                        # from a server NOT advertising gzip capability
+                        # is an old/rolled-back replica choking on the
+                        # encoding: forget the sticky capability and
+                        # let the retry resend plain
+                        self._server_gzip = False
+                        last_err = RPCError(
+                            f"{status} to gzip request from a server "
+                            f"without gzip capability: {detail}")
+                    elif status < 500:
+                        raise RPCError(f"{status}: {detail}")
+                    else:
+                        last_err = RPCError(f"{status}: {detail}")
+                        if status == 503 and policy.respect_retry_after:
+                            retry_after = parse_retry_after(
+                                rhdrs.get("Retry-After"))
+                else:
+                    return faults.corrupt_bytes(raw) if corrupt else raw
             except faults.InjectedHTTPError as exc:
                 if exc.code < 500:
                     raise RPCError(f"{exc.code}: {exc}") from exc
                 last_err = RPCError(f"{exc.code}: {exc}")
-            except urllib.error.HTTPError as exc:
-                detail = exc.read().decode("utf-8", "replace")[:500]
-                if exc.code < 500:  # 4xx is deterministic — don't retry
-                    raise RPCError(f"{exc.code}: {detail}") from exc
-                last_err = RPCError(f"{exc.code}: {detail}")
-                if exc.code == 503 and policy.respect_retry_after:
-                    retry_after = parse_retry_after(
-                        exc.headers.get("Retry-After"))
-            except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            except (urllib.error.URLError, http.client.HTTPException,
+                    OSError, TimeoutError) as exc:
                 last_err = exc
             if attempt < policy.attempts - 1:
                 delay = next(delays)
@@ -155,6 +321,29 @@ class _Conn:
             f"attempts: {last_err}")
 
 
+# process-wide _Conn pool keyed by (url, token) for default-configured
+# clients: the CLI builds a fresh RemoteDriver + RemoteCache per
+# artifact (fleet runs: per lane-slot), and without sharing, each would
+# open its own sockets — the pool makes "TCP connect once per lane,
+# not once per scan" actually hold. Custom retry policies or headers
+# opt out (tests and special callers keep private connections).
+_CONN_POOL: dict[tuple, _Conn] = {}
+_CONN_POOL_LOCK = threading.Lock()
+
+
+def _pooled_conn(url: str, token: str | None,
+                 custom_headers: dict | None,
+                 retry: RetryPolicy | None) -> _Conn:
+    if retry is not None or custom_headers:
+        return _Conn(url, token, custom_headers, retry=retry)
+    key = (url.rstrip("/"), token)
+    with _CONN_POOL_LOCK:
+        c = _CONN_POOL.get(key)
+        if c is None:
+            c = _CONN_POOL[key] = _Conn(url, token)
+        return c
+
+
 class RemoteDriver:
     """Driver implementation that ships the scan to a server
     (reference pkg/rpc/client/client.go:48-73)."""
@@ -162,12 +351,15 @@ class RemoteDriver:
     def __init__(self, url: str, token: str | None = None,
                  custom_headers: dict | None = None,
                  retry: RetryPolicy | None = None):
-        self.conn = _Conn(url, token, custom_headers, retry=retry)
+        self.conn = _pooled_conn(url, token, custom_headers, retry)
 
     def scan(self, target, artifact_key, blob_keys, options):
         body = wire.scan_request(target, artifact_key, blob_keys, options)
         raw = self.conn.post(SCAN_PATH, body)
         return wire.decode_scan_response(raw)
+
+    def close(self) -> None:
+        self.conn.close()
 
 
 class RemoteCache:
@@ -177,7 +369,7 @@ class RemoteCache:
     def __init__(self, url: str, token: str | None = None,
                  custom_headers: dict | None = None,
                  retry: RetryPolicy | None = None):
-        self.conn = _Conn(url, token, custom_headers, retry=retry)
+        self.conn = _pooled_conn(url, token, custom_headers, retry)
 
     def put_artifact(self, artifact_id: str, info) -> None:
         self.conn.post(CACHE_PREFIX + "PutArtifact", wire.encode(
@@ -209,4 +401,4 @@ class RemoteCache:
         return {}
 
     def close(self) -> None:
-        pass
+        self.conn.close()

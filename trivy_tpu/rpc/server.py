@@ -35,19 +35,12 @@ from trivy_tpu.resilience.retry import (
     deadline_scope,
 )
 from trivy_tpu.rpc import wire
+from trivy_tpu.sched.scheduler import Overloaded  # noqa: F401 — re-export
 
 _log = logger("server")
 
 SCAN_PATH = "/twirp/trivy.scanner.v1.Scanner/Scan"
 CACHE_PREFIX = "/twirp/trivy.cache.v1.Cache/"
-
-
-class Overloaded(Exception):
-    """The server sheds this request instead of blocking (503)."""
-
-    def __init__(self, msg: str, retry_after: float = 1.0):
-        super().__init__(msg)
-        self.retry_after = retry_after
 
 
 class _RWLock:
@@ -197,7 +190,9 @@ class Metrics:
 class ScanService:
     """Holds the hot-swappable engine + the server-side cache."""
 
-    def __init__(self, engine, cache, db_path: str | None = None):
+    def __init__(self, engine, cache, db_path: str | None = None,
+                 sched_window_ms: float | None = None,
+                 sched_max_rows: int | None = None):
         self.lock = _RWLock()
         self.engine = engine
         self.cache = cache
@@ -221,6 +216,26 @@ class ScanService:
         self._drain_cond = threading.Condition()
         self._inflight = 0
         self.draining = False
+        # cross-request continuous batching: concurrent scans' detect
+        # phases coalesce into shared device micro-batches
+        # (trivy_tpu/sched). TRIVY_TPU_SCHED=0 restores the exact
+        # per-request path. The engine is read through a callable so a
+        # DB hot swap's replacement engine is picked up at dispatch
+        # time (in-flight scans hold the read lock, so it is always a
+        # consistent read); the in-flight counter feeds the lone-scan
+        # fast path (window skipped when nobody else can submit).
+        from trivy_tpu import sched as _sched
+
+        self.scheduler = None
+        if _sched.enabled():
+            self.scheduler = _sched.MatchScheduler(
+                lambda: self.engine,
+                window_ms=(sched_window_ms if sched_window_ms is not None
+                           else _sched.DEFAULT_WINDOW_MS),
+                max_rows=(sched_max_rows if sched_max_rows is not None
+                          else _sched.DEFAULT_MAX_ROWS),
+                on_shed=self.metrics.scans_shed.inc,
+                busy_fn=lambda: self._inflight)
 
     def _resolved_db_dir(self) -> str | None:
         """Real directory the DB would load from right now (a generation
@@ -354,7 +369,8 @@ class ScanService:
                 retry_after=1.0)
         start = time.perf_counter()
         try:
-            driver = LocalDriver(self.engine, self.cache)
+            driver = LocalDriver(self.engine, self.cache,
+                                 scheduler=self.scheduler)
             with deadline_scope(deadline):
                 results, os_found = driver.scan(
                     target, artifact_key, blob_keys, options)
@@ -362,6 +378,11 @@ class ScanService:
                 time.perf_counter() - start,
                 findings=sum(len(r.vulnerabilities) for r in results))
             return results, os_found
+        except Overloaded:
+            # the match scheduler shed this scan (queue overload or
+            # deadline expiry while queued) and already counted it in
+            # scans_shed_total via its on_shed hook — not a scan error
+            raise
         except DeadlineExceeded:
             # mid-scan deadline checkpoints fired. Sheds count ONLY in
             # scans_shed_total (consistent with the pre-lock shed path):
@@ -479,9 +500,22 @@ def _make_handler(service: ScanService, token: str | None,
         def _reply(self, code: int, body: bytes,
                    ctype: str = "application/json",
                    extra_headers: dict | None = None):
+            # large responses gzip when the client offered it; every
+            # response advertises the server's own gzip capability so
+            # the client may start gzipping large REQUEST bodies
+            # (wire.py negotiation — header-less old clients keep the
+            # plain byte-identical wire)
+            accept = (self.headers.get("Accept-Encoding") or "").lower()
+            encoding = None
+            if "gzip" in accept and len(body) >= wire.GZIP_MIN_BYTES:
+                body = wire.gzip_bytes(body)
+                encoding = "gzip"
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            self.send_header(wire.GZIP_CAPABLE_HEADER, "1")
+            if encoding:
+                self.send_header("Content-Encoding", encoding)
             for name, value in (extra_headers or {}).items():
                 self.send_header(name, value)
             self.end_headers()
@@ -526,6 +560,14 @@ def _make_handler(service: ScanService, token: str | None,
                 return
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length)
+            if "gzip" in (self.headers.get("Content-Encoding")
+                          or "").lower():
+                try:
+                    body = wire.gunzip_bytes(body)
+                except OSError as exc:
+                    # deterministic decode failure: never retried
+                    self._error(400, f"bad request body: {exc}")
+                    return
             if self.path.startswith("/twirp/") and \
                     self.headers.get("X-Trivy-Tpu-Wire") != "internal":
                 # reference wire protocol (Twirp protobuf / proto3-JSON).
@@ -534,9 +576,18 @@ def _make_handler(service: ScanService, token: str | None,
                 # the twirp paths is treated as a reference client.
                 from trivy_tpu.rpc import twirp
 
-                res = twirp.handle(
-                    service, self.path,
-                    self.headers.get("Content-Type", ""), body)
+                try:
+                    res = twirp.handle(
+                        service, self.path,
+                        self.headers.get("Content-Type", ""), body)
+                except Overloaded as exc:
+                    _log.warn("twirp scan shed", err=str(exc))
+                    self._shed(str(exc), exc.retry_after)
+                    return
+                except DeadlineExceeded as exc:
+                    _log.warn("twirp scan shed mid-flight", err=str(exc))
+                    self._shed(str(exc), 1.0)
+                    return
                 if res is not None:
                     status, ct, out = res
                     self._reply(status, out, ct)
@@ -611,10 +662,14 @@ class Server:
     def __init__(self, engine, cache, host="localhost", port=4954,
                  token: str | None = None, db_path: str | None = None,
                  db_reload_interval: float = 3600.0,
-                 path_prefix: str = ""):
+                 path_prefix: str = "",
+                 sched_window_ms: float | None = None,
+                 sched_max_rows: int | None = None):
         if path_prefix and not path_prefix.startswith("/"):
             path_prefix = "/" + path_prefix
-        self.service = ScanService(engine, cache, db_path=db_path)
+        self.service = ScanService(engine, cache, db_path=db_path,
+                                   sched_window_ms=sched_window_ms,
+                                   sched_max_rows=sched_max_rows)
         self.httpd = ThreadingHTTPServer(
             (host, port),
             _make_handler(self.service, token, path_prefix.rstrip("/"))
@@ -667,12 +722,17 @@ class Server:
         if drain_timeout is not None:
             self.drain(drain_timeout)  # idempotent if already draining
         self._stop.set()
+        if self.service.scheduler is not None:
+            # after the drain budget: the scheduler finishes whatever
+            # queued-and-admitted work remains, then stops admitting
+            self.service.scheduler.close()
         self.httpd.shutdown()
         self.httpd.server_close()
 
 
 def serve(engine, host="localhost", port=4954, token=None, cache=None,
-          db_path=None, db_reload_interval=3600.0, drain_timeout=30.0):
+          db_path=None, db_reload_interval=3600.0, drain_timeout=30.0,
+          sched_window_ms=None, sched_max_rows=None):
     """Blocking entry point for `trivy-tpu server`.
 
     SIGTERM triggers a graceful drain: /readyz goes 503 at once,
@@ -685,7 +745,9 @@ def serve(engine, host="localhost", port=4954, token=None, cache=None,
 
         cache = MemoryCache()
     srv = Server(engine, cache, host=host, port=port, token=token,
-                 db_path=db_path, db_reload_interval=db_reload_interval)
+                 db_path=db_path, db_reload_interval=db_reload_interval,
+                 sched_window_ms=sched_window_ms,
+                 sched_max_rows=sched_max_rows)
     srv.start()
     stop = threading.Event()
 

@@ -694,6 +694,14 @@ def handle(service, path: str, ctype: str, body: bytes):
                 scan_response_proto(results, os_found))
             return 200, ct, out
         except Exception as exc:
+            from trivy_tpu.resilience.retry import DeadlineExceeded
+            from trivy_tpu.sched.scheduler import Overloaded
+
+            if isinstance(exc, (Overloaded, DeadlineExceeded)):
+                # backpressure, not an internal fault: propagate so the
+                # HTTP handler sheds with 503 + Retry-After and a
+                # reference client backs off instead of hammering
+                raise
             return _twirp_error("internal", str(exc), 500)
     if path.startswith(CACHE_PREFIX):
         method = path[len(CACHE_PREFIX):]

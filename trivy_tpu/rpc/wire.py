@@ -3,14 +3,47 @@
 The wire shape is the internal dataclass shape (dataclasses.asdict with
 enums rendered to their values) — full fidelity both ways, rebuilt via
 types.serde.from_dict on receipt.
+
+Body compression: request/response bodies above GZIP_MIN_BYTES travel
+gzip-encoded when both ends negotiated it. The client always offers
+``Accept-Encoding: gzip``; the server gzips large responses for such
+clients and advertises its own capability with the ``X-Trivy-Gzip``
+response header, after which the client gzips large REQUEST bodies too
+(``Content-Encoding: gzip``). Ends that send no headers keep the plain
+byte-identical wire: an old client never receives gzip, and an old
+server never sees a gzipped request.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import enum
+import gzip as _gzip
 import json
+import os
+import zlib
 from typing import Any
+
+# responses/requests at or above this many bytes gzip when negotiated
+GZIP_MIN_BYTES = int(os.environ.get("TRIVY_TPU_RPC_GZIP_MIN", "8192"))
+# server capability advertisement: its presence on any response tells
+# the client that gzip REQUEST bodies are understood
+GZIP_CAPABLE_HEADER = "X-Trivy-Gzip"
+
+
+def gzip_bytes(body: bytes) -> bytes:
+    """Deterministic gzip frame (mtime pinned so identical payloads
+    compress to identical bytes)."""
+    return _gzip.compress(body, compresslevel=6, mtime=0)
+
+
+def gunzip_bytes(body: bytes) -> bytes:
+    """Inverse of gzip_bytes; every decode failure surfaces as OSError
+    so both endpoints handle torn/corrupt frames through one branch."""
+    try:
+        return _gzip.decompress(body)
+    except (EOFError, zlib.error) as exc:
+        raise OSError(f"bad gzip body: {exc}") from exc
 
 from trivy_tpu.types.artifact import OS
 from trivy_tpu.types.report import Result

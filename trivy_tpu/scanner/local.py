@@ -27,10 +27,26 @@ from trivy_tpu.detector.langpkg import PKG_TARGETS  # noqa: E402
 
 
 class LocalDriver:
-    def __init__(self, engine: MatchEngine, cache, post_hooks=None):
+    def __init__(self, engine: MatchEngine, cache, post_hooks=None,
+                 scheduler=None):
         self.engine = engine
         self.cache = cache
         self.post_hooks = post_hooks or []
+        # server mode attaches the cross-request match scheduler so the
+        # detect phase joins shared device micro-batches instead of
+        # dispatching privately (trivy_tpu/sched); None = direct path
+        self.scheduler = scheduler
+
+    def _match_engine(self):
+        """Engine handle for the detect phase: with a scheduler
+        attached, detect() routes through its coalesced micro-batches —
+        byte-identical results, one saturated dispatch lane. Everything
+        else (db, cdb, advisories) reads through to the real engine."""
+        if self.scheduler is None:
+            return self.engine
+        from trivy_tpu.sched.scheduler import SchedEngine
+
+        return SchedEngine(self.engine, self.scheduler)
 
     def scan(self, target, artifact_key, blob_keys, options: ScanOptions):
         from trivy_tpu import obs
@@ -129,12 +145,13 @@ class LocalDriver:
         results: list[Result] = []
         include_os = "os" in options.pkg_types
         include_lib = "library" in options.pkg_types
+        engine = self._match_engine()
 
         if include_os and (detail.os.detected or detail.packages):
             vulns, eosl = ([], False)
             if detail.os.detected and detail.packages:
                 vulns, eosl = ospkg.detect(
-                    self.engine, detail.os, detail.repository, detail.packages
+                    engine, detail.os, detail.repository, detail.packages
                 )
                 detail.os.eosl = eosl
             vulnerability.fill_info(self.engine.db, vulns)
@@ -159,7 +176,7 @@ class LocalDriver:
             for app in detail.applications:
                 if not app.packages:
                     continue
-                vulns = langpkg.detect_app(self.engine, app)
+                vulns = langpkg.detect_app(engine, app)
                 vulnerability.fill_info(self.engine.db, vulns)
                 res = Result(
                     target=app.file_path

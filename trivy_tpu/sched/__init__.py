@@ -1,0 +1,23 @@
+"""Cross-request continuous batching (docs/performance.md): the server-
+side match scheduler that coalesces concurrent scans' detect batches
+into shared device micro-batches."""
+
+from trivy_tpu.sched.scheduler import (
+    DEFAULT_MAX_QUEUE,
+    DEFAULT_MAX_ROWS,
+    DEFAULT_WINDOW_MS,
+    MatchScheduler,
+    Overloaded,
+    SchedEngine,
+    enabled,
+)
+
+__all__ = [
+    "DEFAULT_MAX_QUEUE",
+    "DEFAULT_MAX_ROWS",
+    "DEFAULT_WINDOW_MS",
+    "MatchScheduler",
+    "Overloaded",
+    "SchedEngine",
+    "enabled",
+]

@@ -1,0 +1,445 @@
+"""Cross-request continuous batching for the scan server
+(docs/performance.md "Serving: continuous batching").
+
+Before this module, N concurrent scan RPCs ran N private
+``engine.detect`` calls: N small, contending device dispatches instead
+of one saturated batch — the exact problem continuous/dynamic batching
+solves in inference serving. The ``MatchScheduler`` closes that gap:
+the detect phase of every in-flight request submits its ``PkgQuery``
+list here; submissions are coalesced under a size/latency window
+(target rows + max coalesce wait), dispatched through the engine's
+pipelined executor as ONE shared micro-batch, and the per-query
+results are demultiplexed back to each waiting request.
+
+Guarantees:
+
+- **Zero diff.** Results for any interleaving are byte-identical to
+  sequential per-request scans: the engine's detect path is exact and
+  deterministic per query (memo-generation handling makes the shared
+  engine safe under concurrency), and the scheduler only regroups
+  queries — it never reorders results within a request.
+- **Fairness.** Each request's rows are dispatched in
+  ``chunk_rows``-sized chunks, interleaved round-robin across waiting
+  requests in oldest-deadline-first order, so one 200k-package image
+  cannot starve ten 50-package images queued behind it.
+- **Deadlines.** A request whose ambient ``X-Trivy-Deadline`` budget
+  expires while (partly) queued is shed with ``Overloaded`` (503 +
+  Retry-After upstream) and counted via ``on_shed`` — never silently
+  dropped. Rows already in flight are awaited (the batch is running).
+- **Admission control.** A bounded submission queue: past
+  ``max_queue`` waiting requests new submissions shed immediately.
+- **Observability.** ``trivy_tpu_sched_batch_rows`` /
+  ``_coalesced_requests`` / ``_queue_depth`` / ``_wait_seconds``
+  metrics, plus ``sched.enqueue`` (in the request's own trace) and
+  ``sched.batch`` spans (attached to the oldest coalesced request's
+  trace, so batch timing keeps request parentage across the scheduler
+  thread).
+- **Fault site.** ``sched.submit``: ``delay`` stalls the submission,
+  ``drop`` bypasses the scheduler for that submission (direct
+  per-request detect — degraded coalescing, identical bytes),
+  ``error`` sheds it with ``Overloaded``.
+
+``TRIVY_TPU_SCHED=0`` kills the scheduler process-wide: the server
+runs the exact pre-scheduler per-request path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from trivy_tpu.log import logger
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import tracing
+from trivy_tpu.resilience import faults
+from trivy_tpu.resilience.retry import current_deadline
+
+_log = logger("sched")
+
+ENV_KILL = "TRIVY_TPU_SCHED"
+
+DEFAULT_WINDOW_MS = 3.0
+DEFAULT_MAX_ROWS = 65536
+DEFAULT_MAX_QUEUE = 256
+# micro-batches concurrently in flight: >1 lets the next batch encode
+# and dispatch while the previous one's device round-trip (or
+# GIL-dropping crunch) is still running — the continuous-batching
+# analogue of pipeline depth
+DEFAULT_DEPTH = 2
+
+
+def enabled() -> bool:
+    """TRIVY_TPU_SCHED=0 is the kill switch: scans run the exact
+    per-request ``engine.detect`` path with no scheduler thread."""
+    return os.environ.get(ENV_KILL, "1") != "0"
+
+
+class Overloaded(Exception):
+    """The server sheds this request instead of blocking (503).
+
+    Defined here (not in rpc/server) so the scheduler can shed without
+    importing the HTTP layer; ``trivy_tpu.rpc.server`` re-exports it,
+    so existing ``from trivy_tpu.rpc.server import Overloaded`` callers
+    keep working."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class _Pending:
+    """One submitted request: queries, chunk cursor, result slots."""
+
+    __slots__ = ("queries", "results", "next_row", "inflight", "deadline",
+                 "arrival", "seq", "trace_ctx", "error", "done",
+                 "dispatched_at")
+
+    def __init__(self, queries: list, deadline, seq: int):
+        self.queries = queries
+        self.results: list = [None] * len(queries)
+        self.next_row = 0       # first row not yet dispatched
+        self.inflight = 0       # chunks dispatched, results pending
+        self.deadline = deadline
+        self.arrival = time.monotonic()
+        self.seq = seq
+        # captured so the batch span in the scheduler thread can attach
+        # to this request's trace instead of becoming an orphaned root
+        self.trace_ctx = tracing.capture()
+        self.error: Exception | None = None
+        self.done = threading.Event()
+        self.dispatched_at: float | None = None
+
+    @property
+    def queued_rows(self) -> int:
+        return len(self.queries) - self.next_row
+
+    def sort_key(self) -> tuple:
+        """Oldest-deadline-first, then submission order."""
+        d = self.deadline
+        rem = d.remaining() if d is not None else float("inf")
+        return (rem, self.seq)
+
+
+class MatchScheduler:
+    """Coalesces concurrent detect-phase submissions into shared device
+    micro-batches (class docstring above; knobs: ``--sched-window-ms``,
+    ``--sched-max-rows``).
+
+    `engine_fn` is a zero-arg callable returning the CURRENT engine —
+    the server's advisory-DB hot swap replaces the engine object, and
+    in-flight requests hold the service read lock, so reading it at
+    dispatch time is always consistent."""
+
+    def __init__(self, engine_fn, window_ms: float = DEFAULT_WINDOW_MS,
+                 max_rows: int = DEFAULT_MAX_ROWS,
+                 max_queue: int = DEFAULT_MAX_QUEUE,
+                 chunk_rows: int | None = None,
+                 depth: int = DEFAULT_DEPTH, on_shed=None,
+                 busy_fn=None):
+        self._engine_fn = engine_fn
+        # optional zero-arg callable -> number of in-flight scans (the
+        # server wires its admission counter). When it reports <= 1,
+        # nobody else can submit concurrently, so the coalesce window
+        # is skipped — a lone scan on an idle server pays no added
+        # latency per detect submission. None = always hold the window.
+        self._busy_fn = busy_fn
+        self.window_s = max(float(window_ms), 0.0) / 1000.0
+        self.max_rows = max(int(max_rows), 1)
+        self.chunk_rows = (max(int(chunk_rows), 1) if chunk_rows
+                           else max(self.max_rows // 8, 256))
+        self.max_queue = max(int(max_queue), 1)
+        self.depth = max(int(depth), 1)
+        self.on_shed = on_shed
+        self._cond = threading.Condition()
+        self._waiting: list[_Pending] = []
+        self._seq = 0
+        self._stopping = False
+        # bounds concurrently-in-flight micro-batches to `depth`
+        self._inflight_slots = threading.Semaphore(self.depth)
+        # batches/rows/sheds since start (diagnostics + bench)
+        self.stats = {"batches": 0, "rows": 0, "coalesced": 0, "sheds": 0}
+        self._thread = threading.Thread(
+            target=self._run, name="ttpu-sched", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, queries: list) -> list:
+        """Coalesced replacement for ``engine.detect``: blocks until the
+        shared micro-batches carrying this request's rows complete.
+        Byte-identical to a private ``engine.detect(queries)`` call."""
+        if not queries:
+            return []
+        direct = False
+        for rule in faults.fire("sched.submit"):
+            if rule.action == "delay":
+                time.sleep(rule.param if rule.param is not None else 0.002)
+            elif rule.action == "drop":
+                direct = True
+            elif rule.action == "error":
+                self._count_shed()
+                raise Overloaded("injected sched.submit overload",
+                                 retry_after=1.0)
+        if direct:
+            # the scheduler lane is "dropped" for this submission: fall
+            # back to the private per-request dispatch — no coalescing,
+            # identical bytes
+            return self._engine_fn().detect(list(queries))
+        with tracing.span("sched.enqueue", rows=len(queries)):
+            p = self._enqueue(queries)
+            self._await(p)
+        if p.error is not None:
+            raise p.error
+        return p.results
+
+    def _count_shed(self) -> None:
+        self.stats["sheds"] += 1
+        if self.on_shed is not None:
+            self.on_shed()
+
+    def _enqueue(self, queries: list) -> _Pending:
+        deadline = current_deadline()
+        with self._cond:
+            if self._stopping or not self._thread.is_alive():
+                self._count_shed()
+                raise Overloaded(
+                    "match scheduler stopped (server shutting down)",
+                    retry_after=2.0)
+            if len(self._waiting) >= self.max_queue:
+                self._count_shed()
+                raise Overloaded(
+                    f"match scheduler overloaded "
+                    f"({len(self._waiting)} requests queued)",
+                    retry_after=1.0)
+            self._seq += 1
+            p = _Pending(list(queries), deadline, self._seq)
+            self._waiting.append(p)
+            obs_metrics.SCHED_QUEUE_DEPTH.set(len(self._waiting))
+            self._cond.notify_all()
+        return p
+
+    def _await(self, p: _Pending) -> None:
+        while not p.done.is_set():
+            if not self._thread.is_alive():
+                # the scheduler thread died (should not happen; a batch
+                # failure is isolated per slice) — do not hang the
+                # request, and free its bounded-queue slot so queue
+                # depth cannot climb with unreachable entries
+                with self._cond:
+                    if p in self._waiting:
+                        self._waiting.remove(p)
+                        obs_metrics.SCHED_QUEUE_DEPTH.set(
+                            len(self._waiting))
+                if p.error is None:
+                    p.error = RuntimeError("match scheduler thread died")
+                return
+            d = p.deadline
+            if d is None:
+                p.done.wait(0.5)
+                continue
+            rem = d.remaining()
+            if rem > 0:
+                p.done.wait(min(rem + 0.001, 0.5))
+                continue
+            # budget expired: shed the rows still queued. Rows already
+            # in flight are awaited below — their batch is running and
+            # cannot be recalled, and the driver's next deadline
+            # checkpoint sheds the scan right after.
+            with self._cond:
+                if not p.done.is_set() and p.queued_rows:
+                    self._waiting.remove(p)
+                    obs_metrics.SCHED_QUEUE_DEPTH.set(len(self._waiting))
+                    p.error = Overloaded(
+                        f"deadline budget of {d.budget_s:.3f}s expired "
+                        "while queued in the match scheduler",
+                        retry_after=1.0)
+                    self._count_shed()
+                    p.done.set()
+                    return
+            p.done.wait(0.5)
+
+    # --------------------------------------------------------- scheduler
+
+    def _run(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self.depth <= 1:
+            while True:
+                batch = self._compose()
+                if batch is None:
+                    return
+                self._dispatch(*batch)
+        # depth > 1: the compose loop keeps cutting batches while up to
+        # `depth` dispatches run — batch N+1 encodes and dispatches
+        # while batch N's device round-trip / GIL-dropping crunch is
+        # still in flight
+        pool = ThreadPoolExecutor(self.depth,
+                                  thread_name_prefix="ttpu-sched-d")
+        try:
+            while True:
+                batch = self._compose()
+                if batch is None:
+                    return
+                if not batch[0]:
+                    continue
+                self._inflight_slots.acquire()
+                pool.submit(self._dispatch_slot, *batch)
+        finally:
+            pool.shutdown(wait=True)
+
+    def _dispatch_slot(self, parts, rows: int) -> None:
+        try:
+            self._dispatch(parts, rows)
+        finally:
+            self._inflight_slots.release()
+
+    def _compose(self):
+        """Block until work is queued, hold the coalesce window open,
+        then cut a fairness-interleaved batch. -> (parts, rows) with
+        parts = [(pending, lo, hi)], or None when stopped and drained."""
+        with self._cond:
+            while not self._waiting:
+                if self._stopping:
+                    return None
+                self._cond.wait(0.5)
+            # coalesce window: measured from the oldest queued
+            # submission so a request never waits more than window_s
+            # before its first chunk is eligible
+            end = min(p.arrival for p in self._waiting) + self.window_s
+            while (not self._stopping
+                   and sum(p.queued_rows for p in self._waiting)
+                   < self.max_rows):
+                if self._busy_fn is not None and self._busy_fn() <= 1:
+                    # a lone in-flight scan: nothing else can submit,
+                    # holding the window would only add latency
+                    break
+                left = end - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(left)
+                if not self._waiting:
+                    # everything shed while we coalesced
+                    return ([], 0)
+            # fairness: oldest-deadline-first order, one chunk per
+            # request per round, so a huge image shares every batch
+            # with the small ones queued beside it
+            order = sorted(self._waiting, key=_Pending.sort_key)
+            parts: list[tuple[_Pending, int, int]] = []
+            rows = 0
+            progressed = True
+            while rows < self.max_rows and progressed:
+                progressed = False
+                for p in order:
+                    if rows >= self.max_rows:
+                        break
+                    if not p.queued_rows:
+                        continue
+                    lo = p.next_row
+                    hi = min(lo + self.chunk_rows, len(p.queries),
+                             lo + (self.max_rows - rows))
+                    p.next_row = hi
+                    p.inflight += 1
+                    if p.dispatched_at is None:
+                        p.dispatched_at = time.monotonic()
+                        obs_metrics.SCHED_WAIT_SECONDS.observe(
+                            p.dispatched_at - p.arrival)
+                    parts.append((p, lo, hi))
+                    rows += hi - lo
+                    progressed = True
+            # fully-dispatched requests leave the queue; they complete
+            # from the dispatch path when their in-flight chunks land
+            self._waiting = [p for p in self._waiting if p.queued_rows]
+            obs_metrics.SCHED_QUEUE_DEPTH.set(len(self._waiting))
+            return (parts, rows)
+
+    def _dispatch(self, parts, rows: int) -> None:
+        if not parts:
+            return
+        lists = [p.queries[lo:hi] for p, lo, hi in parts]
+        n_req = len({id(p) for p, _lo, _hi in parts})
+        lead = parts[0][0]
+        part_errors: list[Exception | None] = [None] * len(parts)
+        res_lists: list = [None] * len(parts)
+        fatal = None
+        try:
+            # the batch span adopts the oldest coalesced request's
+            # captured context: batch timing stays visible inside that
+            # request's trace instead of orphaning on this thread
+            with tracing.adopt(lead.trace_ctx):
+                with tracing.span("sched.batch", rows=rows,
+                                  requests=n_req):
+                    res_lists = self._engine_fn().submit(lists)
+        except Exception as exc:
+            # fault isolation: re-dispatch each coalesced slice
+            # PRIVATELY so one request's poison queries fail only that
+            # request — per-request-path parity, not collateral 500s
+            _log.warn("sched batch failed; re-dispatching slices "
+                      "per-request", err=str(exc))
+            for i, qs in enumerate(lists):
+                try:
+                    res_lists[i] = self._engine_fn().detect(list(qs))
+                except Exception as solo_exc:
+                    part_errors[i] = solo_exc
+        except BaseException as exc:  # injected kill / interpreter exit
+            err = RuntimeError(f"scheduler batch aborted: {exc!r}")
+            part_errors = [err] * len(parts)
+            fatal = exc
+        obs_metrics.SCHED_BATCH_ROWS.observe(rows)
+        obs_metrics.SCHED_COALESCED.observe(n_req)
+        done_now: list[_Pending] = []
+        with self._cond:
+            self.stats["batches"] += 1
+            self.stats["rows"] += rows
+            self.stats["coalesced"] = max(self.stats["coalesced"], n_req)
+            for i, (p, lo, hi) in enumerate(parts):
+                p.inflight -= 1
+                if part_errors[i] is not None:
+                    if p.error is None:
+                        p.error = part_errors[i]
+                    # nothing more to schedule for a failed request;
+                    # queued_rows drops to 0 so done fires when its
+                    # other in-flight chunks land
+                    p.next_row = len(p.queries)
+                else:
+                    p.results[lo:hi] = res_lists[i]
+                if p.inflight == 0 and not p.queued_rows \
+                        and not p.done.is_set():
+                    done_now.append(p)
+            if any(e is not None for e in part_errors):
+                self._waiting = [p for p in self._waiting
+                                 if p.queued_rows]
+                obs_metrics.SCHED_QUEUE_DEPTH.set(len(self._waiting))
+        for p in done_now:
+            p.done.set()
+        if fatal is not None:
+            raise fatal
+
+    # ---------------------------------------------------------- lifecycle
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop admitting new submissions, finish the queued-and-
+        admitted work (drain semantics), stop the scheduler thread."""
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+
+
+class SchedEngine:
+    """Detect-phase engine facade for ``LocalDriver``: ``detect()``
+    routes through the shared scheduler's coalesced micro-batches;
+    every other attribute (``db``, ``cdb``, ...) reads through to the
+    real engine."""
+
+    __slots__ = ("_engine", "_scheduler")
+
+    def __init__(self, engine, scheduler: MatchScheduler):
+        self._engine = engine
+        self._scheduler = scheduler
+
+    def detect(self, queries: list) -> list:
+        return self._scheduler.submit(queries)
+
+    def __getattr__(self, name: str):
+        return getattr(self._engine, name)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import re
+import threading
 from dataclasses import dataclass, field
 from typing import Literal
 
@@ -31,6 +32,22 @@ from trivy_tpu.secret.rules import (
 from trivy_tpu.types.artifact import Secret, SecretFinding
 
 _log = logger("secret")
+
+# one-shot per-process hybrid probe verdict: {"device": bool, "reason",
+# "device_s", "host_s"} once measured; None = not probed yet. The probe
+# decides whether hybrid mode's device share is worth dispatching at
+# all on THIS process's accelerator (a tunneled chip has benched at
+# 0.01x the native host path — splitting bytes to it then only slows
+# the scan down).
+_HYBRID_PROBE: dict | None = None
+_HYBRID_PROBE_LOCK = threading.Lock()
+
+
+def reset_hybrid_probe() -> None:
+    """Forget the cached hybrid-probe verdict (tests)."""
+    global _HYBRID_PROBE
+    with _HYBRID_PROBE_LOCK:
+        _HYBRID_PROBE = None
 
 
 @dataclass
@@ -263,10 +280,14 @@ class SecretScanner:
             return self._scan_files_host(eligible)
         self._ensure_tiers()
         if use_device == "hybrid":
-            if self._accel_backend():
+            if self._accel_backend() and self._hybrid_device_ok():
                 return self._scan_files_hybrid(eligible)
-            # no accelerator: the "device" share would run on the jax
-            # CPU backend, strictly slower than the native-AC host path
+            # no accelerator (the "device" share would run on the jax
+            # CPU backend, strictly slower than the native-AC host
+            # path), or the one-shot probe measured the device screen
+            # slower than the host — fall back to host; the probe
+            # stamped the choice in a debug log instead of silently
+            # crawling
             return self._scan_files_host(eligible)
         try:
             return self._scan_files_device(eligible)
@@ -287,6 +308,82 @@ class SecretScanner:
 
         return accel_backend()
 
+    def _effective_device_share(self) -> float:
+        """The byte fraction the hybrid split actually hands the device
+        (env override honored) — the probe must judge the SAME split
+        the scan will run."""
+        try:
+            share = float(os.environ.get(
+                "TRIVY_TPU_SECRET_DEVICE_SHARE",
+                self.HYBRID_DEVICE_SHARE))
+        except ValueError:
+            _log.warn("invalid TRIVY_TPU_SECRET_DEVICE_SHARE; using default")
+            share = self.HYBRID_DEVICE_SHARE
+        return max(min(share, 1.0), 0.0)
+
+    def _hybrid_device_ok(self) -> bool:
+        """Should hybrid mode dispatch its device share at all? One-shot
+        per-process probe: times the device anchor screen against the
+        native host path on a small synthetic corpus and falls back to
+        host when the device is unavailable OR measurably slower. The
+        verdict is cached for the process and stamped in a debug log.
+        TRIVY_TPU_SECRET_PROBE=0 skips the probe (always keep the
+        device share — the pre-probe behavior)."""
+        if os.environ.get("TRIVY_TPU_SECRET_PROBE", "1") == "0":
+            return True
+        global _HYBRID_PROBE
+        with _HYBRID_PROBE_LOCK:
+            if _HYBRID_PROBE is None:
+                _HYBRID_PROBE = self._run_hybrid_probe()
+            return _HYBRID_PROBE["device"]
+
+    # extra margin on the probe's hybrid-helps bar ("measurably
+    # slower" = beyond it): the share-weighted device time must beat
+    # the host's full-scan time by at least this factor
+    HYBRID_PROBE_SLACK = 1.25
+
+    def _run_hybrid_probe(self) -> dict:
+        import time as _time
+
+        # deterministic kernel-tree-shaped probe corpus, ~500 KB so
+        # per-batch dispatch overhead does not drown throughput (a
+        # throughput-strong chip with high fixed dispatch cost must
+        # not lose its share to a too-tiny sample)
+        line = (b"static int cfg_%d(struct s *p) { return probe(p, %d); }"
+                b"\n/* tokens */\n")
+        corpus = [(i, f"probe/f{i}.c", b"".join(line % (j, i)
+                                                for j in range(300)))
+                  for i in range(24)]
+        try:
+            self._scan_files_device(corpus)  # warm (jit compile)
+            t0 = _time.perf_counter()
+            self._scan_files_device(corpus)
+            dev_s = _time.perf_counter() - t0
+        except Exception as exc:  # noqa: BLE001 — unavailable -> host
+            _log.debug("secret hybrid probe: device screen unavailable; "
+                       "hybrid falls back to host", err=str(exc))
+            return {"device": False, "reason": f"unavailable: {exc}",
+                    "device_s": None, "host_s": None}
+        t0 = _time.perf_counter()
+        self._scan_files_host(corpus)
+        host_s = _time.perf_counter() - t0
+        # the hybrid split hands the device only its effective share of
+        # the bytes while the host scans the rest concurrently, so the
+        # device share helps wall-clock when share x dev_s stays within
+        # the host's full-scan time (see _scan_files_hybrid); the slack
+        # TIGHTENS the bar (borderline devices fall back) — NOT
+        # full-serial parity
+        device = dev_s * self._effective_device_share() \
+            * self.HYBRID_PROBE_SLACK <= host_s
+        _log.debug(
+            "secret hybrid probe",
+            device_ms=round(dev_s * 1e3, 2), host_ms=round(host_s * 1e3, 2),
+            choice="hybrid" if device else "host",
+            reason="device share pays for itself" if device
+            else "device measurably slower than its share repays")
+        return {"device": device,
+                "reason": "probe", "device_s": dev_s, "host_s": host_s}
+
     def _scan_files_hybrid(self, eligible) -> list[Secret]:
         """Split the corpus by bytes between the device screen and the
         host AC path, DISPATCH-FIRST: every device batch is enqueued
@@ -298,14 +395,7 @@ class SecretScanner:
         host's scan time — the honest way a tunneled single-chip
         sidecar speeds up a CPU-bound scan."""
         total = sum(len(c) for (_i, _p, c) in eligible) or 1
-        try:
-            share = float(os.environ.get(
-                "TRIVY_TPU_SECRET_DEVICE_SHARE",
-                self.HYBRID_DEVICE_SHARE))
-        except ValueError:
-            _log.warn("invalid TRIVY_TPU_SECRET_DEVICE_SHARE; using default")
-            share = self.HYBRID_DEVICE_SHARE
-        budget = total * max(min(share, 1.0), 0.0)
+        budget = total * self._effective_device_share()
         dev_part: list = []
         host_part: list = []
         acc = 0

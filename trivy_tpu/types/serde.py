@@ -9,18 +9,32 @@ import types as _pytypes
 import typing
 
 
+# (class -> resolved type hints) memo: get_type_hints re-compiles every
+# stringified annotation on every call, which dominated server-side blob
+# decoding (~1s per 1k-package artifact); hints are per-class constants
+_HINTS: dict[type, dict] = {}
+_FIELDS: dict[type, tuple] = {}
+
+
 def from_dict(cls, d):
     """Rebuild a dataclass (recursively) from an asdict() dict."""
     if d is None:
         return None
     if not dataclasses.is_dataclass(cls):
         return d
-    hints = typing.get_type_hints(cls)
+    hints = _HINTS.get(cls)
+    if hints is None:
+        hints = typing.get_type_hints(cls)
+        # _FIELDS publishes first: a concurrent decoder that sees the
+        # _HINTS entry must never miss the fields entry (GIL-atomic
+        # dict stores; no lock needed for idempotent values)
+        _FIELDS[cls] = tuple(f.name for f in dataclasses.fields(cls))
+        _HINTS[cls] = hints
     kwargs = {}
-    for f in dataclasses.fields(cls):
-        if f.name not in d:
+    for name in _FIELDS[cls]:
+        if name not in d:
             continue
-        kwargs[f.name] = _convert(hints.get(f.name), d[f.name])
+        kwargs[name] = _convert(hints.get(name), d[name])
     return cls(**kwargs)
 
 
