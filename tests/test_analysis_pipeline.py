@@ -1,0 +1,654 @@
+"""Streaming, fleet-deduped artifact analysis (tier-1-safe, CPU-only):
+
+- walk_layer_tar stream mode: gunzip-on-the-fly parity with the bytes
+  path
+- pipelined layer fetch/analyze: zero-finding-diff vs the serial path
+  (TRIVY_TPU_ANALYSIS_PIPELINE=0), including under analysis.fetch
+  drop/delay/error faults
+- content-addressed cross-image layer dedupe + in-process singleflight:
+  N concurrent scans sharing a base layer analyze it exactly once
+- RedisCache-vs-FSCache dedupe parity (fake redis)
+- journal per-layer records: a --resume'd fleet skips deduped layers,
+  subprocess SIGKILL mid-analysis resumes byte-identically
+- server-side MissingBlobs gate: a second client waits on the first
+  client's in-flight layer instead of re-analyzing it
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import threading
+import time
+
+import pytest
+
+from trivy_tpu.artifact.image import ImageArtifact
+from trivy_tpu.cache.cache import FSCache, MemoryCache
+from trivy_tpu.db import Advisory, AdvisoryDB
+from trivy_tpu.db.model import VulnerabilityMeta
+from trivy_tpu.fanal import pipeline
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.resilience import faults
+
+pytestmark = pytest.mark.fanal
+
+OS_RELEASE = 'ID=alpine\nVERSION_ID=3.18.0\nPRETTY_NAME="Alpine"\n'
+
+APK_INSTALLED = """\
+P:musl
+V:1.2.4-r0
+A:x86_64
+
+P:busybox
+V:1.36.1-r4
+A:x86_64
+"""
+
+PACKAGE_LOCK = json.dumps({
+    "name": "a", "lockfileVersion": 2, "requires": True,
+    "packages": {"": {"name": "a"},
+                 "node_modules/lodash": {"version": "4.17.4"}},
+})
+
+
+def _fixture_db() -> AdvisoryDB:
+    db = AdvisoryDB()
+    db.put_advisory("alpine 3.18", "musl", Advisory(
+        vulnerability_id="CVE-2025-1000", fixed_version="1.2.5-r0"))
+    db.put_advisory("npm::g", "lodash", Advisory(
+        vulnerability_id="CVE-2019-10744", vulnerable_versions=["<4.17.12"]))
+    db.put_meta(VulnerabilityMeta(id="CVE-2019-10744", severity="CRITICAL",
+                                  title="Prototype Pollution"))
+    return db
+
+
+def _mk_layer(files: dict[str, bytes], gz: bool = False) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for path, content in files.items():
+            info = tarfile.TarInfo(path)
+            info.size = len(content)
+            tf.addfile(info, io.BytesIO(content))
+    raw = buf.getvalue()
+    return gzip.compress(raw, mtime=0) if gz else raw
+
+
+def _diff_id(layer: bytes) -> str:
+    raw = gzip.decompress(layer) if layer[:2] == b"\x1f\x8b" else layer
+    return "sha256:" + hashlib.sha256(raw).hexdigest()
+
+
+def _mk_image_tar(path, layers: list[bytes], repo_tag="demo:latest"):
+    diff_ids = [_diff_id(l) for l in layers]
+    config = {
+        "architecture": "amd64", "os": "linux",
+        "rootfs": {"type": "layers", "diff_ids": diff_ids},
+        "history": [{"created_by": f"layer-{i}"}
+                    for i in range(len(layers))],
+    }
+    cfg_raw = json.dumps(config).encode()
+    cfg_name = hashlib.sha256(cfg_raw).hexdigest() + ".json"
+    manifest = [{
+        "Config": cfg_name,
+        "RepoTags": [repo_tag],
+        "Layers": [f"layer{i}/layer.tar" for i in range(len(layers))],
+    }]
+    with tarfile.open(path, "w") as tf:
+        def add(name, content):
+            info = tarfile.TarInfo(name)
+            info.size = len(content)
+            tf.addfile(info, io.BytesIO(content))
+        add(cfg_name, cfg_raw)
+        for i, l in enumerate(layers):
+            add(f"layer{i}/layer.tar", l)
+        add("manifest.json", json.dumps(manifest).encode())
+
+
+BASE_LAYER = _mk_layer({
+    "etc/os-release": OS_RELEASE.encode(),
+    "lib/apk/db/installed": APK_INSTALLED.encode(),
+}, gz=True)
+
+
+def _mk_registry(tmp_path, n_images: int = 3) -> list[str]:
+    """n images sharing one gzipped base layer + one unique app layer
+    each (the realistic-crawl shape: shared distro base, unique app)."""
+    out = []
+    for k in range(n_images):
+        app = _mk_layer({
+            f"app{k}/package-lock.json": PACKAGE_LOCK.encode(),
+            f"app{k}/note.txt": f"image {k}".encode(),
+        })
+        p = str(tmp_path / f"img{k}.tar")
+        _mk_image_tar(p, [BASE_LAYER, app], repo_tag=f"demo{k}:latest")
+        out.append(p)
+    return out
+
+
+@pytest.fixture()
+def env(tmp_path, monkeypatch):
+    _fixture_db().save(str(tmp_path / "db"))
+    monkeypatch.setenv("TRIVY_TPU_FAKE_TIME", "2024-01-01T00:00:00+00:00")
+    monkeypatch.setenv("TRIVY_TPU_DETERMINISTIC_UUID", "1")
+    monkeypatch.delenv("TRIVY_TPU_ANALYSIS_PIPELINE", raising=False)
+    from trivy_tpu.cli import run as run_mod
+    from trivy_tpu.utils import uuid as uuid_util
+
+    run_mod._ENGINE_CACHE.clear()
+    uuid_util.reset()
+    faults.reset()
+    yield tmp_path
+    faults.reset()
+
+
+def _counters() -> tuple[float, float, float]:
+    return (obs_metrics.LAYERS_ANALYZED.value(),
+            obs_metrics.LAYER_DEDUPE_HITS.value(),
+            obs_metrics.LAYER_DEDUPE_INFLIGHT_WAITS.value())
+
+
+def _delta(base) -> tuple[float, float, float]:
+    now = _counters()
+    return tuple(n - b for n, b in zip(now, base))
+
+
+# --------------------------------------------------- streaming walker
+
+
+def test_walk_layer_tar_stream_matches_bytes():
+    from trivy_tpu.fanal.walker import walk_layer_tar
+
+    layer = _mk_layer({
+        "etc/os-release": OS_RELEASE.encode(),
+        "a/.wh.gone.txt": b"",
+        "b/.wh..wh..opq": b"",
+        "app/x.txt": b"x" * 4096,
+    }, gz=True)
+    fb, ob, wb = walk_layer_tar(gzip.decompress(layer))
+    fs, os_, ws = walk_layer_tar(io.BytesIO(layer))  # gz stream
+    assert [(f.path, f.read()) for f in fb] == \
+        [(f.path, f.read()) for f in fs]
+    assert (ob, wb) == (os_, ws)
+
+
+def test_tarimage_layer_stream_is_compressed_member(tmp_path):
+    """layer_stream hands over the raw (still gzipped) member: the
+    decompressed copy `layer_bytes` materializes never exists on the
+    streaming path."""
+    from trivy_tpu.artifact.image import TarImage
+
+    p = str(tmp_path / "img.tar")
+    _mk_image_tar(p, [BASE_LAYER])
+    img = TarImage(p)
+    try:
+        raw = img.layer_stream(0).read()
+        assert raw[:2] == b"\x1f\x8b"            # still compressed
+        assert gzip.decompress(raw) == img.layer_bytes(0)
+        assert len(raw) < len(img.layer_bytes(0))
+    finally:
+        img.close()
+
+
+# ----------------------------------------------- pipelined scan parity
+
+
+def _inspect(tar_path, cache, **kw):
+    art = ImageArtifact(tar_path, cache, from_tar=True, **kw)
+    ref = art.inspect()
+    blobs = [cache.get_blob(b) for b in ref.blob_ids]
+    return art, ref, blobs
+
+
+def test_pipelined_parity_vs_serial_oracle(env, tmp_path, monkeypatch):
+    """Pipelined+deduped scans of overlapping images produce blob docs
+    and references byte-identical to the serial undeduped path."""
+    imgs = _mk_registry(tmp_path, 3)
+
+    monkeypatch.setenv("TRIVY_TPU_ANALYSIS_PIPELINE", "0")
+    serial = [_inspect(p, MemoryCache()) for p in imgs]
+    monkeypatch.setenv("TRIVY_TPU_ANALYSIS_PIPELINE", "1")
+    base = _counters()
+    cache = MemoryCache()
+    piped = [_inspect(p, cache) for p in imgs]
+
+    for (_, sref, sblobs), (_, pref, pblobs) in zip(serial, piped):
+        assert sref.id == pref.id and sref.blob_ids == pref.blob_ids
+        assert json.dumps(sblobs, sort_keys=True) == \
+            json.dumps(pblobs, sort_keys=True)
+    analyzed, hits, _ = _delta(base)
+    # 3 images x 2 layers, base shared: 4 unique analyses, 2 dedupe hits
+    assert analyzed == 4
+    assert hits == 2
+    # per-scan stats recorded on the artifact
+    assert piped[0][0].last_analysis_stats["analyzed"] == 2
+    assert piped[2][0].last_analysis_stats["deduped"] == 1
+    assert 0.0 < piped[0][0].last_analysis_stats["occupancy"] <= 1.0
+    # occupancy gauge published
+    assert 0.0 < obs_metrics.ANALYSIS_PIPELINE_OCCUPANCY.value() <= 1.0
+
+
+def test_kill_switch_disables_pipeline_and_dedupe(env, tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_ANALYSIS_PIPELINE", "0")
+    imgs = _mk_registry(tmp_path, 2)
+    base = _counters()
+    art, _, _ = _inspect(imgs[0], MemoryCache())
+    assert art.last_analysis_stats == {}         # serial path untouched
+    _, hits, waits = _delta(base)
+    assert hits == 0 and waits == 0
+    assert pipeline.SINGLEFLIGHT.inflight() == 0
+
+
+def test_second_scan_of_cached_set_is_all_dedupe_hits(env, tmp_path):
+    imgs = _mk_registry(tmp_path, 3)
+    cache = MemoryCache()
+    for p in imgs:
+        _inspect(p, cache)
+    base = _counters()
+    for p in imgs:
+        _inspect(p, cache)
+    analyzed, hits, _ = _delta(base)
+    assert analyzed == 0
+    assert hits == 6                             # every layer a hit
+
+
+def test_duplicate_diffids_match_serial_last_write(env, tmp_path,
+                                                   monkeypatch):
+    """An image listing the same diffID twice: serial analyzes both
+    occurrences and the last write wins (created_by = history[last]);
+    the deduped path must produce the identical blob document."""
+    layer = _mk_layer({"etc/os-release": OS_RELEASE.encode()})
+    p = str(tmp_path / "dup.tar")
+    _mk_image_tar(p, [layer, layer])
+    monkeypatch.setenv("TRIVY_TPU_ANALYSIS_PIPELINE", "0")
+    _, sref, sblobs = _inspect(p, MemoryCache())
+    monkeypatch.setenv("TRIVY_TPU_ANALYSIS_PIPELINE", "1")
+    art, pref, pblobs = _inspect(p, MemoryCache())
+    assert sref.blob_ids == pref.blob_ids
+    assert json.dumps(sblobs, sort_keys=True) == \
+        json.dumps(pblobs, sort_keys=True)
+    assert sblobs[0]["created_by"] == "layer-1"   # last occurrence wins
+    assert art.last_analysis_stats["analyzed"] == 1
+
+
+def test_fetch_faults_drop_delay_error_parity(env, tmp_path, monkeypatch):
+    imgs = _mk_registry(tmp_path, 2)
+    oracle = [_inspect(p, MemoryCache())[2] for p in imgs]
+
+    for spec in ("analysis.fetch:drop@1",
+                 "analysis.fetch:delay=0.01@2",
+                 "analysis.fetch:error@1",
+                 "analysis.fetch:drop@1;analysis.fetch:error@3"):
+        faults.install_spec(spec)
+        try:
+            got = [_inspect(p, MemoryCache())[2] for p in imgs]
+        finally:
+            faults.reset()
+        assert json.dumps(got, sort_keys=True) == \
+            json.dumps(oracle, sort_keys=True), spec
+
+
+def test_fetch_error_twice_fails_scan_and_releases_claims(env, tmp_path):
+    imgs = _mk_registry(tmp_path, 1)
+    faults.install_spec("analysis.fetch:error")   # every fetch fails
+    try:
+        with pytest.raises(pipeline.AnalysisFetchError):
+            _inspect(imgs[0], MemoryCache())
+    finally:
+        faults.reset()
+    # the failed scan released its singleflight claims
+    assert pipeline.SINGLEFLIGHT.inflight() == 0
+    # and a faultless retry succeeds
+    _inspect(imgs[0], MemoryCache())
+
+
+# -------------------------------------------------------- singleflight
+
+
+def test_concurrent_scans_analyze_shared_layer_exactly_once(env, tmp_path):
+    """Two scans racing on a shared base layer: the follower waits on
+    the leader's BlobInfo instead of re-walking the layer."""
+    imgs = _mk_registry(tmp_path, 2)
+    cache = FSCache(str(tmp_path / "cache"))
+    orig = ImageArtifact._inspect_layer
+    walked: list[str] = []
+    walked_lock = threading.Lock()
+
+    def slow_inspect(self, group, img, i, diff_id, blob_id, layer=None):
+        with walked_lock:
+            walked.append(blob_id)
+        if i == 0:
+            time.sleep(0.3)      # hold the base layer in flight
+        return orig(self, group, img, i, diff_id, blob_id, layer=layer)
+
+    base = _counters()
+    errs: list[BaseException] = []
+    blobs_by_thread: dict[str, list] = {}
+
+    def scan(p):
+        try:
+            _, ref, blobs = _inspect(p, cache)
+            blobs_by_thread[p] = blobs
+        except BaseException as e:  # surfaced below
+            errs.append(e)
+
+    ImageArtifact._inspect_layer = slow_inspect
+    try:
+        threads = [threading.Thread(target=scan, args=(p,)) for p in imgs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        ImageArtifact._inspect_layer = orig
+    assert not errs, errs
+    # base layer walked once, unique app layers once each
+    assert len(walked) == 3
+    assert len(set(walked)) == 3
+    analyzed, hits, waits = _delta(base)
+    assert analyzed == 3 and hits == 1 and waits >= 1
+    # both scans see the complete layer set
+    for p in imgs:
+        assert all(b for b in blobs_by_thread[p])
+
+
+def test_singleflight_leader_failure_hands_off():
+    sf = pipeline.LayerSingleflight()
+    slot, leader = sf.claim("b1")
+    assert leader
+    got = {}
+
+    def follower():
+        s2, lead2 = sf.claim("b1")
+        assert not lead2
+        s2.event.wait(10)
+        got["ok"] = s2.ok
+        # leader failed: the follower re-claims and leads
+        _s3, lead3 = sf.claim("b1")
+        got["lead"] = lead3
+
+    t = threading.Thread(target=follower)
+    t.start()
+    time.sleep(0.05)
+    sf.finish("b1", slot, ok=False)              # leader dies
+    t.join(timeout=10)
+    assert got == {"ok": False, "lead": True}
+    assert sf.inflight() == 1                    # follower's new claim
+    s3, _ = sf.claim("b1")
+    sf.finish("b1", s3, ok=False)
+
+
+def test_singleflight_reclaim_releases_ghost_waiters():
+    """A timed-out waiter takes a ghost claim over: the stale slot's
+    waiters are released and later callers park on the fresh claim."""
+    sf = pipeline.LayerSingleflight(ttl_s=300)
+    sf.claim("b1")                               # ghost leader
+    got = {}
+
+    def waiter():
+        s, lead = sf.claim("b1")
+        assert not lead
+        s.event.wait(10)
+        got["ok"] = s.ok
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    sf.reclaim("b1")
+    t.join(timeout=10)
+    assert got == {"ok": False}                  # ghost waiter released
+    assert sf.inflight() == 1                    # fresh live claim
+    sf.complete("b1")
+    assert sf.inflight() == 0
+
+
+def test_singleflight_ttl_expires_dead_leader():
+    sf = pipeline.LayerSingleflight(ttl_s=0.05)
+    slot, leader = sf.claim("b1")
+    assert leader
+    time.sleep(0.1)
+    slot2, leader2 = sf.claim("b1")              # stale claim taken over
+    assert leader2 and slot2 is not slot
+    assert slot.event.is_set()                   # old waiters released
+    sf.complete("b1")
+    assert sf.inflight() == 0
+
+
+# --------------------------------------------------- redis/fs parity
+
+
+def test_redis_vs_fs_dedupe_parity(env, tmp_path, fake_redis):
+    from trivy_tpu.cache.redis import RedisCache
+
+    imgs = _mk_registry(tmp_path, 2)
+    fs = FSCache(str(tmp_path / "cache"))
+    rd = RedisCache(fake_redis)
+    fs_refs = [_inspect(p, fs) for p in imgs]
+    rd_refs = [_inspect(p, rd) for p in imgs]
+    for (_, fref, fblobs), (_, rref, rblobs) in zip(fs_refs, rd_refs):
+        assert fref.blob_ids == rref.blob_ids
+        assert json.dumps(fblobs, sort_keys=True) == \
+            json.dumps(rblobs, sort_keys=True)
+    # both backends dedupe the shared base on a re-scan: 100% hits
+    for cache in (fs, rd):
+        base = _counters()
+        for p in imgs:
+            _inspect(p, cache)
+        analyzed, hits, _ = _delta(base)
+        assert analyzed == 0 and hits == 4
+
+
+# ----------------------------------------------------- server gate
+
+
+def test_server_missing_blobs_gate_waits_on_inflight(monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_SCHED", "0")
+    from trivy_tpu.rpc.server import ScanService
+
+    svc = ScanService(None, MemoryCache())
+    # client A: leader for b1 — told to analyze it
+    assert svc.filter_inflight_blobs(["b1"]) == ["b1"]
+    got = {}
+
+    def client_b():
+        got["missing"] = svc.filter_inflight_blobs(["b1", "b2"])
+
+    t = threading.Thread(target=client_b)
+    t.start()
+    time.sleep(0.1)
+    # client A's analysis lands (the PutBlob handler path)
+    svc.cache.put_blob("b1", {"schema_version": 2})
+    svc.layer_gate.complete("b1")
+    t.join(timeout=30)
+    # b1 deduped (analyzed by A), b2 claimed by B
+    assert got["missing"] == ["b2"]
+    svc.layer_gate.complete("b2")
+
+
+def test_server_gate_timeout_falls_back_to_analyze(monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_SCHED", "0")
+    from trivy_tpu.rpc.server import ScanService
+
+    svc = ScanService(None, MemoryCache())
+    monkeypatch.setattr(pipeline, "SERVER_WAIT_BUDGET_S", 0.05)
+    assert svc.filter_inflight_blobs(["b1"]) == ["b1"]
+    # leader never completes: the second client times out and analyzes
+    assert svc.filter_inflight_blobs(["b1"]) == ["b1"]
+
+
+def test_server_gate_retried_request_releads_own_claims(monkeypatch):
+    """A resent MissingBlobs (lost response -> client retry) must not
+    park on its own first attempt's claims: the scan's trace id
+    identifies the holder and re-leads idempotently."""
+    monkeypatch.setenv("TRIVY_TPU_SCHED", "0")
+    from trivy_tpu.rpc.server import ScanService
+
+    svc = ScanService(None, MemoryCache())
+    t0 = time.monotonic()
+    assert svc.filter_inflight_blobs(["b1"], holder="trace1") == ["b1"]
+    assert svc.filter_inflight_blobs(["b1"], holder="trace1") == ["b1"]
+    assert time.monotonic() - t0 < 1.0           # no self-wait
+    # a different scan still waits (and takes over on timeout)
+    monkeypatch.setattr(pipeline, "SERVER_WAIT_BUDGET_S", 0.05)
+    assert svc.filter_inflight_blobs(["b1"], holder="trace2") == ["b1"]
+    svc.layer_gate.complete("b1")
+
+
+def test_server_gate_duplicate_diffids_do_not_self_wait(monkeypatch):
+    monkeypatch.setenv("TRIVY_TPU_SCHED", "0")
+    from trivy_tpu.rpc.server import ScanService
+
+    svc = ScanService(None, MemoryCache())
+    t0 = time.monotonic()
+    assert svc.filter_inflight_blobs(["b1", "b1"]) == ["b1", "b1"]
+    assert time.monotonic() - t0 < 1.0           # no budget burned
+
+
+# ------------------------------------------------ fleet journal + kill
+
+
+def _fleet_args(env, imgs, extra):
+    return (["image", imgs[0], "--targets", str(env / "targets.txt"),
+             "--format", "json", "--db-path", str(env / "db"),
+             "--cache-dir", str(env / "cache"), "--no-tpu", "--quiet",
+             "--scanners", "vuln"] + extra)
+
+
+@pytest.fixture()
+def fleet_env(env, tmp_path):
+    imgs = _mk_registry(tmp_path, 3)
+    (tmp_path / "targets.txt").write_text("".join(f"{p}\n" for p in imgs))
+    return env, imgs
+
+
+def test_fleet_journal_records_layers_and_resume_skips(fleet_env):
+    from trivy_tpu.cli.main import main
+    from trivy_tpu.durability import ScanJournal
+
+    env, imgs = fleet_env
+    rc = main(_fleet_args(env, imgs, ["--journal", str(env / "j.jsonl"),
+                                      "--output", str(env / "out.json")]))
+    assert rc == 0
+    recs = [json.loads(ln) for ln in
+            (env / "j.jsonl").read_text().splitlines()]
+    layer_recs = [r for r in recs if r["kind"] == "layer"]
+    # 4 unique layers fleet-wide (shared base journaled once)
+    assert len(layer_recs) == 4
+    assert len({r["blob"] for r in layer_recs}) == 4
+    j = ScanJournal.resume(str(env / "j.jsonl"))
+    assert len(j.layers) == 4
+    j.close()
+
+    # resume re-analyzes nothing and appends no duplicate layer records
+    base = _counters()
+    rc = main(_fleet_args(env, imgs, ["--resume", str(env / "j.jsonl"),
+                                      "--output", str(env / "out2.json")]))
+    assert rc == 0
+    assert (env / "out.json").read_bytes() == (env / "out2.json").read_bytes()
+    analyzed, _, _ = _delta(base)
+    assert analyzed == 0
+    recs2 = [json.loads(ln) for ln in
+             (env / "j.jsonl").read_text().splitlines()]
+    assert len([r for r in recs2 if r["kind"] == "layer"]) == 4
+
+
+def test_fleet_parallel_lanes_share_cache_and_dedupe(fleet_env):
+    from trivy_tpu.cli.main import main
+
+    env, imgs = fleet_env
+    base = _counters()
+    rc = main(_fleet_args(env, imgs, ["--fleet-parallel", "3",
+                                      "--output", str(env / "out.json")]))
+    assert rc == 0
+    analyzed, hits, _ = _delta(base)
+    # 6 layer slots, 4 unique: concurrent lanes still analyze each
+    # unique layer exactly once (cache hit or singleflight wait)
+    assert analyzed == 4
+    assert hits == 2
+    doc = json.loads((env / "out.json").read_text())
+    assert len(doc["Reports"]) == 3
+    for rep, p in zip(doc["Reports"], imgs):
+        ids = {v["VulnerabilityID"] for r in rep.get("Results") or []
+               for v in r.get("Vulnerabilities") or []}
+        assert "CVE-2019-10744" in ids, p
+
+
+@pytest.mark.durability
+def test_fleet_sigkill_mid_analysis_resumes_byte_identical(fleet_env):
+    """SIGKILL at the analysis.fetch fault site mid-crawl; --resume
+    replays journaled layers + reports and the merged report is
+    byte-identical to an uninterrupted run's."""
+    from trivy_tpu.cli.main import main
+
+    env, imgs = fleet_env
+    sub_env = dict(
+        os.environ,
+        # image 1 fetches 2 layers; the kill lands on image 2's unique
+        # layer fetch (its base is a cache hit and never fetched)
+        TRIVY_TPU_FAULTS="analysis.fetch:kill@3",
+        TRIVY_TPU_FAKE_TIME="2024-01-01T00:00:00+00:00",
+        TRIVY_TPU_DETERMINISTIC_UUID="1",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + [p for p in (os.environ.get("PYTHONPATH") or "").split(
+                os.pathsep) if p]),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "trivy_tpu.cli.main"]
+        + _fleet_args(env, imgs, ["--journal", str(env / "j.jsonl"),
+                                  "--output", str(env / "out.json")]),
+        env=sub_env, capture_output=True, timeout=180)
+    assert proc.returncode == -9, proc.stderr.decode()   # SIGKILLed
+
+    recs = [json.loads(ln) for ln in
+            (env / "j.jsonl").read_text().splitlines()]
+    kinds = [r["kind"] for r in recs]
+    assert kinds.count("done") == 1              # image 1 durable
+    assert kinds.count("layer") == 2             # its 2 layers journaled
+
+    # resume (no faults) completes the crawl
+    rc = main(_fleet_args(env, imgs, ["--resume", str(env / "j.jsonl"),
+                                      "--output",
+                                      str(env / "resumed.json")]))
+    assert rc == 0
+
+    # golden: uninterrupted fleet, fresh cache/journal
+    from trivy_tpu.cli import run as run_mod
+    from trivy_tpu.utils import uuid as uuid_util
+
+    run_mod._ENGINE_CACHE.clear()
+    uuid_util.reset()
+    rc = main(_fleet_args(env, imgs,
+                          ["--journal", str(env / "golden.jsonl"),
+                           "--output", str(env / "golden.json"),
+                           "--cache-dir", str(env / "cache2")]))
+    assert rc == 0
+    assert (env / "resumed.json").read_bytes() == \
+        (env / "golden.json").read_bytes()
+
+
+def test_fleet_pipeline_kill_switch_byte_identical(fleet_env, monkeypatch):
+    from trivy_tpu.cli.main import main
+
+    env, imgs = fleet_env
+    rc = main(_fleet_args(env, imgs, ["--output", str(env / "on.json")]))
+    assert rc == 0
+    monkeypatch.setenv("TRIVY_TPU_ANALYSIS_PIPELINE", "0")
+    from trivy_tpu.cli import run as run_mod
+    from trivy_tpu.utils import uuid as uuid_util
+
+    run_mod._ENGINE_CACHE.clear()
+    uuid_util.reset()
+    rc = main(_fleet_args(env, imgs, ["--output", str(env / "off.json"),
+                                      "--cache-dir",
+                                      str(env / "cache-serial")]))
+    assert rc == 0
+    assert (env / "on.json").read_bytes() == (env / "off.json").read_bytes()

@@ -144,5 +144,16 @@ class ContainerdImage:
             return gzip.decompress(raw)
         return raw
 
+    def layer_stream(self, i: int):
+        """Content-store blob as an open file: the tar walk's stream
+        mode reads (and gunzips) it incrementally — neither the
+        compressed nor the decompressed layer is fully materialized."""
+        digest = self.layers[i]["digest"]
+        algo, _, hexd = digest.partition(":")
+        path = os.path.join(self.root, CONTENT_DIR, algo, hexd)
+        if not os.path.exists(path):
+            raise ContainerdError(f"blob {digest} not in content store")
+        return open(path, "rb")
+
     def close(self) -> None:
         pass

@@ -20,10 +20,12 @@ import tarfile
 from trivy_tpu.artifact.base import ArtifactReference
 from trivy_tpu.cache.cache import cache_key
 from trivy_tpu.fanal import analyzers  # noqa: F401
+from trivy_tpu.fanal import pipeline
 from trivy_tpu.fanal.analyzer import AnalysisResult, AnalyzerGroup
 from trivy_tpu.fanal.handlers import system_file_filter
 from trivy_tpu.fanal.walker import walk_layer_tar
 from trivy_tpu.log import logger
+from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.types.artifact import ArtifactInfo, Package, Secret
 
 _log = logger("image")
@@ -107,6 +109,13 @@ class TarImage:
     def layer_bytes(self, i: int) -> bytes:
         return _maybe_gunzip(self._read(self.layer_names[i]))
 
+    def layer_stream(self, i: int) -> io.BytesIO:
+        """Layer i as a readable stream of the (possibly still gzipped)
+        member bytes — walk_layer_tar's stream mode gunzips on the fly,
+        so the full decompressed copy `layer_bytes` materializes never
+        exists; peak RSS is the compressed member plus one tar entry."""
+        return io.BytesIO(self._read(self.layer_names[i]))
+
     def close(self) -> None:
         self._tf.close()
 
@@ -139,6 +148,9 @@ class ImageArtifact:
         self.insecure = insecure
         self.username = username
         self.password = password
+        # populated by the pipelined path: layers/analyzed/deduped/
+        # inflight_waits/journal_replayed/occupancy for this scan
+        self.last_analysis_stats: dict = {}
 
     def _group(self) -> AnalyzerGroup:
         group = AnalyzerGroup.build(disabled_types=self.disabled,
@@ -191,18 +203,29 @@ class ImageArtifact:
         base_diff_ids = set(_guess_base_diff_ids(
             diff_ids, img.config.get("history") or []))
         no_secret_group = None
-        for i, (diff_id, blob_id) in enumerate(zip(diff_ids, blob_ids)):
-            if blob_id not in missing_set:
-                continue
-            g = group
-            if diff_id in base_diff_ids:
-                if no_secret_group is None:
-                    no_secret_group = AnalyzerGroup.build(
-                        disabled_types=self.disabled | {"secret"},
-                        file_patterns=self.file_patterns,
-                        helm_overrides=self.helm_overrides)
-                g = no_secret_group
-            self._inspect_layer(g, img, i, diff_id, blob_id)
+
+        def group_for(diff_id: str) -> AnalyzerGroup:
+            nonlocal no_secret_group
+            if diff_id not in base_diff_ids:
+                return group
+            if no_secret_group is None:
+                no_secret_group = AnalyzerGroup.build(
+                    disabled_types=self.disabled | {"secret"},
+                    file_patterns=self.file_patterns,
+                    helm_overrides=self.helm_overrides)
+            return no_secret_group
+
+        if pipeline.enabled():
+            self._inspect_layers_pipelined(
+                img, group_for, diff_ids, blob_ids, missing_set)
+        else:
+            # serial legacy path, byte-identical to the pre-pipeline
+            # builds (TRIVY_TPU_ANALYSIS_PIPELINE=0)
+            for i, (diff_id, blob_id) in enumerate(zip(diff_ids, blob_ids)):
+                if blob_id not in missing_set:
+                    continue
+                self._inspect_layer(group_for(diff_id), img, i, diff_id,
+                                    blob_id)
 
         if missing_artifact:
             info = self._inspect_config(img)
@@ -230,11 +253,139 @@ class ImageArtifact:
             },
         )
 
+    def _inspect_layers_pipelined(self, img, group_for,
+                                  diff_ids: list[str],
+                                  blob_ids: list[str],
+                                  missing_set: set[str]) -> None:
+        """Default layer path: prefetch layer N+1 while analyzing layer
+        N, with the process-wide singleflight registry ensuring a blob
+        shared by concurrent scans is analyzed exactly once
+        (docs/performance.md "Analysis pipeline & layer dedupe")."""
+        hook = pipeline.journal_hook()
+        stats = {"layers": len(blob_ids), "analyzed": 0, "deduped": 0,
+                 "inflight_waits": 0, "journal_replayed": 0,
+                 "occupancy": 0.0}
+        # serial analyzes every occurrence of a duplicated diffID and
+        # the LAST write wins (created_by = history[last index]); the
+        # deduped path analyzes once, so it must use that same last
+        # index to stay byte-identical
+        last_occurrence = {b: i for i, b in enumerate(blob_ids)
+                           if b in missing_set}
+        todo: list[tuple[int, str, str]] = []
+        seen: set[str] = set()
+        for i, (diff_id, blob_id) in enumerate(zip(diff_ids, blob_ids)):
+            if blob_id not in missing_set or blob_id in seen:
+                # cached at probe time (earlier scan, resumed crawl) or
+                # a duplicate diffID inside this image: no analysis
+                stats["deduped"] += 1
+                obs_metrics.LAYER_DEDUPE_HITS.inc()
+                if hook is not None and blob_id in hook.precompleted:
+                    stats["journal_replayed"] += 1
+                continue
+            seen.add(blob_id)
+            todo.append((last_occurrence[blob_id], diff_id, blob_id))
+
+        lead: list[tuple[int, str, str]] = []
+        slots: dict[str, object] = {}
+        waits: list[tuple[int, str, str, object]] = []
+        for i, diff_id, blob_id in todo:
+            slot, leader = pipeline.SINGLEFLIGHT.claim(blob_id, self.cache)
+            if leader:
+                lead.append((i, diff_id, blob_id))
+                slots[blob_id] = slot
+            else:
+                waits.append((i, diff_id, blob_id, slot))
+
+        def fetch(item):
+            i, _diff_id, _blob_id = item
+            return self._layer_source(img, i)
+
+        def process(item, layer):
+            i, diff_id, blob_id = item
+            self._lead_analyze(group_for(diff_id), img, i, diff_id,
+                               blob_id, slots[blob_id], hook, stats,
+                               layer=layer)
+
+        try:
+            run = pipeline.run_layer_pipeline(lead, fetch, process)
+            stats["occupancy"] = run["occupancy"]
+        finally:
+            # a failed scan must release every claim it still holds or
+            # concurrent scans of the shared layers would wait forever
+            for blob_id, slot in slots.items():
+                pipeline.SINGLEFLIGHT.finish(blob_id, slot, ok=False)
+
+        for i, diff_id, blob_id, slot in waits:
+            self._await_layer(img, group_for(diff_id), i, diff_id,
+                              blob_id, slot, hook, stats)
+        self.last_analysis_stats = stats
+
+    def _lead_analyze(self, group, img, i: int, diff_id: str,
+                      blob_id: str, slot, hook, stats,
+                      layer=None) -> None:
+        """The one leader sequence (pipeline path and follower-promoted
+        takeover alike): analyze, publish to waiters, journal, count."""
+        try:
+            doc = self._inspect_layer(group, img, i, diff_id, blob_id,
+                                      layer=layer)
+        except BaseException:
+            pipeline.SINGLEFLIGHT.finish(blob_id, slot, ok=False)
+            raise
+        pipeline.SINGLEFLIGHT.finish(blob_id, slot, doc=doc, ok=True)
+        if hook is not None:
+            hook.layer_done(blob_id)
+        stats["analyzed"] += 1
+
+    def _await_layer(self, img, group, i: int, diff_id: str, blob_id: str,
+                     slot, hook, stats) -> None:
+        """Follower path: wait for the concurrent leader's BlobInfo; on
+        leader failure, contend to become the new leader and analyze."""
+        for _ in range(8):  # each round either resolves or re-claims
+            obs_metrics.LAYER_DEDUPE_INFLIGHT_WAITS.inc()
+            stats["inflight_waits"] += 1
+            slot.event.wait(pipeline._INPROC_WAIT_S)
+            if slot.ok:
+                if slot.doc is not None and slot.src_cache is not self.cache:
+                    # the leader analyzed into a different cache handle
+                    # (separate scans); replay the doc into ours
+                    self.cache.put_blob(blob_id, slot.doc)
+                stats["deduped"] += 1
+                obs_metrics.LAYER_DEDUPE_HITS.inc()
+                return
+            slot, leader = pipeline.SINGLEFLIGHT.claim(blob_id, self.cache)
+            if leader:
+                # same one-refetch-on-error fetch as the pipeline path
+                # (fault-matrix parity)
+                self._lead_analyze(
+                    group, img, i, diff_id, blob_id, slot, hook, stats,
+                    layer=pipeline.fetch_with_retry(
+                        lambda: self._layer_source(img, i)))
+                return
+        # pathological churn: analyze unconditionally (idempotent write)
+        self._inspect_layer(group, img, i, diff_id, blob_id)
+        stats["analyzed"] += 1
+
+    @staticmethod
+    def _layer_source(img, i: int):
+        """Prefer the streaming accessor (gunzip happens inside the tar
+        walk, bounded by one member); sources without one hand over the
+        decompressed bytes as before."""
+        stream = getattr(img, "layer_stream", None)
+        if stream is not None:
+            return stream(i)
+        return img.layer_bytes(i)
+
     def _inspect_layer(self, group, img, i: int, diff_id: str,
-                       blob_id: str) -> None:
+                       blob_id: str, layer=None) -> dict:
         _log.info("analyzing layer...", diff_id=diff_id[:19])
-        layer = img.layer_bytes(i)
-        files, opaque_dirs, whiteouts = walk_layer_tar(layer)
+        if layer is None:
+            layer = img.layer_bytes(i)
+        try:
+            files, opaque_dirs, whiteouts = walk_layer_tar(layer)
+        finally:
+            # streaming sources hand over open file objects
+            if hasattr(layer, "close"):
+                layer.close()
         result = AnalysisResult()
         post_files: dict = {}
         for inp in files:
@@ -252,7 +403,10 @@ class ImageArtifact:
         ]
         if i < len(history):
             blob.created_by = history[i].get("created_by", "")
-        self.cache.put_blob(blob_id, dataclasses.asdict(blob))
+        doc = dataclasses.asdict(blob)
+        self.cache.put_blob(blob_id, doc)
+        obs_metrics.LAYERS_ANALYZED.inc()
+        return doc
 
     def _inspect_config(self, img: TarImage) -> ArtifactInfo:
         """Image-config analysis (reference image.go:505 inspectConfig):

@@ -3,8 +3,9 @@ source chain docker → containerd → podman → remote registry, and
 pkg/fanal/image/{daemon,registry,remote}.go).
 
 Every backend yields the same surface as artifact.image.TarImage —
-name/config/config_digest/diff_ids()/layer_bytes(i)/close() — so the
-layer-analysis pipeline is source-agnostic:
+name/config/config_digest/diff_ids()/layer_bytes(i)/close(), plus the
+optional streaming layer_stream(i) — so the layer-analysis pipeline is
+source-agnostic:
 
 - DaemonImage: docker/podman engine API over a unix socket; the image
   is exported (`GET /images/{ref}/get`, i.e. docker-save) into a spooled
@@ -170,6 +171,9 @@ class DaemonImage:
 
     def layer_bytes(self, i: int) -> bytes:
         return self._tar.layer_bytes(i)
+
+    def layer_stream(self, i: int):
+        return self._tar.layer_stream(i)
 
     def close(self):
         if getattr(self, "_tar", None) is not None:
@@ -352,6 +356,15 @@ class RegistryImage:
         if data[:2] == b"\x1f\x8b":
             data = gzip.decompress(data)
         return data
+
+    def layer_stream(self, i: int):
+        """Registry blob as a stream of its wire bytes; the tar walk's
+        stream mode gunzips incrementally, so the decompressed layer
+        never fully materializes."""
+        import io
+
+        desc = self._layers[i]
+        return io.BytesIO(self.client.blob(self.repository, desc["digest"]))
 
     def close(self):
         pass

@@ -32,7 +32,9 @@ from trivy_tpu.cli.run import (
 )
 from trivy_tpu.durability import ScanJournal, atomic_write, options_fingerprint
 from trivy_tpu.durability.journal import JournalError
+from trivy_tpu.fanal import pipeline as analysis_pipeline
 from trivy_tpu.log import logger
+from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import tracing
 from trivy_tpu.resilience import faults
 from trivy_tpu.utils import clock
@@ -113,12 +115,22 @@ def run_fleet(args) -> int:
             except JournalError as e:
                 raise FatalError(str(e))
 
+    # ONE cache handle for every lane: layer analyses from concurrent
+    # workers land in (and dedupe through) the same backend, and the
+    # in-process singleflight registry sees one cache identity, so a
+    # base layer shared across --fleet-parallel lanes is analyzed once
     cache = _build_cache(args)
     lane = {t: i + 1 for i, t in enumerate(targets)}  # stable fleet index
     reports: dict[str, dict] = dict(journal.done) if journal else {}
     todo = [t for t in targets if t not in reports]
     if journal and len(reports):
-        _log.info("resuming fleet scan", done=len(reports), todo=len(todo))
+        _log.info("resuming fleet scan", done=len(reports), todo=len(todo),
+                  layers_journaled=len(journal.layers))
+    # snapshot the process-wide analysis counters so the summary line
+    # reports THIS fleet's layers analyzed vs deduped
+    analysis_base = (obs_metrics.LAYERS_ANALYZED.value(),
+                     obs_metrics.LAYER_DEDUPE_HITS.value(),
+                     obs_metrics.LAYER_DEDUPE_INFLIGHT_WAITS.value())
 
     def scan_one(target: str) -> None:
         # deterministic crash point for the kill-and-resume matrix
@@ -160,7 +172,12 @@ def run_fleet(args) -> int:
 
     workers = max(1, int(getattr(args, "fleet_parallel", 1) or 1))
     try:
-        with tracing.span("fleet", artifacts=len(todo), workers=workers):
+        # fleet-wide layer journal: every lane records completed layer
+        # analyses, and a resumed crawl replays them as dedupe hints
+        with tracing.span("fleet", artifacts=len(todo), workers=workers), \
+                analysis_pipeline.journal_scope(
+                    on_layer=journal.mark_layer if journal else None,
+                    precompleted=set(journal.layers) if journal else None):
             run_pipeline(todo, scan_one, workers=workers,
                          on_start=on_start)
     except PipelineError as e:
@@ -170,6 +187,17 @@ def run_fleet(args) -> int:
     finally:
         if journal:
             journal.close()
+        analyzed = obs_metrics.LAYERS_ANALYZED.value() - analysis_base[0]
+        deduped = obs_metrics.LAYER_DEDUPE_HITS.value() - analysis_base[1]
+        waits = obs_metrics.LAYER_DEDUPE_INFLIGHT_WAITS.value() \
+            - analysis_base[2]
+        if analyzed or deduped:
+            _log.info("fleet analysis summary",
+                      layers_analyzed=int(analyzed),
+                      layers_deduped=int(deduped),
+                      inflight_waits=int(waits),
+                      dedupe_ratio=round(
+                          deduped / max(analyzed + deduped, 1), 3))
 
     _write_fleet_report(args, targets, reports)
     # same exit-code policy as single-target scans (cli/run.py

@@ -6,9 +6,16 @@ affecting option; after it, per-artifact lifecycle records:
 
     {"kind": "pending", "target": t}            enqueued
     {"kind": "running", "target": t}            a worker picked it up
+    {"kind": "layer", "blob": blob_id}          a layer analysis landed
+                                                durably in the cache
     {"kind": "done", "target": t,
      "digest": "sha256:…", "report": {…}}       finished; report embedded
     {"kind": "failed", "target": t, "error": e} scan raised
+
+Layer records are fleet-wide (blob ids are content-addressed, so one
+record covers every image sharing that layer): a resumed crawl replays
+them as dedupe hints and skips re-journaling, and the analysis pipeline
+counts cache hits on them as journal-replayed layers.
 
 Every append is flushed + fsynced before the writer proceeds, so the
 journal is a write-ahead log of fleet progress: after SIGKILL, replay
@@ -86,9 +93,11 @@ class ScanJournal:
         self.path = path
         self.header = header
         self._lock = threading.Lock()
+        self._layer_lock = threading.Lock()
         self._fh = None
         self.done: dict[str, dict] = {}
         self.failed: dict[str, str] = {}
+        self.layers: set[str] = set()
 
     # ------------------------------------------------------------ open
 
@@ -173,6 +182,8 @@ class ScanJournal:
             elif kind == "failed" and target:
                 if target not in j.done:
                     j.failed[target] = rec.get("error", "")
+            elif kind == "layer" and rec.get("blob"):
+                j.layers.add(rec["blob"])
         # artifacts that were mid-scan at the crash (running, never
         # done/failed): they re-run, but the distinction matters to an
         # operator reading the resume log
@@ -221,6 +232,19 @@ class ScanJournal:
 
     def mark_running(self, target: str) -> None:
         self._append({"kind": "running", "target": target})
+
+    def mark_layer(self, blob_id: str) -> None:
+        """Record one durable layer analysis (called after put_blob
+        returns, so the cache entry exists when the record does). Each
+        blob id is journaled once per fleet, however many images share
+        it — repeats and replayed layers are no-ops."""
+        if blob_id in self.layers:
+            return
+        with self._layer_lock:
+            if blob_id in self.layers:
+                return
+            self.layers.add(blob_id)
+            self._append({"kind": "layer", "blob": blob_id})
 
     def mark_done(self, target: str, report_doc: dict) -> None:
         self._append({"kind": "done", "target": target,

@@ -73,15 +73,23 @@ class LayerFile:
     opaque_dir: str | None = None
 
 
-def walk_layer_tar(tar_bytes_or_path) -> tuple[list[AnalysisInput], list[str], list[str]]:
-    """-> (files, opaque_dirs, whiteout_files). Reads the whole layer tar
-    (reference walker/tar.go)."""
-    if isinstance(tar_bytes_or_path, (bytes, bytearray)):
+def walk_layer_tar(tar_src) -> tuple[list[AnalysisInput], list[str], list[str]]:
+    """-> (files, opaque_dirs, whiteout_files). Accepts layer bytes, a
+    path, or a readable file-like object (reference walker/tar.go).
+
+    The file-like form opens in tarfile *stream* mode (``r|*``), which
+    gunzips compressed layers incrementally: peak RSS is one tar member
+    plus the source stream, never a full decompressed layer copy. The
+    walk below already consumes members strictly in order, which is the
+    only constraint stream mode adds."""
+    if isinstance(tar_src, (bytes, bytearray)):
         import io
 
-        tf = tarfile.open(fileobj=io.BytesIO(tar_bytes_or_path))
+        tf = tarfile.open(fileobj=io.BytesIO(tar_src))
+    elif hasattr(tar_src, "read"):
+        tf = tarfile.open(fileobj=tar_src, mode="r|*")
     else:
-        tf = tarfile.open(tar_bytes_or_path)
+        tf = tarfile.open(tar_src)
     files: list[AnalysisInput] = []
     opaque_dirs: list[str] = []
     whiteout_files: list[str] = []

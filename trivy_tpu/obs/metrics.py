@@ -381,6 +381,25 @@ PIPELINE_OCCUPANCY = REGISTRY.gauge(
     "Fraction of the last pipelined crawl's wall-clock x stages the "
     "executor's stages were busy (1.0 = encode/device/rescreen fully "
     "overlapped; ~1/3 = serial)")
+ANALYSIS_PIPELINE_OCCUPANCY = REGISTRY.gauge(
+    "trivy_tpu_analysis_pipeline_occupancy",
+    "Fraction of the last layer-analysis pipeline's wall-clock x lanes "
+    "the fetch/walk stages were busy (1.0 = fetch of layer N+1 fully "
+    "overlapped with analysis of layer N; ~0.5 = serial)")
+LAYERS_ANALYZED = REGISTRY.counter(
+    "trivy_tpu_layers_analyzed_total",
+    "Container layers actually walked+analyzed (cache misses that this "
+    "process led)")
+LAYER_DEDUPE_HITS = REGISTRY.counter(
+    "trivy_tpu_layer_dedupe_hits_total",
+    "Layers satisfied without analysis: content-addressed blob-cache "
+    "hits plus singleflight followers that reused a concurrent scan's "
+    "completed analysis")
+LAYER_DEDUPE_INFLIGHT_WAITS = REGISTRY.counter(
+    "trivy_tpu_layer_dedupe_inflight_waits_total",
+    "Times a scan waited on another scan's in-flight analysis of the "
+    "same layer instead of analyzing it itself (in-process singleflight "
+    "and the server-side MissingBlobs gate)")
 SCHED_BATCH_ROWS = REGISTRY.histogram(
     "trivy_tpu_sched_batch_rows",
     "Package-query rows per coalesced match-scheduler micro-batch",

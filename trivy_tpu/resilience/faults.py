@@ -12,7 +12,7 @@ Spec grammar (rules joined by ";" or ","):
     rule     := site ":" action [ "=" param ] [ "@" selector ]
     site     := "rpc" | "rpc.scan" | "rpc.cache" | "rpc.cache.PutBlob"
                 | "engine" | "cache.write" | "db.install" | "fleet.scan"
-                | "journal.append" | "sched.submit"
+                | "journal.append" | "sched.submit" | "analysis.fetch"
                 | ...  (dotted, prefix-matched)
     action   := "drop" | "timeout" | "delay" | "error" | "corrupt"
                 | "device-lost" | "kill" | "torn-write" | "bitflip"

@@ -224,6 +224,16 @@ class ScanService:
         # time (in-flight scans hold the read lock, so it is always a
         # consistent read); the in-flight counter feeds the lone-scan
         # fast path (window skipped when nobody else can submit).
+        # cross-CLIENT layer dedupe: analysis happens client-side, but
+        # MissingBlobs/PutBlob route through this cache — the gate makes
+        # a second client's MissingBlobs wait (bounded) on the first
+        # client's in-flight analysis of a shared base layer instead of
+        # reporting it missing, so the fleet analyzes each unique layer
+        # once (TTL claims: a client that dies mid-analysis expires)
+        from trivy_tpu.fanal import pipeline as _analysis
+
+        self.layer_gate = _analysis.LayerSingleflight(
+            ttl_s=_analysis.SERVER_CLAIM_TTL_S)
         from trivy_tpu import sched as _sched
 
         self.scheduler = None
@@ -336,6 +346,63 @@ class ScanService:
             while self._inflight and time.monotonic() < deadline:
                 self._drain_cond.wait(deadline - time.monotonic())
             return self._inflight
+
+    def filter_inflight_blobs(self, missing: list[str],
+                              budget_s: float | None = None,
+                              holder: str | None = None) -> list[str]:
+        """Cross-client layer dedupe on the MissingBlobs path: blobs
+        another client is analyzing right now (a fresh gate claim with
+        no PutBlob yet) are waited on — bounded by one shared budget —
+        and re-probed; a blob that landed meanwhile is dropped from the
+        missing set, so this caller never analyzes it. Everything else
+        (including wait timeouts and dead leaders) is claimed for this
+        caller and returned, preserving order — correctness never
+        depends on the gate, it only removes duplicate work."""
+        from trivy_tpu.fanal import pipeline as _analysis
+
+        waits: list[tuple[str, object]] = []
+        out: set[str] = set()
+        for b in dict.fromkeys(missing):  # unique, order kept — a dup
+            # diffID must not wait on this very request's own claim,
+            # and neither must a RETRY of this request (lost response,
+            # resent body): the holder identity re-leads idempotently
+            slot, leader = self.layer_gate.claim(b, holder=holder)
+            if leader:
+                out.add(b)
+            else:
+                waits.append((b, slot))
+        budget = _analysis.SERVER_WAIT_BUDGET_S
+        if budget_s is not None:
+            budget = min(budget, budget_s)
+        resolved: list[str] = []
+        for b, slot in waits:
+            if budget > 0:
+                obs_metrics.LAYER_DEDUPE_INFLIGHT_WAITS.inc()
+            t0 = time.monotonic()
+            done = slot.event.wait(budget) if budget > 0 else slot.done
+            budget = max(0.0, budget - (time.monotonic() - t0))
+            if done and slot.ok:
+                resolved.append(b)
+            else:
+                # stale/failed claim: this caller takes it over (the
+                # ghost slot is resolved, so later requests park on
+                # THIS caller's fresh claim instead of re-paying the
+                # wait budget until the TTL expires)
+                self.layer_gate.reclaim(b, holder=holder)
+                out.add(b)
+        if resolved:
+            # the leaders' PutBlobs hit this service's cache; ONE
+            # batched probe verifies before trusting (a leader may have
+            # died after its claim expired elsewhere)
+            _ma, still = self.cache.missing_blobs("", resolved)
+            still_set = set(still)
+            for b in resolved:
+                if b in still_set:
+                    self.layer_gate.reclaim(b, holder=holder)
+                    out.add(b)
+                else:
+                    obs_metrics.LAYER_DEDUPE_HITS.inc()
+        return [b for b in missing if b in out]
 
     def scan(self, target, artifact_key, blob_keys, options,
              deadline: Deadline | None = None):
@@ -638,11 +705,32 @@ def _make_handler(service: ScanService, token: str | None,
                 self._reply(200, b"{}")
             elif method == "PutBlob":
                 cache.put_blob(doc["diff_id"], doc["blob_info"])
+                # a durable layer analysis arrived: release any clients
+                # the MissingBlobs gate parked on this blob
+                service.layer_gate.complete(doc["diff_id"])
                 self._reply(200, b"{}")
             elif method == "MissingBlobs":
                 missing_artifact, missing_blobs = cache.missing_blobs(
                     doc["artifact_id"], doc.get("blob_ids") or []
                 )
+                if missing_blobs:
+                    from trivy_tpu.fanal import pipeline as _analysis
+
+                    if _analysis.enabled():
+                        # a deadline-scoped client must not burn its
+                        # whole budget parked on another client's layer
+                        dl = Deadline.from_header(
+                            self.headers.get(DEADLINE_HEADER))
+                        # the trace id (stable across retry attempts of
+                        # one scan) identifies the claimant, so a
+                        # resent MissingBlobs re-leads its own claims
+                        trace = self.headers.get(tracing.TRACE_HEADER)
+                        holder = trace.split("-", 1)[0] if trace else None
+                        missing_blobs = service.filter_inflight_blobs(
+                            missing_blobs,
+                            budget_s=(max(dl.remaining() / 2, 0.0)
+                                      if dl else None),
+                            holder=holder)
                 self._reply(200, json.dumps({
                     "missing_artifact": missing_artifact,
                     "missing_blob_ids": missing_blobs,
