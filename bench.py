@@ -857,10 +857,33 @@ def _phase_json_path() -> str | None:
     return os.environ.get("TRIVY_TPU_BENCH_PHASE_JSON") or None
 
 
+def _lint_gate() -> int:
+    """Run the project invariant linter (trivy_tpu/analysis) before the
+    measurement: a lint regression fails verification even when every
+    number is green.  Findings go to stderr; the metric line still
+    prints so the driver sees WHY the run failed."""
+    try:
+        from trivy_tpu.analysis import lint as _lint
+
+        findings, _ = _lint.run_lint(
+            root=os.path.dirname(os.path.abspath(__file__)))
+    except Exception as exc:  # a broken linter must not eat the bench
+        print(f"BENCH_STATUS=lint_error {exc}", file=sys.stderr)
+        return 0
+    for f in findings:
+        print(f"LINT {f.render()}", file=sys.stderr)
+    if findings:
+        print(f"BENCH_STATUS=lint_failed findings={len(findings)}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main():
     phase_json = _phase_json_path()
     if not os.environ.get("TRIVY_TPU_BENCH_CHILD"):
-        return _run_supervised(_ensure_device())
+        lint_rc = _lint_gate()
+        return _run_supervised(_ensure_device()) or lint_rc
     device_status = os.environ.get("TRIVY_TPU_BENCH_DEVICE_STATUS",
                                    "unknown")
     from trivy_tpu.obs import tracing as _trace

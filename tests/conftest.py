@@ -49,3 +49,43 @@ def fake_redis():
     yield f"redis://127.0.0.1:{srv.server_address[1]}"
     srv.shutdown()
     srv.server_close()
+
+
+# lock-order witness (trivy_tpu/analysis/witness.py): enabled for the
+# concurrency-marked suites so tier-1 exercises the real interleavings,
+# with cycle detection at every test's teardown.  Tests that seed a
+# deliberate cycle (the ABBA fixture in test_analysis.py) reset the
+# witness before returning, and run under their own marker so this
+# fixture's setup decision (taken before the test body sets the env)
+# skips the teardown assert for them.
+#
+# Scope note: make_lock checks the env at lock CREATION, so only locks
+# created inside an enabled test (schedulers, engines, journals built
+# by the test body) are witnessed here — import-time module globals
+# stay raw; the static with-nesting pass covers those (see the
+# witness.py docstring).
+_WITNESS_MARKERS = ("sched", "fanal", "obs", "durability", "fault")
+
+
+@pytest.fixture(autouse=True)
+def _lock_witness_guard(request, monkeypatch):
+    from trivy_tpu.analysis import witness
+
+    marked = any(request.node.get_closest_marker(m)
+                 for m in _WITNESS_MARKERS)
+    if request.node.get_closest_marker("no_lock_witness"):
+        # timing-sensitive guards (disabled-overhead comparisons) must not
+        # carry per-acquire witness cost on only one side of their delta
+        yield
+        return
+    if not marked and not witness.enabled():
+        yield
+        return
+    monkeypatch.setenv(witness.ENV, "1")
+    witness.WITNESS.reset()
+    yield
+    cycle = witness.WITNESS.find_cycle()
+    if cycle:
+        pytest.fail("lock-order cycle witnessed: "
+                    + " -> ".join(cycle) + "\n"
+                    + witness.WITNESS.report())

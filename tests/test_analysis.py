@@ -1,0 +1,635 @@
+"""Static-analysis suite (trivy_tpu/analysis): the ENFORCEMENT test
+that keeps the whole tree lint-clean, a seeded-violation fixture per
+rule proving each actually fires, suppression/baseline semantics, the
+runtime lock-order witness (ABBA detection, re-entrancy, zero-cost
+disabled path), and the static-vs-runtime lock-graph cross-check."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from trivy_tpu.analysis import knobs, lint, lockstatic, rules, witness
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_project(tmp_path, files: dict[str, str],
+                 docs: dict[str, str] | None = None,
+                 fault_sites=None, knob_table=None) -> rules.Project:
+    """Synthetic mini-tree: `files` land under trivy_tpu/, `docs`
+    under docs/; declared tables overridable per rule under test."""
+    # checkout marker so lint.main's is_project_tree guard accepts the tree
+    (tmp_path / "README.md").write_text("mini-tree fixture\n")
+    for rel, src in files.items():
+        p = tmp_path / "trivy_tpu" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    for rel, text in (docs or {}).items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    project = rules.Project(str(tmp_path))
+    if fault_sites is not None:
+        project.declared_fault_sites = fault_sites
+    if knob_table is not None:
+        project.declared_knobs = knob_table
+    return project
+
+
+def run_rule(project, rule_id) -> list[rules.Finding]:
+    findings, _ = rules.run(project, rule_ids={rule_id})
+    return [f for f in findings if f.rule == rule_id]
+
+
+# ===================================================== enforcement
+
+class TestEnforcement:
+    def test_full_tree_lint_clean(self):
+        """THE gate: the linter exits clean on the real tree (inline
+        suppressions carry reasons; baseline ships empty)."""
+        findings, suppressed = lint.run_lint(root=REPO_ROOT)
+        assert not findings, "\n" + "\n".join(f.render() for f in findings)
+        # every suppression that held carries a non-empty reason by
+        # construction (reasonless ones surface as findings above)
+        assert suppressed, "expected the documented justified suppressions"
+
+    def test_shipped_baseline_is_empty(self):
+        with open(os.path.join(REPO_ROOT, ".lint-baseline.json")) as f:
+            doc = json.load(f)
+        assert doc["findings"] == []
+
+    def test_module_entrypoint_json(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "trivy_tpu.analysis.lint", "--json"],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["clean"] is True
+        assert sorted(doc["rules"]) == sorted(rules.RULES)
+
+    def test_cli_subcommand(self, capsys):
+        from trivy_tpu.cli.main import main
+        assert main(["lint", "--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in rules.RULES:
+            assert rid in out
+
+    def test_every_rule_has_a_seeded_fixture(self):
+        """A rule with no proof it fires is a rule that may be dead."""
+        proven = {name.replace("test_", "").replace("_fires", "")
+                  .replace("_", "-")
+                  for name in dir(TestRuleFixtures)
+                  if name.startswith("test_") and name.endswith("_fires")}
+        assert set(rules.RULES) <= proven, \
+            f"rules without a *_fires fixture: {set(rules.RULES) - proven}"
+
+    def test_unknown_rule_flag(self):
+        assert lint.main(["--rule", "no-such-rule"]) == 2
+
+
+# ============================================== per-rule seeded fixtures
+
+class TestRuleFixtures:
+    def test_atomic_write_fires(self, tmp_path):
+        project = make_project(tmp_path, {
+            "x/writer.py": (
+                "import os\n"
+                "def save(p, data):\n"
+                "    with open(p, 'w') as f:\n"
+                "        f.write(data)\n"
+                "    os.replace(p, p + '.bak')\n"),
+            "durability/atomic2.py": (
+                "def ok(p, data):\n"
+                "    with open(p, 'w') as f:\n"
+                "        f.write(data)\n"),
+        })
+        found = run_rule(project, "atomic-write")
+        assert len(found) == 2  # open + os.replace; durability/ exempt
+        assert {f.line for f in found} == {3, 5}
+
+    def test_atomic_write_read_mode_ok(self, tmp_path):
+        project = make_project(tmp_path, {
+            "x/reader.py": "def load(p):\n    return open(p).read()\n"})
+        assert run_rule(project, "atomic-write") == []
+
+    def test_fault_site_fires(self, tmp_path):
+        project = make_project(
+            tmp_path,
+            {"x/mod.py": (
+                "from trivy_tpu.resilience import faults\n"
+                "def f():\n"
+                "    faults.fire('rogue.site')\n")},
+            docs={"docs/resilience.md": "sites: used.site\n"},
+            fault_sites=[("used.site", ("drop",)),
+                         ("ghost.site", ("drop",))])
+        found = run_rule(project, "fault-site")
+        msgs = "\n".join(f.message for f in found)
+        assert "'rogue.site' used in code but not declared" in msgs
+        # declared 'used.site' has no code use either -> also flagged
+        assert "'ghost.site' declared in faults.SITES but no code" in msgs
+        assert "'ghost.site' not listed in docs/resilience.md" in msgs
+
+    def test_fault_site_doc_grammar_both_directions(self, tmp_path):
+        """A parseable `site :=` production is matched as an exact
+        token set, both ways: a doc-only site is flagged, and deleting
+        a site that is a substring of another row is caught."""
+        project = make_project(
+            tmp_path,
+            {"x/mod.py": (
+                "from trivy_tpu.resilience import faults\n"
+                "def f():\n"
+                "    faults.fire('db.save')\n"
+                "    faults.fire('db.save.metadata')\n")},
+            docs={"docs/resilience.md": (
+                "```\n"
+                "site     := db.save.metadata | phantom.site\n"
+                "```\n")},
+            fault_sites=[("db.save", ("kill",)),
+                         ("db.save.metadata", ("kill",))])
+        found = run_rule(project, "fault-site")
+        msgs = "\n".join(f.message for f in found)
+        # 'db.save' is a substring of the listed 'db.save.metadata' but
+        # its own token is missing -> flagged (substring match would
+        # pass silently)
+        assert "'db.save' not listed in docs/resilience.md" in msgs
+        assert ("doc grammar lists fault site 'phantom.site' but "
+                "faults.SITES does not declare it") in msgs
+
+    def test_metric_name_fires(self, tmp_path):
+        project = make_project(
+            tmp_path,
+            {"obs2/m.py": (
+                "def setup(reg, names):\n"
+                "    reg.counter('trivy_tpu_CamelCase_total', 'x')\n"
+                "    reg.gauge('trivy_tpu_undocumented', 'x')\n"
+                "    reg.histogram('trivy_tpu_computed', 'x',\n"
+                "                  labels=tuple(names))\n")},
+            docs={"docs/observability.md": (
+                "| `trivy_tpu_CamelCase_total` | counter |\n"
+                "| `trivy_tpu_computed` | histogram |\n"
+                "| `trivy_tpu_ghost_total` | counter |\n")})
+        found = run_rule(project, "metric-name")
+        msgs = "\n".join(f.message for f in found)
+        assert "not snake_case" in msgs
+        assert "'trivy_tpu_undocumented' registered but absent" in msgs
+        assert "labels must be a literal tuple" in msgs
+        assert "'trivy_tpu_ghost_total' but no code registers it" in msgs
+
+    def test_env_knob_fires(self, tmp_path):
+        project = make_project(
+            tmp_path,
+            {"x/cfg.py": (
+                "import os\n"
+                "A = os.environ.get('TRIVY_TPU_MYSTERY')\n"
+                "B = os.environ.get('TRIVY_TPU_' + 'DYN')\n")},
+            knob_table=[knobs.Knob("TRIVY_TPU_DECLARED_ONLY", "", "x",
+                                   False, "d")])
+        found = run_rule(project, "env-knob")
+        msgs = "\n".join(f.message for f in found)
+        assert "'TRIVY_TPU_MYSTERY' read here but not declared" in msgs
+        assert "dynamic TRIVY_TPU_* env read" in msgs
+        assert "'TRIVY_TPU_DECLARED_ONLY' declared but nothing reads" in msgs
+
+    def test_env_knob_stale_doc_fires(self, tmp_path):
+        project = make_project(
+            tmp_path, {"x/none.py": "pass\n"},
+            docs={"docs/knobs.md": "# stale\n"})
+        # declared table defaults to the REAL registry -> staleness
+        # check applies; reads are missing too, but the doc finding is
+        # what this fixture pins
+        found = run_rule(project, "env-knob")
+        assert any("docs/knobs.md is stale" in f.message for f in found)
+
+    def test_monotonic_clock_fires(self, tmp_path):
+        project = make_project(tmp_path, {
+            "sched/loop.py": (
+                "import time\n"
+                "def wait(budget):\n"
+                "    deadline = time.time() + budget\n"),
+            "report/stamp.py": (
+                "import time\n"
+                "def stamp():\n"
+                "    return time.time()\n"),  # outside scope: fine
+        })
+        found = run_rule(project, "monotonic-clock")
+        assert len(found) == 1
+        assert found[0].path == "trivy_tpu/sched/loop.py"
+
+    def test_tracing_capture_fires(self, tmp_path):
+        project = make_project(tmp_path, {
+            "x/workers.py": (
+                "import threading\n"
+                "from trivy_tpu.obs import tracing\n"
+                "def orphan(fn):\n"
+                "    threading.Thread(target=fn).start()\n"
+                "def good(fn):\n"
+                "    ctx = tracing.capture()\n"
+                "    threading.Thread(target=fn).start()\n"
+                "def pooled(ex, fn):\n"
+                "    ex.submit(fn)\n")})
+        found = run_rule(project, "tracing-capture")
+        assert {f.line for f in found} == {4, 9}  # good() passes
+
+    def test_bare_except_fires(self, tmp_path):
+        project = make_project(tmp_path, {
+            "x/handlers.py": (
+                "def a():\n"
+                "    try:\n"
+                "        pass\n"
+                "    except:\n"
+                "        pass\n"
+                "def b():\n"
+                "    try:\n"
+                "        pass\n"
+                "    except BaseException:\n"
+                "        pass\n"
+                "def c():\n"
+                "    try:\n"
+                "        pass\n"
+                "    except BaseException:\n"
+                "        raise\n")})
+        found = run_rule(project, "bare-except")
+        assert {f.line for f in found} == {4, 9}  # c() re-raises
+
+    def test_lock_order_fires(self, tmp_path):
+        project = make_project(tmp_path, {
+            "x/abba.py": (
+                "import threading\n"
+                "_a_lock = threading.Lock()\n"
+                "_b_lock = threading.Lock()\n"
+                "def one():\n"
+                "    with _a_lock:\n"
+                "        with _b_lock:\n"
+                "            pass\n"
+                "def two():\n"
+                "    with _b_lock:\n"
+                "        with _a_lock:\n"
+                "            pass\n")})
+        found = run_rule(project, "lock-order")
+        assert len(found) == 1
+        assert "static lock-order cycle" in found[0].message
+        assert "x.abba._a_lock" in found[0].message
+
+    def test_lock_order_consistent_nesting_ok(self, tmp_path):
+        project = make_project(tmp_path, {
+            "x/ok.py": (
+                "import threading\n"
+                "_a_lock = threading.Lock()\n"
+                "_b_lock = threading.Lock()\n"
+                "def one():\n"
+                "    with _a_lock:\n"
+                "        with _b_lock:\n"
+                "            pass\n"
+                "def two():\n"
+                "    with _a_lock, _b_lock:\n"
+                "        pass\n")})
+        assert run_rule(project, "lock-order") == []
+
+
+# ===================================== suppressions, baseline, report
+
+class TestSuppressionAndBaseline:
+    def _violating(self, tmp_path, comment=""):
+        return make_project(tmp_path, {
+            "x/w.py": (
+                "def save(p, d):\n"
+                f"    {comment}\n"
+                "    with open(p, 'w') as f:\n"
+                "        f.write(d)\n")})
+
+    def test_inline_suppression_with_reason(self, tmp_path):
+        project = self._violating(
+            tmp_path, "# lint: allow[atomic-write] user output stream")
+        findings, suppressed = rules.run(project,
+                                         rule_ids={"atomic-write"})
+        assert findings == []
+        assert [via for _, via in suppressed] == ["inline"]
+
+    def test_inline_suppression_requires_reason(self, tmp_path):
+        project = self._violating(tmp_path, "# lint: allow[atomic-write]")
+        findings, _ = rules.run(project, rule_ids={"atomic-write"})
+        assert [f.rule for f in findings] == ["suppression"]
+        assert "no reason" in findings[0].message
+
+    def test_baseline_suppresses_with_reason(self, tmp_path):
+        project = self._violating(tmp_path)
+        baseline = [{"rule": "atomic-write", "path": "trivy_tpu/x/w.py",
+                     "reason": "staged fix, ROADMAP item 9"}]
+        findings, suppressed = rules.run(
+            project, rule_ids={"atomic-write"}, baseline=baseline)
+        assert findings == []
+        assert [via for _, via in suppressed] == ["baseline"]
+
+    def test_baseline_without_reason_is_reported(self, tmp_path):
+        project = self._violating(tmp_path)
+        baseline = [{"rule": "atomic-write", "path": "trivy_tpu/x/w.py"}]
+        findings, _ = rules.run(project, rule_ids={"atomic-write"},
+                                baseline=baseline)
+        assert {f.rule for f in findings} == {"baseline", "atomic-write"}
+
+    def test_json_report_shape(self, tmp_path, capsys):
+        self._violating(tmp_path)
+        rc = lint.main(["--root", str(tmp_path), "--json",
+                        "--rule", "atomic-write", "--baseline", ""])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["clean"] is False
+        f = doc["findings"][0]
+        assert f["rule"] == "atomic-write"
+        assert f["path"] == "trivy_tpu/x/w.py"
+        assert f["line"] == 3
+
+
+# ============================================= knobs registry / doc
+
+class TestKnobs:
+    def test_generated_doc_is_current(self):
+        with open(os.path.join(REPO_ROOT, "docs", "knobs.md"),
+                  encoding="utf-8") as f:
+            assert f.read() == knobs.generate_knobs_md()
+
+    def test_kill_switches_marked(self):
+        names = {k.name for k in knobs.KNOBS if k.kill_switch}
+        assert {"TRIVY_TPU_SCHED", "TRIVY_TPU_PIPELINE",
+                "TRIVY_TPU_ANALYSIS_PIPELINE", "TRIVY_TPU_COMPILE_CACHE",
+                "TRIVY_TPU_SECRET_PROBE"} == names
+
+    def test_write_knobs_doc_roundtrip(self, tmp_path, capsys):
+        (tmp_path / "trivy_tpu").mkdir()
+        (tmp_path / "README.md").write_text("mini-tree fixture\n")
+        assert lint.main(["--root", str(tmp_path),
+                          "--write-knobs-doc"]) == 0
+        with open(tmp_path / "docs" / "knobs.md", encoding="utf-8") as f:
+            assert f.read() == knobs.generate_knobs_md()
+
+
+# ======================================== runtime lock-order witness
+
+class TestWitness:
+    def test_abba_cycle_detected(self, monkeypatch):
+        monkeypatch.setenv(witness.ENV, "1")
+        witness.WITNESS.reset()
+        try:
+            a = witness.make_lock("fix.A")
+            b = witness.make_lock("fix.B")
+
+            def ab():
+                with a:
+                    with b:
+                        pass
+
+            def ba():
+                with b:
+                    with a:
+                        pass
+
+            for fn in (ab, ba):  # separate threads, sequenced: the
+                t = threading.Thread(target=fn)  # ORDER graph does not
+                t.start()                        # need a real deadlock
+                t.join()
+            cyc = witness.WITNESS.find_cycle()
+            assert cyc == ["fix.A", "fix.B", "fix.A"]
+            assert "CYCLE" in witness.WITNESS.report()
+        finally:
+            witness.WITNESS.reset()
+
+    def test_consistent_order_no_cycle(self, monkeypatch):
+        monkeypatch.setenv(witness.ENV, "1")
+        witness.WITNESS.reset()
+        try:
+            a = witness.make_lock("fix2.A")
+            b = witness.make_lock("fix2.B")
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+            assert witness.WITNESS.edges() == {"fix2.A": {"fix2.B"}}
+            assert witness.WITNESS.find_cycle() is None
+        finally:
+            witness.WITNESS.reset()
+
+    def test_rlock_reentry_records_no_self_edge(self, monkeypatch):
+        monkeypatch.setenv(witness.ENV, "1")
+        witness.WITNESS.reset()
+        try:
+            r = witness.make_lock("fix3.R", threading.RLock())
+            with r:
+                with r:  # re-entrant
+                    pass
+            assert witness.WITNESS.edges() == {}
+        finally:
+            witness.WITNESS.reset()
+
+    def test_same_name_distinct_instance_still_records_edges(
+            self, monkeypatch):
+        # re-entrancy is per INSTANCE: holding X then two same-named
+        # but distinct locks must record the X->J edge (a name-keyed
+        # held check would mistake the second J for RLock re-entry and
+        # drop every edge of that acquire)
+        monkeypatch.setenv(witness.ENV, "1")
+        witness.WITNESS.reset()
+        try:
+            x = witness.make_lock("fix5.X")
+            j1 = witness.make_lock("fix5.J")
+            j2 = witness.make_lock("fix5.J")
+            with j1:
+                with x:
+                    with j2:
+                        pass
+            assert witness.WITNESS.edges() == {
+                "fix5.J": {"fix5.X"}, "fix5.X": {"fix5.J"}}
+        finally:
+            witness.WITNESS.reset()
+
+    def test_condition_wrapper_full_surface(self, monkeypatch):
+        monkeypatch.setenv(witness.ENV, "1")
+        witness.WITNESS.reset()
+        try:
+            c = witness.make_lock("fix4.C", threading.Condition())
+            hit = []
+
+            def waiter():
+                with c:
+                    hit.append(c.wait_for(lambda: bool(hit) or True,
+                                          timeout=1.0))
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            with c:
+                c.notify()
+                c.notify_all()
+            t.join()
+            assert hit == [True]
+            assert witness.WITNESS.find_cycle() is None
+        finally:
+            witness.WITNESS.reset()
+
+    def test_disabled_returns_raw_primitive(self, monkeypatch):
+        monkeypatch.delenv(witness.ENV, raising=False)
+        lk = threading.Lock()
+        assert witness.make_lock("x", lk) is lk  # zero wrapping
+        cond = threading.Condition()
+        assert witness.make_lock("x", cond) is cond
+
+    def test_acquire_failure_records_nothing(self, monkeypatch):
+        monkeypatch.setenv(witness.ENV, "1")
+        witness.WITNESS.reset()
+        try:
+            inner = threading.Lock()
+            lk = witness.make_lock("fix5.L", inner)
+            inner.acquire()  # someone else holds it
+            try:
+                assert lk.acquire(blocking=False) is False
+                assert witness.WITNESS._stack() == []
+            finally:
+                inner.release()
+        finally:
+            witness.WITNESS.reset()
+
+    @pytest.mark.slow
+    def test_disabled_overhead_under_2pct(self, monkeypatch):
+        """make_lock with the witness off returns the raw primitive, so
+        the acquire path must be byte-for-byte the stock one — mirror
+        of the tracing slow-mark guard (interleaved alternating order,
+        absolute floor against scheduler jitter).  Both sides run
+        identical bytecode, so ambient load only ADDS time: min-of-k
+        estimates the true cost and stays stable on a loaded box where
+        a median over short windows flakes."""
+        import time as _time
+
+        monkeypatch.delenv(witness.ENV, raising=False)
+        raw = threading.Lock()
+        named = witness.make_lock("overhead.L")
+        N = 100000
+
+        def timed(lk):
+            t0 = _time.perf_counter()
+            for _ in range(N):
+                with lk:
+                    pass
+            return _time.perf_counter() - t0
+
+        timed(raw), timed(named)  # warm
+        raw_t, named_t = [], []
+        for i in range(16):
+            if i % 2 == 0:
+                raw_t.append(timed(raw))
+                named_t.append(timed(named))
+            else:
+                named_t.append(timed(named))
+                raw_t.append(timed(raw))
+        named_best = min(named_t)
+        raw_best = min(raw_t)
+        assert named_best <= raw_best * 1.02 + 0.002, (named_best, raw_best)
+
+
+# ================================= static graph + runtime cross-check
+
+class TestLockGraphCrossCheck:
+    def test_static_extraction_names_and_edges(self, tmp_path):
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def f(self):\n"
+            "        with self._cond:\n"
+            "            with self._memo_lock:\n"
+            "                pass\n")
+        p = tmp_path / "m.py"
+        p.write_text(src)
+        import ast
+        edges, where = lockstatic.static_graph(
+            [("trivy_tpu/sched/scheduler.py", ast.parse(src))])
+        assert edges == {
+            "sched.scheduler._cond": {"sched.scheduler._memo_lock"}}
+        assert where[("sched.scheduler._cond",
+                      "sched.scheduler._memo_lock")][1] == 5
+
+    def test_real_tree_static_graph_acyclic(self):
+        project = rules.Project(REPO_ROOT)
+        edges, _ = lockstatic.static_graph(
+            [(pf.relpath, pf.tree) for pf in project.files()
+             if pf.relpath.startswith("trivy_tpu/")])
+        assert witness.find_cycle(edges) is None, edges
+
+    def test_runtime_union_static_acyclic(self, monkeypatch):
+        """Drive REAL concurrency (scheduler micro-batches over a real
+        host-oracle engine, 4 submitting threads) under the witness,
+        then union the runtime graph with the whole-tree static graph:
+        one combined order check across both halves."""
+        import random
+
+        from trivy_tpu.db import Advisory, AdvisoryDB
+        from trivy_tpu.detector.engine import MatchEngine, PkgQuery
+        from trivy_tpu.sched.scheduler import MatchScheduler
+
+        monkeypatch.setenv(witness.ENV, "1")
+        witness.WITNESS.reset()
+        try:
+            db = AdvisoryDB()
+            for i in range(16):
+                db.put_advisory("npm::ghsa", f"pkg{i}", Advisory(
+                    vulnerability_id=f"CVE-2025-{i}",
+                    vulnerable_versions=[f"<{(i % 4) + 1}.0.0"]))
+            engine = MatchEngine(db, use_device=False)
+            sched = MatchScheduler(lambda: engine, window_ms=3.0)
+            try:
+                rng = random.Random(7)
+
+                def submit():
+                    qs = [PkgQuery("npm::", f"pkg{rng.randrange(16)}",
+                                   f"{rng.randrange(5)}.0.0", "npm")
+                          for _ in range(32)]
+                    sched.submit(qs)
+
+                threads = [threading.Thread(target=submit)
+                           for _ in range(4)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            finally:
+                sched.close()
+            runtime = witness.WITNESS.edges()
+            # single-lock acquisitions record no edges (that IS the
+            # discipline working) — but the wiring must be live
+            assert witness.WITNESS.acquired_total() > 0, \
+                "witness saw no acquisitions — make_lock wiring broken?"
+            project = rules.Project(REPO_ROOT)
+            static, _ = lockstatic.static_graph(
+                [(pf.relpath, pf.tree) for pf in project.files()
+                 if pf.relpath.startswith("trivy_tpu/")])
+            combined = lockstatic.union(runtime, static)
+            cyc = witness.find_cycle(combined)
+            assert cyc is None, (cyc, witness.WITNESS.report())
+        finally:
+            witness.WITNESS.reset()
+
+
+# =============================================== faults.SITES export
+
+class TestFaultSitesExport:
+    def test_structured_grammar(self):
+        from trivy_tpu.resilience import faults
+        sites = dict(faults.SITES)
+        assert "sched.submit" in sites
+        assert "analysis.fetch" in sites
+        for site, actions in faults.SITES:
+            assert actions, site
+            assert set(actions) <= faults.ACTIONS, site
+
+    def test_grammar_matches_docs(self):
+        with open(os.path.join(REPO_ROOT, "docs", "resilience.md"),
+                  encoding="utf-8") as f:
+            doc = f.read()
+        from trivy_tpu.resilience import faults
+        for site, _ in faults.SITES:
+            assert site in doc, site

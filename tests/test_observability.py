@@ -693,6 +693,7 @@ class TestCliTraceSmoke:
 
 @obs
 @pytest.mark.slow
+@pytest.mark.no_lock_witness  # witness wrappers on in-test locks skew the real-vs-stub delta
 class TestDisabledOverheadGuard:
     """Tracing/metrics off must not measurably slow a local scan:
     compare the real (instrumented-but-disabled) scan against one with

@@ -1,0 +1,28 @@
+"""Project invariant linter + concurrency witness (docs/static-analysis.md).
+
+Six PRs of threaded serving work (scheduler micro-batches, pipeline
+lanes, layer singleflight, fleet lanes, TTL server gates) rest on
+conventions no tool enforced: durable writes go through
+``durability/atomic.py``, fault sites appear in the ``faults.py``
+grammar and docs, ``trivy_tpu_*`` metrics are cataloged with bounded
+labels, cross-thread submissions use the capture/adopt tracing idiom,
+``TRIVY_TPU_*`` knobs are declared and documented, and named locks are
+acquired in one global order.  This package machine-checks all of it:
+
+- ``analysis.lint`` — AST project linter (``python -m
+  trivy_tpu.analysis.lint`` or the ``lint`` CLI subcommand) with a
+  pluggable rule framework, inline suppressions, a JSON report mode
+  and a baseline file for staged fixes.
+- ``analysis.witness`` — opt-in (``TRIVY_TPU_LOCK_WITNESS=1``) runtime
+  lock-acquisition-order graph over the project's named locks, with
+  cycle detection at test teardown.
+- ``analysis.lockstatic`` — static companion: extracts ``with <lock>``
+  nesting from the AST and cross-checks it against the witnessed
+  runtime graph.
+- ``analysis.knobs`` — the central ``TRIVY_TPU_*`` env-knob registry
+  that ``docs/knobs.md`` is generated from.
+
+This ``__init__`` stays import-light on purpose: production modules
+import ``analysis.witness`` at module load to name their locks, and
+that import must not drag in the AST machinery.
+"""

@@ -1,0 +1,176 @@
+"""Central registry of every ``TRIVY_TPU_*`` environment knob.
+
+One source of truth: the ``env-knob`` lint rule fails when code reads a
+``TRIVY_TPU_*`` variable that is not declared here (or declares one
+nothing reads), and ``docs/knobs.md`` is GENERATED from this table —
+the rule also fails when that file is stale.  Regenerate with::
+
+    python -m trivy_tpu.analysis.lint --write-knobs-doc
+
+Exception by design: ``cli/config.py`` maps *every* CLI flag onto
+``TRIVY_TPU_<FLAG>`` dynamically; that wildcard family is documented
+below rather than enumerated.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+DOC_PATH = "docs/knobs.md"
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    default: str       # rendered verbatim; "" shows as (unset)
+    subsystem: str
+    kill_switch: bool  # "set to 0 restores the pre-feature path"
+    doc: str
+
+
+KNOBS: tuple[Knob, ...] = (
+    # --- resilience / fault injection
+    Knob("TRIVY_TPU_FAULTS", "", "resilience", False,
+         "Deterministic fault-injection plan (site:action@selector "
+         "grammar, docs/resilience.md); validated at startup."),
+    # --- scheduler (continuous batching)
+    Knob("TRIVY_TPU_SCHED", "1", "sched", True,
+         "Cross-request match scheduler; 0 restores the exact "
+         "per-request detect path."),
+    # --- detector pipeline
+    Knob("TRIVY_TPU_PIPELINE", "1", "detector", True,
+         "Double-buffered host/device match executor; 0 runs the "
+         "serial stage loop."),
+    Knob("TRIVY_TPU_PIPELINE_WORKERS", "(auto)", "detector", False,
+         "Crunch-lane thread count override for the pipelined "
+         "executor; malformed values warn and fall back."),
+    # --- artifact analysis pipeline
+    Knob("TRIVY_TPU_ANALYSIS_PIPELINE", "1", "fanal", True,
+         "Pipelined layer fetch/analyze with cross-image dedupe; 0 "
+         "restores the serial layer loop byte-identically."),
+    Knob("TRIVY_TPU_ANALYSIS_PREFETCH", "2", "fanal", False,
+         "Layer-prefetch depth: compressed layers allowed in flight "
+         "ahead of the analyzing thread."),
+    # --- compiled-DB cache
+    Knob("TRIVY_TPU_COMPILE_CACHE", "1", "tensorize", True,
+         "Persistent compiled-DB tensor cache; 0 recompiles from the "
+         "advisory DB on every start."),
+    # --- secret engine
+    Knob("TRIVY_TPU_SECRET_PROBE", "1", "secret", True,
+         "Hybrid-mode device-vs-host timing probe; 0 skips the probe "
+         "and uses the host AC path."),
+    Knob("TRIVY_TPU_SECRET_DEVICE_SHARE", "(scanner default)", "secret",
+         False,
+         "Byte fraction the hybrid secret split hands the device "
+         "anchor screen."),
+    # --- RPC
+    Knob("TRIVY_TPU_RPC_GZIP_MIN", "8192", "rpc", False,
+         "Minimum body size in bytes before the negotiated gzip wire "
+         "framing compresses a request/response."),
+    # --- observability
+    Knob("TRIVY_TPU_TRACE", "", "obs", False,
+         "Enable span collection without the --trace flag (1/true)."),
+    Knob("TRIVY_TPU_SLOW_SPAN_MS", "", "obs", False,
+         "Log any span exceeding this many milliseconds, even with "
+         "tracing off."),
+    Knob("TRIVY_TPU_JAX_TRACE_DIR", "", "obs", False,
+         "Directory for JAX profiler dumps alongside --trace-export."),
+    # --- analysis (this package)
+    Knob("TRIVY_TPU_LOCK_WITNESS", "", "analysis", False,
+         "1 wraps the project's named locks in the lock-order witness "
+         "(cycle detection at test teardown); off = raw primitives."),
+    # --- CLI / environment plumbing
+    Knob("TRIVY_TPU_CACHE_DIR", "~/.cache/trivy-tpu", "cli", False,
+         "Scan/artifact cache directory (same as --cache-dir)."),
+    Knob("TRIVY_TPU_USERNAME", "", "cli", False,
+         "Default registry username (same as --username)."),
+    Knob("TRIVY_TPU_PASSWORD", "", "cli", False,
+         "Default registry password (same as --password)."),
+    # --- utils
+    Knob("TRIVY_TPU_DETERMINISTIC_UUID", "", "utils", False,
+         "1 makes scan/lane UUIDs a deterministic sequence so fleet "
+         "goldens byte-match."),
+    Knob("TRIVY_TPU_FAKE_TIME", "", "utils", False,
+         "Fixed ISO timestamp for the report clock (golden tests)."),
+    # --- modules / native
+    Knob("TRIVY_TPU_TRUST_STORE", "", "module", False,
+         "Override path for the scan-module trust manifest."),
+    Knob("TRIVY_TPU_NATIVE_DIR", "~/.cache/trivy-tpu/native", "native",
+         False,
+         "Build/cache directory for the native AC helper library."),
+    # --- bench harness (bench.py only)
+    Knob("TRIVY_TPU_DEVICE_WAIT", "900", "bench", False,
+         "Total seconds bench.py spends acquiring the device before "
+         "falling back to CPU."),
+    Knob("TRIVY_TPU_MICRO_WAIT", "600", "bench", False,
+         "Per-attempt device-acquire budget for the bench supervisor."),
+    Knob("TRIVY_TPU_FORCE_CPU", "", "bench", False,
+         "1 pins the bench child to the CPU backend."),
+    Knob("TRIVY_TPU_BENCH_ADVISORIES", "500000", "bench", False,
+         "Synthetic advisory-DB size for the bench run."),
+    Knob("TRIVY_TPU_BENCH_QUERIES", "240000", "bench", False,
+         "Synthetic package-query count for the bench crawl."),
+    Knob("TRIVY_TPU_BENCH_NO_PROBE", "", "bench", False,
+         "1 skips the subprocess device probe."),
+    Knob("TRIVY_TPU_BENCH_RUN_TIMEOUT", "1500", "bench", False,
+         "Seconds before the supervisor kills a wedged bench child."),
+    Knob("TRIVY_TPU_BENCH_CHILD", "", "bench", False,
+         "Internal: set by the supervisor on the re-exec'd child."),
+    Knob("TRIVY_TPU_BENCH_DEVICE_STATUS", "unknown", "bench", False,
+         "Internal: device probe verdict handed to the child."),
+    Knob("TRIVY_TPU_BENCH_PHASE_JSON", "", "bench", False,
+         "Internal: --phase-json path surviving the supervised "
+         "re-exec."),
+    Knob("TRIVY_TPU_BENCH_SCHED_CLIENTS", "8", "bench", False,
+         "Concurrent keep-alive clients in the serving bench."),
+    Knob("TRIVY_TPU_BENCH_SCHED_SCANS", "6", "bench", False,
+         "Scans per client in the serving bench."),
+    Knob("TRIVY_TPU_BENCH_ANALYSIS_IMAGES", "10", "bench", False,
+         "Synthetic-registry image count in the analysis bench."),
+)
+
+
+
+def generate_knobs_md(knob_list=None) -> str:
+    """The exact content of docs/knobs.md (byte-compared by the
+    ``env-knob`` lint rule; regenerate via --write-knobs-doc).
+    ``knob_list`` lets the linter render from the LINTED tree's
+    extracted table so ``--root worktree`` staleness is judged against
+    the worktree's own registry; default is this module's KNOBS."""
+    knob_list = KNOBS if knob_list is None else knob_list
+    lines = [
+        "# `TRIVY_TPU_*` environment knobs",
+        "",
+        "<!-- GENERATED from trivy_tpu/analysis/knobs.py — do not edit",
+        "     by hand.  Regenerate with:",
+        "         python -m trivy_tpu.analysis.lint --write-knobs-doc",
+        "     The env-knob lint rule fails when this file is stale. -->",
+        "",
+        "Every environment variable the scanner reads, from one",
+        "registry (`trivy_tpu/analysis/knobs.py`).  *Kill-switch — yes*",
+        "means setting the knob to `0` restores the exact pre-feature",
+        "code path (the zero-diff escape hatch for each perf layer).",
+        "",
+        "| Name | Default | Subsystem | Kill-switch | What it does |",
+        "|---|---|---|---|---|",
+    ]
+    for k in sorted(knob_list, key=lambda k: (k.subsystem, k.name)):
+        default = f"`{k.default}`" if k.default else "(unset)"
+        lines.append(
+            f"| `{k.name}` | {default} | {k.subsystem} | "
+            f"{'yes' if k.kill_switch else 'no'} | {k.doc} |")
+    lines += [
+        "",
+        "Additionally, **every CLI flag** is settable as",
+        "`TRIVY_TPU_<FLAG>` (upper-cased, `-` → `_`): explicit",
+        "command-line values win, then the environment, then the",
+        "config file (`trivy_tpu/cli/config.py`).  That wildcard",
+        "family is intentionally not enumerated here.",
+        "",
+        "See [docs/performance.md](performance.md) for what the",
+        "perf-layer kill-switches disable, and",
+        "[docs/static-analysis.md](static-analysis.md) for the lint",
+        "rule that keeps this table honest.",
+        "",
+    ]
+    return "\n".join(lines)

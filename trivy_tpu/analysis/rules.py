@@ -1,0 +1,892 @@
+"""Pluggable AST rule framework + the project's invariant rules.
+
+A rule is a class with an ``id``, a one-line ``summary``, a
+``rationale`` (why the invariant exists — shown by ``--list-rules``
+and docs/static-analysis.md), and a ``check(project)`` generator of
+:class:`Finding`.  Register with ``@register``; the lint driver runs
+every registered rule unless ``--rule`` narrows the set.
+
+Suppressions (see docs/static-analysis.md):
+
+- inline — ``# lint: allow[rule-id] reason`` on the flagged line or
+  the line directly above.  A suppression with no reason is itself a
+  finding: the comment is the review trail.
+- baseline — a JSON file of ``{"rule", "path", "reason"}`` entries so
+  a PR can land enforcement before every legacy finding is fixed.
+
+Rules read the tree through :class:`Project`, which seeded-violation
+tests instantiate over a synthetic mini-tree (and override the
+declared fault-site / knob tables) to prove each rule actually fires.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+
+from trivy_tpu.analysis import lockstatic
+
+SUPPRESS_RX = re.compile(
+    r"#\s*lint:\s*allow\[(?P<rules>[a-z0-9_,\- ]+)\]\s*(?P<reason>.*)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str   # project-root-relative, forward slashes
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+class PyFile:
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+
+
+class Project:
+    """The linted file set: ``trivy_tpu/**/*.py`` plus ``bench.py``,
+    tests excluded (they seed violations on purpose).  Declared tables
+    (fault sites, knobs) default to the real registries; tests override
+    the attributes to exercise coherence rules in isolation."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._files: dict[str, PyFile] = {}
+        self._load_order: list[str] = []
+        self._collect()
+        self.declared_fault_sites = self._extract_fault_sites()
+        self.declared_fault_actions = self._extract_fault_actions()
+        self.declared_knobs = self._extract_knobs()
+
+    def _collect(self) -> None:
+        pkg = os.path.join(self.root, "trivy_tpu")
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    self._add(os.path.join(dirpath, fn))
+        bench = os.path.join(self.root, "bench.py")
+        if os.path.exists(bench):
+            self._add(bench)
+
+    def _add(self, path: str) -> None:
+        rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        self._files[rel] = PyFile(rel, source)
+        self._load_order.append(rel)
+
+    def files(self) -> list[PyFile]:
+        return [self._files[r] for r in self._load_order]
+
+    def file(self, relpath: str) -> PyFile | None:
+        return self._files.get(relpath)
+
+    def doc_text(self, relname: str) -> str | None:
+        path = os.path.join(self.root, relname)
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+    # The declared registries come from the LINTED tree's own source
+    # (AST-extracted — both tables are literal enough), not from the
+    # interpreter's imported trivy_tpu package: `lint --root WORKTREE`
+    # must validate the worktree against the worktree's registries.
+    # Trees without the registry file (seeded mini-projects) fall back
+    # to the real import, and tests override the attributes directly.
+
+    def _registry_assign(self, relpath: str, name: str):
+        pf = self.file(relpath)
+        if pf is None:
+            return None
+        for node in pf.tree.body:
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target]
+                       if isinstance(node, ast.AnnAssign) else [])
+            if any(isinstance(t, ast.Name) and t.id == name
+                   for t in targets):
+                return node.value
+        return None
+
+    def _extract_fault_sites(self):
+        value = self._registry_assign(
+            "trivy_tpu/resilience/faults.py", "SITES")
+        if value is not None:
+            try:
+                return [(s, tuple(a)) for s, a in ast.literal_eval(value)]
+            except (ValueError, TypeError):
+                pass
+        return self._real_fault_sites()
+
+    def _extract_fault_actions(self):
+        value = self._registry_assign(
+            "trivy_tpu/resilience/faults.py", "ACTIONS")
+        if value is not None:
+            try:
+                return set(ast.literal_eval(value))
+            except (ValueError, TypeError):
+                pass
+        try:
+            from trivy_tpu.resilience import faults
+            return set(faults.ACTIONS)
+        except ImportError:
+            return None  # no action vocabulary known -> skip the check
+
+    def _extract_knobs(self):
+        value = self._registry_assign(
+            "trivy_tpu/analysis/knobs.py", "KNOBS")
+        if isinstance(value, ast.Tuple):
+            try:
+                from trivy_tpu.analysis.knobs import Knob
+                return [Knob(*[ast.literal_eval(a) for a in c.args],
+                             **{k.arg: ast.literal_eval(k.value)
+                                for k in c.keywords})
+                        for c in value.elts]
+            except Exception:  # malformed table -> import fallback
+                pass
+        return self._real_knobs()
+
+    @staticmethod
+    def _real_fault_sites():
+        try:
+            from trivy_tpu.resilience import faults
+            return list(getattr(faults, "SITES", ()))
+        except ImportError:  # seeded mini-projects override anyway
+            return []
+
+    @staticmethod
+    def _real_knobs():
+        from trivy_tpu.analysis import knobs
+        return list(knobs.KNOBS)
+
+
+# -------------------------------------------------------------- registry
+
+RULES: dict[str, type] = {}
+
+
+def register(cls):
+    RULES[cls.id] = cls
+    return cls
+
+
+class Rule:
+    id = ""
+    summary = ""
+    rationale = ""
+
+    def check(self, project: Project):
+        raise NotImplementedError
+
+
+# ------------------------------------------------------------- helpers
+
+def _const_str(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _func_tail(func) -> str | None:
+    """Rightmost identifier of a call target: ``a.b.c(...)`` -> ``c``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _module_consts(tree: ast.Module) -> dict[str, str]:
+    """Module-level ``NAME = "literal"`` string constants."""
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            val = _const_str(node.value)
+            if val is not None:
+                out[node.targets[0].id] = val
+    return out
+
+
+def _walk_with_parents(tree):
+    """Yield (node, func_stack) — the enclosing FunctionDef chain."""
+    stack: list[ast.AST] = []
+
+    def rec(node):
+        is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        if is_fn:
+            stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            yield child, tuple(stack)
+            yield from rec(child)
+        if is_fn:
+            stack.pop()
+
+    yield from rec(tree)
+
+
+# ======================================================= 1. atomic-write
+
+@register
+class AtomicWriteRule(Rule):
+    id = "atomic-write"
+    summary = ("raw open-for-write / os.replace outside durability/ — "
+               "durable state must use durability.atomic")
+    rationale = (
+        "PR 2 made every persistent write crash-safe (tmp + fsync + "
+        "rename + checksum framing). A raw open(path, 'w') reintroduces "
+        "torn-write windows the whole durability matrix exists to "
+        "close. User-facing output streams are legitimate — suppress "
+        "those with a reason.")
+
+    SCOPE = "trivy_tpu/"
+    EXEMPT = ("trivy_tpu/durability/",)
+
+    def check(self, project: Project):
+        for pf in project.files():
+            if not pf.relpath.startswith(self.SCOPE):
+                continue
+            if pf.relpath.startswith(self.EXEMPT):
+                continue
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = _func_tail(node.func)
+                if tail == "open" and isinstance(node.func, ast.Name):
+                    mode = None
+                    if len(node.args) >= 2:
+                        mode = _const_str(node.args[1])
+                    for kw in node.keywords:
+                        if kw.arg == "mode":
+                            mode = _const_str(kw.value)
+                    if mode and any(c in mode for c in "wax"):
+                        yield Finding(
+                            self.id, pf.relpath, node.lineno,
+                            f"raw open(..., {mode!r}) — persistent state "
+                            "must go through durability.atomic."
+                            "atomic_write (suppress for user-facing "
+                            "output streams)")
+                elif (tail == "replace"
+                      and isinstance(node.func, ast.Attribute)
+                      and isinstance(node.func.value, ast.Name)
+                      and node.func.value.id == "os"):
+                    yield Finding(
+                        self.id, pf.relpath, node.lineno,
+                        "os.replace outside durability/ — promote via "
+                        "durability.atomic (or suppress if this IS an "
+                        "atomic-publish idiom)")
+
+
+# ========================================================= 2. fault-site
+
+@register
+class FaultSiteRule(Rule):
+    id = "fault-site"
+    summary = ("every fault site used in code ⇔ declared in "
+               "faults.SITES ⇔ listed in docs/resilience.md")
+    rationale = (
+        "The fault matrix is only as good as its site list: an "
+        "instrumented call site missing from the grammar cannot be "
+        "exercised by TRIVY_TPU_FAULTS specs, and a documented site "
+        "that no code fires is a matrix hole reviewers trust but "
+        "nothing tests. faults.SITES is the single source of truth.")
+
+    FAULT_FNS = {"fire", "check_kill", "check_device", "mangle_write"}
+    # site families synthesized at runtime by faults.rpc_site(), never
+    # appearing as code literals
+    DYNAMIC_FAMILIES = {"rpc", "rpc.scan", "rpc.cache"}
+    DOC = "docs/resilience.md"
+
+    def _used_sites(self, project: Project):
+        # a site counts as USED only when it flows into a fault call
+        # (directly or via a module constant) — a surviving *_SITE
+        # constant whose fire() was deleted must not mask the
+        # declared-but-never-fired check
+        used: dict[str, tuple[str, int]] = {}
+        for pf in project.files():
+            consts = _module_consts(pf.tree)
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = _func_tail(node.func)
+                if tail in self.FAULT_FNS and node.args:
+                    site = _const_str(node.args[0])
+                    if site is None and isinstance(node.args[0], ast.Name):
+                        site = consts.get(node.args[0].id)
+                    if site:
+                        used.setdefault(site, (pf.relpath, node.lineno))
+                for kw in node.keywords:
+                    if kw.arg == "fault_site":
+                        site = _const_str(kw.value)
+                        if site is None and isinstance(kw.value, ast.Name):
+                            site = consts.get(kw.value.id)
+                        if site:
+                            used.setdefault(site,
+                                            (pf.relpath, node.lineno))
+        return used
+
+    @staticmethod
+    def _covered(site: str, declared: set[str]) -> bool:
+        return site in declared or any(
+            site.startswith(d + ".") for d in declared)
+
+    def check(self, project: Project):
+        declared_pairs = project.declared_fault_sites
+        declared = {s for s, _ in declared_pairs}
+        faults_py = "trivy_tpu/resilience/faults.py"
+        if not declared_pairs:
+            yield Finding(self.id, faults_py, 1,
+                          "faults.SITES is missing or empty — the site "
+                          "grammar must be exported as structured data")
+            return
+        valid_actions = project.declared_fault_actions
+        if valid_actions is not None:
+            for site, actions in declared_pairs:
+                for a in actions:
+                    if a not in valid_actions:
+                        yield Finding(
+                            self.id, faults_py, 1,
+                            f"SITES declares unknown action {a!r} for "
+                            f"site {site!r}")
+        used = self._used_sites(project)
+        for site, (path, line) in sorted(used.items()):
+            if not self._covered(site, declared):
+                yield Finding(
+                    self.id, path, line,
+                    f"fault site {site!r} used in code but not declared "
+                    "in faults.SITES")
+        for site in sorted(declared):
+            if site in self.DYNAMIC_FAMILIES:
+                continue
+            if site not in used and not any(
+                    u == site or u.startswith(site + ".") for u in used):
+                yield Finding(
+                    self.id, faults_py, 1,
+                    f"fault site {site!r} declared in faults.SITES but "
+                    "no code fires it")
+        doc = project.doc_text(self.DOC)
+        if doc is None:
+            yield Finding(self.id, self.DOC, 1,
+                          "docs/resilience.md missing — the fault-site "
+                          "grammar must be documented")
+        else:
+            doc_sites = self._doc_sites(doc)
+            for site in sorted(declared):
+                listed = (site in doc_sites if doc_sites is not None
+                          else site in doc)
+                if not listed:
+                    yield Finding(
+                        self.id, self.DOC, 1,
+                        f"declared fault site {site!r} not listed in "
+                        "docs/resilience.md")
+            for site in sorted(doc_sites or ()):
+                if not self._covered(site, declared):
+                    yield Finding(
+                        self.id, self.DOC, 1,
+                        f"doc grammar lists fault site {site!r} but "
+                        "faults.SITES does not declare it")
+
+    @staticmethod
+    def _doc_sites(doc: str):
+        """Tokens of the doc's ``site :=`` grammar production (exact
+        set — substring matching against prose is unsound: deleting
+        ``db.save`` would still 'match' inside ``db.save.metadata``).
+        None when the doc has no parseable production; the declared→doc
+        check then degrades to the substring test and the reverse
+        direction is skipped (seeded mini-project docs)."""
+        m = re.search(r"^site\s*:=(.*(?:\n\s*\|.*)*)", doc, re.M)
+        if not m:
+            return None
+        return {t for t in re.split(r"[|\s]+", m.group(1)) if t}
+
+
+# ======================================================== 3. metric-name
+
+@register
+class MetricNameRule(Rule):
+    id = "metric-name"
+    summary = ("every trivy_tpu_* metric: registered snake_case, "
+               "bounded literal label set, cataloged in "
+               "docs/observability.md (both directions)")
+    rationale = (
+        "Dashboards and alerts key on metric names; PR 3's golden test "
+        "keeps old names byte-stable but nothing stopped NEW metrics "
+        "from skipping the docs catalog or declaring open-ended label "
+        "sets. The registry bounds series cardinality at runtime — "
+        "this rule bounds it at review time.")
+
+    NAME_RX = re.compile(r"^trivy_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
+    REG_FNS = {"counter", "gauge", "histogram"}
+    DOC = "docs/observability.md"
+    DOC_ROW_RX = re.compile(r"\|\s*`(trivy_tpu_[a-zA-Z0-9_]+)`")
+
+    def check(self, project: Project):
+        registered: dict[str, tuple[str, int]] = {}
+        for pf in project.files():
+            for node in ast.walk(pf.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self.REG_FNS
+                        and node.args):
+                    continue
+                name = _const_str(node.args[0])
+                if name is None or not name.startswith("trivy_tpu_"):
+                    continue
+                registered.setdefault(name, (pf.relpath, node.lineno))
+                if not self.NAME_RX.match(name):
+                    yield Finding(
+                        self.id, pf.relpath, node.lineno,
+                        f"metric {name!r} is not snake_case "
+                        "(trivy_tpu_[a-z0-9_]+)")
+                labels = None
+                for kw in node.keywords:
+                    if kw.arg == "labels":
+                        labels = kw.value
+                if labels is not None and not (
+                        isinstance(labels, (ast.Tuple, ast.List))
+                        and all(_const_str(e) is not None
+                                for e in labels.elts)):
+                    yield Finding(
+                        self.id, pf.relpath, node.lineno,
+                        f"metric {name!r}: labels must be a literal "
+                        "tuple of names (a computed label set defeats "
+                        "the cardinality bound)")
+        doc = project.doc_text(self.DOC)
+        if doc is None:
+            yield Finding(self.id, self.DOC, 1,
+                          "docs/observability.md missing — the metric "
+                          "catalog lives there")
+            return
+        # both directions match against parsed catalog ROWS — a prose
+        # mention of the name elsewhere in the doc is not a catalog entry
+        doc_names = set(self.DOC_ROW_RX.findall(doc))
+        for name, (path, line) in sorted(registered.items()):
+            if name not in doc_names:
+                yield Finding(
+                    self.id, path, line,
+                    f"metric {name!r} registered but absent from the "
+                    "docs/observability.md catalog")
+        for name in sorted(doc_names):
+            if name not in registered:
+                yield Finding(
+                    self.id, self.DOC, 1,
+                    f"docs/observability.md catalogs {name!r} but no "
+                    "code registers it")
+
+
+# ========================================================== 4. env-knob
+
+@register
+class EnvKnobRule(Rule):
+    id = "env-knob"
+    summary = ("every TRIVY_TPU_* env read declared in analysis.knobs "
+               "(and vice versa); docs/knobs.md regenerated")
+    rationale = (
+        "Undocumented knobs are how operators discover behavior by "
+        "reading source at 3am. The knobs table is the contract: every "
+        "read is declared with a default and doc line, every declared "
+        "knob is actually read, and docs/knobs.md is generated from "
+        "the table so it cannot drift.")
+
+    ENV_FNS = {"get", "pop", "getenv"}
+    DOC = "docs/knobs.md"
+
+    @staticmethod
+    def _is_environ(node) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and node.attr == "environ"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "os")
+
+    def _key_name(self, node, consts) -> tuple[str | None, bool]:
+        """-> (resolved TRIVY_TPU_* name or None, is_dynamic)."""
+        val = _const_str(node)
+        if val is None and isinstance(node, ast.Name):
+            val = consts.get(node.id)
+        if val is not None:
+            return (val, False) if val.startswith("TRIVY_TPU_") else \
+                (None, False)
+        # computed key: dynamic iff any resolvable fragment carries the
+        # prefix (cli/config.py's ENV_PREFIX + flag wildcard)
+        for sub in ast.walk(node):
+            frag = _const_str(sub)
+            if frag is None and isinstance(sub, ast.Name):
+                frag = consts.get(sub.id)
+            if frag and frag.startswith("TRIVY_TPU_"):
+                return None, True
+        return None, False
+
+    def _reads(self, pf: PyFile):
+        consts = _module_consts(pf.tree)
+        for node in ast.walk(pf.tree):
+            key = None
+            if isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in self.ENV_FNS
+                        and (self._is_environ(func.value)
+                             or (isinstance(func.value, ast.Name)
+                                 and func.value.id == "os"
+                                 and func.attr == "getenv"))
+                        and node.args):
+                    key = node.args[0]
+            elif isinstance(node, ast.Subscript):
+                if self._is_environ(node.value):
+                    key = node.slice
+            elif isinstance(node, ast.Compare):
+                if (len(node.ops) == 1
+                        and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                        and self._is_environ(node.comparators[0])):
+                    key = node.left
+            if key is None:
+                continue
+            name, dynamic = self._key_name(key, consts)
+            if name is not None:
+                yield name, node.lineno, False
+            elif dynamic:
+                yield "", node.lineno, True
+
+    def check(self, project: Project):
+        declared = {k.name for k in project.declared_knobs}
+        knobs_py = "trivy_tpu/analysis/knobs.py"
+        read: set[str] = set()
+        for pf in project.files():
+            for name, line, dynamic in self._reads(pf):
+                if dynamic:
+                    yield Finding(
+                        self.id, pf.relpath, line,
+                        "dynamic TRIVY_TPU_* env read — computed knob "
+                        "names bypass the registry (suppress with the "
+                        "wildcard's contract if intentional)")
+                    continue
+                read.add(name)
+                if name not in declared:
+                    yield Finding(
+                        self.id, pf.relpath, line,
+                        f"env knob {name!r} read here but not declared "
+                        "in analysis.knobs.KNOBS")
+        for name in sorted(declared - read):
+            yield Finding(
+                self.id, knobs_py, 1,
+                f"knob {name!r} declared but nothing reads it")
+        doc = project.doc_text(self.DOC)
+        if doc is not None or project.doc_text("README.md") is not None:
+            # staleness is judged against the LINTED tree's extracted
+            # table (a --root worktree that adds a knob but forgets to
+            # regenerate must fail); seeded mini-projects have no docs/
+            # at all -> doc is None AND no README -> skip
+            from trivy_tpu.analysis import knobs as knobs_mod
+            want = knobs_mod.generate_knobs_md(project.declared_knobs)
+            if doc is None:
+                yield Finding(
+                    self.id, self.DOC, 1,
+                    "docs/knobs.md missing — generate it with "
+                    "`python -m trivy_tpu.analysis.lint "
+                    "--write-knobs-doc`")
+            elif doc != want:
+                yield Finding(
+                    self.id, self.DOC, 1,
+                    "docs/knobs.md is stale vs analysis.knobs — "
+                    "regenerate with `python -m "
+                    "trivy_tpu.analysis.lint --write-knobs-doc`")
+
+
+# ==================================================== 5. monotonic-clock
+
+@register
+class MonotonicClockRule(Rule):
+    id = "monotonic-clock"
+    summary = ("time.time() banned in retry/deadline/scheduler "
+               "arithmetic — use time.monotonic()")
+    rationale = (
+        "Wall clocks jump (NTP step, VM resume); a deadline computed "
+        "from time.time() can expire a request instantly or never. "
+        "Elapsed-time math in the timing-sensitive modules must use "
+        "the monotonic clock. Wall-clock timestamps persisted for "
+        "humans (journals, report clocks, mtime comparisons) live "
+        "outside this scope or carry a suppression.")
+
+    SCOPE = (
+        "trivy_tpu/resilience/", "trivy_tpu/sched/", "trivy_tpu/rpc/",
+        "trivy_tpu/fanal/", "trivy_tpu/detector/", "trivy_tpu/cache/",
+        "trivy_tpu/utils/pipeline.py", "trivy_tpu/k8s/node_collector.py",
+    )
+
+    def check(self, project: Project):
+        for pf in project.files():
+            if not pf.relpath.startswith(self.SCOPE):
+                continue
+            for node in ast.walk(pf.tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "time"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "time"):
+                    yield Finding(
+                        self.id, pf.relpath, node.lineno,
+                        "time.time() in a timing-sensitive module — "
+                        "use time.monotonic() for elapsed/deadline "
+                        "math (suppress only for persisted wall-clock "
+                        "timestamps)")
+
+
+# =================================================== 6. tracing-capture
+
+@register
+class TracingCaptureRule(Rule):
+    id = "tracing-capture"
+    summary = ("callables handed to threads/executors in "
+               "obs-instrumented modules must capture/adopt the "
+               "tracing context")
+    rationale = (
+        "PR 3's single-trace-tree guarantee depends on every "
+        "cross-thread handoff using tracing.capture() in the submitter "
+        "and tracing.adopt() in the worker; one missed handoff turns a "
+        "scan's spans into orphaned roots and breaks trace-correlated "
+        "log grepping. Server accept loops with no ambient scan "
+        "context suppress with that reason.")
+
+    SCOPE = "trivy_tpu/"
+    EXECUTOR_RX = re.compile(r"(^|_)(ex|executor|pool)$|executor",
+                             re.IGNORECASE)
+
+    @staticmethod
+    def _module_instrumented(pf: PyFile) -> bool:
+        return "trivy_tpu.obs" in pf.source
+
+    @staticmethod
+    def _has_capture(node) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                tail = _func_tail(sub.func)
+                if tail in ("capture", "adopt"):
+                    return True
+        return False
+
+    def check(self, project: Project):
+        for pf in project.files():
+            if not pf.relpath.startswith(self.SCOPE):
+                continue
+            if not self._module_instrumented(pf):
+                continue
+            # class name -> ClassDef, for resolving self.<method> targets
+            classes = {n.name: n for n in ast.walk(pf.tree)
+                       if isinstance(n, ast.ClassDef)}
+            class_of_fn: dict[ast.AST, ast.ClassDef] = {}
+            for cls in classes.values():
+                for item in cls.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        class_of_fn[item] = cls
+            module_fns = {n.name: n for n in pf.tree.body
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))}
+            for node, fn_stack in _walk_with_parents(pf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = None
+                kind = None
+                tail = _func_tail(node.func)
+                if tail == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                            kind = "threading.Thread"
+                elif (tail == "submit"
+                      and isinstance(node.func, ast.Attribute)):
+                    recv = node.func.value
+                    recv_name = (recv.id if isinstance(recv, ast.Name)
+                                 else recv.attr
+                                 if isinstance(recv, ast.Attribute)
+                                 else "")
+                    if recv_name and self.EXECUTOR_RX.search(recv_name) \
+                            and node.args:
+                        target = node.args[0]
+                        kind = f"{recv_name}.submit"
+                if target is None:
+                    continue
+                # pass if the enclosing function captures/adopts ...
+                if fn_stack and self._has_capture(fn_stack[-1]):
+                    continue
+                # ... or the resolved target function / its class does
+                resolved = None
+                if isinstance(target, ast.Name):
+                    resolved = module_fns.get(target.id)
+                elif (isinstance(target, ast.Attribute)
+                      and isinstance(target.value, ast.Name)
+                      and target.value.id == "self" and fn_stack):
+                    cls = class_of_fn.get(fn_stack[-1])
+                    if cls is not None:
+                        resolved = cls  # whole class: worker methods
+                        # often delegate adopt() to a helper method
+                if resolved is not None and self._has_capture(resolved):
+                    continue
+                yield Finding(
+                    self.id, pf.relpath, node.lineno,
+                    f"{kind} handoff in an obs-instrumented module "
+                    "without tracing.capture()/adopt() — worker spans "
+                    "will orphan from the submitting scan's trace")
+
+
+# ====================================================== 7. bare-except
+
+@register
+class BareExceptRule(Rule):
+    id = "bare-except"
+    summary = ("no bare `except:`; `except BaseException` must "
+               "re-raise (or carry a suppression explaining delivery)")
+    rationale = (
+        "InjectedKill is a BaseException precisely so crash simulations "
+        "unwind without cleanup handlers running; a handler that "
+        "swallows BaseException also swallows the injected kill, "
+        "KeyboardInterrupt and interpreter shutdown. Handlers that "
+        "transport the exception to another thread re-raise there — "
+        "they suppress with that reason.")
+
+    def check(self, project: Project):
+        for pf in project.files():
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if node.type is None:
+                    yield Finding(
+                        self.id, pf.relpath, node.lineno,
+                        "bare `except:` — name the exception type "
+                        "(this also catches KeyboardInterrupt and "
+                        "InjectedKill)")
+                    continue
+                names = []
+                types = (node.type.elts
+                         if isinstance(node.type, ast.Tuple)
+                         else [node.type])
+                for t in types:
+                    if isinstance(t, ast.Name):
+                        names.append(t.id)
+                if "BaseException" not in names:
+                    continue
+                if any(isinstance(sub, ast.Raise)
+                       for sub in ast.walk(node)):
+                    continue
+                yield Finding(
+                    self.id, pf.relpath, node.lineno,
+                    "`except BaseException` without a re-raise — this "
+                    "swallows InjectedKill / KeyboardInterrupt; "
+                    "re-raise or suppress with the delivery path")
+
+
+# ======================================================= 8. lock-order
+
+@register
+class LockOrderRule(Rule):
+    id = "lock-order"
+    summary = ("the static `with <lock>` nesting graph must be acyclic "
+               "(companion to the runtime witness)")
+    rationale = (
+        "A lock-order cycle is a deadlock waiting for the right "
+        "interleaving. The runtime witness sees real acquisitions in "
+        "the concurrency tests; this static pass sees every nesting "
+        "the code spells out, so an inversion is caught even when no "
+        "test drives both arms. The two graphs share one naming "
+        "convention and are unioned in tests/test_analysis.py.")
+
+    SCOPE = "trivy_tpu/"
+
+    def check(self, project: Project):
+        files = [(pf.relpath, pf.tree) for pf in project.files()
+                 if pf.relpath.startswith(self.SCOPE)]
+        edges, where = lockstatic.static_graph(files)
+        cyc = lockstatic_find_cycle(edges)
+        if cyc:
+            spots = []
+            for a, b in zip(cyc, cyc[1:]):
+                path, line = where.get((a, b), ("?", 0))
+                spots.append(f"{a} -> {b} ({path}:{line})")
+            first = where.get((cyc[0], cyc[1]), ("trivy_tpu", 1))
+            yield Finding(
+                self.id, first[0], first[1],
+                "static lock-order cycle: " + "; ".join(spots))
+
+
+def lockstatic_find_cycle(edges):
+    from trivy_tpu.analysis.witness import find_cycle
+    return find_cycle(edges)
+
+
+# ----------------------------------------------------------- the driver
+
+def _suppression_for(pf: PyFile | None, finding: Finding):
+    """-> ("ok" | "missing-reason" | None)."""
+    if pf is None:
+        return None
+    for ln in (finding.line, finding.line - 1):
+        if 1 <= ln <= len(pf.lines):
+            m = SUPPRESS_RX.search(pf.lines[ln - 1])
+            if m:
+                rules = {r.strip() for r in m.group("rules").split(",")}
+                if finding.rule in rules:
+                    return "ok" if m.group("reason").strip() else \
+                        "missing-reason"
+    return None
+
+
+def load_baseline(path: str) -> list[dict]:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    entries = doc.get("findings", [])
+    for e in entries:
+        if not (e.get("rule") and e.get("path")):
+            raise ValueError(
+                "baseline entries need at least {rule, path}")
+    return entries
+
+
+def run(project: Project, rule_ids=None, baseline=None):
+    """Run rules -> (findings, suppressed).
+
+    ``baseline`` is a list of ``{"rule", "path", "reason"}`` dicts;
+    entries without a non-empty reason are reported as findings
+    (rule id ``baseline``) rather than honored — a baseline is
+    staged debt, not a mute button."""
+    baseline = baseline or []
+    base_ok = set()
+    findings: list[Finding] = []
+    suppressed: list[tuple[Finding, str]] = []
+    for e in baseline:
+        if str(e.get("reason", "")).strip():
+            base_ok.add((e["rule"], e["path"]))
+        else:
+            findings.append(Finding(
+                "baseline", e["path"], 0,
+                f"baseline entry for [{e['rule']}] has no reason — "
+                "baselines record justified debt, not mutes"))
+    for rid, cls in sorted(RULES.items()):
+        if rule_ids is not None and rid not in rule_ids:
+            continue
+        for f in cls().check(project):
+            sup = _suppression_for(project.file(f.path), f)
+            if sup == "ok":
+                suppressed.append((f, "inline"))
+            elif sup == "missing-reason":
+                findings.append(Finding(
+                    "suppression", f.path, f.line,
+                    f"suppression of [{f.rule}] has no reason — the "
+                    "comment is the review trail"))
+            elif (f.rule, f.path) in base_ok:
+                suppressed.append((f, "baseline"))
+            else:
+                findings.append(f)
+    return findings, suppressed

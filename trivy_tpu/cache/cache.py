@@ -17,6 +17,8 @@ import json
 import os
 import re
 import threading
+
+from trivy_tpu.analysis.witness import make_lock
 from dataclasses import asdict
 
 from trivy_tpu.durability import atomic
@@ -131,7 +133,7 @@ class FSCache(MemoryCache):
         from collections import OrderedDict
 
         self._stash: "OrderedDict[tuple[str, str], dict]" = OrderedDict()
-        self._stash_lock = threading.Lock()
+        self._stash_lock = make_lock("cache.cache._stash_lock")
 
     def _stash_put(self, bucket: str, key: str, doc: dict) -> None:
         with self._stash_lock:

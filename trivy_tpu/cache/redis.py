@@ -15,6 +15,8 @@ import json
 import socket
 import ssl
 import threading
+
+from trivy_tpu.analysis.witness import make_lock
 import urllib.parse
 from dataclasses import asdict
 
@@ -46,7 +48,7 @@ class RespClient:
             sock = ctx.wrap_socket(sock, server_hostname=host)
         self._sock = sock
         self._buf = b""
-        self._lock = threading.Lock()
+        self._lock = make_lock("cache.redis._lock")
         if password:
             args = ["AUTH", username, password] if username \
                 else ["AUTH", password]

@@ -162,6 +162,7 @@ def generate_default_config(path: str | None = None) -> str:
     path = path or "trivy-tpu.yaml"
     if os.path.exists(path):  # reference: refuses to clobber
         raise ValueError(f"config file already exists: {path}")
+    # lint: allow[atomic-write] user-requested --generate-default-config output, not program state
     with open(path, "w", encoding="utf-8") as f:
         f.write(DEFAULT_CONFIG)
     return path

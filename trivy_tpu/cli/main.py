@@ -407,6 +407,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("target")
 
     sub.add_parser("version", help="print version", allow_abbrev=False)
+
+    # `lint` shares the analysis package's flag definitions — one
+    # definition, so global flags may precede the subcommand and the
+    # CLI accepts exactly what `python -m trivy_tpu.analysis.lint` does
+    from trivy_tpu.analysis.lint import add_arguments as _lint_args
+
+    p = sub.add_parser(
+        "lint", help="run the project invariant linter "
+        "(docs/static-analysis.md)", allow_abbrev=False)
+    _lint_args(p)
     return parser
 
 
@@ -418,7 +428,8 @@ def main(argv: list[str] | None = None) -> int:
     # (reference pkg/plugin/plugin.go:101 + cmd/trivy plugin-mode)
     known = {"image", "filesystem", "fs", "rootfs", "repository", "repo",
              "sbom", "vm", "kubernetes", "k8s", "convert", "server", "db",
-             "clean", "config", "version", "registry", "plugin", "module"}
+             "clean", "config", "version", "registry", "plugin", "module",
+             "lint"}
     if argv and not argv[0].startswith("-") and argv[0] not in known:
         from trivy_tpu.plugin import PluginManager
 
@@ -430,6 +441,11 @@ def main(argv: list[str] | None = None) -> int:
             return mgr.run(argv[0], argv[1:])
 
     args = parser.parse_args(argv)
+
+    if getattr(args, "command", None) == "lint":
+        from trivy_tpu.analysis.lint import run_from_args
+
+        return run_from_args(args)
 
     if getattr(args, "generate_default_config", False):
         from trivy_tpu.cli.config import generate_default_config

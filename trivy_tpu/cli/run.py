@@ -325,7 +325,7 @@ def _scan_with_timeout(scanner, options, timeout_s: float,
                         box["report"] = scanner.scan_artifact(options)
                 else:
                     box["report"] = scanner.scan_artifact(options)
-        except BaseException as exc:  # re-raised on the main thread
+        except BaseException as exc:  # lint: allow[bare-except] re-raised on the main thread after join
             box["error"] = exc
 
     t = threading.Thread(target=work, daemon=True)
@@ -411,6 +411,7 @@ def _run_scan_core(args, compliance_spec) -> int:
         )
 
         comp = build_compliance_report(report.results, compliance_spec)
+        # lint: allow[atomic-write] user-requested report stream (--output), partial file is visible to the user
         out = open(args.output, "w") if args.output else None
         try:
             write_compliance_report(
@@ -734,6 +735,7 @@ def run_k8s(args) -> int:
                     title=f.title, message=f.message,
                     severity=f.severity, status="FAIL")]))
         comp = build_compliance_report(results, compliance_spec)
+        # lint: allow[atomic-write] user-requested report stream (--output), partial file is visible to the user
         out = open(args.output, "w") if args.output else None
         try:
             write_compliance_report(
@@ -1052,7 +1054,9 @@ def run_registry(args) -> int:
         if auths.pop(args.server, None) is None:
             _log.warn("not logged in", registry=args.server)
             return 0
-        with open(cfg_path, "w") as f:
+        # same 0600 idiom as login: credentials must never be group-readable
+        fd = os.open(cfg_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             _json.dump(cfg, f, indent=2)
         _log.info("logged out", registry=args.server)
         return 0

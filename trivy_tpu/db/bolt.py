@@ -259,5 +259,6 @@ def write_bolt(path: str, tree: dict, page_size: int = 4096) -> None:
     put(3, PAGE_HEADER.pack(3, FLAG_LEAF, 0, 0))  # spare empty page
     for pgid, body in pages.items():
         put(pgid, body)
+    # lint: allow[atomic-write] single-shot generated bolt fixture, no reader until return
     with open(path, "wb") as f:
         f.write(bytes(blob))

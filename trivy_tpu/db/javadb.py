@@ -22,6 +22,7 @@ import os
 import sqlite3
 from dataclasses import dataclass
 
+from trivy_tpu.durability import atomic_write
 from trivy_tpu.log import logger
 
 _log = logger("javadb")
@@ -99,9 +100,9 @@ class JavaDB:
         if not self.path:
             return
         meta = {"Version": SCHEMA_VERSION}
-        with open(os.path.join(os.path.dirname(self.path),
-                               "metadata.json"), "w") as f:
-            json.dump(meta, f)
+        atomic_write(os.path.join(os.path.dirname(self.path),
+                                  "metadata.json"),
+                     json.dumps(meta).encode())
 
     # ----------------------------------------------------------- search
 

@@ -122,6 +122,8 @@ class MatchEngine:
 
         import numpy as _np
 
+        from trivy_tpu.analysis.witness import make_lock
+
         self._version_tokens: dict[tuple[str, str], int] = {}
         # each tier is an immutable (keys, vals) pair swapped atomically
         # under _memo_lock — pipelined collect workers read a consistent
@@ -130,7 +132,7 @@ class MatchEngine:
                            _np.empty(0, dtype=bool))
         self._memo_over = (_np.empty(0, dtype=_np.int64),
                            _np.empty(0, dtype=bool))
-        self._memo_lock = threading.Lock()
+        self._memo_lock = make_lock("detector.engine._memo_lock")
         # bumped whenever the version-token space resets: a batch
         # encoded under an older generation must not absorb its (stale
         # token-id) verdicts into the fresh memo
@@ -562,12 +564,13 @@ class MatchEngine:
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
+        from trivy_tpu.analysis.witness import make_lock
         from trivy_tpu.obs import metrics as obs_metrics
         from trivy_tpu.obs import tracing
 
         cache = self._crawl_cache
         busy = {"encode": 0.0, "crunch": 0.0, "finalize": 0.0}
-        busy_lock = threading.Lock()
+        busy_lock = make_lock("detector.engine.busy_lock")
         trace_ctx = tracing.capture()
 
         def crunch_stage(ctx, qs):

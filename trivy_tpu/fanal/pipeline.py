@@ -45,6 +45,8 @@ import contextlib
 import os
 import queue
 import threading
+
+from trivy_tpu.analysis.witness import make_lock
 import time
 
 from trivy_tpu.log import logger
@@ -122,7 +124,7 @@ class LayerSingleflight:
     """
 
     def __init__(self, ttl_s: float | None = None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("fanal.pipeline._lock")
         self._inflight: dict[str, _Slot] = {}
         self.ttl_s = ttl_s
 
@@ -347,7 +349,7 @@ def run_layer_pipeline(items: list, fetch, process,
                     try:
                         with tracing.span(FETCH_SITE):
                             payload = fetch_with_retry(lambda: fetch(item))
-                    except BaseException as exc:  # delivered in order
+                    except BaseException as exc:  # lint: allow[bare-except] delivered to the analyzing thread in layer order
                         stats["fetch_busy_s"] += time.perf_counter() - t0
                         _put_interruptible(out, (item, exc, True), stop)
                         return

@@ -49,6 +49,8 @@ import os
 import re
 import threading
 
+from trivy_tpu.analysis.witness import make_lock
+
 import yaml
 
 from trivy_tpu.iac.check import Cause, Check
@@ -502,7 +504,7 @@ class CheckSet:
 
 _default = CheckSet()
 _active: CheckSet = _default
-_lock = threading.Lock()
+_lock = make_lock("iac.engine._lock")
 
 
 def configure(check_paths: list[str] | None = None,

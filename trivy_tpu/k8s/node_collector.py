@@ -120,10 +120,10 @@ def collect_node_info(client, node: str,
         return None
     name = job["metadata"]["name"]
     selector = f"app={JOB_LABEL},node={_node_tag(node)}"
-    deadline = time.time() + timeout_s
+    deadline = time.monotonic() + timeout_s
     doc = None
     try:
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             pods = client.list("Pod", namespace=namespace,
                                selector=selector)
             failed = 0

@@ -127,6 +127,7 @@ def write_cluster_report(report: ClusterReport, fmt: str = "summary",
     else:
         text = render_summary(report)
     if output:
+        # lint: allow[atomic-write] user-requested report stream, partial file is visible to the user
         with open(output, "w") as f:
             f.write(text + "\n")
     else:

@@ -27,6 +27,9 @@ import hashlib
 import os
 import types
 
+from trivy_tpu.durability import atomic_write
+
+
 def trust_store_path() -> str:
     """Operator-owned manifest location. Deliberately OUTSIDE the
     cache/modules directory: the threat model is an attacker who can
@@ -55,9 +58,8 @@ def _read_manifest(path: str) -> dict[str, str]:
 
 def _write_manifest(path: str, entries: dict[str, str]) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
-        for name in sorted(entries):
-            f.write(f"{entries[name]} {name}\n")
+    body = "".join(f"{entries[name]} {name}\n" for name in sorted(entries))
+    atomic_write(path, body.encode("utf-8"))
 
 from trivy_tpu.fanal.analyzer import (
     AnalysisResult,

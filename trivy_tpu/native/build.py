@@ -12,6 +12,8 @@ import subprocess
 import tempfile
 import threading
 
+from trivy_tpu.analysis.witness import make_lock
+
 from trivy_tpu.log import logger
 
 _log = logger("native")
@@ -44,6 +46,7 @@ def build_library(src_path: str, lib_prefix: str) -> str | None:
                   src=os.path.basename(src_path), err=str(e),
                   stderr=stderr.decode()[:500])
         return None
+    # lint: allow[atomic-write] atomic-publish idiom: tmp build + rename, racing builders converge
     os.replace(tmp, out)  # atomic: concurrent builders race safely
     return out
 
@@ -56,7 +59,7 @@ class LazyLibrary:
         self._src = src_path
         self._prefix = lib_prefix
         self._configure = configure
-        self._lock = threading.Lock()
+        self._lock = make_lock("native.build._lock")
         self._lib: ctypes.CDLL | None = None
         self._failed = False
 

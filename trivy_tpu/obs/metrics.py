@@ -26,6 +26,8 @@ metric (even before the first sample) in the Prometheus text format
 from __future__ import annotations
 
 import threading
+
+from trivy_tpu.analysis.witness import make_lock
 from typing import Callable, Iterable
 
 # Fixed default latency buckets (seconds): micro-phases up to the
@@ -273,7 +275,7 @@ class Registry:
     def __init__(self):
         # RLock: multi-metric updates group under locked() while each
         # single inc stays safe on its own
-        self._lock = threading.RLock()
+        self._lock = make_lock("obs.metrics._lock", threading.RLock())
         self._metrics: dict[str, _Metric] = {}
 
     def locked(self):

@@ -37,6 +37,8 @@ import contextvars
 import json
 import os
 import threading
+
+from trivy_tpu.analysis.witness import make_lock
 import time
 from dataclasses import dataclass, field
 
@@ -110,7 +112,7 @@ _remote_link: contextvars.ContextVar[tuple[str, str] | None] = \
 # finished root spans; generation guards reset() against spans still
 # closing on other threads (their append is simply dropped)
 _roots: list[Span] = []
-_roots_lock = threading.Lock()
+_roots_lock = make_lock("obs.tracing._roots_lock")
 _generation = 0
 
 
@@ -427,6 +429,7 @@ def export_chrome(path: str) -> int:
     Perfetto / chrome://tracing). Returns the number of events."""
     events = chrome_events()
     doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    # lint: allow[atomic-write] user-requested --trace-export artifact, not program state
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=1)
         f.write("\n")

@@ -221,6 +221,7 @@ def run(out_path: str | None = None, timeout: int = 600) -> dict:
                 f"cross-host mismatch: global={sorted(g)} "
                 f"local_sum={local_sum}")
     if out_path:
+        # lint: allow[atomic-write] dryrun report artifact for the bench driver, not program state
         with open(out_path, "w") as f:
             json.dump(doc, f, indent=2)
     return doc

@@ -24,6 +24,7 @@ from dataclasses import dataclass, field
 
 import yaml
 
+from trivy_tpu.durability import atomic_write
 from trivy_tpu.log import logger
 
 _log = logger("plugin")
@@ -151,8 +152,7 @@ class PluginManager:
         with urllib.request.urlopen(url, timeout=60) as resp:
             data = resp.read()
         os.makedirs(self.root, exist_ok=True)
-        with open(self.index_path, "wb") as f:
-            f.write(data)
+        atomic_write(self.index_path, data)
         _log.info("plugin index updated", url=url)
 
     def index(self) -> list[dict]:
@@ -191,6 +191,7 @@ class PluginManager:
                 data = resp.read()
             tmp = os.path.join(self.root, ".download.zip")
             os.makedirs(self.root, exist_ok=True)
+            # lint: allow[atomic-write] transient download buffer, consumed and unlinked in this call
             with open(tmp, "wb") as f:
                 f.write(data)
             try:

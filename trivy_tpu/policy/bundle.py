@@ -14,6 +14,7 @@ import json
 import os
 import time
 
+from trivy_tpu.durability import atomic_write
 from trivy_tpu.log import logger
 
 _log = logger("policy")
@@ -54,9 +55,9 @@ def update_bundle(cache_dir: str, repository: str,
     download_artifact(repository, content, media_type=None,
                       insecure=insecure)
     os.makedirs(_policy_dir(cache_dir), exist_ok=True)
-    with open(_metadata_path(cache_dir), "w") as f:
-        json.dump({"downloaded_at": time.time(),
-                   "repository": repository}, f)
+    atomic_write(_metadata_path(cache_dir), json.dumps(
+        {"downloaded_at": time.time(),
+         "repository": repository}).encode())
     return content
 
 

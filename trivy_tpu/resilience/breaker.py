@@ -15,6 +15,8 @@ one lock so concurrent callers observe a consistent state.
 from __future__ import annotations
 
 import threading
+
+from trivy_tpu.analysis.witness import make_lock
 import time
 from typing import Callable
 
@@ -48,7 +50,7 @@ class CircuitBreaker:
         self.half_open_max = half_open_max
         self.name = name
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.breaker._lock")
         self._state = CLOSED
         self._failures = 0
         self._opened_at = 0.0

@@ -57,12 +57,40 @@ import os
 import random
 import re
 import threading
+
+from trivy_tpu.analysis.witness import make_lock
 from dataclasses import dataclass, field
 
 ENV_VAR = "TRIVY_TPU_FAULTS"
 
 ACTIONS = {"drop", "timeout", "delay", "error", "corrupt", "device-lost",
            "kill", "torn-write", "bitflip"}
+
+# The site grammar as STRUCTURED data — one source of truth consumed by
+# the linter (`fault-site` rule), docs/resilience.md, and tests.  Each
+# entry is (site, actions-the-site's-call-site-handles).  Sites are
+# prefix-matched at fire() time, so "rpc" covers every rpc.* child; the
+# atomic-write sites (cache.write, db.save, ...) also fire a ``kill``
+# probe at "<site>.commit" between the tmp write and the rename.
+SITES: tuple[tuple[str, tuple[str, ...]], ...] = (
+    ("rpc", ("drop", "timeout", "delay", "error", "corrupt")),
+    ("rpc.scan", ("drop", "timeout", "delay", "error", "corrupt")),
+    ("rpc.cache", ("drop", "timeout", "delay", "error", "corrupt")),
+    ("engine", ("device-lost",)),
+    ("engine.device", ("drop", "delay", "device-lost")),
+    ("sched.submit", ("drop", "delay", "error")),
+    ("analysis.fetch", ("drop", "delay", "error", "kill")),
+    ("fleet.scan", ("kill",)),
+    ("journal.append", ("kill", "torn-write", "bitflip")),
+    ("db.download", ("torn-write", "bitflip")),
+    ("db.install.extract", ("kill",)),
+    ("db.install.promote", ("kill",)),
+    ("db.save", ("kill", "torn-write", "bitflip")),
+    ("db.save.metadata", ("kill", "torn-write", "bitflip")),
+    ("cache.write", ("kill", "torn-write", "bitflip")),
+    ("compile_cache.save", ("kill", "torn-write", "bitflip")),
+    ("report.write", ("kill", "torn-write", "bitflip")),
+)
 
 
 class FaultError(Exception):
@@ -152,7 +180,7 @@ class FaultPlan:
     def __init__(self, rules: list[Rule], seed: int = 0):
         self.rules = list(rules)
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.faults._lock")
 
     @classmethod
     def from_spec(cls, spec: str) -> "FaultPlan":

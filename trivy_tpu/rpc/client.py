@@ -23,6 +23,8 @@ import http.client
 import json
 import random
 import threading
+
+from trivy_tpu.analysis.witness import make_lock
 import time
 import urllib.error
 import urllib.request
@@ -71,7 +73,7 @@ class _Conn:
         # each lane amortizes its TCP connect across its whole run
         self._tls = threading.local()
         self._all_conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = make_lock("rpc.client._conns_lock")
         # sticky capability learned from the first response's
         # X-Trivy-Gzip header: only then are REQUEST bodies gzipped
         # (an old server must never see a gzip request body)
@@ -328,7 +330,7 @@ class _Conn:
 # not once per scan" actually hold. Custom retry policies or headers
 # opt out (tests and special callers keep private connections).
 _CONN_POOL: dict[tuple, _Conn] = {}
-_CONN_POOL_LOCK = threading.Lock()
+_CONN_POOL_LOCK = make_lock("rpc.client._CONN_POOL_LOCK")
 
 
 def _pooled_conn(url: str, token: str | None,

@@ -21,6 +21,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+from trivy_tpu.analysis.witness import make_lock
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -47,7 +49,7 @@ class _RWLock:
     """Many readers (scans) / one writer (DB swap)."""
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = make_lock("rpc.server._cond", threading.Condition())
         self._readers = 0
         self._writing = False
         self._writers_waiting = 0
@@ -200,10 +202,11 @@ class ScanService:
         self._db_state = self._db_identity()
         self.metrics = Metrics()
         # generation age: seconds since the served DB was (re)loaded,
-        # evaluated at /metrics render time
-        self._db_loaded_at = time.time()
+        # evaluated at /metrics render time (monotonic: an NTP step
+        # must not age or rejuvenate the serving generation)
+        self._db_loaded_at = time.monotonic()
         self.metrics.db_generation_age.set_function(
-            lambda: time.time() - self._db_loaded_at)
+            lambda: time.monotonic() - self._db_loaded_at)
         # durable-lifecycle state: the generation the live engine was
         # loaded from (rollback target), the identity of the last
         # candidate we rejected (avoid a reload/reject loop), and a
@@ -213,7 +216,8 @@ class ScanService:
         self.db_degraded: str = ""
         # drain state: SIGTERM flips draining; in-flight scans finish
         # under the drain budget, new scans shed with Retry-After
-        self._drain_cond = threading.Condition()
+        self._drain_cond = make_lock("rpc.server._drain_cond",
+                                     threading.Condition())
         self._inflight = 0
         self.draining = False
         # cross-request continuous batching: concurrent scans' detect
@@ -534,7 +538,7 @@ class ScanService:
             self._active_db_dir = resolved
             self._rejected_db_state = ()
             self.db_degraded = ""
-            self._db_loaded_at = time.time()
+            self._db_loaded_at = time.monotonic()
         finally:
             self.lock.release_write()
         self.metrics.db_reloads.inc()
@@ -772,10 +776,12 @@ class Server:
         return f"http://{host}:{port}"
 
     def start(self):
+        # lint: allow[tracing-capture] accept loop: no ambient scan exists; per-request spans adopt X-Trivy-Trace
         t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         t.start()
         self._threads.append(t)
         if self.service.db_path:
+            # lint: allow[tracing-capture] background DB-reload poller, owns its own root spans
             w = threading.Thread(target=self._db_worker, daemon=True)
             w.start()
             self._threads.append(w)

@@ -47,6 +47,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from trivy_tpu.analysis.witness import make_lock
 import time
 
 from trivy_tpu.log import logger
@@ -151,7 +153,8 @@ class MatchScheduler:
         self.max_queue = max(int(max_queue), 1)
         self.depth = max(int(depth), 1)
         self.on_shed = on_shed
-        self._cond = threading.Condition()
+        self._cond = make_lock("sched.scheduler._cond",
+                               threading.Condition())
         self._waiting: list[_Pending] = []
         self._seq = 0
         self._stopping = False
@@ -381,7 +384,7 @@ class MatchScheduler:
                     res_lists[i] = self._engine_fn().detect(list(qs))
                 except Exception as solo_exc:
                     part_errors[i] = solo_exc
-        except BaseException as exc:  # injected kill / interpreter exit
+        except BaseException as exc:  # lint: allow[bare-except] injected kill / interpreter exit: delivered to every coalesced waiter
             err = RuntimeError(f"scheduler batch aborted: {exc!r}")
             part_errors = [err] * len(parts)
             fatal = exc

@@ -16,6 +16,8 @@ from __future__ import annotations
 import os
 import re
 import threading
+
+from trivy_tpu.analysis.witness import make_lock
 from dataclasses import dataclass, field
 from typing import Literal
 
@@ -40,7 +42,7 @@ _log = logger("secret")
 # 0.01x the native host path — splitting bytes to it then only slows
 # the scan down).
 _HYBRID_PROBE: dict | None = None
-_HYBRID_PROBE_LOCK = threading.Lock()
+_HYBRID_PROBE_LOCK = make_lock("secret.scanner._HYBRID_PROBE_LOCK")
 
 
 def reset_hybrid_probe() -> None:

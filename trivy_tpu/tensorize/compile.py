@@ -25,6 +25,8 @@ Names with more than `window` rows are evicted to a host-side fallback map
 from __future__ import annotations
 
 import threading
+
+from trivy_tpu.analysis.witness import make_lock
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -212,8 +214,9 @@ class CompiledDB:
     # guards intern-table mutation: the RPC server runs CONCURRENT
     # scans on one shared engine (read-locked, not exclusive), so two
     # first-seen components must not race the dense-id assignment
-    _intern_lock: object = field(default_factory=threading.Lock,
-                                 repr=False)
+    _intern_lock: object = field(
+        default_factory=lambda: make_lock("tensorize.compile._intern_lock"),
+        repr=False)
 
     @property
     def n_rows(self) -> int:
