@@ -408,6 +408,139 @@ def bench_serving(engine, db) -> dict:
         srv_off.shutdown()
 
 
+def _bench_mesh_child() -> int:
+    """Child half of bench_mesh: runs inside a subprocess whose env
+    pins an 8-virtual-CPU-device backend (the multichip-dryrun dance),
+    crawls the synthetic pod fleet through the production ops/mesh.py
+    path at each shard count, and prints ONE JSON line on stdout."""
+    import statistics
+
+    os.environ.setdefault("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in \
+            os.environ["XLA_FLAGS"]:
+        os.environ["XLA_FLAGS"] += \
+            " --xla_force_host_platform_device_count=8"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.ops import mesh as mesh_ops
+    from trivy_tpu.tensorize.synth import synth_trivy_db
+
+    pods = int(os.environ.get("TRIVY_TPU_BENCH_MESH_PODS", "10000"))
+    # BASELINE config #5 shape: a 10k-pod k8s crawl — every pod
+    # contributes a modest package inventory with fleet-wide overlap
+    # (shared base images), the DB pod-slice-sharded over the mesh
+    db = synth_trivy_db(n_advisories=30_000)
+    queries = build_queries(db, pods * 12, seed=17)
+
+    oracle_engine = MatchEngine(db, use_device=False)
+    oracle = [r.adv_indices for r in
+              oracle_engine.detect_many(queries, batch_size=65536)]
+
+    shapes = [(8, 1), (4, 2), (2, 4), (1, 8)]  # dp x db, 8 devices
+    engines = {}
+    for dp, n_db in shapes:
+        e = MatchEngine(db, mesh=mesh_ops.build_mesh(dp, n_db))
+        e.detect(queries[:2048])  # warm jit at the crawl bucket
+        e._crawl_cache.clear()
+        engines[(dp, n_db)] = e
+
+    # rounds interleaved across shard counts so shared-box load drift
+    # hits every shape equally; medians of 3
+    walls: dict = {s: [] for s in shapes}
+    diffs = 0
+    for _round in range(3):
+        for s in shapes:
+            e = engines[s]
+            e._crawl_cache.clear()
+            t0 = time.time()
+            res = e.detect_many(queries, batch_size=65536)
+            walls[s].append(time.time() - t0)
+            diffs += sum(1 for a, b in zip(res, oracle)
+                         if a.adv_indices != b)
+
+    # mesh-aware compiled-DB cache: per-shard slices must warm-start
+    # without re-slicing (a second engine over the same on-disk DB)
+    import shutil
+    import tempfile
+
+    from trivy_tpu.obs import metrics as _obs
+
+    tmp = tempfile.mkdtemp(prefix="trivy_tpu_bench_mesh_db_")
+    try:
+        db.save(tmp, compress=False)
+        mesh = mesh_ops.build_mesh(2, 4)
+        t0 = time.time()
+        MatchEngine(db, db_path=tmp, mesh=mesh)
+        cold_s = time.time() - t0
+        h0 = _obs.COMPILE_CACHE_HITS.value()
+        t0 = time.time()
+        MatchEngine(db, db_path=tmp, mesh=mesh)
+        warm_s = time.time() - t0
+        shard_cache = {
+            "cold_s": round(cold_s, 2),
+            "warm_s": round(warm_s, 2),
+            "warm_hits": int(_obs.COMPILE_CACHE_HITS.value() - h0),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    per_shape = {}
+    for (dp, n_db), ws in walls.items():
+        wall = statistics.median(ws)
+        per_shape[f"{dp}x{n_db}"] = {
+            "db_shards": n_db,
+            "pkg_per_s": round(len(queries) / wall),
+            "pods_per_s": round(pods / wall),
+        }
+    print(json.dumps({
+        "pods": pods,
+        "queries": len(queries),
+        "db_rows": int(oracle_engine.cdb.n_rows),
+        "shapes": per_shape,
+        "mesh_diff_vs_oracle": diffs,
+        "shard_cache": shard_cache,
+    }))
+    return 0
+
+
+def bench_mesh() -> dict:
+    """Mesh serving (BASELINE config #5): a synthetic 10k-pod
+    pod-slice-sharded crawl through the production ops/mesh.py path at
+    shard counts {1, 2, 4, 8}, interleaved medians, zero-diff asserted
+    per shard count — run in a subprocess that forces an 8-virtual-CPU
+    device mesh (like the multichip dryruns) so the section exists on
+    any parent backend."""
+    import subprocess
+
+    env = {
+        **os.environ,
+        "TRIVY_TPU_BENCH_MESH_CHILD": "1",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    # the child must not inherit the supervisor/child markers of the
+    # outer bench, or it would re-enter the main bench path
+    env.pop("TRIVY_TPU_BENCH_CHILD", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        return {"error": "mesh bench child timed out"}
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                break
+    return {"error": "mesh bench child failed "
+                     f"(rc={proc.returncode}): {proc.stderr[-2000:]}"}
+
+
 def bench_analysis() -> dict:
     """Artifact-analysis pipeline + cross-image layer dedupe (ISSUE 6
     tentpole): a synthetic registry of M images sharing ~70% of their
@@ -880,6 +1013,8 @@ def _lint_gate() -> int:
 
 
 def main():
+    if os.environ.get("TRIVY_TPU_BENCH_MESH_CHILD"):
+        return _bench_mesh_child()
     phase_json = _phase_json_path()
     if not os.environ.get("TRIVY_TPU_BENCH_CHILD"):
         lint_rc = _lint_gate()
@@ -1123,6 +1258,12 @@ def main():
     with _trace.span("serving_sched"):
         sched_detail = bench_serving(engine, db)
 
+    # --- mesh serving: pod-slice-sharded crawl (BASELINE config #5) ------
+    # the production ops/mesh.py path at shard counts {1,2,4,8}, zero
+    # diff asserted per count (subprocess with an 8-device CPU mesh)
+    with _trace.span("mesh_serving"):
+        mesh_detail = bench_mesh()
+
     # --- artifact analysis: pipelined fetch/analyze + layer dedupe -------
     # the dominant north-star cost after PR 4/5 (BASELINE.md arithmetic):
     # a synthetic registry with realistic base-image overlap (ISSUE 6)
@@ -1190,6 +1331,7 @@ def main():
         "pipeline": pipe,
         "compile_cache": compile_cache_detail,
         "sched": sched_detail,
+        "mesh": mesh_detail,
     }
     if pipe:
         detail["pipeline_occupancy"] = pipe.get("pipeline_occupancy", 0.0)
@@ -1208,6 +1350,9 @@ def main():
     print(json.dumps(result))
     if analysis_detail.get("analysis_diff_vs_serial", 0):
         return 1  # pipelined analysis must be byte-identical to serial
+    if mesh_detail.get("error") or mesh_detail.get(
+            "mesh_diff_vs_oracle", 0):
+        return 1  # every mesh shard count must match the oracle exactly
     return 0 if diffs == 0 else 1
 
 

@@ -64,7 +64,8 @@ def fake_redis():
 # by the test body) are witnessed here — import-time module globals
 # stay raw; the static with-nesting pass covers those (see the
 # witness.py docstring).
-_WITNESS_MARKERS = ("sched", "fanal", "obs", "durability", "fault")
+_WITNESS_MARKERS = ("sched", "fanal", "obs", "durability", "fault",
+                    "mesh")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,443 @@
+"""Serving-mesh tests (trivy_tpu/ops/mesh.py): the production sharded
+MeshMatchEngine path must be byte-identical to the single-chip oracle
+on every dp×db topology — including shard-boundary edge shapes (uneven
+row remainders, a DB smaller than the shard count) and under
+`engine.shard` fault injection at every rung of the degradation ladder
+(retry, drop-redispatch, shard degraded to host).  Plus the
+mesh-topology-aware compiled-DB cache and the scheduler's
+mesh-shape-aware batch composition."""
+
+import os
+import random
+
+import pytest
+
+from trivy_tpu.ops import mesh as mesh_ops
+
+pytestmark = [
+    pytest.mark.mesh,
+    pytest.mark.skipif(not mesh_ops.multi_device_ready(8),
+                       reason="multi-device runtime absent "
+                              "(needs 8 devices)"),
+]
+
+from test_match import _random_db, _random_queries  # noqa: E402
+
+from trivy_tpu.db import Advisory, AdvisoryDB  # noqa: E402
+from trivy_tpu.detector.engine import MatchEngine, PkgQuery  # noqa: E402
+from trivy_tpu.obs import metrics as obs_metrics  # noqa: E402
+from trivy_tpu.resilience import faults  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def db():
+    return _random_db(random.Random(42))
+
+
+@pytest.fixture(scope="module")
+def queries():
+    return _random_queries(random.Random(13), n=500)
+
+
+@pytest.fixture(scope="module")
+def oracle(db, queries):
+    e = MatchEngine(db, window=32, use_device=False)
+    return [r.adv_indices for r in e.oracle_detect(queries)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _mesh_engine(db, dp, n_db, **kw):
+    return MatchEngine(db, window=32,
+                       mesh=mesh_ops.build_mesh(dp, n_db), **kw)
+
+
+def _hits(engine, queries):
+    return [r.adv_indices for r in engine.detect(queries)]
+
+
+# ------------------------------------------------------------- topology
+
+
+def test_parse_spec():
+    assert mesh_ops.parse_spec("") is None
+    assert mesh_ops.parse_spec("off") is None
+    assert mesh_ops.parse_spec("0") is None
+    assert mesh_ops.parse_spec("auto") == "auto"
+    assert mesh_ops.parse_spec("2x4") == (2, 4)
+    assert mesh_ops.parse_spec(" 8 X 1 ") == (8, 1)
+    with pytest.raises(ValueError, match="bad mesh spec"):
+        mesh_ops.parse_spec("banana")
+    with pytest.raises(ValueError, match=">= 1"):
+        mesh_ops.parse_spec("0x4")
+
+
+def test_choose_topology(monkeypatch):
+    # a DB that fits one chip: all devices go to the data axis
+    assert mesh_ops.choose_topology(8, 10_000) == (8, 1)
+    # shrink the per-device budget until the DB needs every shard
+    monkeypatch.setenv(mesh_ops.ENV_HBM, "0.001")  # 1 MB
+    dp, n_db = mesh_ops.choose_topology(8, 1_000_000)
+    assert n_db == 8 and dp == 1
+    monkeypatch.delenv(mesh_ops.ENV_HBM)
+    # mid-size: smallest divisor whose slice fits wins
+    monkeypatch.setenv(mesh_ops.ENV_HBM, "0.01")  # 10 MB ~ 277k rows
+    dp, n_db = mesh_ops.choose_topology(8, 500_000)
+    assert (dp, n_db) == (4, 2)
+
+
+def test_build_mesh_too_big_rejected():
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        mesh_ops.build_mesh(4, 4)
+
+
+def test_engine_mesh_spec(db, queries, oracle):
+    e = MatchEngine(db, window=32, mesh_spec="2x4")
+    assert e.shard_health() == {"shape": "2x4", "data": 2, "db": 4,
+                                "degraded": []}
+    assert e.mesh_data_axis == 2
+    assert _hits(e, queries) == oracle
+    # off/empty spec: the plain single-chip path
+    e1 = MatchEngine(db, window=32, mesh_spec="off")
+    assert e1.shard_health() is None and e1.mesh_data_axis == 1
+    with pytest.raises(ValueError, match="bad mesh spec"):
+        MatchEngine(db, window=32, mesh_spec="nope")
+
+
+def test_engine_mesh_spec_auto(db, queries, oracle):
+    e = MatchEngine(db, window=32, mesh_spec="auto")
+    h = e.shard_health()
+    assert h is not None and h["data"] * h["db"] == 8
+    assert _hits(e, queries) == oracle
+
+
+# --------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize("dp,n_db", [(1, 1), (2, 4), (4, 2), (1, 8)])
+def test_mesh_zero_diff_all_shapes(db, queries, oracle, dp, n_db):
+    e = _mesh_engine(db, dp, n_db)
+    if n_db > 1:
+        # every shard is halo-padded (shard_len = base + window), so
+        # PAD sentinel rows sit in-table on every shard and must never
+        # match
+        assert e._mdb.shard_len > e._mdb.shard_base
+    if n_db == 8:
+        # uneven-remainder edge: 2100 rows over 8 shards leaves the
+        # last shard short (263*7 = 1841; 259 real rows + pad)
+        assert e.cdb.n_rows % e._mdb.shard_base != 0
+    assert _hits(e, queries) == oracle
+
+
+def test_mesh_vs_singlechip_byte_parity(db, queries):
+    single = MatchEngine(db, window=32)
+    meshed = _mesh_engine(db, 2, 4)
+    assert _hits(meshed, queries) == _hits(single, queries)
+
+
+def test_db_smaller_than_shard_count():
+    tiny = AdvisoryDB()
+    tiny.put_advisory("npm::ghsa", "left-pad", Advisory(
+        vulnerability_id="CVE-1", vulnerable_versions=["<2.0.0"]))
+    tiny.put_advisory("npm::ghsa", "lodash", Advisory(
+        vulnerability_id="CVE-2", vulnerable_versions=[">=1.0.0, <3.0.0"]))
+    tiny.put_advisory("pip::ghsa", "requests", Advisory(
+        vulnerability_id="CVE-3", vulnerable_versions=["<1.5.0"]))
+    e = _mesh_engine(tiny, 1, 8)  # more shards than advisory rows
+    qs = [
+        PkgQuery("npm::", "left-pad", "1.0.0", "npm"),
+        PkgQuery("npm::", "lodash", "2.5.0", "npm"),
+        PkgQuery("pip::", "requests", "1.0", "pep440"),
+        PkgQuery("pip::", "requests", "9.9", "pep440"),
+        PkgQuery("go::", "not-in-db", "1.0.0", "generic"),
+    ]
+    got = _hits(e, qs)
+    want = [r.adv_indices for r in e.oracle_detect(qs)]
+    assert got == want
+    assert got[0] and got[1] and got[2]  # real matches happened
+    assert got[3] == [] and got[4] == []  # padding shards match nothing
+
+
+def test_detect_many_and_submit_on_mesh(db, queries, oracle):
+    e = _mesh_engine(db, 2, 4)
+    crawl = e.detect_many(queries, batch_size=128, depth=2)
+    assert [r.adv_indices for r in crawl] == oracle
+    # the scheduler's batched entry point fans coalesced unions back
+    # out per request, byte-identically
+    lists = [queries[:200], queries[200:201], queries[201:]]
+    per_req = e.submit(lists)
+    flat = [r.adv_indices for rs in per_req for r in rs]
+    assert flat == oracle
+
+
+# ------------------------------------------------------ fault isolation
+
+
+@pytest.mark.fault
+def test_shard_error_retried_then_healthy(db, queries, oracle):
+    faults.install_spec("engine.shard:error@1")
+    before = obs_metrics.MESH_SHARD_RETRIES.value(shard="0")
+    e = _mesh_engine(db, 2, 4)
+    assert _hits(e, queries) == oracle
+    assert e.shard_health()["degraded"] == []  # retry succeeded
+    assert obs_metrics.MESH_SHARD_RETRIES.value(shard="0") == before + 1
+
+
+@pytest.mark.fault
+def test_shard_error_exhausts_retries_degrades(db, queries, oracle):
+    # shard 0's first collect AND its retry fail: that shard's slice
+    # degrades to the host oracle; the other shards stay on-device
+    faults.install_spec("engine.shard:error@1-2")
+    e = _mesh_engine(db, 1, 4)
+    assert _hits(e, queries) == oracle
+    assert e.shard_health()["degraded"] == [0]
+    # a later crawl on the degraded engine stays byte-identical
+    faults.reset()
+    assert _hits(e, queries) == oracle
+    assert e.shard_health()["degraded"] == [0]
+
+
+@pytest.mark.fault
+def test_shard_device_lost_degrades_immediately(db, queries, oracle):
+    faults.install_spec("engine.shard:device-lost@1")
+    before = obs_metrics.MESH_SHARD_DEGRADATIONS.value(shard="0")
+    e = _mesh_engine(db, 2, 4)
+    assert _hits(e, queries) == oracle
+    h = e.shard_health()
+    assert h["degraded"] == [0]  # only the lost shard left the device
+    assert obs_metrics.MESH_SHARD_DEGRADATIONS.value(shard="0") \
+        == before + 1
+
+
+@pytest.mark.fault
+def test_shard_drop_redispatches(db, queries, oracle):
+    faults.install_spec("engine.shard:drop@2;engine.shard:delay=0.001@3")
+    e = _mesh_engine(db, 2, 4)
+    assert _hits(e, queries) == oracle
+    assert e.shard_health()["degraded"] == []
+
+
+@pytest.mark.fault
+def test_whole_device_lost_still_degrades_engine(db, queries, oracle):
+    # the pre-mesh contract survives: site "engine" device-lost flips
+    # the whole engine to the host oracle, mesh or not
+    faults.install_spec("engine:device-lost@1")
+    e = _mesh_engine(db, 2, 4)
+    assert _hits(e, queries) == oracle
+    assert e.device_lost and not e.use_device
+
+
+# ------------------------------------------------------ mesh-aware cache
+
+
+def _saved_db_dir(db, tmp_path):
+    root = str(tmp_path / "db")
+    db.save(root, compress=False)
+    return root
+
+
+def test_shard_cache_warm_start(db, queries, oracle, tmp_path):
+    from trivy_tpu.tensorize import cache as compile_cache
+
+    root = _saved_db_dir(db, tmp_path)
+    e1 = _mesh_engine(db, 2, 4, db_path=root)
+    assert _hits(e1, queries) == oracle
+    digest = compile_cache.db_digest(root)
+    shard_path = compile_cache.shard_entry_path(root, digest, 32, 4)
+    assert os.path.exists(shard_path)
+    assert shard_path.endswith(".mesh4.npz")
+    # the BASE entry key is byte-identical to the pre-mesh layout: no
+    # mesh component in single-chip entries
+    assert os.path.exists(compile_cache.entry_path(root, digest, 32))
+    hits0 = obs_metrics.COMPILE_CACHE_HITS.value()
+    e2 = _mesh_engine(db, 2, 4, db_path=root)
+    # warm start: base tensors AND the per-shard slices load from the
+    # cache (no re-slice), byte-identical results
+    assert obs_metrics.COMPILE_CACHE_HITS.value() >= hits0 + 2
+    assert _hits(e2, queries) == oracle
+
+
+def test_shard_cache_keyed_by_shard_count(db, queries, oracle, tmp_path):
+    root = _saved_db_dir(db, tmp_path)
+    _mesh_engine(db, 1, 4, db_path=root)
+    misses0 = obs_metrics.COMPILE_CACHE_MISSES.value()
+    e = _mesh_engine(db, 1, 8, db_path=root)  # different db axis
+    assert obs_metrics.COMPILE_CACHE_MISSES.value() > misses0
+    assert _hits(e, queries) == oracle
+
+
+def test_shard_cache_corrupt_entry_quarantined(db, queries, oracle,
+                                               tmp_path):
+    from trivy_tpu.tensorize import cache as compile_cache
+
+    root = _saved_db_dir(db, tmp_path)
+    _mesh_engine(db, 2, 4, db_path=root)
+    digest = compile_cache.db_digest(root)
+    path = compile_cache.shard_entry_path(root, digest, 32, 4)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0x01  # silent bit rot
+    with open(path, "wb") as f:  # lint: allow[atomic-write] test seeds deliberate corruption in place
+        f.write(bytes(raw))
+    e = _mesh_engine(db, 2, 4, db_path=root)  # re-slices, zero diff
+    assert _hits(e, queries) == oracle
+    assert os.path.exists(path + compile_cache.QUARANTINE_SUFFIX)
+
+
+def test_one_by_one_mesh_creates_no_mesh_entries(db, tmp_path):
+    from trivy_tpu.tensorize import cache as compile_cache
+
+    root = _saved_db_dir(db, tmp_path)
+    _mesh_engine(db, 1, 1, db_path=root)
+    names = os.listdir(compile_cache.cache_root(root))
+    assert not [n for n in names if ".mesh" in n]
+
+
+# ------------------------------------------- scheduler + server surface
+
+
+def test_sched_mesh_fill_tops_up_to_data_axis(db):
+    import time
+
+    from trivy_tpu.sched.scheduler import MatchScheduler
+
+    class _ManualSched(MatchScheduler):
+        def _run(self):
+            while not self._stopping:
+                time.sleep(0.02)
+
+    engine = MatchEngine(db, window=32, use_device=False)
+    qs = _random_queries(random.Random(3), n=700)
+    sched = _ManualSched(lambda: engine, window_ms=30.0, max_rows=64,
+                         chunk_rows=16, data_axis_fn=lambda: 4)
+    try:
+        p1 = sched._enqueue(qs[:350])
+        p2 = sched._enqueue(qs[350:])
+        parts, rows = sched._compose()
+        # interleave cut 64 rows; the mesh fill tops the batch up to a
+        # multiple of 128*dp so every data-parallel group carries real
+        # queries instead of padding
+        assert rows == 512
+        assert rows % (128 * 4) == 0
+        assert sum(hi - lo for _p, lo, hi in parts) == rows
+        sched._dispatch(parts, rows)
+        while not (p1.done.is_set() and p2.done.is_set()):
+            parts, rows = sched._compose()
+            sched._dispatch(parts, rows)
+        want = engine.detect(qs)
+        got = [r.adv_indices for r in p1.results + p2.results]
+        assert got == [r.adv_indices for r in want]
+    finally:
+        sched.close()
+
+
+def test_sched_mesh_fill_honors_bucket_floor(db):
+    import time
+
+    from trivy_tpu.sched.scheduler import MatchScheduler
+
+    class _ManualSched(MatchScheduler):
+        def _run(self):
+            while not self._stopping:
+                time.sleep(0.02)
+
+    engine = MatchEngine(db, window=32, use_device=False)
+    qs = _random_queries(random.Random(7), n=700)
+    # a prior big crawl ratcheted every grid cell's jit bucket to 256:
+    # dispatch pads each of the 2 data groups to 256 rows regardless,
+    # so the fill must target 2*256, not 2*_bucket(32)=256
+    sched = _ManualSched(lambda: engine, window_ms=30.0, max_rows=64,
+                         chunk_rows=16, data_axis_fn=lambda: 2,
+                         row_floor_fn=lambda: 256)
+    try:
+        sched._enqueue(qs)
+        _parts, rows = sched._compose()
+        assert rows == 512
+    finally:
+        sched.close()
+
+
+def test_sched_mesh_fill_noop_single_chip(db):
+    import time
+
+    from trivy_tpu.sched.scheduler import MatchScheduler
+
+    class _ManualSched(MatchScheduler):
+        def _run(self):
+            while not self._stopping:
+                time.sleep(0.02)
+
+    engine = MatchEngine(db, window=32, use_device=False)
+    qs = _random_queries(random.Random(5), n=300)
+    sched = _ManualSched(lambda: engine, window_ms=30.0, max_rows=64,
+                         chunk_rows=16, data_axis_fn=lambda: 1)
+    try:
+        sched._enqueue(qs)
+        _parts, rows = sched._compose()
+        assert rows == 64  # dp=1: the classic cut, no top-up
+    finally:
+        sched.close()
+
+
+def test_db_hot_reload_keeps_mesh(db, queries, oracle, tmp_path):
+    import os
+
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.db import generations
+    from trivy_tpu.db.store import AdvisoryDB as StoreDB
+    from trivy_tpu.rpc.server import ScanService
+
+    root = str(tmp_path / "db")
+    gen1 = os.path.join(generations.generations_root(root), "sha256-aaa")
+    os.makedirs(gen1)
+    db.meta.updated_at = "2024-01-01T00:00:00Z"
+    db.save(gen1)
+    generations.promote(root, gen1)
+    e = MatchEngine(StoreDB.load(root), window=32, mesh_spec="2x4",
+                    db_path=root)
+    svc = ScanService(e, MemoryCache(), db_path=root)
+    try:
+        # a new DB generation lands: the hot swap must keep serving
+        # the 2x4 mesh, not silently revert to single-chip
+        gen2 = os.path.join(generations.generations_root(root),
+                            "sha256-bbb")
+        os.makedirs(gen2)
+        db.meta.updated_at = "2024-02-02T00:00:00Z"
+        db.save(gen2)
+        generations.promote(root, gen2)
+        assert svc.maybe_reload_db() is True
+        assert svc.engine is not e
+        h = svc.engine.shard_health()
+        assert h is not None and h["shape"] == "2x4", h
+        got = [r.adv_indices for r in svc.engine.detect(queries)]
+        assert got == oracle
+    finally:
+        if svc.scheduler is not None:
+            svc.scheduler.close()
+
+
+def test_readyz_reports_shard_health(db, queries, oracle):
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.rpc.server import ScanService
+
+    e = _mesh_engine(db, 2, 4)
+    svc = ScanService(e, MemoryCache())
+    try:
+        ok, why = svc.ready()
+        assert ok and "mesh 2x4" in why and "degraded" not in why
+        # the scheduler composes against the engine's data axis
+        if svc.scheduler is not None:
+            assert svc.scheduler._data_axis_fn() == 2
+        faults.install_spec("engine.shard:device-lost@1")
+        assert _hits(e, queries) == oracle
+        faults.reset()
+        ok, why = svc.ready()
+        assert ok, why  # a degraded shard serves on, like last-good
+        assert "shard(s) 0 degraded to host" in why
+    finally:
+        if svc.scheduler is not None:
+            svc.scheduler.close()

@@ -8,7 +8,16 @@ import random
 
 import pytest
 
-from trivy_tpu.ops import multihost
+from trivy_tpu.ops import mesh as mesh_ops
+
+# ops/multihost builds meshes over the runtime's devices: on a box
+# without the 8-device virtual mesh (conftest forces it where the
+# runtime allows), these are clean skips, not failures
+pytestmark = pytest.mark.skipif(
+    not mesh_ops.multi_device_ready(8),
+    reason="multi-device runtime absent (needs 8 devices)")
+
+from trivy_tpu.ops import multihost  # noqa: E402
 
 
 def test_crawl_mesh_axes():

@@ -37,6 +37,17 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TRIVY_TPU_SCHED", "1", "sched", True,
          "Cross-request match scheduler; 0 restores the exact "
          "per-request detect path."),
+    # --- serving mesh
+    Knob("TRIVY_TPU_MESH", "", "ops", False,
+         "Serving-mesh topology: 'DPxDB' (e.g. 2x4), 'auto' (sized "
+         "from DB rows + device count), unset/off = single-chip "
+         "(same as --mesh)."),
+    Knob("TRIVY_TPU_MESH_SHARD_RETRIES", "1", "ops", False,
+         "Failed mesh shard dispatches retried before that shard's "
+         "advisory slice degrades to the host oracle."),
+    Knob("TRIVY_TPU_MESH_HBM_GB", "8.0", "ops", False,
+         "Per-device HBM budget (GB) the 'auto' mesh topology sizes "
+         "advisory shards against."),
     # --- detector pipeline
     Knob("TRIVY_TPU_PIPELINE", "1", "detector", True,
          "Double-buffered host/device match executor; 0 runs the "
@@ -127,6 +138,12 @@ KNOBS: tuple[Knob, ...] = (
          "Scans per client in the serving bench."),
     Knob("TRIVY_TPU_BENCH_ANALYSIS_IMAGES", "10", "bench", False,
          "Synthetic-registry image count in the analysis bench."),
+    Knob("TRIVY_TPU_BENCH_MESH_PODS", "10000", "bench", False,
+         "Synthetic pod count for the mesh-serving bench crawl "
+         "(BASELINE config #5 shape)."),
+    Knob("TRIVY_TPU_BENCH_MESH_CHILD", "", "bench", False,
+         "Internal: set on the CPU-mesh subprocess the mesh bench "
+         "spawns (8 virtual devices)."),
 )
 
 

@@ -75,6 +75,12 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--exit-on-eol", type=int, default=0)
     p.add_argument("--no-tpu", action="store_true",
                    help="run matching on host instead of the TPU kernel")
+    p.add_argument("--mesh", default=None, metavar="DPxDB",
+                   help="serve matching from a sharded device mesh: "
+                        "'DPxDB' (e.g. 2x4: 2 data-parallel groups x 4 "
+                        "advisory shards), 'auto' (topology from DB "
+                        "size and device count), or 'off' single-chip "
+                        "(default; env TRIVY_TPU_MESH)")
     p.add_argument("--timeout", default="5m",
                    help="per-scan deadline (e.g. 300s, 5m, 1h; "
                         "reference --timeout default 5m)")
@@ -284,6 +290,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--token", default=None)
     p.add_argument("--db-path", default=None)
     p.add_argument("--no-tpu", action="store_true")
+    p.add_argument("--mesh", default=None, metavar="DPxDB",
+                   help="serve matching from a sharded device mesh: "
+                        "'DPxDB', 'auto', or 'off' (default; env "
+                        "TRIVY_TPU_MESH)")
     p.add_argument("--drain-timeout", default="30s",
                    help="graceful-drain budget on SIGTERM: /readyz goes "
                         "503 immediately, in-flight scans get this long "

@@ -63,13 +63,36 @@ def new_engine(args):
     engine, like the server, must not leave the old one pinned). The
     on-disk DB path is threaded through so a warm start with an
     unchanged DB loads the persistent compiled-tensor cache instead of
-    recompiling (tensorize.cache)."""
+    recompiling (tensorize.cache). `--mesh` / TRIVY_TPU_MESH serves
+    matching from a sharded device mesh (ops/mesh.py); a malformed
+    spec fails here at startup."""
     from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.ops import mesh as mesh_ops
 
     db = _load_db(args)
     db_path = _db_path(args)
-    return MatchEngine(db, use_device=not getattr(args, "no_tpu", False),
-                       db_path=db_path if db.buckets else None)
+    mesh_spec = getattr(args, "mesh", None)
+    if mesh_spec is None:
+        mesh_spec = mesh_ops.spec_from_env()
+    try:
+        mesh_requested = mesh_ops.parse_spec(mesh_spec) is not None
+    except ValueError as exc:
+        raise FatalError(f"--mesh/TRIVY_TPU_MESH: {exc}")
+    if not mesh_requested:
+        # no mesh in play: engine errors must not be mislabeled as
+        # mesh-knob problems
+        return MatchEngine(
+            db, use_device=not getattr(args, "no_tpu", False),
+            db_path=db_path if db.buckets else None)
+    try:
+        return MatchEngine(
+            db, use_device=not getattr(args, "no_tpu", False),
+            db_path=db_path if db.buckets else None,
+            mesh_spec=mesh_spec)
+    except ValueError as exc:
+        # the spec parsed, so a ValueError here is a topology the
+        # runtime cannot place (e.g. not enough devices)
+        raise FatalError(f"--mesh/TRIVY_TPU_MESH: {exc}")
 
 
 def build_engine(args):

@@ -67,14 +67,23 @@ class MatchEngine:
         mesh=None,
         use_device: bool = True,
         db_path: str | None = None,
+        mesh_spec: str | None = None,
     ):
         """`db_path`: the on-disk root `db` was loaded from. When given,
         the compiled tensor set is loaded from / saved to the persistent
         compiled-DB cache keyed by the DB digest + compile params
         (tensorize.cache) — a warm process start with an unchanged DB
-        skips the multi-second recompile entirely."""
+        skips the multi-second recompile entirely.
+
+        `mesh`: a prebuilt (data, db) jax Mesh — the engine serves from
+        a sharded device mesh (ops/mesh.py MeshDB) with per-shard fault
+        isolation. `mesh_spec`: operator topology string ("DPxDB",
+        "auto", "off" — --mesh / TRIVY_TPU_MESH), resolved against the
+        compiled DB's row count; invalid specs raise ValueError at
+        construction so a typo fails at startup, not mid-crawl."""
         self.db = db
         self.cdb: CompiledDB | None = None
+        digest = db_meta = None
         if db_path:
             from trivy_tpu.tensorize import cache as compile_cache
 
@@ -95,10 +104,23 @@ class MatchEngine:
                         db_meta=db_meta)
         if self.cdb is None:
             self.cdb = compile_db(db, window=window)
+        # routes the mesh's per-shard slices through the persistent
+        # compiled-DB cache under mesh-topology-aware keys
+        self._cache_ctx = (db_path, digest, db_meta, window) \
+            if db_path else None
+        if use_device and mesh is None and mesh_spec:
+            from trivy_tpu.ops import mesh as mesh_ops
+
+            mesh = mesh_ops.build_from_spec(mesh_spec,
+                                            n_rows=self.cdb.n_rows)
         self.mesh = mesh
+        # the requested spec, kept so an engine rebuild (the server's
+        # hot DB reload) re-resolves the topology against the NEW DB's
+        # row count instead of silently dropping the mesh
+        self.mesh_spec = mesh_spec
         self.use_device = use_device
         self._ddb = None
-        self._sdb = None
+        self._mdb = None
         self.rescreen_stats = {"candidates": 0, "confirmed": 0}
         # set when an (injected or real) device loss degraded this
         # engine to the host oracle mid-flight
@@ -159,7 +181,13 @@ class MatchEngine:
             self.cdb.name_tokens = self._name_tokens
             self.cdb.version_tokens = self._version_tokens
             if mesh is not None:
-                self._sdb = m.ShardedDB.from_compiled(self.cdb, mesh)
+                from trivy_tpu.ops import mesh as mesh_ops
+
+                # the serving mesh path: per-shard DeviceDB slices with
+                # shard-level fault isolation (ops/mesh.py), warm-started
+                # from the mesh-aware compiled-DB cache when possible
+                self._mdb = mesh_ops.MeshDB.from_compiled(
+                    self.cdb, mesh, cache_ctx=self._cache_ctx)
             else:
                 self._ddb = m.DeviceDB.from_compiled(self.cdb)
             # hot names match on device against their own partitions
@@ -175,6 +203,30 @@ class MatchEngine:
         """The resident single-device DB tensors (None in mesh/host
         modes) — public handle for benches and diagnostics."""
         return self._ddb
+
+    @property
+    def mesh_data_axis(self) -> int:
+        """Data-parallel width of the serving mesh (1 = single-chip).
+        The match scheduler composes its coalesced micro-batches to
+        fill this axis."""
+        return self._mdb.n_data if self._mdb is not None else 1
+
+    @property
+    def mesh_row_floor(self) -> int:
+        """Largest per-group jit bucket the mesh grid has ratcheted to
+        (ops/match DeviceDB.bucket_floor; 0 = single-chip / cold).
+        Dispatch pads every data group up to this anyway, so the match
+        scheduler tops coalesced batches up to it for free."""
+        if self._mdb is None:
+            return 0
+        return max((ddb.bucket_floor for row in self._mdb.grid
+                    for ddb in row), default=0)
+
+    def shard_health(self) -> dict | None:
+        """Mesh shard health for /readyz and diagnostics: the topology
+        plus which db shards are degraded to the host oracle. None on
+        the single-chip path."""
+        return self._mdb.health() if self._mdb is not None else None
 
     @staticmethod
     def dedupe_queries(queries: list[PkgQuery]):
@@ -757,8 +809,8 @@ class MatchEngine:
         ctx = {"queries": queries, "batch": batch,
                "memo_gen": self._memo_gen,
                "main": None, "sharded": None, "hot": None, "tall": None}
-        if self._sdb is not None:
-            ctx["sharded"] = m.sharded_dispatch(self._sdb, batch)
+        if self._mdb is not None:
+            ctx["sharded"] = self._mdb.dispatch(batch)
         elif self._ddb is not None:
             ctx["main"] = m.match_dispatch(self._ddb, batch)
         # hot/tall tier routing comes gathered from the name intern
@@ -895,10 +947,10 @@ class MatchEngine:
 
         if ctx["sharded"] is not None:
             masks = ctx["sharded"].collect()  # [D, B, W]
-            base = self._sdb.shard_base
+            base = self._mdb.shard_base
             for d in range(masks.shape[0]):
                 lo_i = d * base
-                hi_i = min(lo_i + self._sdb.shard_len, cdb.n_rows)
+                hi_i = min(lo_i + self._mdb.shard_len, cdb.n_rows)
                 if lo_i >= cdb.n_rows:
                     break
                 start = np.searchsorted(

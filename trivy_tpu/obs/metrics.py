@@ -418,3 +418,26 @@ SCHED_WAIT_SECONDS = REGISTRY.histogram(
     "Queue wait from scheduler submission to first micro-batch dispatch",
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
              0.25, 1.0, 5.0))
+MESH_SHAPE = REGISTRY.gauge(
+    "trivy_tpu_mesh_shape",
+    "Serving-mesh topology by axis (axis=data: query-parallel groups, "
+    "axis=db: advisory shards); absent/0 = single-chip path",
+    labels=("axis",))
+MESH_SHARD_DISPATCH_SECONDS = REGISTRY.histogram(
+    "trivy_tpu_mesh_shard_dispatch_seconds",
+    "Per-shard dispatch+collect wall seconds of the mesh match path "
+    "(includes retries and the host fallback of a degraded shard)",
+    labels=("shard",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             1.0, 5.0))
+MESH_SHARD_RETRIES = REGISTRY.counter(
+    "trivy_tpu_mesh_shard_retries_total",
+    "Mesh shard dispatches retried after a shard-local failure "
+    "(before any degradation)",
+    labels=("shard",))
+MESH_SHARD_DEGRADATIONS = REGISTRY.counter(
+    "trivy_tpu_mesh_shard_degradations_total",
+    "Mesh shards degraded to the host oracle after retries were "
+    "exhausted or the shard's device was lost (zero finding diff; the "
+    "healthy shards keep serving on-device)",
+    labels=("shard",))

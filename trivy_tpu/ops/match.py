@@ -53,6 +53,30 @@ TABLE_LANES = 8  # int32 lanes per row: h1,h2,lo,hi,flags + 3 pad
 _PAD_H1 = np.uint32(0xFFFFFFFF)
 
 
+def _shard_map():
+    """jax.shard_map moved out of the experimental namespace around
+    jax 0.5; resolve whichever spelling this runtime has. ImportError
+    propagates when neither exists (no collective sharding support) —
+    callers on such runtimes use the per-shard dispatch path
+    (ops/mesh.py) instead."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def shard_map_available() -> bool:
+    """Whether this jax runtime can run the collective shard_map path
+    (the DCN dryrun and ShardedDB). The serving mesh (ops/mesh.py)
+    does NOT need it — per-shard dispatches are plain jits."""
+    try:
+        _shard_map()
+    except ImportError:
+        return False
+    return True
+
+
 def _words(window: int) -> int:
     """Output words per query for a given guarantee window."""
     return -(-window // 32)
@@ -369,8 +393,7 @@ def _sharded_match(row_h1, table, pkg_h1, pkg_h2, pkg_rank, pkg_flags,
         )
         return out[None]  # [1, b_local, W/32]
 
-    from jax import shard_map
-
+    shard_map = _shard_map()
     return shard_map(
         local,
         mesh=mesh,
@@ -382,57 +405,7 @@ def _sharded_match(row_h1, table, pkg_h1, pkg_h2, pkg_rank, pkg_flags,
     )(row_h1, table, pkg_h1, pkg_h2, pkg_rank, pkg_flags)
 
 
-@dataclass
-class ShardedPending:
-    """In-flight sharded match (see Pending)."""
-
-    out: jax.Array  # uint32[n_db, cut, W/32] — already bucket-trimmed
-    order: np.ndarray
-    b: int
-    window: int
-    n_db: int
-
-    def collect(self) -> np.ndarray:
-        """Block and -> bool[n_db, B, ceil32(W)] per-shard masks in the
-        original query order."""
-        w = _words(self.window) * 32
-        out = np.asarray(self.out)[:, : self.b]
-        masks = np.empty((self.n_db, self.b, w), dtype=bool)
-        for d in range(self.n_db):
-            m = _unpack_words(out[d], self.window)
-            masks[d][self.order] = m
-        return masks
-
-
-def sharded_dispatch(sdb: ShardedDB,
-                     batch: PackageBatch) -> ShardedPending | None:
-    """Enqueue a sharded match without blocking. None when no work."""
-    n_data = sdb.mesh.shape["data"]
-    n_db = sdb.mesh.shape["db"]
-    b = len(batch.h1)
-    if b == 0:
-        return None
-    bucket = _bucket(max(b, n_data))
-    bucket += (-bucket) % n_data
-    order, h1, h2, rank, flags = _sorted_padded(batch, bucket)
-    spec = NamedSharding(sdb.mesh, P("data"))
-    out = _sharded_match(
-        sdb.h1, sdb.table,
-        jax.device_put(h1, spec), jax.device_put(h2, spec),
-        jax.device_put(rank, spec), jax.device_put(flags, spec),
-        window=sdb.window, mesh=sdb.mesh,
-    )
-    out = trim_and_prefetch(out, b, axis=1)
-    return ShardedPending(out=out, order=order, b=b,
-                          window=sdb.window, n_db=n_db)
-
-
-def match_batch_sharded(sdb: ShardedDB, batch: PackageBatch) -> np.ndarray:
-    """Sharded match -> bool[n_db, B, ceil32(W)] per-shard hit masks in the
-    original query order. Global row index of bit (d, b, w) =
-    d*shard_base + local_searchsorted(shard_h1_d, h1[b]) + w."""
-    p = sharded_dispatch(sdb, batch)
-    if p is None:
-        return np.zeros(
-            (sdb.mesh.shape["db"], 0, _words(sdb.window) * 32), dtype=bool)
-    return p.collect()
+# NB: the SERVING multi-device path does not live here — it is
+# ops/mesh.py MeshDB.dispatch (plain per-cell jits with per-shard fault
+# isolation).  ShardedDB + _sharded_match stay as the collective
+# shard_map formulation the DCN dryrun's cross-host reduction needs.

@@ -1,0 +1,466 @@
+"""Serving-grade multichip mesh for the match spine.
+
+`MULTICHIP_r05.json` / `MULTICHIP_DCN_r05.json` proved the 2x4 dp x db
+mesh and the 2-process DCN reconciliation zero-diff — but only as
+dryruns.  This module promotes that layout into the production path:
+`MatchEngine(mesh=...)` builds a `MeshDB` here and every
+`detect`/`submit`/`detect_many` batch dispatches onto it.
+
+Layout (same physics as ops/multihost.py, now with serving semantics):
+
+  "db" axis    the advisory row table sharded into halo-padded slices
+               (ops/match.py `ShardedDB.host_shards`), one slice per
+               shard, each slice resident on its own device — the axis
+               that admits advisory sets larger than one chip's HBM.
+  "data" axis  the query batch split into contiguous row groups, one
+               group per data-parallel replica set — the axis that buys
+               query throughput.
+
+Unlike the dryrun's collective `shard_map` kernel, the serving path
+dispatches each (data-group, db-shard) cell as its OWN plain jit on
+that cell's device.  That choice is deliberate:
+
+- **Per-shard fault isolation.**  A failing cell is retried
+  (`TRIVY_TPU_MESH_SHARD_RETRIES`, default 1) and then only that
+  shard's advisory slice degrades to the host oracle — the healthy
+  shards keep serving on-device, and the finding set is byte-identical
+  either way (the host mask replicates the kernel bit-for-bit over the
+  shard's row range).  A collective kernel can only fail as a whole.
+- **No collectives needed.**  The match kernel is a pure map (see
+  ops/match.py): every cell answers "which of my rows hit" for its
+  queries; the host-side decoder merges shard bitmaps.  shard_map
+  bought nothing on the hot path but a single failure domain.
+- **Runtime reach.**  Plain jits run on any jax; `shard_map` moved
+  namespaces across jax releases (ops/match.py `shard_map_available`)
+  and stays needed only by the DCN dryrun's cross-host reduction.
+
+Topology comes from `--mesh DPxDB` / `TRIVY_TPU_MESH` ("auto" sizes the
+db axis so each shard slice fits the per-device HBM budget,
+`TRIVY_TPU_MESH_HBM_GB`, and gives every remaining device to "data").
+Per-shard compiled-DB slices warm-start from the persistent cache
+(tensorize/cache.py `load_shards`; a 1x1 topology never creates mesh
+entries, so single-chip cache keys stay byte-identical to before).
+
+Fault site ``engine.shard``: ``drop`` discards one cell's in-flight
+result and re-dispatches it, ``delay`` stalls the collect, ``error``
+fails the cell (retry, then degrade), ``device-lost`` degrades the
+shard immediately.  Degradations surface in
+``trivy_tpu_mesh_shard_degradations_total`` and in /readyz.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from trivy_tpu.log import logger
+from trivy_tpu.resilience import faults
+
+_log = logger("mesh")
+
+ENV_MESH = "TRIVY_TPU_MESH"
+ENV_RETRIES = "TRIVY_TPU_MESH_SHARD_RETRIES"
+ENV_HBM = "TRIVY_TPU_MESH_HBM_GB"
+
+DEFAULT_RETRIES = 1
+# conservative per-device budget for the resident advisory tensors:
+# half a v5e chip's 16 GB HBM, leaving room for batch buffers and the
+# hot/tall partitions
+DEFAULT_HBM_GB = 8.0
+
+_SPEC_RX = re.compile(r"^(\d+)\s*[xX]\s*(\d+)$")
+
+
+class ShardFault(faults.FaultError):
+    """A single mesh cell failed (injected or real); retried, then the
+    shard degrades to the host oracle."""
+
+
+class ShardLost(ShardFault):
+    """A mesh cell's device is gone: degrade the shard without retry."""
+
+
+def spec_from_env() -> str:
+    """The ambient mesh spec (TRIVY_TPU_MESH); "" = single-chip."""
+    return os.environ.get(ENV_MESH, "")
+
+
+def shard_retries() -> int:
+    raw = os.environ.get(ENV_RETRIES, "")
+    if raw:
+        try:
+            return max(int(raw), 0)
+        except ValueError:
+            _log.warn("bad TRIVY_TPU_MESH_SHARD_RETRIES; using default",
+                      value=raw)
+    return DEFAULT_RETRIES
+
+
+def _hbm_budget_bytes() -> float:
+    raw = os.environ.get(ENV_HBM, "")
+    if raw:
+        try:
+            return max(float(raw), 0.001) * 1e9
+        except ValueError:
+            _log.warn("bad TRIVY_TPU_MESH_HBM_GB; using default",
+                      value=raw)
+    return DEFAULT_HBM_GB * 1e9
+
+
+def parse_spec(spec: str):
+    """"" / "0" / "off" -> None (single-chip), "auto" -> "auto",
+    "DPxDB" -> (dp, db).  Raises ValueError on anything else so an
+    operator typo fails at startup, not mid-crawl."""
+    s = (spec or "").strip().lower()
+    if s in ("", "0", "off", "none"):
+        return None
+    if s == "auto":
+        return "auto"
+    m = _SPEC_RX.match(s)
+    if not m:
+        raise ValueError(
+            f"bad mesh spec {spec!r}: want 'DPxDB' (e.g. 2x4), 'auto', "
+            "or 'off'")
+    dp, db = int(m.group(1)), int(m.group(2))
+    if dp < 1 or db < 1:
+        raise ValueError(f"mesh axes must be >= 1, got {spec!r}")
+    return dp, db
+
+
+def multi_device_ready(n: int = 2) -> bool:
+    """True when the runtime can place an n-device mesh.  Test suites
+    use this to SKIP mesh cases cleanly on boxes without a multi-device
+    runtime instead of failing the import or the placement."""
+    try:
+        import jax
+
+        return jax.local_device_count() >= n
+    except Exception:
+        return False
+
+
+def choose_topology(n_devices: int, n_rows: int) -> tuple[int, int]:
+    """(dp, db) for `n_devices` and an `n_rows`-row advisory table:
+    the db axis is the smallest divisor of the device count whose
+    per-shard slice fits the HBM budget (advisory sets beyond one
+    chip), and every remaining device goes to data (query throughput).
+    """
+    from trivy_tpu.ops.match import TABLE_LANES
+
+    n_devices = max(int(n_devices), 1)
+    row_bytes = 4 * (1 + TABLE_LANES)  # h1 column + interleaved table
+    budget = _hbm_budget_bytes()
+    db = n_devices
+    for cand in range(1, n_devices + 1):
+        if n_devices % cand:
+            continue
+        if -(-max(n_rows, 1) // cand) * row_bytes <= budget:
+            db = cand
+            break
+    return n_devices // db, db
+
+
+def build_mesh(dp: int, db: int):
+    """A (data=dp, db=db) Mesh over the first dp*db local devices.
+    The serving mesh is single-process by design — every cell's slice
+    is device_put onto an addressable device.  Multi-process (DCN)
+    serving is rejected here rather than handed a cross-host mesh the
+    per-cell placement cannot commit to; the cross-host reconciliation
+    exists only as the dryrun (ops/dcn_dryrun.py) — run one server
+    per host until it is promoted."""
+    import jax
+
+    from trivy_tpu.ops import multihost
+
+    if jax.process_count() > 1:
+        raise ValueError(
+            "multi-process serving mesh is not supported (the DCN "
+            "path is dryrun-only, ops/dcn_dryrun.py); run one server "
+            "per host")
+    n_local = jax.local_device_count()
+    if dp * db > n_local:
+        raise ValueError(
+            f"mesh {dp}x{db} needs {dp * db} devices, have {n_local}")
+    return multihost.crawl_mesh(n_db=db, devices=jax.devices()[: dp * db])
+
+
+def build_from_spec(spec: str, n_rows: int):
+    """Mesh from an operator spec, or None for the single-chip path.
+    "auto" picks the topology from the DB size and the device count;
+    on a single-device runtime auto stays on the plain (cheaper)
+    single-device path."""
+    parsed = parse_spec(spec)
+    if parsed is None:
+        return None
+    import jax
+
+    n_local = jax.local_device_count()
+    if parsed == "auto":
+        if n_local <= 1:
+            return None
+        dp, db = choose_topology(n_local, n_rows)
+    else:
+        dp, db = parsed
+    mesh = build_mesh(dp, db)
+    _log.info("serving mesh topology selected", data=dp, db=db,
+              devices=dp * db, spec=spec, rows=n_rows)
+    return mesh
+
+
+# ------------------------------------------------------------------ MeshDB
+
+
+def _host_shard_mask(cdb, lo: int, hi: int, window: int,
+                     h1, h2, rank, flags) -> np.ndarray:
+    """bool[B, ceil32(W)] hit mask for rows [lo, hi) computed on host —
+    a bit-exact numpy replica of ops/match._match_kernel over one
+    shard's row range (the degraded-shard path; padding rows past `hi`
+    contribute no bits, exactly like the device's PAD sentinel rows)."""
+    from trivy_tpu.ops import match as m
+
+    w = m._words(window) * 32
+    b = len(h1)
+    out = np.zeros((b, w), dtype=bool)
+    n = hi - lo
+    if n <= 0 or b == 0:
+        return out
+    start = np.searchsorted(cdb.row_h1[lo:hi], h1).astype(np.int64)
+    offs = start[:, None] + np.arange(w, dtype=np.int64)[None, :]
+    inb = offs < n
+    idx = lo + np.minimum(offs, n - 1)
+    rh1 = cdb.row_h1[idx]
+    rh2 = cdb.row_h2[idx]
+    rlo = cdb.row_lo[idx]
+    rhi = cdb.row_hi[idx]
+    rfl = cdb.row_flags[idx]
+    name_eq = inb & (rh1 == h1[:, None]) & (rh2 == h2[:, None])
+    rk = rank[:, None]
+    in_iv = (rlo <= rk) & (rk <= rhi)
+    host = ((rfl & m.FLAG_NEEDS_HOST) != 0) | (
+        (flags[:, None] & m.FLAG_NEEDS_HOST) != 0)
+    pre_ok = ((rfl & m.FLAG_PRE_ONLY) == 0) | (
+        (flags[:, None] & (m.FLAG_RESCREEN | m.FLAG_NEEDS_HOST)) != 0)
+    return name_eq & (in_iv | host) & pre_ok
+
+
+@dataclass
+class MeshPending:
+    """In-flight mesh match: one Pending per (data-group, db-shard)
+    cell, collected into the [n_db, B, W] per-shard mask stack the
+    engine's decoder consumes.  Fault handling (engine.shard) happens
+    at collect time so a lost in-flight result can be re-dispatched."""
+
+    mdb: "MeshDB"
+    # (lo, hi, sub_batch, [pending-or-None per shard])
+    groups: list
+    b: int
+
+    def collect(self) -> np.ndarray:
+        from trivy_tpu.ops import match as m
+
+        w = m._words(self.mdb.window) * 32
+        masks = np.zeros((self.mdb.n_db, self.b, w), dtype=bool)
+        for d in range(self.mdb.n_db):
+            for lo, hi, sub, pends in self.groups:
+                masks[d, lo:hi] = self.mdb._collect_cell(
+                    d, sub, pends[d])
+        return masks
+
+
+@dataclass
+class MeshDB:
+    """The serving mesh: per-shard halo-padded advisory slices, each
+    replicated across the data axis as plain per-device DeviceDBs
+    (one device holds one slice — the HBM story of the db axis)."""
+
+    cdb: object
+    grid: list          # [n_data][n_db] DeviceDB
+    n_data: int
+    n_db: int
+    window: int
+    shard_len: int
+    shard_base: int
+    retries: int = field(default_factory=shard_retries)
+    degraded: set = field(default_factory=set)
+    _lock: object = None
+
+    def __post_init__(self):
+        from trivy_tpu.analysis.witness import make_lock
+
+        self._lock = make_lock("ops.mesh.MeshDB._lock")
+
+    # -------------------------------------------------------- construction
+
+    @classmethod
+    def from_compiled(cls, cdb, mesh, cache_ctx=None) -> "MeshDB":
+        """Build the mesh-resident DB from a CompiledDB.  `cache_ctx` =
+        (db_path, digest, db_meta, requested_window) routes the
+        per-shard slices through the persistent compiled-DB cache
+        (mesh-topology-aware keys) so a warm start skips the
+        slice+pack pass."""
+        import functools
+
+        import jax
+
+        from trivy_tpu.obs import metrics as obs_metrics
+        from trivy_tpu.ops import match as m
+        from trivy_tpu.tensorize import cache as compile_cache
+
+        n_data = mesh.shape["data"]
+        n_db = mesh.shape["db"]
+        shards = None
+        db_path = digest = db_meta = window_req = None
+        if cache_ctx:
+            db_path, digest, db_meta, window_req = cache_ctx
+        use_cache = bool(db_path) and n_db >= 2 and compile_cache.enabled()
+        if use_cache:
+            shards = compile_cache.load_shards(
+                db_path, cdb, n_db, window=window_req, digest=digest,
+                db_meta=db_meta)
+        if shards is None:
+            shards = m.ShardedDB.host_shards(cdb, n_db)
+            if use_cache:
+                compile_cache.save_shards(
+                    db_path, cdb, n_db, shards, window=window_req,
+                    digest=digest, db_meta=db_meta)
+        h1s, tables, shard_len, shard_base = shards
+        devices = np.asarray(mesh.devices).reshape(n_data, n_db)
+        grid = []
+        for g in range(n_data):
+            row = []
+            for d in range(n_db):
+                put = functools.partial(jax.device_put,
+                                        device=devices[g, d])
+                row.append(m.DeviceDB(
+                    h1=put(h1s[d]), table=put(tables[d]),
+                    n_rows=shard_len, window=cdb.window))
+            grid.append(row)
+        obs_metrics.MESH_SHAPE.set(n_data, axis="data")
+        obs_metrics.MESH_SHAPE.set(n_db, axis="db")
+        _log.info("mesh DB resident", data=n_data, db=n_db,
+                  shard_rows=shard_len, total_rows=cdb.n_rows)
+        return cls(cdb=cdb, grid=grid, n_data=n_data, n_db=n_db,
+                   window=cdb.window, shard_len=shard_len,
+                   shard_base=shard_base)
+
+    # ------------------------------------------------------------ dispatch
+
+    def dispatch(self, batch) -> MeshPending | None:
+        """Enqueue a batch across the mesh without blocking: the query
+        rows split into contiguous data-axis groups, each group's rows
+        dispatch against every db shard's slice on that cell's device.
+        None when there is no work."""
+        from trivy_tpu.ops import match as m
+
+        b = len(batch.h1)
+        if b == 0 or self.cdb.n_rows == 0:
+            return None
+        base, rem = divmod(b, self.n_data)
+        groups = []
+        lo = 0
+        for g in range(self.n_data):
+            hi = lo + base + (1 if g < rem else 0)
+            if hi == lo:
+                continue
+            sub = m.PackageBatch(
+                h1=batch.h1[lo:hi], h2=batch.h2[lo:hi],
+                rank=batch.rank[lo:hi], flags=batch.flags[lo:hi],
+                queries=batch.queries[lo:hi],
+            )
+            pends = []
+            for d in range(self.n_db):
+                if d in self.degraded:
+                    pends.append(None)  # host fallback at collect
+                else:
+                    pends.append((g, m.match_dispatch(self.grid[g][d],
+                                                      sub)))
+            groups.append((lo, hi, sub, pends))
+            lo = hi
+        return MeshPending(mdb=self, groups=groups, b=b)
+
+    # ------------------------------------------------------------- collect
+
+    def _host_mask(self, d: int, sub) -> np.ndarray:
+        lo = d * self.shard_base
+        hi = min(lo + self.shard_len, self.cdb.n_rows)
+        return _host_shard_mask(self.cdb, lo, hi, self.window,
+                                sub.h1, sub.h2, sub.rank, sub.flags)
+
+    def _degrade(self, d: int, exc: Exception) -> None:
+        from trivy_tpu.obs import metrics as obs_metrics
+
+        with self._lock:
+            fresh = d not in self.degraded
+            self.degraded.add(d)
+        if fresh:
+            obs_metrics.MESH_SHARD_DEGRADATIONS.inc(shard=str(d))
+            _log.warn(
+                "mesh shard degraded to host oracle (healthy shards "
+                "keep serving on-device; zero finding diff)",
+                shard=d, err=str(exc))
+
+    def _collect_cell(self, d: int, sub, cell) -> np.ndarray:
+        """Block on one (data-group, db-shard) cell's result, running
+        the engine.shard fault ladder: drop -> re-dispatch, error ->
+        retry then degrade, device-lost -> degrade now.  Always returns
+        a bit-exact mask — degradation changes latency, never bits."""
+        from trivy_tpu.obs import metrics as obs_metrics
+        from trivy_tpu.ops import match as m
+
+        t0 = time.perf_counter()
+        try:
+            if cell is None or d in self.degraded:
+                return self._host_mask(d, sub)
+            g, pending = cell
+            attempt = 0
+            while True:
+                try:
+                    redo = pending is None
+                    for r in faults.fire("engine.shard"):
+                        if r.action == "delay":
+                            time.sleep(r.param if r.param is not None
+                                       else 0.02)
+                        elif r.action == "drop":
+                            redo = True
+                        elif r.action == "error":
+                            raise ShardFault(
+                                f"injected shard error (shard {d})")
+                        elif r.action == "device-lost":
+                            raise ShardLost(
+                                f"injected shard device loss (shard {d})")
+                    if redo:
+                        # a dropped in-flight result is recomputed —
+                        # the match set stays byte-identical
+                        pending = m.match_dispatch(self.grid[g][d], sub)
+                    return pending.collect()
+                except ShardLost as exc:
+                    self._degrade(d, exc)
+                    return self._host_mask(d, sub)
+                except Exception as exc:
+                    if attempt >= self.retries:
+                        self._degrade(d, exc)
+                        return self._host_mask(d, sub)
+                    attempt += 1
+                    obs_metrics.MESH_SHARD_RETRIES.inc(shard=str(d))
+                    _log.warn("mesh shard dispatch failed; retrying",
+                              shard=d, attempt=attempt, err=str(exc))
+                    pending = None  # re-dispatch on the next pass
+        finally:
+            obs_metrics.MESH_SHARD_DISPATCH_SECONDS.observe(
+                time.perf_counter() - t0, shard=str(d))
+
+    # -------------------------------------------------------------- health
+
+    def health(self) -> dict:
+        with self._lock:
+            degraded = sorted(self.degraded)
+        return {
+            "shape": f"{self.n_data}x{self.n_db}",
+            "data": self.n_data,
+            "db": self.n_db,
+            "degraded": degraded,
+        }

@@ -78,6 +78,7 @@ SITES: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("rpc.cache", ("drop", "timeout", "delay", "error", "corrupt")),
     ("engine", ("device-lost",)),
     ("engine.device", ("drop", "delay", "device-lost")),
+    ("engine.shard", ("drop", "delay", "error", "device-lost")),
     ("sched.submit", ("drop", "delay", "error")),
     ("analysis.fetch", ("drop", "delay", "error", "kill")),
     ("fleet.scan", ("kill",)),

@@ -249,7 +249,15 @@ class ScanService:
                 max_rows=(sched_max_rows if sched_max_rows is not None
                           else _sched.DEFAULT_MAX_ROWS),
                 on_shed=self.metrics.scans_shed.inc,
-                busy_fn=lambda: self._inflight)
+                busy_fn=lambda: self._inflight,
+                # mesh-shape-aware composition: coalesced micro-batches
+                # top up to fill the engine's data-parallel axis (the
+                # engine is read at compose time so a hot swap onto a
+                # different topology is picked up immediately)
+                data_axis_fn=lambda: getattr(
+                    self.engine, "mesh_data_axis", 1),
+                row_floor_fn=lambda: getattr(
+                    self.engine, "mesh_row_floor", 0))
 
     def _resolved_db_dir(self) -> str | None:
         """Real directory the DB would load from right now (a generation
@@ -315,9 +323,23 @@ class ScanService:
             return False, "engine not loaded"
         if self.lock.write_busy:
             return False, "advisory-DB swap in progress"
+        # mesh shard health: a shard degraded to the host oracle keeps
+        # the server ready (zero finding diff, reduced throughput) but
+        # /readyz says so, the way serving last-good does
+        mesh_note = ""
+        health_fn = getattr(self.engine, "shard_health", None)
+        health = health_fn() if callable(health_fn) else None
+        if health:
+            mesh_note = f"; mesh {health['shape']}"
+            if health["degraded"]:
+                mesh_note += (
+                    " shard(s) "
+                    + ",".join(str(d) for d in health["degraded"])
+                    + " degraded to host")
         if self.db_degraded:
-            return True, f"ok (serving last-good: {self.db_degraded})"
-        return True, "ok"
+            return True, (f"ok (serving last-good: {self.db_degraded})"
+                          + mesh_note)
+        return True, "ok" + mesh_note
 
     def begin_scan(self) -> None:
         """Admission control: refused while draining (503 + Retry-After
@@ -505,8 +527,17 @@ class ScanService:
                 # compiled-DB cache: a generation already compiled by a
                 # sibling process (or a rollback to last-good) swaps in
                 # without paying the full tensorize cost again
-                new_engine = MatchEngine(db, use_device=self.engine.use_device,
-                                         db_path=self.db_path)
+                # the swap must keep the serving-mesh topology: a
+                # spec-built mesh re-resolves against the new DB's row
+                # count ("auto" can re-size), a prebuilt mesh carries
+                # over as-is — never silently revert to single-chip
+                mesh_spec = getattr(self.engine, "mesh_spec", None)
+                new_engine = MatchEngine(
+                    db, use_device=self.engine.use_device,
+                    db_path=self.db_path,
+                    mesh=None if mesh_spec else getattr(
+                        self.engine, "mesh", None),
+                    mesh_spec=mesh_spec)
         except Exception as exc:
             problem = f"unloadable: {exc}"
         if problem is not None:

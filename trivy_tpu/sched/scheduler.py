@@ -138,8 +138,18 @@ class MatchScheduler:
                  max_queue: int = DEFAULT_MAX_QUEUE,
                  chunk_rows: int | None = None,
                  depth: int = DEFAULT_DEPTH, on_shed=None,
-                 busy_fn=None):
+                 busy_fn=None, data_axis_fn=None, row_floor_fn=None):
         self._engine_fn = engine_fn
+        # optional zero-arg callable -> the engine's mesh data-parallel
+        # width (1 = single-chip). When > 1, composed batches top up to
+        # a multiple of the data axis' padded row granularity so every
+        # data-parallel group carries real queries, not padding
+        # (mesh-shape-aware composition; see _compose).
+        self._data_axis_fn = data_axis_fn
+        # optional zero-arg callable -> the mesh grid's ratcheted
+        # per-group jit bucket (engine.mesh_row_floor): dispatch pads
+        # every group up to it regardless, so the top-up targets it
+        self._row_floor_fn = row_floor_fn
         # optional zero-arg callable -> number of in-flight scans (the
         # server wires its admission counter). When it reports <= 1,
         # nobody else can submit concurrently, so the coalesce window
@@ -350,11 +360,71 @@ class MatchScheduler:
                     parts.append((p, lo, hi))
                     rows += hi - lo
                     progressed = True
+            self._mesh_fill(order, parts, rows)
+            rows = sum(hi - lo for _p, lo, hi in parts)
             # fully-dispatched requests leave the queue; they complete
             # from the dispatch path when their in-flight chunks land
             self._waiting = [p for p in self._waiting if p.queued_rows]
             obs_metrics.SCHED_QUEUE_DEPTH.set(len(self._waiting))
             return (parts, rows)
+
+    def _mesh_fill(self, order, parts, rows: int) -> None:
+        """Mesh-shape-aware composition (caller holds _cond): when the
+        engine serves from a dp>1 data-parallel mesh, the dispatch path
+        splits each batch across dp device groups and pads every group
+        up to its 128*2^k jit bucket (ops/match._bucket) — a batch
+        whose per-group size is off-bucket ships padding rows on every
+        group. Top the batch up from the waiting requests' queued rows
+        (deadline order, same as the interleave) to dp * the bucket the
+        groups will compile to anyway, so the shipped buckets carry
+        real queries instead of padding."""
+        if not parts:
+            return
+        dp = 1
+        if self._data_axis_fn is not None:
+            try:
+                dp = max(int(self._data_axis_fn()), 1)
+            except Exception:
+                # advisory sizing hint only; a broken probe must not
+                # kill batch composition
+                dp = 1
+        if dp <= 1:
+            return
+        from trivy_tpu.ops.match import _bucket
+
+        floor = 0
+        if self._row_floor_fn is not None:
+            try:
+                floor = max(int(self._row_floor_fn()), 0)
+            except Exception:
+                floor = 0
+        # each data group pads to max(its 128*2^k bucket, the grid's
+        # ratcheted floor) on dispatch — top up to whichever the groups
+        # will actually compile to
+        rem = dp * max(_bucket(-(-rows // dp)), floor) - rows
+        for p in order:
+            if not rem:
+                return
+            take = min(rem, p.queued_rows)
+            if not take:
+                continue
+            lo = p.next_row
+            hi = lo + take
+            p.next_row = hi
+            rem -= take
+            for i in range(len(parts) - 1, -1, -1):
+                if parts[i][0] is p and parts[i][2] == lo:
+                    # extend this request's last chunk in place — no
+                    # extra in-flight accounting needed
+                    parts[i] = (p, parts[i][1], hi)
+                    break
+            else:
+                p.inflight += 1
+                if p.dispatched_at is None:
+                    p.dispatched_at = time.monotonic()
+                    obs_metrics.SCHED_WAIT_SECONDS.observe(
+                        p.dispatched_at - p.arrival)
+                parts.append((p, lo, hi))
 
     def _dispatch(self, parts, rows: int) -> None:
         if not parts:

@@ -80,6 +80,18 @@ def entry_path(db_root: str, digest: str, window: int | None) -> str:
                         f"{digest}.{params_key(window)}.npz")
 
 
+def shard_entry_path(db_root: str, digest: str, window: int | None,
+                     n_db: int) -> str:
+    """Mesh-topology-aware key for a per-shard slice set: the base
+    params plus the db-shard count (the slices depend on nothing else —
+    dp only replicates them).  Single-chip (and 1x1 mesh) engines never
+    create these, so the base entry keys above stay byte-identical to
+    the pre-mesh layout."""
+    return os.path.join(
+        cache_root(db_root),
+        f"{digest}.{params_key(window)}.mesh{int(n_db)}.npz")
+
+
 def db_digest(db_path: str) -> str | None:
     """Digest identifying the advisory-DB bytes an entry was compiled
     from. A generation-managed root reuses the generation's OCI digest
@@ -312,3 +324,123 @@ def load_compiled(db_path: str, db, window: int | None,
               load_s=round(time.perf_counter() - t0, 3),
               rows=cdb.n_rows)
     return cdb
+
+
+def save_shards(db_path: str, cdb, n_db: int, shards,
+                window: int | None = None, digest: str | None = None,
+                db_meta: dict | None = None) -> str | None:
+    """Serialize a mesh's per-shard slice set (`shards` =
+    (h1s [D,S], tables [D,S,L], shard_len, shard_base) from
+    ops/match.ShardedDB.host_shards) under the digest + params +
+    db-shard-count key.  Same framing/quarantine/never-raise contract
+    as save_compiled — the cache is an accelerator, not a dependency.
+    """
+    if not enabled():
+        return None
+    try:
+        digest = digest or db_digest(db_path)
+        if digest is None:
+            return None
+        h1s, tables, shard_len, shard_base = shards
+        root = cache_root(db_path)
+        os.makedirs(root, exist_ok=True)
+        t0 = time.perf_counter()
+        meta = {
+            "format": FORMAT_VERSION,
+            "digest": digest,
+            "params": params_key(window),
+            "db_meta": db_meta or {},
+            "n_db": int(n_db),
+            "n_rows": int(cdb.n_rows),
+            # the RESOLVED window (the halo width baked into the
+            # slices), distinct from the requested window in `params`
+            "window": int(cdb.window),
+            "shard_len": int(shard_len),
+            "shard_base": int(shard_base),
+        }
+        arrays = {
+            "h1s": h1s,
+            "tables": tables,
+            "meta_json": np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8).copy(),
+        }
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        path = shard_entry_path(db_path, digest, window, n_db)
+        atomic.atomic_write(path, atomic.frame(buf.getvalue()),
+                            fault_site="compile_cache.save")
+        _log.info("mesh shard-slice cache entry saved", path=path,
+                  n_db=n_db, mb=round(buf.tell() / 1e6, 1),
+                  save_s=round(time.perf_counter() - t0, 2))
+        return path
+    except Exception as exc:  # pragma: no cover - best-effort
+        _log.warn("mesh shard-slice cache save failed", err=str(exc))
+        return None
+
+
+def load_shards(db_path: str, cdb, n_db: int,
+                window: int | None = None, digest: str | None = None,
+                db_meta: dict | None = None):
+    """-> (h1s, tables, shard_len, shard_base) from the cache, or None
+    on a miss.  `cdb` is the (already loaded/compiled) CompiledDB the
+    slices must belong to: row count and resolved window cross-check
+    the entry, and a `db_meta` mismatch is a plain miss (generation
+    moved), never a quarantine.  Corrupt entries quarantine and the
+    caller re-slices — zero scan diff by construction."""
+    from trivy_tpu.obs import metrics as obs_metrics
+
+    if not enabled():
+        return None
+    digest = digest or db_digest(db_path)
+    path = shard_entry_path(db_path, digest, window, n_db) \
+        if digest else None
+    if path is None or not os.path.exists(path):
+        obs_metrics.COMPILE_CACHE_MISSES.inc()
+        return None
+    t0 = time.perf_counter()
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as exc:
+        obs_metrics.COMPILE_CACHE_MISSES.inc()
+        _log.warn("mesh shard-slice cache entry unreadable (io); "
+                  "re-slicing", path=path, err=str(exc))
+        return None
+    try:
+        body = atomic.unframe(raw)
+        if body is raw:
+            raise atomic.CorruptEntry("missing checksum footer")
+        z = np.load(io.BytesIO(body), allow_pickle=False)
+        meta = json.loads(z["meta_json"].tobytes())
+        if meta.get("format") != FORMAT_VERSION \
+                or meta.get("digest") != digest \
+                or meta.get("params") != params_key(window) \
+                or meta.get("n_db") != int(n_db):
+            raise atomic.CorruptEntry("metadata/key mismatch")
+        if db_meta is not None and meta.get("db_meta") != db_meta:
+            obs_metrics.COMPILE_CACHE_MISSES.inc()
+            _log.warn("mesh shard-slice cache entry is for a different "
+                      "DB generation; re-slicing", path=path)
+            return None
+        if meta.get("n_rows") != int(cdb.n_rows) \
+                or meta.get("window") != int(cdb.window):
+            raise atomic.CorruptEntry(
+                f"slice/DB mismatch (entry rows={meta.get('n_rows')} "
+                f"window={meta.get('window')}, db rows={cdb.n_rows} "
+                f"window={cdb.window})")
+        h1s, tables = z["h1s"], z["tables"]
+        shard_len = int(meta["shard_len"])
+        shard_base = int(meta["shard_base"])
+        if h1s.shape != (n_db, shard_len) \
+                or tables.shape[:2] != (n_db, shard_len):
+            raise atomic.CorruptEntry("shard array shape mismatch")
+    except Exception as exc:
+        _quarantine(path)
+        obs_metrics.COMPILE_CACHE_MISSES.inc()
+        _log.warn("mesh shard-slice cache entry unreadable; re-slicing",
+                  path=path, err=str(exc))
+        return None
+    obs_metrics.COMPILE_CACHE_HITS.inc()
+    _log.info("mesh shard-slice cache hit", path=path, n_db=n_db,
+              load_s=round(time.perf_counter() - t0, 3))
+    return h1s, tables, shard_len, shard_base
