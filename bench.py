@@ -541,6 +541,126 @@ def bench_mesh() -> dict:
                      f"(rc={proc.returncode}): {proc.stderr[-2000:]}"}
 
 
+def bench_delta() -> dict:
+    """Advisory-delta incremental re-matching (ISSUE 9 tentpole): a
+    synthetic fleet of journaled artifacts against two advisory-DB
+    generations whose delta touches a small fraction of (space, name)
+    keys — the hourly trivy-db refresh shape.  Reports full-rescan vs
+    incremental wall time and artifacts re-matched; the exit gate
+    asserts `delta_diff_vs_full=0` (the incremental index state must be
+    byte-identical to re-matching every artifact from scratch)."""
+    import shutil
+    import tempfile
+
+    from trivy_tpu.db.model import Advisory
+    from trivy_tpu.db.store import AdvisoryDB, Metadata
+    from trivy_tpu.detector.engine import MatchEngine, PkgQuery
+    from trivy_tpu.monitor import MonitorIndex, compute_delta, rescore
+    from trivy_tpu.monitor.rematch import full_findings
+    from trivy_tpu.tensorize import cache as compile_cache
+
+    n_keys = int(os.environ.get("TRIVY_TPU_BENCH_DELTA_KEYS", "50000"))
+    n_artifacts = int(os.environ.get(
+        "TRIVY_TPU_BENCH_DELTA_ARTIFACTS", "200"))
+    pkgs_per = 100
+    touched_target = max(1, n_keys // 2000)      # 0.05% of keys
+    rng = random.Random(17)
+
+    def mk_db(mutated: set) -> AdvisoryDB:
+        db = AdvisoryDB()
+        for i in range(n_keys):
+            fixed = "3.0.0" if f"p{i}" in mutated else "2.0.0"
+            db.put_advisory(
+                "npm::ghsa", f"p{i}",
+                Advisory(vulnerability_id=f"CVE-2026-{i:06d}",
+                         fixed_version=fixed,
+                         vulnerable_versions=[f"<{fixed}"]))
+        db.meta = Metadata(updated_at="2" if mutated else "1")
+        return db
+
+    tmp = tempfile.mkdtemp(prefix="trivy_tpu_bench_delta_")
+    try:
+        db_root = os.path.join(tmp, "db")
+        db1 = mk_db(set())
+        db1.save(db_root)
+        d1 = compile_cache.db_digest(db_root)
+        eng1 = MatchEngine(db1, use_device=False, db_path=db_root)
+
+        # the journaled fleet: artifacts hold random slices of the key
+        # space, half their packages vulnerable
+        index = MonitorIndex.open(os.path.join(tmp, "idx.jsonl"))
+        fleets = []
+        for a in range(n_artifacts):
+            names = rng.sample(range(n_keys), pkgs_per)
+            # 1.0.0 vulnerable either way; 2.5.0 crosses the moved fix
+            # bound (introduced on mutation); 9.9.9 never vulnerable
+            pkgs = [("npm::", f"p{i}",
+                     ("1.0.0", "2.5.0", "9.9.9")[i % 3], "npm")
+                    for i in names]
+            fleets.append((f"img{a}", pkgs))
+        t0 = time.time()
+        for aid, pkgs in fleets:
+            keys = eng1.match_keys(
+                [[PkgQuery(*p) for p in pkgs]])[0]
+            index.update(aid, pkgs, keys, db_digest=d1)
+        index.set_state(d1)
+        baseline_s = time.time() - t0
+
+        # the "hourly refresh": touched_target keys change content
+        mutated = {f"p{i}" for i in rng.sample(range(n_keys),
+                                               touched_target)}
+        db2 = mk_db(mutated)
+        db2.save(db_root)
+        d2 = compile_cache.db_digest(db_root)
+        eng_full = MatchEngine(db2, use_device=False, db_path=db_root)
+        eng_incr = MatchEngine(db2, use_device=False, db_path=db_root)
+        # warm the lazy oracle name index outside both timed regions: a
+        # serving engine already has it, and the fixed build cost would
+        # otherwise swamp the small incremental sweep
+        warm_q = [PkgQuery("npm::", "p0", "1.0.0", "npm")]
+        eng_full.match_keys([warm_q])
+        eng_incr.match_keys([warm_q])
+
+        # full-rescan reference: every artifact re-matched from scratch
+        t0 = time.time()
+        oracle = full_findings(eng_full, index)
+        full_s = time.time() - t0
+
+        # incremental: diff + affected-only re-match
+        t0 = time.time()
+        plan = compute_delta(db_root, d1, db2, new_digest=d2)
+        report = rescore(eng_incr, index, plan)
+        incremental_s = time.time() - t0
+
+        diff = sum(1 for aid in oracle
+                   if (index.findings_of(aid) or set()) != oracle[aid])
+        index.close()
+        return {
+            "keys": n_keys,
+            "touched_keys": len(plan.touched),
+            "touched_fraction": round(len(plan.touched) / n_keys, 5),
+            "artifacts": n_artifacts,
+            "pkgs_per_artifact": pkgs_per,
+            "baseline_index_s": round(baseline_s, 2),
+            "full_rescan_s": round(full_s, 3),
+            "incremental_s": round(incremental_s, 3),
+            "speedup": round(full_s / incremental_s, 1)
+            if incremental_s else 0.0,
+            "rematched_incremental": report.rematched,
+            "rematched_full": n_artifacts,
+            "rematch_ratio": round(
+                n_artifacts / max(report.rematched, 1), 1),
+            "events": {"introduced": report.introduced,
+                       "resolved": report.resolved},
+            "plan_full": report.full,
+            "delta_diff_vs_full": diff,
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_analysis() -> dict:
     """Artifact-analysis pipeline + cross-image layer dedupe (ISSUE 6
     tentpole): a synthetic registry of M images sharing ~70% of their
@@ -1270,6 +1390,12 @@ def main():
     with _trace.span("analysis_pipeline"):
         analysis_detail = bench_analysis()
 
+    # --- advisory-delta incremental re-matching (ISSUE 9) ----------------
+    # hourly DB refresh → re-score only the affected journaled artifacts;
+    # zero diff vs a from-scratch full rescan asserted in the exit gate
+    with _trace.span("delta_rescore"):
+        delta_detail = bench_delta()
+
     # --- secret path (BASELINE config #3: kernel-tree shape) -------------
     with _trace.span("secret_path"):
         secret_detail = bench_secrets()
@@ -1332,6 +1458,7 @@ def main():
         "compile_cache": compile_cache_detail,
         "sched": sched_detail,
         "mesh": mesh_detail,
+        "delta": delta_detail,
     }
     if pipe:
         detail["pipeline_occupancy"] = pipe.get("pipeline_occupancy", 0.0)
@@ -1353,6 +1480,9 @@ def main():
     if mesh_detail.get("error") or mesh_detail.get(
             "mesh_diff_vs_oracle", 0):
         return 1  # every mesh shard count must match the oracle exactly
+    if delta_detail.get("error") or delta_detail.get(
+            "delta_diff_vs_full", 0):
+        return 1  # incremental re-score must equal a from-scratch rescan
     return 0 if diffs == 0 else 1
 
 

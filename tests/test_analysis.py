@@ -361,7 +361,7 @@ class TestKnobs:
         names = {k.name for k in knobs.KNOBS if k.kill_switch}
         assert {"TRIVY_TPU_SCHED", "TRIVY_TPU_PIPELINE",
                 "TRIVY_TPU_ANALYSIS_PIPELINE", "TRIVY_TPU_COMPILE_CACHE",
-                "TRIVY_TPU_SECRET_PROBE"} == names
+                "TRIVY_TPU_SECRET_PROBE", "TRIVY_TPU_MONITOR"} == names
 
     def test_write_knobs_doc_roundtrip(self, tmp_path, capsys):
         (tmp_path / "trivy_tpu").mkdir()

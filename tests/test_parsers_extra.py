@@ -195,3 +195,64 @@ def test_gomod_117_no_sum_supplement():
     res = GoModAnalyzer().post_analyze(files)
     names = {p.name for p in res.applications[0].packages}
     assert "golang.org/x/text" not in names
+
+
+# ------------------------------------------------- toml fallback parser
+
+
+class TestTomlCompat:
+    """trivy_tpu/parsers/toml_compat.py — the tomllib stand-in the
+    lockfile parsers fall back to on Python <= 3.10. Parity checked
+    against real tomllib when this interpreter has it."""
+
+    def _loads(self, s: str):
+        from trivy_tpu.parsers import toml_compat
+
+        doc = toml_compat.loads(s)
+        try:
+            import tomllib
+        except ImportError:
+            return doc
+        assert doc == tomllib.loads(s)  # parity on 3.11+
+        return doc
+
+    def test_tables_and_array_of_tables(self):
+        doc = self._loads(
+            '[[package]]\nname = "a"\nversion = "1.0"\n'
+            "[package.dependencies]\nb = \">=2\"\n"
+            '[[package]]\nname = "b"\n'
+            "[tool.poetry.group.dev.dependencies]\npytest = \"^8.0\"\n")
+        assert [p["name"] for p in doc["package"]] == ["a", "b"]
+        assert doc["package"][0]["dependencies"] == {"b": ">=2"}
+        assert doc["tool"]["poetry"]["group"]["dev"]["dependencies"] \
+            == {"pytest": "^8.0"}
+
+    def test_values_arrays_inline_tables(self):
+        doc = self._loads(
+            "n = 42\nf = 1.5\nneg = -3\nok = true\nno = false\n"
+            "arr = [\n  \"x\",  # comment\n  'y',\n]\n"
+            "tbl = { version = \"^1\", optional = true }\n"
+            "esc = \"a\\tb\\u0041\"\nlit = 'c:\\path'\n")
+        assert doc["n"] == 42 and doc["f"] == 1.5 and doc["neg"] == -3
+        assert doc["ok"] is True and doc["no"] is False
+        assert doc["arr"] == ["x", "y"]
+        assert doc["tbl"] == {"version": "^1", "optional": True}
+        assert doc["esc"] == "a\tbA"
+        assert doc["lit"] == "c:\\path"
+
+    def test_multiline_strings(self):
+        doc = self._loads(
+            'a = """\nline1\nline2"""\n'
+            "b = '''raw\n'quoted'\n'''\n")
+        assert doc["a"] == "line1\nline2"
+        assert doc["b"] == "raw\n'quoted'\n"
+
+    def test_decode_errors(self):
+        import pytest
+
+        from trivy_tpu.parsers import toml_compat
+
+        for bad in ("key = ", "key", "[unclosed\n", 'x = "open',
+                    "x = [1, 2", "d = 2024-01-01"):
+            with pytest.raises(toml_compat.TOMLDecodeError):
+                toml_compat.loads(bad)

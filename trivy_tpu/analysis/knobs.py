@@ -66,6 +66,19 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TRIVY_TPU_COMPILE_CACHE", "1", "tensorize", True,
          "Persistent compiled-DB tensor cache; 0 recompiles from the "
          "advisory DB on every start."),
+    # --- continuous monitoring (advisory-delta re-scoring)
+    Knob("TRIVY_TPU_MONITOR", "1", "monitor", True,
+         "Advisory-delta monitor subsystem; 0 stops scans recording "
+         "index state and promotes triggering re-scores."),
+    Knob("TRIVY_TPU_DELTA_FULL_THRESHOLD", "0.5", "monitor", False,
+         "Touched-key fraction above which a delta re-score degrades "
+         "to re-matching every indexed artifact."),
+    Knob("TRIVY_TPU_DELTA_VERIFY", "", "monitor", False,
+         "1 makes every delta re-score cross-check itself against a "
+         "from-scratch full re-match (double work; CI paranoia)."),
+    Knob("TRIVY_TPU_DELTA_BUDGET_S", "", "monitor", False,
+         "Wall-time budget (seconds) for one delta re-score; on "
+         "expiry the sweep sheds and the index state is not advanced."),
     # --- secret engine
     Knob("TRIVY_TPU_SECRET_PROBE", "1", "secret", True,
          "Hybrid-mode device-vs-host timing probe; 0 skips the probe "
@@ -144,6 +157,12 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TRIVY_TPU_BENCH_MESH_CHILD", "", "bench", False,
          "Internal: set on the CPU-mesh subprocess the mesh bench "
          "spawns (8 virtual devices)."),
+    Knob("TRIVY_TPU_BENCH_DELTA_KEYS", "50000", "bench", False,
+         "Advisory (space, name) key count for the delta-rescore "
+         "bench's synthetic DB generations."),
+    Knob("TRIVY_TPU_BENCH_DELTA_ARTIFACTS", "200", "bench", False,
+         "Journaled-artifact count for the delta-rescore bench's "
+         "synthetic fleet."),
 )
 
 

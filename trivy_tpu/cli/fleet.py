@@ -29,6 +29,7 @@ from trivy_tpu.cli.run import (
     _build_cache,
     _postprocess_report,
     _scan_target,
+    open_monitor_index,
 )
 from trivy_tpu.durability import ScanJournal, atomic_write, options_fingerprint
 from trivy_tpu.durability.journal import JournalError
@@ -120,6 +121,34 @@ def run_fleet(args) -> int:
     # in-process singleflight registry sees one cache identity, so a
     # base layer shared across --fleet-parallel lanes is analyzed once
     cache = _build_cache(args)
+    # --monitor-index: every completed artifact records its package
+    # inventory + finding baseline into the shared durable index, so a
+    # later `trivy-tpu watch` / DB promote re-scores this fleet
+    # incrementally (docs/monitoring.md). Lanes share one handle —
+    # updates serialize on the index lock.
+    mon_index = open_monitor_index(args)
+    mon_digest = None
+    if mon_index is not None:
+        from trivy_tpu.cli.run import _db_path
+        from trivy_tpu.tensorize import cache as compile_cache
+
+        # one digest for the whole fleet: the generation every lane's
+        # baseline is matched against (stamped per index record)
+        mon_digest = compile_cache.db_digest(_db_path(args))
+        if journal is not None and resume_path:
+            # artifacts already completed in the resumed journal are
+            # skipped by the scan loop, so they would silently miss the
+            # index: backfill from the embedded reports (null baseline,
+            # like a rebuild — first re-score adopts silently) unless a
+            # pre-crash record already covers them
+            from trivy_tpu.monitor.index import packages_from_report
+
+            for t, doc in journal.done.items():
+                if mon_index.packages_of(t):
+                    continue
+                pkgs = packages_from_report(doc)
+                if pkgs:
+                    mon_index.update(t, pkgs, None)
     lane = {t: i + 1 for i, t in enumerate(targets)}  # stable fleet index
     reports: dict[str, dict] = dict(journal.done) if journal else {}
     todo = [t for t in targets if t not in reports]
@@ -154,7 +183,15 @@ def run_fleet(args) -> int:
                 tracing.span("fleet.artifact", target=target,
                              lane=lane[target]):
             try:
-                report = _scan_target(a, cache)
+                if mon_index is None:
+                    report = _scan_target(a, cache)
+                else:
+                    from trivy_tpu.monitor.capture import capture_scan
+
+                    with capture_scan() as cap:
+                        report = _scan_target(a, cache)
+                    mon_index.update(target, cap.packages, cap.findings,
+                                     db_digest=mon_digest)
                 _postprocess_report(a, report)
             except Exception as e:
                 if journal:
@@ -187,6 +224,8 @@ def run_fleet(args) -> int:
     finally:
         if journal:
             journal.close()
+        if mon_index is not None:
+            mon_index.close()
         analyzed = obs_metrics.LAYERS_ANALYZED.value() - analysis_base[0]
         deduped = obs_metrics.LAYER_DEDUPE_HITS.value() - analysis_base[1]
         waits = obs_metrics.LAYER_DEDUPE_INFLIGHT_WAITS.value() \

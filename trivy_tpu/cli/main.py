@@ -113,6 +113,12 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
                         "byte-identical to an uninterrupted run")
     p.add_argument("--fleet-parallel", type=int, default=1,
                    help="fleet mode: artifacts scanned concurrently")
+    p.add_argument("--monitor-index", default=None, metavar="PATH",
+                   help="record each scanned artifact's package "
+                        "inventory + finding baseline into the durable "
+                        "monitor index at PATH, enabling `trivy-tpu "
+                        "watch` advisory-delta re-scoring "
+                        "(docs/monitoring.md)")
     p.add_argument("--server", default=None,
                    help="scan server URL (client mode)")
     p.add_argument("--token", default=None, help="server auth token")
@@ -317,6 +323,51 @@ def build_parser() -> argparse.ArgumentParser:
                         "package-query rows; larger requests are "
                         "chunk-interleaved across batches so small "
                         "scans are never starved")
+    p.add_argument("--monitor-index", default=None, metavar="PATH",
+                   help="continuous monitoring: record completed scans "
+                        "in the durable monitor index at PATH and "
+                        "re-score the fleet incrementally after every "
+                        "advisory-DB hot swap, emitting introduced/"
+                        "resolved finding events at /monitor/events "
+                        "(docs/monitoring.md)")
+
+    p = sub.add_parser(
+        "watch", help="continuous monitoring: re-score indexed "
+        "artifacts when the advisory DB changes, emitting introduced/"
+        "resolved findings as JSON lines (docs/monitoring.md)",
+        allow_abbrev=False)
+    _add_global_flags(p)
+    p.add_argument("--db-path", default=None,
+                   help="advisory DB directory to watch "
+                        "(default <cache>/db)")
+    p.add_argument("--index", default=None, metavar="PATH",
+                   help="monitor index path (default "
+                        "<cache>/monitor-index.jsonl; create it by "
+                        "scanning with --monitor-index)")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="fleet scan journal to rebuild the index from "
+                        "when it is missing or corrupt")
+    p.add_argument("--interval", default="60s",
+                   help="poll interval between DB generation checks "
+                        "(go-style duration)")
+    p.add_argument("--once", action="store_true",
+                   help="process at most one pending DB change, then "
+                        "exit (scripting/CI)")
+    p.add_argument("--server", default=None,
+                   help="tail a running server's /monitor/events ring "
+                        "instead of watching a local DB root")
+    p.add_argument("--token", default=None, help="server auth token")
+    p.add_argument("--output", "-o", default=None,
+                   help="write events here instead of stdout")
+    p.add_argument("--verify", action="store_true",
+                   help="cross-check every re-score against a "
+                        "from-scratch full re-match (double work)")
+    p.add_argument("--no-tpu", action="store_true",
+                   help="run re-matching on host instead of the TPU "
+                        "kernel")
+    p.add_argument("--mesh", default=None, metavar="DPxDB",
+                   help="re-match on a sharded device mesh ('DPxDB', "
+                        "'auto', or 'off'; env TRIVY_TPU_MESH)")
 
     p = sub.add_parser("db", help="advisory DB operations", allow_abbrev=False)
     _add_global_flags(p)
@@ -439,7 +490,7 @@ def main(argv: list[str] | None = None) -> int:
     known = {"image", "filesystem", "fs", "rootfs", "repository", "repo",
              "sbom", "vm", "kubernetes", "k8s", "convert", "server", "db",
              "clean", "config", "version", "registry", "plugin", "module",
-             "lint"}
+             "lint", "watch"}
     if argv and not argv[0].startswith("-") and argv[0] not in known:
         from trivy_tpu.plugin import PluginManager
 
@@ -499,6 +550,8 @@ def main(argv: list[str] | None = None) -> int:
             return run.run_convert(args)
         if args.command == "server":
             return run.run_server(args)
+        if args.command == "watch":
+            return run.run_watch(args)
         if args.command == "db":
             return run.run_db(args)
         if args.command == "clean":

@@ -333,11 +333,17 @@ def _scan_with_timeout(scanner, options, timeout_s: float,
     # the worker thread starts from an empty contextvars context:
     # adopt the submitting thread's span/scan id so a fleet lane's scan
     # spans stay attached to the lane's span instead of orphaning
+    from trivy_tpu.monitor import capture as mon_capture
+
     trace_ctx = tracing.capture()
+    # the monitor's scan capture (a contextvar, like the trace context)
+    # must follow the scan onto the worker thread or a --monitor-index
+    # scan records an empty inventory
+    mon_ctx = mon_capture.current()
 
     def work():
         try:
-            with tracing.adopt(trace_ctx):
+            with tracing.adopt(trace_ctx), mon_capture.adopt(mon_ctx):
                 if budget_s:
                     from trivy_tpu.resilience.retry import (
                         Deadline,
@@ -361,6 +367,26 @@ def _scan_with_timeout(scanner, options, timeout_s: float,
     if "error" in box:
         raise box["error"]
     return box["report"]
+
+
+def open_monitor_index(args):
+    """The durable monitor index for a --monitor-index scan, or None
+    (no flag, monitor disabled, or client mode — a remote scan's detect
+    phase runs server-side, so the server owns the index there)."""
+    path = getattr(args, "monitor_index", None)
+    if not path:
+        return None
+    from trivy_tpu import monitor as monitor_mod
+
+    if not monitor_mod.enabled():
+        return None
+    if getattr(args, "server", None):
+        _log.warn("--monitor-index is ignored in client mode; run the "
+                  "server with --monitor-index instead")
+        return None
+    from trivy_tpu.monitor.index import MonitorIndex
+
+    return MonitorIndex.open_or_reset(path)
 
 
 def _build_cache(args):
@@ -424,7 +450,22 @@ def _run_scan_core(args, compliance_spec) -> int:
         return run_fleet(args)
 
     cache = _build_cache(args)
-    report = _scan_target(args, cache)
+    mon_index = open_monitor_index(args)
+    if mon_index is None:
+        report = _scan_target(args, cache)
+    else:
+        from trivy_tpu.monitor.capture import capture_scan
+        from trivy_tpu.tensorize import cache as compile_cache
+
+        try:
+            with capture_scan() as cap:
+                report = _scan_target(args, cache)
+            mon_index.update(
+                getattr(args, "input", None) or args.target,
+                cap.packages, cap.findings,
+                db_digest=compile_cache.db_digest(_db_path(args)))
+        finally:
+            mon_index.close()
     severities = _postprocess_report(args, report)
 
     if compliance_spec is not None:
@@ -924,8 +965,54 @@ def run_server(args) -> int:
           drain_timeout=_parse_duration(
               getattr(args, "drain_timeout", None) or "30s"),
           sched_window_ms=getattr(args, "sched_window_ms", None),
-          sched_max_rows=getattr(args, "sched_max_rows", None))
+          sched_max_rows=getattr(args, "sched_max_rows", None),
+          monitor_index=getattr(args, "monitor_index", None))
     return 0
+
+
+def run_watch(args) -> int:
+    """`trivy-tpu watch` (docs/monitoring.md): poll for advisory-DB
+    generation changes and re-score the indexed fleet incrementally,
+    emitting introduced/resolved finding events as JSON lines — or
+    tail a running server's /monitor/events ring with --server."""
+    import sys
+
+    from trivy_tpu.monitor import watch as watch_mod
+
+    _validate_fault_spec()
+    interval = _parse_duration(getattr(args, "interval", None) or "60s")
+    out = sys.stdout
+    if getattr(args, "output", None):
+        # lint: allow[atomic-write] user-requested event stream (--output): append-only JSONL the user tails
+        out = open(args.output, "a", encoding="utf-8")
+    try:
+        if getattr(args, "server", None):
+            return watch_mod.watch_remote(
+                args.server, out, token=getattr(args, "token", None),
+                interval_s=min(interval, 10.0),
+                once=getattr(args, "once", False))
+        from trivy_tpu import monitor as monitor_mod
+
+        if not monitor_mod.enabled():
+            raise FatalError(
+                "TRIVY_TPU_MONITOR=0 disables the monitor subsystem")
+        db_path = _db_path(args)
+        index_path = getattr(args, "index", None) or os.path.join(
+            args.cache_dir, "monitor-index.jsonl")
+        index = watch_mod.open_index(
+            index_path, journal_path=getattr(args, "journal", None))
+        try:
+            return watch_mod.watch_local(
+                db_path, index, lambda: new_engine(args), out,
+                interval_s=interval, once=getattr(args, "once", False),
+                verify=True if getattr(args, "verify", False) else None)
+        finally:
+            index.close()
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if out is not sys.stdout:
+            out.close()
 
 
 def run_db(args) -> int:

@@ -56,6 +56,20 @@ class MatchResult:
     adv_indices: list[int]  # indices into CompiledDB.advisories
 
 
+def finding_keys(advisories, results) -> set[tuple]:
+    """MatchResults → engine-level finding keys: the stable,
+    DB-generation-independent identity of a finding —
+    ``(space, name, version, scheme, vulnerability_id)``.  The ONE
+    definition shared by `MatchEngine.match_keys`, the monitor's
+    re-scoring (rematch.py) and its scan-time capture tap: the
+    monitor's zero-diff contract depends on all three agreeing
+    byte-for-byte."""
+    return {
+        (r.query.space, r.query.name, r.query.version,
+         r.query.scheme_name, advisories[i][2].vulnerability_id)
+        for r in results for i in r.adv_indices}
+
+
 class MatchEngine:
     """Holds the advisory DB in compiled tensor form (and on device) and
     answers batched detection queries."""
@@ -102,6 +116,11 @@ class MatchEngine:
                     compile_cache.save_compiled(
                         db_path, self.cdb, window=window, digest=digest,
                         db_meta=db_meta)
+                # advisory-key fingerprints ride along with the tensor
+                # entry (content-addressed by digest, saved once): the
+                # OLD generation's table is what makes a promote-time
+                # delta diff cheap (trivy_tpu/monitor, docs/monitoring.md)
+                compile_cache.save_keymap(db_path, db, digest=digest)
         if self.cdb is None:
             self.cdb = compile_db(db, window=window)
         # routes the mesh's per-shard slices through the persistent
@@ -413,6 +432,18 @@ class MatchEngine:
             out.append(res[i: i + len(qs)])
             i += len(qs)
         return out
+
+    def match_keys(self, query_lists: list[list[PkgQuery]]
+                   ) -> list[list[tuple]]:
+        """Batched finding-key extraction for the monitor's delta
+        re-scoring (trivy_tpu/monitor): ONE submit() micro-batch over
+        many artifacts' query lists, reduced to per-list sorted
+        ``(space, name, version, scheme, vulnerability_id)`` tuples —
+        the stable, DB-generation-independent identity of a finding.
+        Mesh-aware and cross-artifact-deduped for free via submit()."""
+        res_lists = self.submit(query_lists)
+        advs = self.cdb.advisories
+        return [sorted(finding_keys(advs, rl)) for rl in res_lists]
 
     def detect_many(self, queries: list[PkgQuery], batch_size: int = 65536,
                     depth: int = 3) -> list[MatchResult]:

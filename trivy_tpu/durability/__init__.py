@@ -5,11 +5,14 @@ scanner (docs/durability.md).
   sweeping, whole-tree fsync for staged directories
 - `journal` — append-only JSONL fleet-scan journal with torn-tail
   tolerant replay (`trivy-tpu <kind> --targets … --journal/--resume`)
+- `appendlog` — the generic fsynced JSONL append-log primitive the
+  journal pioneered, reused by the monitor's package→artifact index
 
 Stdlib-only so it can be imported from the cache, the DB lifecycle, the
 server, and tests without pulling in jax.
 """
 
+from trivy_tpu.durability.appendlog import AppendLog, AppendLogError
 from trivy_tpu.durability.atomic import (
     CorruptEntry,
     atomic_write,
@@ -27,6 +30,8 @@ from trivy_tpu.durability.journal import (
 )
 
 __all__ = [
+    "AppendLog",
+    "AppendLogError",
     "CorruptEntry",
     "JournalError",
     "ScanJournal",

@@ -441,3 +441,36 @@ MESH_SHARD_DEGRADATIONS = REGISTRY.counter(
     "exhausted or the shard's device was lost (zero finding diff; the "
     "healthy shards keep serving on-device)",
     labels=("shard",))
+DELTA_DIFF_SECONDS = REGISTRY.histogram(
+    "trivy_tpu_delta_diff_seconds",
+    "Advisory-delta diff duration on a DB generation promote "
+    "(fingerprint load + touched-key computation)")
+DELTA_REMATCH_SECONDS = REGISTRY.histogram(
+    "trivy_tpu_delta_rematch_seconds",
+    "Delta re-score duration: affected artifacts re-matched through "
+    "the engine's micro-batch path after a generation promote")
+DELTA_TOUCHED_KEYS = REGISTRY.gauge(
+    "trivy_tpu_delta_touched_keys",
+    "Advisory (space, name) keys whose content changed in the most "
+    "recent generation promote's delta diff")
+DELTA_REMATCHED = REGISTRY.counter(
+    "trivy_tpu_delta_rematched_artifacts_total",
+    "Journaled artifacts re-matched by delta re-scores (incremental "
+    "passes count only the affected subset)")
+DELTA_FULL_RESCANS = REGISTRY.counter(
+    "trivy_tpu_delta_full_rescans_total",
+    "Delta re-scores that degraded to re-matching every indexed "
+    "artifact, by reason (schema change, missing fingerprints, "
+    "injected fault, degraded index, threshold, verify mismatch)",
+    labels=("reason",))
+DELTA_EVENTS = REGISTRY.counter(
+    "trivy_tpu_delta_events_total",
+    "Finding edges emitted by delta re-scores (kind=introduced: new "
+    "finding on a frozen artifact; kind=resolved: finding retracted "
+    "by the new advisory generation)",
+    labels=("kind",))
+DELTA_SHEDS = REGISTRY.counter(
+    "trivy_tpu_delta_sheds_total",
+    "Delta re-scores shed or deferred: wall-time budget expired "
+    "mid-sweep, or a promote landed while a re-score was running "
+    "(queued, not stacked)")

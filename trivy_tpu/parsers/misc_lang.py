@@ -17,7 +17,10 @@ def _mk(name: str, version: str, **kw) -> Package:
 
 
 def parse_cargo_lock(content: bytes) -> list[Package]:
-    import tomllib
+    try:
+        import tomllib
+    except ImportError:  # Python <= 3.10: stdlib tomllib is 3.11+
+        from trivy_tpu.parsers import toml_compat as tomllib
 
     doc = tomllib.loads(content.decode("utf-8", "replace"))
     out = []
@@ -409,7 +412,10 @@ def parse_conda_environment(content: bytes) -> list[Package]:
 def parse_julia_manifest(content: bytes) -> list[Package]:
     """Manifest.toml (reference pkg/dependency/parser/julia/manifest):
     supports both the flat pre-1.7 layout and the 1.7+ [deps] table."""
-    import tomllib
+    try:
+        import tomllib
+    except ImportError:  # Python <= 3.10: stdlib tomllib is 3.11+
+        from trivy_tpu.parsers import toml_compat as tomllib
 
     try:
         doc = tomllib.loads(content.decode("utf-8", "replace"))

@@ -53,7 +53,10 @@ def parse_pipfile_lock(content: bytes) -> list[Package]:
 
 
 def parse_poetry_lock(content: bytes) -> list[Package]:
-    import tomllib
+    try:
+        import tomllib
+    except ImportError:  # Python <= 3.10: stdlib tomllib is 3.11+
+        from trivy_tpu.parsers import toml_compat as tomllib
 
     doc = tomllib.loads(content.decode("utf-8", "replace"))
     out = []
@@ -78,7 +81,10 @@ def parse_poetry_lock(content: bytes) -> list[Package]:
 
 
 def parse_uv_lock(content: bytes) -> list[Package]:
-    import tomllib
+    try:
+        import tomllib
+    except ImportError:  # Python <= 3.10: stdlib tomllib is 3.11+
+        from trivy_tpu.parsers import toml_compat as tomllib
 
     doc = tomllib.loads(content.decode("utf-8", "replace"))
     out = []
@@ -112,7 +118,10 @@ def parse_pyproject(content: bytes) -> dict:
     dep names, "groups": {group: set}} (reference
     parser/python/pyproject/pyproject.go:14-45).  Used to mark
     direct/dev relationships on poetry.lock packages."""
-    import tomllib
+    try:
+        import tomllib
+    except ImportError:  # Python <= 3.10: stdlib tomllib is 3.11+
+        from trivy_tpu.parsers import toml_compat as tomllib
 
     doc = tomllib.loads(content.decode("utf-8", "replace"))
     poetry = (doc.get("tool") or {}).get("poetry") or {}

@@ -227,8 +227,19 @@ def _go_date(layout, t=None) -> str:
                    ("15", "%H"), ("04", "%M"), ("05", "%S"),
                    ("MST", "%Z"), ("Jan", "%b"), ("Mon", "%a")):
         fmt = fmt.replace(go, py)
-    out = t.strftime(fmt)
-    return out.replace("\x00FRAC\x00", frac).replace("\x00OFF\x00", off)
+    # strftime segments BETWEEN the markers: platform C strftime treats
+    # the format as NUL-terminated, so a \x00 marker inside the format
+    # string silently truncates everything after it (glibc drops the
+    # "Z" of ".999999999Z07:00" layouts)
+    out = []
+    # re.split with a capture group alternates segment/marker: odd
+    # indices are the markers (a literal "FRAC" in a layout stays text)
+    for k, tok in enumerate(re.split(r"\x00(FRAC|OFF)\x00", fmt)):
+        if k % 2:
+            out.append(frac if tok == "FRAC" else off)
+        elif tok:
+            out.append(t.strftime(tok))
+    return "".join(out)
 
 
 _FUNCS = {

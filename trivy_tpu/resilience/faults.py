@@ -83,6 +83,8 @@ SITES: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("analysis.fetch", ("drop", "delay", "error", "kill")),
     ("fleet.scan", ("kill",)),
     ("journal.append", ("kill", "torn-write", "bitflip")),
+    ("monitor.index", ("drop", "error", "kill", "torn-write", "bitflip")),
+    ("monitor.rematch", ("drop", "delay", "error", "kill")),
     ("db.download", ("torn-write", "bitflip")),
     ("db.install.extract", ("kill",)),
     ("db.install.promote", ("kill",)),

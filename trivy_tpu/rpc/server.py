@@ -194,7 +194,8 @@ class ScanService:
 
     def __init__(self, engine, cache, db_path: str | None = None,
                  sched_window_ms: float | None = None,
-                 sched_max_rows: int | None = None):
+                 sched_max_rows: int | None = None,
+                 monitor_index: str | None = None):
         self.lock = _RWLock()
         self.engine = engine
         self.cache = cache
@@ -258,6 +259,29 @@ class ScanService:
                     self.engine, "mesh_data_axis", 1),
                 row_floor_fn=lambda: getattr(
                     self.engine, "mesh_row_floor", 0))
+        # continuous monitoring (--monitor-index, docs/monitoring.md):
+        # completed scans record inventory + finding baselines into the
+        # durable package→artifact index; every DB hot swap triggers an
+        # advisory-delta re-score emitting introduced/resolved events
+        # at /monitor/events. The served generation's digest is tracked
+        # so the promote hook knows the delta's old side.
+        self.monitor = None
+        self._db_digest: str | None = None
+        if monitor_index and db_path:
+            from trivy_tpu import monitor as monitor_mod
+
+            if monitor_mod.enabled():
+                from trivy_tpu.monitor.watch import MonitorService
+                from trivy_tpu.tensorize import cache as compile_cache
+
+                # the digest is only read by the monitor's promote hook
+                # and scan stamps: computing the full content hash on
+                # every monitor-less server start would duplicate the
+                # engine's own digest work for nothing
+                self._db_digest = compile_cache.db_digest(db_path)
+                self.monitor = MonitorService(
+                    monitor_index, lambda: self.engine, db_path,
+                    scheduler=self.scheduler)
 
     def _resolved_db_dir(self) -> str | None:
         """Real directory the DB would load from right now (a generation
@@ -434,8 +458,24 @@ class ScanService:
              deadline: Deadline | None = None):
         self.begin_scan()
         try:
-            return self._scan_admitted(target, artifact_key, blob_keys,
-                                       options, deadline)
+            if self.monitor is None:
+                return self._scan_admitted(target, artifact_key,
+                                           blob_keys, options, deadline)
+            from trivy_tpu.monitor.capture import capture_scan
+
+            # the generation stamp is read BEFORE the scan runs: a hot
+            # swap completing mid-scan must not stamp the NEW digest
+            # onto findings the OLD engine matched (a stale-looking
+            # stamp only re-baselines conservatively; a too-new stamp
+            # would make an incremental re-score trust stale findings)
+            db_digest = self._db_digest
+            with capture_scan() as cap:
+                out = self._scan_admitted(target, artifact_key,
+                                          blob_keys, options, deadline)
+            # only a COMPLETED scan updates the artifact's index record
+            # (a shed/failed scan must not regress the stored baseline)
+            self.monitor.record_scan(target, cap, db_digest=db_digest)
+            return out
         finally:
             self.end_scan()
 
@@ -562,6 +602,12 @@ class ScanService:
                 self._rejected_db_state = ()
                 self._db_state = self._db_identity()
             return False
+        old_digest = new_digest = None
+        if self.monitor is not None:
+            from trivy_tpu.tensorize import cache as compile_cache
+
+            old_digest = self._db_digest
+            new_digest = compile_cache.db_digest(self.db_path)
         self.lock.acquire_write()  # quiesce in-flight scans
         try:
             self.engine = new_engine
@@ -570,12 +616,19 @@ class ScanService:
             self._rejected_db_state = ()
             self.db_degraded = ""
             self._db_loaded_at = time.monotonic()
+            self._db_digest = new_digest
         finally:
             self.lock.release_write()
         self.metrics.db_reloads.inc()
         self.metrics.db_reload_seconds.observe(
             time.perf_counter() - reload_start)
         _log.info("advisory DB hot-swapped", **db.stats())
+        if self.monitor is not None:
+            # continuous monitoring: the promote triggers an advisory-
+            # delta re-score in the background (docs/monitoring.md) —
+            # affected journaled artifacts re-match and the introduced/
+            # resolved finding events land on /monitor/events
+            self.monitor.on_promote(old_digest, db, new_digest)
         return True
 
 
@@ -639,6 +692,28 @@ def _make_handler(service: ScanService, token: str | None,
             return self.headers.get("Trivy-Token") == token
 
         def do_GET(self):
+            if self.path.startswith("/monitor/events"):
+                if not self._authed():
+                    # events name scan targets + CVEs: token-gated like
+                    # the scan/cache POST surface, unlike bare /metrics
+                    self._error(401, "invalid token")
+                    return
+                if service.monitor is None:
+                    self._error(404, "monitor not enabled "
+                                     "(--monitor-index)")
+                    return
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(self.path).query)
+                try:
+                    since = int((q.get("since") or ["0"])[0])
+                except ValueError:
+                    self._error(400, "bad since cursor")
+                    return
+                nxt, events = service.monitor.events_since(since)
+                self._reply(200, json.dumps(
+                    {"next": nxt, "events": events}).encode())
+                return
             if self.path == "/healthz":
                 self._reply(200, b"ok", "text/plain")
             elif self.path == "/readyz":
@@ -787,12 +862,14 @@ class Server:
                  db_reload_interval: float = 3600.0,
                  path_prefix: str = "",
                  sched_window_ms: float | None = None,
-                 sched_max_rows: int | None = None):
+                 sched_max_rows: int | None = None,
+                 monitor_index: str | None = None):
         if path_prefix and not path_prefix.startswith("/"):
             path_prefix = "/" + path_prefix
         self.service = ScanService(engine, cache, db_path=db_path,
                                    sched_window_ms=sched_window_ms,
-                                   sched_max_rows=sched_max_rows)
+                                   sched_max_rows=sched_max_rows,
+                                   monitor_index=monitor_index)
         self.httpd = ThreadingHTTPServer(
             (host, port),
             _make_handler(self.service, token, path_prefix.rstrip("/"))
@@ -851,13 +928,16 @@ class Server:
             # after the drain budget: the scheduler finishes whatever
             # queued-and-admitted work remains, then stops admitting
             self.service.scheduler.close()
+        if self.service.monitor is not None:
+            self.service.monitor.close()
         self.httpd.shutdown()
         self.httpd.server_close()
 
 
 def serve(engine, host="localhost", port=4954, token=None, cache=None,
           db_path=None, db_reload_interval=3600.0, drain_timeout=30.0,
-          sched_window_ms=None, sched_max_rows=None):
+          sched_window_ms=None, sched_max_rows=None,
+          monitor_index=None):
     """Blocking entry point for `trivy-tpu server`.
 
     SIGTERM triggers a graceful drain: /readyz goes 503 at once,
@@ -872,7 +952,8 @@ def serve(engine, host="localhost", port=4954, token=None, cache=None,
     srv = Server(engine, cache, host=host, port=port, token=token,
                  db_path=db_path, db_reload_interval=db_reload_interval,
                  sched_window_ms=sched_window_ms,
-                 sched_max_rows=sched_max_rows)
+                 sched_max_rows=sched_max_rows,
+                 monitor_index=monitor_index)
     srv.start()
     stop = threading.Event()
 

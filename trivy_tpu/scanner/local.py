@@ -41,12 +41,17 @@ class LocalDriver:
         """Engine handle for the detect phase: with a scheduler
         attached, detect() routes through its coalesced micro-batches —
         byte-identical results, one saturated dispatch lane. Everything
-        else (db, cdb, advisories) reads through to the real engine."""
+        else (db, cdb, advisories) reads through to the real engine.
+        Under an active monitor capture scope the handle additionally
+        records query inventory + finding keys for the package→artifact
+        index (trivy_tpu/monitor; no-op wrapper otherwise)."""
+        from trivy_tpu.monitor.capture import tap
+
         if self.scheduler is None:
-            return self.engine
+            return tap(self.engine)
         from trivy_tpu.sched.scheduler import SchedEngine
 
-        return SchedEngine(self.engine, self.scheduler)
+        return tap(SchedEngine(self.engine, self.scheduler))
 
     def scan(self, target, artifact_key, blob_keys, options: ScanOptions):
         from trivy_tpu import obs

@@ -206,6 +206,24 @@ class MatchScheduler:
             raise p.error
         return p.results
 
+    def submit_lists(self, query_lists: list[list]) -> list[list]:
+        """Batched ``engine.submit`` equivalent THROUGH the scheduler:
+        the flattened union joins the shared micro-batch stream, so a
+        bulk submitter (the monitor's delta re-scoring after a DB
+        promote) interleaves chunk-wise with live scan requests under
+        the same fairness/deadline rules instead of monopolizing the
+        device, then results demux back per input list."""
+        flat: list = []
+        for qs in query_lists:
+            flat.extend(qs)
+        res = self.submit(flat)
+        out: list[list] = []
+        i = 0
+        for qs in query_lists:
+            out.append(res[i: i + len(qs)])
+            i += len(qs)
+        return out
+
     def _count_shed(self) -> None:
         self.stats["sheds"] += 1
         if self.on_shed is not None:
@@ -513,6 +531,12 @@ class SchedEngine:
 
     def detect(self, queries: list) -> list:
         return self._scheduler.submit(queries)
+
+    def submit(self, query_lists: list[list]) -> list[list]:
+        """Batched entry point, routed through the scheduler so bulk
+        submissions (monitor re-scoring) share micro-batches with live
+        scans — byte-identical to ``MatchEngine.submit``."""
+        return self._scheduler.submit_lists(query_lists)
 
     def __getattr__(self, name: str):
         return getattr(self._engine, name)
