@@ -208,9 +208,19 @@ def run_crawl(engine, queries, batch=65536):
 
 def bench_secrets(n_files: int = 1500) -> dict:
     """Secret path on a kernel-tree-shaped corpus (BASELINE config #3):
-    many source files, almost all clean, a few planted secrets. Device
-    tiers (NFA + literal windows) vs the whole-file host regex loop."""
-    from trivy_tpu.secret.scanner import SecretScanner
+    many source files, almost all clean, a few planted secrets.
+
+    Rungs (ISSUE 10): whole-file host loop, device tiers (packed
+    super-buffers), scheduler-batched concurrent scans sharing device
+    dispatches, the hybrid split, and the streaming chunked path on a
+    >10 MiB file — at two packing and two streaming-chunk
+    configurations.  `finding_diff_vs_host` sums the symmetric
+    finding diff across EVERY rung and is asserted == 0 in the bench
+    exit gate (zero-diff is the contract, not a hope)."""
+    import threading
+
+    from trivy_tpu.obs import metrics as obs_metrics
+    from trivy_tpu.secret.scanner import SecretScanner, reset_hybrid_probe
 
     rng = random.Random(42)
     lines = [b"static int foo_%d(struct bar *b) {" % i for i in range(50)]
@@ -233,6 +243,10 @@ def bench_secrets(n_files: int = 1500) -> dict:
         total += len(content)
         corpus.append((f"drivers/x/file{i}.c", content))
 
+    def norm(secrets):
+        return {(s.file_path, f.rule_id, f.start_line, f.match)
+                for s in secrets for f in s.findings}
+
     scanner = SecretScanner()
     scanner.scan_files(corpus[:20])  # warm jit
     t0 = time.time()
@@ -241,29 +255,114 @@ def bench_secrets(n_files: int = 1500) -> dict:
     t0 = time.time()
     host = scanner.scan_files(corpus, use_device=False)
     host_s = time.time() - t0
-    # the shipped default: device screen + concurrent host-AC thread
+    # the shipped default: device share dispatched first, host AC path
+    # scanning the rest while the chip computes
     t0 = time.time()
     hyb = scanner.scan_files(corpus, use_device="hybrid")
     hyb_s = time.time() - t0
+    diff = len((norm(dev) ^ norm(host)) | (norm(hyb) ^ norm(host)))
 
-    def norm(secrets):
-        return {(s.file_path, f.rule_id, f.start_line, f.match)
-                for s in secrets for f in s.findings}
+    # scheduler-batched rung: concurrent scans (the server/fleet
+    # shape) share super-buffer dispatches through the secret lane —
+    # aggregate throughput is the tentpole number on real silicon
+    n_threads = int(os.environ.get("TRIVY_TPU_BENCH_SECRET_CLIENTS",
+                                   "6"))
+    slices = [corpus[i::n_threads] for i in range(n_threads)]
+    results: list = [None] * n_threads
+
+    def _one(k: int) -> None:
+        results[k] = scanner.scan_files(slices[k], use_device=True)
+
+    threads = [threading.Thread(target=_one, args=(k,))
+               for k in range(n_threads)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    batched_s = time.time() - t0
+    batched = [s for r in results for s in r]
+    diff += len(norm(batched) ^ norm(host))
+    sched_stats = dict(scanner._sched.stats) if scanner._sched else {}
+
+    # packing rung: a different super-buffer size must not change one
+    # finding (fresh scanner: the pack knob binds at tier build)
+    os.environ["TRIVY_TPU_SECRET_PACK_MB"] = "1"
+    try:
+        packed1 = SecretScanner()
+        dev1 = packed1.scan_files(corpus, use_device=True)
+        diff += len(norm(dev1) ^ norm(host))
+        packed1.close()
+    finally:
+        os.environ.pop("TRIVY_TPU_SECRET_PACK_MB", None)
+
+    # streaming rung: >10 MiB file, device + host, two chunk sizes,
+    # secrets planted to straddle chunk boundaries
+    big_parts = []
+    size = 0
+    i = 0
+    while size < 12 * (1 << 20):
+        line = lines[i % len(lines)]
+        big_parts.append(line)
+        size += len(line) + 1
+        if i % 20000 == 10000:
+            big_parts.append(b'token = "' + planted[i % 3] + b'"')
+        i += 1
+    big = b"\n".join(big_parts)
+    whole = scanner.scan_file("drivers/x/big.c", big)
+    whole_set = {(f.rule_id, f.start_line, f.offset, f.match)
+                 for f in (whole.findings if whole else [])}
+    stream_mb = {}
+    for chunk_mb, mode in (("4", True), ("4", False), ("1", False)):
+        os.environ["TRIVY_TPU_SECRET_STREAM_CHUNK_MB"] = chunk_mb
+        try:
+            t0 = time.time()
+            st = scanner.scan_stream("drivers/x/big.c", big,
+                                     use_device=mode)
+            st_s = time.time() - t0
+        finally:
+            os.environ.pop("TRIVY_TPU_SECRET_STREAM_CHUNK_MB", None)
+        st_set = {(f.rule_id, f.start_line, f.offset, f.match)
+                  for f in (st.findings if st else [])}
+        diff += len(st_set ^ whole_set)
+        key = f"stream_{'device' if mode else 'host'}_c{chunk_mb}"
+        stream_mb[key] = round(len(big) / 1e6 / st_s, 1)
+
+    # probe rung: the recorded decision (device on silicon that pays
+    # for itself, host on CPU-only boxes) — read back from /metrics
+    reset_hybrid_probe()
+    scanner._ensure_tiers()
+    probe_device = bool(scanner._accel_backend()
+                        and scanner._hybrid_device_ok())
+    probe_mbps = {
+        "device": round(
+            obs_metrics.SECRET_PROBE_MBPS.value(path="device"), 1),
+        "host": round(
+            obs_metrics.SECRET_PROBE_MBPS.value(path="host"), 1),
+    }
+    scanner.close()
 
     return {
         "corpus_files": n_files,
         "corpus_mb": round(total / 1e6, 1),
         "device_mb_per_s": round(total / 1e6 / dev_s, 1),
+        "device_batched_mb_per_s": round(total / 1e6 / batched_s, 1),
         "host_mb_per_s": round(total / 1e6 / host_s, 1),
         "hybrid_mb_per_s": round(total / 1e6 / hyb_s, 1),
+        "stream_mb_per_s": stream_mb,
+        "stream_file_mb": round(len(big) / 1e6, 1),
         # vs_host scores the production configuration (hybrid): the
         # device's contribution is the wall-clock it removes from the
         # host-only path, not a solo race over a tunneled link
         "vs_host": round(host_s / hyb_s, 2),
         "device_only_vs_host": round(host_s / dev_s, 2),
+        "device_batched_vs_host": round(host_s / batched_s, 2),
+        "sched": {k: sched_stats.get(k, 0)
+                  for k in ("batches", "rows", "coalesced")},
+        "probe_choice": "device" if probe_device else "host",
+        "probe_mb_per_s": probe_mbps,
         "findings": len(norm(dev)),
-        "finding_diff_vs_host": len(
-            (norm(dev) ^ norm(host)) | (norm(hyb) ^ norm(host))),
+        "finding_diff_vs_host": diff,
     }
 
 
@@ -1483,6 +1582,9 @@ def main():
     if delta_detail.get("error") or delta_detail.get(
             "delta_diff_vs_full", 0):
         return 1  # incremental re-score must equal a from-scratch rescan
+    if secret_detail.get("finding_diff_vs_host", 0):
+        return 1  # every secret rung (packed/batched/hybrid/streaming,
+        # at every packing + chunk config) must match the host exactly
     return 0 if diffs == 0 else 1
 
 

@@ -65,7 +65,7 @@ def fake_redis():
 # stay raw; the static with-nesting pass covers those (see the
 # witness.py docstring).
 _WITNESS_MARKERS = ("sched", "fanal", "obs", "durability", "fault",
-                    "mesh", "monitor")
+                    "mesh", "monitor", "secret")
 
 
 @pytest.fixture(autouse=True)

@@ -99,7 +99,7 @@ class TestScannerFastPrefilter:
         from trivy_tpu.secret.scanner import SecretScanner
 
         s = SecretScanner()
-        matcher, _ = s._ensure_kw_matcher()
+        matcher, _rule_kws, _kw_index = s._ensure_kw_matcher()
         assert matcher is not None
         rng = random.Random(7)
         corpus = (b"PASSWORD=hunter2 ", b"AKIA1234 ", b"GHP_tokenish ",

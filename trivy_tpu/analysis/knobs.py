@@ -87,6 +87,14 @@ KNOBS: tuple[Knob, ...] = (
          False,
          "Byte fraction the hybrid secret split hands the device "
          "anchor screen."),
+    Knob("TRIVY_TPU_SECRET_PACK_MB", "(per-bank default)", "secret",
+         False,
+         "Packed super-buffer MiB per device anchor-screen dispatch "
+         "(the secret engine's dispatch-amortization lever; same as "
+         "--secret-pack-mb)."),
+    Knob("TRIVY_TPU_SECRET_STREAM_CHUNK_MB", "4", "secret", False,
+         "Streaming secret-scan chunk MiB for files over 10 MiB "
+         "(floor 64 KiB; same as --secret-stream-chunk-mb)."),
     # --- RPC
     Knob("TRIVY_TPU_RPC_GZIP_MIN", "8192", "rpc", False,
          "Minimum body size in bytes before the negotiated gzip wire "
@@ -147,6 +155,9 @@ KNOBS: tuple[Knob, ...] = (
          "re-exec."),
     Knob("TRIVY_TPU_BENCH_SCHED_CLIENTS", "8", "bench", False,
          "Concurrent keep-alive clients in the serving bench."),
+    Knob("TRIVY_TPU_BENCH_SECRET_CLIENTS", "6", "bench", False,
+         "Concurrent scans in the scheduler-batched secret bench "
+         "rung."),
     Knob("TRIVY_TPU_BENCH_SCHED_SCANS", "6", "bench", False,
          "Scans per client in the serving bench."),
     Knob("TRIVY_TPU_BENCH_ANALYSIS_IMAGES", "10", "bench", False,

@@ -81,6 +81,18 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
                         "advisory shards), 'auto' (topology from DB "
                         "size and device count), or 'off' single-chip "
                         "(default; env TRIVY_TPU_MESH)")
+    p.add_argument("--secret-pack-mb", type=float, default=None,
+                   metavar="MB",
+                   help="packed super-buffer MiB per device secret "
+                        "anchor-screen dispatch (dispatch "
+                        "amortization; default per-bank measured "
+                        "value; env TRIVY_TPU_SECRET_PACK_MB)")
+    p.add_argument("--secret-stream-chunk-mb", type=float, default=None,
+                   metavar="MB",
+                   help="streaming secret-scan chunk MiB for files "
+                        "over 10 MiB (byte-identical to whole-file; "
+                        "default 4; env "
+                        "TRIVY_TPU_SECRET_STREAM_CHUNK_MB)")
     p.add_argument("--timeout", default="5m",
                    help="per-scan deadline (e.g. 300s, 5m, 1h; "
                         "reference --timeout default 5m)")

@@ -138,6 +138,23 @@ def run_scan(args) -> int:
     secret_analyzer.USE_DEVICE = (
         False if getattr(args, "no_tpu", False) else "hybrid")
 
+    # the compiled-NFA cache follows the resolved --cache-dir like
+    # every other cache (set per invocation, same pattern as
+    # USE_DEVICE above)
+    from trivy_tpu.secret import scanner as _secret_scanner
+
+    _secret_scanner.set_cache_dir(getattr(args, "cache_dir", None))
+
+    # secret-engine sizing flags reach the scanner (deep inside the
+    # fanal post-analyzer) through their env knobs; explicit flags win
+    # over an inherited environment
+    if getattr(args, "secret_pack_mb", None) is not None:
+        os.environ["TRIVY_TPU_SECRET_PACK_MB"] = \
+            str(args.secret_pack_mb)
+    if getattr(args, "secret_stream_chunk_mb", None) is not None:
+        os.environ["TRIVY_TPU_SECRET_STREAM_CHUNK_MB"] = \
+            str(args.secret_stream_chunk_mb)
+
 
     # jar sha1->GAV lookups use the java DB when it has been imported
     # (reference pkg/javadb updater singleton)

@@ -61,6 +61,12 @@ class SecretAnalyzer(PostAnalyzer):
         return self._scanner
 
     def configure(self, config_path: str | None) -> None:
+        if config_path == self._config_path and self._scanner is not None:
+            return  # unchanged config keeps the warm scanner (and its
+            # scheduler thread + uploaded device bank) across scans
+        if self._scanner is not None:
+            self._scanner.close()  # stop the secret-lane scheduler
+            # thread so a re-config can't leak one per scan
         self._config_path = config_path
         self._scanner = None
 
@@ -78,8 +84,12 @@ class SecretAnalyzer(PostAnalyzer):
         if self.scanner.skip_file(path):
             return False
         if size > WARN_SIZE:
-            _log.warn("the file is larger than 10 MiB, secret scan may be slow",
-                      path=path, size=size)
+            # the reference warns here and scans anyway (secret.go:110);
+            # scan_files routes files over the threshold through the
+            # streaming chunked path (byte-identical findings, bounded
+            # window memory — docs/secrets.md), so no warn-and-punt
+            _log.debug("large file takes the streaming secret path",
+                       path=path, size=size)
         return True
 
     def post_analyze(self, files: dict[str, AnalysisInput]) -> AnalysisResult | None:

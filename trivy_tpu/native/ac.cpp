@@ -98,6 +98,33 @@ void* ac_build(const uint8_t** keywords, const int32_t* lengths,
   return ac;
 }
 
+// Position-reporting variant: writes (keyword id, END offset) pairs of
+// every occurrence (case-folded) into out_ids/out_pos, up to cap.
+// Returns the number written, or -1 when the input holds more than cap
+// occurrences — the caller must then treat positions as unknown (fall
+// back to a whole-buffer scan), never as a truncated-but-trusted set.
+int64_t ac_scan_pos(void* handle, const uint8_t* data, int64_t len,
+                    int32_t* out_ids, int64_t* out_pos, int64_t cap) {
+  auto* ac = static_cast<Automaton*>(handle);
+  int64_t found = 0;
+  int cur = 0;
+  const auto* nodes = ac->nodes.data();
+  const uint8_t* fold = ac->fold;
+  for (int64_t i = 0; i < len; i++) {
+    cur = nodes[cur].next[fold[data[i]]];
+    const auto& out = nodes[cur].out;
+    if (!out.empty()) {
+      for (int32_t id : out) {
+        if (found == cap) return -1;
+        out_ids[found] = id;
+        out_pos[found] = i;  // offset of the occurrence's LAST byte
+        found++;
+      }
+    }
+  }
+  return found;
+}
+
 int32_t ac_scan(void* handle, const uint8_t* data, int64_t len,
                 uint8_t* hits) {
   auto* ac = static_cast<Automaton*>(handle);

@@ -31,6 +31,15 @@ def _configure(lib: ctypes.CDLL) -> None:
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_uint8),
     ]
+    lib.ac_scan_pos.restype = ctypes.c_int64
+    lib.ac_scan_pos.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+    ]
     lib.ac_free.restype = None
     lib.ac_free.argtypes = [ctypes.c_void_p]
 
@@ -56,13 +65,35 @@ class NativeMatcher:
         lens = (ctypes.c_int32 * n)(*[len(k) for k in keywords])
         self._handle = lib.ac_build(
             ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)), lens, n)
-        self._hits_buf = (ctypes.c_uint8 * max(n, 1))()
+        self._n = n
 
     def scan(self, content: bytes) -> np.ndarray:
-        """-> bool[n_keywords] — which keywords occur in content."""
-        self._lib.ac_scan(self._handle, content, len(content),
-                          self._hits_buf)
-        return np.frombuffer(self._hits_buf, dtype=np.uint8).astype(bool)
+        """-> bool[n_keywords] — which keywords occur in content.
+        The hits buffer is per call, so concurrent scans (fleet lanes
+        sharing one scanner) cannot tear each other's verdicts."""
+        hits = (ctypes.c_uint8 * max(self._n, 1))()
+        self._lib.ac_scan(self._handle, content, len(content), hits)
+        return np.frombuffer(hits, dtype=np.uint8).astype(bool)
+
+    # generous default: secret-bearing files have few candidate-window
+    # anchors; a file denser than this gets the whole-buffer fallback
+    POS_CAP = 16384
+
+    def scan_positions(self, content: bytes,
+                       cap: int | None = None):
+        """-> (ids int32[n], end_offsets int64[n]) of every case-folded
+        keyword occurrence, or None when the buffer holds more than
+        `cap` occurrences (the caller must NOT trust a truncated set —
+        fall back to scanning the whole buffer)."""
+        cap = self.POS_CAP if cap is None else int(cap)
+        ids = (ctypes.c_int32 * max(cap, 1))()
+        pos = (ctypes.c_int64 * max(cap, 1))()
+        n = self._lib.ac_scan_pos(self._handle, content, len(content),
+                                  ids, pos, cap)
+        if n < 0:
+            return None
+        return (np.frombuffer(ids, dtype=np.int32)[:n].copy(),
+                np.frombuffer(pos, dtype=np.int64)[:n].copy())
 
     def __del__(self):
         lib = getattr(self, "_lib", None)

@@ -353,7 +353,8 @@ DEGRADED_TOTAL = REGISTRY.counter(
     "trivy_tpu_degraded_total",
     "Degraded-mode activations by component "
     "(driver=local fallback scan, cache=local-only mirror, "
-    "engine=host-oracle after device loss)",
+    "engine=host-oracle after device loss, "
+    "secret=host scanner after a device-screen failure)",
     labels=("component",))
 FAULT_FIRES = REGISTRY.counter(
     "trivy_tpu_fault_injections_total",
@@ -474,3 +475,44 @@ DELTA_SHEDS = REGISTRY.counter(
     "Delta re-scores shed or deferred: wall-time budget expired "
     "mid-sweep, or a promote landed while a re-score was running "
     "(queued, not stacked)")
+SECRET_PROBE_DEVICE = REGISTRY.gauge(
+    "trivy_tpu_secret_probe_device",
+    "Hybrid secret probe verdict: 1 = the device anchor screen's "
+    "share-weighted time beat the host path (hybrid keeps its device "
+    "share), 0 = host-only; absent until the one-shot probe runs")
+SECRET_PROBE_MBPS = REGISTRY.gauge(
+    "trivy_tpu_secret_probe_mb_per_s",
+    "Hybrid secret probe throughput by path (path=device: anchor "
+    "screen on the accelerator; path=host: native-AC host scan) on "
+    "the probe corpus",
+    labels=("path",))
+SECRET_DEVICE_SHARE = REGISTRY.gauge(
+    "trivy_tpu_secret_device_share",
+    "Byte fraction of the last hybrid secret scan actually handed to "
+    "the device screen (0 = the probe or a device failure routed "
+    "everything to the host path)")
+SECRET_STREAM_FILES = REGISTRY.counter(
+    "trivy_tpu_secret_stream_files_total",
+    "Files scanned through the streaming chunked secret path "
+    "(size over the whole-file threshold; byte-identical findings)")
+SECRET_STREAM_BYTES = REGISTRY.counter(
+    "trivy_tpu_secret_stream_bytes_total",
+    "Bytes consumed by the streaming chunked secret path")
+SECRET_NFA_CACHE_HITS = REGISTRY.counter(
+    "trivy_tpu_secret_nfa_cache_hits_total",
+    "Compiled secret-NFA programs loaded from the persistent "
+    "compiled-artifact cache (warm start skipped rule compilation)")
+SECRET_NFA_CACHE_MISSES = REGISTRY.counter(
+    "trivy_tpu_secret_nfa_cache_misses_total",
+    "Compiled secret-NFA cache lookups that fell back to compiling "
+    "the ruleset (absent, version mismatch, or corrupt-quarantined)")
+SECRET_SCHED_BATCH_CHUNKS = REGISTRY.histogram(
+    "trivy_tpu_secret_sched_batch_chunks",
+    "16 KiB device chunks per coalesced secret anchor-screen "
+    "micro-batch (the packed super-buffer the kernel scans at once)",
+    buckets=(16, 64, 256, 1024, 4096, 16384))
+SECRET_SCHED_COALESCED = REGISTRY.histogram(
+    "trivy_tpu_secret_sched_coalesced_requests",
+    "Distinct concurrent scans coalesced into one secret anchor-"
+    "screen micro-batch",
+    buckets=(1, 2, 4, 8, 16, 32))

@@ -305,6 +305,74 @@ def required_literal(pattern: str) -> bytes | None:
     return max(runs, key=len).lower()
 
 
+def prefix_literal(pattern: str) -> bytes | None:
+    """Leading literal byte run every match must START with (>= 3
+    bytes), or None.  Unlike ``required_literal`` (which anchors a
+    window the match merely *contains*), occurrences of this literal
+    are candidate match *starts*, so a host verifier can run the real
+    regex only inside ``[pos, pos + max_width]`` windows — the host
+    analogue of the device lit tier (docs/secrets.md "host floor").
+    Conservative: stops at the first non-literal element."""
+    try:
+        parsed = sre_parse.parse(pattern)
+    except re.error:
+        return None
+    out = bytearray()
+
+    def walk(items) -> bool:
+        """Collect leading literals; False = stop everywhere."""
+        for op, arg in items:
+            if op is sre_c.LITERAL and arg < 256:
+                out.append(arg)
+                continue
+            if op is sre_c.SUBPATTERN:
+                if not walk(list(arg[3])):
+                    return False
+                continue
+            if op in (sre_c.MAX_REPEAT, sre_c.MIN_REPEAT):
+                lo, hi, sub = arg
+                if isinstance(lo, int) and lo == hi and lo <= 64:
+                    for _ in range(lo):
+                        if not walk(list(sub)):
+                            return False
+                    continue
+                return False
+            return False
+        return True
+
+    walk(list(parsed))
+    return bytes(out) if len(out) >= 3 else None
+
+
+# --------------------------------------------- anchor-row serialization
+
+
+def pack_anchor_rows(rows: list[list[np.ndarray]]):
+    """Anchor class rows -> (bits uint8[n_positions, 32], lens
+    int32[n_rows]) for the persistent compiled-NFA cache entry
+    (tensorize/cache.save_nfa).  Lossless: each 256-bool class mask
+    packs to 32 bytes."""
+    lens = np.array([len(r) for r in rows], dtype=np.int32)
+    flat = [m for r in rows for m in r]
+    if not flat:
+        return np.zeros((0, 32), dtype=np.uint8), lens
+    bits = np.packbits(np.stack(flat).astype(bool), axis=1)
+    return bits.astype(np.uint8), lens
+
+
+def unpack_anchor_rows(bits: np.ndarray,
+                       lens: np.ndarray) -> list[list[np.ndarray]]:
+    """Inverse of pack_anchor_rows."""
+    masks = np.unpackbits(bits.astype(np.uint8), axis=1)[:, :256] \
+        .astype(bool)
+    rows: list[list[np.ndarray]] = []
+    pos = 0
+    for n in lens.tolist():
+        rows.append([masks[pos + j] for j in range(n)])
+        pos += n
+    return rows
+
+
 # ------------------------------------------------------------- anchors
 
 

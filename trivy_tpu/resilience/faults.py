@@ -80,6 +80,7 @@ SITES: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("engine.device", ("drop", "delay", "device-lost")),
     ("engine.shard", ("drop", "delay", "error", "device-lost")),
     ("sched.submit", ("drop", "delay", "error")),
+    ("secret.device", ("drop", "delay", "error", "device-lost")),
     ("analysis.fetch", ("drop", "delay", "error", "kill")),
     ("fleet.scan", ("kill",)),
     ("journal.append", ("kill", "torn-write", "bitflip")),

@@ -360,6 +360,16 @@ class ScanService:
                     " shard(s) "
                     + ",".join(str(d) for d in health["degraded"])
                     + " degraded to host")
+        # hybrid secret probe verdict: once this process has measured
+        # its device-vs-host split, /readyz says which path secret
+        # scans take (the decision used to be visible only in a debug
+        # log); absent until the one-shot probe runs
+        from trivy_tpu.secret.scanner import hybrid_probe_state
+
+        probe = hybrid_probe_state()
+        if probe is not None:
+            mesh_note += ("; secret probe: "
+                          + ("device" if probe["device"] else "host"))
         if self.db_degraded:
             return True, (f"ok (serving last-good: {self.db_degraded})"
                           + mesh_note)

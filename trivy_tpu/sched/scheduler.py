@@ -41,6 +41,16 @@ Guarantees:
 
 ``TRIVY_TPU_SCHED=0`` kills the scheduler process-wide: the server
 runs the exact pre-scheduler per-request path.
+
+The machinery is lane-generic: queries are opaque to the scheduler, so
+the secret engine reuses it verbatim (``lane="secret"``) with 16 KiB
+anchor-screen chunks as rows and a ``_ScreenEngine`` facade as the
+engine — concurrent scans' secret screens coalesce into shared packed
+super-buffer dispatches (docs/secrets.md), reported under
+``trivy_tpu_secret_sched_*`` instead of the match-lane histograms.
+``submit_async``/``collect`` split the blocking ``submit`` so
+dispatch-first callers (the hybrid secret split, streaming steps) can
+enqueue, do host work, then block.
 """
 
 from __future__ import annotations
@@ -138,8 +148,24 @@ class MatchScheduler:
                  max_queue: int = DEFAULT_MAX_QUEUE,
                  chunk_rows: int | None = None,
                  depth: int = DEFAULT_DEPTH, on_shed=None,
-                 busy_fn=None, data_axis_fn=None, row_floor_fn=None):
+                 busy_fn=None, data_axis_fn=None, row_floor_fn=None,
+                 lane: str = "match"):
         self._engine_fn = engine_fn
+        # metric handles per lane: the vuln-match lane keeps the
+        # historical trivy_tpu_sched_* series byte-stable; the secret
+        # anchor-screen lane (rows = 16 KiB device chunks, a different
+        # unit entirely) reports under trivy_tpu_secret_sched_* instead
+        # of skewing the match-lane row histograms
+        if lane == "secret":
+            self._m_rows = obs_metrics.SECRET_SCHED_BATCH_CHUNKS
+            self._m_coalesced = obs_metrics.SECRET_SCHED_COALESCED
+            self._m_depth = None
+            self._m_wait = None
+        else:
+            self._m_rows = obs_metrics.SCHED_BATCH_ROWS
+            self._m_coalesced = obs_metrics.SCHED_COALESCED
+            self._m_depth = obs_metrics.SCHED_QUEUE_DEPTH
+            self._m_wait = obs_metrics.SCHED_WAIT_SECONDS
         # optional zero-arg callable -> the engine's mesh data-parallel
         # width (1 = single-chip). When > 1, composed batches top up to
         # a multiple of the data axis' padded row granularity so every
@@ -206,6 +232,33 @@ class MatchScheduler:
             raise p.error
         return p.results
 
+    def submit_async(self, queries: list) -> _Pending:
+        """Dispatch-first entry point: enqueue `queries` into the shared
+        micro-batch stream and return immediately with an opaque handle
+        for :meth:`collect`.  The scheduler thread encodes and
+        dispatches while the caller does other work — the secret
+        engine's hybrid split enqueues its device share here, scans its
+        host share, then collects (docs/secrets.md).  No fault probe
+        fires here: callers with their own site (``secret.device``)
+        probe before enqueueing."""
+        if not queries:
+            p = _Pending([], None, 0)
+            p.done.set()
+            return p
+        with tracing.span("sched.enqueue", rows=len(queries)):
+            return self._enqueue(queries)
+
+    def collect(self, p: _Pending) -> list:
+        """Block until a :meth:`submit_async` handle's micro-batches
+        complete; returns its per-query results (or raises the shed /
+        batch error, exactly like :meth:`submit`)."""
+        if not p.queries:
+            return []
+        self._await(p)
+        if p.error is not None:
+            raise p.error
+        return p.results
+
     def submit_lists(self, query_lists: list[list]) -> list[list]:
         """Batched ``engine.submit`` equivalent THROUGH the scheduler:
         the flattened union joins the shared micro-batch stream, so a
@@ -229,6 +282,14 @@ class MatchScheduler:
         if self.on_shed is not None:
             self.on_shed()
 
+    def _set_depth(self, n: int) -> None:
+        if self._m_depth is not None:
+            self._m_depth.set(n)
+
+    def _observe_wait(self, seconds: float) -> None:
+        if self._m_wait is not None:
+            self._m_wait.observe(seconds)
+
     def _enqueue(self, queries: list) -> _Pending:
         deadline = current_deadline()
         with self._cond:
@@ -246,7 +307,7 @@ class MatchScheduler:
             self._seq += 1
             p = _Pending(list(queries), deadline, self._seq)
             self._waiting.append(p)
-            obs_metrics.SCHED_QUEUE_DEPTH.set(len(self._waiting))
+            self._set_depth(len(self._waiting))
             self._cond.notify_all()
         return p
 
@@ -260,8 +321,7 @@ class MatchScheduler:
                 with self._cond:
                     if p in self._waiting:
                         self._waiting.remove(p)
-                        obs_metrics.SCHED_QUEUE_DEPTH.set(
-                            len(self._waiting))
+                        self._set_depth(len(self._waiting))
                 if p.error is None:
                     p.error = RuntimeError("match scheduler thread died")
                 return
@@ -280,7 +340,7 @@ class MatchScheduler:
             with self._cond:
                 if not p.done.is_set() and p.queued_rows:
                     self._waiting.remove(p)
-                    obs_metrics.SCHED_QUEUE_DEPTH.set(len(self._waiting))
+                    self._set_depth(len(self._waiting))
                     p.error = Overloaded(
                         f"deadline budget of {d.budget_s:.3f}s expired "
                         "while queued in the match scheduler",
@@ -373,8 +433,7 @@ class MatchScheduler:
                     p.inflight += 1
                     if p.dispatched_at is None:
                         p.dispatched_at = time.monotonic()
-                        obs_metrics.SCHED_WAIT_SECONDS.observe(
-                            p.dispatched_at - p.arrival)
+                        self._observe_wait(p.dispatched_at - p.arrival)
                     parts.append((p, lo, hi))
                     rows += hi - lo
                     progressed = True
@@ -383,7 +442,7 @@ class MatchScheduler:
             # fully-dispatched requests leave the queue; they complete
             # from the dispatch path when their in-flight chunks land
             self._waiting = [p for p in self._waiting if p.queued_rows]
-            obs_metrics.SCHED_QUEUE_DEPTH.set(len(self._waiting))
+            self._set_depth(len(self._waiting))
             return (parts, rows)
 
     def _mesh_fill(self, order, parts, rows: int) -> None:
@@ -440,8 +499,7 @@ class MatchScheduler:
                 p.inflight += 1
                 if p.dispatched_at is None:
                     p.dispatched_at = time.monotonic()
-                    obs_metrics.SCHED_WAIT_SECONDS.observe(
-                        p.dispatched_at - p.arrival)
+                    self._observe_wait(p.dispatched_at - p.arrival)
                 parts.append((p, lo, hi))
 
     def _dispatch(self, parts, rows: int) -> None:
@@ -476,8 +534,8 @@ class MatchScheduler:
             err = RuntimeError(f"scheduler batch aborted: {exc!r}")
             part_errors = [err] * len(parts)
             fatal = exc
-        obs_metrics.SCHED_BATCH_ROWS.observe(rows)
-        obs_metrics.SCHED_COALESCED.observe(n_req)
+        self._m_rows.observe(rows)
+        self._m_coalesced.observe(n_req)
         done_now: list[_Pending] = []
         with self._cond:
             self.stats["batches"] += 1
@@ -500,7 +558,7 @@ class MatchScheduler:
             if any(e is not None for e in part_errors):
                 self._waiting = [p for p in self._waiting
                                  if p.queued_rows]
-                obs_metrics.SCHED_QUEUE_DEPTH.set(len(self._waiting))
+                self._set_depth(len(self._waiting))
         for p in done_now:
             p.done.set()
         if fatal is not None:
