@@ -640,6 +640,435 @@ def bench_mesh() -> dict:
                      f"(rc={proc.returncode}): {proc.stderr[-2000:]}"}
 
 
+def _capstone_mk_layer(tag: str, pkgs: list, rng, planted: bool) -> bytes:
+    """One synthetic gzipped layer tar: an npm lockfile drawing from
+    the advisory DB's own package pool (so CVE matches occur), filler
+    payload files, and optionally a planted secret for the secret
+    lane."""
+    import gzip as _gzip
+    import io as _io
+    import tarfile as _tarfile
+
+    buf = _io.BytesIO()
+    with _tarfile.open(fileobj=buf, mode="w") as tf:
+        lock_pkgs = {f"node_modules/{name}": {"version": version}
+                     for name, version in pkgs}
+        lock = json.dumps({"name": tag, "lockfileVersion": 2,
+                           "packages": {"": {"name": tag}, **lock_pkgs}})
+        members = {f"{tag}/app/package-lock.json": lock.encode()}
+        if planted:
+            members[f"{tag}/src/cfg.c"] = (
+                b"/* service config */\ntoken = \"ghp_" + b"k3J9" * 9
+                + b"\"\n")
+        for j in range(20):
+            members[f"{tag}/srv/f{j}.txt"] = (
+                b"%d " % rng.randrange(1 << 30)) * 128
+        for path, content in members.items():
+            info = _tarfile.TarInfo(path)
+            info.size = len(content)
+            tf.addfile(info, _io.BytesIO(content))
+    return _gzip.compress(buf.getvalue(), mtime=0)
+
+
+def _capstone_mk_image(path: str, layers: list[bytes], tag: str) -> None:
+    import gzip as _gzip
+    import hashlib as _hashlib
+    import io as _io
+    import tarfile as _tarfile
+
+    diff_ids = ["sha256:" + _hashlib.sha256(
+        _gzip.decompress(l)).hexdigest() for l in layers]
+    cfg = json.dumps({
+        "architecture": "amd64", "os": "linux",
+        "rootfs": {"type": "layers", "diff_ids": diff_ids},
+        "history": [{"created_by": f"l{i}"}
+                    for i in range(len(layers))],
+    }).encode()
+    cfg_name = _hashlib.sha256(cfg).hexdigest() + ".json"
+    manifest = json.dumps([{
+        "Config": cfg_name, "RepoTags": [f"{tag}:latest"],
+        "Layers": [f"l{i}/layer.tar" for i in range(len(layers))],
+    }]).encode()
+    with _tarfile.open(path, "w") as tf:
+        for name, content in [(cfg_name, cfg), *[
+                (f"l{i}/layer.tar", l) for i, l in enumerate(layers)],
+                ("manifest.json", manifest)]:
+            info = _tarfile.TarInfo(name)
+            info.size = len(content)
+            tf.addfile(info, _io.BytesIO(content))
+
+
+def _capstone_norm(rep) -> set:
+    """Order-insensitive finding fingerprint of one report (vulns +
+    secrets) — the unit the zero-diff exit gate compares."""
+    out = set()
+    for r in rep.results:
+        for v in r.vulnerabilities:
+            out.add(("vuln", r.target, v.vulnerability_id,
+                     v.pkg_name, v.installed_version))
+        for s in r.secrets:
+            out.add(("secret", r.target, s.rule_id, s.start_line,
+                     s.match))
+    return out
+
+
+def _capstone_attrib_overhead(scan_once) -> dict:
+    """Disabled-overhead guard for the attribution aggregator, wired
+    into the bench exit gate: with the sink released, the span seams
+    must cost < 2% of a warm scan vs the same scan with the seams
+    stubbed to no-ops (interleaved alternating pairs, medians — the
+    tests/test_attrib.py guard at bench scale)."""
+    import contextlib
+    import statistics
+
+    from trivy_tpu import obs as obs_pkg
+    from trivy_tpu.obs import tracing as _tracing
+
+    @contextlib.contextmanager
+    def null_phase(span_name, phase=None, **meta):
+        yield None
+
+    @contextlib.contextmanager
+    def stubbed():
+        orig_phase, orig_span = obs_pkg.phase, _tracing.span
+        obs_pkg.phase = null_phase
+        _tracing.span = lambda name, **meta: contextlib.nullcontext()
+        try:
+            yield
+        finally:
+            obs_pkg.phase, _tracing.span = orig_phase, orig_span
+
+    def timed() -> float:
+        t0 = time.perf_counter()
+        scan_once()
+        return time.perf_counter() - t0
+
+    timed(), timed()  # warm
+    real_times, stub_times = [], []
+    for i in range(8):  # alternating order cancels drift bias
+        if i % 2 == 0:
+            real_times.append(timed())
+            with stubbed():
+                stub_times.append(timed())
+        else:
+            with stubbed():
+                stub_times.append(timed())
+            real_times.append(timed())
+    real = statistics.median(real_times)
+    stub = statistics.median(stub_times)
+    return {
+        "real_scan_s": round(real, 4),
+        "stub_scan_s": round(stub, 4),
+        "overhead_frac": round(real / stub - 1.0, 4) if stub else 0.0,
+        # 2 ms absolute floor keeps scheduler jitter from flaking the
+        # gate on loaded boxes (same bar as the tier-1 guard)
+        "ok": real <= stub * 1.02 + 0.002,
+    }
+
+
+def _bench_capstone_child() -> int:
+    """Child half of bench_capstone: BASELINE configs #4 and #5 as ONE
+    system on an 8-virtual-device CPU mesh.  N fleet clients crawl a
+    synthetic registry with realistic base-image overlap against a
+    LIVE server — match scheduler, 2x4 serving mesh, cross-client layer
+    dedupe and the secret lane all on — with full SBOM+CVE+secret
+    scans; then a config-#5 pod sweep re-scans the shared images the
+    way a cluster crawl does (artifact-level dedupe).  Emits the
+    per-phase resource-lane attribution report, a projected-v5e-8
+    number from the measured attribution + the ADR 0002 link physics,
+    the attribution disabled-overhead guard, and the zero-diff count
+    vs a sequential kill-switched oracle.  Prints ONE JSON line."""
+    import shutil
+    import tempfile
+    import threading
+
+    os.environ.setdefault("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in \
+            os.environ["XLA_FLAGS"]:
+        os.environ["XLA_FLAGS"] += \
+            " --xla_force_host_platform_device_count=8"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import logging
+
+    from trivy_tpu.artifact.image import ImageArtifact
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.obs import attrib
+    from trivy_tpu.ops import mesh as mesh_ops
+    from trivy_tpu.rpc.client import RemoteCache, RemoteDriver
+    from trivy_tpu.rpc.server import Server
+    from trivy_tpu.scanner.local import LocalDriver
+    from trivy_tpu.scanner.scan import Scanner
+    from trivy_tpu.tensorize.synth import synth_queries, synth_trivy_db
+    from trivy_tpu.types.scan import ScanOptions
+
+    n_images = int(os.environ.get("TRIVY_TPU_BENCH_CAPSTONE_IMAGES",
+                                  "6"))
+    n_clients = int(os.environ.get("TRIVY_TPU_BENCH_CAPSTONE_CLIENTS",
+                                   "4"))
+    n_pods = int(os.environ.get("TRIVY_TPU_BENCH_CAPSTONE_PODS", "240"))
+
+    _tt_logger = logging.getLogger("trivy_tpu")
+    prev_level = _tt_logger.level
+    _tt_logger.setLevel(logging.WARNING)
+
+    rng = random.Random(31)
+    db = synth_trivy_db(n_advisories=30_000)
+    pool = [(q.name, q.version) for q in synth_queries(db, 20_000,
+                                                       seed=99)
+            if q.space == "npm::"]
+
+    def pick_pkgs(n: int) -> list:
+        seen = {}
+        while len(seen) < n:
+            name, version = pool[rng.randrange(len(pool))]
+            seen.setdefault(name, version)
+        return sorted(seen.items())
+
+    tmp = tempfile.mkdtemp(prefix="trivy_tpu_bench_capstone_")
+    prev_env = {k: os.environ.get(k)
+                for k in ("TRIVY_TPU_SCHED", "TRIVY_TPU_ANALYSIS_PIPELINE")}
+    try:
+        # registry with realistic base-image overlap: 5 shared base
+        # layers + 2 unique layers per image (~71% shared), packages
+        # drawn from the advisory DB's own pool, one planted secret in
+        # a base layer and one per unique layer
+        base_layers = [
+            _capstone_mk_layer(f"base{i}", pick_pkgs(40), rng,
+                               planted=(i == 0))
+            for i in range(5)]
+        paths = []
+        for k in range(n_images):
+            layers = base_layers + [
+                _capstone_mk_layer(f"img{k}u{i}", pick_pkgs(40), rng,
+                                   planted=(i == 0))
+                for i in range(2)]
+            p = os.path.join(tmp, f"img{k}.tar")
+            _capstone_mk_image(p, layers, f"img{k}")
+            paths.append(p)
+
+        engine = MatchEngine(db, mesh=mesh_ops.build_mesh(2, 4))
+        srv = Server(engine, MemoryCache(), host="localhost", port=0,
+                     token="capstone")
+        srv.start()
+        opts = ScanOptions()  # vuln + secret (the full default scan)
+
+        def scan_remote(path):
+            cache = RemoteCache(srv.address, token="capstone")
+            driver = RemoteDriver(srv.address, token="capstone")
+            try:
+                art = ImageArtifact(path, cache, from_tar=True)
+                return Scanner(driver, art).scan_artifact(opts)
+            finally:
+                driver.close()
+                cache.close()
+
+        def run_fleet(targets: list) -> tuple[float, dict, list]:
+            """N threaded clients draining `targets`; -> (wall, reports
+            by basename (last write wins), errors)."""
+            reports: dict = {}
+            errs: list = []
+
+            def worker(ci: int):
+                try:
+                    for k in range(ci, len(targets), n_clients):
+                        rep = scan_remote(targets[k])
+                        reports[os.path.basename(targets[k])] = rep
+                except Exception as exc:  # noqa: BLE001 — surfaced below
+                    errs.append(exc)
+
+            threads = [threading.Thread(target=worker, args=(ci,))
+                       for ci in range(n_clients)]
+            t0 = time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.time() - t0, reports, errs
+
+        def attr_report(snap: dict) -> dict:
+            per_scan_ok = all(
+                sum(r["crit"].values()) <= r["wall_s"] * 1.001 + 1e-6
+                for r in snap["recent"])
+            return {
+                "scans": snap["scans"],
+                "wall_s": round(snap["wall_s"], 3),
+                "lanes": {lane: row for lane, row in
+                          snap["lanes"].items()
+                          if row["busy_s"] or row["crit_s"]},
+                "other_s": snap["other_s"],
+                "verdict": snap["verdict"],
+                "dominant": max(
+                    snap["lanes"],
+                    key=lambda l: snap["lanes"][l]["crit_s"]),
+                "crit_sum_le_wall_per_scan": per_scan_ok,
+            }
+
+        # --- config #4: fleet clients crawling the registry ----------
+        scan_remote(paths[0])  # warm jit/cache shapes outside timing
+        attrib.AGG.reset()
+        wall4, fleet_reports, errs = run_fleet(paths)
+        if errs:
+            raise errs[0]
+        snap4 = attrib.AGG.snapshot()
+        registry_cfg = {
+            "images": n_images,
+            "clients": n_clients,
+            "images_per_s": round(n_images / wall4, 2),
+            "wall_s": round(wall4, 2),
+            "attribution": attr_report(snap4),
+        }
+
+        # --- config #5: pod sweep over the shared images -------------
+        from trivy_tpu.obs import metrics as _obs
+
+        attrib.AGG.reset()
+        h0 = _obs.LAYER_DEDUPE_HITS.value()
+        pod_targets = [paths[k % n_images] for k in range(n_pods)]
+        wall5, _pod_reports, errs = run_fleet(pod_targets)
+        if errs:
+            raise errs[0]
+        snap5 = attrib.AGG.snapshot()
+        cluster_cfg = {
+            "pods": n_pods,
+            "images": n_images,
+            "clients": n_clients,
+            "pods_per_s": round(n_pods / wall5, 2),
+            "wall_s": round(wall5, 2),
+            "dedupe_hits": int(_obs.LAYER_DEDUPE_HITS.value() - h0),
+            "attribution": attr_report(snap5),
+        }
+        srv.shutdown()
+
+        # --- sequential oracle: serial scans, every perf layer off ---
+        os.environ["TRIVY_TPU_SCHED"] = "0"
+        os.environ["TRIVY_TPU_ANALYSIS_PIPELINE"] = "0"
+        oracle_engine = MatchEngine(db, use_device=False)
+        diff = 0
+        for p in paths:
+            cache = MemoryCache()
+            art = ImageArtifact(p, cache, from_tar=True)
+            rep = Scanner(LocalDriver(oracle_engine, cache),
+                          art).scan_artifact(opts)
+            diff += len(_capstone_norm(rep)
+                        ^ _capstone_norm(
+                            fleet_reports[os.path.basename(p)]))
+
+        # --- attribution disabled-overhead guard ---------------------
+        for k, v in prev_env.items():  # restore the live-path knobs
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        warm_cache = MemoryCache()
+        ImageArtifact(paths[0], warm_cache, from_tar=True).inspect()
+
+        def scan_once():
+            art = ImageArtifact(paths[0], warm_cache, from_tar=True)
+            Scanner(LocalDriver(oracle_engine, warm_cache),
+                    art).scan_artifact(opts)
+
+        overhead = _capstone_attrib_overhead(scan_once)
+
+        # --- projected v5e-8 from attribution + ADR 0002 -------------
+        # device-lane critical seconds scale across the 8-chip data
+        # axis; the host/fetch lanes stay; the scaled device lane is
+        # floored at one overlapped result fetch per scan (ADR 0002:
+        # ~70 ms fixed per fetch, fetches start at dispatch).  The
+        # projection is a derived number, not a measurement — it says
+        # what the MEASURED attribution implies for the north-star
+        # hardware, and which lane the roadmap should attack next.
+        adr0002_fetch_fixed_s = 0.070
+        # per-scan wall from the MEASURED fleet run (the attribution
+        # snapshot counts loopback scans twice: the client view and
+        # the server view are both roots); the device share comes from
+        # the attribution, which is a ratio and unaffected
+        wall_per_scan = wall4 * n_clients / max(n_images, 1)
+        device_share = (sum(
+            snap4["lanes"][lane]["crit_s"] for lane in
+            ("device_dispatch", "device_wait"))
+            / snap4["wall_s"]) if snap4["wall_s"] else 0.0
+        device_per_scan = wall_per_scan * device_share
+        proj_scan_s = (wall_per_scan - device_per_scan
+                       + max(device_per_scan / 8.0,
+                             adr0002_fetch_fixed_s))
+        measured_rate = n_images / wall4
+        proj_rate = measured_rate * (wall_per_scan / proj_scan_s) \
+            if proj_scan_s else measured_rate
+        projection = {
+            "formula": "host+fetch lanes unchanged; device lanes /8 "
+                       "(data axis), floored at one overlapped fetch "
+                       "(ADR 0002, 70 ms fixed)",
+            "adr0002_fetch_fixed_s": adr0002_fetch_fixed_s,
+            "measured_wall_per_scan_s": round(wall_per_scan, 4),
+            "device_crit_per_scan_s": round(device_per_scan, 4),
+            "projected_wall_per_scan_s": round(proj_scan_s, 4),
+            "projected_images_per_s": round(proj_rate, 2),
+            "projected_10k_images_s": round(10_000 / proj_rate, 1)
+            if proj_rate else None,
+            "north_star_60s_met": bool(
+                proj_rate and 10_000 / proj_rate < 60.0),
+        }
+
+        print(json.dumps({
+            "configs": {
+                "registry_fleet": registry_cfg,
+                "cluster_pods": cluster_cfg,
+            },
+            "capstone_diff_vs_oracle": diff,
+            "attrib_overhead": overhead,
+            "projection_v5e8": projection,
+            "db_rows": int(engine.cdb.n_rows),
+            "mesh": "2x4",
+        }))
+        return 0
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _tt_logger.setLevel(prev_level)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_capstone() -> dict:
+    """Capstone end-to-end bench (ROADMAP open item 3): BASELINE
+    configs #4/#5 composed as one system against a live server with
+    every perf subsystem on, reported through the resource-lane
+    attribution layer (obs/attrib.py) with a zero-diff exit gate vs
+    the sequential oracle — run in a subprocess that forces an
+    8-virtual-CPU-device mesh, like the mesh bench."""
+    import subprocess
+
+    env = {
+        **os.environ,
+        "TRIVY_TPU_BENCH_CAPSTONE_CHILD": "1",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    env.pop("TRIVY_TPU_BENCH_CHILD", None)
+    env.pop("TRIVY_TPU_BENCH_MESH_CHILD", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        return {"error": "capstone bench child timed out"}
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                break
+    return {"error": "capstone bench child failed "
+                     f"(rc={proc.returncode}): {proc.stderr[-2000:]}"}
+
+
 def bench_delta() -> dict:
     """Advisory-delta incremental re-matching (ISSUE 9 tentpole): a
     synthetic fleet of journaled artifacts against two advisory-DB
@@ -1234,6 +1663,8 @@ def _lint_gate() -> int:
 def main():
     if os.environ.get("TRIVY_TPU_BENCH_MESH_CHILD"):
         return _bench_mesh_child()
+    if os.environ.get("TRIVY_TPU_BENCH_CAPSTONE_CHILD"):
+        return _bench_capstone_child()
     phase_json = _phase_json_path()
     if not os.environ.get("TRIVY_TPU_BENCH_CHILD"):
         lint_rc = _lint_gate()
@@ -1495,6 +1926,23 @@ def main():
     with _trace.span("delta_rescore"):
         delta_detail = bench_delta()
 
+    # --- capstone: configs #4/#5 as one system + attribution (ISSUE 12) --
+    # fleet clients against a live scheduler+mesh+dedupe server, full
+    # SBOM+CVE+secret scans, resource-lane attribution report, projected
+    # v5e-8, zero diff vs the sequential oracle — also written to
+    # BENCH_capstone.json so the perf trajectory has the e2e number
+    with _trace.span("bench_capstone"):
+        capstone_detail = bench_capstone()
+    capstone_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_capstone.json")
+    try:
+        with open(capstone_path, "w", encoding="utf-8") as f:
+            json.dump(capstone_detail, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError as exc:
+        print(f"BENCH_STATUS=capstone_report_unwritable {exc}",
+              file=sys.stderr)
+
     # --- secret path (BASELINE config #3: kernel-tree shape) -------------
     with _trace.span("secret_path"):
         secret_detail = bench_secrets()
@@ -1558,6 +2006,7 @@ def main():
         "sched": sched_detail,
         "mesh": mesh_detail,
         "delta": delta_detail,
+        "capstone": capstone_detail,
     }
     if pipe:
         detail["pipeline_occupancy"] = pipe.get("pipeline_occupancy", 0.0)
@@ -1585,6 +2034,12 @@ def main():
     if secret_detail.get("finding_diff_vs_host", 0):
         return 1  # every secret rung (packed/batched/hybrid/streaming,
         # at every packing + chunk config) must match the host exactly
+    if capstone_detail.get("error") or capstone_detail.get(
+            "capstone_diff_vs_oracle", 0):
+        return 1  # the composed fleet system must match the serial
+        # kill-switched oracle finding-for-finding
+    if not capstone_detail.get("attrib_overhead", {}).get("ok", True):
+        return 1  # disabled attribution must stay a free span fast path
     return 0 if diffs == 0 else 1
 
 

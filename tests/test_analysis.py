@@ -239,6 +239,53 @@ class TestRuleFixtures:
         found = run_rule(project, "tracing-capture")
         assert {f.line for f in found} == {4, 9}  # good() passes
 
+    def test_span_taxonomy_fires(self, tmp_path):
+        project = make_project(tmp_path, {
+            "x/spans.py": (
+                "from trivy_tpu.obs import tracing\n"
+                "SITE = 'const.span'\n"
+                "def f(method):\n"
+                "    with tracing.span('rogue.span'):\n"
+                "        pass\n"
+                "    with tracing.span(SITE):\n"
+                "        pass\n"
+                "    with tracing.span(f'dyn.{method}'):\n"
+                "        pass\n")})
+        project.declared_span_taxonomy = {
+            "lanes": ("fetch_io",),
+            "span_lanes": {"const.span": "fetch_io",
+                           "ghost.span": "fetch_io",
+                           "bad.span": "no_such_lane"},
+            "structural": set(),
+            "prefixes": (("rpc.", "fetch_io"),),
+        }
+        found = run_rule(project, "span-taxonomy")
+        msgs = "\n".join(f.message for f in found)
+        assert "'rogue.span' emitted here but not classified" in msgs
+        assert "'const.span'" not in msgs  # const-resolved and declared
+        assert "dynamic span family 'dyn.'" in msgs
+        assert ("classifies span 'ghost.span' but no instrumented "
+                "call site emits it") in msgs
+        assert "'bad.span' to unknown lane 'no_such_lane'" in msgs
+        assert ("declares family 'rpc.' but no call site emits"
+                in msgs)
+
+    def test_span_taxonomy_prefix_and_structural_ok(self, tmp_path):
+        project = make_project(tmp_path, {
+            "x/spans.py": (
+                "from trivy_tpu.obs import tracing\n"
+                "def f(method):\n"
+                "    with tracing.span('scan'):\n"
+                "        with tracing.span(f'rpc.{method}'):\n"
+                "            pass\n")})
+        project.declared_span_taxonomy = {
+            "lanes": ("fetch_io",),
+            "span_lanes": {},
+            "structural": {"scan"},
+            "prefixes": (("rpc.", "fetch_io"),),
+        }
+        assert run_rule(project, "span-taxonomy") == []
+
     def test_bare_except_fires(self, tmp_path):
         project = make_project(tmp_path, {
             "x/handlers.py": (
@@ -361,7 +408,8 @@ class TestKnobs:
         names = {k.name for k in knobs.KNOBS if k.kill_switch}
         assert {"TRIVY_TPU_SCHED", "TRIVY_TPU_PIPELINE",
                 "TRIVY_TPU_ANALYSIS_PIPELINE", "TRIVY_TPU_COMPILE_CACHE",
-                "TRIVY_TPU_SECRET_PROBE", "TRIVY_TPU_MONITOR"} == names
+                "TRIVY_TPU_SECRET_PROBE", "TRIVY_TPU_MONITOR",
+                "TRIVY_TPU_ATTRIB"} == names
 
     def test_write_knobs_doc_roundtrip(self, tmp_path, capsys):
         (tmp_path / "trivy_tpu").mkdir()

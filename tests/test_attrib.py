@@ -1,0 +1,475 @@
+"""Bottleneck attribution layer (docs/observability.md "Attribution &
+profiling"): taxonomy classification, streaming per-scan/fleet
+aggregation under concurrency, the critical-path <= wall invariant,
+exemplar exposition + legacy byte-stability, the slow-scan flight
+recorder, the bounded trace buffer, /debug/profile auth + shape, the
+`trivy-tpu profile` CLI view, and the disabled-overhead guard."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trivy_tpu.obs import attrib, metrics as obs_metrics, tracing
+
+pytestmark = pytest.mark.obs
+
+
+def _scan_once(agg_sleep_s: float = 0.0):
+    """One synthetic scan trace with one span per classified lane."""
+    with tracing.span("scan_artifact"):
+        with tracing.span("inspect"):
+            with tracing.span("analysis.fetch"):
+                time.sleep(0.002 + agg_sleep_s)
+            with tracing.span("analysis.walk"):
+                time.sleep(0.004)
+        with tracing.span("detect"):
+            with tracing.span("sched.enqueue"):
+                time.sleep(0.001)
+        with tracing.span("report"):
+            time.sleep(0.001)
+
+
+@pytest.fixture()
+def fresh_agg(monkeypatch):
+    """Route the tracing sink into a private Aggregator (and restore
+    the module singleton's sink state afterwards)."""
+    agg = attrib.Aggregator()
+    prev = tracing._sink
+    tracing.set_sink(agg.observe_root)
+    yield agg
+    tracing.set_sink(prev)
+
+
+class TestTaxonomy:
+    def test_every_lane_value_is_declared(self):
+        for name, lane in attrib.SPAN_LANES.items():
+            assert lane in attrib.LANES, (name, lane)
+        for prefix, lane in attrib.SPAN_PREFIX_LANES:
+            assert lane in attrib.LANES, (prefix, lane)
+        assert set(attrib.PRIORITY) == set(attrib.LANES)
+
+    def test_classify(self):
+        assert attrib.classify("analysis.fetch") == "fetch_io"
+        assert attrib.classify("rpc.Scan") == "fetch_io"  # prefix family
+        assert attrib.classify("scan_artifact") is None   # structural
+        assert attrib.classify("no.such.span") is None    # unknown
+
+    def test_structural_and_lanes_disjoint(self):
+        assert not set(attrib.SPAN_LANES) & attrib.SPAN_STRUCTURAL
+
+
+class TestAttribution:
+    def test_busy_unions_overlapping_same_lane_spans(self, fresh_agg):
+        # nested same-lane spans must count once, not twice
+        with tracing.span("scan_artifact"):
+            with tracing.span("analysis.walk"):
+                with tracing.span("analysis.walk"):
+                    time.sleep(0.01)
+        rec = fresh_agg.snapshot()["recent"][0]
+        assert rec["busy"]["host_crunch"] <= rec["wall_s"] + 1e-9
+
+    def test_crit_partition_sums_to_wall(self, fresh_agg):
+        _scan_once()
+        rec = fresh_agg.snapshot()["recent"][0]
+        total = sum(rec["crit"].values()) + rec["other_s"]
+        assert total == pytest.approx(rec["wall_s"], rel=1e-3, abs=1e-5)
+        # and the classified lanes alone can never exceed the wall
+        assert sum(rec["crit"].values()) <= rec["wall_s"] + 1e-9
+
+    def test_work_lane_outranks_wait_lane(self, fresh_agg):
+        # queue_wait covering the whole scan + host_crunch inside it:
+        # the overlapped instant goes to the WORK lane
+        with tracing.span("scan_artifact"):
+            with tracing.span("sched.enqueue"):
+                with tracing.span("pipeline.crunch"):
+                    time.sleep(0.01)
+        rec = fresh_agg.snapshot()["recent"][0]
+        assert rec["crit"]["host_crunch"] > rec["crit"]["queue_wait"]
+        # busy still sees both lanes fully
+        assert rec["busy"]["queue_wait"] >= rec["busy"]["host_crunch"]
+
+    def test_concurrent_aggregation_totals_equal_per_scan_sums(
+            self, fresh_agg):
+        """8 threaded scans: fleet totals must equal the sum of the
+        per-scan records exactly (streaming accumulation loses
+        nothing and double-counts nothing)."""
+        n = 8
+        barrier = threading.Barrier(n)
+
+        def work():
+            barrier.wait(5)
+            _scan_once()
+
+        threads = [threading.Thread(target=work) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = fresh_agg.snapshot()
+        assert snap["scans"] == n
+        assert len(snap["recent"]) == n
+        # snapshot values are rounded to 6 dp per record, so the
+        # 8-record sums compare at 1e-5 absolute
+        for lane in attrib.LANES:
+            per_scan_busy = sum(r["busy"].get(lane, 0.0)
+                                for r in snap["recent"])
+            per_scan_crit = sum(r["crit"].get(lane, 0.0)
+                                for r in snap["recent"])
+            assert snap["lanes"][lane]["busy_s"] == pytest.approx(
+                per_scan_busy, rel=1e-5, abs=1e-5), lane
+            assert snap["lanes"][lane]["crit_s"] == pytest.approx(
+                per_scan_crit, rel=1e-5, abs=1e-5), lane
+        assert snap["wall_s"] == pytest.approx(
+            sum(r["wall_s"] for r in snap["recent"]), rel=1e-5,
+            abs=1e-5)
+        assert "bound by" in snap["verdict"]
+
+    def test_reset(self, fresh_agg):
+        _scan_once()
+        fresh_agg.reset()
+        snap = fresh_agg.snapshot()
+        assert snap["scans"] == 0 and snap["wall_s"] == 0.0
+        assert snap["flight"]["slowest"] == []
+
+
+class TestFlightRecorder:
+    def test_keeps_n_slowest_in_order(self, fresh_agg, monkeypatch):
+        monkeypatch.setenv("TRIVY_TPU_FLIGHT_RECORDER_N", "3")
+        walls = [0.02, 0.005, 0.03, 0.001, 0.01]
+        for w in walls:
+            with tracing.span("scan_artifact"):
+                time.sleep(w)
+        recs = fresh_agg.flight.records()
+        assert len(recs) == 3
+        got = [r["wall_s"] for r in recs]
+        # slowest-first, and the two fastest scans were evicted
+        assert got == sorted(got, reverse=True)
+        assert got[0] >= 0.03 and min(got) >= 0.01
+
+    def test_zero_disables(self, fresh_agg, monkeypatch):
+        monkeypatch.setenv("TRIVY_TPU_FLIGHT_RECORDER_N", "0")
+        _scan_once()
+        assert fresh_agg.flight.records() == []
+
+    def test_chrome_doc_shape(self, fresh_agg, monkeypatch):
+        monkeypatch.setenv("TRIVY_TPU_FLIGHT_RECORDER_N", "2")
+        _scan_once()
+        doc = fresh_agg.flight.chrome_doc()
+        assert doc["flightRecorder"]["traces"] == 1
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "scan_artifact" in names and "analysis.fetch" in names
+        for e in doc["traceEvents"]:
+            assert e["ph"] == "X" and e["dur"] >= 0
+
+
+class TestBoundedTraceBuffer:
+    def test_ring_caps_and_counts_drops(self, monkeypatch, tmp_path):
+        monkeypatch.setattr(tracing, "MAX_BUFFERED_ROOTS", 4)
+        tracing.enable(True)
+        tracing.reset()
+        try:
+            before = obs_metrics.TRACE_SPANS_DROPPED.value()
+            for i in range(10):
+                with tracing.span(f"rpc.root{i}"):
+                    with tracing.span("analysis.fetch"):
+                        pass
+            with tracing._roots_lock:
+                assert len(tracing._roots) == 4
+            # 6 evicted roots x 2 spans each
+            assert tracing.dropped_spans() == 12
+            assert obs_metrics.TRACE_SPANS_DROPPED.value() \
+                == before + 12
+            out = tmp_path / "t.json"
+            tracing.export_chrome(str(out))
+            doc = json.loads(out.read_text())
+            assert doc["spansDropped"] == 12
+            assert len(doc["traceEvents"]) == 8  # 4 roots x 2 spans
+            tracing.reset()
+            assert tracing.dropped_spans() == 0
+        finally:
+            tracing.enable(False)
+            tracing.reset()
+
+
+class TestExemplars:
+    def test_openmetrics_exemplar_and_eof(self):
+        reg = obs_metrics.Registry()
+        h = reg.histogram("t_seconds", "h", buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar="a" * 32)
+        h.observe(0.5)  # no exemplar on this bucket
+        om = reg.render_openmetrics().decode()
+        assert om.endswith("# EOF\n")
+        assert ('t_seconds_bucket{le="0.1"} 1 '
+                '# {trace_id="' + "a" * 32 + '"} 0.05 ') in om
+        # bucket without an exemplar renders bare
+        assert 't_seconds_bucket{le="1"} 2\n' in om
+
+    def test_legacy_exposition_bytes_unchanged_by_exemplars(self):
+        """Golden: the 0.0.4 text is byte-identical whether or not
+        exemplars were recorded."""
+        def build(with_exemplar: bool) -> bytes:
+            reg = obs_metrics.Registry()
+            h = reg.histogram("t_seconds", "h", buckets=(0.1, 1.0))
+            h.observe(0.05, exemplar="e" * 32 if with_exemplar else None)
+            h.observe(0.75)
+            return reg.render()
+
+        assert build(True) == build(False)
+        assert b"# {" not in build(True)
+        assert b"# EOF" not in build(True)
+
+    def test_phase_records_exemplar_when_traced(self):
+        from trivy_tpu import obs
+
+        tracing.enable(True)
+        tracing.reset()
+        try:
+            with tracing.span("scan_artifact") as root:
+                with obs.phase("detect"):
+                    pass
+            om = obs_metrics.REGISTRY.render_openmetrics().decode()
+            assert f'trace_id="{root.trace_id}"' in om
+        finally:
+            tracing.enable(False)
+            tracing.reset()
+
+
+def _mini_server(token=None):
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.db.store import AdvisoryDB
+    from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.rpc.server import Server
+
+    srv = Server(MatchEngine(AdvisoryDB(), use_device=False),
+                 MemoryCache(), host="localhost", port=0, token=token)
+    srv.start()
+    return srv
+
+
+def _get(url: str, token: str | None = None) -> tuple[int, bytes]:
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("Trivy-Token", token)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, e.read()
+
+
+class TestDebugEndpoints:
+    def test_profile_auth_and_shape(self):
+        srv = _mini_server(token="sekrit")
+        try:
+            code, _ = _get(srv.address + "/debug/profile")
+            assert code == 401
+            code, body = _get(srv.address + "/debug/profile",
+                              token="sekrit")
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["enabled"] is True
+            assert set(doc["lanes"]) == set(attrib.LANES)
+            for key in ("scans", "roots", "wall_s", "verdict",
+                        "recent", "flight"):
+                assert key in doc, key
+        finally:
+            srv.shutdown()
+
+    def test_profile_token_knob(self, monkeypatch):
+        monkeypatch.setenv("TRIVY_TPU_PROFILE_TOKEN", "profonly")
+        srv = _mini_server(token="sekrit")
+        try:
+            code, _ = _get(srv.address + "/debug/profile",
+                           token="profonly")
+            assert code == 200
+            # the profile token does NOT open the scan surface
+            req = urllib.request.Request(
+                srv.address + "/twirp/trivy.cache.v1.Cache/MissingBlobs",
+                data=b"{}", headers={"Trivy-Token": "profonly",
+                                     "X-Trivy-Tpu-Wire": "internal"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                with e:
+                    code = e.code
+            assert code == 401
+        finally:
+            srv.shutdown()
+
+    def test_flight_endpoint(self):
+        srv = _mini_server()
+        try:
+            code, body = _get(srv.address + "/debug/flight")
+            assert code == 200
+            doc = json.loads(body)
+            assert "traceEvents" in doc and "flightRecorder" in doc
+        finally:
+            srv.shutdown()
+
+    def test_attrib_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("TRIVY_TPU_ATTRIB", "0")
+        srv = _mini_server()
+        try:
+            _code, body = _get(srv.address + "/debug/profile")
+            assert json.loads(body)["enabled"] is False
+        finally:
+            srv.shutdown()
+
+    def test_metrics_negotiation(self):
+        srv = _mini_server()
+        try:
+            legacy = urllib.request.urlopen(
+                srv.address + "/metrics", timeout=10)
+            lbody = legacy.read()
+            assert legacy.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            # byte-identical to the pre-negotiation exposition (modulo
+            # the render-time DB-generation-age gauge, which ticks
+            # between the two renders)
+            def stable(body: bytes) -> list[bytes]:
+                return [ln for ln in body.splitlines()
+                        if not ln.startswith(
+                            b"trivy_tpu_db_generation_age_seconds ")]
+
+            assert stable(lbody) == stable(srv.service.metrics.render())
+            assert b"# EOF" not in lbody
+            req = urllib.request.Request(
+                srv.address + "/metrics",
+                headers={"Accept": "application/openmetrics-text"})
+            om = urllib.request.urlopen(req, timeout=10)
+            ombody = om.read()
+            assert om.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
+            assert ombody.endswith(b"# EOF\n")
+            assert ombody.count(b"# EOF") == 1
+        finally:
+            srv.shutdown()
+
+    def test_server_releases_sink_on_shutdown(self):
+        assert not attrib.enabled()
+        srv = _mini_server()
+        assert attrib.enabled()
+        srv.shutdown()
+        assert not attrib.enabled()
+
+
+class TestProfileCli:
+    def test_profile_command_renders(self, capsys):
+        from trivy_tpu.cli.main import main
+
+        srv = _mini_server(token="tok")
+        try:
+            # drive one remote scan so the profile has content
+            from trivy_tpu.rpc.client import RemoteCache, RemoteDriver
+            from trivy_tpu.types.scan import ScanOptions
+
+            cache = RemoteCache(srv.address, token="tok")
+            cache.put_blob("sha256:b", {"schema_version": 2,
+                                        "applications": []})
+            driver = RemoteDriver(srv.address, token="tok")
+            driver.scan("img", "", ["sha256:b"], ScanOptions())
+            rc = main(["profile", srv.address, "--token", "tok",
+                       "--quiet"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "verdict: bound by" in out
+            assert "fetch_io" in out
+            rc = main(["profile", srv.address, "--token", "tok",
+                       "--json", "--quiet"])
+            assert rc == 0
+            doc = json.loads(capsys.readouterr().out)
+            assert doc["scans"] >= 1
+        finally:
+            srv.shutdown()
+
+    def test_profile_flight_export(self, capsys, tmp_path):
+        from trivy_tpu.cli.main import main
+
+        srv = _mini_server()
+        try:
+            out_file = tmp_path / "flight.json"
+            rc = main(["profile", srv.address, "--flight",
+                       str(out_file), "--json", "--quiet"])
+            assert rc == 0
+            assert "traceEvents" in json.loads(out_file.read_text())
+        finally:
+            srv.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.no_lock_witness  # witness wrappers skew the real-vs-stub delta
+class TestAttribDisabledOverheadGuard:
+    """With no server holding the sink and TRIVY_TPU_ATTRIB unset, the
+    attribution seams must cost < 2% of a scan vs the same scan with
+    the span seams stubbed to no-ops (interleaved alternating pairs —
+    the no_lock_witness overhead-guard pattern)."""
+
+    def _corpus(self, tmp_path):
+        root = tmp_path / "corpus"
+        root.mkdir()
+        for i in range(20):
+            (root / f"requirements-{i}.txt").write_text(
+                "".join(f"pkg{j}=={j}.0\n" for j in range(40)))
+        return root
+
+    def test_disabled_overhead_under_2pct(self, tmp_path):
+        import contextlib
+        import os
+        import statistics
+
+        from trivy_tpu import obs as obs_pkg
+        from trivy_tpu.cli.main import main
+
+        assert not attrib.enabled()
+        root = self._corpus(tmp_path)
+
+        def scan():
+            rc = main(["filesystem", str(root), "--format", "json",
+                       "--cache-dir", str(tmp_path / "cache"),
+                       "--scanners", "vuln", "--quiet",
+                       "--output", os.devnull])
+            assert rc == 0
+
+        @contextlib.contextmanager
+        def null_phase(span_name, phase=None, **meta):
+            yield None
+
+        @contextlib.contextmanager
+        def stubbed():
+            orig_phase, orig_span = obs_pkg.phase, tracing.span
+            obs_pkg.phase = null_phase
+            tracing.span = \
+                lambda name, **meta: contextlib.nullcontext()
+            try:
+                yield
+            finally:
+                obs_pkg.phase, tracing.span = orig_phase, orig_span
+
+        def timed():
+            t0 = time.perf_counter()
+            scan()
+            return time.perf_counter() - t0
+
+        scan()
+        scan()
+        real_times, stub_times = [], []
+        for i in range(16):
+            if i % 2 == 0:
+                real_times.append(timed())
+                with stubbed():
+                    stub_times.append(timed())
+            else:
+                with stubbed():
+                    stub_times.append(timed())
+                real_times.append(timed())
+        real = statistics.median(real_times)
+        stub = statistics.median(stub_times)
+        assert real <= stub * 1.02 + 0.002, (real, stub)

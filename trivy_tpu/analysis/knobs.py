@@ -107,6 +107,19 @@ KNOBS: tuple[Knob, ...] = (
          "tracing off."),
     Knob("TRIVY_TPU_JAX_TRACE_DIR", "", "obs", False,
          "Directory for JAX profiler dumps alongside --trace-export."),
+    Knob("TRIVY_TPU_ATTRIB", "", "obs", True,
+         "Span-to-resource-lane bottleneck attribution "
+         "(docs/observability.md): unset = on while a scan server "
+         "runs, 1 forces it on for one-shot CLI scans, 0 disables "
+         "the aggregator entirely (pre-feature span fast path)."),
+    Knob("TRIVY_TPU_FLIGHT_RECORDER_N", "8", "obs", False,
+         "Slow-scan flight recorder ring size: the N slowest scan "
+         "traces kept live for /debug/flight Chrome-JSON export "
+         "(0 disables the recorder)."),
+    Knob("TRIVY_TPU_PROFILE_TOKEN", "", "obs", False,
+         "Dedicated auth token for the server's /debug/profile and "
+         "/debug/flight endpoints (grants profiling access without "
+         "the scan/cache token; the scan token always works too)."),
     # --- analysis (this package)
     Knob("TRIVY_TPU_LOCK_WITNESS", "", "analysis", False,
          "1 wraps the project's named locks in the lock-order witness "
@@ -174,6 +187,19 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TRIVY_TPU_BENCH_DELTA_ARTIFACTS", "200", "bench", False,
          "Journaled-artifact count for the delta-rescore bench's "
          "synthetic fleet."),
+    Knob("TRIVY_TPU_BENCH_CAPSTONE_IMAGES", "6", "bench", False,
+         "Synthetic-registry image count for the capstone "
+         "end-to-end bench (BASELINE configs #4/#5 as one system)."),
+    Knob("TRIVY_TPU_BENCH_CAPSTONE_CLIENTS", "4", "bench", False,
+         "Concurrent fleet clients crawling the capstone bench's "
+         "live server."),
+    Knob("TRIVY_TPU_BENCH_CAPSTONE_PODS", "240", "bench", False,
+         "Pod-scan count for the capstone bench's cluster "
+         "(config #5) phase; pods round-robin over the registry "
+         "images so artifact-level dedupe engages."),
+    Knob("TRIVY_TPU_BENCH_CAPSTONE_CHILD", "", "bench", False,
+         "Internal: set on the 8-virtual-device subprocess the "
+         "capstone bench spawns."),
 )
 
 

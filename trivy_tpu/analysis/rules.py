@@ -70,6 +70,7 @@ class Project:
         self.declared_fault_sites = self._extract_fault_sites()
         self.declared_fault_actions = self._extract_fault_actions()
         self.declared_knobs = self._extract_knobs()
+        self.declared_span_taxonomy = self._extract_span_taxonomy()
 
     def _collect(self) -> None:
         pkg = os.path.join(self.root, "trivy_tpu")
@@ -159,6 +160,43 @@ class Project:
             except Exception:  # malformed table -> import fallback
                 pass
         return self._real_knobs()
+
+    def _extract_span_taxonomy(self):
+        """Attribution taxonomy from the LINTED tree's obs/attrib.py
+        (lane map, structural set, prefix families, lane vocabulary) —
+        AST-extracted like the knob/fault tables; import fallback for
+        trees without the module; tests override the attribute."""
+        attrib_py = "trivy_tpu/obs/attrib.py"
+        vals = {}
+        for name in ("SPAN_LANES", "SPAN_STRUCTURAL",
+                     "SPAN_PREFIX_LANES", "LANES"):
+            node = self._registry_assign(attrib_py, name)
+            if node is None:
+                vals = None
+                break
+            try:
+                vals[name] = ast.literal_eval(node)
+            except (ValueError, TypeError):
+                vals = None
+                break
+        if vals is not None:
+            return {
+                "span_lanes": dict(vals["SPAN_LANES"]),
+                "structural": set(vals["SPAN_STRUCTURAL"]),
+                "prefixes": tuple(tuple(p)
+                                  for p in vals["SPAN_PREFIX_LANES"]),
+                "lanes": tuple(vals["LANES"]),
+            }
+        try:
+            from trivy_tpu.obs import attrib
+        except ImportError:
+            return None
+        return {
+            "span_lanes": dict(attrib.SPAN_LANES),
+            "structural": set(attrib.SPAN_STRUCTURAL),
+            "prefixes": tuple(attrib.SPAN_PREFIX_LANES),
+            "lanes": tuple(attrib.LANES),
+        }
 
     @staticmethod
     def _real_fault_sites():
@@ -824,6 +862,115 @@ class LockOrderRule(Rule):
 def lockstatic_find_cycle(edges):
     from trivy_tpu.analysis.witness import find_cycle
     return find_cycle(edges)
+
+
+# ==================================================== 9. span-taxonomy
+
+@register
+class SpanTaxonomyRule(Rule):
+    id = "span-taxonomy"
+    summary = ("every span name emitted under trivy_tpu/ ⇔ classified "
+               "in obs/attrib.py's attribution taxonomy (both "
+               "directions; dynamic families via declared prefixes)")
+    rationale = (
+        "The bottleneck attribution layer (/debug/profile, bench "
+        "capstone) is only as honest as its span taxonomy: an emitted "
+        "span the classifier doesn't know silently lands in 'other' "
+        "and the roofline verdict drifts, while a classified span no "
+        "code emits is vocabulary reviewers trust but nothing feeds. "
+        "obs/attrib.py is the single source of truth; bench.py's "
+        "harness-only spans are out of scope by design.")
+
+    SPAN_FNS = {"span", "phase", "server_span"}
+    SCOPE = "trivy_tpu/"
+    ATTRIB_PY = "trivy_tpu/obs/attrib.py"
+
+    def _emitted(self, project: Project):
+        """-> ({name: (path, line)}, [(prefix_frag, path, line)]).
+        A span name counts when the first argument of a span/phase/
+        server_span call resolves to a literal (directly or via a
+        module constant); f-string names contribute their leading
+        literal fragment as a dynamic-family probe. Unresolvable
+        names (helper parameters like obs.phase's forwarding call)
+        are ignored — they re-emit a name classified at the real
+        call site."""
+        used: dict[str, tuple[str, int]] = {}
+        dynamic: list[tuple[str, str, int]] = []
+        for pf in project.files():
+            if not pf.relpath.startswith(self.SCOPE):
+                continue
+            consts = _module_consts(pf.tree)
+            for node in ast.walk(pf.tree):
+                if not (isinstance(node, ast.Call)
+                        and _func_tail(node.func) in self.SPAN_FNS
+                        and node.args):
+                    continue
+                arg = node.args[0]
+                name = _const_str(arg)
+                if name is None and isinstance(arg, ast.Name):
+                    name = consts.get(arg.id)
+                if name is not None:
+                    used.setdefault(name, (pf.relpath, node.lineno))
+                elif isinstance(arg, ast.JoinedStr) and arg.values:
+                    frag = _const_str(arg.values[0])
+                    if frag:
+                        dynamic.append((frag, pf.relpath, node.lineno))
+        return used, dynamic
+
+    def check(self, project: Project):
+        tax = project.declared_span_taxonomy
+        if tax is None:
+            return  # no taxonomy known (mini-tree without attrib)
+        lanes = set(tax["lanes"])
+        span_lanes = tax["span_lanes"]
+        structural = set(tax["structural"])
+        prefixes = tuple(tax["prefixes"])
+        for name, lane in sorted(span_lanes.items()):
+            if lane not in lanes:
+                yield Finding(
+                    self.id, self.ATTRIB_PY, 1,
+                    f"SPAN_LANES maps {name!r} to unknown lane "
+                    f"{lane!r} (not in LANES)")
+        for prefix, lane in prefixes:
+            if lane not in lanes:
+                yield Finding(
+                    self.id, self.ATTRIB_PY, 1,
+                    f"SPAN_PREFIX_LANES maps {prefix!r} to unknown "
+                    f"lane {lane!r} (not in LANES)")
+        used, dynamic = self._emitted(project)
+        declared = set(span_lanes) | structural
+        for name, (path, line) in sorted(used.items()):
+            if name in declared:
+                continue
+            if any(name.startswith(p) for p, _l in prefixes):
+                continue
+            yield Finding(
+                self.id, path, line,
+                f"span {name!r} emitted here but not classified in "
+                "obs/attrib.py (SPAN_LANES / SPAN_STRUCTURAL / a "
+                "declared prefix family) — unclassified spans land "
+                "in the attribution report's 'other' bucket")
+        for frag, path, line in dynamic:
+            if not any(frag.startswith(p) or p.startswith(frag)
+                       for p, _l in prefixes):
+                yield Finding(
+                    self.id, path, line,
+                    f"dynamic span family {frag!r}… not covered by "
+                    "any SPAN_PREFIX_LANES entry in obs/attrib.py")
+        for name in sorted(declared):
+            if name not in used:
+                yield Finding(
+                    self.id, self.ATTRIB_PY, 1,
+                    f"taxonomy classifies span {name!r} but no "
+                    "instrumented call site emits it")
+        for prefix, _lane in prefixes:
+            if not any(f.startswith(prefix) or prefix.startswith(f)
+                       for f, _p, _ln in dynamic) \
+                    and not any(u.startswith(prefix) for u in used):
+                yield Finding(
+                    self.id, self.ATTRIB_PY, 1,
+                    f"SPAN_PREFIX_LANES declares family {prefix!r} "
+                    "but no call site emits a span under it")
 
 
 # ----------------------------------------------------------- the driver

@@ -26,6 +26,7 @@ from trivy_tpu.fanal.handlers import system_file_filter
 from trivy_tpu.fanal.walker import walk_layer_tar
 from trivy_tpu.log import logger
 from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import tracing
 from trivy_tpu.types.artifact import ArtifactInfo, Package, Secret
 
 _log = logger("image")
@@ -343,7 +344,10 @@ class ImageArtifact:
         for _ in range(8):  # each round either resolves or re-claims
             obs_metrics.LAYER_DEDUPE_INFLIGHT_WAITS.inc()
             stats["inflight_waits"] += 1
-            slot.event.wait(pipeline._INPROC_WAIT_S)
+            # queue_wait attribution lane: parked on another scan's
+            # in-flight analysis of this same layer
+            with tracing.span("analysis.dedupe.wait"):
+                slot.event.wait(pipeline._INPROC_WAIT_S)
             if slot.ok:
                 if slot.doc is not None and slot.src_cache is not self.cache:
                     # the leader analyzed into a different cache handle

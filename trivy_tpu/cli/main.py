@@ -381,6 +381,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="re-match on a sharded device mesh ('DPxDB', "
                         "'auto', or 'off'; env TRIVY_TPU_MESH)")
 
+    p = sub.add_parser(
+        "profile", help="fetch a live server's bottleneck attribution "
+        "(/debug/profile): per-resource-lane occupancy, critical-path "
+        "shares, the roofline verdict, and the slow-scan flight "
+        "recorder (docs/observability.md)", allow_abbrev=False)
+    _add_global_flags(p)
+    p.add_argument("server", help="scan server URL (e.g. "
+                                  "http://localhost:4954)")
+    p.add_argument("--token", default=None,
+                   help="server auth token (or the dedicated "
+                        "TRIVY_TPU_PROFILE_TOKEN)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw /debug/profile document")
+    p.add_argument("--flight", default=None, metavar="FILE",
+                   help="also fetch /debug/flight (the N slowest scan "
+                        "traces) as Chrome trace-event JSON to FILE")
+
     p = sub.add_parser("db", help="advisory DB operations", allow_abbrev=False)
     _add_global_flags(p)
     dbsub = p.add_subparsers(dest="db_command")
@@ -502,7 +519,7 @@ def main(argv: list[str] | None = None) -> int:
     known = {"image", "filesystem", "fs", "rootfs", "repository", "repo",
              "sbom", "vm", "kubernetes", "k8s", "convert", "server", "db",
              "clean", "config", "version", "registry", "plugin", "module",
-             "lint", "watch"}
+             "lint", "watch", "profile"}
     if argv and not argv[0].startswith("-") and argv[0] not in known:
         from trivy_tpu.plugin import PluginManager
 
@@ -564,6 +581,8 @@ def main(argv: list[str] | None = None) -> int:
             return run.run_server(args)
         if args.command == "watch":
             return run.run_watch(args)
+        if args.command == "profile":
+            return run.run_profile(args)
         if args.command == "db":
             return run.run_db(args)
         if args.command == "clean":

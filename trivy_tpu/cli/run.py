@@ -1032,6 +1032,71 @@ def run_watch(args) -> int:
             out.close()
 
 
+def run_profile(args) -> int:
+    """`trivy-tpu profile URL`: render a live server's bottleneck
+    attribution (docs/observability.md "Attribution & profiling") —
+    per-lane busy/critical seconds, the roofline "bound by X" verdict,
+    recent per-scan records, and the slow-scan flight recorder."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    base = args.server.rstrip("/")
+    if not base.startswith("http"):
+        base = "http://" + base
+
+    def get(path: str) -> dict:
+        req = urllib.request.Request(base + path)
+        if getattr(args, "token", None):
+            req.add_header("Trivy-Token", args.token)
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return _json.loads(r.read().decode())
+
+    try:
+        doc = get("/debug/profile")
+        if getattr(args, "flight", None):
+            fdoc = get("/debug/flight")
+            # lint: allow[atomic-write] user-requested trace-export artifact, not program state
+            with open(args.flight, "w", encoding="utf-8") as f:
+                _json.dump(fdoc, f, indent=1)
+                f.write("\n")
+            print(f"flight ring written: {args.flight} "
+                  f"({len(fdoc.get('traceEvents', []))} events, "
+                  f"{fdoc.get('flightRecorder', {}).get('traces', 0)} "
+                  "traces)")
+    except urllib.error.URLError as e:
+        raise FatalError(f"profile fetch failed: {e}")
+    if getattr(args, "json", False):
+        print(_json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    if not doc.get("enabled", False) and not doc.get("roots"):
+        print("attribution disabled on this server "
+              "(TRIVY_TPU_ATTRIB=0) or no scans observed yet")
+        return 0
+    print(f"scans observed: {doc.get('scans', 0)}  "
+          f"(roots: {doc.get('roots', 0)}, "
+          f"wall {doc.get('wall_s', 0.0):.3f}s)")
+    print(f"{'lane':<16} {'busy s':>10} {'critical s':>11} {'share':>7}")
+    for lane, row in (doc.get("lanes") or {}).items():
+        print(f"{lane:<16} {row.get('busy_s', 0.0):>10.3f} "
+              f"{row.get('crit_s', 0.0):>11.3f} "
+              f"{row.get('crit_share', 0.0):>7.1%}")
+    print(f"{'other':<16} {'':>10} "
+          f"{doc.get('other_s', 0.0):>11.3f}")
+    print(f"verdict: {doc.get('verdict', '?')}")
+    flight = doc.get("flight") or {}
+    slowest = flight.get("slowest") or []
+    if slowest:
+        print(f"flight recorder (slowest {len(slowest)} of "
+              f"ring {flight.get('n')}):")
+        for r in slowest:
+            print(f"  {r.get('wall_s', 0.0):>9.3f}s  "
+                  f"{r.get('name', ''):<14} "
+                  f"dominant={r.get('dominant', '')} "
+                  f"trace={r.get('trace_id', '')}")
+    return 0
+
+
 def run_db(args) -> int:
     from trivy_tpu.db.store import AdvisoryDB
 

@@ -831,6 +831,7 @@ class MatchEngine:
     def _dispatch_unique(self, queries: list[PkgQuery]) -> dict:
         """Encode and enqueue the device work for a unique-query batch
         without blocking. -> opaque ctx for _collect_unique."""
+        from trivy_tpu.obs import tracing
         from trivy_tpu.ops import match as m
 
         cdb = self.cdb
@@ -840,10 +841,13 @@ class MatchEngine:
         ctx = {"queries": queries, "batch": batch,
                "memo_gen": self._memo_gen,
                "main": None, "sharded": None, "hot": None, "tall": None}
-        if self._mdb is not None:
-            ctx["sharded"] = self._mdb.dispatch(batch)
-        elif self._ddb is not None:
-            ctx["main"] = m.match_dispatch(self._ddb, batch)
+        # the device_dispatch attribution lane: kernel enqueues are
+        # async, so this span times the launch work, not the compute
+        with tracing.span("engine.dispatch", queries=len(queries)):
+            if self._mdb is not None:
+                ctx["sharded"] = self._mdb.dispatch(batch)
+            elif self._ddb is not None:
+                ctx["main"] = m.match_dispatch(self._ddb, batch)
         # hot/tall tier routing comes gathered from the name intern
         # table (batch.route) — no per-query dict probe; the dict walk
         # below only serves batches encoded outside the engine
@@ -872,9 +876,11 @@ class MatchEngine:
             return (idx, m.match_dispatch(ddb, sub), sub)
 
         if len(hot_idx) and self._ddb_hot is not None:
-            ctx["hot"] = sub_dispatch(hot_idx, self._ddb_hot)
+            with tracing.span("engine.dispatch", queries=len(hot_idx)):
+                ctx["hot"] = sub_dispatch(hot_idx, self._ddb_hot)
         if len(tall_idx) and self._ddb_tall is not None:
-            ctx["tall"] = sub_dispatch(tall_idx, self._ddb_tall)
+            with tracing.span("engine.dispatch", queries=len(tall_idx)):
+                ctx["tall"] = sub_dispatch(tall_idx, self._ddb_tall)
         return ctx
 
     def _detect_unique(self, queries: list[PkgQuery]) -> list[list[int]]:

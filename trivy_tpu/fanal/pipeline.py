@@ -378,7 +378,11 @@ def run_layer_pipeline(items: list, fetch, process,
 
         try:
             for _ in items:
-                item, payload, is_err = next_payload()
+                # queue_wait attribution lane: the analyzing thread
+                # starving on the fetch lane (fetch-bound crawls show
+                # up here, not in analysis.walk)
+                with tracing.span("analysis.await_fetch"):
+                    item, payload, is_err = next_payload()
                 if is_err:
                     raise payload
                 t0 = time.perf_counter()

@@ -26,6 +26,7 @@ metric (even before the first sample) in the Prometheus text format
 from __future__ import annotations
 
 import threading
+import time
 
 from trivy_tpu.analysis.witness import make_lock
 from typing import Callable, Iterable
@@ -122,13 +123,32 @@ class _Metric:
 
     # rendering -------------------------------------------------------
 
-    def _render(self, out: list[str]) -> None:
-        out.append(f"# HELP {self.name} {self.help}")
-        out.append(f"# TYPE {self.name} {self.kind}")
-        for key in sorted(self._series):
-            self._render_series(out, key, self._series[key])
+    def _om_family(self) -> tuple[str, str]:
+        """(family name, type) for the OpenMetrics metadata lines.
+        OpenMetrics names a counter FAMILY without the `_total` suffix
+        (samples keep it); a counter whose name cannot be suffixed that
+        way (legacy `*_seconds_sum`) degrades to `unknown`, which has
+        no naming constraints — the sample names themselves never
+        change in either exposition."""
+        if self.kind == "counter":
+            if self.name.endswith("_total"):
+                return self.name[: -len("_total")], "counter"
+            return self.name, "unknown"
+        return self.name, self.kind
 
-    def _render_series(self, out: list[str], key, state) -> None:
+    def _render(self, out: list[str], om: bool = False) -> None:
+        if om:
+            family, kind = self._om_family()
+            out.append(f"# HELP {family} {self.help}")
+            out.append(f"# TYPE {family} {kind}")
+        else:
+            out.append(f"# HELP {self.name} {self.help}")
+            out.append(f"# TYPE {self.name} {self.kind}")
+        for key in sorted(self._series):
+            self._render_series(out, key, self._series[key], om=om)
+
+    def _render_series(self, out: list[str], key, state,
+                       om: bool = False) -> None:
         out.append(
             f"{self.name}{_labels_text(self.label_names, key)} "
             f"{_fmt(state)}")
@@ -186,7 +206,7 @@ class Gauge(_Metric):
                 return float(self._fn())
             return float(self._series.get(self._key(labels), 0.0))
 
-    def _render(self, out: list[str]) -> None:
+    def _render(self, out: list[str], om: bool = False) -> None:
         if self._fn is not None:
             try:
                 val = float(self._fn())
@@ -196,16 +216,21 @@ class Gauge(_Metric):
             out.append(f"# TYPE {self.name} {self.kind}")
             out.append(f"{self.name} {_fmt(val)}")
             return
-        super()._render(out)
+        super()._render(out, om=om)
 
 
 class _HistState:
-    __slots__ = ("counts", "total", "count")
+    __slots__ = ("counts", "total", "count", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets  # cumulative at render, raw here
         self.total = 0.0
         self.count = 0
+        # per-raw-bucket (trace_id, value, epoch_ts) — the last traced
+        # observation that landed in each bucket; only materialized
+        # once an exemplar is actually recorded, rendered only in the
+        # OpenMetrics exposition (the 0.0.4 bytes never change)
+        self.exemplars: list | None = None
 
 
 class Histogram(_Metric):
@@ -224,7 +249,12 @@ class Histogram(_Metric):
     def _new_state(self) -> _HistState:
         return _HistState(len(self.buckets) + 1)  # +1 for +Inf
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: str | None = None,
+                **labels) -> None:
+        """Record one observation. `exemplar` is an optional trace id:
+        the OpenMetrics exposition links the bucket this value lands in
+        to that trace (`... # {trace_id="…"} value ts`), so a p99
+        bucket names the exact scan that put it there."""
         value = float(value)
         with self.registry._lock:
             state: _HistState = self._series[self._slot(labels)]  # type: ignore[assignment]
@@ -237,6 +267,10 @@ class Histogram(_Metric):
             state.counts[i] += 1
             state.total += value
             state.count += 1
+            if exemplar:
+                if state.exemplars is None:
+                    state.exemplars = [None] * len(state.counts)
+                state.exemplars[i] = (str(exemplar), value, time.time())
 
     def snapshot(self, **labels) -> tuple[list[int], float, int]:
         """(cumulative bucket counts incl. +Inf, sum, count)."""
@@ -250,20 +284,29 @@ class Histogram(_Metric):
                 cum.append(running)
             return cum, state.total, state.count
 
+    @staticmethod
+    def _exemplar_text(state: _HistState, i: int, om: bool) -> str:
+        if not om or state.exemplars is None or state.exemplars[i] is None:
+            return ""
+        trace_id, value, ts = state.exemplars[i]
+        return (f' # {{trace_id="{_escape(trace_id)}"}} '
+                f"{_fmt(value)} {ts:.3f}")
+
     def _render_series(self, out: list[str], key,
-                       state: _HistState) -> None:
+                       state: _HistState, om: bool = False) -> None:
         running = 0
-        for bound, c in zip(self.buckets, state.counts):
+        for i, (bound, c) in enumerate(zip(self.buckets, state.counts)):
             running += c
             out.append(
                 f"{self.name}_bucket"
                 f"{_labels_text(self.label_names, key, (('le', _fmt(bound)),))}"
-                f" {running}")
+                f" {running}" + self._exemplar_text(state, i, om))
         running += state.counts[-1]
         out.append(
             f"{self.name}_bucket"
             f"{_labels_text(self.label_names, key, (('le', '+Inf'),))}"
-            f" {running}")
+            f" {running}"
+            + self._exemplar_text(state, len(self.buckets), om))
         lbl = _labels_text(self.label_names, key)
         out.append(f"{self.name}_sum{lbl} {_fmt(state.total)}")
         out.append(f"{self.name}_count{lbl} {state.count}")
@@ -331,6 +374,23 @@ class Registry:
             for name in self._metrics:  # registration order is stable
                 self._metrics[name]._render(out)
         return ("\n".join(out) + "\n").encode()
+
+    def render_openmetrics(self, eof: bool = True) -> bytes:
+        """OpenMetrics-flavored exposition: the same series as
+        :meth:`render` plus trace-id **exemplars** on histogram buckets
+        and the `# EOF` terminator. Served from `/metrics` only under
+        `Accept: application/openmetrics-text` content negotiation —
+        the default 0.0.4 bytes never change (golden-tested).
+        `eof=False` lets a caller concatenate several registries into
+        one exposition with a single terminator."""
+        out: list[str] = []
+        with self._lock:
+            for name in self._metrics:
+                self._metrics[name]._render(out, om=True)
+        text = "\n".join(out) + "\n"
+        if eof:
+            text += "# EOF\n"
+        return text.encode()
 
 
 # ---------------------------------------------------------------- spine
@@ -516,3 +576,16 @@ SECRET_SCHED_COALESCED = REGISTRY.histogram(
     "Distinct concurrent scans coalesced into one secret anchor-"
     "screen micro-batch",
     buckets=(1, 2, 4, 8, 16, 32))
+TRACE_SPANS_DROPPED = REGISTRY.counter(
+    "trivy_tpu_trace_spans_dropped_total",
+    "Collected trace spans evicted by the bounded root-trace buffer "
+    "(a long-running server with tracing on keeps the newest "
+    "MAX_BUFFERED_ROOTS traces; the Chrome export notes this count)")
+ATTRIB_LANE_SECONDS = REGISTRY.counter(
+    "trivy_tpu_attrib_lane_seconds_total",
+    "Resource-lane attribution seconds accumulated from completed "
+    "scan traces (kind=busy: wall-clock union the lane's spans "
+    "covered; kind=critical: the lane's slice of the per-scan "
+    "critical-path partition) — docs/observability.md "
+    "'Attribution & profiling'",
+    labels=("lane", "kind"))

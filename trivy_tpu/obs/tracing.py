@@ -115,6 +115,46 @@ _roots: list[Span] = []
 _roots_lock = make_lock("obs.tracing._roots_lock")
 _generation = 0
 
+# the buffered-root ring bound: a long-running server with tracing on
+# (--trace-export, TRIVY_TPU_TRACE=1) used to grow _roots without limit
+# until exit; past this many buffered roots the OLDEST trace is dropped
+# and counted in trivy_tpu_trace_spans_dropped_total (the export file
+# carries the drop count, so a truncated trace is never mistaken for a
+# complete one)
+MAX_BUFFERED_ROOTS = 4096
+_dropped = 0  # spans dropped since the last reset(); guarded by _roots_lock
+
+# completed-root sink (obs.attrib): when set, spans collect and every
+# finished ROOT trace is handed to the sink even while classic tracing
+# is off — the attribution aggregator and flight recorder see whole
+# trees without buffering anything in _roots
+_sink = None
+
+
+def set_sink(fn) -> None:
+    """Install (or clear, fn=None) the completed-root-trace sink.
+    Owned by obs.attrib — use attrib.acquire()/release() instead of
+    calling this directly."""
+    global _sink
+    _sink = fn
+
+
+def _span_count(root: Span) -> int:
+    n = 0
+    stack = [root]
+    while stack:
+        s = stack.pop()
+        n += 1
+        stack.extend(s.children)
+    return n
+
+
+def dropped_spans() -> int:
+    """Spans evicted from the bounded root buffer since the last
+    reset() (mirrored in trivy_tpu_trace_spans_dropped_total)."""
+    with _roots_lock:
+        return _dropped
+
 
 class _Noop:
     """Reusable no-op context manager: the disabled-tracing fast path
@@ -133,14 +173,15 @@ _NOOP = _Noop()
 
 
 def span(name: str, **meta):
-    if not _enabled and _slow_ms is None:
+    if not _enabled and _slow_ms is None and _sink is None:
         return _NOOP
     return _span_cm(name, meta)
 
 
 @contextlib.contextmanager
 def _span_cm(name: str, meta: dict):
-    collect = _enabled
+    sink = _sink
+    collect = _enabled or sink is not None
     slow = _slow_ms
     s = Span(name=name, meta=meta, tid=threading.get_ident())
     token = None
@@ -173,11 +214,40 @@ def _span_cm(name: str, meta: dict):
         if collect:
             _current.reset(token)
             if is_root:
-                with _roots_lock:
-                    if gen == _generation:  # reset() since open: drop
-                        _roots.append(s)
+                if _enabled:
+                    evicted = None
+                    with _roots_lock:
+                        if gen == _generation:  # reset() since open: drop
+                            _roots.append(s)
+                            if len(_roots) > MAX_BUFFERED_ROOTS:
+                                evicted = _roots.pop(0)
+                    if evicted is not None:
+                        # count the evicted tree OUTSIDE the lock (a
+                        # large trace walk must not stall concurrent
+                        # span closes), once for both sinks
+                        n = _span_count(evicted)
+                        global _dropped
+                        with _roots_lock:
+                            _dropped += n
+                        _count_dropped(n)
+                if sink is not None:
+                    try:
+                        sink(s)
+                    except Exception:
+                        # a broken profiler sink must never break the
+                        # scan that produced the trace
+                        pass
         if slow is not None and s.elapsed * 1000.0 >= slow:
             _log_slow(s)
+
+
+def _count_dropped(n: int) -> None:
+    # lazy import: metrics never imports tracing, but the package
+    # __init__ imports both and the counter is only needed on the rare
+    # eviction path
+    from trivy_tpu.obs import metrics as _metrics
+
+    _metrics.TRACE_SPANS_DROPPED.inc(n)
 
 
 def _log_slow(s: Span) -> None:
@@ -322,10 +392,11 @@ def reset() -> None:
     thread while spans are open elsewhere (their eventual close is
     discarded by the generation guard) and idempotent when tracing is
     disabled."""
-    global _generation
+    global _generation, _dropped
     with _roots_lock:
         _generation += 1
         _roots.clear()
+        _dropped = 0
 
 
 def _stitched_roots() -> tuple[list[Span], dict[str, list[Span]]]:
@@ -402,11 +473,12 @@ def timings() -> dict[str, float]:
     return {k: round(v, 6) for k, v in agg.items()}
 
 
-def chrome_events() -> list[dict]:
+def chrome_events(span_list: list[Span] | None = None) -> list[dict]:
     """Chrome trace-event 'complete' (ph=X) events for every collected
-    span; timestamps in microseconds since epoch."""
+    span (or an explicit span list — the flight recorder exports its
+    retained traces this way); timestamps in microseconds since epoch."""
     events = []
-    for s in spans():
+    for s in (spans() if span_list is None else span_list):
         args = {"trace_id": s.trace_id, "span_id": s.span_id}
         if s.parent_id:
             args["parent_id"] = s.parent_id
@@ -428,7 +500,11 @@ def export_chrome(path: str) -> int:
     """Write the collected spans as Chrome trace-event JSON (open in
     Perfetto / chrome://tracing). Returns the number of events."""
     events = chrome_events()
-    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           # bounded-buffer honesty: spans evicted by the root ring
+           # since the last reset — non-zero means this file is a
+           # truncated window, not the whole run
+           "spansDropped": dropped_spans()}
     # lint: allow[atomic-write] user-requested --trace-export artifact, not program state
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=1)

@@ -409,9 +409,17 @@ class MeshDB:
         retry then degrade, device-lost -> degrade now.  Always returns
         a bit-exact mask — degradation changes latency, never bits."""
         from trivy_tpu.obs import metrics as obs_metrics
+        from trivy_tpu.obs import tracing
         from trivy_tpu.ops import match as m
 
         t0 = time.perf_counter()
+        # the device_wait attribution lane: this is where the match
+        # path actually blocks on silicon (dispatch is async)
+        with tracing.span("engine.shard", shard=d):
+            return self._collect_cell_timed(d, sub, cell, t0,
+                                            obs_metrics, m)
+
+    def _collect_cell_timed(self, d: int, sub, cell, t0, obs_metrics, m):
         try:
             if cell is None or d in self.degraded:
                 return self._host_mask(d, sub)

@@ -98,6 +98,11 @@ class _Conn:
 
     def _connection(self, timeout: float) -> http.client.HTTPConnection:
         c = getattr(self._tls, "conn", None)
+        if c is not None and getattr(c, "_ttpu_close_deferred", False):
+            # a concurrent close() asked for teardown while this thread
+            # was mid-request: honor it now, then hand out a fresh conn
+            self._drop_connection()
+            c = None
         if c is None:
             cls = (http.client.HTTPSConnection if self._https
                    else http.client.HTTPConnection)
@@ -130,12 +135,23 @@ class _Conn:
                 pass
 
     def close(self) -> None:
-        """Close every thread's keep-alive socket (best effort). A
-        pooled connection stays usable: the next request auto-reopens
-        and re-registers its socket."""
+        """Close every IDLE thread's keep-alive socket (best effort).
+        A pooled connection stays usable: the next request auto-reopens
+        and re-registers its socket.
+
+        Pooled _Conns are shared across threads (one socket per
+        thread), so a conn currently INSIDE a request on another
+        thread must not be torn down under it — closing it there races
+        http.client's response read (fp=None mid-read, observed as an
+        AttributeError under the capstone bench's fleet). Busy conns
+        are marked close-deferred instead; the owning thread finishes
+        its round trip and closes on its next handout."""
         with self._conns_lock:
             conns, self._all_conns = list(self._all_conns), set()
         for c in conns:
+            if getattr(c, "_ttpu_busy", False):
+                c._ttpu_close_deferred = True
+                continue
             try:
                 c.close()
             except OSError:
@@ -157,9 +173,7 @@ class _Conn:
         conn = self._connection(timeout)
         url_path = self._path_prefix + path
         try:
-            conn.request("POST", url_path, body=body, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
+            resp, data = self._roundtrip(conn, url_path, body, headers)
         except TimeoutError:
             self._drop_connection()
             raise
@@ -169,9 +183,8 @@ class _Conn:
                 raise
             conn = self._connection(timeout)
             try:
-                conn.request("POST", url_path, body=body, headers=headers)
-                resp = conn.getresponse()
-                data = resp.read()
+                resp, data = self._roundtrip(conn, url_path, body,
+                                             headers)
             except (http.client.HTTPException, ConnectionError, OSError):
                 self._drop_connection()
                 raise
@@ -180,6 +193,25 @@ class _Conn:
             # auto-reopens (http.client auto_open), nothing to do
             pass
         return resp.status, resp.headers, data
+
+    def _roundtrip(self, conn, url_path: str, body: bytes,
+                   headers: dict):
+        """One request/response on `conn`, marked busy for the
+        duration so a concurrent close() of this (pooled, shared)
+        _Conn defers teardown instead of yanking the socket out from
+        under the in-flight response read."""
+        conn._ttpu_busy = True
+        try:
+            conn.request("POST", url_path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp, data
+        finally:
+            conn._ttpu_busy = False
+            if getattr(conn, "_ttpu_close_deferred", False):
+                # the close that was deferred to us: the response is
+                # consumed, teardown is safe now
+                self._drop_connection()
 
     def _request_via_urllib(self, path: str, body: bytes, headers: dict,
                             timeout: float):
@@ -263,9 +295,15 @@ class _Conn:
                     status, rhdrs, raw = self._request_once(
                         path, send_body, hdrs, timeout)
                 finally:
-                    # per-attempt round-trip latency, errors included
+                    # per-attempt round-trip latency, errors included;
+                    # the ambient rpc.<method> span's trace id rides
+                    # along as an OpenMetrics exemplar so a tail bucket
+                    # names the exact trace that landed there
+                    cur = tracing.current()
                     obs_metrics.RPC_CLIENT_SECONDS.observe(
-                        time.perf_counter() - rt_start, method=method)
+                        time.perf_counter() - rt_start, method=method,
+                        exemplar=cur.trace_id if cur is not None
+                        else None)
                 if rhdrs.get(wire.GZIP_CAPABLE_HEADER):
                     self._server_gzip = True
                 if "gzip" in (rhdrs.get("Content-Encoding")

@@ -188,6 +188,13 @@ class Metrics:
     def render(self) -> bytes:
         return self.registry.render() + obs_metrics.REGISTRY.render()
 
+    def render_openmetrics(self) -> bytes:
+        """OpenMetrics exposition (exemplars + `# EOF`), served only
+        under `Accept: application/openmetrics-text` — the legacy
+        0.0.4 bytes from render() stay golden."""
+        return (self.registry.render_openmetrics(eof=False)
+                + obs_metrics.REGISTRY.render_openmetrics())
+
 
 class ScanService:
     """Holds the hot-swappable engine + the server-side cache."""
@@ -436,10 +443,15 @@ class ScanService:
             budget = min(budget, budget_s)
         resolved: list[str] = []
         for b, slot in waits:
+            t0 = time.monotonic()
             if budget > 0:
                 obs_metrics.LAYER_DEDUPE_INFLIGHT_WAITS.inc()
-            t0 = time.monotonic()
-            done = slot.event.wait(budget) if budget > 0 else slot.done
+                # queue_wait attribution lane: this request parked on
+                # another client's in-flight analysis of a shared layer
+                with tracing.span("analysis.dedupe.wait"):
+                    done = slot.event.wait(budget)
+            else:
+                done = slot.done
             budget = max(0.0, budget - (time.monotonic() - t0))
             if done and slot.ok:
                 resolved.append(b)
@@ -701,7 +713,35 @@ def _make_handler(service: ScanService, token: str | None,
                 return True
             return self.headers.get("Trivy-Token") == token
 
+        def _debug_authed(self) -> bool:
+            """/debug/* gate: the scan token works, and the dedicated
+            TRIVY_TPU_PROFILE_TOKEN (when set) grants profile access
+            without handing out the scan/cache surface."""
+            profile_token = os.environ.get("TRIVY_TPU_PROFILE_TOKEN", "")
+            if profile_token and \
+                    self.headers.get("Trivy-Token") == profile_token:
+                return True
+            return self._authed()
+
         def do_GET(self):
+            if self.path.startswith("/debug/"):
+                # live bottleneck attribution + slow-scan flight
+                # recorder; token-gated like /monitor/events (profiles
+                # name scan targets and trace ids)
+                if not self._debug_authed():
+                    self._error(401, "invalid token")
+                    return
+                from trivy_tpu.obs import attrib
+
+                if self.path.startswith("/debug/profile"):
+                    self._reply(200, json.dumps(
+                        attrib.AGG.snapshot()).encode())
+                elif self.path.startswith("/debug/flight"):
+                    self._reply(200, json.dumps(
+                        attrib.AGG.flight.chrome_doc()).encode())
+                else:
+                    self._error(404, "not found")
+                return
             if self.path.startswith("/monitor/events"):
                 if not self._authed():
                     # events name scan targets + CVEs: token-gated like
@@ -736,8 +776,19 @@ def _make_handler(service: ScanService, token: str | None,
                 self._reply(200, json.dumps(
                     {"Version": trivy_tpu.__version__}).encode())
             elif self.path == "/metrics":
-                self._reply(200, service.metrics.render(),
-                            "text/plain; version=0.0.4")
+                # content negotiation: the OpenMetrics exposition (with
+                # trace-id exemplars) only on explicit Accept — every
+                # header-less legacy scraper keeps the byte-stable
+                # 0.0.4 text (golden-tested)
+                accept = self.headers.get("Accept") or ""
+                if "application/openmetrics-text" in accept:
+                    self._reply(
+                        200, service.metrics.render_openmetrics(),
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8")
+                else:
+                    self._reply(200, service.metrics.render(),
+                                "text/plain; version=0.0.4")
             else:
                 self._error(404, "not found")
 
@@ -887,6 +938,14 @@ class Server:
         self.db_reload_interval = db_reload_interval
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # live bottleneck attribution (obs/attrib.py): on by default
+        # for the server's lifetime — /debug/profile answers "which
+        # lane bounds this fleet" without a restart; TRIVY_TPU_ATTRIB=0
+        # is the kill switch. Refcounted so tests spinning several
+        # servers per process release the span sink on shutdown.
+        from trivy_tpu.obs import attrib
+
+        self._attrib_held = attrib.acquire()
 
     @property
     def address(self) -> str:
@@ -933,6 +992,11 @@ class Server:
     def shutdown(self, drain_timeout: float | None = None):
         if drain_timeout is not None:
             self.drain(drain_timeout)  # idempotent if already draining
+        if self._attrib_held:
+            from trivy_tpu.obs import attrib
+
+            self._attrib_held = False
+            attrib.release()
         self._stop.set()
         if self.service.scheduler is not None:
             # after the drain budget: the scheduler finishes whatever

@@ -254,7 +254,8 @@ class MatchScheduler:
         batch error, exactly like :meth:`submit`)."""
         if not p.queries:
             return []
-        self._await(p)
+        with tracing.span("sched.collect", rows=len(p.queries)):
+            self._await(p)
         if p.error is not None:
             raise p.error
         return p.results

@@ -956,7 +956,12 @@ class SecretScanner:
             else:
                 chunks, segments = chunk_files_packed(contents)
                 collect = self._screen_submit(chunks)
-            hits = collect()
+            from trivy_tpu.obs import tracing
+
+            # device_wait attribution lane: the dispatch-first split
+            # blocks here, after the host share has been scanned
+            with tracing.span("secret.screen", files=nf):
+                hits = collect()
             # flatten segments once; keyword rows hit densely (common
             # words fire in nearly every chunk), so their per-file OR is
             # a sorted reduceat, not a Python loop — only the sparse
