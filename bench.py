@@ -507,6 +507,227 @@ def bench_serving(engine, db) -> dict:
         srv_off.shutdown()
 
 
+def bench_fleet(engine, db) -> dict:
+    """Fleet serving tier (docs/fleet.md): a replica set behind the
+    smart client vs a single server on the same artifact set
+    (images/s, interleaved medians), hedged vs unhedged p99 under an
+    injected slow replica (fleet.endpoint.<i>:delay), and the
+    coordinated advisory-DB rollout wall clock vs the reference's
+    quiesce-the-world refresh — with a zero-diff exit gate
+    (fleet_diff_vs_single)."""
+    import shutil
+    import statistics
+    import tempfile
+    import threading
+
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.db import generations as _generations
+    from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.fleet import rollout as _rollout
+    from trivy_tpu.fleet.endpoints import EndpointSet
+    from trivy_tpu.resilience import faults as _faults
+    from trivy_tpu.rpc import wire as _wire
+    from trivy_tpu.rpc.server import SCAN_PATH, Server
+    from trivy_tpu.tensorize.synth import synth_queries, synth_trivy_db
+    from trivy_tpu.types.scan import ScanOptions
+
+    n_replicas = int(os.environ.get(
+        "TRIVY_TPU_BENCH_FLEET_REPLICAS", "3"))
+    n_clients = int(os.environ.get("TRIVY_TPU_BENCH_FLEET_CLIENTS", "6"))
+    per_client = int(os.environ.get("TRIVY_TPU_BENCH_FLEET_SCANS", "8"))
+    pool = [q for q in synth_queries(db, 40_000, seed=99)
+            if q.space == "npm::"]
+    if not pool:
+        return {}
+    rng = random.Random(9)
+    sizes = [25, 80, 240, 800]
+    cache = MemoryCache()  # the shared cache tier, in miniature
+    artifacts = []
+    for i in range(n_clients * 2):
+        n = sizes[i % len(sizes)]
+        pkgs = []
+        for _ in range(n):
+            q = pool[rng.randrange(len(pool))]
+            pkgs.append({"id": f"{q.name}@{q.version}", "name": q.name,
+                         "version": q.version})
+        key = f"sha256:fleet{i}"
+        cache.put_blob(key, {"schema_version": 2, "applications": [{
+            "type": "npm", "file_path": f"img{i}/package-lock.json",
+            "packages": pkgs}]})
+        artifacts.append((f"img{i}", key))
+
+    servers = [Server(engine, cache, host="localhost", port=0)
+               for _ in range(n_replicas)]
+    for srv in servers:
+        srv.start()
+    addrs = [srv.address for srv in servers]
+
+    def scan_once(es, target, key) -> bytes:
+        return es.post(SCAN_PATH, _wire.scan_request(
+            target, "", [key], ScanOptions()))
+
+    def run_round(es) -> float:
+        errs: list[Exception] = []
+
+        def worker(ci: int):
+            try:
+                for k in range(per_client):
+                    target, key = artifacts[(ci * per_client + k)
+                                            % len(artifacts)]
+                    scan_once(es, target, key)
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errs.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(ci,))
+                   for ci in range(n_clients)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        return n_clients * per_client / (time.time() - t0)
+
+    es_single = EndpointSet([addrs[0]], health_interval_s=0)
+    es_fleet = EndpointSet(addrs, hedge_s=0, health_interval_s=0)
+    try:
+        # zero-diff gate: every artifact byte-identical through the
+        # load-balanced set vs the single server
+        diffs = sum(
+            1 for target, key in artifacts
+            if scan_once(es_fleet, target, key)
+            != scan_once(es_single, target, key))
+
+        run_round(es_single)  # warm (jit shapes, keep-alive sockets)
+        run_round(es_fleet)
+        single_rates, fleet_rates = [], []
+        for _ in range(3):
+            single_rates.append(run_round(es_single))
+            fleet_rates.append(run_round(es_fleet))
+        single_med = statistics.median(single_rates)
+        fleet_med = statistics.median(fleet_rates)
+
+        # hedged vs unhedged tail latency under one slow replica: the
+        # delay only fires on endpoint 0 of each set, so ~1/N of
+        # unhedged scans eat it while a hedged scan races a healthy
+        # replica after the hedge delay
+        slow_s = 0.25
+        hedge_s = 0.04
+        target, key = artifacts[0]
+        _faults.install_spec(f"fleet.endpoint.0:delay={slow_s}")
+        es_unhedged = EndpointSet(addrs, hedge_s=0,
+                                  health_interval_s=0)
+        es_hedged = EndpointSet(addrs, hedge_s=hedge_s,
+                                hedge_budget=1.0, health_interval_s=0)
+        try:
+            oracle_bytes = scan_once(es_single, target, key)
+            hedged_diffs = 0
+            lat: dict = {"unhedged": [], "hedged": []}
+            for _ in range(45):
+                t0 = time.time()
+                scan_once(es_unhedged, target, key)
+                lat["unhedged"].append(time.time() - t0)
+                t0 = time.time()
+                out = scan_once(es_hedged, target, key)
+                lat["hedged"].append(time.time() - t0)
+                if out != oracle_bytes:
+                    hedged_diffs += 1
+            diffs += hedged_diffs
+
+            def p99(xs):
+                return sorted(xs)[min(int(len(xs) * 0.99),
+                                      len(xs) - 1)]
+
+            unhedged_p99 = p99(lat["unhedged"])
+            hedged_p99 = p99(lat["hedged"])
+        finally:
+            _faults.reset()
+            es_unhedged.close()
+            es_hedged.close()
+
+        from trivy_tpu.obs import metrics as _obs
+
+        hedges_won = int(_obs.FLEET_HEDGES.value(outcome="won"))
+    finally:
+        es_single.close()
+        es_fleet.close()
+        for srv in servers:
+            srv.shutdown()
+
+    # --- coordinated rollout wall clock (mini replica cluster) ----------
+    # the reference refreshes hourly by quiescing requests for the whole
+    # swap (BASELINE.md); here every replica serves until the instant
+    # its own guarded swap lands, so the window is the staged sum
+    root = tempfile.mkdtemp(prefix="trivy_tpu_bench_fleet_db_")
+    rollout_detail: dict = {}
+    rollout_servers: list = []
+    try:
+        db1 = synth_trivy_db(n_advisories=4_000)
+        db1.meta.updated_at = "2026-01-01T00:00:00Z"
+        gen1 = os.path.join(_generations.generations_root(root),
+                            "sha256-bench-gen1")
+        db1.save(gen1, compress=False)
+        _generations.promote(root, gen1)
+        eng1 = MatchEngine(db1, use_device=False)
+        rollout_servers = [
+            Server(eng1, MemoryCache(), host="localhost", port=0,
+                   db_path=root, db_reload_interval=3600.0)
+            for _ in range(n_replicas)]
+        for srv in rollout_servers:
+            srv.start()
+        db2 = synth_trivy_db(n_advisories=4_000, seed=5)
+        db2.meta.updated_at = "2026-01-02T00:00:00Z"
+        gen2 = os.path.join(_generations.generations_root(root),
+                            "sha256-bench-gen2")
+        db2.save(gen2, compress=False)
+        _generations.promote(root, gen2)
+        t0 = time.time()
+        report = _rollout.run_rollout(
+            root, [srv.address for srv in rollout_servers])
+        rollout_wall_s = time.time() - t0
+        rollout_detail = {
+            "replicas": n_replicas,
+            "outcome": report.outcome,
+            "wall_s": round(rollout_wall_s, 2),
+            "stages": {s.name: round(s.seconds, 3)
+                       for s in report.stages},
+            "reference_quiesce": "entire refresh window "
+                                 "(BASELINE.md: hourly, requests "
+                                 "quiesced)",
+        }
+    except Exception as exc:  # noqa: BLE001 — bench detail, not a crash
+        rollout_detail = {"error": str(exc)}
+    finally:
+        for srv in rollout_servers:
+            srv.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+    out = {
+        "replicas": n_replicas,
+        "clients": n_clients,
+        "scans_per_client": per_client,
+        "single_images_per_s": round(single_med, 1),
+        "fleet_images_per_s": round(fleet_med, 1),
+        "fleet_vs_single": round(fleet_med / single_med, 2)
+        if single_med else 0.0,
+        "slow_replica_delay_s": slow_s,
+        "hedge_ms": round(hedge_s * 1e3),
+        "unhedged_p99_s": round(unhedged_p99, 3),
+        "hedged_p99_s": round(hedged_p99, 3),
+        "hedge_p99_speedup": round(unhedged_p99 / hedged_p99, 2)
+        if hedged_p99 else 0.0,
+        "hedges_won": hedges_won,
+        "fleet_diff_vs_single": diffs,
+        "rollout": rollout_detail,
+    }
+    if rollout_detail.get("error") or (
+            rollout_detail.get("outcome") not in ("completed", None)):
+        out["error"] = rollout_detail.get(
+            "error", f"rollout {rollout_detail.get('outcome')}")
+    return out
+
+
 def _bench_mesh_child() -> int:
     """Child half of bench_mesh: runs inside a subprocess whose env
     pins an 8-virtual-CPU-device backend (the multichip-dryrun dance),
@@ -1908,6 +2129,13 @@ def main():
     with _trace.span("serving_sched"):
         sched_detail = bench_serving(engine, db)
 
+    # --- fleet serving tier: replica set + hedging + rollout -------------
+    # the smart client over N live replicas (docs/fleet.md): LB zero
+    # diff vs a single server, hedged p99 under a slow replica, and the
+    # staged advisory-DB rollout wall clock (ISSUE 13)
+    with _trace.span("fleet_serving"):
+        fleet_detail = bench_fleet(engine, db)
+
     # --- mesh serving: pod-slice-sharded crawl (BASELINE config #5) ------
     # the production ops/mesh.py path at shard counts {1,2,4,8}, zero
     # diff asserted per count (subprocess with an 8-device CPU mesh)
@@ -2004,6 +2232,7 @@ def main():
         "pipeline": pipe,
         "compile_cache": compile_cache_detail,
         "sched": sched_detail,
+        "fleet": fleet_detail,
         "mesh": mesh_detail,
         "delta": delta_detail,
         "capstone": capstone_detail,
@@ -2031,6 +2260,10 @@ def main():
     if delta_detail.get("error") or delta_detail.get(
             "delta_diff_vs_full", 0):
         return 1  # incremental re-score must equal a from-scratch rescan
+    if fleet_detail.get("error") or fleet_detail.get(
+            "fleet_diff_vs_single", 0):
+        return 1  # the load-balanced/hedged replica set must answer
+        # byte-identically to one server, and the rollout must complete
     if secret_detail.get("finding_diff_vs_host", 0):
         return 1  # every secret rung (packed/batched/hybrid/streaming,
         # at every packing + chunk config) must match the host exactly

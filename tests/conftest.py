@@ -41,6 +41,7 @@ def fake_redis():
     _FakeRedisHandler.store = {}
     _FakeRedisHandler.set_log = []
     _FakeRedisHandler.auth = ""
+    _FakeRedisHandler.expiry = {}
     srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
                                           _FakeRedisHandler)
     srv.daemon_threads = True
@@ -65,7 +66,7 @@ def fake_redis():
 # stay raw; the static with-nesting pass covers those (see the
 # witness.py docstring).
 _WITNESS_MARKERS = ("sched", "fanal", "obs", "durability", "fault",
-                    "mesh", "monitor", "secret")
+                    "mesh", "monitor", "secret", "fleet")
 
 
 @pytest.fixture(autouse=True)

@@ -409,7 +409,7 @@ class TestKnobs:
         assert {"TRIVY_TPU_SCHED", "TRIVY_TPU_PIPELINE",
                 "TRIVY_TPU_ANALYSIS_PIPELINE", "TRIVY_TPU_COMPILE_CACHE",
                 "TRIVY_TPU_SECRET_PROBE", "TRIVY_TPU_MONITOR",
-                "TRIVY_TPU_ATTRIB"} == names
+                "TRIVY_TPU_ATTRIB", "TRIVY_TPU_FLEET"} == names
 
     def test_write_knobs_doc_roundtrip(self, tmp_path, capsys):
         (tmp_path / "trivy_tpu").mkdir()

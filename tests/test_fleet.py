@@ -1,0 +1,780 @@
+"""Fleet serving tier (trivy_tpu/fleet, docs/fleet.md):
+
+- EndpointSet: round-robin LB zero-diff vs a single server, failover
+  on a dropped endpoint, per-replica breakers, hedged requests cutting
+  tail latency under an injected slow replica (budget-capped, first
+  response wins, zero diff)
+- /readyz JSON variant (Accept: application/json) + the legacy text
+  body staying byte-identical (golden)
+- endpoint-aware close/rebuild: a replica removed from the set is
+  retired — sockets closed, no resurrection via stale thread-locals
+- cross-SERVER layer dedupe: distributed redis claims make two live
+  servers sharing the fake-redis cache tier analyze each unique layer
+  once, byte-identical reports
+- coordinated advisory-DB rollout: canary + zero-diff probe set +
+  staged roll; a seeded-bad generation triggers automatic rollback
+  with the fleet serving last-good throughout; the delta re-score
+  runs once fleet-wide, not per-replica
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trivy_tpu.cache.cache import MemoryCache
+from trivy_tpu.db import generations
+from trivy_tpu.db.model import Advisory
+from trivy_tpu.db.store import AdvisoryDB, Metadata
+from trivy_tpu.detector.engine import MatchEngine, PkgQuery
+from trivy_tpu.fleet.endpoints import EndpointSet, split_urls
+from trivy_tpu.fleet.rollout import RolloutError, fleet_status, run_rollout
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.resilience import faults
+from trivy_tpu.rpc import wire
+from trivy_tpu.rpc.client import RemoteCache, RemoteDriver, RPCUnavailable
+from trivy_tpu.rpc.server import SCAN_PATH, ScanService, Server
+from trivy_tpu.tensorize import cache as compile_cache
+from trivy_tpu.types.scan import ScanOptions
+
+pytestmark = pytest.mark.fleet
+
+NPM_BUCKET = "npm::GitHub Security Advisory Npm"
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def adv(vid: str, fixed: str = "2.0.0") -> Advisory:
+    return Advisory(vulnerability_id=vid, fixed_version=fixed,
+                    vulnerable_versions=[f"<{fixed}"])
+
+
+def mk_db(n: int = 6, drop: set | None = None,
+          updated: str = "2026-01-01") -> AdvisoryDB:
+    db = AdvisoryDB()
+    for i in range(n):
+        name = f"pkg{i}"
+        if drop and name in drop:
+            continue
+        db.put_advisory(NPM_BUCKET, name, adv(f"CVE-2024-{i:04d}"))
+    db.meta = Metadata(updated_at=updated)
+    return db
+
+
+def npm_blob(names: list[str], version: str = "1.0.0") -> dict:
+    return {"schema_version": 2, "applications": [{
+        "type": "npm", "file_path": "package-lock.json",
+        "packages": [{"id": f"{n}@{version}", "name": n,
+                      "version": version} for n in names]}]}
+
+
+def scan_bytes(poster, target: str, key: str) -> bytes:
+    body = wire.scan_request(target, "", [key], ScanOptions())
+    return poster.post(SCAN_PATH, body)
+
+
+@pytest.fixture()
+def two_servers(monkeypatch):
+    """Two live replicas sharing one engine + cache (the minimal
+    replica set), plus the artifact both can serve."""
+    engine = MatchEngine(mk_db(), use_device=False)
+    cache = MemoryCache()
+    cache.put_blob("sha256:b1", npm_blob(["pkg0", "pkg3"]))
+    cache.put_blob("sha256:b2", npm_blob(["pkg1"]))
+    servers = [Server(engine, cache, host="localhost", port=0)
+               for _ in range(2)]
+    for s in servers:
+        s.start()
+    yield servers
+    for s in servers:
+        s.shutdown()
+
+
+# ======================================================== endpoint set
+
+
+def test_split_urls():
+    assert split_urls("http://a:1, http://b:2 ,") == \
+        ["http://a:1", "http://b:2"]
+
+
+def test_lb_round_robin_zero_diff(two_servers):
+    addrs = [s.address for s in two_servers]
+    single = EndpointSet([addrs[0]], health_interval_s=0)
+    es = EndpointSet(addrs, hedge_s=0, health_interval_s=0)
+    try:
+        oracle = scan_bytes(single, "img1", "sha256:b1")
+        for _ in range(6):
+            assert scan_bytes(es, "img1", "sha256:b1") == oracle
+        # both replicas actually served traffic
+        assert all(s.service.metrics.scans_total >= 3
+                   for s in two_servers)
+    finally:
+        single.close()
+        es.close()
+
+
+def test_failover_on_dropped_endpoint(two_servers):
+    addrs = [s.address for s in two_servers]
+    base_failovers = obs_metrics.FLEET_FAILOVERS.value()
+    faults.install_spec("fleet.endpoint.0:drop")
+    es = EndpointSet(addrs, hedge_s=0, health_interval_s=0)
+    try:
+        single = EndpointSet([addrs[1]], health_interval_s=0)
+        oracle = scan_bytes(single, "img1", "sha256:b1")
+        single.close()
+        for _ in range(6):
+            assert scan_bytes(es, "img1", "sha256:b1") == oracle
+        assert obs_metrics.FLEET_FAILOVERS.value() > base_failovers
+        # the drop fires before the wire: replica 0 never saw a scan
+        assert two_servers[0].service.metrics.scans_total == 0
+        # repeated failures opened replica 0's breaker, so the picker
+        # now skips it without burning an attempt
+        ep0 = es._live()[0]
+        assert ep0.breaker.state == "open"
+    finally:
+        es.close()
+
+
+def test_hedged_requests_cut_tail_latency(two_servers):
+    """fleet.endpoint.0:delay makes replica 0 slow on every dispatch;
+    a hedged set answers fast (the race goes to replica 1) at zero
+    diff, while the unhedged set eats the delay whenever round-robin
+    lands on replica 0."""
+    addrs = [s.address for s in two_servers]
+    single = EndpointSet([addrs[1]], health_interval_s=0)
+    oracle = scan_bytes(single, "img1", "sha256:b1")
+    single.close()
+    won0 = obs_metrics.FLEET_HEDGES.value(outcome="won")
+
+    faults.install_spec("fleet.endpoint.0:delay=0.5")
+    hedged = EndpointSet(addrs, hedge_s=0.05, hedge_budget=1.0,
+                         health_interval_s=0)
+    unhedged = EndpointSet(addrs, hedge_s=0, health_interval_s=0)
+    try:
+        slow = 0
+        for _ in range(6):
+            t0 = time.monotonic()
+            assert scan_bytes(hedged, "img1", "sha256:b1") == oracle
+            assert time.monotonic() - t0 < 0.45  # never eats the delay
+        for _ in range(4):
+            t0 = time.monotonic()
+            assert scan_bytes(unhedged, "img1", "sha256:b1") == oracle
+            if time.monotonic() - t0 >= 0.45:
+                slow += 1
+        assert slow >= 1  # round-robin hit the slow replica unhedged
+        assert obs_metrics.FLEET_HEDGES.value(outcome="won") > won0
+    finally:
+        faults.reset()
+        hedged.close()
+        unhedged.close()
+
+
+def test_hedge_budget_denies(two_servers):
+    addrs = [s.address for s in two_servers]
+    denied0 = obs_metrics.FLEET_HEDGES.value(outcome="denied")
+    faults.install_spec("fleet.endpoint.0:delay=0.3")
+    es = EndpointSet(addrs, hedge_s=0.02, hedge_budget=0.0,
+                     health_interval_s=0)
+    try:
+        hit_delay = 0
+        for _ in range(4):
+            t0 = time.monotonic()
+            scan_bytes(es, "img1", "sha256:b1")
+            if time.monotonic() - t0 >= 0.28:
+                hit_delay += 1
+        assert hit_delay >= 1  # zero budget: the delay is eaten
+        assert obs_metrics.FLEET_HEDGES.value(outcome="denied") \
+            > denied0
+    finally:
+        faults.reset()
+        es.close()
+
+
+def test_endpoint_retire_no_resurrection(two_servers):
+    """Satellite: a replica removed from the set cannot leak sockets
+    or be resurrected by a stale thread-local."""
+    addrs = [s.address for s in two_servers]
+    es = EndpointSet(addrs, hedge_s=0, health_interval_s=0)
+    try:
+        for _ in range(4):  # both endpoints get a keep-alive socket
+            scan_bytes(es, "img1", "sha256:b1")
+        ep0 = es._live()[0]
+        assert ep0.conn._all_conns  # live socket on the calling thread
+        before = two_servers[0].service.metrics.scans_total
+        es.set_endpoints([addrs[1]])
+        assert ep0.removed and ep0.conn._retired
+        assert not ep0.conn._all_conns  # sockets torn down
+        # this very thread still holds ep0's conn in its thread-local;
+        # a direct request on it must fail, not quietly reopen
+        with pytest.raises(RPCUnavailable):
+            ep0.conn.post_once(SCAN_PATH, wire.scan_request(
+                "img1", "", ["sha256:b1"], ScanOptions()))
+        for _ in range(4):  # the set keeps serving from replica 1
+            scan_bytes(es, "img1", "sha256:b1")
+        assert two_servers[0].service.metrics.scans_total == before
+    finally:
+        es.close()
+
+
+def test_remote_driver_accepts_replica_set(two_servers):
+    addrs = [s.address for s in two_servers]
+    fleet_driver = RemoteDriver(",".join(addrs))
+    single_driver = RemoteDriver(addrs[0])
+    r1, os1 = fleet_driver.scan("img1", "", ["sha256:b1"],
+                                ScanOptions())
+    r2, os2 = single_driver.scan("img1", "", ["sha256:b1"],
+                                 ScanOptions())
+    assert wire.scan_response(r1, os1) == wire.scan_response(r2, os2)
+    # default-configured clients share the pooled set per (urls, token)
+    assert RemoteCache(",".join(addrs)).conn is fleet_driver.conn
+    fleet_driver.close()
+    single_driver.close()
+
+
+# ============================================================= readyz
+
+
+def test_readyz_text_golden_and_json(two_servers):
+    from trivy_tpu.secret.scanner import reset_hybrid_probe
+
+    reset_hybrid_probe()
+    addr = two_servers[0].address
+    svc = two_servers[0].service
+    # legacy text body: byte-identical to the pre-fleet rendering
+    with urllib.request.urlopen(addr + "/readyz", timeout=10) as r:
+        text = r.read()
+        ctype = r.headers.get("Content-Type")
+    assert text == b"ok"  # golden: no JSON leaked into the text body
+    assert text.decode() == svc.ready()[1]
+    assert "text/plain" in ctype
+    # JSON variant under Accept
+    req = urllib.request.Request(
+        addr + "/readyz", headers={"Accept": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        doc = json.loads(r.read())
+        jtype = r.headers.get("Content-Type")
+    assert "application/json" in jtype
+    assert doc["ready"] is True
+    assert doc["status"] == svc.ready()[1]  # no drift between bodies
+    assert doc["draining"] is False
+    assert doc["generation"] is None  # no generation-managed DB root
+    assert doc["monitor"] is False
+
+
+def test_readyz_json_not_ready_when_draining(two_servers):
+    srv = two_servers[1]
+    srv.service.start_drain()
+    req = urllib.request.Request(
+        srv.address + "/readyz", headers={"Accept": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=10)
+    assert exc.value.code == 503
+    with exc.value:
+        doc = json.loads(exc.value.read())
+    assert doc["ready"] is False and doc["draining"] is True
+
+
+# ========================================== cross-server layer dedupe
+
+
+def _redis_service(url, monkeypatch):
+    from trivy_tpu.cache.redis import RedisCache
+
+    monkeypatch.setenv("TRIVY_TPU_SCHED", "0")
+    return ScanService(None, RedisCache(url))
+
+
+def test_redis_gate_selected_and_kill_switch(fake_redis, monkeypatch):
+    from trivy_tpu.fanal.pipeline import LayerSingleflight
+    from trivy_tpu.fleet.dedupe import RedisLayerGate
+
+    svc = _redis_service(fake_redis, monkeypatch)
+    assert isinstance(svc.layer_gate, RedisLayerGate)
+    monkeypatch.setenv("TRIVY_TPU_FLEET", "0")
+    svc2 = _redis_service(fake_redis, monkeypatch)
+    assert isinstance(svc2.layer_gate, LayerSingleflight)
+    # a plain cache never gets the distributed gate
+    monkeypatch.delenv("TRIVY_TPU_FLEET")
+    svc3 = ScanService(None, MemoryCache())
+    assert isinstance(svc3.layer_gate, LayerSingleflight)
+
+
+def test_cross_server_gate_waits_and_dedupes(fake_redis, monkeypatch):
+    """Two ScanServices (distinct servers) sharing the redis cache
+    tier: server B's client parks on server A's client's in-flight
+    layer, then drops it from its missing set once the PutBlob lands
+    — the trivy_tpu_layer_dedupe_* metrics count the cross-server
+    wait and hit."""
+    svc_a = _redis_service(fake_redis, monkeypatch)
+    svc_b = _redis_service(fake_redis, monkeypatch)
+    hits0 = obs_metrics.LAYER_DEDUPE_HITS.value()
+    waits0 = obs_metrics.LAYER_DEDUPE_INFLIGHT_WAITS.value()
+    followers0 = obs_metrics.FLEET_DEDUPE_CLAIMS.value(
+        outcome="follower")
+
+    assert svc_a.filter_inflight_blobs(["b1"]) == ["b1"]  # A leads
+    got: dict = {}
+
+    def client_b():
+        got["missing"] = svc_b.filter_inflight_blobs(["b1", "b2"])
+
+    t = threading.Thread(target=client_b)
+    t.start()
+    time.sleep(0.2)
+    svc_a.cache.put_blob("b1", {"schema_version": 2})
+    svc_a.layer_gate.complete("b1")
+    t.join(timeout=30)
+    assert got["missing"] == ["b2"]  # b1 deduped ACROSS servers
+    assert obs_metrics.LAYER_DEDUPE_HITS.value() == hits0 + 1
+    assert obs_metrics.LAYER_DEDUPE_INFLIGHT_WAITS.value() \
+        == waits0 + 1
+    assert obs_metrics.FLEET_DEDUPE_CLAIMS.value(outcome="follower") \
+        > followers0
+    svc_b.layer_gate.complete("b2")
+
+
+def test_cross_server_gate_dead_leader_failure_ladder(
+        fake_redis, monkeypatch):
+    from trivy_tpu.fanal import pipeline as fanal_pipeline
+    from trivy_tpu.fleet.dedupe import RedisLayerGate
+
+    svc_a = _redis_service(fake_redis, monkeypatch)
+    svc_b = _redis_service(fake_redis, monkeypatch)
+    monkeypatch.setattr(fanal_pipeline, "SERVER_WAIT_BUDGET_S", 0.2)
+    assert svc_a.filter_inflight_blobs(["b1"]) == ["b1"]
+    # leader dies (never completes): B times out, reclaims, analyzes
+    t0 = time.monotonic()
+    assert svc_b.filter_inflight_blobs(["b1"]) == ["b1"]
+    assert time.monotonic() - t0 < 5.0
+    # the reclaim is in redis: a third server parks on B's claim now
+    gate_c = RedisLayerGate(svc_a.cache, ttl_s=60.0)
+    _slot, leader = gate_c.claim("b1", holder="other-scan")
+    assert not leader
+    # retried request (same holder identity) re-leads its own claim
+    assert svc_a.filter_inflight_blobs(["b9"], holder="t1") == ["b9"]
+    t0 = time.monotonic()
+    assert svc_b.filter_inflight_blobs(["b9"], holder="t1") == ["b9"]
+    assert time.monotonic() - t0 < 0.15  # no self-wait
+    svc_b.layer_gate.complete("b1")
+    svc_b.layer_gate.complete("b9")
+
+
+def test_two_live_servers_exactly_once_e2e(fake_redis, monkeypatch,
+                                           tmp_path):
+    """The satellite end-to-end: two live Servers sharing the
+    fake-redis backend, two concurrent clients scanning overlapping
+    images through DIFFERENT servers — the shared base layer is
+    analyzed exactly once fleet-wide and the blob documents are
+    byte-identical to a serial single-cache oracle."""
+    from test_analysis_pipeline import _mk_registry
+
+    from trivy_tpu.artifact.image import ImageArtifact
+    from trivy_tpu.cache.redis import RedisCache
+
+    monkeypatch.setenv("TRIVY_TPU_SCHED", "0")
+    imgs = _mk_registry(tmp_path, 2)
+
+    # both "clients" live in THIS process, so the in-process
+    # singleflight would dedupe them on its own; stub it to always
+    # lead so exactly-once can only come from the shared redis tier's
+    # distributed claims (the thing under test)
+    from trivy_tpu.fanal import pipeline as fanal_pipeline
+
+    class _AlwaysLead:
+        def claim(self, blob_id, src_cache=None, holder=None):
+            return fanal_pipeline._Slot(src_cache), True
+
+        def finish(self, blob_id, slot, doc=None, ok=False):
+            slot.done, slot.ok, slot.doc = True, ok, doc
+            slot.event.set()
+
+    monkeypatch.setattr(fanal_pipeline, "SINGLEFLIGHT", _AlwaysLead())
+
+    # serial oracle: each image into its own private cache
+    oracle_docs = {}
+    for p in imgs:
+        c = MemoryCache()
+        ref = ImageArtifact(p, c, from_tar=True).inspect()
+        for bid in ref.blob_ids:
+            oracle_docs[bid] = json.dumps(c.get_blob(bid),
+                                          sort_keys=True)
+
+    servers = [Server(None, RedisCache(fake_redis), host="localhost",
+                      port=0) for _ in range(2)]
+    for s in servers:
+        s.start()
+    analyzed0 = obs_metrics.LAYERS_ANALYZED.value()
+    errs: list = []
+    barrier = threading.Barrier(2)
+
+    def scan(img_path: str, addr: str):
+        try:
+            cache = RemoteCache(addr)
+            barrier.wait(timeout=10)
+            ImageArtifact(img_path, cache, from_tar=True).inspect()
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errs.append(exc)
+
+    try:
+        threads = [
+            threading.Thread(target=scan,
+                             args=(imgs[i], servers[i].address))
+            for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs
+        # 2 images x (shared base + unique app): exactly 3 analyses
+        assert obs_metrics.LAYERS_ANALYZED.value() - analyzed0 == 3
+        # blob ids are content-addressed cache keys: the shared base
+        # layer collapses to ONE of the three
+        assert len(oracle_docs) == 3
+        # the shared tier holds byte-identical docs to the oracle
+        reader = RedisCache(fake_redis)
+        for bid, want in oracle_docs.items():
+            assert json.dumps(reader.get_blob(bid),
+                              sort_keys=True) == want
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+# ============================================================ rollout
+
+
+def _gen_dir(root: str, name: str) -> str:
+    return os.path.join(generations.generations_root(root), name)
+
+
+def _install_gen(root: str, name: str, db: AdvisoryDB) -> str:
+    gen = _gen_dir(root, name)
+    db.save(gen)
+    generations.promote(root, gen)
+    return gen
+
+
+class FleetEnv:
+    """N live replicas over one generation-managed DB root + shared
+    cache, with per-replica monitor indexes and probe blobs."""
+
+    def __init__(self, tmp_path, n: int = 2, monitor: bool = True):
+        self.root = str(tmp_path / "db")
+        self.db1 = mk_db()
+        _install_gen(self.root, "sha256-g1", self.db1)
+        self.d1 = compile_cache.db_digest(self.root)
+        self.cache = MemoryCache()
+        # probe artifact (pkg1: untouched by the refreshes below) and
+        # a monitored artifact (pkg0: dropped by the good refresh)
+        self.cache.put_blob("sha256:probe", npm_blob(["pkg1"]))
+        self.cache.put_blob("sha256:mon", npm_blob(["pkg0"]))
+        self.engine = MatchEngine(self.db1, use_device=False)
+        self.servers = []
+        for i in range(n):
+            self.servers.append(Server(
+                self.engine, self.cache, host="localhost", port=0,
+                db_path=self.root, db_reload_interval=3600.0,
+                monitor_index=(str(tmp_path / f"idx{i}.jsonl")
+                               if monitor else None)))
+        for s in self.servers:
+            s.start()
+        if monitor:
+            # per-replica index slices, the real fleet shape: replica i
+            # recorded the scans IT served (img-mon<i> holding pkg<3i>)
+            for i, s in enumerate(self.servers):
+                pname = f"pkg{i * 3}"
+                qs = [PkgQuery("npm::", pname, "1.0.0", "npm")]
+                keys = self.engine.match_keys([qs])[0]
+                s.service.monitor.index.update(
+                    f"img-mon{i}", [("npm::", pname, "1.0.0", "npm")],
+                    keys, db_digest=self.d1)
+                s.service.monitor.index.set_state(self.d1)
+
+    @property
+    def addrs(self) -> list[str]:
+        return [s.address for s in self.servers]
+
+    @property
+    def probe(self) -> dict:
+        return {"target": "probe", "artifact_id": "",
+                "blob_ids": ["sha256:probe"], "options": {}}
+
+    def serving(self) -> list[str]:
+        return [s.get("generation")
+                for s in fleet_status(self.addrs)]
+
+    def scan_all(self, key: str = "sha256:probe") -> list[bytes]:
+        out = []
+        for addr in self.addrs:
+            es = EndpointSet([addr], health_interval_s=0)
+            try:
+                out.append(scan_bytes(es, "t", key))
+            finally:
+                es.close()
+        return out
+
+    def shutdown(self):
+        for s in self.servers:
+            s.shutdown()
+
+
+def test_rollout_completed_with_fleet_wide_rescore_once(tmp_path):
+    env = FleetEnv(tmp_path, n=2)
+    try:
+        before = env.scan_all()
+        assert env.serving() == ["sha256-g1", "sha256-g1"]
+        # the hourly refresh lands: the advisories backing each
+        # replica's journaled slice are withdrawn (pkg1 — the probe's
+        # package — stays untouched)
+        _install_gen(env.root, "sha256-g2",
+                     mk_db(drop={"pkg0", "pkg3"}, updated="2026-01-02"))
+        report = run_rollout(env.root, env.addrs,
+                             probes=[env.probe])
+        assert report.outcome == "completed"
+        assert report.target == "sha256-g2"
+        assert report.previous == "sha256-g1"
+        assert report.probe_diffs == 0
+        assert env.serving() == ["sha256-g2", "sha256-g2"]
+        # the probe artifact (untouched advisory) is byte-identical
+        # across the swap and across replicas
+        after = env.scan_all()
+        assert after == before and after[0] == after[1]
+        # pkg0's finding resolved identically on every replica
+        mon = env.scan_all("sha256:mon")
+        assert mon[0] == mon[1]
+        assert b"CVE-2024-0000" not in mon[0]
+        # ONE refresh re-scored the whole fleet's journaled artifacts
+        # once each: every monitor replica consumed its parked swap
+        # over its own disjoint index slice — each artifact's event
+        # appears exactly once, in its own replica's ring, and no
+        # re-score ran before the fleet had fully rolled
+        assert report.rescored_on == env.addrs
+        want = {0: ("img-mon0", "CVE-2024-0000"),
+                1: ("img-mon1", "CVE-2024-0003")}
+        for i, (artifact, vuln) in want.items():
+            deadline = time.monotonic() + 30.0
+            events = []
+            while time.monotonic() < deadline:
+                _nxt, events = \
+                    env.servers[i].service.monitor.events_since(0)
+                if events:
+                    break
+                time.sleep(0.05)
+            assert [(e["artifact"], e["vuln_id"], e["event"])
+                    for e in events] == [(artifact, vuln, "resolved")]
+        # nothing left parked: a second trigger is a no-op
+        for s in env.servers:
+            assert s.service.trigger_pending_rescore()["rescored"] \
+                is False
+    finally:
+        env.shutdown()
+
+
+def test_rollout_rejected_candidate_rolls_back(tmp_path):
+    """A seeded-bad generation (empty DB = fails the server's own
+    validation) stops at the canary: the fleet serves last-good
+    throughout, the bad generation is quarantined, nothing else
+    reloads."""
+    env = FleetEnv(tmp_path, n=2, monitor=False)
+    try:
+        before = env.scan_all()
+        bad = AdvisoryDB()
+        bad.meta = Metadata(updated_at="2026-01-03")
+        bad_dir = _install_gen(env.root, "sha256-bad", bad)
+
+        stop = threading.Event()
+        scan_errs: list = []
+
+        def background_scans():
+            # the fleet must keep serving DURING the whole episode
+            es = EndpointSet(env.addrs, hedge_s=0,
+                             health_interval_s=0)
+            try:
+                while not stop.is_set():
+                    if scan_bytes(es, "t", "sha256:probe") != before[0]:
+                        scan_errs.append("diff")
+                    time.sleep(0.01)
+            except Exception as exc:  # noqa: BLE001 — asserted below
+                scan_errs.append(exc)
+            finally:
+                es.close()
+
+        t = threading.Thread(target=background_scans)
+        t.start()
+        try:
+            report = run_rollout(env.root, env.addrs)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert not scan_errs
+        assert report.outcome == "rolled_back"
+        assert env.serving() == ["sha256-g1", "sha256-g1"]
+        assert env.scan_all() == before
+        assert not os.path.isdir(bad_dir)  # quarantined
+        assert generations.is_quarantined(env.root, "sha256-bad")
+        # last-good points back at g1: a fresh reader loads last-good
+        assert os.path.basename(os.path.realpath(
+            generations.last_good_path(env.root))) == "sha256-g1"
+    finally:
+        env.shutdown()
+
+
+def test_rollout_probe_diff_rolls_back(tmp_path):
+    """A loadable-but-wrong generation (drops the PROBE artifact's
+    advisory) passes the server's validation but diverges on the probe
+    set: the canary is rolled back, the reference replica never
+    swaps."""
+    env = FleetEnv(tmp_path, n=2, monitor=False)
+    try:
+        before = env.scan_all()
+        _install_gen(env.root, "sha256-wrong",
+                     mk_db(drop={"pkg1"}, updated="2026-01-04"))
+        report = run_rollout(env.root, env.addrs,
+                             probes=[env.probe])
+        assert report.outcome == "rolled_back"
+        assert report.probe_diffs == 1
+        assert env.serving() == ["sha256-g1", "sha256-g1"]
+        assert env.scan_all() == before
+        assert generations.is_quarantined(env.root, "sha256-wrong")
+    finally:
+        env.shutdown()
+
+
+@pytest.mark.fault
+def test_rollout_roll_stage_failure_rolls_back(tmp_path):
+    """fleet.rollout:error at the roll stage: the canary has already
+    swapped — the rollback ladder reloads it back so the fleet
+    converges on the previous generation."""
+    env = FleetEnv(tmp_path, n=3, monitor=False)
+    try:
+        _install_gen(env.root, "sha256-g2",
+                     mk_db(drop={"pkg0"}, updated="2026-01-02"))
+        # stage fires: plan@1, canary@2, roll@3 (first non-canary)
+        faults.install_spec("fleet.rollout:error@3")
+        report = run_rollout(env.root, env.addrs)
+        faults.reset()
+        assert report.outcome == "rolled_back"
+        assert env.serving() == ["sha256-g1"] * 3
+        # a controller-level failure does NOT quarantine the target:
+        # the operator re-promotes and the re-run completes
+        assert not generations.is_quarantined(env.root, "sha256-g2")
+        generations.promote(env.root, _gen_dir(env.root, "sha256-g2"))
+        report2 = run_rollout(env.root, env.addrs)
+        assert report2.outcome == "completed"
+        assert env.serving() == ["sha256-g2"] * 3
+    finally:
+        env.shutdown()
+
+
+def test_rollout_noop_and_not_ready(tmp_path):
+    env = FleetEnv(tmp_path, n=2, monitor=False)
+    try:
+        report = run_rollout(env.root, env.addrs)
+        assert report.outcome == "noop"
+        env.servers[1].service.start_drain()
+        with pytest.raises(RolloutError, match="not ready"):
+            run_rollout(env.root, env.addrs)
+    finally:
+        env.shutdown()
+
+
+def test_pending_rescore_consumed_once(tmp_path):
+    """maybe_reload_db(rescore=False) parks the delta re-score; the
+    /fleet/rescore trigger consumes it exactly once."""
+    env = FleetEnv(tmp_path, n=1)
+    try:
+        svc = env.servers[0].service
+        _install_gen(env.root, "sha256-g2",
+                     mk_db(drop={"pkg0"}, updated="2026-01-02"))
+        assert svc.maybe_reload_db(rescore=False) is True
+        assert svc.monitor.events_since(0) == (0, [])  # parked
+        assert svc._pending_rescore is not None
+        out = svc.trigger_pending_rescore()
+        assert out == {"rescored": True}
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            _nxt, events = svc.monitor.events_since(0)
+            if events:
+                break
+            time.sleep(0.05)
+        assert events
+        out2 = svc.trigger_pending_rescore()
+        assert out2["rescored"] is False
+        assert "no pending swap" in out2["reason"]
+    finally:
+        env.shutdown()
+
+
+def test_fleet_reload_endpoint_token_gated(tmp_path):
+    """The /fleet/* control surface honors the server token like the
+    scan/cache POSTs."""
+    db = mk_db()
+    srv = Server(MatchEngine(db, use_device=False), MemoryCache(),
+                 host="localhost", port=0, token="s3cret")
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            srv.address + "/fleet/reload", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 401
+        req.add_header("Trivy-Token", "s3cret")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["reloaded"] is False  # no db_path: nothing to do
+    finally:
+        srv.shutdown()
+
+
+def test_fleet_cli_status_and_rollout(tmp_path, capsys):
+    """The operator loop through the real CLI: `trivy-tpu fleet
+    status` then `trivy-tpu fleet rollout` with a probe set and a
+    report file."""
+    from trivy_tpu.cli.main import main as cli_main
+
+    env = FleetEnv(tmp_path, n=2, monitor=False)
+    try:
+        rc = cli_main(["--quiet", "fleet", "status",
+                       ",".join(env.addrs)])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert len(out) == 2 and all(s["ready"] for s in out)
+        _install_gen(env.root, "sha256-g2",
+                     mk_db(drop={"pkg0"}, updated="2026-01-02"))
+        probes_file = tmp_path / "probes.json"
+        probes_file.write_text(json.dumps([env.probe]))
+        report_file = tmp_path / "report.json"
+        rc = cli_main(["--quiet", "fleet", "rollout",
+                       ",".join(env.addrs),
+                       "--db-path", env.root,
+                       "--probes", str(probes_file),
+                       "--output", str(report_file)])
+        assert rc == 0
+        doc = json.loads(report_file.read_text())
+        assert doc["outcome"] == "completed"
+        assert doc["probes"] == 1 and doc["probe_diffs"] == 0
+        assert env.serving() == ["sha256-g2", "sha256-g2"]
+    finally:
+        env.shutdown()
+
+
+def test_fleet_status_cli_shape(two_servers):
+    status = fleet_status([s.address for s in two_servers])
+    assert len(status) == 2
+    assert all(s["ready"] for s in status)
+    assert all("endpoint" in s and "status" in s for s in status)
+    dead = fleet_status(["http://127.0.0.1:1"])
+    assert dead[0]["ready"] is False

@@ -20,6 +20,11 @@ class _FakeRedisHandler(socketserver.StreamRequestHandler):
     store: dict = {}
     set_log: list = []
     auth: str = ""
+    expiry: dict = {}  # key -> unix deadline (SET ... EX n)
+    # SET NX must be atomic across the server's handler threads (real
+    # redis is single-threaded; the fleet's distributed claims rely on
+    # exactly-one-winner semantics)
+    store_lock = threading.Lock()
 
     def handle(self):
         authed = not self.auth
@@ -82,15 +87,45 @@ class _FakeRedisHandler(socketserver.StreamRequestHandler):
     def _cmd_select(self, args):
         self._ok()
 
+    def _purge(self, *keys):
+        import time as _time
+
+        now = _time.time()
+        for k in (keys or list(self.expiry)):
+            if self.expiry.get(k, now + 1) <= now:
+                self.store.pop(k, None)
+                self.expiry.pop(k, None)
+
     def _cmd_set(self, args):
-        self.store[args[1]] = args[2]
-        self.set_log.append(args[1])
+        # real-redis SET options subset: NX (only if absent), XX (only
+        # if present), EX <s> — what the fleet's distributed layer
+        # claims (trivy_tpu/fleet/dedupe.py) rely on
+        key = args[1]
+        with self.store_lock:
+            self._purge(key)
+            opts = [a.decode().upper() for a in args[3:]]
+            exists = key in self.store
+            if ("NX" in opts and exists) or ("XX" in opts
+                                             and not exists):
+                self._bulk(None)
+                return
+            self.store[key] = args[2]
+            self.set_log.append(key)
+            if "EX" in opts:
+                import time as _time
+
+                self.expiry[key] = _time.time() + int(
+                    opts[opts.index("EX") + 1])
+            else:
+                self.expiry.pop(key, None)
         self._ok()
 
     def _cmd_get(self, args):
+        self._purge(args[1])
         self._bulk(self.store.get(args[1]))
 
     def _cmd_exists(self, args):
+        self._purge(*args[1:])
         self._int(sum(1 for k in args[1:] if k in self.store))
 
     def _cmd_del(self, args):
@@ -115,6 +150,7 @@ def fake_redis():
     _FakeRedisHandler.store = {}
     _FakeRedisHandler.set_log = []
     _FakeRedisHandler.auth = ""
+    _FakeRedisHandler.expiry = {}
     srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
                                           _FakeRedisHandler)
     srv.daemon_threads = True

@@ -95,6 +95,23 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TRIVY_TPU_SECRET_STREAM_CHUNK_MB", "4", "secret", False,
          "Streaming secret-scan chunk MiB for files over 10 MiB "
          "(floor 64 KiB; same as --secret-stream-chunk-mb)."),
+    # --- fleet serving tier
+    Knob("TRIVY_TPU_FLEET", "1", "fleet", True,
+         "Fleet smart-client + cache-tier features; 0 pins multi-URL "
+         "clients to their first endpoint through the exact "
+         "single-server path and keeps the in-process layer gate on "
+         "redis caches."),
+    Knob("TRIVY_TPU_FLEET_HEDGE_MS", "75", "fleet", False,
+         "Hedge delay: milliseconds a scan may sit unanswered on its "
+         "primary replica before the same request is raced on a "
+         "second one (first response wins, zero diff); 0 disables "
+         "hedging."),
+    Knob("TRIVY_TPU_FLEET_HEDGE_BUDGET", "0.1", "fleet", False,
+         "Max fraction of requests allowed to hedge (bounds the "
+         "duplicate-work cost of a uniformly slow fleet)."),
+    Knob("TRIVY_TPU_FLEET_HEALTH_INTERVAL_S", "5", "fleet", False,
+         "Period of the smart client's background /readyz (JSON) "
+         "health prober over the endpoint set."),
     # --- RPC
     Knob("TRIVY_TPU_RPC_GZIP_MIN", "8192", "rpc", False,
          "Minimum body size in bytes before the negotiated gzip wire "
@@ -200,6 +217,12 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TRIVY_TPU_BENCH_CAPSTONE_CHILD", "", "bench", False,
          "Internal: set on the 8-virtual-device subprocess the "
          "capstone bench spawns."),
+    Knob("TRIVY_TPU_BENCH_FLEET_REPLICAS", "3", "bench", False,
+         "Replica-set size for the fleet-serving bench."),
+    Knob("TRIVY_TPU_BENCH_FLEET_CLIENTS", "6", "bench", False,
+         "Concurrent smart clients in the fleet-serving bench."),
+    Knob("TRIVY_TPU_BENCH_FLEET_SCANS", "8", "bench", False,
+         "Scans per client in the fleet-serving bench."),
 )
 
 

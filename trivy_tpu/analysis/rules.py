@@ -344,9 +344,11 @@ class FaultSiteRule(Rule):
         "nothing tests. faults.SITES is the single source of truth.")
 
     FAULT_FNS = {"fire", "check_kill", "check_device", "mangle_write"}
-    # site families synthesized at runtime by faults.rpc_site(), never
-    # appearing as code literals
-    DYNAMIC_FAMILIES = {"rpc", "rpc.scan", "rpc.cache"}
+    # site families synthesized at runtime (faults.rpc_site();
+    # fleet.endpoint.<index> per replica), never appearing as code
+    # literals
+    DYNAMIC_FAMILIES = {"rpc", "rpc.scan", "rpc.cache",
+                        "fleet.endpoint"}
     DOC = "docs/resilience.md"
 
     def _used_sites(self, project: Project):

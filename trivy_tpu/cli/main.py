@@ -132,7 +132,11 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
                         "watch` advisory-delta re-scoring "
                         "(docs/monitoring.md)")
     p.add_argument("--server", default=None,
-                   help="scan server URL (client mode)")
+                   help="scan server URL (client mode); a comma-"
+                        "separated list names a replica set served "
+                        "through the fleet smart client (client-side "
+                        "load balancing, failover, hedged requests — "
+                        "docs/fleet.md)")
     p.add_argument("--token", default=None, help="server auth token")
     p.add_argument("--cache-backend", default="fs",
                    help="cache backend: fs, memory, or redis://host:port")
@@ -382,6 +386,47 @@ def build_parser() -> argparse.ArgumentParser:
                         "'auto', or 'off'; env TRIVY_TPU_MESH)")
 
     p = sub.add_parser(
+        "fleet", help="fleet administration: replica status and the "
+        "coordinated advisory-DB rollout (canary, zero-diff probe "
+        "set, staged roll, automatic rollback — docs/fleet.md)",
+        allow_abbrev=False)
+    _add_global_flags(p)
+    flsub = p.add_subparsers(dest="fleet_command")
+    pfs = flsub.add_parser(
+        "status", help="JSON /readyz of every replica (ready state, "
+        "serving generation, mesh/secret-probe notes)",
+        allow_abbrev=False)
+    _add_global_flags(pfs)
+    pfs.add_argument("endpoints",
+                     help="comma-separated replica URLs")
+    pfs.add_argument("--token", default=None, help="server auth token")
+    pfr = flsub.add_parser(
+        "rollout", help="staged fleet-wide advisory-DB hot swap: "
+        "canary first, probe set replayed for zero diff, then roll, "
+        "rollback on regression; the delta re-score triggers on "
+        "exactly one replica", allow_abbrev=False)
+    _add_global_flags(pfr)
+    pfr.add_argument("endpoints",
+                     help="comma-separated replica URLs")
+    pfr.add_argument("--db-path", required=True,
+                     help="shared advisory-DB root (the staged+promoted "
+                          "generation under it is the rollout target)")
+    pfr.add_argument("--token", default=None, help="server auth token")
+    pfr.add_argument("--probes", default=None, metavar="FILE",
+                     help="probe set: JSON (array or lines) of "
+                          "captured scan requests replayed against the "
+                          "canary vs the serving fleet; any byte diff "
+                          "rolls back")
+    pfr.add_argument("--canary", default=None, metavar="URL",
+                     help="replica to roll first (default: the first "
+                          "endpoint still behind)")
+    pfr.add_argument("--no-rescore", action="store_true",
+                     help="skip triggering the advisory-delta "
+                          "re-score after the roll")
+    pfr.add_argument("--output", "-o", default=None,
+                     help="write the rollout report JSON here")
+
+    p = sub.add_parser(
         "profile", help="fetch a live server's bottleneck attribution "
         "(/debug/profile): per-resource-lane occupancy, critical-path "
         "shares, the roofline verdict, and the slow-scan flight "
@@ -519,7 +564,7 @@ def main(argv: list[str] | None = None) -> int:
     known = {"image", "filesystem", "fs", "rootfs", "repository", "repo",
              "sbom", "vm", "kubernetes", "k8s", "convert", "server", "db",
              "clean", "config", "version", "registry", "plugin", "module",
-             "lint", "watch", "profile"}
+             "lint", "watch", "profile", "fleet"}
     if argv and not argv[0].startswith("-") and argv[0] not in known:
         from trivy_tpu.plugin import PluginManager
 
@@ -583,6 +628,8 @@ def main(argv: list[str] | None = None) -> int:
             return run.run_watch(args)
         if args.command == "profile":
             return run.run_profile(args)
+        if args.command == "fleet":
+            return run.run_fleet_admin(args)
         if args.command == "db":
             return run.run_db(args)
         if args.command == "clean":

@@ -1032,6 +1032,51 @@ def run_watch(args) -> int:
             out.close()
 
 
+def run_fleet_admin(args) -> int:
+    """`trivy-tpu fleet status|rollout` (docs/fleet.md): replica-set
+    health and the coordinated advisory-DB rollout controller."""
+    import json as _json
+    import sys
+
+    from trivy_tpu.fleet import rollout as rollout_mod
+    from trivy_tpu.fleet.endpoints import split_urls
+
+    _validate_fault_spec()
+    cmd = getattr(args, "fleet_command", None)
+    if cmd is None:
+        raise FatalError("fleet: choose a subcommand (status, rollout)")
+    endpoints = split_urls(getattr(args, "endpoints", "") or "")
+    if not endpoints:
+        raise FatalError("fleet: no endpoints given")
+    token = getattr(args, "token", None)
+    if cmd == "status":
+        status = rollout_mod.fleet_status(endpoints, token=token)
+        print(_json.dumps(status, indent=2, sort_keys=True))
+        return 0 if all(s.get("ready") for s in status) else 1
+    if cmd != "rollout":
+        raise FatalError(f"fleet: unknown subcommand {cmd!r}")
+    probes = None
+    if getattr(args, "probes", None):
+        probes = rollout_mod.load_probes(args.probes)
+    try:
+        report = rollout_mod.run_rollout(
+            _db_path(args), endpoints, token=token, probes=probes,
+            rescore=not getattr(args, "no_rescore", False),
+            canary=getattr(args, "canary", None),
+            on_event=lambda ev: print(
+                _json.dumps(ev, sort_keys=True), file=sys.stderr))
+    except rollout_mod.RolloutError as e:
+        raise FatalError(f"fleet rollout: {e}")
+    doc = report.doc()
+    out = _json.dumps(doc, indent=2, sort_keys=True)
+    if getattr(args, "output", None):
+        # lint: allow[atomic-write] user-requested report stream (--output), partial file is visible to the user
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(out + "\n")
+    print(out)
+    return 0 if report.outcome in ("completed", "noop") else 1
+
+
 def run_profile(args) -> int:
     """`trivy-tpu profile URL`: render a live server's bottleneck
     attribution (docs/observability.md "Attribution & profiling") —

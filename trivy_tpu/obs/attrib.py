@@ -82,6 +82,8 @@ SPAN_LANES = {
     "engine.dispatch": "device_dispatch",
     "engine.shard": "device_wait",
     "secret.screen": "device_wait",
+    "fleet.hedge": "fetch_io",
+    "fleet.probe": "fetch_io",
     "report": "report",
 }
 
@@ -100,6 +102,7 @@ SPAN_STRUCTURAL = {
     "monitor.promote",
     "watch.rescore",
     "delta.rematch",
+    "fleet.rollout",
 }
 
 # dynamic span families (f-string names) -> lane, matched by prefix

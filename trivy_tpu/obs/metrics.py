@@ -581,6 +581,46 @@ TRACE_SPANS_DROPPED = REGISTRY.counter(
     "Collected trace spans evicted by the bounded root-trace buffer "
     "(a long-running server with tracing on keeps the newest "
     "MAX_BUFFERED_ROOTS traces; the Chrome export notes this count)")
+FLEET_REQUESTS = REGISTRY.counter(
+    "trivy_tpu_fleet_requests_total",
+    "Requests dispatched per fleet endpoint (the smart client's "
+    "load-balanced + hedged dispatches; endpoint = index within the "
+    "set, stable across membership changes)",
+    labels=("endpoint",))
+FLEET_FAILOVERS = REGISTRY.counter(
+    "trivy_tpu_fleet_failovers_total",
+    "Requests retried on a different replica after a transport-level "
+    "failure on the first choice")
+FLEET_HEDGES = REGISTRY.counter(
+    "trivy_tpu_fleet_hedges_total",
+    "Hedged scan dispatches by outcome: won (the hedge's response was "
+    "used), lost (the primary answered first after all), denied (the "
+    "hedge budget refused to fire one)",
+    labels=("outcome",))
+FLEET_ENDPOINT_HEALTH = REGISTRY.gauge(
+    "trivy_tpu_fleet_endpoint_healthy",
+    "Per-endpoint health from the /readyz JSON prober (1 ready, "
+    "0 not ready/unreachable/removed)",
+    labels=("endpoint",))
+FLEET_DEDUPE_CLAIMS = REGISTRY.counter(
+    "trivy_tpu_fleet_dedupe_claims_total",
+    "Distributed (redis-backed) layer-claim outcomes across the "
+    "replica set: leader (this server's client analyzes), follower "
+    "(parked on another server's in-flight analysis), expired "
+    "(took over a dead leader's claim), reclaim (waiter timeout "
+    "takeover)",
+    labels=("outcome",))
+FLEET_ROLLOUTS = REGISTRY.counter(
+    "trivy_tpu_fleet_rollouts_total",
+    "Coordinated advisory-DB rollouts by outcome (completed, "
+    "rolled_back, noop)",
+    labels=("outcome",))
+FLEET_ROLLOUT_STAGE_SECONDS = REGISTRY.histogram(
+    "trivy_tpu_fleet_rollout_stage_seconds",
+    "Wall seconds per rollout stage (plan, canary, probe, roll, "
+    "rescore, rollback) — the sum is the fleet's refresh window, vs "
+    "the reference's full-fleet quiesce",
+    labels=("stage",))
 ATTRIB_LANE_SECONDS = REGISTRY.counter(
     "trivy_tpu_attrib_lane_seconds_total",
     "Resource-lane attribution seconds accumulated from completed "

@@ -81,6 +81,8 @@ SITES: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("engine.shard", ("drop", "delay", "error", "device-lost")),
     ("sched.submit", ("drop", "delay", "error")),
     ("secret.device", ("drop", "delay", "error", "device-lost")),
+    ("fleet.endpoint", ("drop", "timeout", "delay", "error")),
+    ("fleet.rollout", ("delay", "error", "kill")),
     ("analysis.fetch", ("drop", "delay", "error", "kill")),
     ("fleet.scan", ("kill",)),
     ("journal.append", ("kill", "torn-write", "bitflip")),

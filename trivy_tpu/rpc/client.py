@@ -7,7 +7,11 @@ the server's cache. Transport is a persistent keep-alive
 http.client.HTTPConnection per thread (fleet lanes each hold their own
 socket), so a fleet run pays TCP connect + handshake once per lane
 instead of once per scan; a stale keep-alive socket (server closed it
-idle) is rebuilt transparently. Transient failures retry under a
+idle) is rebuilt transparently. Both clients accept a comma-separated
+URL naming a replica SET: routing then goes through
+trivy_tpu/fleet/endpoints.py EndpointSet (client-side load balancing,
+per-replica circuit breakers, failover, hedged requests —
+docs/fleet.md); a single URL keeps the exact single-server path. Transient failures retry under a
 RetryPolicy with decorrelated jitter; 503 responses honor Retry-After;
 the ambient per-scan deadline budget (resilience.retry.deadline_scope)
 rides the X-Trivy-Deadline header and bounds both the per-request
@@ -53,6 +57,13 @@ class RPCError(Exception):
     pass
 
 
+class RPCUnavailable(RPCError):
+    """Transport-level / retries-exhausted failure: the endpoint did
+    not produce a definite answer. Distinct from a deterministic 4xx
+    RPCError so the fleet EndpointSet knows a failover to another
+    replica may still succeed (docs/fleet.md)."""
+
+
 class _Conn:
     def __init__(self, url: str, token: str | None = None,
                  custom_headers: dict | None = None, timeout: float = 300.0,
@@ -82,6 +93,10 @@ class _Conn:
         # (which implements proxy routing); keep-alive sockets are for
         # direct connections only
         self._via_proxy = self._proxy_configured()
+        # a retired conn belongs to an endpoint REMOVED from its fleet
+        # set: it refuses new requests so a stale thread-local cannot
+        # resurrect the replica (docs/fleet.md)
+        self._retired = False
 
     def _proxy_configured(self) -> bool:
         proxies = urllib.request.getproxies()
@@ -158,6 +173,16 @@ class _Conn:
                 pass
         self._tls.conn = None
 
+    def retire(self) -> None:
+        """Endpoint-aware teardown: this conn's endpoint left the
+        fleet set. Every thread's keep-alive socket is closed (busy
+        ones right after their in-flight round trip via the deferred
+        path), and any LATER request on this conn — e.g. from a thread
+        still holding it in a thread-local — fails instead of quietly
+        reopening a socket to the removed replica."""
+        self._retired = True
+        self.close()
+
     def _request_once(self, path: str, body: bytes, headers: dict,
                       timeout: float):
         """One HTTP round trip on this thread's keep-alive connection.
@@ -166,6 +191,10 @@ class _Conn:
         and resent ONCE transparently, so the retry policy only ever
         sees real failures; timeouts are never transparently resent
         (the deadline budget owns those)."""
+        if self._retired:
+            raise RPCUnavailable(
+                f"endpoint {self.base} retired (removed from its "
+                "endpoint set)")
         if self._via_proxy:
             return self._request_via_urllib(path, body, headers, timeout)
         reused = getattr(self._tls, "conn", None) is not None \
@@ -237,7 +266,18 @@ class _Conn:
         with tracing.span(f"rpc.{method}", url=self.base):
             return self._post_attempts(path, method, body)
 
-    def _post_attempts(self, path: str, method: str, body: bytes) -> bytes:
+    def post_once(self, path: str, body: bytes) -> bytes:
+        """Single-attempt post: the fleet EndpointSet drives its own
+        failover loop ACROSS endpoints, so the per-endpoint retry loop
+        collapses to one attempt (the stale-keep-alive rebuild inside
+        _request_once still applies — it is transport plumbing, not a
+        retry)."""
+        method = path.rsplit("/", 1)[-1]
+        with tracing.span(f"rpc.{method}", url=self.base):
+            return self._post_attempts(path, method, body, attempts=1)
+
+    def _post_attempts(self, path: str, method: str, body: bytes,
+                       attempts: int | None = None) -> bytes:
         # the extended-fidelity internal encoding is marked so the server
         # can tell it apart from reference Twirp clients on the same paths
         headers = {"Content-Type": "application/json",
@@ -248,11 +288,12 @@ class _Conn:
             headers["Trivy-Token"] = self.token
         tracing.inject_headers(headers)
         policy = self.retry
+        attempts = policy.attempts if attempts is None else attempts
         deadline = current_deadline()
         delays = policy.delays(self._rng)
         site = faults.rpc_site(path)
         last_err: Exception | None = None
-        for attempt in range(policy.attempts):
+        for attempt in range(attempts):
             if deadline is not None and deadline.expired:
                 raise DeadlineExceeded(
                     f"rpc to {self.base}{path}: deadline of "
@@ -342,7 +383,7 @@ class _Conn:
             except (urllib.error.URLError, http.client.HTTPException,
                     OSError, TimeoutError) as exc:
                 last_err = exc
-            if attempt < policy.attempts - 1:
+            if attempt < attempts - 1:
                 delay = next(delays)
                 if retry_after is not None:
                     # the server told us when it expects to recover;
@@ -356,42 +397,52 @@ class _Conn:
                         budget_s=deadline.budget_s)
                 obs_metrics.RETRY_ATTEMPTS.inc(method=method)
                 policy.sleep(delay)
-        raise RPCError(
-            f"rpc to {self.base}{path} failed after {policy.attempts} "
+        raise RPCUnavailable(
+            f"rpc to {self.base}{path} failed after {attempts} "
             f"attempts: {last_err}")
 
 
-# process-wide _Conn pool keyed by (url, token) for default-configured
-# clients: the CLI builds a fresh RemoteDriver + RemoteCache per
-# artifact (fleet runs: per lane-slot), and without sharing, each would
-# open its own sockets — the pool makes "TCP connect once per lane,
-# not once per scan" actually hold. Custom retry policies or headers
-# opt out (tests and special callers keep private connections).
-_CONN_POOL: dict[tuple, _Conn] = {}
+# process-wide EndpointSet pool keyed by (urls, token) for default-
+# configured clients: the CLI builds a fresh RemoteDriver + RemoteCache
+# per artifact (fleet runs: per lane-slot), and without sharing, each
+# would open its own sockets — the pool makes "TCP connect once per
+# lane, not once per scan" actually hold. A single-URL set routes
+# through its one _Conn byte-identically to the pre-fleet client; a
+# comma-separated URL becomes a replica set with client-side LB,
+# failover, and hedging (trivy_tpu/fleet/endpoints.py). Custom retry
+# policies or headers opt out (tests and special callers keep private
+# connections).
+_CONN_POOL: dict[tuple, object] = {}
 _CONN_POOL_LOCK = make_lock("rpc.client._CONN_POOL_LOCK")
 
 
-def _pooled_conn(url: str, token: str | None,
-                 custom_headers: dict | None,
-                 retry: RetryPolicy | None) -> _Conn:
+def _pooled_set(url: str, token: str | None,
+                custom_headers: dict | None,
+                retry: RetryPolicy | None):
+    from trivy_tpu.fleet.endpoints import EndpointSet, split_urls
+
+    urls = tuple(u.rstrip("/") for u in split_urls(url))
     if retry is not None or custom_headers:
-        return _Conn(url, token, custom_headers, retry=retry)
-    key = (url.rstrip("/"), token)
+        return EndpointSet(list(urls), token, custom_headers,
+                           retry=retry)
+    key = (urls, token)
     with _CONN_POOL_LOCK:
         c = _CONN_POOL.get(key)
         if c is None:
-            c = _CONN_POOL[key] = _Conn(url, token)
+            c = _CONN_POOL[key] = EndpointSet(list(urls), token)
         return c
 
 
 class RemoteDriver:
     """Driver implementation that ships the scan to a server
-    (reference pkg/rpc/client/client.go:48-73)."""
+    (reference pkg/rpc/client/client.go:48-73). `url` may name a whole
+    replica set (comma-separated) — requests then load-balance with
+    failover and hedged tail-latency dispatch (docs/fleet.md)."""
 
     def __init__(self, url: str, token: str | None = None,
                  custom_headers: dict | None = None,
                  retry: RetryPolicy | None = None):
-        self.conn = _pooled_conn(url, token, custom_headers, retry)
+        self.conn = _pooled_set(url, token, custom_headers, retry)
 
     def scan(self, target, artifact_key, blob_keys, options):
         body = wire.scan_request(target, artifact_key, blob_keys, options)
@@ -409,7 +460,7 @@ class RemoteCache:
     def __init__(self, url: str, token: str | None = None,
                  custom_headers: dict | None = None,
                  retry: RetryPolicy | None = None):
-        self.conn = _pooled_conn(url, token, custom_headers, retry)
+        self.conn = _pooled_set(url, token, custom_headers, retry)
 
     def put_artifact(self, artifact_id: str, info) -> None:
         self.conn.post(CACHE_PREFIX + "PutArtifact", wire.encode(

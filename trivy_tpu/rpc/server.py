@@ -246,6 +246,20 @@ class ScanService:
 
         self.layer_gate = _analysis.LayerSingleflight(
             ttl_s=_analysis.SERVER_CLAIM_TTL_S)
+        # fleet tier: when the server cache is the shared redis
+        # backend, layer claims live in redis too, so clients of
+        # DIFFERENT replicas dedupe against each other — each unique
+        # layer is analyzed once fleet-wide (docs/fleet.md).
+        # TRIVY_TPU_FLEET=0 keeps the in-process gate.
+        from trivy_tpu import fleet as _fleet
+
+        if _fleet.enabled():
+            from trivy_tpu.fleet.dedupe import maybe_distributed_gate
+
+            gate = maybe_distributed_gate(
+                cache, ttl_s=_analysis.SERVER_CLAIM_TTL_S)
+            if gate is not None:
+                self.layer_gate = gate
         from trivy_tpu import sched as _sched
 
         self.scheduler = None
@@ -274,6 +288,16 @@ class ScanService:
         # so the promote hook knows the delta's old side.
         self.monitor = None
         self._db_digest: str | None = None
+        # coordinated fleet rollout (docs/fleet.md): a hot swap driven
+        # with rescore=False parks its delta re-score here; the rollout
+        # controller consumes it via POST /fleet/rescore after the
+        # whole fleet has rolled. The reload mutex serializes the
+        # check-load-swap of maybe_reload_db — it is reachable from
+        # arbitrary /fleet/reload handler threads AND the hourly
+        # poller, and two racing reloads would double-build engines
+        # and clobber the parked-rescore invariant.
+        self._pending_rescore: tuple | None = None
+        self._reload_lock = make_lock("rpc.server._reload_lock")
         if monitor_index and db_path:
             from trivy_tpu import monitor as monitor_mod
 
@@ -381,6 +405,58 @@ class ScanService:
             return True, (f"ok (serving last-good: {self.db_degraded})"
                           + mesh_note)
         return True, "ok" + mesh_note
+
+    def generation_name(self) -> str | None:
+        """Name of the advisory-DB generation the live engine serves
+        (``sha256-<hex>``), or None on a flat/unmanaged DB root. Cheap
+        (a path basename), so fleet health probes can poll it."""
+        d = self._active_db_dir
+        return os.path.basename(d) if self._is_generation(d) else None
+
+    def ready_doc(self) -> dict:
+        """Machine-parseable readiness (the ``Accept:
+        application/json`` variant of /readyz): everything the text
+        body says, as structured fields, plus the serving generation —
+        what the fleet health prober and the rollout controller consume
+        instead of string-matching the text (docs/fleet.md). The text
+        body itself stays byte-identical for legacy probes."""
+        ok, why = self.ready()
+        doc = {
+            "ready": ok,
+            "status": why,
+            "draining": self.draining,
+            "serving_last_good": self.db_degraded,
+            "generation": self.generation_name(),
+            "monitor": self.monitor is not None,
+        }
+        health_fn = getattr(self.engine, "shard_health", None)
+        health = health_fn() if callable(health_fn) else None
+        if health:
+            doc["mesh"] = {"shape": health["shape"],
+                           "degraded": list(health["degraded"])}
+        from trivy_tpu.secret.scanner import hybrid_probe_state
+
+        probe = hybrid_probe_state()
+        if probe is not None:
+            doc["secret_probe"] = "device" if probe["device"] else "host"
+        return doc
+
+    def trigger_pending_rescore(self) -> dict:
+        """Consume the re-score a rescore=False hot swap parked: after
+        the whole fleet has rolled, the rollout controller calls this
+        on each monitor-enabled replica — every replica re-scores its
+        OWN journaled slice exactly once, instead of N uncoordinated
+        mid-rollout sweeps against mixed generations."""
+        with self._reload_lock:
+            pending, self._pending_rescore = self._pending_rescore, None
+        if self.monitor is None:
+            return {"rescored": False,
+                    "reason": "monitor not enabled (--monitor-index)"}
+        if pending is None:
+            return {"rescored": False, "reason": "no pending swap"}
+        old_digest, db, new_digest = pending
+        self.monitor.on_promote(old_digest, db, new_digest)
+        return {"rescored": True}
 
     def begin_scan(self) -> None:
         """Admission control: refused while draining (503 + Retry-After
@@ -558,7 +634,7 @@ class ScanService:
 
         return validate_db(db)
 
-    def maybe_reload_db(self) -> bool:
+    def maybe_reload_db(self, rescore: bool = True) -> bool:
         """Hot-swap the engine when the DB *metadata* changed (a new
         UpdatedAt/Version), not merely a file timestamp.
 
@@ -567,7 +643,15 @@ class ScanService:
         or validate is never served — the server keeps the engine it
         has (last-good), quarantines the corrupt generation when the
         root is generation-managed, and remembers the rejected identity
-        so the reload worker doesn't retry the same bad bytes forever."""
+        so the reload worker doesn't retry the same bad bytes forever.
+
+        ``rescore=False`` (the fleet rollout controller's reload) parks
+        the monitor's delta re-score instead of running it — the
+        controller triggers it via /fleet/rescore after the roll."""
+        with self._reload_lock:
+            return self._maybe_reload_db_locked(rescore)
+
+    def _maybe_reload_db_locked(self, rescore: bool) -> bool:
         state = self._db_identity()
         if not self.db_path or not state or state == self._db_state \
                 or state == self._rejected_db_state:
@@ -646,11 +730,17 @@ class ScanService:
             time.perf_counter() - reload_start)
         _log.info("advisory DB hot-swapped", **db.stats())
         if self.monitor is not None:
-            # continuous monitoring: the promote triggers an advisory-
-            # delta re-score in the background (docs/monitoring.md) —
-            # affected journaled artifacts re-match and the introduced/
-            # resolved finding events land on /monitor/events
-            self.monitor.on_promote(old_digest, db, new_digest)
+            if rescore:
+                # continuous monitoring: the promote triggers an
+                # advisory-delta re-score in the background
+                # (docs/monitoring.md) — affected journaled artifacts
+                # re-match and the introduced/resolved finding events
+                # land on /monitor/events
+                self.monitor.on_promote(old_digest, db, new_digest)
+            else:
+                # fleet rollout: the controller decides which replica
+                # re-scores, once, after the whole fleet has rolled
+                self._pending_rescore = (old_digest, db, new_digest)
         return True
 
 
@@ -767,6 +857,20 @@ def _make_handler(service: ScanService, token: str | None,
             if self.path == "/healthz":
                 self._reply(200, b"ok", "text/plain")
             elif self.path == "/readyz":
+                accept = self.headers.get("Accept") or ""
+                if "application/json" in accept:
+                    # machine-parseable variant (fleet health prober /
+                    # rollout controller): same verdict as the text
+                    # body, structured, plus the serving generation.
+                    # 503-when-not-ready semantics are identical.
+                    doc = service.ready_doc()
+                    body = json.dumps(doc).encode()
+                    if doc["ready"]:
+                        self._reply(200, body)
+                    else:
+                        self._reply(503, body, extra_headers={
+                            "Retry-After": "1"})
+                    return
                 ok, why = service.ready()
                 if ok:
                     self._reply(200, why.encode(), "text/plain")
@@ -835,6 +939,8 @@ def _make_handler(service: ScanService, token: str | None,
                     self._handle_scan(body)
                 elif self.path.startswith(CACHE_PREFIX):
                     self._handle_cache(self.path[len(CACHE_PREFIX):], body)
+                elif self.path.startswith("/fleet/"):
+                    self._handle_fleet(self.path[len("/fleet/"):], body)
                 else:
                     self._error(404, "not found")
             except (json.JSONDecodeError, KeyError, TypeError) as exc:
@@ -867,6 +973,35 @@ def _make_handler(service: ScanService, token: str | None,
                     self._shed(str(exc), 1.0)
                     return
             self._reply(200, wire.scan_response(results, os_found))
+
+        def _handle_fleet(self, method: str, body: bytes):
+            """Fleet-rollout control surface (docs/fleet.md), token-
+            gated like the scan/cache POSTs:
+
+            - ``reload``  — run one maybe_reload_db pass NOW (the
+              controller's staged hot swap; the hourly poller stays as
+              the standalone path). Body: {"rescore": bool} — False
+              parks the monitor's delta re-score for /fleet/rescore.
+            - ``rescore`` — trigger the parked delta re-score (the
+              controller calls this per monitor-enabled replica, once
+              the whole fleet serves the new generation).
+            """
+            if method == "reload":
+                doc = json.loads(body) if body else {}
+                changed = service.maybe_reload_db(
+                    rescore=bool(doc.get("rescore", True)))
+                self._reply(200, json.dumps({
+                    "reloaded": changed,
+                    "serving": service.generation_name(),
+                    "degraded": service.db_degraded,
+                }).encode())
+            elif method == "rescore":
+                self._reply(200,
+                            json.dumps(
+                                service.trigger_pending_rescore()
+                            ).encode())
+            else:
+                self._error(404, f"unknown fleet method {method}")
 
         def _handle_cache(self, method: str, body: bytes):
             doc = json.loads(body) if body else {}
