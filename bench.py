@@ -728,6 +728,166 @@ def bench_fleet(engine, db) -> dict:
     return out
 
 
+def bench_fleetobs() -> dict:
+    """Federation rung of the fleet bench (docs/fleet.md "Fleet
+    observability control plane"): scrape-and-merge wall time for a
+    3-replica set, the federated-sum invariant (fleet counter totals
+    == sum of per-replica scrapes), a hedged-scan stitch with the
+    zero-orphan-root gate, and the <2% disabled-overhead guard for
+    fleet event emission. Written to BENCH_fleetobs.json."""
+    import statistics
+
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.fleet import slo as _slo
+    from trivy_tpu.fleet import telemetry as _telemetry
+    from trivy_tpu.fleet.endpoints import EndpointSet
+    from trivy_tpu.obs import attrib as _attrib
+    from trivy_tpu.obs import tracing as _tracing
+    from trivy_tpu.resilience import faults as _faults
+    from trivy_tpu.rpc import wire as _wire
+    from trivy_tpu.rpc.server import SCAN_PATH, Server
+    from trivy_tpu.tensorize.synth import synth_queries, synth_trivy_db
+    from trivy_tpu.types.scan import ScanOptions
+
+    n_replicas = 3
+    db = synth_trivy_db(n_advisories=4_000)
+    engine = MatchEngine(db, use_device=False)
+    pool = [q for q in synth_queries(db, 10_000, seed=7)
+            if q.space == "npm::"]
+    cache = MemoryCache()
+    rng = random.Random(3)
+    artifacts = []
+    for i in range(6):
+        pkgs = []
+        for _ in range(120):
+            q = pool[rng.randrange(len(pool))]
+            pkgs.append({"id": f"{q.name}@{q.version}", "name": q.name,
+                         "version": q.version})
+        key = f"sha256:fo{i}"
+        cache.put_blob(key, {"schema_version": 2, "applications": [{
+            "type": "npm", "file_path": f"img{i}/package-lock.json",
+            "packages": pkgs}]})
+        artifacts.append((f"img{i}", key))
+
+    servers = [Server(engine, cache, host="localhost", port=0)
+               for _ in range(n_replicas)]
+    for srv in servers:
+        srv.start()
+    addrs = [srv.address for srv in servers]
+    out: dict = {"replicas": n_replicas}
+    try:
+        es = EndpointSet(addrs, hedge_s=0, health_interval_s=0)
+        scan_walls = []
+        try:
+            for _ in range(2):  # every replica serves (round-robin)
+                for target, key in artifacts:
+                    t0 = time.time()
+                    es.post(SCAN_PATH, _wire.scan_request(
+                        target, "", [key], ScanOptions()))
+                    scan_walls.append(time.time() - t0)
+        finally:
+            es.close()
+        scan_wall = statistics.median(scan_walls)
+
+        # --- scrape-and-merge wall + the federated-sum invariant -----
+        walls = []
+        fed = None
+        for _ in range(5):
+            t0 = time.time()
+            fed = _telemetry.federate_endpoints(addrs)
+            fed.render()
+            walls.append(time.time() - t0)
+        per_replica_scans = sum(
+            srv.service.metrics.scans_total for srv in servers)
+        fed_scans = fed.total("trivy_tpu_scans_total")
+        out["federation"] = {
+            "scrape_merge_wall_s_median": round(
+                statistics.median(walls), 4),
+            "series_merged": len(fed.totals),
+            "federated_scans_total": int(fed_scans),
+            "per_replica_scans_sum": int(per_replica_scans),
+        }
+        out["federation_sum_diff"] = int(
+            abs(fed_scans - per_replica_scans))
+
+        # --- hedged-scan stitch: zero orphan roots -------------------
+        _attrib.AGG.reset()
+        _faults.install_spec("fleet.endpoint.0:delay=0.2")
+        hedged = EndpointSet(addrs, hedge_s=0.02, hedge_budget=1.0,
+                             health_interval_s=0)
+        try:
+            target, key = artifacts[0]
+            with _tracing.span("scan_artifact"):
+                hedged.post(SCAN_PATH, _wire.scan_request(
+                    target, "", [key], ScanOptions()))
+            time.sleep(0.4)  # the losing attempt finishes + closes
+        finally:
+            _faults.reset()
+            hedged.close()
+        doc = _attrib.AGG.flight.chrome_doc()
+        stitched = _telemetry.stitch_flight(
+            [(a, doc) for a in addrs])
+        out["stitch"] = stitched["stitch"]
+        out["stitch_orphan_roots"] = stitched["stitch"]["orphan_roots"]
+    finally:
+        for srv in servers:
+            srv.shutdown()
+
+    # --- disabled-overhead guard for event emission ------------------
+    # mirror of the witness/tracing guards: the kill-switched
+    # emit_event call must stay a near-free env check. Min-of-k
+    # interleaved against an empty-body callable (identical call
+    # shape), then expressed as a per-scan percentage over the emit
+    # sites a scan's fleet dispatch can touch.
+    def noop(kind, **fields):
+        return None
+
+    n_calls = 50_000
+
+    def timed(fn) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            fn("hedge", outcome="won")
+        return time.perf_counter() - t0
+
+    old = os.environ.get("TRIVY_TPU_FLEET_EVENTS")
+    os.environ["TRIVY_TPU_FLEET_EVENTS"] = "0"
+    try:
+        timed(noop), timed(_slo.emit_event)  # warm
+        noop_t, disabled_t = [], []
+        for i in range(8):
+            if i % 2 == 0:
+                noop_t.append(timed(noop))
+                disabled_t.append(timed(_slo.emit_event))
+            else:
+                disabled_t.append(timed(_slo.emit_event))
+                noop_t.append(timed(noop))
+        disabled_ns = min(disabled_t) / n_calls * 1e9
+        noop_ns = min(noop_t) / n_calls * 1e9
+    finally:
+        if old is None:
+            os.environ.pop("TRIVY_TPU_FLEET_EVENTS", None)
+        else:
+            os.environ["TRIVY_TPU_FLEET_EVENTS"] = old
+    # a fleet dispatch touches at most ~4 emit sites (failover, hedge,
+    # breaker x2); the guard bounds their DISABLED cost vs the scan
+    emit_sites_per_scan = 4
+    overhead_pct = (max(disabled_ns - noop_ns, 0.0) * emit_sites_per_scan
+                    / (scan_wall * 1e9) * 100.0)
+    out["event_overhead"] = {
+        "disabled_ns_per_call": round(disabled_ns, 1),
+        "noop_ns_per_call": round(noop_ns, 1),
+        "median_scan_wall_ms": round(scan_wall * 1e3, 2),
+        "per_scan_overhead_pct": round(overhead_pct, 4),
+        "ok": overhead_pct < 2.0,
+    }
+    if out["federation_sum_diff"] or out["stitch_orphan_roots"] \
+            or not out["event_overhead"]["ok"]:
+        out["error"] = "fleetobs gate failed"
+    return out
+
+
 def _bench_mesh_child() -> int:
     """Child half of bench_mesh: runs inside a subprocess whose env
     pins an 8-virtual-CPU-device backend (the multichip-dryrun dance),
@@ -1886,6 +2046,20 @@ def main():
         return _bench_mesh_child()
     if os.environ.get("TRIVY_TPU_BENCH_CAPSTONE_CHILD"):
         return _bench_capstone_child()
+    if "--fleetobs" in sys.argv:
+        # standalone federation rung (CPU-only, no device probe): the
+        # quick way to refresh BENCH_fleetobs.json
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        detail = bench_fleetobs()
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_fleetobs.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(detail, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(detail, indent=2, sort_keys=True))
+        return 1 if detail.get("error") else 0
     phase_json = _phase_json_path()
     if not os.environ.get("TRIVY_TPU_BENCH_CHILD"):
         lint_rc = _lint_gate()
@@ -2136,6 +2310,22 @@ def main():
     with _trace.span("fleet_serving"):
         fleet_detail = bench_fleet(engine, db)
 
+    # --- fleet observability: federation + stitch + event overhead -------
+    # scrape-and-merge wall for 3 replicas, federated-sum invariant,
+    # hedged-scan stitch (zero orphan roots), <2% disabled-overhead
+    # guard for event emission — also written to BENCH_fleetobs.json
+    with _trace.span("fleet_observability"):
+        fleetobs_detail = bench_fleetobs()
+    fleetobs_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_fleetobs.json")
+    try:
+        with open(fleetobs_path, "w", encoding="utf-8") as f:
+            json.dump(fleetobs_detail, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError as exc:
+        print(f"BENCH_STATUS=fleetobs_report_unwritable {exc}",
+              file=sys.stderr)
+
     # --- mesh serving: pod-slice-sharded crawl (BASELINE config #5) ------
     # the production ops/mesh.py path at shard counts {1,2,4,8}, zero
     # diff asserted per count (subprocess with an 8-device CPU mesh)
@@ -2233,6 +2423,7 @@ def main():
         "compile_cache": compile_cache_detail,
         "sched": sched_detail,
         "fleet": fleet_detail,
+        "fleetobs": fleetobs_detail,
         "mesh": mesh_detail,
         "delta": delta_detail,
         "capstone": capstone_detail,
@@ -2264,6 +2455,10 @@ def main():
             "fleet_diff_vs_single", 0):
         return 1  # the load-balanced/hedged replica set must answer
         # byte-identically to one server, and the rollout must complete
+    if fleetobs_detail.get("error"):
+        return 1  # federated counter totals must equal the sum of the
+        # per-replica scrapes, a stitched hedge trace must leave zero
+        # orphan roots, and kill-switched event emission must stay free
     if secret_detail.get("finding_diff_vs_host", 0):
         return 1  # every secret rung (packed/batched/hybrid/streaming,
         # at every packing + chunk config) must match the host exactly

@@ -286,6 +286,46 @@ class TestRuleFixtures:
         }
         assert run_rule(project, "span-taxonomy") == []
 
+    def test_event_kind_fires(self, tmp_path):
+        project = make_project(
+            tmp_path,
+            {"fleet2/ops.py": (
+                "from trivy_tpu.fleet.slo import emit_event\n"
+                "KIND = 'const_kind'\n"
+                "def f(kind):\n"
+                "    emit_event('rogue_kind', endpoint='x')\n"
+                "    emit_event(KIND)\n"
+                "    emit_event(kind)\n")},
+            docs={"docs/fleet.md": (
+                "| Kind | One record means |\n"
+                "|---|---|\n"
+                "| `const_kind` | declared and emitted |\n"
+                "| `phantom_kind` | documented but undeclared |\n")})
+        project.declared_event_kinds = [
+            ("const_kind", "d"), ("ghost_kind", "d")]
+        found = run_rule(project, "event-kind")
+        msgs = "\n".join(f.message for f in found)
+        assert "'rogue_kind' emitted here but not declared" in msgs
+        assert "'const_kind'" not in msgs  # const-resolved + declared
+        assert "emit_event() with a computed kind" in msgs
+        assert ("'ghost_kind' declared in EVENTS but no code emits"
+                in msgs)
+        assert ("'ghost_kind' absent from the docs/fleet.md event "
+                "catalog") in msgs
+        assert ("catalogs event kind 'phantom_kind' but "
+                "fleet.slo.EVENTS does not declare it") in msgs
+
+    def test_event_kind_clean_mini_tree(self, tmp_path):
+        project = make_project(
+            tmp_path,
+            {"fleet2/ops.py": (
+                "from trivy_tpu.fleet.slo import emit_event\n"
+                "def f():\n"
+                "    emit_event('good_kind', endpoint='x')\n")},
+            docs={"docs/fleet.md": "| `good_kind` | all three ways |\n"})
+        project.declared_event_kinds = [("good_kind", "d")]
+        assert run_rule(project, "event-kind") == []
+
     def test_bare_except_fires(self, tmp_path):
         project = make_project(tmp_path, {
             "x/handlers.py": (
@@ -409,7 +449,8 @@ class TestKnobs:
         assert {"TRIVY_TPU_SCHED", "TRIVY_TPU_PIPELINE",
                 "TRIVY_TPU_ANALYSIS_PIPELINE", "TRIVY_TPU_COMPILE_CACHE",
                 "TRIVY_TPU_SECRET_PROBE", "TRIVY_TPU_MONITOR",
-                "TRIVY_TPU_ATTRIB", "TRIVY_TPU_FLEET"} == names
+                "TRIVY_TPU_ATTRIB", "TRIVY_TPU_FLEET",
+                "TRIVY_TPU_FLEET_EVENTS"} == names
 
     def test_write_knobs_doc_roundtrip(self, tmp_path, capsys):
         (tmp_path / "trivy_tpu").mkdir()

@@ -1,0 +1,599 @@
+"""Fleet observability control plane (trivy_tpu/fleet/telemetry.py +
+fleet/slo.py, docs/fleet.md "Fleet observability control plane"):
+
+- metrics federation: federated counter totals provably equal the sum
+  of the per-replica scrapes, histogram buckets merge bound-for-bound,
+  gauges are never summed, exemplars survive, and the single-server
+  legacy exposition stays untouched
+- cross-replica trace stitching: a hedged scan under an injected slow
+  replica yields ONE stitched Chrome trace containing both replicas'
+  spans with the losing attempt marked cancelled and zero orphan roots
+- hedge-loser trace hygiene: the losing attempt leaves no orphan root
+  trace and no slowest-scan flight-recorder entry (fragments ride a
+  separate ring)
+- SLO engine + durable ops event log: a burn-rate alert fires as a
+  journaled event under a replica fault, clears after the fault lifts,
+  and the journal replays intact across a controller restart with a
+  torn tail
+- probe observability: routable-health gauge, probe-latency histogram,
+  replica-skew events on generation mismatch
+- CLI: multi-endpoint `profile` (per-replica sections + federated
+  merge + stitched --flight), `fleet metrics`, `fleet events`
+- the token-gated federation endpoint
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from trivy_tpu.cache.cache import MemoryCache
+from trivy_tpu.db.model import Advisory
+from trivy_tpu.db.store import AdvisoryDB, Metadata
+from trivy_tpu.detector.engine import MatchEngine
+from trivy_tpu.fleet import slo, telemetry
+from trivy_tpu.fleet.endpoints import EndpointSet
+from trivy_tpu.obs import attrib, metrics as obs_metrics, tracing
+from trivy_tpu.resilience import faults
+from trivy_tpu.rpc import wire
+from trivy_tpu.rpc.server import SCAN_PATH, Server
+from trivy_tpu.types.scan import ScanOptions
+
+pytestmark = [pytest.mark.fleet, pytest.mark.obs]
+
+NPM_BUCKET = "npm::GitHub Security Advisory Npm"
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.reset()
+    slo.reset_bus()
+    attrib.AGG.reset()
+    yield
+    faults.reset()
+    slo.reset_bus()
+    attrib.AGG.reset()
+
+
+def mk_db(n: int = 4) -> AdvisoryDB:
+    db = AdvisoryDB()
+    for i in range(n):
+        db.put_advisory(
+            NPM_BUCKET, f"pkg{i}",
+            Advisory(vulnerability_id=f"CVE-2026-{i:04d}",
+                     fixed_version="2.0.0",
+                     vulnerable_versions=["<2.0.0"]))
+    db.meta = Metadata(updated_at="2026-01-01")
+    return db
+
+
+def npm_blob(names: list[str]) -> dict:
+    return {"schema_version": 2, "applications": [{
+        "type": "npm", "file_path": "package-lock.json",
+        "packages": [{"id": f"{n}@1.0.0", "name": n, "version": "1.0.0"}
+                     for n in names]}]}
+
+
+@pytest.fixture()
+def two_servers():
+    engine = MatchEngine(mk_db(), use_device=False)
+    cache = MemoryCache()
+    cache.put_blob("sha256:b1", npm_blob(["pkg0", "pkg2"]))
+    servers = [Server(engine, cache, host="localhost", port=0)
+               for _ in range(2)]
+    for s in servers:
+        s.start()
+    yield servers
+    for s in servers:
+        s.shutdown()
+
+
+def scan_via(addr_or_set, key: str = "sha256:b1") -> bytes:
+    body = wire.scan_request("img1", "", [key], ScanOptions())
+    if isinstance(addr_or_set, str):
+        es = EndpointSet([addr_or_set], health_interval_s=0)
+        try:
+            return es.post(SCAN_PATH, body)
+        finally:
+            es.close()
+    return addr_or_set.post(SCAN_PATH, body)
+
+
+# ========================================================== federation
+
+
+class TestFederation:
+    def test_counter_totals_equal_sum_of_scrapes(self, two_servers):
+        """Acceptance: the federated counter total equals the sum of
+        the per-replica scrapes — computed from the scraped bytes
+        themselves, not in-memory objects."""
+        scan_via(two_servers[0].address)
+        scan_via(two_servers[0].address)
+        scan_via(two_servers[1].address)
+        scrapes = [(str(i), telemetry.scrape_metrics(s.address))
+                   for i, s in enumerate(two_servers)]
+        per_replica = 0.0
+        for _label, text in scrapes:
+            for fam in telemetry.parse_exposition(text):
+                for sample in fam.samples:
+                    if sample.name == "trivy_tpu_scans_total":
+                        per_replica += sample.value
+        fed = telemetry.federate(scrapes)
+        assert fed.total("trivy_tpu_scans_total") == per_replica == 3.0
+        out = fed.render().decode()
+        assert "trivy_tpu_scans_total 3" in out
+        assert 'trivy_tpu_scans_total{replica="0"} 2' in out
+        assert 'trivy_tpu_scans_total{replica="1"} 1' in out
+        assert out.endswith("# EOF\n")
+
+    def test_histogram_buckets_merge_and_exemplars_survive(self):
+        exp_a = (
+            "# HELP lat_seconds latency\n"
+            "# TYPE lat_seconds histogram\n"
+            'lat_seconds_bucket{le="0.1"} 2 # {trace_id="aa"} 0.05 1.0\n'
+            'lat_seconds_bucket{le="+Inf"} 3\n'
+            "lat_seconds_sum 0.4\n"
+            "lat_seconds_count 3\n")
+        exp_b = (
+            "# HELP lat_seconds latency\n"
+            "# TYPE lat_seconds histogram\n"
+            'lat_seconds_bucket{le="0.1"} 5\n'
+            'lat_seconds_bucket{le="+Inf"} 7\n'
+            "lat_seconds_sum 1.1\n"
+            "lat_seconds_count 7\n")
+        fed = telemetry.federate([("0", exp_a), ("1", exp_b)])
+        assert fed.total("lat_seconds_bucket", le="0.1") == 7
+        assert fed.total("lat_seconds_bucket", le="+Inf") == 10
+        assert fed.total("lat_seconds_count") == 10
+        out = fed.render().decode()
+        # bucket-merged aggregate, per-replica series, exemplar intact
+        assert 'lat_seconds_bucket{le="0.1"} 7' in out
+        assert ('lat_seconds_bucket{le="0.1",replica="0"} 2 '
+                '# {trace_id="aa"} 0.05 1.0') in out
+        assert "lat_seconds_sum 1.5" in out
+
+    def test_gauges_are_never_summed(self):
+        exp = ("# HELP breaker_state state\n"
+               "# TYPE breaker_state gauge\n"
+               "breaker_state 1\n")
+        fed = telemetry.federate([("0", exp), ("1", exp)])
+        out = fed.render().decode()
+        assert 'breaker_state{replica="0"} 1' in out
+        assert "\nbreaker_state 1\n" not in out  # no aggregate line
+        assert "\nbreaker_state 2\n" not in out
+        assert fed.total("breaker_state") == 0.0
+
+    def test_single_server_legacy_exposition_untouched(self, two_servers):
+        """Federation lives in the scraper: the replica's own default
+        /metrics bytes carry no replica label and stay 0.0.4."""
+        with urllib.request.urlopen(
+                two_servers[0].address + "/metrics", timeout=10) as r:
+            body = r.read().decode()
+            ctype = r.headers.get("Content-Type")
+        assert "version=0.0.4" in ctype
+        assert "replica=" not in body
+
+    def test_federate_endpoints_survives_a_dead_replica(
+            self, two_servers):
+        scan_via(two_servers[0].address)
+        fed = telemetry.federate_endpoints(
+            [two_servers[0].address, "http://127.0.0.1:1"])
+        assert fed.total("trivy_tpu_scans_total") >= 1.0
+        assert list(fed.errors) == ["1"]
+
+    def test_federate_profiles_verdict(self):
+        lanes_a = {lane: {"busy_s": 0.0, "crit_s": 0.0}
+                   for lane in attrib.LANES}
+        lanes_a["fetch_io"] = {"busy_s": 3.0, "crit_s": 3.0}
+        doc = telemetry.federate_profiles([
+            ("r0", {"scans": 2, "roots": 2, "wall_s": 4.0,
+                    "other_s": 0.5, "lanes": lanes_a}),
+            ("r1", {"scans": 1, "roots": 1, "wall_s": 2.0,
+                    "other_s": 0.1, "lanes": {}}),
+        ])
+        assert doc["fleet"]["scans"] == 3
+        assert doc["fleet"]["lanes"]["fetch_io"]["crit_s"] == 3.0
+        assert doc["fleet"]["verdict"].startswith("bound by fetch_io")
+
+
+# =========================================================== stitching
+
+
+def _hedged_scan(servers, root_name: str = "scan_artifact") -> bytes:
+    """One hedged scan with replica 0 slowed: the primary dispatch
+    (round-robin starts at endpoint 0) eats the delay, the hedge races
+    endpoint 1 and wins."""
+    faults.install_spec("fleet.endpoint.0:delay=0.4")
+    es = EndpointSet([s.address for s in servers], hedge_s=0.05,
+                     hedge_budget=1.0, health_interval_s=0)
+    try:
+        with tracing.span(root_name):
+            out = scan_via(es)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if len(attrib.AGG.flight.fragment_records()) >= 2:
+                break
+            time.sleep(0.02)
+        return out
+    finally:
+        faults.reset()
+        es.close()
+
+
+class TestStitching:
+    def test_hedged_scan_one_stitched_trace_loser_cancelled(
+            self, two_servers):
+        """Acceptance: a hedged scan under fleet.endpoint.0:delay
+        yields ONE stitched Chrome trace with both replicas' spans,
+        the losing attempt marked cancelled, zero orphan roots."""
+        _hedged_scan(two_servers)
+        docs = [(s.address, json.loads(telemetry._get(
+            s.address + "/debug/flight"))) for s in two_servers]
+        for _addr, doc in docs:
+            assert doc["flightRecorder"]["fragments"] == 2
+        stitched = telemetry.stitch_flight(docs)
+        st = stitched["stitch"]
+        assert st["traces"] == 1
+        assert st["fragments"] == 2
+        assert st["orphan_roots"] == 0
+        assert st["cancelled_spans"] >= 1
+        frags = [e for e in stitched["traceEvents"]
+                 if e.get("name") == "server.scan"]
+        assert {e["args"]["attempt"] for e in frags} == {"0", "1"}
+        # the loser (slowed endpoint 0) is cancelled, the winner is not
+        by_ep = {e["args"]["endpoint"]: e for e in frags}
+        assert by_ep["0"]["args"].get("cancelled") == "1"
+        assert "cancelled" not in by_ep["1"]["args"]
+        # per-replica process rows named in the metadata
+        names = [e for e in stitched["traceEvents"]
+                 if e.get("ph") == "M"]
+        assert len(names) == 2
+        # hedge outcome landed on the event bus too
+        _nxt, events = slo.events_since(0)
+        assert any(e["kind"] == "hedge" and e.get("outcome") == "won"
+                   for e in events)
+
+    def test_hedge_loser_trace_hygiene(self, two_servers):
+        """Satellite: the losing attempt must not leave an orphan root
+        trace or leak a slowest-scan flight-recorder entry."""
+        tracing.enable(True)
+        tracing.reset()
+        try:
+            _hedged_scan(two_servers)
+            top, _extra = tracing._stitched_roots()
+            assert len(top) == 1  # ONE root: the client's scan
+            assert top[0].name == "scan_artifact"
+        finally:
+            tracing.enable(False)
+            tracing.reset()
+        snap = attrib.AGG.snapshot()
+        assert snap["scans"] == 1        # the client root only
+        assert snap["fragments"] == 2    # both attempts, as fragments
+        names = [r["name"] for r in attrib.AGG.flight.records()]
+        assert "server.scan" not in names
+        assert [r["name"] for r in attrib.AGG.flight.fragment_records()
+                ] == ["server.scan", "server.scan"]
+
+    def test_failover_retry_stays_a_full_scan(self, two_servers):
+        """A failover retry's server tree is the scan's ONLY record:
+        it must count as a scan (tagged failover_attempt for the
+        stitcher), never demote to a fragment."""
+        faults.install_spec("fleet.endpoint.0:drop")
+        es = EndpointSet([s.address for s in two_servers], hedge_s=0,
+                         health_interval_s=0)
+        try:
+            with tracing.span("scan_artifact"):
+                scan_via(es)
+        finally:
+            faults.reset()
+            es.close()
+        snap = attrib.AGG.snapshot()
+        assert snap["scans"] == 2      # client root + the retry's tree
+        assert snap["fragments"] == 0
+        assert attrib.AGG.flight.fragment_records() == []
+        server_recs = [r for r in attrib.AGG.flight.records()
+                       if r["name"] == "server.scan"]
+        assert len(server_recs) == 1
+        _nxt, events = slo.events_since(0)
+        assert any(e["kind"] == "failover" for e in events)
+
+    def test_stitch_derives_loser_from_hedge_winner_meta(self):
+        """Even when the loser's fleet.attempt span closed before the
+        cancelled stamp landed (the race the client cannot close), the
+        hedge span's winner meta marks the loser in the stitch."""
+        def frag(ep, span_id):
+            return {"name": "server.scan", "ph": "X", "ts": 1.0,
+                    "dur": 2.0, "pid": 0, "tid": 1,
+                    "args": {"trace_id": "t1", "span_id": span_id,
+                             "parent_id": "root", "attempt": ep,
+                             "endpoint": ep}}
+        doc = {"traceEvents": [
+            {"name": "scan_artifact", "ph": "X", "ts": 0.0, "dur": 5.0,
+             "pid": 0, "tid": 1,
+             "args": {"trace_id": "t1", "span_id": "root"}},
+            {"name": "fleet.hedge", "ph": "X", "ts": 0.5, "dur": 2.0,
+             "pid": 0, "tid": 1,
+             "args": {"trace_id": "t1", "span_id": "h1",
+                      "parent_id": "root", "winner": "1"}},
+            frag("0", "s0"), frag("1", "s1"),
+        ]}
+        stitched = telemetry.stitch_flight([("r0", doc)])
+        frags = {e["args"]["endpoint"]: e
+                 for e in stitched["traceEvents"]
+                 if e.get("name") == "server.scan"}
+        assert frags["0"]["args"].get("cancelled") == "1"
+        assert "cancelled" not in frags["1"]["args"]
+        assert stitched["stitch"]["orphan_roots"] == 0
+
+    def test_env_journal_knob_installs_lazily(self, tmp_path,
+                                              monkeypatch):
+        """TRIVY_TPU_FLEET_EVENTS_JOURNAL: a scan-client process can
+        journal its own failover/hedge/breaker events durably without
+        any controller wiring."""
+        path = str(tmp_path / "client-events.jsonl")
+        monkeypatch.setenv("TRIVY_TPU_FLEET_EVENTS_JOURNAL", path)
+        slo.reset_bus()  # re-arm the lazy env check
+        try:
+            slo.emit_event("failover", endpoint="http://a", attempt=1)
+        finally:
+            slo.reset_bus()
+            monkeypatch.delenv("TRIVY_TPU_FLEET_EVENTS_JOURNAL")
+        events = slo.OpsEventLog.read(path)
+        assert [e["kind"] for e in events] == ["failover"]
+
+    def test_unstitchable_fragment_gets_synthesized_root(self):
+        """A fragment whose client trace is in no pulled recorder must
+        not dangle: the stitcher synthesizes a fleet.stitch container."""
+        doc = {"traceEvents": [{
+            "name": "server.scan", "ph": "X", "ts": 1.0, "dur": 5.0,
+            "pid": 1, "tid": 1,
+            "args": {"trace_id": "t1", "span_id": "s1",
+                     "parent_id": "gone", "attempt": "1",
+                     "endpoint": "1"},
+        }]}
+        stitched = telemetry.stitch_flight([("r0", doc)])
+        st = stitched["stitch"]
+        assert st["synthesized_roots"] == 1
+        assert st["orphan_roots"] == 0
+        assert any(e["name"] == "fleet.stitch"
+                   for e in stitched["traceEvents"])
+
+
+# ================================================= SLO + ops event log
+
+
+class TestOpsEventLog:
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fleet event"):
+            slo.emit_event("made_up_kind")
+
+    def test_kill_switch_disables_emission(self, monkeypatch):
+        monkeypatch.setenv("TRIVY_TPU_FLEET_EVENTS", "0")
+        assert slo.emit_event("hedge", outcome="won") is None
+        _nxt, events = slo.events_since(0)
+        assert events == []
+
+    def test_journal_append_and_torn_tail_replay(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        assert slo.install_journal(path) == []
+        slo.emit_event("failover", endpoint="http://a", attempt=1)
+        slo.emit_event("hedge", outcome="lost")
+        slo.uninstall_journal()
+        with open(path, "ab") as f:
+            f.write(b'{"kind": "hedge", "torn tail with no newline')
+        events = slo.OpsEventLog.read(path)
+        assert [e["kind"] for e in events] == ["failover", "hedge"]
+        # a restarted controller resumes the sequence past the replay
+        past = slo.install_journal(path)
+        assert [e["kind"] for e in past] == ["failover", "hedge"]
+        ev = slo.emit_event("hedge", outcome="won")
+        assert ev["seq"] > past[-1]["seq"]
+        slo.uninstall_journal()
+
+    def test_burn_rate_fires_and_clears_journaled_across_restart(
+            self, tmp_path, two_servers):
+        """Acceptance: a burn-rate alert fires as a journaled event
+        under an injected replica fault, clears after the fault lifts,
+        and replays intact across a controller restart with a torn
+        tail tolerated."""
+        path = str(tmp_path / "slo-events.jsonl")
+        slo.install_journal(path)
+        clock = [1000.0]
+        engine = slo.SLOEngine(target=0.9,
+                               windows=((10.0, 2.0, 2.0),),
+                               clock=lambda: clock[0])
+        monitor = telemetry.FleetMonitor(
+            [s.address for s in two_servers], engine=engine)
+        state = monitor.tick()
+        assert state["slo"]["firing"] is False
+        # the injected replica fault: replica 1 drains -> /readyz 503
+        two_servers[1].service.start_drain()
+        for _ in range(12):
+            clock[0] += 0.2
+            state = monitor.tick()
+        assert state["slo"]["firing"] is True
+        # the fault lifts; the long window drains the bad samples
+        two_servers[1].service.draining = False
+        for _ in range(30):
+            clock[0] += 0.5
+            state = monitor.tick()
+        assert state["slo"]["firing"] is False
+        slo.uninstall_journal()
+        with open(path, "ab") as f:
+            f.write(b'{"kind": "slo_burn", "state": "torn')
+        replayed = slo.OpsEventLog.read(path)
+        burns = [e for e in replayed if e["kind"] == "slo_burn"]
+        assert [b["state"] for b in burns] == ["firing", "resolved"]
+        # the probe flip of the drained replica is journaled too
+        flips = [e for e in replayed if e["kind"] == "probe_health"]
+        assert any(e["healthy"] is False for e in flips)
+        assert any(e["healthy"] is True for e in flips)
+
+
+# =================================================== probe observability
+
+
+class TestProbeObservability:
+    def test_probe_sets_gauges_and_latency_histogram(self, two_servers):
+        addrs = [s.address for s in two_servers]
+        es = EndpointSet(addrs, health_interval_s=0)
+        try:
+            es.probe_health()
+            for ep in es._live():
+                assert obs_metrics.FLEET_REPLICA_HEALTHY.value(
+                    endpoint=str(ep.index)) == 1.0
+                _cum, _total, count = \
+                    obs_metrics.FLEET_PROBE_SECONDS.snapshot(
+                        endpoint=str(ep.index))
+                assert count >= 1
+            # drain one replica: routable verdict drops, flip emitted
+            two_servers[1].service.start_drain()
+            es.probe_health()
+            idx = str(es._live()[1].index)
+            assert obs_metrics.FLEET_REPLICA_HEALTHY.value(
+                endpoint=idx) == 0.0
+            _nxt, events = slo.events_since(0)
+            assert any(e["kind"] == "probe_health"
+                       and e["healthy"] is False for e in events)
+        finally:
+            two_servers[1].service.draining = False
+            es.close()
+
+    def test_generation_mismatch_emits_replica_skew(self, monkeypatch):
+        es = EndpointSet(["http://a:1", "http://b:2"],
+                         health_interval_s=0)
+        docs = {"http://a:1": {"ready": True, "generation": "sha256-g1"},
+                "http://b:2": {"ready": True, "generation": "sha256-g2"}}
+        monkeypatch.setattr(
+            "trivy_tpu.fleet.endpoints.readyz_doc",
+            lambda url, token=None, timeout=2.0: docs[url])
+        es.probe_health()
+        es.probe_health()  # same skew again: no duplicate event
+        _nxt, events = slo.events_since(0)
+        skew = [e for e in events if e["kind"] == "replica_skew"]
+        assert len(skew) == 1
+        assert skew[0]["reason"] == "generation_mismatch"
+        assert set(skew[0]["generations"]) == {"sha256-g1", "sha256-g2"}
+        # convergence clears it, once
+        docs["http://b:2"] = {"ready": True, "generation": "sha256-g1"}
+        es.probe_health()
+        es.probe_health()
+        _nxt, events = slo.events_since(0)
+        skew = [e for e in events if e["kind"] == "replica_skew"]
+        assert [s["reason"] for s in skew] == [
+            "generation_mismatch", "generation_converged"]
+        es.close()
+
+
+# ================================================================= CLI
+
+
+class TestCli:
+    def test_profile_multi_endpoint_with_stitched_flight(
+            self, two_servers, tmp_path, capsys):
+        from trivy_tpu.cli.main import main as cli_main
+
+        _hedged_scan(two_servers)
+        flight = tmp_path / "stitched.json"
+        rc = cli_main(["--quiet", "profile",
+                       ",".join(s.address for s in two_servers),
+                       "--flight", str(flight)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "-- fleet (2 replica(s)" in out
+        assert "fleet verdict:" in out
+        assert out.count("-- replica ") == 2
+        doc = json.loads(flight.read_text())
+        assert doc["stitch"]["orphan_roots"] == 0
+        assert doc["stitch"]["fragments"] == 2
+
+    def test_fleet_metrics_cli(self, two_servers, tmp_path, capsys):
+        from trivy_tpu.cli.main import main as cli_main
+
+        scan_via(two_servers[0].address)
+        out_file = tmp_path / "fed.txt"
+        rc = cli_main(["--quiet", "fleet", "metrics",
+                       ",".join(s.address for s in two_servers),
+                       "--output", str(out_file)])
+        assert rc == 0
+        body = out_file.read_text()
+        assert 'replica="0"' in body and 'replica="1"' in body
+        assert "trivy_tpu_scans_total 1" in body
+
+    def test_fleet_events_cli(self, tmp_path, capsys):
+        from trivy_tpu.cli.main import main as cli_main
+
+        path = str(tmp_path / "ev.jsonl")
+        slo.install_journal(path)
+        slo.emit_event("db_swap", endpoint="http://a",
+                       serving="sha256-g2", reloaded=True)
+        slo.uninstall_journal()
+        rc = cli_main(["--quiet", "fleet", "events", "--journal", path])
+        assert rc == 0
+        lines = [json.loads(ln) for ln in
+                 capsys.readouterr().out.splitlines() if ln.strip()]
+        assert [e["kind"] for e in lines] == ["db_swap"]
+
+    def test_rollout_journals_stage_events(self, tmp_path, capsys):
+        """The rollout controller's --journal records stages + swaps
+        durably (smoke over the noop path: plan stage only)."""
+        from trivy_tpu.cli.main import main as cli_main
+        from trivy_tpu.db import generations
+
+        db = mk_db()
+        root = str(tmp_path / "db")
+        gen = os.path.join(generations.generations_root(root),
+                           "sha256-g1")
+        db.save(gen)
+        generations.promote(root, gen)
+        engine = MatchEngine(db, use_device=False)
+        srv = Server(engine, MemoryCache(), host="localhost", port=0,
+                     db_path=root)
+        srv.start()
+        journal = str(tmp_path / "rollout-ev.jsonl")
+        try:
+            rc = cli_main(["--quiet", "fleet", "rollout", srv.address,
+                           "--db-path", root, "--journal", journal])
+            assert rc == 0
+        finally:
+            srv.shutdown()
+            slo.uninstall_journal()
+        events = slo.OpsEventLog.read(journal)
+        assert any(e["kind"] == "rollout_stage"
+                   and e["stage"] == "plan" for e in events)
+
+
+# ================================================== federation endpoint
+
+
+class TestFederationServer:
+    def test_token_gate_and_surfaces(self, two_servers):
+        scan_via(two_servers[0].address)
+        slo.emit_event("hedge", outcome="denied")
+        fed = telemetry.FederationServer(
+            [s.address for s in two_servers], token="fedtok")
+        fed.start()
+        try:
+            # gate: no token -> 401
+            with pytest.raises(telemetry.FederationError,
+                               match="401"):
+                telemetry._get(fed.address + "/metrics")
+            body = telemetry._get(fed.address + "/metrics",
+                                  token="fedtok").decode()
+            assert "trivy_tpu_scans_total 1" in body
+            assert 'replica="0"' in body
+            prof = json.loads(telemetry._get(
+                fed.address + "/profile", token="fedtok"))
+            assert "fleet" in prof and "replicas" in prof
+            ev = json.loads(telemetry._get(
+                fed.address + "/events?since=0", token="fedtok"))
+            assert [e["kind"] for e in ev["events"]] == ["hedge"]
+            flight = json.loads(telemetry._get(
+                fed.address + "/flight", token="fedtok"))
+            assert "stitch" in flight
+        finally:
+            fed.shutdown()

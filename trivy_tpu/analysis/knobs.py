@@ -112,6 +112,25 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TRIVY_TPU_FLEET_HEALTH_INTERVAL_S", "5", "fleet", False,
          "Period of the smart client's background /readyz (JSON) "
          "health prober over the endpoint set."),
+    Knob("TRIVY_TPU_FLEET_EVENTS", "1", "fleet", True,
+         "Fleet ops event bus (docs/fleet.md 'Event catalog'): "
+         "failovers, hedge outcomes, breaker/health transitions, "
+         "rollout stages, replica skew, SLO burn alerts — ringed, "
+         "counted, and journaled when a journal is installed; 0 "
+         "restores the pre-feature path (no emission at all)."),
+    Knob("TRIVY_TPU_FLEET_EVENTS_JOURNAL", "", "fleet", False,
+         "Path of a durable fleet ops event journal THIS process "
+         "installs lazily on its first emit — the way a scan client "
+         "makes its failover/hedge/breaker events durable (the event "
+         "bus is process-local; use one path per process)."),
+    Knob("TRIVY_TPU_FLEET_SLO_TARGET", "0.999", "fleet", False,
+         "Fleet availability SLO target the burn-rate engine "
+         "evaluates multi-window alerts against (burn = error rate / "
+         "(1 - target))."),
+    Knob("TRIVY_TPU_FLEET_SLO_LATENCY_MS", "", "fleet", False,
+         "Latency SLI threshold in milliseconds: a successful request "
+         "slower than this counts against the SLO budget (unset = "
+         "availability-only SLO)."),
     # --- RPC
     Knob("TRIVY_TPU_RPC_GZIP_MIN", "8192", "rpc", False,
          "Minimum body size in bytes before the negotiated gzip wire "

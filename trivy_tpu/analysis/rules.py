@@ -71,6 +71,7 @@ class Project:
         self.declared_fault_actions = self._extract_fault_actions()
         self.declared_knobs = self._extract_knobs()
         self.declared_span_taxonomy = self._extract_span_taxonomy()
+        self.declared_event_kinds = self._extract_event_kinds()
 
     def _collect(self) -> None:
         pkg = os.path.join(self.root, "trivy_tpu")
@@ -197,6 +198,26 @@ class Project:
             "prefixes": tuple(attrib.SPAN_PREFIX_LANES),
             "lanes": tuple(attrib.LANES),
         }
+
+    def _extract_event_kinds(self):
+        """Fleet event-kind registry from the LINTED tree's
+        fleet/slo.py EVENTS table (AST-extracted like the fault/knob
+        tables; import fallback; tests override the attribute).
+        ``None`` means no registry is known and the event-kind rule
+        skips (seeded mini-trees override explicitly)."""
+        value = self._registry_assign("trivy_tpu/fleet/slo.py", "EVENTS")
+        if value is not None:
+            try:
+                return [(k, d) for k, d in ast.literal_eval(value)]
+            except (ValueError, TypeError):
+                pass
+        if self.file("trivy_tpu/fleet/slo.py") is not None:
+            return []  # present but unparsable: the rule flags it
+        try:
+            from trivy_tpu.fleet import slo
+            return list(slo.EVENTS)
+        except ImportError:
+            return None
 
     @staticmethod
     def _real_fault_sites():
@@ -973,6 +994,103 @@ class SpanTaxonomyRule(Rule):
                     self.id, self.ATTRIB_PY, 1,
                     f"SPAN_PREFIX_LANES declares family {prefix!r} "
                     "but no call site emits a span under it")
+
+
+# ====================================================== 10. event-kind
+
+@register
+class EventKindRule(Rule):
+    id = "event-kind"
+    summary = ("every fleet event kind emitted via emit_event() ⇔ "
+               "declared in fleet/slo.py EVENTS ⇔ cataloged in "
+               "docs/fleet.md, all directions")
+    rationale = (
+        "The fleet ops event log is the durable record operators "
+        "replay after an incident; its value rests on a closed "
+        "vocabulary. A kind emitted but undeclared bypasses the "
+        "registry's validation and the docs catalog; a declared kind "
+        "nothing emits is operational vocabulary reviewers trust but "
+        "no code produces; an undocumented kind is a journal record "
+        "nobody can interpret at 3am. fleet/slo.py's EVENTS table is "
+        "the single source of truth.")
+
+    EMIT_FNS = {"emit_event"}
+    SLO_PY = "trivy_tpu/fleet/slo.py"
+    DOC = "docs/fleet.md"
+    # catalog rows: | `kind` | description |  (the event catalog is the
+    # only docs/fleet.md table whose first cell is a backticked
+    # lowercase identifier)
+    DOC_ROW_RX = re.compile(r"^\|\s*`([a-z][a-z0-9_]*)`\s*\|", re.M)
+
+    def _emitted(self, project: Project):
+        used: dict[str, tuple[str, int]] = {}
+        for pf in project.files():
+            consts = _module_consts(pf.tree)
+            for node in ast.walk(pf.tree):
+                if not (isinstance(node, ast.Call)
+                        and _func_tail(node.func) in self.EMIT_FNS
+                        and node.args):
+                    continue
+                kind = _const_str(node.args[0])
+                if kind is None and isinstance(node.args[0], ast.Name):
+                    kind = consts.get(node.args[0].id)
+                if kind is not None:
+                    used.setdefault(kind, (pf.relpath, node.lineno))
+                else:
+                    yield None, (pf.relpath, node.lineno)
+        for kind, where in used.items():
+            yield kind, where
+
+    def check(self, project: Project):
+        declared_pairs = project.declared_event_kinds
+        if declared_pairs is None:
+            return  # no registry known (mini-tree without fleet/slo.py)
+        if not declared_pairs:
+            yield Finding(self.id, self.SLO_PY, 1,
+                          "fleet.slo.EVENTS is missing or empty — the "
+                          "event vocabulary must be exported as "
+                          "structured data")
+            return
+        declared = {k for k, _ in declared_pairs}
+        used: dict[str, tuple[str, int]] = {}
+        for kind, (path, line) in self._emitted(project):
+            if kind is None:
+                yield Finding(
+                    self.id, path, line,
+                    "emit_event() with a computed kind — event kinds "
+                    "must be literal so the registry/docs coherence "
+                    "is checkable (suppress with the contract if "
+                    "intentional)")
+                continue
+            used.setdefault(kind, (path, line))
+            if kind not in declared:
+                yield Finding(
+                    self.id, path, line,
+                    f"fleet event kind {kind!r} emitted here but not "
+                    "declared in fleet.slo.EVENTS")
+        for kind in sorted(declared - set(used)):
+            yield Finding(
+                self.id, self.SLO_PY, 1,
+                f"fleet event kind {kind!r} declared in EVENTS but "
+                "no code emits it")
+        doc = project.doc_text(self.DOC)
+        if doc is None:
+            yield Finding(self.id, self.DOC, 1,
+                          "docs/fleet.md missing — the fleet event "
+                          "catalog lives there")
+            return
+        doc_kinds = set(self.DOC_ROW_RX.findall(doc))
+        for kind in sorted(declared):
+            if kind not in doc_kinds:
+                yield Finding(
+                    self.id, self.DOC, 1,
+                    f"declared fleet event kind {kind!r} absent from "
+                    "the docs/fleet.md event catalog")
+        for kind in sorted(doc_kinds - declared):
+            yield Finding(
+                self.id, self.DOC, 1,
+                f"docs/fleet.md catalogs event kind {kind!r} but "
+                "fleet.slo.EVENTS does not declare it")
 
 
 # ----------------------------------------------------------- the driver

@@ -425,15 +425,84 @@ def build_parser() -> argparse.ArgumentParser:
                           "re-score after the roll")
     pfr.add_argument("--output", "-o", default=None,
                      help="write the rollout report JSON here")
+    pfr.add_argument("--journal", default=None, metavar="PATH",
+                     help="durable fleet ops event journal: rollout "
+                          "stages and DB swaps append (fsynced) here "
+                          "(docs/fleet.md 'Event catalog')")
+    pfm = flsub.add_parser(
+        "metrics", help="federated fleet exposition: scrape every "
+        "replica's /metrics (OpenMetrics, exemplars preserved) and "
+        "merge — counters summed, histogram buckets merged, every "
+        "series re-emitted with a replica label (docs/fleet.md)",
+        allow_abbrev=False)
+    _add_global_flags(pfm)
+    pfm.add_argument("endpoints", help="comma-separated replica URLs")
+    pfm.add_argument("--token", default=None, help="server auth token")
+    pfm.add_argument("--output", "-o", default=None,
+                     help="write the federated exposition here "
+                          "instead of stdout")
+    pfp = flsub.add_parser(
+        "profile", help="federated bottleneck attribution: every "
+        "replica's /debug/profile merged into one fleet roofline "
+        "verdict with per-replica sections (docs/observability.md "
+        "'Fleet observability')", allow_abbrev=False)
+    _add_global_flags(pfp)
+    pfp.add_argument("endpoints", help="comma-separated replica URLs")
+    pfp.add_argument("--token", default=None, help="server auth token")
+    pfp.add_argument("--json", action="store_true",
+                     help="print the raw federated document")
+    pfp.add_argument("--flight", default=None, metavar="FILE",
+                     help="also stitch every replica's flight "
+                          "recorder into ONE Chrome trace at FILE "
+                          "(per-replica process rows; hedge losers "
+                          "marked cancelled)")
+    pfe = flsub.add_parser(
+        "events", help="fleet ops event log: read (or follow) the "
+        "durable event journal — breaker trips, failovers, hedge "
+        "outcomes, rollout stages, DB swaps, replica skew, SLO burn "
+        "alerts (docs/fleet.md 'Event catalog')", allow_abbrev=False)
+    _add_global_flags(pfe)
+    pfe.add_argument("--journal", required=True, metavar="PATH",
+                     help="event journal path (torn-tail-tolerant "
+                          "replay)")
+    pfe.add_argument("--follow", action="store_true",
+                     help="keep tailing the journal for new events")
+    pfe.add_argument("--since", type=int, default=0, metavar="SEQ",
+                     help="only events with a sequence number > SEQ")
+    pfe.add_argument("--output", "-o", default=None,
+                     help="write events here instead of stdout")
+    pfv = flsub.add_parser(
+        "serve", help="run the fleet observability control plane: a "
+        "token-gated federation endpoint (/metrics /profile /flight "
+        "/events) plus the monitor loop — health probes, replica-skew "
+        "detection, SLO burn-rate alerts journaled durably "
+        "(docs/fleet.md)", allow_abbrev=False)
+    _add_global_flags(pfv)
+    pfv.add_argument("endpoints", help="comma-separated replica URLs")
+    pfv.add_argument("--listen", default="localhost:4955",
+                     help="host:port for the federation endpoint")
+    pfv.add_argument("--token", default=None,
+                     help="token gating the federation endpoint "
+                          "(also used upstream unless --upstream-token)")
+    pfv.add_argument("--upstream-token", default=None,
+                     help="auth token for scraping the replicas")
+    pfv.add_argument("--journal", default=None, metavar="PATH",
+                     help="durable ops event journal path")
+    pfv.add_argument("--interval", default="5s",
+                     help="monitor tick period (go-style duration)")
 
     p = sub.add_parser(
         "profile", help="fetch a live server's bottleneck attribution "
         "(/debug/profile): per-resource-lane occupancy, critical-path "
         "shares, the roofline verdict, and the slow-scan flight "
-        "recorder (docs/observability.md)", allow_abbrev=False)
+        "recorder; a comma-separated URL federates a replica set "
+        "(docs/observability.md)", allow_abbrev=False)
     _add_global_flags(p)
     p.add_argument("server", help="scan server URL (e.g. "
-                                  "http://localhost:4954)")
+                                  "http://localhost:4954); a comma-"
+                                  "separated list federates the whole "
+                                  "replica set (per-replica sections + "
+                                  "the fleet merge)")
     p.add_argument("--token", default=None,
                    help="server auth token (or the dedicated "
                         "TRIVY_TPU_PROFILE_TOKEN)")
@@ -441,7 +510,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print the raw /debug/profile document")
     p.add_argument("--flight", default=None, metavar="FILE",
                    help="also fetch /debug/flight (the N slowest scan "
-                        "traces) as Chrome trace-event JSON to FILE")
+                        "traces) as Chrome trace-event JSON to FILE; "
+                        "with a replica set, every recorder is pulled "
+                        "and stitched into ONE trace (per-replica "
+                        "process rows, hedge losers marked cancelled)")
 
     p = sub.add_parser("db", help="advisory DB operations", allow_abbrev=False)
     _add_global_flags(p)

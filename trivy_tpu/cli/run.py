@@ -1033,8 +1033,11 @@ def run_watch(args) -> int:
 
 
 def run_fleet_admin(args) -> int:
-    """`trivy-tpu fleet status|rollout` (docs/fleet.md): replica-set
-    health and the coordinated advisory-DB rollout controller."""
+    """`trivy-tpu fleet status|rollout|metrics|profile|events|serve`
+    (docs/fleet.md): replica-set health, the coordinated advisory-DB
+    rollout controller, and the fleet observability control plane
+    (metrics/attribution federation, stitched traces, the durable ops
+    event log)."""
     import json as _json
     import sys
 
@@ -1044,17 +1047,46 @@ def run_fleet_admin(args) -> int:
     _validate_fault_spec()
     cmd = getattr(args, "fleet_command", None)
     if cmd is None:
-        raise FatalError("fleet: choose a subcommand (status, rollout)")
+        raise FatalError("fleet: choose a subcommand (status, rollout, "
+                         "metrics, profile, events, serve)")
+    token = getattr(args, "token", None)
+    if cmd == "events":
+        return _run_fleet_events(args)
     endpoints = split_urls(getattr(args, "endpoints", "") or "")
     if not endpoints:
         raise FatalError("fleet: no endpoints given")
-    token = getattr(args, "token", None)
     if cmd == "status":
         status = rollout_mod.fleet_status(endpoints, token=token)
         print(_json.dumps(status, indent=2, sort_keys=True))
         return 0 if all(s.get("ready") for s in status) else 1
+    if cmd == "metrics":
+        from trivy_tpu.fleet import telemetry
+
+        fed = telemetry.federate_endpoints(endpoints, token=token)
+        body = fed.render().decode()
+        if getattr(args, "output", None):
+            # lint: allow[atomic-write] user-requested exposition dump (--output), not program state
+            with open(args.output, "w", encoding="utf-8") as f:
+                f.write(body)
+        else:
+            print(body, end="")
+        errors = getattr(fed, "errors", {})
+        for idx, err in sorted(errors.items()):
+            print(f"# scrape failed: replica {idx}: {err}",
+                  file=sys.stderr)
+        return 0 if not errors else 1
+    if cmd == "profile":
+        return _render_fleet_profile(endpoints, token,
+                                     as_json=getattr(args, "json", False),
+                                     flight=getattr(args, "flight", None))
+    if cmd == "serve":
+        return _run_fleet_serve(args, endpoints, token)
     if cmd != "rollout":
         raise FatalError(f"fleet: unknown subcommand {cmd!r}")
+    if getattr(args, "journal", None):
+        from trivy_tpu.fleet import slo as slo_mod
+
+        slo_mod.install_journal(args.journal)
     probes = None
     if getattr(args, "probes", None):
         probes = rollout_mod.load_probes(args.probes)
@@ -1077,14 +1109,163 @@ def run_fleet_admin(args) -> int:
     return 0 if report.outcome in ("completed", "noop") else 1
 
 
+def _run_fleet_events(args) -> int:
+    """`trivy-tpu fleet events --journal PATH [--follow]`: replay the
+    durable ops event journal (torn tail tolerated) as JSON lines;
+    --follow keeps polling the file for appended records."""
+    import json as _json
+    import time as _time
+
+    from trivy_tpu.durability.appendlog import AppendLogError
+    from trivy_tpu.fleet.slo import OpsEventLog
+
+    out = sys.stdout
+    if getattr(args, "output", None):
+        # lint: allow[atomic-write] user-requested event stream (--output): append-only JSONL the user tails
+        out = open(args.output, "a", encoding="utf-8")
+    since = getattr(args, "since", 0) or 0
+    try:
+        while True:
+            try:
+                events = OpsEventLog.read(args.journal)
+            except (AppendLogError, OSError) as e:
+                if getattr(args, "follow", False):
+                    _time.sleep(1.0)
+                    continue
+                raise FatalError(f"fleet events: {e}")
+            for ev in events:
+                if int(ev.get("seq", 0)) > since:
+                    since = max(since, int(ev.get("seq", 0)))
+                    out.write(_json.dumps(ev, sort_keys=True) + "\n")
+            out.flush()
+            if not getattr(args, "follow", False):
+                return 0
+            _time.sleep(1.0)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if out is not sys.stdout:
+            out.close()
+
+
+def _run_fleet_serve(args, endpoints: list, token: str | None) -> int:
+    """`trivy-tpu fleet serve`: the blocking control-plane process —
+    federation endpoint + monitor loop (docs/fleet.md)."""
+    import time
+
+    from trivy_tpu.fleet import slo as slo_mod
+    from trivy_tpu.fleet import telemetry
+
+    if getattr(args, "journal", None):
+        past = slo_mod.install_journal(args.journal)
+        _log.info("ops event journal installed", path=args.journal,
+                  replayed=len(past))
+    host, _sep, port = (getattr(args, "listen", None)
+                        or "localhost:4955").rpartition(":")
+    try:
+        port_n = int(port)
+    except ValueError:
+        raise FatalError(f"fleet serve: bad --listen {args.listen!r}")
+    interval = _parse_duration(getattr(args, "interval", None) or "5s")
+    monitor = telemetry.FleetMonitor(endpoints, token=token)
+    srv = telemetry.FederationServer(
+        endpoints, host=host or "localhost", port=port_n,
+        token=getattr(args, "token", None),
+        upstream_token=getattr(args, "upstream_token", None) or token,
+        monitor=monitor, monitor_interval_s=interval)
+    srv.start()
+    print(f"federation endpoint: {srv.address} "
+          f"({len(endpoints)} replica(s))")
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        srv.shutdown()
+        if getattr(args, "journal", None):
+            slo_mod.uninstall_journal()
+
+
+def _render_fleet_profile(endpoints: list, token: str | None,
+                          as_json: bool, flight: str | None) -> int:
+    """Shared by `trivy-tpu fleet profile` and the multi-endpoint form
+    of `trivy-tpu profile`: per-replica attribution sections plus the
+    federated fleet verdict; --flight stitches every replica's flight
+    recorder into ONE Chrome trace."""
+    import json as _json
+
+    from trivy_tpu.fleet import telemetry
+
+    profiles = []
+    errors = []
+    for ep in endpoints:
+        try:
+            profiles.append((ep.rstrip("/"),
+                             telemetry.fetch_profile(ep, token=token)))
+        except telemetry.FederationError as e:
+            errors.append(str(e))
+    if not profiles:
+        raise FatalError("profile fetch failed: "
+                         + "; ".join(errors or ["no endpoints"]))
+    doc = telemetry.federate_profiles(profiles)
+    if flight:
+        fdoc = telemetry.stitch_endpoints(endpoints, token=token)
+        # lint: allow[atomic-write] user-requested trace-export artifact, not program state
+        with open(flight, "w", encoding="utf-8") as f:
+            _json.dump(fdoc, f, indent=1)
+            f.write("\n")
+        st = fdoc.get("stitch", {})
+        print(f"stitched flight trace written: {flight} "
+              f"({st.get('replicas', 0)} replica(s), "
+              f"{st.get('traces', 0)} trace(s), "
+              f"{st.get('fragments', 0)} fragment(s), "
+              f"{st.get('cancelled_spans', 0)} cancelled span(s), "
+              f"{st.get('orphan_roots', 0)} orphan root(s))")
+    if as_json:
+        print(_json.dumps(doc, indent=2, sort_keys=True))
+        return 0 if not errors else 1
+    for label, rep in doc["replicas"].items():
+        print(f"-- replica {label} "
+              f"(scans {rep.get('scans', 0)}, "
+              f"verdict: {rep.get('verdict', '?')})")
+    fleet = doc["fleet"]
+    print(f"-- fleet ({len(doc['replicas'])} replica(s), "
+          f"scans {fleet['scans']}, wall {fleet['wall_s']:.3f}s)")
+    print(f"{'lane':<16} {'busy s':>10} {'critical s':>11} {'share':>7}")
+    for lane, row in fleet["lanes"].items():
+        print(f"{lane:<16} {row['busy_s']:>10.3f} "
+              f"{row['crit_s']:>11.3f} {row['crit_share']:>7.1%}")
+    print(f"{'other':<16} {'':>10} {fleet['other_s']:>11.3f}")
+    print(f"fleet verdict: {fleet['verdict']}")
+    for err in errors:
+        print(f"scrape failed: {err}", file=sys.stderr)
+    return 0 if not errors else 1
+
+
 def run_profile(args) -> int:
     """`trivy-tpu profile URL`: render a live server's bottleneck
     attribution (docs/observability.md "Attribution & profiling") —
     per-lane busy/critical seconds, the roofline "bound by X" verdict,
-    recent per-scan records, and the slow-scan flight recorder."""
+    recent per-scan records, and the slow-scan flight recorder.
+
+    A comma-separated URL names a replica set: every replica's profile
+    renders as its own section plus the federated fleet merge, and
+    --flight stitches every replica's flight recorder into ONE Chrome
+    trace (docs/observability.md "Fleet observability")."""
     import json as _json
     import urllib.error
     import urllib.request
+
+    from trivy_tpu.fleet.endpoints import split_urls
+
+    endpoints = [u if u.startswith("http") else "http://" + u
+                 for u in split_urls(args.server)]
+    if len(endpoints) > 1:
+        return _render_fleet_profile(
+            endpoints, getattr(args, "token", None),
+            as_json=getattr(args, "json", False),
+            flight=getattr(args, "flight", None))
 
     base = args.server.rstrip("/")
     if not base.startswith("http"):

@@ -14,11 +14,22 @@ Three pieces compose the single-server subsystems into a deployment:
 - :mod:`trivy_tpu.fleet.rollout` — the coordinated advisory-DB rollout
   controller: canary replica first, a zero-diff probe set, then roll
   the rest, automatic rollback on a ``/readyz`` regression or a probe
-  diff, and the PR-9 delta re-score triggered exactly once fleet-wide.
+  diff, and the PR-9 delta re-score triggered exactly once fleet-wide;
+- :mod:`trivy_tpu.fleet.telemetry` — the observability control plane:
+  metrics + attribution federation over every replica's ``/metrics``
+  and ``/debug/profile`` (counters summed, histogram buckets merged,
+  ``replica`` label, exemplars preserved), cross-replica trace
+  stitching of hedge/failover fragments into one Chrome trace, the
+  token-gated federation endpoint, and the fleet monitor loop;
+- :mod:`trivy_tpu.fleet.slo` — the fleet ops event bus (closed EVENTS
+  vocabulary, durable fsynced journal with torn-tail-tolerant replay),
+  the multi-window burn-rate SLO engine, and the replica-skew
+  detector.
 
 ``TRIVY_TPU_FLEET=0`` is the kill switch: multi-URL clients pin to the
 first endpoint through the exact single-server code path, and servers
 keep the in-process layer gate even on a redis cache.
+``TRIVY_TPU_FLEET_EVENTS=0`` kills the ops event bus alone.
 """
 
 from __future__ import annotations

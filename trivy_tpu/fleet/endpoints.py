@@ -43,6 +43,7 @@ from trivy_tpu.analysis.witness import make_lock
 import time
 
 from trivy_tpu import fleet as fleet_mod
+from trivy_tpu.fleet import slo as slo_mod
 from trivy_tpu.log import logger
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import tracing
@@ -154,6 +155,7 @@ class EndpointSet:
         self._pool: futures.ThreadPoolExecutor | None = None
         self._prober: threading.Thread | None = None
         self._prober_stop = threading.Event()
+        self._skew = slo_mod.SkewDetector()
 
     # compatibility fall-through: single-connection callers keep
     # reading transport internals (keep-alive socket, gzip capability)
@@ -212,14 +214,41 @@ class EndpointSet:
 
     def probe_health(self) -> None:
         """One synchronous health pass over the set (the background
-        prober calls this; tests may too)."""
+        prober calls this; tests may too). Each probe is timed into
+        ``trivy_tpu_fleet_probe_seconds{endpoint}``; the routable
+        verdict (ready AND breaker admits) lands in
+        ``trivy_tpu_fleet_replica_healthy{endpoint}``; health flips,
+        shard degradations, and cross-replica skew (mixed advisory
+        generations, probe-latency outliers) are emitted into the
+        fleet event bus on the transition."""
+        statuses = []
         for ep in self._live():
+            was_healthy = ep.healthy
+            t0 = time.monotonic()
             doc = readyz_doc(ep.url, token=self._token)
+            probe_s = time.monotonic() - t0
             ep.healthy = bool(doc.get("ready")) if doc else False
             ep.note = (str(doc.get("status", "")) if doc
                        else "unreachable")
+            obs_metrics.FLEET_PROBE_SECONDS.observe(
+                probe_s, endpoint=str(ep.index))
             obs_metrics.FLEET_ENDPOINT_HEALTH.set(
                 1.0 if ep.healthy else 0.0, endpoint=str(ep.index))
+            routable = ep.healthy and ep.breaker.state != "open"
+            obs_metrics.FLEET_REPLICA_HEALTHY.set(
+                1.0 if routable else 0.0, endpoint=str(ep.index))
+            if ep.healthy != was_healthy:
+                slo_mod.emit_event("probe_health", endpoint=ep.url,
+                                   healthy=ep.healthy, status=ep.note)
+            statuses.append({
+                "endpoint": ep.url,
+                "ready": ep.healthy,
+                "generation": doc.get("generation") if doc else None,
+                "mesh": doc.get("mesh") if doc else None,
+                "probe_s": probe_s,
+            })
+        if slo_mod.events_enabled():
+            self._skew.observe(statuses)
 
     def _ensure_prober(self) -> None:
         if self._health_interval_s <= 0:
@@ -300,10 +329,21 @@ class EndpointSet:
             try:
                 if path in HEDGE_PATHS and self._hedge_s > 0:
                     return self._hedged(ep, path, body, deadline)
-                return self._dispatch(ep, path, body)
+                # failover retries (attempt >= 1) carry their attempt
+                # identity in X-Trivy-Trace (kind "failover": the tree
+                # still counts as a scan server-side — it is the
+                # scan's only record — but the stitched trace shows
+                # which retry produced it)
+                return self._dispatch(
+                    ep, path, body,
+                    attempt=attempt if attempt else None,
+                    attempt_kind="failover")
             except RPCUnavailable as exc:
                 last = exc
                 obs_metrics.FLEET_FAILOVERS.inc()
+                slo_mod.emit_event("failover", endpoint=ep.url,
+                                   attempt=attempt, path=path,
+                                   error=str(exc)[:200])
                 _log.warn("endpoint failed; failing over",
                           url=ep.url, err=str(exc))
             if (attempt + 1) % max(len(eps), 1) == 0 \
@@ -328,11 +368,19 @@ class EndpointSet:
             f"{'' if ep.healthy else ' unhealthy'}"
             for ep in self._live())
 
-    def _dispatch(self, ep: Endpoint, path: str, body: bytes) -> bytes:
+    def _dispatch(self, ep: Endpoint, path: str, body: bytes,
+                  attempt: int | None = None,
+                  attempt_kind: str = "hedge") -> bytes:
         """One attempt on one endpoint, with breaker accounting. Only
         RPCUnavailable counts against the breaker — a deterministic
-        4xx reply proves the replica is alive and answering."""
+        4xx reply proves the replica is alive and answering.
+
+        ``attempt`` (hedged or failover dispatches) tags the outgoing
+        trace header with the dispatch identity so the server-side
+        trace tree is attributable to THIS attempt; the plain
+        single-dispatch path stays untagged, byte-identical."""
         obs_metrics.FLEET_REQUESTS.inc(endpoint=str(ep.index))
+        state_before = ep.breaker.state
         try:
             for rule in faults.fire(f"fleet.endpoint.{ep.index}"):
                 if rule.action == "delay":
@@ -348,17 +396,33 @@ class EndpointSet:
                     raise RPCUnavailable(
                         f"injected HTTP {int(rule.param or 503)} at "
                         f"endpoint {ep.index}")
-            out = ep.conn.post_once(path, body)
+            if attempt is not None:
+                with tracing.attempt_scope(attempt, ep.index,
+                                           kind=attempt_kind):
+                    out = ep.conn.post_once(path, body)
+            else:
+                out = ep.conn.post_once(path, body)
         except RPCUnavailable:
             ep.breaker.record_failure()
+            self._breaker_event(ep, state_before)
             raise
         except DeadlineExceeded:
             raise  # the caller's budget, not this endpoint's health
         except RPCError:
             ep.breaker.record_success()
+            self._breaker_event(ep, state_before)
             raise
         ep.breaker.record_success()
+        self._breaker_event(ep, state_before)
         return out
+
+    @staticmethod
+    def _breaker_event(ep: Endpoint, state_before: str) -> None:
+        state = ep.breaker.state
+        if state != state_before:
+            slo_mod.emit_event("breaker", endpoint=ep.url,
+                               breaker=f"fleet.endpoint.{ep.index}",
+                               state=state, previous=state_before)
 
     # ---------------------------------------------------------- hedging
 
@@ -374,6 +438,7 @@ class EndpointSet:
         with self._lock:
             if self._hedge_n + 1 > self._hedge_budget * self._req_n:
                 obs_metrics.FLEET_HEDGES.inc(outcome="denied")
+                slo_mod.emit_event("hedge", outcome="denied")
                 return False
             self._hedge_n += 1
             return True
@@ -384,17 +449,34 @@ class EndpointSet:
         delay, dispatch the same request to a second replica and take
         whichever answers first. The loser is not awaited — its worker
         finishes in the background and the response is discarded (its
-        breaker bookkeeping still happens)."""
+        breaker bookkeeping still happens).
+
+        Trace hygiene: each raced dispatch runs under its own
+        ``fleet.attempt`` span (attempt index + endpoint) and tags its
+        outgoing X-Trivy-Trace accordingly, so the server-side trees
+        become attributable FRAGMENTS of this one scan instead of
+        orphan roots; the instant the race resolves, the losing
+        attempt's span is stamped ``cancelled`` (it is still open —
+        that is WHY it lost), which is what marks the loser in the
+        stitched cross-replica trace (fleet/telemetry.py)."""
         pool = self._ensure_pool()
         ctx = tracing.capture()
+        lost: set[int] = set()  # endpoint indexes whose attempt lost
 
-        def submit(target: Endpoint):
+        def submit(target: Endpoint, attempt: int):
             def _go():
                 with tracing.adopt(ctx):
-                    return self._dispatch(target, path, body)
+                    with tracing.span("fleet.attempt",
+                                      attempt=str(attempt),
+                                      endpoint=str(target.index)) as s:
+                        out = self._dispatch(target, path, body,
+                                             attempt=attempt)
+                        if s is not None and target.index in lost:
+                            s.meta["cancelled"] = "1"
+                        return out
             return pool.submit(_go)
 
-        f1 = submit(ep)
+        f1 = submit(ep, 0)
         wait_s = self._hedge_s
         if deadline is not None:
             wait_s = min(wait_s, max(deadline.remaining(), 0.001))
@@ -411,18 +493,39 @@ class EndpointSet:
                 return f1.result()
             raise exc
         # fetch_io attribution lane: waiting on the raced responses
-        with tracing.span("fleet.hedge", endpoint=str(alt.index)):
-            f2 = submit(alt)
+        with tracing.span("fleet.hedge", endpoint=str(alt.index)) as hs:
+            f2 = submit(alt, 1)
+            by_future = {f1: ep, f2: alt}
             pending = {f1, f2}
             first_err: Exception | None = None
             while pending:
                 done, pending = futures.wait(
                     pending, return_when=futures.FIRST_COMPLETED)
-                for f in done:
+                # deterministic preference when both landed in one
+                # wake-up: the primary answered, so the hedge "lost"
+                for f in (x for x in (f1, f2) if x in done):
                     exc = f.exception()
                     if exc is None:
-                        obs_metrics.FLEET_HEDGES.inc(
-                            outcome="won" if f is f2 else "lost")
+                        winner = by_future[f]
+                        # every non-winning attempt is the loser —
+                        # recorded FIRST (best-effort: the loser's
+                        # attempt span reads this set when its own
+                        # dispatch returns; the stitcher additionally
+                        # derives the loser from the winner meta on
+                        # this still-open hedge span, which is not
+                        # subject to that race)
+                        for other in by_future.values():
+                            if other is not winner:
+                                lost.add(other.index)
+                        if hs is not None:
+                            hs.meta["winner"] = str(winner.index)
+                        outcome = "won" if f is f2 else "lost"
+                        obs_metrics.FLEET_HEDGES.inc(outcome=outcome)
+                        slo_mod.emit_event(
+                            "hedge", outcome=outcome,
+                            winner=winner.url,
+                            loser=next((o.url for o in by_future.values()
+                                        if o is not winner), None))
                         return f.result()
                     if first_err is None:
                         first_err = exc

@@ -58,6 +58,7 @@ import time
 from dataclasses import dataclass, field
 
 from trivy_tpu.db import generations
+from trivy_tpu.fleet import slo as slo_mod
 from trivy_tpu.fleet.endpoints import readyz_doc
 from trivy_tpu.log import logger
 from trivy_tpu.obs import metrics as obs_metrics
@@ -221,6 +222,12 @@ def run_rollout(db_root: str, endpoints: list[str],
         report.stages.append(st)
         obs_metrics.FLEET_ROLLOUT_STAGE_SECONDS.observe(
             st.seconds, stage=name)
+        # the durable ops record of this stage (docs/fleet.md "Event
+        # catalog"): journaled when the controller runs with one, so a
+        # crashed rollout's last completed stage is replayable
+        slo_mod.emit_event("rollout_stage", stage=name, ok=ok,
+                           detail=detail, target=report.target,
+                           seconds=round(st.seconds, 3))
         _log.info("rollout stage", stage=name, ok=ok, detail=detail)
         if on_event is not None:
             on_event(st.doc())
@@ -231,6 +238,10 @@ def run_rollout(db_root: str, endpoints: list[str],
         if status != 200:
             raise RolloutError(
                 f"{ep}/fleet/reload -> HTTP {status}: {doc}")
+        slo_mod.emit_event("db_swap", endpoint=ep,
+                           serving=doc.get("serving"),
+                           reloaded=bool(doc.get("reloaded")),
+                           degraded=str(doc.get("degraded") or ""))
         return doc
 
     def rollback(target_dir: str | None, rolled: list[str],

@@ -84,6 +84,7 @@ SPAN_LANES = {
     "secret.screen": "device_wait",
     "fleet.hedge": "fetch_io",
     "fleet.probe": "fetch_io",
+    "fleet.attempt": "fetch_io",
     "report": "report",
 }
 
@@ -243,6 +244,13 @@ def flight_n() -> int:
         return 8
 
 
+#: bounded ring of retained fleet-attempt trace fragments (hedged /
+#: failed-over dispatches tagged by the smart client) — kept SEPARATE
+#: from the slowest-scan heap so a losing hedge attempt never pollutes
+#: the per-scan records, yet stays pullable for cross-replica stitching
+FRAGMENT_RING = 32
+
+
 class FlightRecorder:
     """Bounded ring of the N slowest scan traces seen since the last
     reset — a live server keeps whole trace trees for exactly the scans
@@ -251,12 +259,20 @@ class FlightRecorder:
 
     Internally a min-heap keyed on wall seconds: a new scan evicts the
     CURRENT FASTEST retained trace once the ring is full, so the ring
-    converges on the true top-N slowest."""
+    converges on the true top-N slowest.
+
+    A second, separate ring retains fleet-attempt FRAGMENTS: server-
+    side trees of hedged/failed-over dispatches (tagged with their
+    attempt identity by the smart client). Fragments are not scans —
+    the losing attempt of a hedge race must not masquerade as a slow
+    scan — but the cross-replica stitcher (fleet/telemetry.py) pulls
+    them from `/debug/flight` to rebuild ONE trace per hedged request."""
 
     def __init__(self):
         self._lock = make_lock("obs.attrib.flight._lock")
         self._heap: list[tuple[float, int, dict, object]] = []
         self._seq = 0
+        self._fragments: deque = deque(maxlen=FRAGMENT_RING)
 
     def offer(self, root, rec: dict) -> None:
         n = flight_n()
@@ -273,19 +289,39 @@ class FlightRecorder:
             while len(self._heap) > n:
                 heapq.heappop(self._heap)
 
+    def offer_fragment(self, root, rec: dict) -> None:
+        """Retain a fleet-attempt fragment for the stitcher (newest
+        FRAGMENT_RING kept; disabled with the recorder itself)."""
+        if flight_n() <= 0:
+            return
+        with self._lock:
+            self._fragments.append((rec, root))
+
     def records(self) -> list[dict]:
-        """Retained scan records, slowest first."""
+        """Retained scan records, slowest first (fragments excluded)."""
         with self._lock:
             entries = sorted(self._heap, reverse=True)
         return [rec for _w, _s, rec, _r in entries]
 
+    def fragment_records(self) -> list[dict]:
+        with self._lock:
+            return [rec for rec, _r in self._fragments]
+
     def chrome_doc(self) -> dict:
         """Chrome trace-event JSON of every retained trace (slowest
-        first), the same shape --trace-export writes."""
+        first) plus the fleet-attempt fragments, the same shape
+        --trace-export writes."""
         with self._lock:
             entries = sorted(self._heap, reverse=True)
+            fragments = list(self._fragments)
         flat = []
         for _w, _s, _rec, root in entries:
+            stack = [root]
+            while stack:
+                s = stack.pop()
+                flat.append(s)
+                stack.extend(s.children)
+        for _rec, root in fragments:
             stack = [root]
             while stack:
                 s = stack.pop()
@@ -294,11 +330,13 @@ class FlightRecorder:
         return {"traceEvents": tracing.chrome_events(flat),
                 "displayTimeUnit": "ms",
                 "flightRecorder": {"n": flight_n(),
-                                   "traces": len(entries)}}
+                                   "traces": len(entries),
+                                   "fragments": len(fragments)}}
 
     def reset(self) -> None:
         with self._lock:
             self._heap.clear()
+            self._fragments.clear()
 
 
 # ----------------------------------------------------------- aggregator
@@ -323,6 +361,7 @@ class Aggregator:
         self._wall = 0.0
         self._scans = 0
         self._roots = 0
+        self._fragments = 0
         self._recent: deque = deque(maxlen=_RECENT)
 
     def reset(self) -> None:
@@ -331,9 +370,22 @@ class Aggregator:
         self.flight.reset()
 
     def observe_root(self, root) -> None:
-        """The obs.tracing sink: classify one finished root trace."""
+        """The obs.tracing sink: classify one finished root trace.
+
+        A root carrying a HEDGE attempt tag (``server.scan`` adopted
+        from one side of a raced dispatch) is a FRAGMENT of a scan
+        whose real root lives on the client: its lanes still fold into
+        the fleet totals (the server really did the work), but it is
+        not counted as a scan, never enters the per-scan records or
+        the slowest-scan ring — a losing hedge attempt must not
+        masquerade as an independent slow scan — and is retained in
+        the fragment ring for the cross-replica stitcher instead. A
+        FAILOVER retry (meta ``failover_attempt``) stays a full scan:
+        it is the scan's only server-side record."""
         rec = attribute_root(root)
-        is_scan = root.name in SCAN_ROOTS
+        is_fragment = (root.name in SCAN_ROOTS
+                       and root.meta.get("attempt") is not None)
+        is_scan = root.name in SCAN_ROOTS and not is_fragment
         with self._lock:
             self._roots += 1
             self._wall += rec["wall_s"]
@@ -345,6 +397,8 @@ class Aggregator:
             if is_scan:
                 self._scans += 1
                 self._recent.append(rec)
+            if is_fragment:
+                self._fragments += 1
         for lane, v in rec["busy"].items():
             if v > 0:
                 obs_metrics.ATTRIB_LANE_SECONDS.inc(v, lane=lane,
@@ -355,6 +409,8 @@ class Aggregator:
                                                     kind="critical")
         if is_scan:
             self.flight.offer(root, rec)
+        elif is_fragment:
+            self.flight.offer_fragment(root, rec)
 
     @staticmethod
     def _round_rec(rec: dict) -> dict:
@@ -402,6 +458,7 @@ class Aggregator:
                 "enabled": enabled(),
                 "scans": self._scans,
                 "roots": self._roots,
+                "fragments": self._fragments,
                 "wall_s": round(self._wall, 6),
                 "other_s": round(self._other, 6),
                 "lanes": lanes,

@@ -602,6 +602,28 @@ FLEET_ENDPOINT_HEALTH = REGISTRY.gauge(
     "Per-endpoint health from the /readyz JSON prober (1 ready, "
     "0 not ready/unreachable/removed)",
     labels=("endpoint",))
+FLEET_REPLICA_HEALTHY = REGISTRY.gauge(
+    "trivy_tpu_fleet_replica_healthy",
+    "Per-endpoint ROUTABLE verdict after a health-prober pass: 1 = the "
+    "picker will route to this replica (ready AND its circuit breaker "
+    "is not open), 0 = skipped (not ready, unreachable, or breaker "
+    "open) — the raw /readyz verdict alone is "
+    "trivy_tpu_fleet_endpoint_healthy",
+    labels=("endpoint",))
+FLEET_PROBE_SECONDS = REGISTRY.histogram(
+    "trivy_tpu_fleet_probe_seconds",
+    "Wall seconds per background /readyz health probe, by endpoint — "
+    "a replica whose probe latency is an outlier vs the fleet median "
+    "is flagged as replica skew in the fleet event log",
+    labels=("endpoint",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             1.0, 5.0))
+FLEET_EVENTS = REGISTRY.counter(
+    "trivy_tpu_fleet_events_total",
+    "Fleet ops events emitted into the event bus by kind (the durable "
+    "journal + /events tail carry the full records — docs/fleet.md "
+    "'Event catalog')",
+    labels=("kind",))
 FLEET_DEDUPE_CLAIMS = REGISTRY.counter(
     "trivy_tpu_fleet_dedupe_claims_total",
     "Distributed (redis-backed) layer-claim outcomes across the "

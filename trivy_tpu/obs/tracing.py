@@ -108,6 +108,16 @@ _scan_id: contextvars.ContextVar[str] = contextvars.ContextVar(
 # next root span opened in this context joins the caller's trace
 _remote_link: contextvars.ContextVar[tuple[str, str] | None] = \
     contextvars.ContextVar("trivy_tpu_remote_link", default=None)
+# fleet attempt identity (attempt index, endpoint index, kind): set by
+# the smart client around hedged/failed-over dispatches so the
+# outgoing X-Trivy-Trace header tags WHICH attempt a server-side trace
+# fragment belongs to — the cross-replica stitcher joins fragments by
+# this tag (docs/observability.md "Fleet observability"). kind "hedge"
+# marks a raced duplicate (the server-side tree is a FRAGMENT of one
+# scan); kind "failover" marks a sequential retry whose tree is the
+# scan's only server-side record and still counts as a scan.
+_attempt_tag: contextvars.ContextVar[tuple[int, int, str] | None] = \
+    contextvars.ContextVar("trivy_tpu_attempt_tag", default=None)
 
 # finished root spans; generation guards reset() against spans still
 # closing on other threads (their append is simply dropped)
@@ -351,24 +361,81 @@ def scan_scope(scan_id: str | None = None, force: bool = False):
 
 def inject_headers(headers: dict) -> None:
     """Client side: stamp the current span's identity into the outgoing
-    request so the server's spans join this trace."""
+    request so the server's spans join this trace. Under an
+    :func:`attempt_scope` the header additionally carries
+    ``-<attempt>.<endpoint>`` so the server-side fragment is
+    attributable to ONE dispatch of a hedged/failed-over request."""
     s = _current.get()
-    if _enabled and s is not None:
-        headers[TRACE_HEADER] = f"{s.trace_id}-{s.span_id}"
+    if (_enabled or _sink is not None) and s is not None:
+        value = f"{s.trace_id}-{s.span_id}"
+        tag = _attempt_tag.get()
+        if tag is not None:
+            value += f"-{tag[0]}.{tag[1]}"
+            if tag[2] == "failover":
+                value += ".f"
+        headers[TRACE_HEADER] = value
+
+
+@contextlib.contextmanager
+def attempt_scope(attempt: int, endpoint: int, kind: str = "hedge"):
+    """Tag every request injected inside this scope with its fleet
+    dispatch identity (attempt index + endpoint index). The smart
+    client opens one scope per hedged (kind="hedge") or failed-over
+    (kind="failover") dispatch; plain single-dispatch requests stay
+    untagged (byte-identical header)."""
+    token = _attempt_tag.set((int(attempt), int(endpoint), kind))
+    try:
+        yield
+    finally:
+        _attempt_tag.reset(token)
+
+
+def current_attempt_tag() -> tuple[int, int, str] | None:
+    """The ambient fleet dispatch identity, or None outside an
+    attempt_scope (the RPC client stamps it onto its span meta so the
+    stitched cross-replica trace shows which attempt each client-side
+    round trip belonged to)."""
+    return _attempt_tag.get()
 
 
 def parse_trace_header(value: str | None) -> tuple[str, str] | None:
-    """'<32-hex trace>-<16-hex span>' -> (trace_id, parent_span_id)."""
+    """'<32-hex trace>-<16-hex span>[-<attempt>.<endpoint>]' ->
+    (trace_id, parent_span_id). The optional third segment (a fleet
+    attempt tag) is parsed separately by :func:`parse_attempt_tag`."""
     if not value:
         return None
-    trace_id, sep, span_id = value.partition("-")
-    if not sep or not trace_id or not span_id:
+    parts = value.split("-")
+    if len(parts) < 2 or not parts[0] or not parts[1]:
         return None
+    trace_id, span_id = parts[0], parts[1]
     try:
         int(trace_id, 16), int(span_id, 16)
     except ValueError:
         return None
     return trace_id, span_id
+
+
+def parse_attempt_tag(value: str | None) -> tuple[int, int, str] | None:
+    """The '<attempt>.<endpoint>[.f]' segment of an extended trace
+    header -> (attempt_index, endpoint_index, kind) where kind is
+    "failover" for the ``.f`` suffix and "hedge" otherwise, or None
+    when the header is the legacy two-part form (or malformed — never
+    an error: tagging only enriches, correctness never depends on
+    it)."""
+    if not value:
+        return None
+    parts = value.split("-")
+    if len(parts) < 3:
+        return None
+    fields = parts[2].split(".")
+    if len(fields) < 2:
+        return None
+    try:
+        attempt, endpoint = int(fields[0]), int(fields[1])
+    except ValueError:
+        return None
+    kind = "failover" if fields[2:3] == ["f"] else "hedge"
+    return attempt, endpoint, kind
 
 
 @contextlib.contextmanager

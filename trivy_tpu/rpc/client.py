@@ -258,12 +258,25 @@ class _Conn:
 
     # ------------------------------------------------------------ post
 
+    @staticmethod
+    def _span_meta(base: str) -> dict:
+        # a fleet dispatch (hedged / failover retry) carries its
+        # attempt identity on the client span too, so the stitched
+        # cross-replica trace shows which attempt each round trip
+        # belonged to (fleet/telemetry.py)
+        meta = {"url": base}
+        tag = tracing.current_attempt_tag()
+        if tag is not None:
+            meta["attempt"] = str(tag[0])
+            meta["endpoint"] = str(tag[1])
+        return meta
+
     def post(self, path: str, body: bytes) -> bytes:
         # one client span covers the whole retried call; the trace
         # identity rides X-Trivy-Trace so the server's handler span
         # becomes this span's child (docs/observability.md)
         method = path.rsplit("/", 1)[-1]
-        with tracing.span(f"rpc.{method}", url=self.base):
+        with tracing.span(f"rpc.{method}", **self._span_meta(self.base)):
             return self._post_attempts(path, method, body)
 
     def post_once(self, path: str, body: bytes) -> bytes:
@@ -273,7 +286,7 @@ class _Conn:
         _request_once still applies — it is transport plumbing, not a
         retry)."""
         method = path.rsplit("/", 1)[-1]
-        with tracing.span(f"rpc.{method}", url=self.base):
+        with tracing.span(f"rpc.{method}", **self._span_meta(self.base)):
             return self._post_attempts(path, method, body, attempts=1)
 
     def _post_attempts(self, path: str, method: str, body: bytes,

@@ -957,10 +957,25 @@ def _make_handler(service: ScanService, token: str | None,
                 self.headers.get(DEADLINE_HEADER))
             # adopt the caller's trace identity (X-Trivy-Trace) so the
             # server-side phases nest under the client's RPC span — a
-            # remote scan renders as one stitched tree
+            # remote scan renders as one stitched tree. A hedged
+            # dispatch additionally carries its attempt identity
+            # (attempt index + endpoint index): the "attempt" meta
+            # makes this tree a FRAGMENT of the client's scan —
+            # retained for the cross-replica stitcher, never counted
+            # as its own scan (obs/attrib.py, fleet/telemetry.py). A
+            # FAILOVER retry is tagged too (failover_attempt) but
+            # stays a full scan: unlike a hedge race it is the scan's
+            # only server-side record.
+            trace_header = self.headers.get(tracing.TRACE_HEADER)
+            extra = {}
+            tag = tracing.parse_attempt_tag(trace_header)
+            if tag is not None:
+                key = ("failover_attempt" if tag[2] == "failover"
+                       else "attempt")
+                extra = {key: str(tag[0]), "endpoint": str(tag[1])}
             with tracing.server_span(
-                    "server.scan", self.headers.get(tracing.TRACE_HEADER),
-                    target=target):
+                    "server.scan", trace_header,
+                    target=target, **extra):
                 try:
                     results, os_found = service.scan(
                         target, akey, blobs, options, deadline=deadline)
