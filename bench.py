@@ -1021,6 +1021,218 @@ def bench_mesh() -> dict:
                      f"(rc={proc.returncode}): {proc.stderr[-2000:]}"}
 
 
+def _bench_dcn_child() -> int:
+    """Child half of bench_dcn: a 4-virtual-CPU-device coordinator
+    serving a synthetic advisory DB whose row footprint EXCEEDS one
+    host's configured HBM budget across a 2-process distributed
+    MeshDB (ops/dcn.py, one spawned worker), measured against the
+    sequential oracle and the single-host ceiling, with a host-loss
+    rung and a warm-start (slice-cache) guard.  Prints ONE JSON line
+    on stdout."""
+    import shutil
+    import statistics
+    import tempfile
+
+    os.environ.setdefault("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in \
+            os.environ["XLA_FLAGS"]:
+        os.environ["XLA_FLAGS"] += \
+            " --xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.obs import metrics as _obs
+    from trivy_tpu.ops import dcn as dcn_ops
+    from trivy_tpu.ops import mesh as mesh_ops
+    from trivy_tpu.ops.match import TABLE_LANES
+    from trivy_tpu.resilience import faults
+    from trivy_tpu.tensorize.synth import synth_trivy_db
+
+    n_adv = int(os.environ.get("TRIVY_TPU_BENCH_DCN_ADVISORIES",
+                               "320000"))
+    n_q = int(os.environ.get("TRIVY_TPU_BENCH_DCN_QUERIES", "40000"))
+    db = synth_trivy_db(n_advisories=n_adv)
+    queries = build_queries(db, n_q, seed=23)
+
+    oracle_engine = MatchEngine(db, use_device=False)
+    oracle = [r.adv_indices for r in
+              oracle_engine.detect_many(queries, batch_size=65536)]
+    rows = int(oracle_engine.cdb.n_rows)
+    row_bytes = 4 * (1 + TABLE_LANES)
+
+    # the acceptance shape: size the per-device budget so ONE host's 4
+    # devices cannot hold the table (4·B < rows·36 B) while each of
+    # the 8 global shards of a 2-host 2x1x4 layout fits (B >= slice8).
+    # The arithmetic below re-reads the budget through the resolver's
+    # own (floor-clamped) parser so the gate judges the exact number
+    # the auto topology used; the default DB size keeps the real
+    # budget above that floor.
+    n_local = 4
+    slice8 = -(-rows // (2 * n_local)) * row_bytes
+    os.environ["TRIVY_TPU_MESH_HBM_GB"] = str(slice8 * 1.05 / 1e9)
+    os.environ[dcn_ops.ENV_DCN] = "spawn"
+    budget_bytes = mesh_ops._hbm_budget_bytes()
+    single_host_capacity = n_local * budget_bytes
+    exceeds_single_host = rows * row_bytes > single_host_capacity
+
+    tmp = tempfile.mkdtemp(prefix="trivy_tpu_bench_dcn_db_")
+    doc: dict = {}
+    try:
+        db.save(tmp, compress=False)
+        t0 = time.time()
+        engine = MatchEngine(db, mesh_spec="auto", db_path=tmp)
+        cold_build_s = time.time() - t0
+        health = engine.shard_health()
+        assert health and health.get("hosts") == 2, health
+        shape = health["shape"]
+
+        # single-host ceiling: the same box, all 4 local devices, the
+        # WHOLE table resident (what the budget says one host cannot
+        # do — measured here as the overlap reference)
+        ceiling = MatchEngine(db, mesh=mesh_ops.build_mesh(1, n_local))
+
+        engine.detect(queries[:2048])  # warm jit both paths
+        ceiling.detect(queries[:2048])
+        engine._crawl_cache.clear()
+        ceiling._crawl_cache.clear()
+
+        walls: dict = {"dcn": [], "single": []}
+        diffs = 0
+        snap0 = _obs.DCN_HOST_DISPATCH_SECONDS.snapshot(host="1")
+        for _round in range(3):
+            for key, e in (("dcn", engine), ("single", ceiling)):
+                e._crawl_cache.clear()
+                t0 = time.time()
+                res = e.detect_many(queries, batch_size=65536)
+                walls[key].append(time.time() - t0)
+                diffs += sum(1 for a, b in zip(res, oracle)
+                             if a.adv_indices != b)
+        snap1 = _obs.DCN_HOST_DISPATCH_SECONDS.snapshot(host="1")
+        dcn_wall = statistics.median(walls["dcn"])
+        single_wall = statistics.median(walls["single"])
+        # the per-host dispatch overlap the rung exists to measure:
+        # the engine.host span times only the coordinator's WAIT on
+        # the remote host (requests go out at dispatch time, before
+        # the local cells and the host crunch run), so overlap =
+        # 1 - wait/wall — a fully-overlapped remote host costs the
+        # coordinator ~zero blocked seconds
+        remote_wait_s = snap1[1] - snap0[1]
+        remote_dispatches = snap1[2] - snap0[2]
+        overlap = max(0.0, 1.0 - remote_wait_s
+                      / max(sum(walls["dcn"]), 1e-9))
+
+        # host-loss rung: lose the worker mid-flight; byte-identical
+        # findings with the host's slice on the coordinator host mask
+        faults.install_spec("engine.host:device-lost@1")
+        engine._crawl_cache.clear()
+        res = engine.detect_many(queries, batch_size=65536)
+        faults.reset()
+        host_loss_diff = sum(1 for a, b in zip(res, oracle)
+                             if a.adv_indices != b)
+        health = engine.shard_health()
+        host_loss_degraded = list(health["degraded_hosts"])
+        engine.close()
+        ceiling.close()
+
+        # warm start: compile + slice load from the cache (worker
+        # warm-loads only its slice entry)
+        t0 = time.time()
+        warm = MatchEngine(db, mesh_spec="auto", db_path=tmp)
+        warm_build_s = time.time() - t0
+        warm_sources = warm._mdb.host_sources()
+        warm.close()
+
+        doc = {
+            "advisories": n_adv,
+            "db_rows": rows,
+            "queries": n_q,
+            "mesh": shape,
+            "hbm_budget_mb": round(budget_bytes / 1e6, 2),
+            "db_tensor_mb": round(rows * row_bytes / 1e6, 2),
+            "exceeds_single_host_budget": exceeds_single_host,
+            "dcn_diff_vs_oracle": diffs,
+            "dcn_pkg_per_s": round(n_q / dcn_wall),
+            "single_host_pkg_per_s": round(n_q / single_wall),
+            "dcn_vs_single_host": round(single_wall / dcn_wall, 2),
+            "remote_dispatches": int(remote_dispatches),
+            "remote_wait_s": round(remote_wait_s, 4),
+            "remote_host_overlap": round(overlap, 3),
+            "host_loss_diff_vs_oracle": host_loss_diff,
+            "host_loss_degraded_hosts": host_loss_degraded,
+            "cold_build_s": round(cold_build_s, 2),
+            "warm_build_s": round(warm_build_s, 2),
+            "warm_speedup": round(cold_build_s / warm_build_s, 2)
+            if warm_build_s else 0.0,
+            "warm_slice_sources": warm_sources,
+        }
+        print(json.dumps(doc))
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_dcn() -> dict:
+    """Cross-host sharded serving (ROADMAP open item 2, ISSUE 15): the
+    2-process distributed MeshDB serving a DB too big for one host's
+    configured HBM budget at zero diff vs the sequential oracle — run
+    in a subprocess that forces a 4-virtual-CPU-device coordinator
+    (the worker subprocess brings its own 4), like the other mesh
+    benches."""
+    import subprocess
+
+    env = {
+        **os.environ,
+        "TRIVY_TPU_BENCH_DCN_CHILD": "1",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }
+    env.pop("TRIVY_TPU_BENCH_CHILD", None)
+    env.pop("TRIVY_TPU_BENCH_MESH_CHILD", None)
+    env.pop("TRIVY_TPU_BENCH_CAPSTONE_CHILD", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        return {"error": "dcn bench child timed out"}
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                break
+    return {"error": "dcn bench child failed "
+                     f"(rc={proc.returncode}): {proc.stderr[-2000:]}"}
+
+
+def dcn_gates(detail: dict) -> list[str]:
+    """Exit-gate verdicts for the --dcn rung: every string returned is
+    a failed gate (empty = green).  Gate 1 is the acceptance bar: a DB
+    bigger than one host's budget served across 2 processes at zero
+    diff; gate 2 is the host-loss parity; gate 3 the warm-start
+    (slice-cache) guard."""
+    fails = []
+    if detail.get("error"):
+        return [f"dcn_error {detail['error']}"]
+    if detail.get("dcn_diff_vs_oracle") != 0:
+        fails.append(f"dcn_diff_vs_oracle={detail.get('dcn_diff_vs_oracle')}")
+    if not detail.get("exceeds_single_host_budget"):
+        fails.append("db_fits_single_host_budget")
+    if not detail.get("remote_dispatches"):
+        fails.append("remote_host_never_dispatched")
+    if detail.get("host_loss_diff_vs_oracle") != 0:
+        fails.append("host_loss_diff_vs_oracle="
+                     f"{detail.get('host_loss_diff_vs_oracle')}")
+    if detail.get("host_loss_degraded_hosts") != [1]:
+        fails.append("host_loss_not_degraded")
+    if detail.get("warm_speedup", 0) < 1.2:
+        fails.append(f"warm_speedup={detail.get('warm_speedup')}<1.2")
+    return fails
+
+
 def _capstone_mk_layer(tag: str, pkgs: list, rng, planted: bool) -> bytes:
     """One synthetic gzipped layer tar: an npm lockfile drawing from
     the advisory DB's own package pool (so CVE matches occur), filler
@@ -2046,6 +2258,25 @@ def main():
         return _bench_mesh_child()
     if os.environ.get("TRIVY_TPU_BENCH_CAPSTONE_CHILD"):
         return _bench_capstone_child()
+    if os.environ.get("TRIVY_TPU_BENCH_DCN_CHILD"):
+        return _bench_dcn_child()
+    if "--dcn" in sys.argv:
+        # standalone cross-host serving rung (CPU-only; the
+        # coordinator + worker subprocesses force their own virtual
+        # devices): the quick way to refresh BENCH_dcn.json.  Runs the
+        # invariant-lint gate like every supervised rung.
+        lint_rc = _lint_gate()
+        detail = bench_dcn()
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_dcn.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(detail, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(detail, indent=2, sort_keys=True))
+        fails = dcn_gates(detail)
+        for f_ in fails:
+            print(f"BENCH_STATUS=dcn_gate_failed {f_}", file=sys.stderr)
+        return 1 if (fails or lint_rc) else 0
     if "--fleetobs" in sys.argv:
         # standalone federation rung (CPU-only, no device probe): the
         # quick way to refresh BENCH_fleetobs.json

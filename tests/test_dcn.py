@@ -1,0 +1,555 @@
+"""Cross-host distributed-MeshDB tests (trivy_tpu/ops/dcn.py): the
+production 2-process serving path must be byte-identical to the
+sequential oracle at every host×dp×db shape, under the whole
+`engine.host` degradation ladder (drop-resend, error-retry-degrade,
+device-lost, real worker death), with per-host slice-cache keying +
+corrupt-entry quarantine, hot reload keeping the host topology, and
+the /readyz + fleet surfaces reporting host degradation.
+
+Harness: the pytest process IS the coordinator (conftest forces 8
+virtual CPU devices, enough local room for every dp×db per-host
+shape); ONE worker subprocess is shared module-wide in endpoint mode
+(`TRIVY_TPU_DCN=host:port`) — each engine's hello re-loads the
+worker's slice, so successive tests reuse the process.  Tests that
+must kill or respawn a worker use spawn mode privately.  Skips
+cleanly when a worker subprocess cannot come up (like test_dcn_dryrun
+does for its runtime)."""
+
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from trivy_tpu.ops import dcn as dcn_ops
+from trivy_tpu.ops import mesh as mesh_ops
+
+pytestmark = [
+    pytest.mark.dcn,
+    pytest.mark.skipif(not mesh_ops.multi_device_ready(8),
+                       reason="multi-device runtime absent "
+                              "(needs 8 devices)"),
+]
+
+from test_match import _random_db, _random_queries  # noqa: E402
+
+from trivy_tpu.detector.engine import MatchEngine  # noqa: E402
+from trivy_tpu.obs import metrics as obs_metrics  # noqa: E402
+from trivy_tpu.resilience import faults  # noqa: E402
+
+
+def _spawn_worker_proc(n_devices: int = 8):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_devices}")
+    env.pop("TRIVY_TPU_MESH", None)
+    env.pop(dcn_ops.ENV_DCN, None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "trivy_tpu.ops.dcn", "--worker",
+         "--port", "0"],
+        env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    deadline = time.monotonic() + 120
+    port = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line or line.startswith("DCN_WORKER_READY"):
+            if line:
+                port = int(line.split("port=")[1].strip())
+            break
+    if port is None:
+        proc.kill()
+        return None, None
+    return proc, f"127.0.0.1:{port}"
+
+
+@pytest.fixture(scope="module")
+def worker():
+    """ONE shared worker subprocess for the whole module (endpoint
+    mode); each engine's hello swaps its resident slice."""
+    proc, endpoint = _spawn_worker_proc()
+    if endpoint is None:
+        pytest.skip("DCN worker subprocess failed to come up")
+    yield endpoint
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def db():
+    return _random_db(random.Random(42))
+
+
+@pytest.fixture(scope="module")
+def queries():
+    return _random_queries(random.Random(13), n=500)
+
+
+@pytest.fixture(scope="module")
+def oracle(db, queries):
+    e = MatchEngine(db, window=32, use_device=False)
+    return [r.adv_indices for r in e.oracle_detect(queries)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def dcn_env(worker, monkeypatch):
+    monkeypatch.setenv(dcn_ops.ENV_DCN, worker)
+    yield worker
+
+
+def _dcn_engine(db, spec, **kw):
+    return MatchEngine(db, window=32, mesh_spec=spec, **kw)
+
+
+def _hits(engine, queries):
+    return [r.adv_indices for r in engine.detect(queries)]
+
+
+# ------------------------------------------------------------- spec/topology
+
+
+def test_parse_spec_hosts():
+    assert mesh_ops.parse_spec("2x1x2") == (2, 1, 2)
+    assert mesh_ops.parse_spec(" 2 X 2 x 4 ") == (2, 2, 4)
+    # a 1-host 3-field spec collapses onto the plain local mesh
+    assert mesh_ops.parse_spec("1x2x4") == (2, 4)
+    with pytest.raises(ValueError, match=">= 1"):
+        mesh_ops.parse_spec("0x1x2")
+    with pytest.raises(ValueError, match="bad mesh spec"):
+        mesh_ops.parse_spec("2x1x2x2")
+
+
+def test_spec_spanning_hosts_requires_dcn(db, monkeypatch):
+    monkeypatch.delenv(dcn_ops.ENV_DCN, raising=False)
+    with pytest.raises(ValueError, match="TRIVY_TPU_DCN"):
+        MatchEngine(db, window=32, mesh_spec="2x1x2")
+    # and the local-mesh builder refuses to eat a cross-host spec
+    with pytest.raises(ValueError, match="spans hosts"):
+        mesh_ops.build_from_spec("2x1x2", n_rows=100)
+
+
+def test_endpoint_count_must_match_spec(db, monkeypatch):
+    monkeypatch.setenv(dcn_ops.ENV_DCN, "127.0.0.1:1,127.0.0.1:2")
+    with pytest.raises(ValueError, match="needs 1 workers"):
+        MatchEngine(db, window=32, mesh_spec="2x1x2")
+
+
+def test_configured_workers_parse(monkeypatch):
+    monkeypatch.delenv(dcn_ops.ENV_DCN, raising=False)
+    assert dcn_ops.configured_workers() is None
+    monkeypatch.setenv(dcn_ops.ENV_DCN, "spawn")
+    assert dcn_ops.configured_workers() == "spawn"
+    monkeypatch.setenv(dcn_ops.ENV_DCN, "spawn:3")
+    assert dcn_ops.configured_workers() == 3
+    monkeypatch.setenv(dcn_ops.ENV_DCN, "a:1, b:2")
+    assert dcn_ops.configured_workers() == ["a:1", "b:2"]
+    monkeypatch.setenv(dcn_ops.ENV_DCN, "nocolon")
+    with pytest.raises(ValueError, match="host:port"):
+        dcn_ops.configured_workers()
+
+
+def test_spawn_count_must_match_spec(db, monkeypatch):
+    # an explicit spawn COUNT disagreeing with an explicit spec is an
+    # operator error, not a silent 2-host fleet
+    monkeypatch.setenv(dcn_ops.ENV_DCN, "spawn:4")
+    with pytest.raises(ValueError, match="spawn:4"):
+        MatchEngine(db, window=32, mesh_spec="2x1x2")
+    # bare "spawn" sizes itself from the spec
+    monkeypatch.setenv(dcn_ops.ENV_DCN, "spawn")
+    assert dcn_ops.plan_from_spec("2x1x2", n_rows=100) == (2, 1, 2)
+
+
+def test_choose_host_topology(monkeypatch):
+    # a DB that fits one shard: everything goes to data
+    assert dcn_ops.choose_host_topology(2, 4, 10_000) == (4, 1)
+    # shrink the budget until the GLOBAL slice needs every local shard
+    monkeypatch.setenv(mesh_ops.ENV_HBM, "0.001")  # 1 MB
+    assert dcn_ops.choose_host_topology(2, 4, 1_000_000) == (1, 4)
+    # two hosts halve the per-shard rows vs the single-host choice
+    monkeypatch.setenv(mesh_ops.ENV_HBM, "0.01")  # ~277k rows/shard
+    assert dcn_ops.choose_host_topology(1, 8, 500_000) == (4, 2)
+    assert dcn_ops.choose_host_topology(2, 8, 500_000) == (8, 1)
+
+
+# ------------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize("spec,dp,db_local",
+                         [("2x1x2", 1, 2), ("2x2x2", 2, 2),
+                          ("2x2x4", 2, 4)])
+def test_zero_diff_all_host_shapes(db, queries, oracle, dcn_env,
+                                   spec, dp, db_local):
+    e = _dcn_engine(db, spec)
+    try:
+        h = e.shard_health()
+        assert h == {"shape": spec, "data": dp, "db": 2 * db_local,
+                     "degraded": [], "hosts": 2, "degraded_hosts": []}
+        # every global shard is halo-padded; the remote host's slices
+        # use the same global partition
+        assert e._mdb.shard_len > e._mdb.shard_base
+        assert _hits(e, queries) == oracle
+        # crawl + scheduler entry points ride the same dispatch
+        crawl = e.detect_many(queries, batch_size=128, depth=2)
+        assert [r.adv_indices for r in crawl] == oracle
+        lists = [queries[:200], queries[200:201], queries[201:]]
+        flat = [r.adv_indices for rs in e.submit(lists) for r in rs]
+        assert flat == oracle
+    finally:
+        e.close()
+
+
+def test_sched_probes_compose_with_host_grid(db, queries, oracle,
+                                             dcn_env):
+    e = _dcn_engine(db, "2x2x2")
+    try:
+        assert e.mesh_data_axis == 2  # the LOCAL data axis
+        assert _hits(e, queries) == oracle
+        assert e.mesh_row_floor >= 128  # local grid ratcheted a bucket
+    finally:
+        e.close()
+
+
+# ----------------------------------------------------------- fault ladder
+
+
+@pytest.mark.fault
+def test_host_error_retried_then_healthy(db, queries, oracle, dcn_env):
+    faults.install_spec("engine.host:error@1")
+    e = _dcn_engine(db, "2x1x1")
+    try:
+        assert _hits(e, queries) == oracle
+        assert e.shard_health()["degraded_hosts"] == []  # retry healed
+    finally:
+        e.close()
+
+
+@pytest.mark.fault
+def test_host_error_exhausts_retries_degrades(db, queries, oracle,
+                                              dcn_env):
+    faults.install_spec("engine.host:error@1-2")
+    e = _dcn_engine(db, "2x1x1")
+    try:
+        assert _hits(e, queries) == oracle
+        assert e.shard_health()["degraded_hosts"] == [1]
+        # a later crawl on the degraded engine stays byte-identical
+        faults.reset()
+        assert _hits(e, queries) == oracle
+        assert e.shard_health()["degraded_hosts"] == [1]
+    finally:
+        e.close()
+
+
+@pytest.mark.fault
+def test_host_device_lost_mid_flight(db, queries, oracle, dcn_env):
+    before = obs_metrics.DCN_HOST_DEGRADATIONS.value(host="1")
+    e = _dcn_engine(db, "2x1x2")
+    try:
+        assert _hits(e, queries[:100]) == oracle[:100]  # healthy first
+        faults.install_spec("engine.host:device-lost@1")
+        assert _hits(e, queries) == oracle
+        h = e.shard_health()
+        assert h["degraded_hosts"] == [1]
+        assert h["degraded"] == []  # the local slice stays on-device
+        assert obs_metrics.DCN_HOST_DEGRADATIONS.value(host="1") \
+            == before + 1
+    finally:
+        e.close()
+
+
+@pytest.mark.fault
+def test_host_drop_resends(db, queries, oracle, dcn_env):
+    faults.install_spec(
+        "engine.host:drop@1;engine.host:delay=0.001@2")
+    e = _dcn_engine(db, "2x1x1")
+    try:
+        assert _hits(e, queries) == oracle
+        assert e.shard_health()["degraded_hosts"] == []
+    finally:
+        e.close()
+
+
+@pytest.mark.fault
+def test_local_shard_ladder_still_works(db, queries, oracle, dcn_env):
+    # engine.shard fires for the coordinator's OWN cells, independent
+    # of the host ladder
+    faults.install_spec("engine.shard:device-lost@1")
+    e = _dcn_engine(db, "2x1x2")
+    try:
+        assert _hits(e, queries) == oracle
+        h = e.shard_health()
+        assert h["degraded"] == [0] and h["degraded_hosts"] == []
+    finally:
+        e.close()
+
+
+def test_real_worker_death_degrades_host(db, queries, oracle,
+                                         monkeypatch):
+    monkeypatch.setenv(dcn_ops.ENV_DCN, "spawn")
+    e = _dcn_engine(db, "2x1x1")
+    try:
+        assert _hits(e, queries[:100]) == oracle[:100]
+        # kill the worker subprocess mid-service: the next collect's
+        # transport failure rides the engine.host ladder into the
+        # host-mask, byte-identically
+        e._mdb.hosts[0].proc.kill()
+        assert _hits(e, queries) == oracle
+        assert e.shard_health()["degraded_hosts"] == [1]
+    finally:
+        e.close()
+
+
+# ------------------------------------------------------- host-slice cache
+
+
+def _saved_db_dir(db, tmp_path):
+    root = str(tmp_path / "db")
+    db.save(root, compress=False)
+    return root
+
+
+def test_host_slice_cache_warm_start(db, queries, oracle, dcn_env,
+                                     tmp_path):
+    from trivy_tpu.tensorize import cache as compile_cache
+
+    root = _saved_db_dir(db, tmp_path)
+    e1 = _dcn_engine(db, "2x1x2", db_path=root)
+    try:
+        assert _hits(e1, queries) == oracle
+    finally:
+        e1.close()
+    digest = compile_cache.db_digest(root)
+    for h in (0, 1):
+        p = compile_cache.host_slice_entry_path(root, digest, 32, 2, h,
+                                                4)
+        assert os.path.exists(p), p
+        assert p.endswith(f".dcn2h{h}.mesh4.npz")
+    hits0 = obs_metrics.COMPILE_CACHE_HITS.value()
+    e2 = _dcn_engine(db, "2x1x2", db_path=root)
+    try:
+        # coordinator warm-loads its own slice; the worker reports its
+        # slice came from the cache, not a push
+        assert obs_metrics.COMPILE_CACHE_HITS.value() > hits0
+        assert e2._mdb.host_sources() == ["cache"]
+        assert _hits(e2, queries) == oracle
+    finally:
+        e2.close()
+
+
+def test_host_slice_cache_keyed_by_topology(db, dcn_env, tmp_path):
+    from trivy_tpu.tensorize import cache as compile_cache
+
+    root = _saved_db_dir(db, tmp_path)
+    e = _dcn_engine(db, "2x1x2", db_path=root)
+    e.close()
+    digest = compile_cache.db_digest(root)
+    # a different host count / db axis is a different entry set
+    assert not os.path.exists(compile_cache.host_slice_entry_path(
+        root, digest, 32, 2, 0, 2))
+    assert not os.path.exists(compile_cache.host_slice_entry_path(
+        root, digest, 32, 3, 0, 4))
+
+
+def test_host_slice_corrupt_entry_quarantined(db, queries, oracle,
+                                              dcn_env, tmp_path):
+    from trivy_tpu.tensorize import cache as compile_cache
+
+    root = _saved_db_dir(db, tmp_path)
+    e1 = _dcn_engine(db, "2x1x1", db_path=root)
+    e1.close()
+    digest = compile_cache.db_digest(root)
+    path = compile_cache.host_slice_entry_path(root, digest, 32, 2, 1, 2)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0x01  # silent bit rot in the WORKER's entry
+    with open(path, "wb") as f:  # lint: allow[atomic-write] test seeds deliberate corruption in place
+        f.write(bytes(raw))
+    e2 = _dcn_engine(db, "2x1x1", db_path=root)
+    try:
+        # the worker quarantined its corrupt entry and fell back to a
+        # coordinator push — zero diff either way
+        assert e2._mdb.host_sources() == ["push"]
+        assert _hits(e2, queries) == oracle
+        assert os.path.exists(path + compile_cache.QUARANTINE_SUFFIX)
+    finally:
+        e2.close()
+
+
+# ------------------------------------------- server + fleet surfaces
+
+
+def test_readyz_and_doc_report_host_topology(db, queries, oracle,
+                                             dcn_env):
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.rpc.server import ScanService
+
+    e = _dcn_engine(db, "2x1x2")
+    svc = ScanService(e, MemoryCache())
+    try:
+        ok, why = svc.ready()
+        assert ok and "mesh 2x1x2" in why and "degraded" not in why
+        doc = svc.ready_doc()
+        assert doc["mesh"] == {"shape": "2x1x2", "degraded": [],
+                               "hosts": 2, "degraded_hosts": []}
+        faults.install_spec("engine.host:device-lost@1")
+        assert _hits(e, queries) == oracle
+        faults.reset()
+        ok, why = svc.ready()
+        assert ok, why  # a degraded host serves on, like last-good
+        assert "host(s) 1 degraded to host-mask" in why
+        doc = svc.ready_doc()
+        assert doc["mesh"]["degraded_hosts"] == [1]
+        assert doc["mesh"]["hosts"] == 2
+    finally:
+        if svc.scheduler is not None:
+            svc.scheduler.close()
+        e.close()
+
+
+def test_skew_detector_emits_on_host_degradation():
+    from trivy_tpu.fleet import slo
+
+    events = []
+    orig = slo.emit_event
+
+    def capture(kind, **fields):
+        events.append((kind, fields))
+        return orig(kind, **fields)
+
+    det = slo.SkewDetector()
+    base = {"endpoint": "http://r0", "ready": True,
+            "generation": "sha256-aaa", "probe_s": 0.01}
+    healthy = dict(base, mesh={"shape": "2x1x2", "degraded": [],
+                               "hosts": 2, "degraded_hosts": []})
+    lost = dict(base, mesh={"shape": "2x1x2", "degraded": [],
+                            "hosts": 2, "degraded_hosts": [1]})
+    slo.emit_event, _saved = capture, orig
+    try:
+        det.observe([healthy])
+        assert not [e for e in events if e[0] == "shard_degraded"]
+        det.observe([lost])  # transition fires exactly once
+        det.observe([lost])
+        got = [e for e in events if e[0] == "shard_degraded"]
+        assert len(got) == 1
+        assert got[0][1]["hosts"] == [1] and not got[0][1]["recovered"]
+        det.observe([healthy])  # recovery fires once
+        got = [e for e in events if e[0] == "shard_degraded"]
+        assert len(got) == 2 and got[1][1]["recovered"]
+    finally:
+        slo.emit_event = _saved
+
+
+def test_hot_reload_keeps_host_topology(db, queries, monkeypatch,
+                                        tmp_path):
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.db import generations
+    from trivy_tpu.db.store import AdvisoryDB as StoreDB
+    from trivy_tpu.rpc.server import ScanService
+
+    monkeypatch.setenv(dcn_ops.ENV_DCN, "spawn")
+    root = str(tmp_path / "db")
+    gen1 = os.path.join(generations.generations_root(root), "sha256-aaa")
+    os.makedirs(gen1)
+    db.meta.updated_at = "2024-01-01T00:00:00Z"
+    db.save(gen1)
+    generations.promote(root, gen1)
+    e = MatchEngine(StoreDB.load(root), window=32, mesh_spec="2x1x1",
+                    db_path=root)
+    svc = ScanService(e, MemoryCache(), db_path=root)
+    try:
+        gen2 = os.path.join(generations.generations_root(root),
+                            "sha256-bbb")
+        os.makedirs(gen2)
+        db.meta.updated_at = "2024-02-02T00:00:00Z"
+        db.save(gen2)
+        generations.promote(root, gen2)
+        assert svc.maybe_reload_db() is True
+        assert svc.engine is not e
+        # the swap kept the host topology AND closed the old engine's
+        # worker fleet (no leaked subprocess per reload)
+        h = svc.engine.shard_health()
+        assert h is not None and h["shape"] == "2x1x1", h
+        assert h["hosts"] == 2
+        assert e._mdb._closed
+        assert e._mdb.hosts[0].proc.poll() is not None
+        want = [r.adv_indices
+                for r in svc.engine.oracle_detect(queries)]
+        got = [r.adv_indices for r in svc.engine.detect(queries)]
+        assert got == want
+    finally:
+        if svc.scheduler is not None:
+            svc.scheduler.close()
+        close = getattr(svc.engine, "close", None)
+        if close:
+            close()
+        e.close()
+
+
+def test_standalone_worker_refuses_remote_shutdown(worker):
+    """A worker started WITHOUT --parent-watch (the endpoint-mode /
+    peer-host posture) must not be killable by one unauthenticated
+    frame from anything that can reach its port."""
+    import socket as _socket
+
+    sock = _socket.create_connection(
+        tuple(worker.rsplit(":", 1)[0:1]) + (int(worker.rsplit(":", 1)[1]),),
+        timeout=10)
+    try:
+        sock.settimeout(10)
+        dcn_ops._send_msg(sock, {"op": "shutdown", "rid": 1})
+        reply, _ = dcn_ops._recv_msg(sock)
+        assert not reply.get("ok") and "not allowed" in reply["error"]
+        # still alive and serving
+        dcn_ops._send_msg(sock, {"op": "ping", "rid": 2})
+        reply, _ = dcn_ops._recv_msg(sock)
+        assert reply.get("ok") and reply.get("rid") == 2
+    finally:
+        sock.close()
+
+
+def test_worker_keeps_predecessor_session_resident(db, queries, oracle,
+                                                   dcn_env):
+    """Endpoint-mode hot swap: the successor engine hellos the SAME
+    worker before the old engine is swapped out — the old engine must
+    keep serving its slice (no stale-slice degradation storm)."""
+    e1 = _dcn_engine(db, "2x1x1")
+    e2 = None
+    try:
+        assert _hits(e1, queries[:100]) == oracle[:100]
+        e2 = _dcn_engine(db, "2x1x1")  # successor session on the worker
+        # BOTH engines keep serving on-device, byte-identically
+        assert _hits(e2, queries) == oracle
+        assert _hits(e1, queries) == oracle
+        assert e1.shard_health()["degraded_hosts"] == []
+        assert e2.shard_health()["degraded_hosts"] == []
+    finally:
+        if e2 is not None:
+            e2.close()
+        e1.close()
+
+
+# --------------------------------------------------------- retired halves
+
+
+def test_collective_halves_retired():
+    """The dryrun-only collective kernel is gone: host_shards is the
+    one slice partition, shared by both serving paths."""
+    from trivy_tpu.ops import match as m
+    from trivy_tpu.ops import multihost
+
+    assert not hasattr(m, "ShardedDB")
+    assert not hasattr(m, "_sharded_match")
+    assert not hasattr(m, "shard_map_available")
+    assert callable(m.host_shards)
+    assert not hasattr(multihost, "bootstrap")
+    assert not hasattr(multihost, "put_sharded")
+    assert not hasattr(multihost, "globalize_batch")
+    assert callable(multihost.crawl_mesh)

@@ -1,40 +1,35 @@
-"""Two-process DCN dryrun in CI: jax.distributed across a real process
-boundary (2 procs x 4 virtual CPU devices), hybrid mesh, DB shard
-broadcast, per-host batch globalization, sharded match, and a cross-host
-collective — all must agree bit-for-bit with the single-host path
-(SURVEY §2.10 DCN half; VERDICT r4 directive 9)."""
+"""Two-process DCN dryrun in CI: the cross-host serving path across a
+real process boundary — a 4-virtual-device coordinator subprocess
+serving half the global shard partition on its local mesh plus one
+spawned worker serving the other half over the DCN worker protocol,
+asserted bit-identical to the host oracle THROUGH the production
+distributed-MeshDB path (ops/dcn.py; the dryrun and serving cannot
+drift because they are the same code)."""
 
 import pytest
 
-from trivy_tpu.ops.match import shard_map_available
+from trivy_tpu.ops.dcn_dryrun import N_HOSTS, run
 
-# the DCN dryrun's cross-host reduction is the one path that still
-# needs the collective shard_map runtime; without it (or without a
-# multi-device backend) this is a clean environmental skip
-pytestmark = pytest.mark.skipif(
-    not shard_map_available(),
-    reason="collective shard_map runtime unavailable")
-
-from trivy_tpu.ops.dcn_dryrun import N_PROCESSES, run  # noqa: E402
+pytestmark = pytest.mark.dcn
 
 
 def test_two_process_dcn_dryrun(tmp_path):
     out = tmp_path / "dcn.json"
     doc = run(out_path=str(out), timeout=300)
-    if not doc["ok"] and any(
-            "Multiprocess computations aren't implemented" in e
-            for e in doc["errors"]):
-        # the backend bootstrapped jax.distributed but cannot execute
-        # cross-process collectives (older CPU XLA): environmental,
-        # not a code regression — the serving mesh path needs no
-        # collectives and is covered by tests/test_mesh.py
-        pytest.skip("runtime cannot execute multiprocess CPU "
-                    "collectives")
+    if doc["result"] is None:
+        # the coordinator subprocess never produced its result line:
+        # the runtime cannot spawn/force the virtual-device child at
+        # all — environmental, not a code regression (the production
+        # path is covered in-process by tests/test_dcn.py)
+        pytest.skip("DCN dryrun subprocess could not come up: "
+                    f"{doc['errors']}")
     assert doc["ok"], doc["errors"]
-    assert len(doc["workers"]) == N_PROCESSES
-    globals_ = {w["global_hit_bits"] for w in doc["workers"]}
-    assert len(globals_) == 1, "hosts disagree on the DCN reduction"
-    assert sum(w["local_hit_bits"] for w in doc["workers"]) == \
-        globals_.pop() > 0
-    assert all(w["diff_vs_local_mesh"] == 0 for w in doc["workers"])
+    res = doc["result"]
+    assert res["hosts"] == N_HOSTS
+    assert res["mesh"] == "2x1x4"
+    assert res["diff_vs_oracle"] == 0
+    assert res["matches"] > 0
+    # the worker really served its slice (not silently host-masked)
+    assert res["remote_dispatches"] > 0
+    assert res["degraded_hosts"] == []
     assert out.exists()

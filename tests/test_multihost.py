@@ -1,8 +1,9 @@
-"""Multi-host mesh construction + sharded-engine integration
-(trivy_tpu/ops/multihost.py; virtual 8-device CPU mesh from conftest).
-The DCN tier itself cannot run in one process — these tests pin the
-single-process degenerations and the mesh/axis contracts the multi-host
-path builds on."""
+"""Local mesh construction (trivy_tpu/ops/multihost.py; virtual
+8-device CPU mesh from conftest): the axis contracts the serving mesh
+builds on.  The cross-host tier itself lives in ops/dcn.py and is
+covered by tests/test_dcn.py — the old collective halves (bootstrap,
+put_sharded, globalize_batch) are retired with the dryrun's
+promotion."""
 
 import random
 
@@ -38,21 +39,6 @@ def test_crawl_mesh_rejects_non_divisor():
         multihost.crawl_mesh(n_db=3)
     with pytest.raises(ValueError, match="must divide"):
         multihost.crawl_mesh(n_db=16)
-
-
-def test_bootstrap_noop_single_process(monkeypatch):
-    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
-    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
-    assert multihost.bootstrap() is False
-
-
-def test_globalize_batch_identity_single_process():
-    import numpy as np
-
-    mesh = multihost.crawl_mesh(n_db=4)
-    arrays = {"h1": np.arange(8, dtype=np.uint32)}
-    out = multihost.globalize_batch(mesh, arrays)
-    assert out["h1"] is arrays["h1"]
 
 
 def test_engine_over_crawl_mesh_zero_diff():

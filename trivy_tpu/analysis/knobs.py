@@ -47,7 +47,19 @@ KNOBS: tuple[Knob, ...] = (
          "advisory slice degrades to the host oracle."),
     Knob("TRIVY_TPU_MESH_HBM_GB", "8.0", "ops", False,
          "Per-device HBM budget (GB) the 'auto' mesh topology sizes "
-         "advisory shards against."),
+         "advisory shards against (per host on the distributed "
+         "MeshDB)."),
+    Knob("TRIVY_TPU_DCN", "", "ops", False,
+         "Cross-host distributed-MeshDB workers: 'spawn' launches as "
+         "many local worker subprocesses as the spec needs ('spawn:N' "
+         "pins the count, validated against the spec), "
+         "'host:port,...' connects pre-started workers (python -m "
+         "trivy_tpu.ops.dcn --worker [--bind ADDR]); unset = "
+         "single-host serving only."),
+    Knob("TRIVY_TPU_DCN_TIMEOUT_S", "60", "ops", False,
+         "Per-request DCN worker timeout (seconds) before the "
+         "coordinator retries and then degrades that host's advisory "
+         "slice to the bit-identical host mask."),
     # --- detector pipeline
     Knob("TRIVY_TPU_PIPELINE", "1", "detector", True,
          "Double-buffered host/device match executor; 0 runs the "
@@ -236,6 +248,15 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TRIVY_TPU_BENCH_CAPSTONE_CHILD", "", "bench", False,
          "Internal: set on the 8-virtual-device subprocess the "
          "capstone bench spawns."),
+    Knob("TRIVY_TPU_BENCH_DCN_ADVISORIES", "320000", "bench", False,
+         "Synthetic advisory-DB size for the cross-host serving bench "
+         "(the TRIVY_TPU_SCALE_FULL 2M shape, scaled for CI)."),
+    Knob("TRIVY_TPU_BENCH_DCN_QUERIES", "40000", "bench", False,
+         "Synthetic package-query count for the cross-host serving "
+         "bench crawl."),
+    Knob("TRIVY_TPU_BENCH_DCN_CHILD", "", "bench", False,
+         "Internal: set on the 4-virtual-device coordinator "
+         "subprocess the DCN bench spawns."),
     Knob("TRIVY_TPU_BENCH_FLEET_REPLICAS", "3", "bench", False,
          "Replica-set size for the fleet-serving bench."),
     Knob("TRIVY_TPU_BENCH_FLEET_CLIENTS", "6", "bench", False,

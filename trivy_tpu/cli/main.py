@@ -78,9 +78,12 @@ def _add_scan_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--mesh", default=None, metavar="DPxDB",
                    help="serve matching from a sharded device mesh: "
                         "'DPxDB' (e.g. 2x4: 2 data-parallel groups x 4 "
-                        "advisory shards), 'auto' (topology from DB "
-                        "size and device count), or 'off' single-chip "
-                        "(default; env TRIVY_TPU_MESH)")
+                        "advisory shards), 'HOSTSxDPxDB' (e.g. 2x1x4: "
+                        "cross-host distributed MeshDB over "
+                        "TRIVY_TPU_DCN workers, dp x db per host), "
+                        "'auto' (topology from DB size, device count "
+                        "and per-host HBM budget), or 'off' "
+                        "single-chip (default; env TRIVY_TPU_MESH)")
     p.add_argument("--secret-pack-mb", type=float, default=None,
                    metavar="MB",
                    help="packed super-buffer MiB per device secret "
@@ -314,8 +317,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-tpu", action="store_true")
     p.add_argument("--mesh", default=None, metavar="DPxDB",
                    help="serve matching from a sharded device mesh: "
-                        "'DPxDB', 'auto', or 'off' (default; env "
-                        "TRIVY_TPU_MESH)")
+                        "'DPxDB', 'HOSTSxDPxDB' (cross-host over "
+                        "TRIVY_TPU_DCN workers), 'auto', or 'off' "
+                        "(default; env TRIVY_TPU_MESH)")
     p.add_argument("--drain-timeout", default="30s",
                    help="graceful-drain budget on SIGTERM: /readyz goes "
                         "503 immediately, in-flight scans get this long "
@@ -383,7 +387,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "kernel")
     p.add_argument("--mesh", default=None, metavar="DPxDB",
                    help="re-match on a sharded device mesh ('DPxDB', "
-                        "'auto', or 'off'; env TRIVY_TPU_MESH)")
+                        "'HOSTSxDPxDB', 'auto', or 'off'; env "
+                        "TRIVY_TPU_MESH)")
 
     p = sub.add_parser(
         "fleet", help="fleet administration: replica status and the "

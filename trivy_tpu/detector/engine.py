@@ -127,11 +127,19 @@ class MatchEngine:
         # compiled-DB cache under mesh-topology-aware keys
         self._cache_ctx = (db_path, digest, db_meta, window) \
             if db_path else None
+        dcn_plan = None
         if use_device and mesh is None and mesh_spec:
+            from trivy_tpu.ops import dcn as dcn_ops
             from trivy_tpu.ops import mesh as mesh_ops
 
-            mesh = mesh_ops.build_from_spec(mesh_spec,
-                                            n_rows=self.cdb.n_rows)
+            # a spec spanning hosts (HOSTSxDPxDB, or "auto" with
+            # TRIVY_TPU_DCN workers configured) serves from the
+            # distributed MeshDB instead of a local jax mesh
+            dcn_plan = dcn_ops.plan_from_spec(mesh_spec,
+                                              n_rows=self.cdb.n_rows)
+            if dcn_plan is None:
+                mesh = mesh_ops.build_from_spec(mesh_spec,
+                                                n_rows=self.cdb.n_rows)
         self.mesh = mesh
         # the requested spec, kept so an engine rebuild (the server's
         # hot DB reload) re-resolves the topology against the NEW DB's
@@ -207,6 +215,19 @@ class MatchEngine:
                 # from the mesh-aware compiled-DB cache when possible
                 self._mdb = mesh_ops.MeshDB.from_compiled(
                     self.cdb, mesh, cache_ctx=self._cache_ctx)
+            elif dcn_plan is not None:
+                from trivy_tpu.ops import dcn as dcn_ops
+
+                # cross-host: this process serves only its advisory
+                # slice on its local grid; peer hosts serve theirs
+                # behind the DCN worker protocol, merged by the same
+                # host-merge decoder (ops/dcn.py HostMeshDB — the
+                # surface matches MeshDB, so everything below and the
+                # scheduler's composition probes work unchanged)
+                n_hosts, dp, db_local = dcn_plan
+                self._mdb = dcn_ops.HostMeshDB.from_compiled(
+                    self.cdb, n_hosts, dp, db_local,
+                    cache_ctx=self._cache_ctx)
             else:
                 self._ddb = m.DeviceDB.from_compiled(self.cdb)
             # hot names match on device against their own partitions
@@ -243,9 +264,21 @@ class MatchEngine:
 
     def shard_health(self) -> dict | None:
         """Mesh shard health for /readyz and diagnostics: the topology
-        plus which db shards are degraded to the host oracle. None on
-        the single-chip path."""
+        plus which db shards are degraded to the host oracle (and, on
+        the distributed MeshDB, which peer HOSTS are degraded to the
+        coordinator's host mask). None on the single-chip path."""
         return self._mdb.health() if self._mdb is not None else None
+
+    def close(self) -> None:
+        """Release engine-owned serving resources.  Only the
+        distributed MeshDB holds any (worker subprocesses, DCN
+        connections); single-chip and local-mesh engines no-op.  The
+        server calls this on the OLD engine after a hot swap — the
+        write lock has quiesced in-flight scans by then — and on
+        shutdown."""
+        mdb = self._mdb
+        if mdb is not None and hasattr(mdb, "close"):
+            mdb.close()
 
     @staticmethod
     def dedupe_queries(queries: list[PkgQuery]):

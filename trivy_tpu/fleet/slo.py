@@ -466,12 +466,21 @@ class SkewDetector:
     def _check_shards(self, statuses: list[dict]) -> None:
         for s in statuses:
             ep = s.get("endpoint", "?")
-            degraded = sorted((s.get("mesh") or {}).get("degraded") or ())
+            mesh = s.get("mesh") or {}
+            degraded = sorted(mesh.get("degraded") or ())
+            # distributed MeshDB: a degraded peer HOST (its whole
+            # advisory slice on the coordinator's host mask) is the
+            # same ladder one level up — fold it into the transition
+            # signature so host losses fire exactly once, like shards
+            dhosts = sorted(mesh.get("degraded_hosts") or ())
             sig = ",".join(str(d) for d in degraded)
+            if dhosts:
+                sig += "|hosts:" + ",".join(str(h) for h in dhosts)
             prev = self._degraded.get(ep, "")
             if sig != prev:
                 emit_event("shard_degraded", endpoint=ep,
-                           shards=degraded, recovered=not sig)
+                           shards=degraded, hosts=dhosts,
+                           recovered=not sig)
                 if sig:
                     self._degraded[ep] = sig
                 else:

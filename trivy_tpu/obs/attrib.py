@@ -81,6 +81,8 @@ SPAN_LANES = {
     "sched.batch": "device_dispatch",
     "engine.dispatch": "device_dispatch",
     "engine.shard": "device_wait",
+    "engine.host": "device_wait",
+    "dcn.merge": "host_crunch",
     "secret.screen": "device_wait",
     "fleet.hedge": "fetch_io",
     "fleet.probe": "fetch_io",

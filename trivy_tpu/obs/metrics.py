@@ -482,7 +482,8 @@ SCHED_WAIT_SECONDS = REGISTRY.histogram(
 MESH_SHAPE = REGISTRY.gauge(
     "trivy_tpu_mesh_shape",
     "Serving-mesh topology by axis (axis=data: query-parallel groups, "
-    "axis=db: advisory shards); absent/0 = single-chip path",
+    "axis=db: advisory shards — GLOBAL across hosts on the distributed "
+    "MeshDB, axis=hosts: DCN processes); absent/0 = single-chip path",
     labels=("axis",))
 MESH_SHARD_DISPATCH_SECONDS = REGISTRY.histogram(
     "trivy_tpu_mesh_shard_dispatch_seconds",
@@ -502,6 +503,28 @@ MESH_SHARD_DEGRADATIONS = REGISTRY.counter(
     "exhausted or the shard's device was lost (zero finding diff; the "
     "healthy shards keep serving on-device)",
     labels=("shard",))
+DCN_HOST_DISPATCH_SECONDS = REGISTRY.histogram(
+    "trivy_tpu_dcn_host_dispatch_seconds",
+    "Per-remote-host dispatch+collect wall seconds of the distributed "
+    "MeshDB (the cross-host wait, incl. retries; a degraded host's "
+    "mask recompute is in the merge, not here)",
+    labels=("host",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             1.0, 5.0, 30.0))
+DCN_HOST_DEGRADATIONS = REGISTRY.counter(
+    "trivy_tpu_dcn_host_degradations_total",
+    "Remote hosts whose whole advisory slice degraded to the "
+    "coordinator's bit-identical host mask (worker death, transport "
+    "timeout, or injected engine.host fault; surviving hosts keep "
+    "serving on-device, zero finding diff)",
+    labels=("host",))
+DCN_MERGE_SECONDS = REGISTRY.histogram(
+    "trivy_tpu_dcn_merge_seconds",
+    "Coordinator-side merge of per-host shard bitmaps into the global "
+    "mask stack the host-merge decoder consumes (unpack + degraded-"
+    "host mask recompute)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 1.0))
 DELTA_DIFF_SECONDS = REGISTRY.histogram(
     "trivy_tpu_delta_diff_seconds",
     "Advisory-delta diff duration on a DB generation promote "

@@ -1,0 +1,1111 @@
+"""Cross-host sharded serving: the distributed MeshDB.
+
+`MULTICHIP_DCN_r05.json` proved the 2-process DCN reconciliation
+zero-diff, but only as a collective-kernel dryrun — `build_mesh`
+rejected multi-process runtimes and the advisory DB stayed capped at
+one host's HBM.  This module promotes the DCN tier to the serving path
+the same way PR 8 promoted the single-host dryrun: **no collectives**.
+The match kernel is a pure map, so each host answers "which of my rows
+hit" for the whole query batch against ONLY its advisory row slice on
+its local (data x db) device mesh, and the coordinator merges the
+per-host shard bitmaps through the existing host-merge decoder
+(detector/engine.py `_crunch`'s sharded branch).  Nothing but the
+encoded query stream and packed hit words ever crosses DCN.
+
+Topology (`--mesh HOSTSxDPxDB` / `TRIVY_TPU_MESH`, "auto" sizes the
+db axis against `TRIVY_TPU_MESH_HBM_GB` per device per host):
+
+  HOSTS    processes (host 0 = the coordinator, the process serving
+           scans); the advisory row table splits into HOSTS * DB
+           global shards, host h owning the contiguous run
+           [h*DB, (h+1)*DB).  This is the axis that admits advisory
+           sets larger than one host's HBM.
+  DP x DB  each host's local mesh over its own devices — exactly
+           ops/mesh.py semantics, per host.
+
+Workers come from ``TRIVY_TPU_DCN``:
+
+  "spawn" / "spawn:N"   the coordinator spawns local worker
+                        subprocesses (CI / single-box scale-out; the
+                        bench and test harness path),
+  "host:port,..."       pre-started workers
+                        (``python -m trivy_tpu.ops.dcn --worker``)
+                        on peer hosts.
+
+Each worker device_puts ONLY its slice: warm starts load the
+host-slice-keyed compiled-DB cache entry
+(tensorize/cache.py ``load_host_slice``); a cold worker asks the
+coordinator to push the slice over the wire (and persists it for the
+next start).  The slice partition is `ops/match.host_shards` over the
+GLOBAL shard count, so the coordinator's decoder consumes the exact
+(shard_base, shard_len) layout the single-host mesh uses.
+
+Fault site ``engine.host`` (per host, at collect time): ``drop``
+re-sends the request, ``delay`` stalls, ``error`` retries up to
+`TRIVY_TPU_MESH_SHARD_RETRIES` then degrades, ``device-lost`` degrades
+now.  Degrading a HOST swaps only its advisory slice to the
+bit-identical host mask (ops/mesh.py `_host_shard_mask` over the
+host's global row ranges) while the surviving hosts keep serving
+on-device — zero finding diff at every rung, the same ladder
+discipline as ``engine.shard``.  Real transport failures (worker
+death, socket timeout after ``TRIVY_TPU_DCN_TIMEOUT_S``) ride the
+same ladder.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from trivy_tpu.log import logger
+from trivy_tpu.resilience import faults
+
+_log = logger("dcn")
+
+ENV_DCN = "TRIVY_TPU_DCN"
+ENV_TIMEOUT = "TRIVY_TPU_DCN_TIMEOUT_S"
+
+DEFAULT_TIMEOUT_S = 60.0
+
+_MAGIC = b"TDCN1\n"
+
+
+class HostFault(faults.FaultError):
+    """A remote host's dispatch failed (injected or real); retried,
+    then the host's whole advisory slice degrades to the host mask."""
+
+
+class HostLost(HostFault):
+    """A remote host is gone: degrade its slice without retry."""
+
+
+# ------------------------------------------------------------- spec helpers
+
+
+def configured_workers() -> list[str] | int | str | None:
+    """Parse TRIVY_TPU_DCN: None (off), the string "spawn" (launch as
+    many local workers as the mesh spec needs), an int (spawn exactly
+    N), or an explicit endpoint list.  Raises ValueError on malformed
+    specs so a typo fails at engine construction, not mid-crawl."""
+    raw = os.environ.get(ENV_DCN, "").strip()
+    if not raw or raw in ("0", "off"):
+        return None
+    if raw == "spawn":
+        return "spawn"
+    if raw.startswith("spawn:"):
+        try:
+            n = int(raw[6:])
+        except ValueError:
+            raise ValueError(f"bad {ENV_DCN} spawn count {raw!r}")
+        if n < 1:
+            raise ValueError(f"{ENV_DCN} spawn count must be >= 1")
+        return n
+    eps = [e.strip() for e in raw.split(",") if e.strip()]
+    for e in eps:
+        if ":" not in e:
+            raise ValueError(
+                f"bad {ENV_DCN} endpoint {e!r}: want host:port")
+    return eps
+
+
+def dcn_timeout_s() -> float:
+    raw = os.environ.get(ENV_TIMEOUT, "")
+    if raw:
+        try:
+            return max(float(raw), 0.1)
+        except ValueError:
+            _log.warn("bad TRIVY_TPU_DCN_TIMEOUT_S; using default",
+                      value=raw)
+    return DEFAULT_TIMEOUT_S
+
+
+def choose_host_topology(n_hosts: int, n_local: int,
+                         n_rows: int) -> tuple[int, int]:
+    """(dp, db_local) for an `n_hosts`-process runtime with `n_local`
+    devices per host: the per-host db axis is the smallest divisor of
+    the local device count whose GLOBAL per-shard slice (rows split
+    HOSTS*DB ways) fits the per-device HBM budget, and every remaining
+    local device goes to data."""
+    from trivy_tpu.ops.match import TABLE_LANES
+    from trivy_tpu.ops.mesh import _hbm_budget_bytes
+
+    n_local = max(int(n_local), 1)
+    n_hosts = max(int(n_hosts), 1)
+    row_bytes = 4 * (1 + TABLE_LANES)
+    budget = _hbm_budget_bytes()
+    db_local = n_local
+    for cand in range(1, n_local + 1):
+        if n_local % cand:
+            continue
+        per_shard = -(-max(n_rows, 1) // (n_hosts * cand))
+        if per_shard * row_bytes <= budget:
+            db_local = cand
+            break
+    return n_local // db_local, db_local
+
+
+def plan_from_spec(spec: str, n_rows: int):
+    """-> (n_hosts, dp, db_local) when `spec` spans hosts, else None
+    (the single-host ops/mesh.py path).  A HOSTSxDPxDB spec with
+    hosts >= 2 requires TRIVY_TPU_DCN workers; "auto" goes cross-host
+    exactly when TRIVY_TPU_DCN is configured, resolving the per-host
+    topology against the per-host HBM budget."""
+    from trivy_tpu.ops import mesh as mesh_ops
+
+    parsed = mesh_ops.parse_spec(spec)
+    if parsed is None:
+        return None
+    workers = configured_workers()
+    if parsed == "auto":
+        if workers is None:
+            return None
+        if isinstance(workers, list):
+            n_hosts = len(workers) + 1
+        elif workers == "spawn":
+            n_hosts = 2  # bare "spawn" with auto: one worker
+        else:
+            n_hosts = workers + 1
+        import jax
+
+        n_local = jax.local_device_count()
+        dp, db_local = choose_host_topology(n_hosts, n_local, n_rows)
+        return n_hosts, dp, db_local
+    if len(parsed) == 2:
+        return None
+    n_hosts, dp, db_local = parsed
+    if workers is None:
+        raise ValueError(
+            f"mesh spec {spec!r} spans {n_hosts} hosts but {ENV_DCN} "
+            "is unset: point it at worker endpoints (host:port,...) "
+            "or 'spawn' to launch local workers")
+    if isinstance(workers, list) and len(workers) != n_hosts - 1:
+        raise ValueError(
+            f"mesh spec {spec!r} needs {n_hosts - 1} workers but "
+            f"{ENV_DCN} lists {len(workers)}")
+    if isinstance(workers, int) and workers != n_hosts - 1:
+        # an explicit spawn COUNT must agree with the explicit spec —
+        # silently spawning a different fleet than the operator sized
+        # their HBM budget for is worse than failing at startup
+        raise ValueError(
+            f"mesh spec {spec!r} needs {n_hosts - 1} spawned workers "
+            f"but {ENV_DCN} says spawn:{workers}")
+    return n_hosts, dp, db_local
+
+
+# ---------------------------------------------------------------- wire form
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("DCN peer closed the connection")
+        buf += chunk
+    return bytes(buf)
+
+
+def _send_msg(sock: socket.socket, header: dict,
+              arrays: dict | None = None) -> None:
+    payload = b""
+    if arrays:
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        payload = buf.getvalue()
+    h = dict(header)
+    h["_body"] = len(payload)
+    hb = json.dumps(h).encode()
+    sock.sendall(_MAGIC + struct.pack("<I", len(hb)) + hb + payload)
+
+
+def _recv_msg(sock: socket.socket) -> tuple[dict, dict]:
+    magic = _recv_exact(sock, len(_MAGIC))
+    if magic != _MAGIC:
+        raise ConnectionError(f"bad DCN frame magic {magic!r}")
+    (hlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    header = json.loads(_recv_exact(sock, hlen))
+    body = _recv_exact(sock, int(header.get("_body", 0)))
+    arrays = {}
+    if body:
+        z = np.load(io.BytesIO(body), allow_pickle=False)
+        arrays = {k: z[k] for k in z.files}
+    return header, arrays
+
+
+# ------------------------------------------------------------- remote hosts
+
+
+class _RemoteHost:
+    """One worker connection: a single background I/O thread drains a
+    request queue (send + recv are strictly request-response per
+    connection), so dispatches to different hosts — and the local
+    grid's jax work — overlap while each host computes."""
+
+    def __init__(self, idx: int, endpoint: str | None = None,
+                 proc=None, sock: socket.socket | None = None):
+        import queue
+
+        self.idx = idx
+        self.endpoint = endpoint
+        self.proc = proc  # spawn mode: the worker subprocess handle
+        self._sock = sock
+        self._q: "queue.Queue" = queue.Queue()
+        self.info: dict = {}
+        self._closed = False
+        # request/response correlation: every frame carries a rid the
+        # worker echoes; owned by the io thread (the only socket user)
+        self._rid = 0
+        # plain request-response plumbing on a dedicated thread; the
+        # spans that need trace parentage (engine.host, dcn.merge) are
+        # emitted on the calling scan thread, not here
+        self._thread = threading.Thread(  # lint: allow[tracing-capture] io pump emits no spans; parentage lives on the collecting scan thread
+            target=self._run, name=f"ttpu-dcn-io-{idx}", daemon=True)
+        self._thread.start()
+
+    def request(self, header: dict, arrays: dict | None = None) -> Future:
+        fut: Future = Future()
+        if self._closed or self._sock is None:
+            # fail fast instead of parking the caller for the full DCN
+            # timeout behind a shutdown sentinel no thread will drain
+            fut.set_exception(
+                ConnectionError("DCN worker connection closed"))
+            return fut
+        self._q.put((header, arrays, fut))
+        return fut
+
+    def _mark_broken(self) -> None:
+        """A send/recv failed: the stream may hold a partial frame or
+        an abandoned request's late reply, so the connection can never
+        be trusted again — close it and fail everything after fast
+        (the collectors' engine.host ladder degrades the host).  A
+        reply consumed off a desynced stream is the one way this
+        protocol could mis-pair results, so the connection is the
+        correlation unit: one failure ends it."""
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                # drain anything enqueued behind the shutdown sentinel
+                # (a dispatch racing close()): fail those futures now
+                # so their collectors degrade immediately
+                while True:
+                    try:
+                        late = self._q.get_nowait()
+                    except Exception:
+                        return
+                    if late is not None and late[2] is not None:
+                        late[2].set_exception(ConnectionError(
+                            "DCN worker connection closed"))
+            header, arrays, fut = item
+            try:
+                if self._sock is None:
+                    raise ConnectionError("DCN worker connection closed")
+                self._rid += 1
+                header = dict(header, rid=self._rid)
+                _send_msg(self._sock, header, arrays)
+                if fut is None:
+                    continue  # fire-and-forget (the shutdown frame)
+                reply, rarrays = _recv_msg(self._sock)
+                if reply.get("rid") != self._rid:
+                    # a reply for a request this loop never paired
+                    # (stream desync): never trust this connection
+                    raise ConnectionError(
+                        f"worker {self.idx} reply correlation mismatch "
+                        f"(got rid={reply.get('rid')}, "
+                        f"want {self._rid})")
+                if not reply.get("ok"):
+                    raise HostFault(
+                        f"worker {self.idx} error: "
+                        f"{reply.get('error', 'unknown')}")
+                fut.set_result((reply, rarrays))
+            except BaseException as exc:  # lint: allow[bare-except] every failure (incl. injected kills) must reach the waiting collector, not die on the io thread
+                self._mark_broken()
+                if fut is not None:
+                    try:
+                        fut.set_exception(exc)
+                    except Exception:
+                        pass
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # only workers WE spawned die with us; an endpoint-mode worker
+        # outlives any one coordinator (it may serve the hot-swap
+        # successor, or a sibling, next).  The shutdown frame rides the
+        # request queue so it cannot interleave with an in-flight
+        # request's bytes on the socket — the io thread is the only
+        # writer.
+        if self._sock is not None and self.proc is not None:
+            self._q.put(({"op": "shutdown"}, None, None))
+        self._q.put(None)
+        self._thread.join(timeout=5)
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self.proc is not None:
+            try:
+                self.proc.terminate()
+                self.proc.wait(timeout=5)
+            except Exception:
+                try:
+                    self.proc.kill()
+                except Exception:
+                    pass
+
+
+def _connect(endpoint: str, timeout: float) -> socket.socket:
+    host, _, port = endpoint.rpartition(":")
+    sock = socket.create_connection((host or "127.0.0.1", int(port)),
+                                    timeout=timeout)
+    sock.settimeout(timeout)
+    return sock
+
+
+def _spawn_worker(n_devices: int, timeout: float):
+    """Launch a local worker subprocess on an ephemeral port with an
+    `n_devices` virtual-CPU backend (the single-box scale-out /
+    CI path; real peer hosts run ``-m trivy_tpu.ops.dcn --worker``
+    themselves).  -> (proc, endpoint)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_devices}")
+    # the worker serves slices, it must never recursively build meshes
+    # or spawn its own workers off the coordinator's knobs
+    env.pop("TRIVY_TPU_MESH", None)
+    env.pop(ENV_DCN, None)
+    # --parent-watch: this worker dies with us (stdin-EOF watchdog) and
+    # honors the remote shutdown op — both spawn-mode-only behaviors
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "trivy_tpu.ops.dcn", "--worker",
+         "--port", "0", "--parent-watch"],
+        env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+    # deadline-bounded readiness read: a wedged worker (jax import
+    # hang) must fail engine construction at the timeout, not block
+    # readline() forever under the server's reload mutex
+    import selectors
+
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    deadline = time.monotonic() + timeout
+    port = None
+    buf = b""
+    try:
+        while time.monotonic() < deadline and port is None:
+            if b"\n" in buf:
+                line, _, buf = buf.partition(b"\n")
+                if line.startswith(b"DCN_WORKER_READY"):
+                    port = int(line.split(b"port=")[1].strip())
+                continue
+            if not sel.select(timeout=min(
+                    1.0, max(deadline - time.monotonic(), 0.05))):
+                if proc.poll() is not None:
+                    break
+                continue
+            chunk = os.read(proc.stdout.fileno(), 4096)
+            if not chunk:
+                break
+            buf += chunk
+    finally:
+        sel.close()
+    if port is None:
+        proc.kill()
+        raise ValueError("DCN worker subprocess failed to come up")
+    return proc, f"127.0.0.1:{port}"
+
+
+# ---------------------------------------------------------------- HostMeshDB
+
+
+@dataclass
+class HostPending:
+    """In-flight distributed match: the local slice's MeshPending plus
+    one request future per remote host.  Host-level fault handling
+    (``engine.host``) happens at collect time so a lost in-flight
+    result can be re-sent or degraded to the host mask."""
+
+    hmdb: "HostMeshDB"
+    local: object  # ops/mesh.MeshPending | None
+    remote: list   # [(host_index, _RemoteHost, Future | None)]
+    arrays: dict   # wire batch (re-sent on drop / retry)
+    b: int
+
+    def collect(self) -> np.ndarray:
+        from trivy_tpu.obs import metrics as obs_metrics
+        from trivy_tpu.obs import tracing
+        from trivy_tpu.ops import match as m
+
+        h = self.hmdb
+        w = m._words(h.window) * 32
+        words_by_host: dict[int, np.ndarray | None] = {}
+        # remote hosts were dispatched first and are computing now;
+        # blocking on the local grid first keeps the overlap
+        local_masks = self.local.collect() if self.local is not None \
+            else np.zeros((h.db_local, self.b, w), dtype=bool)
+        for idx, host, fut in self.remote:
+            words_by_host[idx] = h._collect_host(host, fut, self.arrays,
+                                                 self.b)
+        # the host-merge step: per-host packed words unpack into the
+        # [n_db_total, B, W] stack the engine's shard decoder consumes
+        # (a degraded host's slice recomputes on the coordinator as the
+        # bit-identical host mask)
+        t0 = time.perf_counter()
+        with tracing.span("dcn.merge", hosts=h.n_hosts):
+            masks = np.zeros((h.n_db, self.b, w), dtype=bool)
+            masks[: h.db_local] = local_masks
+            for idx, _host, _fut in self.remote:
+                lo = idx * h.db_local
+                words = words_by_host[idx]
+                if words is None:
+                    masks[lo: lo + h.db_local] = h._host_mask_block(
+                        idx, self.arrays)
+                else:
+                    for j in range(h.db_local):
+                        masks[lo + j] = m._unpack_words(words[j],
+                                                        h.window)
+        obs_metrics.DCN_MERGE_SECONDS.observe(time.perf_counter() - t0)
+        return masks
+
+
+class HostMeshDB:
+    """The distributed MeshDB the coordinator serves from: host 0's
+    slice on a local ops/mesh.py grid (full ``engine.shard``
+    semantics), hosts 1..H-1 behind the DCN worker protocol.  Presents
+    the same surface as ``MeshDB`` (dispatch/shard_base/shard_len/
+    n_db/n_data/grid/health) so the engine's decoder and the
+    scheduler's composition probes work unchanged."""
+
+    def __init__(self, cdb, local_mdb, hosts: list[_RemoteHost],
+                 n_hosts: int, db_local: int):
+        from trivy_tpu.analysis.witness import make_lock
+        from trivy_tpu.ops import mesh as mesh_ops
+
+        self.cdb = cdb
+        self._local = local_mdb
+        self.hosts = hosts
+        self.n_hosts = n_hosts
+        self.db_local = db_local
+        self.n_db = n_hosts * db_local  # global shard count
+        self.n_data = local_mdb.n_data
+        self.window = local_mdb.window
+        self.shard_len = local_mdb.shard_len
+        self.shard_base = local_mdb.shard_base
+        self.retries = mesh_ops.shard_retries()
+        self.degraded_hosts: set[int] = set()
+        self._lock = make_lock("ops.dcn.HostMeshDB._lock")
+        self._closed = False
+        # close spawned workers when the coordinator process exits even
+        # if the owning engine is never explicitly closed
+        import atexit
+
+        atexit.register(self.close)
+
+    # surface parity with MeshDB for the engine's row-floor probe
+    @property
+    def grid(self):
+        return self._local.grid
+
+    @property
+    def degraded(self):
+        """Locally-degraded GLOBAL shard indices (host 0's slice)."""
+        return self._local.degraded
+
+    # -------------------------------------------------------- construction
+
+    @classmethod
+    def from_compiled(cls, cdb, n_hosts: int, dp: int, db_local: int,
+                      cache_ctx=None) -> "HostMeshDB":
+        """Build the cross-host DB from a CompiledDB.  The coordinator
+        warm-loads ONLY its own slice when the host-slice cache has it
+        (`cache_ctx` = (db_path, digest, db_meta, requested_window));
+        otherwise it slices the full table once, persists every host's
+        entry, and keeps the non-local slices around just long enough
+        to push them to cold workers."""
+        from trivy_tpu.obs import metrics as obs_metrics
+        from trivy_tpu.ops import match as m
+        from trivy_tpu.ops import mesh as mesh_ops
+        from trivy_tpu.tensorize import cache as compile_cache
+
+        n_db = n_hosts * db_local
+        db_path = digest = db_meta = window_req = None
+        if cache_ctx:
+            db_path, digest, db_meta, window_req = cache_ctx
+        use_cache = bool(db_path) and digest is not None \
+            and compile_cache.enabled()
+        own = None
+        if use_cache:
+            own = compile_cache.load_host_slice(
+                db_path, digest=digest, window=window_req,
+                db_meta=db_meta, n_hosts=n_hosts, host_index=0,
+                n_db=n_db, n_rows=cdb.n_rows,
+                resolved_window=cdb.window)
+        global_shards = None
+        if own is None:
+            global_shards = m.host_shards(cdb, n_db)
+            h1s, tables, shard_len, shard_base = global_shards
+            own = {"h1s": h1s[:db_local], "tables": tables[:db_local],
+                   "shard_len": shard_len, "shard_base": shard_base}
+            if use_cache:
+                for h in range(n_hosts):
+                    lo = h * db_local
+                    compile_cache.save_host_slice(
+                        db_path, digest=digest, window=window_req,
+                        db_meta=db_meta, n_hosts=n_hosts, host_index=h,
+                        n_db=n_db, n_rows=cdb.n_rows,
+                        resolved_window=cdb.window,
+                        shard_len=shard_len, shard_base=shard_base,
+                        h1s=h1s[lo: lo + db_local],
+                        tables=tables[lo: lo + db_local])
+
+        shard_len = int(own["shard_len"])
+        shard_base = int(own["shard_base"])
+        grid = _build_grid(dp, db_local, own["h1s"], own["tables"],
+                           shard_len, cdb.window, side="coordinator")
+        # host 0's global shards ARE indices 0..db_local-1, so a plain
+        # MeshDB over the local grid — with the GLOBAL (base, len)
+        # partition — reuses the whole engine.shard ladder verbatim
+        local = mesh_ops.MeshDB(
+            cdb=cdb, grid=grid, n_data=dp, n_db=db_local,
+            window=cdb.window, shard_len=shard_len,
+            shard_base=shard_base)
+        self = cls(cdb, local, [], n_hosts, db_local)
+        timeout = dcn_timeout_s()
+        workers = configured_workers()
+        session = uuid.uuid4().hex
+
+        def slice_of(h: int):
+            nonlocal global_shards
+            if global_shards is None:
+                global_shards = m.host_shards(cdb, n_db)
+            h1s, tables, _sl, _sb = global_shards
+            lo = h * db_local
+            return h1s[lo: lo + db_local], tables[lo: lo + db_local]
+
+        hello = {
+            "op": "hello", "session": session, "hosts": n_hosts,
+            "n_db": n_db, "db_local": db_local, "dp": dp,
+            "n_rows": int(cdb.n_rows), "window": int(cdb.window),
+            "window_req": window_req, "shard_len": shard_len,
+            "shard_base": shard_base,
+            "digest": digest, "db_path": db_path, "db_meta": db_meta,
+        }
+        try:
+            for h in range(1, n_hosts):
+                if not isinstance(workers, list):
+                    proc, endpoint = _spawn_worker(
+                        max(dp * db_local, 1), timeout)
+                else:
+                    proc, endpoint = None, workers[h - 1]
+                sock = _connect(endpoint, timeout)
+                host = _RemoteHost(h, endpoint=endpoint, proc=proc,
+                                   sock=sock)
+                self.hosts.append(host)
+                reply, _ = host.request(
+                    dict(hello, host_index=h)).result(timeout)
+                if reply.get("need_slice"):
+                    h1s_h, tables_h = slice_of(h)
+                    reply, _ = host.request(
+                        {"op": "load", "session": session},
+                        arrays={"h1s": h1s_h, "tables": tables_h},
+                    ).result(timeout)
+                host.info = {"endpoint": endpoint,
+                             "source": reply.get("source", "push"),
+                             "session": session}
+        except Exception:
+            self.close()
+            raise
+        self._session = session
+        obs_metrics.MESH_SHAPE.set(n_hosts, axis="hosts")
+        obs_metrics.MESH_SHAPE.set(dp, axis="data")
+        obs_metrics.MESH_SHAPE.set(n_db, axis="db")
+        _log.info("distributed mesh DB resident", hosts=n_hosts,
+                  data=dp, db_local=db_local, shard_rows=shard_len,
+                  total_rows=cdb.n_rows,
+                  sources=[h.info.get("source") for h in self.hosts])
+        return self
+
+    # ------------------------------------------------------------ dispatch
+
+    def dispatch(self, batch) -> HostPending | None:
+        """Enqueue a batch across every host without blocking: remote
+        requests go out first (their hosts start computing while the
+        local grid dispatches), then the local cells.  None when there
+        is no work."""
+        b = len(batch.h1)
+        if b == 0 or self.cdb.n_rows == 0:
+            return None
+        arrays = {
+            "h1": np.ascontiguousarray(batch.h1),
+            "h2": np.ascontiguousarray(batch.h2),
+            "rank": np.ascontiguousarray(batch.rank),
+            "flags": np.ascontiguousarray(batch.flags),
+        }
+        remote = []
+        with self._lock:
+            degraded = set(self.degraded_hosts)
+        for host in self.hosts:
+            if host.idx in degraded:
+                remote.append((host.idx, host, None))
+            else:
+                remote.append((host.idx, host, self._send_match(
+                    host, arrays, b)))
+        local = self._local.dispatch(batch)
+        return HostPending(hmdb=self, local=local, remote=remote,
+                           arrays=arrays, b=b)
+
+    def _send_match(self, host: _RemoteHost, arrays: dict,
+                    b: int) -> Future:
+        return host.request(
+            {"op": "match", "b": b, "session": self._session}, arrays)
+
+    # ------------------------------------------------------------- collect
+
+    def _host_mask_block(self, host_idx: int, arrays: dict) -> np.ndarray:
+        """bool[db_local, B, W] host-mask replica of one host's slice:
+        the degraded-host path, bit-exact with the kernel over every
+        global shard the host owns (the coordinator's full host-side
+        row table answers for any row range)."""
+        from trivy_tpu.ops import mesh as mesh_ops
+
+        b = len(arrays["h1"])
+        from trivy_tpu.ops import match as m
+
+        w = m._words(self.window) * 32
+        out = np.zeros((self.db_local, b, w), dtype=bool)
+        for j in range(self.db_local):
+            d = host_idx * self.db_local + j
+            lo = d * self.shard_base
+            hi = min(lo + self.shard_len, self.cdb.n_rows)
+            out[j] = mesh_ops._host_shard_mask(
+                self.cdb, lo, hi, self.window,
+                arrays["h1"], arrays["h2"], arrays["rank"],
+                arrays["flags"])
+        return out
+
+    def _degrade_host(self, idx: int, exc: Exception) -> None:
+        from trivy_tpu.obs import metrics as obs_metrics
+
+        with self._lock:
+            fresh = idx not in self.degraded_hosts
+            self.degraded_hosts.add(idx)
+        if fresh:
+            obs_metrics.DCN_HOST_DEGRADATIONS.inc(host=str(idx))
+            _log.warn(
+                "DCN host degraded: its advisory slice now serves from "
+                "the coordinator's bit-identical host mask (surviving "
+                "hosts keep serving on-device; zero finding diff)",
+                host=idx, err=str(exc))
+
+    def _collect_host(self, host: _RemoteHost, fut,
+                      arrays: dict, b: int) -> np.ndarray | None:
+        """Block on one remote host's packed words, running the
+        ``engine.host`` fault ladder: drop -> re-send, error -> retry
+        then degrade, device-lost -> degrade now.  Returns None when
+        the host is (now) degraded — the caller recomputes its slice
+        as the host mask.  Degradation changes latency, never bits."""
+        from trivy_tpu.obs import metrics as obs_metrics
+        from trivy_tpu.obs import tracing
+
+        t0 = time.perf_counter()
+        # the cross-host wait: where the coordinator actually blocks
+        # on a peer's silicon + DCN round trip
+        with tracing.span("engine.host", host=host.idx):
+            try:
+                return self._collect_host_timed(host, fut, arrays, b)
+            finally:
+                obs_metrics.DCN_HOST_DISPATCH_SECONDS.observe(
+                    time.perf_counter() - t0, host=str(host.idx))
+
+    def _collect_host_timed(self, host, fut, arrays, b):
+        from trivy_tpu.ops import match as m
+
+        with self._lock:
+            if host.idx in self.degraded_hosts:
+                return None
+        if fut is None:
+            return None
+        timeout = dcn_timeout_s()
+        attempt = 0
+        while True:
+            try:
+                redo = fut is None
+                for r in faults.fire("engine.host"):
+                    if r.action == "delay":
+                        time.sleep(r.param if r.param is not None
+                                   else 0.02)
+                    elif r.action == "drop":
+                        redo = True
+                    elif r.action == "error":
+                        raise HostFault(
+                            f"injected host error (host {host.idx})")
+                    elif r.action == "device-lost":
+                        raise HostLost(
+                            f"injected host loss (host {host.idx})")
+                if redo:
+                    # a dropped in-flight result is recomputed on the
+                    # worker — the match set stays byte-identical
+                    fut = self._send_match(host, arrays, b)
+                _reply, rarrays = fut.result(timeout)
+                words = rarrays["words"]
+                if words.shape[:2] != (self.db_local, b):
+                    raise HostFault(
+                        f"host {host.idx} returned mask shape "
+                        f"{words.shape}, want ({self.db_local}, {b}, _)")
+                return words.astype(np.uint32, copy=False)
+            except HostLost as exc:
+                self._degrade_host(host.idx, exc)
+                return None
+            except Exception as exc:
+                if attempt >= self.retries:
+                    self._degrade_host(host.idx, exc)
+                    return None
+                attempt += 1
+                _log.warn("DCN host dispatch failed; retrying",
+                          host=host.idx, attempt=attempt, err=str(exc))
+                fut = None  # re-send on the next pass
+
+    # -------------------------------------------------------------- health
+
+    def health(self) -> dict:
+        """Mesh health with the host topology: shape HOSTSxDPxDB,
+        per-shard degradation of the local slice (``degraded``, global
+        indices — same key as the single-host mesh) plus
+        ``degraded_hosts`` (peers whose whole slice serves from the
+        coordinator's host mask).  /readyz, ready_doc and the fleet
+        SkewDetector consume this."""
+        local = self._local.health()
+        with self._lock:
+            dh = sorted(self.degraded_hosts)
+        return {
+            "shape": f"{self.n_hosts}x{self.n_data}x{self.db_local}",
+            "data": self.n_data,
+            "db": self.n_db,
+            "degraded": local["degraded"],
+            "hosts": self.n_hosts,
+            "degraded_hosts": dh,
+        }
+
+    def host_sources(self) -> list[str]:
+        """Where each remote host's slice came from ("cache" = the
+        host-slice-keyed compiled-DB cache entry, "push" = shipped
+        over the wire) — diagnostics and warm-start tests."""
+        return [h.info.get("source", "?") for h in self.hosts]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        import atexit
+
+        try:
+            atexit.unregister(self.close)
+        except Exception:
+            pass
+        for h in self.hosts:
+            h.close()
+
+
+# -------------------------------------------------------------- worker side
+
+
+class _WorkerState:
+    """The worker's resident slices, keyed by session.  Up to
+    ``MAX_SESSIONS`` stay resident so a server hot reload — where the
+    successor engine hellos BEFORE the old engine is swapped out and
+    closed — never evicts the live engine's slice mid-scan (the old
+    session keeps answering until its coordinator goes away; the
+    oldest session is evicted only when a third arrives)."""
+
+    MAX_SESSIONS = 2
+
+    def __init__(self):
+        from collections import OrderedDict
+
+        self.lock = threading.Lock()
+        # session -> {"grid": [dp][db_local] DeviceDB, "meta": dict}
+        self.sessions: "OrderedDict[str, dict]" = OrderedDict()
+        # session -> hello meta awaiting its pushed slice
+        self.pending: dict[str, dict] = {}
+
+    def insert(self, session: str, grid, meta: dict) -> None:
+        """Caller holds self.lock."""
+        self.sessions[session] = {"grid": grid, "meta": meta}
+        self.sessions.move_to_end(session)
+        while len(self.sessions) > self.MAX_SESSIONS:
+            self.sessions.popitem(last=False)
+
+
+def _build_grid(dp: int, db_local: int, h1s: np.ndarray,
+                tables: np.ndarray, shard_len: int, window: int,
+                side: str):
+    """[dp][db_local] DeviceDB grid over the first dp*db_local local
+    devices — the ONE slice-placement loop, shared by the coordinator
+    (its own global shards 0..db_local-1) and every worker (its run of
+    the same partition), so device selection and DeviceDB construction
+    can never diverge between the two sides."""
+    import functools
+
+    import jax
+
+    from trivy_tpu.ops import match as m
+
+    n_local = jax.local_device_count()
+    if dp * db_local > n_local:
+        raise ValueError(
+            f"{side} needs {dp * db_local} local devices, has {n_local}")
+    devices = np.asarray(
+        jax.devices()[: dp * db_local]).reshape(dp, db_local)
+    grid = []
+    for g in range(dp):
+        row = []
+        for j in range(db_local):
+            put = functools.partial(jax.device_put, device=devices[g, j])
+            row.append(m.DeviceDB(
+                h1=put(h1s[j]), table=put(tables[j]),
+                n_rows=int(shard_len), window=int(window)))
+        grid.append(row)
+    return grid
+
+
+def _worker_build_grid(meta: dict, h1s: np.ndarray,
+                       tables: np.ndarray):
+    return _build_grid(int(meta["dp"]), int(meta["db_local"]), h1s,
+                       tables, int(meta["shard_len"]),
+                       int(meta["window"]), side="worker")
+
+
+def _worker_hello(state: _WorkerState, h: dict) -> dict:
+    """(Re)load this worker's slice for the hello'd session: the
+    host-slice cache entry when the coordinator names an on-disk DB,
+    else ask for a push.  The digest + db_meta cross-checks are the
+    same zero-diff guarantees the coordinator's own cache loads make."""
+    from trivy_tpu.tensorize import cache as compile_cache
+
+    with state.lock:
+        resident = state.sessions.get(h["session"])
+        if resident is not None:
+            state.sessions.move_to_end(h["session"])
+            return {"ok": 1,
+                    "source": resident["meta"].get("source", "?")}
+    # cache probe + grid build happen OUTSIDE the lock: a hot-swap
+    # successor's multi-MB device_put must not stall the live
+    # session's match requests into the coordinator's timeout ladder
+    entry = None
+    if h.get("db_path") and h.get("digest") \
+            and compile_cache.enabled():
+        entry = compile_cache.load_host_slice(
+            h["db_path"], digest=h["digest"],
+            window=h.get("window_req"), db_meta=h.get("db_meta"),
+            n_hosts=int(h["hosts"]), host_index=int(h["host_index"]),
+            n_db=int(h["n_db"]), n_rows=int(h["n_rows"]),
+            resolved_window=int(h["window"]))
+    if entry is not None \
+            and (int(entry["shard_len"]) != int(h["shard_len"])
+                 or int(entry["shard_base"]) != int(h["shard_base"])):
+        entry = None
+    if entry is None:
+        with state.lock:
+            # remember the hello so the follow-up load can bind to it
+            state.pending[h["session"]] = dict(h, source="push")
+        return {"ok": 1, "need_slice": 1}
+    grid = _worker_build_grid(h, entry["h1s"], entry["tables"])
+    with state.lock:
+        state.insert(h["session"], grid, dict(h, source="cache"))
+    return {"ok": 1, "source": "cache"}
+
+
+def _worker_load(state: _WorkerState, h: dict, arrays: dict) -> dict:
+    from trivy_tpu.tensorize import cache as compile_cache
+
+    with state.lock:
+        meta = state.pending.pop(h.get("session"), None)
+    if meta is None:
+        return {"ok": 0, "error": "load without a matching hello"}
+    # device_put outside the lock (see _worker_hello)
+    grid = _worker_build_grid(meta, arrays["h1s"], arrays["tables"])
+    with state.lock:
+        state.insert(meta["session"], grid, meta)
+    # persist the pushed slice so the NEXT start of this worker
+    # warm-loads it from the host-slice cache (best-effort)
+    if meta.get("db_path") and meta.get("digest"):
+        compile_cache.save_host_slice(
+            meta["db_path"], digest=meta["digest"],
+            window=meta.get("window_req"),
+            db_meta=meta.get("db_meta"),
+            n_hosts=int(meta["hosts"]),
+            host_index=int(meta["host_index"]),
+            n_db=int(meta["n_db"]), n_rows=int(meta["n_rows"]),
+            resolved_window=int(meta["window"]),
+            shard_len=int(meta["shard_len"]),
+            shard_base=int(meta["shard_base"]),
+            h1s=arrays["h1s"], tables=arrays["tables"])
+    return {"ok": 1, "source": "push"}
+
+
+def _worker_match(state: _WorkerState, h: dict,
+                  arrays: dict) -> tuple[dict, dict]:
+    from trivy_tpu.ops import match as m
+    from trivy_tpu.tensorize.compile import PackageBatch
+
+    with state.lock:
+        resident = state.sessions.get(h.get("session"))
+        if resident is None:
+            return {"ok": 0, "error": "stale-slice"}, {}
+        state.sessions.move_to_end(h["session"])
+        grid = resident["grid"]
+        meta = resident["meta"]
+    dp = int(meta["dp"])
+    db_local = int(meta["db_local"])
+    window = int(meta["window"])
+    b = int(h["b"])
+    h1 = arrays["h1"]
+    h2 = arrays["h2"]
+    rank = arrays["rank"]
+    flags = arrays["flags"]
+    out = np.zeros((db_local, b, m._words(window)), dtype=np.uint32)
+    base, rem = divmod(b, dp)
+    pend = []
+    lo = 0
+    for g in range(dp):
+        hi = lo + base + (1 if g < rem else 0)
+        if hi == lo:
+            continue
+        sub = PackageBatch(
+            h1=h1[lo:hi], h2=h2[lo:hi], rank=rank[lo:hi],
+            flags=flags[lo:hi], queries=[None] * (hi - lo))
+        for j in range(db_local):
+            pend.append((j, lo, hi, m.match_dispatch(grid[g][j], sub)))
+        lo = hi
+    for j, glo, ghi, p in pend:
+        if p is not None:
+            out[j, glo:ghi] = p.collect_words()
+    return {"ok": 1}, {"words": out}
+
+
+def _serve_conn(conn: socket.socket, state: _WorkerState,
+                allow_shutdown: bool) -> None:
+    try:
+        while True:
+            header, arrays = _recv_msg(conn)
+            op = header.get("op")
+            if op == "shutdown":
+                # only a spawn-mode worker (loopback, owned by its
+                # coordinator) honors remote shutdown; a standalone
+                # endpoint worker must not be killable by one frame
+                # from anything that can reach its port
+                if allow_shutdown:
+                    os._exit(0)
+                _send_msg(conn, {"ok": 0, "rid": header.get("rid"),
+                                 "error": "shutdown not allowed on a "
+                                          "standalone worker"})
+                continue
+            try:
+                if op == "hello":
+                    reply, rarrays = _worker_hello(state, header), {}
+                elif op == "load":
+                    reply, rarrays = _worker_load(state, header,
+                                                  arrays), {}
+                elif op == "match":
+                    reply, rarrays = _worker_match(state, header, arrays)
+                elif op == "ping":
+                    reply, rarrays = {"ok": 1}, {}
+                else:
+                    reply, rarrays = {"ok": 0,
+                                      "error": f"unknown op {op!r}"}, {}
+            except Exception as exc:
+                reply, rarrays = {"ok": 0, "error": str(exc)}, {}
+            reply["rid"] = header.get("rid")  # correlation echo
+            _send_msg(conn, reply, rarrays or None)
+    except (ConnectionError, OSError):
+        pass  # coordinator went away; wait for the next connection
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def _watch_stdin() -> None:
+    """Exit when the spawning coordinator dies: its stdin pipe EOFs.
+    Spawn-mode only (``--parent-watch``) — a standalone worker
+    daemonized with stdin at EOF (systemd, ``< /dev/null``) must NOT
+    exit on this."""
+    import sys
+
+    try:
+        while sys.stdin.buffer.read(1 << 16):
+            pass
+    except Exception:
+        pass
+    os._exit(0)
+
+
+def worker_main(argv: list[str]) -> int:
+    """``python -m trivy_tpu.ops.dcn --worker [--port N]
+    [--bind ADDR]``: serve this host's advisory slice to a
+    coordinator.  Prints ``DCN_WORKER_READY port=N`` once listening.
+    Binds loopback by default (the spawn-mode / single-box posture);
+    a worker on a peer host serving a remote coordinator passes
+    ``--bind 0.0.0.0`` (or its DCN interface address) explicitly and
+    should sit on a private interconnect — the slice protocol is a
+    data plane, not a public surface.  ``--parent-watch`` (spawn mode
+    only) ties the worker's lifetime to the coordinator's stdin pipe
+    and enables the remote ``shutdown`` op; a standalone worker
+    ignores both."""
+    port = 0
+    bind = "127.0.0.1"
+    if "--port" in argv:
+        port = int(argv[argv.index("--port") + 1])
+    if "--bind" in argv:
+        bind = argv[argv.index("--bind") + 1]
+    parent_watch = "--parent-watch" in argv
+    srv = socket.create_server((bind, port))
+    print(f"DCN_WORKER_READY port={srv.getsockname()[1]}", flush=True)
+    if parent_watch:
+        threading.Thread(  # lint: allow[tracing-capture] parent-death watchdog in the worker process; no tracing spine on this side
+            target=_watch_stdin, daemon=True).start()
+    state = _WorkerState()
+    while True:
+        conn, _addr = srv.accept()
+        threading.Thread(  # lint: allow[tracing-capture] worker process serves raw slices; no tracing spine on this side
+            target=_serve_conn, args=(conn, state, parent_watch),
+            daemon=True).start()
+
+
+def main(argv: list[str]) -> int:
+    if "--worker" in argv:
+        return worker_main(argv)
+    print("usage: python -m trivy_tpu.ops.dcn --worker [--port N] "
+          "[--bind ADDR] [--parent-watch]")
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    raise SystemExit(main(sys.argv[1:]))

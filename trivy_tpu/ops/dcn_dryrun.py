@@ -1,48 +1,50 @@
-"""Two-process DCN dryrun: the multi-host half of SURVEY §2.10,
-exercised for real with `jax.distributed` — 2 CPU processes × 4 virtual
-devices each, a hybrid (data × db) mesh whose "data" axis spans the
-process boundary (DCN) while "db" stays host-local (ICI), the DB shard
-broadcast (ops/multihost.put_sharded), per-host query globalization
-(make_array_from_process_local_data), one jitted sharded match over the
-global mesh, and a cross-host collective reduction.
+"""Two-process DCN dryrun: the cross-host half of SURVEY §2.10,
+exercised through the PRODUCTION distributed-MeshDB path (ops/dcn.py)
+— a coordinator process with 4 virtual CPU devices serving shards
+0..3 of an 8-way global partition on its local mesh, plus one spawned
+worker process (4 more virtual devices) serving shards 4..7 behind
+the DCN worker protocol, merged by the host-merge decoder.
 
-Verification per host: the global run's addressable output shards must
-be bit-identical to a single-host run of the same half-batch on a local
-mesh (which tests/test_match.py ties to the python oracle), and the
-jitted global hit-count must equal the sum both hosts report.
+This is deliberately NOT a parallel dryrun-only kernel: the old
+collective `shard_map` formulation is retired, and the dryrun asserts
+the exact engine path a `--mesh 2x1x4` server would take
+(`MatchEngine._mdb` is a `dcn.HostMeshDB`, health reports the host
+topology, zero degradations) so dryrun and serving cannot drift —
+the same promotion contract `__graft_entry__.dryrun_multichip`
+enforces for the single-host mesh.
 
-Run the launcher (spawns both workers, writes the artifact):
+Verification: the distributed engine's findings must be bit-identical
+to the pure-host oracle for every query, and the per-host metric spine
+must show the remote host actually dispatched (its slice was not
+silently host-masked).
+
+Run the launcher (spawns the coordinator, which spawns the worker,
+and writes the artifact):
 
     python -m trivy_tpu.ops.dcn_dryrun [--out MULTICHIP_DCN.json]
-
-(reference counterpart: the NCCL/MPI-style multi-node scan fan-out the
-Go scanner delegates to its client/server split, pkg/rpc + SURVEY §2.10)
 """
 
 from __future__ import annotations
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
-N_PROCESSES = 2
-N_LOCAL_DEVICES = 4
-N_QUERIES_PER_HOST = 257        # deliberately not a lane multiple
+N_HOSTS = 2
+N_LOCAL_DEVICES = 4            # per host
+N_QUERIES = 514                # deliberately not a lane multiple
 DB_ADVISORIES = 3000
 
 
-# ------------------------------------------------------------------ worker
+# ------------------------------------------------------------- coordinator
 
 
-def _worker(process_id: int, coordinator: str) -> None:
-    import numpy as np
-
+def _coordinator() -> None:
     # jax may be pre-imported by a sitecustomize with a hardware
     # platform pinned; env vars are too late for that, so force the
-    # virtual-CPU platform via config BEFORE any backend/distributed
-    # initialization (same dance as __graft_entry__.dryrun_multichip)
+    # virtual-CPU platform via config BEFORE any backend use (same
+    # dance as __graft_entry__.dryrun_multichip)
     os.environ.setdefault("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in \
             os.environ["XLA_FLAGS"]:
@@ -52,174 +54,107 @@ def _worker(process_id: int, coordinator: str) -> None:
 
     jax.config.update("jax_platforms", "cpu")
 
-    from trivy_tpu.ops import multihost
-
-    ok = multihost.bootstrap(coordinator, N_PROCESSES, process_id)
-    assert ok, "jax.distributed bootstrap did not come up"
-
-    import jax.numpy as jnp
-
-    assert jax.process_count() == N_PROCESSES
-    assert jax.local_device_count() == N_LOCAL_DEVICES
-
-    # hybrid mesh: "db" on the 4 local devices, "data" across the 2
-    # hosts — nothing but the query stream crosses DCN
-    mesh = multihost.crawl_mesh(n_db=N_LOCAL_DEVICES)
-    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == \
-        {"data": N_PROCESSES, "db": N_LOCAL_DEVICES}
-
-    from trivy_tpu.ops.match import (
-        ShardedDB,
-        _sharded_match,
-        _sorted_padded,
-        _words,
-    )
-    from trivy_tpu.tensorize.compile import compile_db
+    from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.obs import metrics as obs_metrics
+    from trivy_tpu.ops import dcn
     from trivy_tpu.tensorize.synth import synth_queries, synth_trivy_db
 
-    # identical DB on both hosts (same seed), broadcast as shards
+    assert jax.local_device_count() == N_LOCAL_DEVICES
+
     db = synth_trivy_db(n_advisories=DB_ADVISORIES)
-    cdb = compile_db(db)
-    sdb = multihost.sharded_db(cdb, mesh)
+    os.environ[dcn.ENV_DCN] = "spawn"
+    engine = MatchEngine(
+        db, mesh_spec=f"{N_HOSTS}x1x{N_LOCAL_DEVICES}")
+    try:
+        # the dryrun exercises the PRODUCTION cross-host path: the
+        # engine must be serving from a HostMeshDB, not a local mesh
+        assert isinstance(engine._mdb, dcn.HostMeshDB), \
+            "engine did not take the distributed-MeshDB path"
+        health = engine.shard_health()
+        assert health is not None \
+            and health["shape"] == f"{N_HOSTS}x1x{N_LOCAL_DEVICES}", \
+            health
+        assert health["hosts"] == N_HOSTS, health
+        assert not health["degraded"] and not health["degraded_hosts"], \
+            health
 
-    # every host sees the full query list but contributes only its own
-    # half to the global batch
-    all_queries = synth_queries(db, N_QUERIES_PER_HOST * N_PROCESSES)
-    lo = process_id * N_QUERIES_PER_HOST
-    mine = all_queries[lo:lo + N_QUERIES_PER_HOST]
-    batch = cdb.encode_packages(
-        [(q.space, q.name, q.version, q.scheme_name) for q in mine])
-
-    # per-host padding to a common local bucket, then globalize
-    from trivy_tpu.ops.match import _bucket
-
-    local_bucket = _bucket(len(batch.h1))
-    order, h1, h2, rank, flags = _sorted_padded(batch, local_bucket)
-    globals_ = multihost.globalize_batch(mesh, {
-        "h1": h1, "h2": h2, "rank": rank, "flags": flags,
-    })
-
-    out = _sharded_match(
-        sdb.h1, sdb.table,
-        globals_["h1"], globals_["h2"], globals_["rank"],
-        globals_["flags"],
-        window=sdb.window, mesh=mesh,
-    )
-    out.block_until_ready()
-
-    # ---- per-host result gather: addressable shards of my data block
-    n_words = _words(sdb.window)
-    local_out = np.zeros((N_LOCAL_DEVICES, local_bucket, n_words),
-                         dtype=np.uint32)
-    row0 = process_id * local_bucket
-    for shard in out.addressable_shards:
-        d_sl, b_sl, w_sl = shard.index
-        b_start = b_sl.start or 0
-        local_out[d_sl, b_start - row0:(b_sl.stop or out.shape[1])
-                  - row0, w_sl] = np.asarray(shard.data)
-
-    # ---- reference: same half-batch on a host-local mesh (the path
-    # test_match.py proves oracle-identical)
-    from jax.sharding import Mesh
-
-    local_mesh = Mesh(
-        np.array(jax.local_devices()).reshape(1, N_LOCAL_DEVICES),
-        ("data", "db"))
-    local_sdb = ShardedDB.from_compiled(cdb, local_mesh)
-    ref = _sharded_match(
-        local_sdb.h1, local_sdb.table,
-        jnp.asarray(h1), jnp.asarray(h2), jnp.asarray(rank),
-        jnp.asarray(flags),
-        window=sdb.window, mesh=local_mesh,
-    )
-    ref_np = np.asarray(ref)
-    diff = int((local_out != ref_np).sum())
-
-    # ---- DCN collective: a jitted global reduction both hosts must
-    # agree on (the all-reduce rides the process boundary)
-    local_bits = int(np.unpackbits(
-        local_out.view(np.uint8)).sum())
-    global_bits = int(jax.jit(
-        lambda x: jnp.sum(jnp.asarray(
-            jax.lax.population_count(x.astype(jnp.uint32)),
-            jnp.int64)))(out))
-
-    print(json.dumps({
-        "process": process_id,
-        "mesh": {"data": N_PROCESSES, "db": N_LOCAL_DEVICES},
-        "db_rows": int(cdb.n_rows),
-        "queries": len(mine),
-        "diff_vs_local_mesh": diff,
-        "local_hit_bits": local_bits,
-        "global_hit_bits": global_bits,
-    }), flush=True)
-    assert diff == 0, f"process {process_id}: {diff} mismatched words"
+        queries = synth_queries(db, N_QUERIES)
+        got = engine.detect(queries)
+        oracle = engine.oracle_detect(queries)
+        diff = sum(1 for g, o in zip(got, oracle)
+                   if g.adv_indices != o.adv_indices)
+        matches = sum(len(g.adv_indices) for g in got)
+        # the remote host must have actually served its slice
+        remote_dispatches = obs_metrics.DCN_HOST_DISPATCH_SECONDS.snapshot(
+            host="1")[2]
+        health = engine.shard_health()
+        print(json.dumps({
+            "hosts": N_HOSTS,
+            "mesh": health["shape"],
+            "db_rows": int(engine.cdb.n_rows),
+            "global_shards": engine._mdb.n_db,
+            "queries": len(queries),
+            "diff_vs_oracle": diff,
+            "matches": matches,
+            "remote_dispatches": int(remote_dispatches),
+            "degraded_hosts": health["degraded_hosts"],
+            "slice_sources": engine._mdb.host_sources(),
+        }), flush=True)
+        assert diff == 0, f"{diff} queries mismatched the oracle"
+    finally:
+        engine.close()
 
 
 # ---------------------------------------------------------------- launcher
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def run(out_path: str | None = None, timeout: int = 600) -> dict:
-    """Spawn both workers, verify, and (optionally) write the artifact.
-    Returns the combined result document."""
-    coordinator = f"127.0.0.1:{_free_port()}"
-    env_base = {
+    """Spawn the coordinator (which spawns its worker), verify, and
+    (optionally) write the artifact.  Returns the result document."""
+    env = {
         **os.environ,
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS":
             f"--xla_force_host_platform_device_count={N_LOCAL_DEVICES}",
-        "JAX_ENABLE_X64": "1",
     }
-    procs = []
-    for pid in range(N_PROCESSES):
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "trivy_tpu.ops.dcn_dryrun",
-             "--worker", str(pid), coordinator],
-            env=env_base, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True,
-        ))
-    results, errs = [], []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            out, err = p.communicate()
-            errs.append("timeout")
-        for line in out.splitlines():
-            if line.startswith("{"):
-                try:
-                    results.append(json.loads(line))
-                except json.JSONDecodeError:
-                    errs.append(f"unparseable worker line: {line[:200]}")
-        if p.returncode != 0:
-            errs.append(err[-2000:])
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "trivy_tpu.ops.dcn_dryrun",
+         "--coordinator"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    errs: list[str] = []
+    result = None
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate()
+        errs.append("timeout")
+    for line in out.splitlines():
+        if line.startswith("{"):
+            try:
+                result = json.loads(line)
+            except json.JSONDecodeError:
+                errs.append(f"unparseable coordinator line: {line[:200]}")
+    if proc.returncode != 0:
+        errs.append(err[-2000:])
     doc = {
-        "n_processes": N_PROCESSES,
+        "n_hosts": N_HOSTS,
         "n_local_devices": N_LOCAL_DEVICES,
-        "workers": results,
-        "ok": not errs and len(results) == N_PROCESSES,
+        "result": result,
+        "ok": not errs and result is not None,
         "errors": errs,
     }
     if doc["ok"]:
-        g = {r["global_hit_bits"] for r in results}
-        local_sum = sum(r["local_hit_bits"] for r in results)
         doc["ok"] = (
-            len(g) == 1
-            and g == {local_sum}
-            and all(r["diff_vs_local_mesh"] == 0 for r in results)
-            and local_sum > 0
+            result["diff_vs_oracle"] == 0
+            and result["matches"] > 0
+            and result["remote_dispatches"] > 0
+            and not result["degraded_hosts"]
         )
         if not doc["ok"]:
-            doc["errors"].append(
-                f"cross-host mismatch: global={sorted(g)} "
-                f"local_sum={local_sum}")
+            doc["errors"].append(f"production-path check failed: {result}")
     if out_path:
         # lint: allow[atomic-write] dryrun report artifact for the bench driver, not program state
         with open(out_path, "w") as f:
@@ -228,8 +163,8 @@ def run(out_path: str | None = None, timeout: int = 600) -> dict:
 
 
 def main(argv: list[str]) -> int:
-    if len(argv) >= 3 and argv[0] == "--worker":
-        _worker(int(argv[1]), argv[2])
+    if argv and argv[0] == "--coordinator":
+        _coordinator()
         return 0
     out = "MULTICHIP_DCN.json"
     if len(argv) >= 2 and argv[0] == "--out":

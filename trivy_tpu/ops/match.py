@@ -40,7 +40,6 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from trivy_tpu.tensorize.compile import CompiledDB, PackageBatch
 
@@ -51,30 +50,6 @@ FLAG_PRE_ONLY = 4  # row-level: only candidates for pre-release queries
 TABLE_LANES = 8  # int32 lanes per row: h1,h2,lo,hi,flags + 3 pad
 
 _PAD_H1 = np.uint32(0xFFFFFFFF)
-
-
-def _shard_map():
-    """jax.shard_map moved out of the experimental namespace around
-    jax 0.5; resolve whichever spelling this runtime has. ImportError
-    propagates when neither exists (no collective sharding support) —
-    callers on such runtimes use the per-shard dispatch path
-    (ops/mesh.py) instead."""
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-    return shard_map
-
-
-def shard_map_available() -> bool:
-    """Whether this jax runtime can run the collective shard_map path
-    (the DCN dryrun and ShardedDB). The serving mesh (ops/mesh.py)
-    does NOT need it — per-shard dispatches are plain jits."""
-    try:
-        _shard_map()
-    except ImportError:
-        return False
-    return True
 
 
 def _words(window: int) -> int:
@@ -315,97 +290,45 @@ def match_batch(ddb: DeviceDB, batch: PackageBatch) -> np.ndarray:
 # --------------------------------------------------------------- sharded
 
 
-@dataclass
-class ShardedDB:
-    """DB rows split into `n_db` halo-padded shards, laid out [n_db, S]
-    and sharded over the mesh "db" axis."""
+def host_shards(cdb: CompiledDB, n_db: int):
+    """Halo-padded per-shard host arrays: (h1s [D,S], tables
+    [D,S,L], shard_len, shard_base). The ONE slice partition shared by
+    the single-host mesh's device_put path (ops/mesh.py MeshDB) and
+    the cross-host distributed MeshDB (ops/dcn.py — each host serves a
+    contiguous run of these global shards), so the host-merge decoder
+    consumes one (shard_base, shard_len) layout everywhere."""
+    w = cdb.window
+    n = cdb.n_rows
+    base = -(-max(n, 1) // n_db)
+    shard_len = base + w  # ceil + halo
 
-    h1: jax.Array  # uint32[D, S]
-    table: jax.Array  # int32[D, S, TABLE_LANES]
-    mesh: Mesh
-    window: int
-    shard_len: int
-    shard_base: int  # global row stride between shard starts
+    def shard(arr, fill):
+        out = np.full((n_db, shard_len), fill, dtype=arr.dtype)
+        for d in range(n_db):
+            lo_i = d * base
+            hi_i = min(lo_i + shard_len, n)
+            if lo_i < n:
+                out[d, : hi_i - lo_i] = arr[lo_i:hi_i]
+        return out
 
-    @staticmethod
-    def host_shards(cdb: CompiledDB, n_db: int):
-        """Halo-padded per-shard host arrays: (h1s [D,S], tables
-        [D,S,L], shard_len, shard_base). Shared by the single-process
-        device_put path and the multi-process DCN placement
-        (ops/multihost.put_sharded)."""
-        w = cdb.window
-        n = cdb.n_rows
-        base = -(-max(n, 1) // n_db)
-        shard_len = base + w  # ceil + halo
-
-        def shard(arr, fill):
-            out = np.full((n_db, shard_len), fill, dtype=arr.dtype)
-            for d in range(n_db):
-                lo_i = d * base
-                hi_i = min(lo_i + shard_len, n)
-                if lo_i < n:
-                    out[d, : hi_i - lo_i] = arr[lo_i:hi_i]
-            return out
-
-        # pad rows with h1=0xffffffff so searchsorted lands before padding
-        # and name_eq fails on it (no real hash is all-ones with h2 ones too)
-        h1s = shard(cdb.row_h1, _PAD_H1)
-        tables = np.stack([
-            _pack_table(h1s[d],
-                        shard(cdb.row_h2, _PAD_H1)[d],
-                        shard(cdb.row_lo, 0)[d],
-                        shard(cdb.row_hi, -1)[d],
-                        shard(cdb.row_flags, 0)[d])
-            for d in range(n_db)
-        ])
-        return h1s, tables, shard_len, base
-
-    @classmethod
-    def from_compiled(cls, cdb: CompiledDB, mesh: Mesh,
-                      put=None) -> "ShardedDB":
-        """`put(arr, mesh, spec)` overrides placement — the DCN path
-        passes ops/multihost.put_sharded; default is plain device_put
-        (single-process)."""
-        if put is None:
-            def put(arr, mesh_, spec):
-                return jax.device_put(arr, NamedSharding(mesh_, spec))
-        n_db = mesh.shape["db"]
-        h1s, tables, shard_len, base = cls.host_shards(cdb, n_db)
-        return cls(
-            h1=put(h1s, mesh, P("db", None)),
-            table=put(tables, mesh, P("db", None, None)),
-            mesh=mesh, window=cdb.window, shard_len=shard_len,
-            shard_base=base,
-        )
+    # pad rows with h1=0xffffffff so searchsorted lands before padding
+    # and name_eq fails on it (no real hash is all-ones with h2 ones too)
+    h1s = shard(cdb.row_h1, _PAD_H1)
+    tables = np.stack([
+        _pack_table(h1s[d],
+                    shard(cdb.row_h2, _PAD_H1)[d],
+                    shard(cdb.row_lo, 0)[d],
+                    shard(cdb.row_hi, -1)[d],
+                    shard(cdb.row_flags, 0)[d])
+        for d in range(n_db)
+    ])
+    return h1s, tables, shard_len, base
 
 
-@functools.partial(jax.jit, static_argnames=("window", "mesh"))
-def _sharded_match(row_h1, table, pkg_h1, pkg_h2, pkg_rank, pkg_flags,
-                   *, window: int, mesh: Mesh):
-    """DB sharded over "db", packages sharded over "data".
-    -> uint32[n_db, B, W/32] stacked per-shard hit words (the host maps
-    each shard's bits through that shard's own window starts and dedupes
-    the halo overlap)."""
-
-    def local(rh1, rtab, ph1, ph2, prank, pflags):
-        out = _match_kernel(
-            rh1[0], rtab[0], ph1, ph2, prank, pflags, window=window,
-        )
-        return out[None]  # [1, b_local, W/32]
-
-    shard_map = _shard_map()
-    return shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(
-            P("db", None), P("db", None, None),
-            P("data"), P("data"), P("data"), P("data"),
-        ),
-        out_specs=P("db", "data", None),
-    )(row_h1, table, pkg_h1, pkg_h2, pkg_rank, pkg_flags)
-
-
-# NB: the SERVING multi-device path does not live here — it is
+# NB: the SERVING multi-device paths do not live here — single-host is
 # ops/mesh.py MeshDB.dispatch (plain per-cell jits with per-shard fault
-# isolation).  ShardedDB + _sharded_match stay as the collective
-# shard_map formulation the DCN dryrun's cross-host reduction needs.
+# isolation) and cross-host is ops/dcn.py HostMeshDB (the same cells
+# per host plus a host-merge over DCN).  The old collective shard_map
+# formulation (ShardedDB + _sharded_match) is retired: the promoted
+# serving path needs no collectives, and the DCN dryrun now asserts
+# the production path instead of a parallel kernel.

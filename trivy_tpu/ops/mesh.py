@@ -9,16 +9,16 @@ dryruns.  This module promotes that layout into the production path:
 Layout (same physics as ops/multihost.py, now with serving semantics):
 
   "db" axis    the advisory row table sharded into halo-padded slices
-               (ops/match.py `ShardedDB.host_shards`), one slice per
+               (ops/match.py `host_shards`), one slice per
                shard, each slice resident on its own device — the axis
                that admits advisory sets larger than one chip's HBM.
   "data" axis  the query batch split into contiguous row groups, one
                group per data-parallel replica set — the axis that buys
                query throughput.
 
-Unlike the dryrun's collective `shard_map` kernel, the serving path
-dispatches each (data-group, db-shard) cell as its OWN plain jit on
-that cell's device.  That choice is deliberate:
+Unlike the dryrun era's collective `shard_map` kernel (retired), the
+serving path dispatches each (data-group, db-shard) cell as its OWN
+plain jit on that cell's device.  That choice is deliberate:
 
 - **Per-shard fault isolation.**  A failing cell is retried
   (`TRIVY_TPU_MESH_SHARD_RETRIES`, default 1) and then only that
@@ -30,9 +30,10 @@ that cell's device.  That choice is deliberate:
   ops/match.py): every cell answers "which of my rows hit" for its
   queries; the host-side decoder merges shard bitmaps.  shard_map
   bought nothing on the hot path but a single failure domain.
-- **Runtime reach.**  Plain jits run on any jax; `shard_map` moved
-  namespaces across jax releases (ops/match.py `shard_map_available`)
-  and stays needed only by the DCN dryrun's cross-host reduction.
+- **Runtime reach.**  Plain jits run on any jax, and the same
+  property is what lets the distributed MeshDB (ops/dcn.py) span
+  hosts with no multi-process jax runtime at all — each host runs
+  these cells locally and ships packed words.
 
 Topology comes from `--mesh DPxDB` / `TRIVY_TPU_MESH` ("auto" sizes the
 db axis so each shard slice fits the per-device HBM budget,
@@ -72,7 +73,7 @@ DEFAULT_RETRIES = 1
 # hot/tall partitions
 DEFAULT_HBM_GB = 8.0
 
-_SPEC_RX = re.compile(r"^(\d+)\s*[xX]\s*(\d+)$")
+_SPEC_RX = re.compile(r"^(?:(\d+)\s*[xX]\s*)?(\d+)\s*[xX]\s*(\d+)$")
 
 
 class ShardFault(faults.FaultError):
@@ -113,8 +114,11 @@ def _hbm_budget_bytes() -> float:
 
 def parse_spec(spec: str):
     """"" / "0" / "off" -> None (single-chip), "auto" -> "auto",
-    "DPxDB" -> (dp, db).  Raises ValueError on anything else so an
-    operator typo fails at startup, not mid-crawl."""
+    "DPxDB" -> (dp, db), "HOSTSxDPxDB" with hosts >= 2 ->
+    (hosts, dp, db) — the cross-host distributed MeshDB (ops/dcn.py;
+    dp x db is each host's LOCAL mesh).  A "1xDPxDB" spec collapses to
+    the plain local (dp, db).  Raises ValueError on anything else so
+    an operator typo fails at startup, not mid-crawl."""
     s = (spec or "").strip().lower()
     if s in ("", "0", "off", "none"):
         return None
@@ -123,12 +127,15 @@ def parse_spec(spec: str):
     m = _SPEC_RX.match(s)
     if not m:
         raise ValueError(
-            f"bad mesh spec {spec!r}: want 'DPxDB' (e.g. 2x4), 'auto', "
-            "or 'off'")
-    dp, db = int(m.group(1)), int(m.group(2))
-    if dp < 1 or db < 1:
+            f"bad mesh spec {spec!r}: want 'DPxDB' (e.g. 2x4), "
+            "'HOSTSxDPxDB' (e.g. 2x1x4), 'auto', or 'off'")
+    hosts = int(m.group(1)) if m.group(1) is not None else 1
+    dp, db = int(m.group(2)), int(m.group(3))
+    if hosts < 1 or dp < 1 or db < 1:
         raise ValueError(f"mesh axes must be >= 1, got {spec!r}")
-    return dp, db
+    if hosts == 1:
+        return dp, db
+    return hosts, dp, db
 
 
 def multi_device_ready(n: int = 2) -> bool:
@@ -166,21 +173,22 @@ def choose_topology(n_devices: int, n_rows: int) -> tuple[int, int]:
 
 def build_mesh(dp: int, db: int):
     """A (data=dp, db=db) Mesh over the first dp*db local devices.
-    The serving mesh is single-process by design — every cell's slice
-    is device_put onto an addressable device.  Multi-process (DCN)
-    serving is rejected here rather than handed a cross-host mesh the
-    per-cell placement cannot commit to; the cross-host reconciliation
-    exists only as the dryrun (ops/dcn_dryrun.py) — run one server
-    per host until it is promoted."""
+    This LOCAL mesh is single-process by design — every cell's slice
+    is device_put onto an addressable device.  A multi-process jax
+    runtime is rejected here rather than handed a cross-host mesh the
+    per-cell placement cannot commit to: cross-host serving is the
+    distributed MeshDB (ops/dcn.py, `--mesh HOSTSxDPxDB` +
+    TRIVY_TPU_DCN workers), which spans hosts at the process level and
+    needs no multi-process jax at all."""
     import jax
 
     from trivy_tpu.ops import multihost
 
     if jax.process_count() > 1:
         raise ValueError(
-            "multi-process serving mesh is not supported (the DCN "
-            "path is dryrun-only, ops/dcn_dryrun.py); run one server "
-            "per host")
+            "multi-process jax serving mesh is not supported; "
+            "cross-host serving is the distributed MeshDB "
+            "(--mesh HOSTSxDPxDB + TRIVY_TPU_DCN, ops/dcn.py)")
     n_local = jax.local_device_count()
     if dp * db > n_local:
         raise ValueError(
@@ -196,6 +204,13 @@ def build_from_spec(spec: str, n_rows: int):
     parsed = parse_spec(spec)
     if parsed is None:
         return None
+    if parsed != "auto" and len(parsed) == 3:
+        # cross-host specs never build a local jax Mesh: the engine
+        # routes them to the distributed MeshDB (ops/dcn.py) before
+        # this point, so reaching here means a caller skipped that
+        raise ValueError(
+            f"mesh spec {spec!r} spans hosts; cross-host serving is "
+            "the distributed MeshDB (ops/dcn.py), not a local mesh")
     import jax
 
     n_local = jax.local_device_count()
@@ -322,7 +337,7 @@ class MeshDB:
                 db_path, cdb, n_db, window=window_req, digest=digest,
                 db_meta=db_meta)
         if shards is None:
-            shards = m.ShardedDB.host_shards(cdb, n_db)
+            shards = m.host_shards(cdb, n_db)
             if use_cache:
                 compile_cache.save_shards(
                     db_path, cdb, n_db, shards, window=window_req,
@@ -341,6 +356,9 @@ class MeshDB:
             grid.append(row)
         obs_metrics.MESH_SHAPE.set(n_data, axis="data")
         obs_metrics.MESH_SHAPE.set(n_db, axis="db")
+        # a reload from a distributed topology back onto a local mesh
+        # must not leave a stale cross-host gauge behind
+        obs_metrics.MESH_SHAPE.set(1, axis="hosts")
         _log.info("mesh DB resident", data=n_data, db=n_db,
                   shard_rows=shard_len, total_rows=cdb.n_rows)
         return cls(cdb=cdb, grid=grid, n_data=n_data, n_db=n_db,

@@ -1,55 +1,31 @@
-"""Multi-host (DCN + ICI) deployment of the match engine.
+"""Local (data x db) mesh construction for the match engine.
 
-The reference scales scans with one server process and goroutine
-pools (pkg/parallel/pipeline.go); the TPU-native equivalent scales in
-two orthogonal dimensions, mapped to the two interconnect tiers
-(SURVEY.md §2.10, §5 "distributed communication backend"):
+Historical note: this module used to carry the whole multi-host story
+(jax.distributed bootstrap, cross-process DB shard broadcast, per-host
+query globalization) for the collective DCN dryrun.  That tier was
+promoted to the SERVING path as the distributed MeshDB — ops/dcn.py:
+each host serves only its advisory row slice on its local mesh through
+plain per-cell jits and the coordinator merges per-host shard bitmaps
+on the host side, so no jax collective (and no multi-process jax
+runtime) is needed at all.  The dead collective halves (``bootstrap``,
+``put_sharded``, ``sharded_db``, ``globalize_batch``) are retired with
+it; what remains is the one live piece: the local mesh builder the
+single-host serving mesh (ops/mesh.py) and the driver dryrun
+(`__graft_entry__.dryrun_multichip`) share.
 
-  ICI ("db" mesh axis, devices within a slice)
-      The advisory row table is the big tensor (~19 MB per 500k
-      advisories, ~1.2 GB for a full trivy-db-scale compile with hot
-      partitions). It shards over the devices of one slice; each shard
-      carries a window-sized halo so interval windows never straddle a
-      boundary (ops/match.py ShardedDB). The kernel is then a pure map
-      — ZERO collectives on the hot path: every device answers "which
-      of my rows hit" for every query it sees, and the host-side
-      decoder merges shard bitmaps. ICI is only exercised at DB load /
-      hot-swap time (device_put of the new shard tensors).
+Axis semantics (SURVEY.md §2.10):
 
-  DCN ("data" mesh axis, across hosts)
-      A registry crawl is embarrassingly parallel over artifacts, so
-      hosts split the query stream, not the DB: each host holds a FULL
-      copy of the compiled DB on its slice and scans its own batches.
-      No tensor ever crosses DCN — the only cross-host traffic is the
-      scan RPC (rpc/server.py) and the OCI pull of new DB versions.
-      This mirrors the reference's client/server split (clients fan
-      out, each server matches locally) rather than NCCL-style
-      allreduce,
-      because matching has no gradient-like reduction: results are
-      per-query and stay with the host that owns the query.
-
-  Hybrid ("data" over DCN x "db" over ICI)
-      For DBs too large for one slice's HBM, create_hybrid_device_mesh
-      places "db" on the ICI-connected axis and "data" across hosts;
-      queries are globalized with make_array_from_process_local_data
-      so each host feeds only its own batch rows.
-
-DB hot-swap across hosts reuses the single-host design (rpc/server.py
-metadata watcher): every host watches the DB metadata document and
-double-buffers its device shards; swaps are not synchronized across
-hosts — two hosts briefly serving different DB versions is the same
-consistency model as the reference's rolling server fleet.
-
-Failure model: hosts are stateless replicas behind the scan RPC (the
-cache and DB are content-addressed); a lost host loses only its
-in-flight batches, which the client retries (rpc/client.py backoff)
-against another replica. No checkpointing is needed — scans are
-idempotent, exactly as in the reference (SURVEY.md §5).
+  "db"    the advisory row table is the big tensor; it shards over the
+          devices of one host with window-sized halos so interval
+          windows never straddle a boundary (ops/match.host_shards).
+          The kernel is then a pure map — ZERO collectives on the hot
+          path: every device answers "which of my rows hit", and the
+          host-side decoder merges shard bitmaps.
+  "data"  the query batch splits into contiguous row groups, one per
+          data-parallel replica set — the axis that buys throughput.
 """
 
 from __future__ import annotations
-
-import os
 
 import numpy as np
 
@@ -58,49 +34,15 @@ from trivy_tpu.log import logger
 _log = logger("multihost")
 
 
-def bootstrap(coordinator: str | None = None,
-              num_processes: int | None = None,
-              process_id: int | None = None) -> bool:
-    """Initialize jax.distributed from args or the standard env vars
-    (JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID).
-    Returns True when a multi-process runtime came up, False for the
-    single-process case (no-op)."""
-    import jax
-
-    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if num_processes is None:
-        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "0") or 0)
-    if process_id is None:
-        pid = os.environ.get("JAX_PROCESS_ID")
-        process_id = int(pid) if pid is not None else None
-    if not coordinator or num_processes <= 1:
-        return False
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
-    _log.info("multihost runtime up",
-              processes=jax.process_count(),
-              local_devices=jax.local_device_count())
-    return True
-
-
 def crawl_mesh(n_db: int | None = None, devices=None):
-    """Build the crawl Mesh: "db" (advisory shards) on the fastest
-    interconnect, "data" (query batches) across the remaining device
-    factor / hosts.
-
-    Single-process: a plain (data, db) mesh over `devices` (default:
-    all local devices). Multi-process: a hybrid mesh with "db" inside
-    each host's slice (ICI) and "data" spanning hosts (DCN); `devices`
-    must be None there — the hybrid layout owns device placement."""
+    """Build the local crawl Mesh: "db" (advisory shards) on the
+    fastest interconnect, "data" (query batches) over the remaining
+    device factor.  Single-process only — a multi-process jax runtime
+    is rejected by the serving-mesh builder (ops/mesh.build_mesh);
+    cross-host serving is ops/dcn.py."""
     import jax
     from jax.sharding import Mesh
 
-    n_proc = jax.process_count()
-    if devices is not None and n_proc > 1:
-        raise ValueError("explicit devices only in single-process mode")
     if devices is None:
         n_local = jax.local_device_count()
     else:
@@ -112,68 +54,6 @@ def crawl_mesh(n_db: int | None = None, devices=None):
             f"db axis ({n_db}) must divide local device count "
             f"({n_local}): DB shards must stay ICI-connected")
     data_local = n_local // n_db
-    if n_proc == 1:
-        devs = np.array(devices if devices is not None
-                        else jax.devices()[:n_local])
-        return Mesh(devs.reshape(data_local, n_db), ("data", "db"))
-    from jax.experimental import mesh_utils
-
-    try:
-        devices = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(data_local, n_db),
-            dcn_mesh_shape=(n_proc, 1),  # data spans hosts, db local
-        )
-    except ValueError:
-        # no slice topology (e.g. multi-process CPU in the DCN dryrun):
-        # lay the mesh out by hand with the same property — each host's
-        # devices form whole rows, so "db" never crosses DCN
-        per_proc: dict[int, list] = {}
-        for d in sorted(jax.devices(), key=lambda d: (d.process_index,
-                                                      d.id)):
-            per_proc.setdefault(d.process_index, []).append(d)
-        rows = [np.array(ds).reshape(data_local, n_db)
-                for _p, ds in sorted(per_proc.items())]
-        devices = np.concatenate(rows, axis=0)
-    return Mesh(devices, ("data", "db"))
-
-
-def put_sharded(arr: np.ndarray, mesh, spec):
-    """Place a host-identical numpy array onto the mesh with `spec`.
-    Works across processes (DCN): every host holds the full array and
-    each contributes only the shards it is addressable for
-    (make_array_from_callback) — the multi-host form of the DB shard
-    broadcast. Single-process this is equivalent to device_put."""
-    import jax
-    from jax.sharding import NamedSharding
-
-    s = NamedSharding(mesh, spec)
-    if jax.process_count() == 1:
-        return jax.device_put(arr, s)
-    return jax.make_array_from_callback(
-        arr.shape, s, lambda idx: arr[idx])
-
-
-def sharded_db(cdb, mesh):
-    """ShardedDB placed DCN-aware: shards over "db" (local/ICI),
-    replicated over "data" (across hosts)."""
-    from trivy_tpu.ops.match import ShardedDB
-
-    return ShardedDB.from_compiled(cdb, mesh, put=put_sharded)
-
-
-def globalize_batch(mesh, arrays: dict):
-    """Per-host batch arrays -> global jax Arrays sharded over "data".
-    Single-process returns the inputs unchanged (device_put happens in
-    the dispatch path); multi-process uses
-    make_array_from_process_local_data so each host contributes only
-    its own rows and nothing crosses DCN."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    if jax.process_count() == 1:
-        return arrays
-    spec = NamedSharding(mesh, P("data"))
-    return {
-        k: jax.make_array_from_process_local_data(spec, v)
-        for k, v in arrays.items()
-    }
+    devs = np.array(devices if devices is not None
+                    else jax.devices()[:n_local])
+    return Mesh(devs.reshape(data_local, n_db), ("data", "db"))

@@ -79,6 +79,7 @@ SITES: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("engine", ("device-lost",)),
     ("engine.device", ("drop", "delay", "device-lost")),
     ("engine.shard", ("drop", "delay", "error", "device-lost")),
+    ("engine.host", ("drop", "delay", "error", "device-lost")),
     ("sched.submit", ("drop", "delay", "error")),
     ("secret.device", ("drop", "delay", "error", "device-lost")),
     ("fleet.endpoint", ("drop", "timeout", "delay", "error")),

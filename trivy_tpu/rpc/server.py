@@ -386,6 +386,15 @@ class ScanService:
         health = health_fn() if callable(health_fn) else None
         if health:
             mesh_note = f"; mesh {health['shape']}"
+            if health.get("degraded_hosts"):
+                # distributed MeshDB: a lost peer host serves its whole
+                # advisory slice from the coordinator's bit-identical
+                # host mask — ready, but the fleet should know
+                mesh_note += (
+                    " host(s) "
+                    + ",".join(str(h)
+                               for h in health["degraded_hosts"])
+                    + " degraded to host-mask")
             if health["degraded"]:
                 mesh_note += (
                     " shard(s) "
@@ -434,6 +443,13 @@ class ScanService:
         if health:
             doc["mesh"] = {"shape": health["shape"],
                            "degraded": list(health["degraded"])}
+            if "hosts" in health:
+                # the distributed MeshDB's host topology: what the
+                # fleet prober's SkewDetector watches for
+                # host-degradation transitions (docs/fleet.md)
+                doc["mesh"]["hosts"] = health["hosts"]
+                doc["mesh"]["degraded_hosts"] = list(
+                    health.get("degraded_hosts") or ())
         from trivy_tpu.secret.scanner import hybrid_probe_state
 
         probe = hybrid_probe_state()
@@ -714,6 +730,7 @@ class ScanService:
 
             old_digest = self._db_digest
             new_digest = compile_cache.db_digest(self.db_path)
+        old_engine = self.engine
         self.lock.acquire_write()  # quiesce in-flight scans
         try:
             self.engine = new_engine
@@ -725,6 +742,17 @@ class ScanService:
             self._db_digest = new_digest
         finally:
             self.lock.release_write()
+        # the write lock quiesced every scan on the old engine: release
+        # its serving resources (the distributed MeshDB's workers /
+        # DCN connections; single-chip engines no-op) — the hot swap
+        # must not leak a worker fleet per reload
+        close = getattr(old_engine, "close", None)
+        if callable(close) and old_engine is not new_engine:
+            try:
+                close()
+            except Exception as exc:
+                _log.warn("old engine close failed after hot swap",
+                          err=str(exc))
         self.metrics.db_reloads.inc()
         self.metrics.db_reload_seconds.observe(
             time.perf_counter() - reload_start)
@@ -1154,6 +1182,15 @@ class Server:
             self.service.scheduler.close()
         if self.service.monitor is not None:
             self.service.monitor.close()
+        close = getattr(self.service.engine, "close", None)
+        if callable(close):
+            # distributed-MeshDB engines own worker subprocesses /
+            # DCN connections; everything else no-ops
+            try:
+                close()
+            except Exception as exc:
+                _log.warn("engine close failed on shutdown",
+                          err=str(exc))
         self.httpd.shutdown()
         self.httpd.server_close()
 
