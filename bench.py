@@ -888,6 +888,226 @@ def bench_fleetobs() -> dict:
     return out
 
 
+def bench_selfdrive() -> dict:
+    """Self-driving rung (docs/fleet.md "Self-driving fleet"): a
+    synthetic diurnal-load day against an in-process replica fleet.
+    The controller breathes the fleet 1 -> 3 -> 1 replicas against the
+    offered load (cost floor both ways), a mid-run replica kill is
+    auto-drained and replaced, and every scan routed through the
+    controlled fleet must stay byte-identical to an uncontrolled
+    single-server oracle (selfdrive_diff_vs_oracle=0, exit-gated).
+    Every action lands in the durable ops-event journal; a dry-run
+    pass over the same pressure provably changes nothing but the
+    journal.  Written to BENCH_selfdrive.json."""
+    import shutil
+    import tempfile
+
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.fleet import controller as _ctrl
+    from trivy_tpu.fleet import slo as _slo
+    from trivy_tpu.fleet.endpoints import EndpointSet
+    from trivy_tpu.rpc import wire as _wire
+    from trivy_tpu.rpc.server import SCAN_PATH, Server
+    from trivy_tpu.tensorize.synth import synth_queries, synth_trivy_db
+    from trivy_tpu.types.scan import ScanOptions
+
+    db = synth_trivy_db(n_advisories=4_000)
+    engine = MatchEngine(db, use_device=False)
+    pool = [q for q in synth_queries(db, 10_000, seed=11)
+            if q.space == "npm::"]
+    cache = MemoryCache()
+    rng = random.Random(5)
+    artifacts = []
+    for i in range(6):
+        pkgs = []
+        for _ in range(120):
+            q = pool[rng.randrange(len(pool))]
+            pkgs.append({"id": f"{q.name}@{q.version}", "name": q.name,
+                         "version": q.version})
+        key = f"sha256:sd{i}"
+        cache.put_blob(key, {"schema_version": 2, "applications": [{
+            "type": "npm", "file_path": f"img{i}/package-lock.json",
+            "packages": pkgs}]})
+        artifacts.append((f"img{i}", key))
+
+    def scan_once(es, target, key) -> bytes:
+        return es.post(SCAN_PATH, _wire.scan_request(
+            target, "", [key], ScanOptions()))
+
+    # --- the uncontrolled oracle: one replica, no controller ---------
+    oracle_srv = Server(engine, cache, host="localhost", port=0)
+    oracle_srv.start()
+    oracle: dict = {}
+    try:
+        es_oracle = EndpointSet([oracle_srv.address],
+                                health_interval_s=0)
+        try:
+            for target, key in artifacts:
+                oracle[target] = scan_once(es_oracle, target, key)
+        finally:
+            es_oracle.close()
+    finally:
+        oracle_srv.shutdown()
+
+    def factory():
+        srv = Server(engine, cache, host="localhost", port=0)
+        srv.start()
+        return srv
+
+    tmp = tempfile.mkdtemp(prefix="trivy_tpu_bench_selfdrive_")
+    out: dict = {}
+    load_box = [1.0]
+    try:
+        _slo.install_journal(os.path.join(tmp, "ops.jsonl"))
+        first = factory()
+        es = EndpointSet([first.address], hedge_s=0,
+                         health_interval_s=0)
+        actuator = _ctrl.LocalFleetActuator(
+            factory, endpoint_set=es,
+            load_fn=lambda: load_box[0], drain_timeout_s=2.0)
+        actuator.adopt(first)
+        policy = _ctrl.ControllerPolicy(
+            min_replicas=1, max_replicas=3, scale_up_load=4.0,
+            scale_down_load=1.0, scale_down_holds=2, cooldown_s=0.0,
+            unhealthy_ticks=2, degraded_ticks=2, hedge_skew=1e9)
+        ctl = _ctrl.FleetController(
+            actuator, policy=policy,
+            journal_path=os.path.join(tmp, "actions.jsonl"))
+
+        # a synthetic day: night / morning ramp / midday peak (with a
+        # replica killed under the controller's feet) / evening calm
+        day = ([("night", 1.0)] * 2 + [("ramp", 9.0)] * 3
+               + [("peak", 9.0, "kill")] + [("peak", 9.0)] * 3
+               + [("calm", 0.5)] * 5)
+        trajectory = []
+        diffs = 0
+        scans = 0
+        killed = None
+        t0 = time.time()
+        try:
+            for phase in day:
+                if len(phase) == 3 and killed is None:
+                    # degrade a replica the controller spawned: shut
+                    # its HTTP front door so probes see ready=False
+                    victim = [u for u in actuator.urls
+                              if u != first.address]
+                    killed = victim[-1] if victim else first.address
+                    actuator._servers[killed].shutdown()
+                load_box[0] = phase[1]
+                report = ctl.tick()
+                trajectory.append({
+                    "phase": phase[0], "load": phase[1],
+                    "replicas": len(report["replicas"]),
+                    "actions": [a["action"]
+                                for a in report["actions"]],
+                })
+                for target, key in artifacts:
+                    if scan_once(es, target, key) != oracle[target]:
+                        diffs += 1
+                    scans += 1
+            # let the calm tail settle the fleet back to the floor
+            for _ in range(4):
+                report = ctl.tick()
+                trajectory.append({
+                    "phase": "calm", "load": load_box[0],
+                    "replicas": len(report["replicas"]),
+                    "actions": [a["action"]
+                                for a in report["actions"]],
+                })
+        finally:
+            ctl.close()
+        wall_s = time.time() - t0
+
+        counts: dict = {}
+        for t in trajectory:
+            for a in t["actions"]:
+                counts[a] = counts.get(a, 0) + 1
+        peak = max(t["replicas"] for t in trajectory)
+        floor = trajectory[-1]["replicas"]
+        replaced = killed is not None and killed not in actuator.urls
+
+        # every action must be in the durable ops-event journal
+        events = _slo.OpsEventLog.read(os.path.join(tmp, "ops.jsonl"))
+        journaled = [e for e in events
+                     if e.get("kind") == "controller_action"]
+        acted = sum(counts.values())
+
+        # --- dry-run: same pressure, nothing changes but the journal -
+        dry_pol = _ctrl.ControllerPolicy(
+            min_replicas=1, max_replicas=3, scale_up_load=4.0,
+            scale_down_load=1.0, scale_down_holds=2, cooldown_s=0.0,
+            unhealthy_ticks=2, degraded_ticks=2, hedge_skew=1e9)
+        dry_srv = factory()
+        dry_es = EndpointSet([dry_srv.address], hedge_s=0,
+                             health_interval_s=0)
+        dry_act = _ctrl.LocalFleetActuator(
+            factory, endpoint_set=dry_es,
+            load_fn=lambda: 9.0, drain_timeout_s=2.0)
+        dry_act.adopt(dry_srv)
+        dry = _ctrl.FleetController(
+            dry_act, policy=dry_pol, dry_run=True,
+            journal_path=os.path.join(tmp, "dry.jsonl"))
+        try:
+            for _ in range(3):
+                dry.tick()
+        finally:
+            dry.close()
+        dry_records = _ctrl.ActionJournal.open(
+            os.path.join(tmp, "dry.jsonl"))
+        try:
+            dry_recs = dry_records.records()
+        finally:
+            dry_records.close()
+        dry_fleet_unchanged = len(dry_act.urls) == 1
+        dry_journaled = sum(1 for r in dry_recs
+                            if r.get("phase") == "applied"
+                            and r.get("outcome") == "dry_run")
+        dry_act.close()
+        dry_es.close()
+
+        es.close()
+        actuator.close()
+        out = {
+            "scans": scans,
+            "wall_s": round(wall_s, 2),
+            "trajectory": trajectory,
+            "actions": counts,
+            "peak_replicas": peak,
+            "floor_replicas": floor,
+            "drain_replaced_killed": bool(replaced),
+            "actions_acted": acted,
+            "actions_journaled": len(journaled),
+            "selfdrive_diff_vs_oracle": diffs,
+            "dry_run": {
+                "fleet_unchanged": dry_fleet_unchanged,
+                "decisions_journaled": dry_journaled,
+            },
+        }
+        gates = []
+        if diffs:
+            gates.append(f"scan results diverged from the "
+                         f"uncontrolled oracle ({diffs})")
+        if peak < 3 or floor != 1:
+            gates.append(f"fleet did not breathe 1->3->1 "
+                         f"(peak={peak} floor={floor})")
+        if not replaced:
+            gates.append("killed replica was not drain-replaced")
+        if counts.get("drain_replace", 0) < 1:
+            gates.append("no drain_replace action recorded")
+        if len(journaled) < acted:
+            gates.append(f"ops journal is missing actions "
+                         f"({len(journaled)} < {acted})")
+        if not dry_fleet_unchanged or not dry_journaled:
+            gates.append("dry-run contract violated")
+        if gates:
+            out["error"] = "; ".join(gates)
+    finally:
+        _slo.uninstall_journal()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _bench_mesh_child() -> int:
     """Child half of bench_mesh: runs inside a subprocess whose env
     pins an 8-virtual-CPU-device backend (the multichip-dryrun dance),
@@ -2277,6 +2497,25 @@ def main():
         for f_ in fails:
             print(f"BENCH_STATUS=dcn_gate_failed {f_}", file=sys.stderr)
         return 1 if (fails or lint_rc) else 0
+    if "--selfdrive" in sys.argv:
+        # standalone self-driving-fleet rung (CPU-only, no device
+        # probe): the quick way to refresh BENCH_selfdrive.json.  Runs
+        # the invariant-lint gate like every supervised rung.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        lint_rc = _lint_gate()
+        detail = bench_selfdrive()
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_selfdrive.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(detail, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(detail, indent=2, sort_keys=True))
+        if detail.get("error"):
+            print(f"BENCH_STATUS=selfdrive_gate_failed "
+                  f"{detail['error']}", file=sys.stderr)
+        return 1 if (detail.get("error") or lint_rc) else 0
     if "--fleetobs" in sys.argv:
         # standalone federation rung (CPU-only, no device probe): the
         # quick way to refresh BENCH_fleetobs.json

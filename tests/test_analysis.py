@@ -326,6 +326,86 @@ class TestRuleFixtures:
         project.declared_event_kinds = [("good_kind", "d")]
         assert run_rule(project, "event-kind") == []
 
+    def test_action_kind_fires(self, tmp_path):
+        """Seeded violations of the controller-action extension:
+        emitted-not-declared, computed kind at the emit funnel,
+        declared-not-emitted, documented-in-neither-registry."""
+        project = make_project(
+            tmp_path,
+            {"fleet2/ctl.py": (
+                "from trivy_tpu.fleet.controller import (\n"
+                "    _Decision, emit_action)\n"
+                "from trivy_tpu.fleet.slo import emit_event\n"
+                "def f(kind):\n"
+                "    emit_event('good_event')\n"
+                "    emit_action('rogue_action')\n"
+                "    emit_action(kind)\n"
+                "    _Decision('site_action', {}, None)\n")},
+            docs={"docs/fleet.md": (
+                "| Kind | One action means |\n"
+                "|---|---|\n"
+                "| `good_event` | a healthy event row |\n"
+                "| `declared_action` | declared, emitted nowhere |\n"
+                "| `phantom_action` | documented, in neither registry |\n"
+                "| `rogue_action` | emitted + documented, undeclared |\n"
+                "| `site_action` | emitted via a _Decision site |\n")})
+        project.declared_event_kinds = [("good_event", "d")]
+        project.declared_action_kinds = [
+            ("declared_action", "d"), ("site_action", "d")]
+        found = run_rule(project, "event-kind")
+        msgs = "\n".join(f.message for f in found)
+        assert ("controller action kind 'rogue_action' emitted here "
+                "but not declared") in msgs
+        assert "emit_action() with a computed kind" in msgs
+        assert ("'declared_action' declared in ACTIONS but no code "
+                "emits it") in msgs
+        assert "'site_action'" not in msgs  # _Decision site anchors it
+        assert ("catalogs kind 'phantom_action' but neither "
+                "fleet.slo.EVENTS nor fleet.controller.ACTIONS "
+                "declares it") in msgs
+
+    def test_action_vocabularies_disjoint_and_required(self, tmp_path):
+        project = make_project(
+            tmp_path,
+            {"fleet2/ctl.py": (
+                "from trivy_tpu.fleet.controller import _Decision\n"
+                "from trivy_tpu.fleet.slo import emit_event\n"
+                "def f():\n"
+                "    emit_event('dup_kind')\n"
+                "    _Decision('dup_kind', {}, None)\n")},
+            docs={"docs/fleet.md": "| `dup_kind` | both registries |\n"})
+        project.declared_event_kinds = [("dup_kind", "d")]
+        project.declared_action_kinds = [("dup_kind", "d")]
+        msgs = "\n".join(
+            f.message for f in run_rule(project, "event-kind"))
+        assert ("'dup_kind' declared in BOTH fleet.slo.EVENTS and "
+                "fleet.controller.ACTIONS") in msgs
+        # an empty ACTIONS table (vs absent = None) is itself a finding
+        project.declared_action_kinds = []
+        msgs = "\n".join(
+            f.message for f in run_rule(project, "event-kind"))
+        assert "fleet.controller.ACTIONS is missing or empty" in msgs
+
+    def test_action_kind_clean_mini_tree(self, tmp_path):
+        project = make_project(
+            tmp_path,
+            {"fleet2/ctl.py": (
+                "from trivy_tpu.fleet.controller import (\n"
+                "    _Decision, emit_action)\n"
+                "from trivy_tpu.fleet.slo import emit_event\n"
+                "def f():\n"
+                "    emit_event('good_kind', endpoint='x')\n"
+                "    emit_action('good_action', outcome='applied')\n"
+                "    _Decision('other_action', {}, None)\n")},
+            docs={"docs/fleet.md": (
+                "| `good_kind` | the event |\n"
+                "| `good_action` | the funnel-emitted action |\n"
+                "| `other_action` | the site-emitted action |\n")})
+        project.declared_event_kinds = [("good_kind", "d")]
+        project.declared_action_kinds = [
+            ("good_action", "d"), ("other_action", "d")]
+        assert run_rule(project, "event-kind") == []
+
     def test_bare_except_fires(self, tmp_path):
         project = make_project(tmp_path, {
             "x/handlers.py": (
@@ -450,7 +530,8 @@ class TestKnobs:
                 "TRIVY_TPU_ANALYSIS_PIPELINE", "TRIVY_TPU_COMPILE_CACHE",
                 "TRIVY_TPU_SECRET_PROBE", "TRIVY_TPU_MONITOR",
                 "TRIVY_TPU_ATTRIB", "TRIVY_TPU_FLEET",
-                "TRIVY_TPU_FLEET_EVENTS"} == names
+                "TRIVY_TPU_FLEET_EVENTS",
+                "TRIVY_TPU_CONTROLLER"} == names
 
     def test_write_knobs_doc_roundtrip(self, tmp_path, capsys):
         (tmp_path / "trivy_tpu").mkdir()

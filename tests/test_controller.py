@@ -1,0 +1,629 @@
+"""Self-driving fleet controller (trivy_tpu/fleet/controller.py,
+docs/fleet.md "Self-driving fleet"):
+
+- policy: eager scale-up under load, hysteretic scale-down (holds
+  window + cost floor), per-action cooldowns, env-knob defaults
+- drain-and-replace on an unhealthy probe *streak* (one flaky probe
+  never costs a replica), mesh re-resolve on sustained degradation,
+  hedge-budget tuning from measured p99/p50 probe skew
+- the intent -> act -> applied action journal: replay reconciles a
+  crash-pending intent against the live fleet (never acts twice),
+  re-fires at most once under the same id, compaction keeps pending
+  intents
+- --dry-run journals decisions and emits events but never touches an
+  actuator
+- fleet.controller fault site: drop/delay/error/kill all degrade the
+  loop to "observe only, never act twice"
+- crash safety across a REAL process boundary: subprocess SIGKILLed
+  mid-action; restart + journal replay converges the fleet to the
+  same state as an uninterrupted run with no duplicate action
+- `trivy-tpu fleet control --ticks N --dry-run` CLI smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from trivy_tpu.fleet import controller as ctrl
+from trivy_tpu.fleet import slo
+from trivy_tpu.resilience import faults
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.reset()
+    slo.reset_bus()
+    yield
+    faults.reset()
+    slo.reset_bus()
+
+
+class FakeActuator:
+    """A scripted fleet: membership, health, mesh and probe latency
+    are plain dicts the test mutates; every act is recorded."""
+
+    def __init__(self, urls=("http://r0",), load=0.0):
+        self._urls = list(urls)
+        self.load = load
+        self.ready = {u: True for u in urls}
+        self.mesh: dict = {}
+        self.probe = {u: 0.01 for u in urls}
+        self.hedge = None
+        self.calls: list = []
+        self._n = 0
+
+    @property
+    def urls(self):
+        return list(self._urls)
+
+    def observe(self):
+        statuses = [{"endpoint": u,
+                     "ready": bool(self.ready.get(u)),
+                     "generation": "g1",
+                     "mesh": self.mesh.get(u),
+                     "probe_s": self.probe.get(u, 0.01)}
+                    for u in self._urls]
+        return {"statuses": statuses,
+                "offered_load": float(self.load),
+                "replicas": list(self._urls)}
+
+    def spawn_replica(self):
+        self._n += 1
+        u = f"http://new{self._n}"
+        self._urls.append(u)
+        self.ready[u] = True
+        self.probe[u] = 0.01
+        self.calls.append(("spawn", u))
+        return u
+
+    def drain_replica(self, url):
+        self.calls.append(("drain", url))
+        return True
+
+    def retire_replica(self, url):
+        self.calls.append(("retire", url))
+        self._urls = [u for u in self._urls if u != url]
+
+    def reresolve_mesh(self, url):
+        self.calls.append(("reresolve", url))
+        self.mesh[url] = {"degraded_hosts": []}
+        return {"reresolved": True}
+
+    def set_hedge_budget(self, budget):
+        self.hedge = budget
+        self.calls.append(("hedge", budget))
+        return True
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def mk_controller(act, tmp_path=None, clock=None, dry_run=False,
+                  **pol):
+    defaults = dict(min_replicas=1, max_replicas=3, scale_up_load=4.0,
+                    scale_down_load=1.0, scale_down_holds=2,
+                    cooldown_s=0.0, unhealthy_ticks=2,
+                    degraded_ticks=2, hedge_skew=1e9)
+    defaults.update(pol)
+    policy = ctrl.ControllerPolicy(**defaults)
+    journal = str(tmp_path / "actions.jsonl") if tmp_path else None
+    return ctrl.FleetController(act, policy=policy,
+                                journal_path=journal,
+                                dry_run=dry_run,
+                                clock=clock or FakeClock())
+
+
+def acted(act, kind):
+    return [c for c in act.calls if c[0] == kind]
+
+
+# ============================================================= policy
+
+
+class TestPolicy:
+    def test_env_defaults_clamps_and_malformed(self, monkeypatch):
+        monkeypatch.setenv("TRIVY_TPU_CONTROLLER_MIN_REPLICAS", "0")
+        monkeypatch.setenv("TRIVY_TPU_CONTROLLER_MAX_REPLICAS",
+                           "not-a-number")
+        monkeypatch.setenv("TRIVY_TPU_CONTROLLER_HOLDS", "5")
+        p = ctrl.ControllerPolicy()
+        assert p.min_replicas == 1        # clamped to >= 1
+        assert p.max_replicas == 4        # malformed -> default
+        assert p.scale_down_holds == 5    # env wins
+        p2 = ctrl.ControllerPolicy(min_replicas=3, max_replicas=2)
+        assert p2.max_replicas >= p2.min_replicas
+
+    def test_scale_up_is_eager(self):
+        act = FakeActuator(load=9.0)
+        c = mk_controller(act)
+        report = c.tick()
+        assert [a["action"] for a in report["actions"]] == ["scale_up"]
+        assert len(act.urls) == 2
+        # next tick: 9/2 = 4.5 > 4 -> up again, to the ceiling
+        c.tick()
+        assert len(act.urls) == 3
+        c.tick()                           # at max: no further growth
+        assert len(act.urls) == 3
+
+    def test_below_floor_scales_up_at_zero_load(self):
+        """A fleet below min_replicas (operator raised the floor, or
+        a replica died outside a drain) is restored regardless of
+        offered load — the floor is not just a scale-down stop."""
+        act = FakeActuator(load=0.0)       # one replica, idle
+        c = mk_controller(act, min_replicas=2)
+        report = c.tick()
+        assert [a["action"] for a in report["actions"]] == ["scale_up"]
+        assert report["actions"][0]["reason"] == "below_min_replicas"
+        assert len(act.urls) == 2
+        c.tick()                           # at the floor: steady
+        assert len(act.urls) == 2
+
+    def test_scale_down_hysteresis_and_cost_floor(self):
+        act = FakeActuator(urls=("http://r0", "http://r1",
+                                 "http://r2"), load=0.5)
+        c = mk_controller(act)
+        r1 = c.tick()                      # calm tick 1: hold
+        assert r1["actions"] == []
+        r2 = c.tick()                      # calm tick 2: holds met
+        assert [a["action"] for a in r2["actions"]] == ["scale_down"]
+        assert len(act.urls) == 2
+        c.tick()
+        c.tick()
+        assert len(act.urls) == 1
+        for _ in range(4):                 # never below the floor
+            c.tick()
+        assert len(act.urls) == 1
+
+    def test_load_spike_resets_calm_streak(self):
+        act = FakeActuator(urls=("http://r0", "http://r1"), load=0.5)
+        c = mk_controller(act, scale_down_holds=2)
+        c.tick()                           # calm 1
+        act.load = 9.0
+        c.tick()                           # spike: streak resets,
+        act.load = 0.5                     # (n=2 < ceiling -> grew)
+        n = len(act.urls)
+        c.tick()                           # calm 1 again
+        assert len(act.urls) == n          # no scale_down yet
+
+    def test_cooldown_blocks_consecutive_scale_ups(self):
+        clock = FakeClock()
+        act = FakeActuator(load=20.0)
+        c = mk_controller(act, clock=clock, cooldown_s=60.0)
+        c.tick()
+        assert len(act.urls) == 2
+        c.tick()                           # still cooling: no action
+        assert len(act.urls) == 2
+        clock.now += 61.0
+        c.tick()
+        assert len(act.urls) == 3
+
+    def test_drain_replace_needs_a_streak(self):
+        act = FakeActuator(urls=("http://r0", "http://r1"), load=2.0)
+        c = mk_controller(act, unhealthy_ticks=2)
+        act.ready["http://r1"] = False
+        c.tick()                           # one flaky probe: patient
+        assert acted(act, "retire") == []
+        act.ready["http://r1"] = True      # recovered: streak resets
+        c.tick()
+        act.ready["http://r1"] = False
+        c.tick()
+        assert acted(act, "retire") == []
+        report = c.tick()                  # streak of 2: replace
+        assert [a["action"] for a in report["actions"]] \
+            == ["drain_replace"]
+        assert acted(act, "drain") == [("drain", "http://r1")]
+        assert acted(act, "retire") == [("retire", "http://r1")]
+        assert len(acted(act, "spawn")) == 1
+        assert len(act.urls) == 2
+
+    def test_drain_replace_suppresses_autoscale_same_tick(self):
+        act = FakeActuator(urls=("http://r0", "http://r1"), load=50.0)
+        c = mk_controller(act, unhealthy_ticks=1, max_replicas=5)
+        act.ready["http://r1"] = False
+        report = c.tick()
+        kinds = [a["action"] for a in report["actions"]]
+        assert kinds == ["drain_replace"]  # one membership change/tick
+
+    def test_mesh_reresolve_on_sustained_degradation(self):
+        act = FakeActuator(urls=("http://r0",), load=2.0)
+        c = mk_controller(act, degraded_ticks=2)
+        act.mesh["http://r0"] = {"degraded_hosts": [2]}
+        c.tick()                           # sustained, not single-tick
+        assert acted(act, "reresolve") == []
+        report = c.tick()
+        assert [a["action"] for a in report["actions"]] \
+            == ["mesh_reresolve"]
+        assert acted(act, "reresolve") == [("reresolve", "http://r0")]
+        c.tick()                           # actuator cleared the mask
+        assert len(acted(act, "reresolve")) == 1
+
+    def test_hedge_tune_follows_skew_and_returns_to_baseline(self):
+        act = FakeActuator(urls=("http://r0", "http://r1",
+                                 "http://r2", "http://r3"), load=8.0)
+        c = mk_controller(act, hedge_skew=4.0)  # load in the neutral band
+        act.probe["http://r3"] = 0.5       # 50x the p50: skewed
+        report = c.tick()
+        assert [a["action"] for a in report["actions"]] \
+            == ["hedge_tune"]
+        assert act.hedge == c.policy.hedge_budget_hi
+        act.probe["http://r3"] = 0.01      # uniform again
+        report = c.tick()
+        assert [a["action"] for a in report["actions"]] \
+            == ["hedge_tune"]
+        assert act.hedge == c._hedge_baseline
+
+    def test_kill_switch_observes_and_decides_nothing(self, monkeypatch):
+        monkeypatch.setenv("TRIVY_TPU_CONTROLLER", "0")
+        act = FakeActuator(load=50.0)
+        c = mk_controller(act)
+        report = c.tick()
+        assert report["enabled"] is False
+        assert act.calls == []
+
+    def test_every_action_emits_a_controller_action_event(self):
+        act = FakeActuator(load=9.0)
+        c = mk_controller(act)
+        c.tick()
+        _, ring = slo.events_since(0)
+        events = [e for e in ring if e["kind"] == "controller_action"]
+        assert [e["action"] for e in events] == ["scale_up"]
+        assert events[0]["outcome"] == "applied"
+
+
+# ====================================================== action journal
+
+
+class TestActionJournal:
+    def test_intent_applied_pending_roundtrip(self, tmp_path):
+        path = str(tmp_path / "a.jsonl")
+        j = ctrl.ActionJournal.open(path)
+        a1 = j.intent("scale_up", want=2)
+        a2 = j.intent("scale_down", want=1, target="http://r1")
+        j.applied(a1, "applied", spawned="http://new1")
+        assert [r["id"] for r in j.pending()] == [a2]
+        j.close()
+        j2 = ctrl.ActionJournal.open(path)   # replay restores ids
+        assert [r["id"] for r in j2.pending()] == [a2]
+        a3 = j2.intent("scale_up", want=3)
+        assert a3 > a2
+        j2.close()
+
+    def test_open_rejects_a_foreign_log(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        slo.install_journal(path)            # a fleet-events journal
+        slo.uninstall_journal()
+        from trivy_tpu.durability.appendlog import AppendLogError
+        with pytest.raises(AppendLogError):
+            ctrl.ActionJournal.open(path)
+
+    def test_compact_keeps_pending_intents(self, tmp_path):
+        path = str(tmp_path / "a.jsonl")
+        j = ctrl.ActionJournal.open(path)
+        stale = j.intent("scale_up", want=2)
+        for i in range(20):
+            aid = j.intent("hedge_tune", budget=0.1)
+            j.applied(aid, "applied")
+        j.compact(keep_last=4)
+        assert [r["id"] for r in j.pending()] == [stale]
+        j.close()
+        j2 = ctrl.ActionJournal.open(path)
+        assert [r["id"] for r in j2.pending()] == [stale]
+        j2.close()
+
+
+class TestReplay:
+    def test_reconcile_seals_an_already_holding_intent(self, tmp_path):
+        """Crash AFTER the act but BEFORE the applied record: restart
+        finds the spawn already landed and seals the intent without
+        re-acting."""
+        path = tmp_path / "actions.jsonl"
+        j = ctrl.ActionJournal.open(str(path))
+        j.intent("scale_up", want=2)
+        j.close()
+        act = FakeActuator(urls=("http://r0", "http://r1"), load=2.0)
+        c = mk_controller(act, tmp_path=tmp_path)
+        report = c.tick()
+        assert [r["outcome"] for r in report["reconciled"]] \
+            == ["reconciled"]
+        assert acted(act, "spawn") == []     # never acts twice
+        assert c.journal.pending() == []
+        c.close()
+
+    def test_reconcile_refires_once_under_the_same_id(self, tmp_path):
+        """Crash BETWEEN intent and act: the post-condition does not
+        hold, so the intent re-fires exactly once, same id."""
+        path = tmp_path / "actions.jsonl"
+        j = ctrl.ActionJournal.open(str(path))
+        aid = j.intent("scale_up", want=2)
+        j.close()
+        act = FakeActuator(load=0.5)         # calm: no NEW decision
+        c = mk_controller(act, tmp_path=tmp_path)
+        c.tick()
+        assert len(acted(act, "spawn")) == 1
+        recs = c.journal.records()
+        intents = [r for r in recs if r.get("phase") == "intent"]
+        assert len(intents) == 1 and intents[0]["id"] == aid
+        assert c.journal.pending() == []
+        c.close()
+
+    def test_stale_intent_is_sealed_not_refired(self, tmp_path):
+        path = tmp_path / "actions.jsonl"
+        j = ctrl.ActionJournal.open(str(path))
+        j.intent("drain_replace")            # no target: unactionable
+        j.close()
+        act = FakeActuator(load=2.0)
+        c = mk_controller(act, tmp_path=tmp_path)
+        c.tick()
+        assert act.calls == []
+        assert c.journal.pending() == []
+        c.close()
+
+    def test_dry_run_changes_nothing_but_the_journal(self, tmp_path):
+        act = FakeActuator(load=9.0)
+        c = mk_controller(act, tmp_path=tmp_path, dry_run=True)
+        for _ in range(3):
+            report = c.tick()
+        assert act.calls == []               # provably untouched
+        assert len(act.urls) == 1
+        assert all(a["outcome"] == "dry_run"
+                   for a in report["actions"])
+        recs = c.journal.records()
+        assert any(r.get("outcome") == "dry_run" for r in recs)
+        assert c.journal.pending() == []     # rehearsals are sealed
+        _, ring = slo.events_since(0)
+        events = [e for e in ring if e["kind"] == "controller_action"]
+        assert events and all(e["outcome"] == "dry_run"
+                              for e in events)
+        c.close()
+
+
+# ========================================= fault site: observe only
+
+
+class TestControllerFaultSite:
+    """Satellite: every injected fleet.controller failure degrades the
+    loop to 'observe only, never act twice'."""
+
+    def test_site_registered(self):
+        sites = dict(faults.SITES)
+        assert sites["fleet.controller"] == ("drop", "delay", "error",
+                                             "kill")
+
+    def test_drop_skips_the_act_and_journals_it(self, tmp_path):
+        faults.install_spec("fleet.controller:drop")
+        act = FakeActuator(load=9.0)
+        c = mk_controller(act, tmp_path=tmp_path)
+        report = c.tick()
+        assert act.calls == []               # observe only
+        assert [a["outcome"] for a in report["actions"]] == ["dropped"]
+        assert c.journal.pending() == []     # dropped is sealed
+        c.close()
+
+    def test_delay_stalls_but_still_applies(self, tmp_path):
+        faults.install_spec("fleet.controller:delay=0.01")
+        act = FakeActuator(load=9.0)
+        c = mk_controller(act, tmp_path=tmp_path)
+        report = c.tick()
+        assert [a["outcome"] for a in report["actions"]] == ["applied"]
+        assert len(acted(act, "spawn")) == 1
+        c.close()
+
+    def test_error_aborts_then_reconciles_not_twice(self, tmp_path):
+        faults.install_spec("fleet.controller:error@1")
+        act = FakeActuator(load=9.0)
+        c = mk_controller(act, tmp_path=tmp_path)
+        report = c.tick()
+        assert [a["outcome"] for a in report["actions"]] == ["failed"]
+        assert act.calls == []               # aborted before the act
+        assert len(c.journal.pending()) == 1
+        faults.reset()
+        act.load = 2.0                       # neutral: no NEW decision
+        c._reconciled_start = False          # a fresh start would
+        c.tick()                             # replay the journal
+        assert len(acted(act, "spawn")) == 1  # re-fired exactly once
+        assert c.journal.pending() == []
+        c.close()
+
+    def test_kill_crashes_with_the_intent_durable(self, tmp_path):
+        faults.set_kill_mode("raise")
+        faults.install_spec("fleet.controller:kill@1")
+        act = FakeActuator(load=9.0)
+        c = mk_controller(act, tmp_path=tmp_path)
+        with pytest.raises(faults.InjectedKill):
+            c.tick()
+        assert act.calls == []               # died before acting
+        c.journal.close()
+        faults.reset()
+        act.load = 2.0                       # neutral: no NEW decision
+        # restart: replay re-fires the pending intent exactly once
+        c2 = mk_controller(act, tmp_path=tmp_path)
+        c2.tick()
+        assert len(acted(act, "spawn")) == 1
+        assert c2.journal.pending() == []
+        intents = [r for r in c2.journal.records()
+                   if r.get("phase") == "intent"
+                   and r.get("action") == "scale_up"]
+        assert len(intents) == 1             # never a duplicate intent
+        c2.close()
+
+
+# ==================================== crash safety (process boundary)
+
+
+CRASH_CHILD = r"""
+import json, os, sys
+from trivy_tpu.fleet import controller as ctrl
+
+state_path, journal_path, ticks = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+
+class FileActuator:
+    def __init__(self, path):
+        self.path = path
+        if not os.path.exists(path):
+            self._write({"replicas": ["r0"], "spawns": 0})
+
+    def _read(self):
+        with open(self.path, encoding="utf-8") as f:
+            return json.load(f)
+
+    def _write(self, doc):
+        with open(self.path, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+
+    @property
+    def urls(self):
+        return list(self._read()["replicas"])
+
+    def observe(self):
+        doc = self._read()
+        return {"statuses": [{"endpoint": u, "ready": True,
+                              "generation": "g", "mesh": None,
+                              "probe_s": 0.01}
+                             for u in doc["replicas"]],
+                "offered_load": 9.0,
+                "replicas": list(doc["replicas"])}
+
+    def spawn_replica(self):
+        doc = self._read()
+        doc["spawns"] += 1
+        url = "r%d" % doc["spawns"]
+        doc["replicas"].append(url)
+        self._write(doc)
+        return url
+
+    def drain_replica(self, url):
+        return True
+
+    def retire_replica(self, url):
+        doc = self._read()
+        doc["replicas"] = [u for u in doc["replicas"] if u != url]
+        self._write(doc)
+
+    def reresolve_mesh(self, url):
+        return {}
+
+    def set_hedge_budget(self, budget):
+        return False
+
+
+policy = ctrl.ControllerPolicy(
+    min_replicas=1, max_replicas=2, scale_up_load=4.0,
+    scale_down_load=1.0, scale_down_holds=2, cooldown_s=0.0,
+    unhealthy_ticks=2, degraded_ticks=2, hedge_skew=1e9)
+c = ctrl.FleetController(FileActuator(state_path), policy=policy,
+                         journal_path=journal_path)
+for _ in range(ticks):
+    c.tick()
+c.close()
+print("DONE")
+"""
+
+
+def _run_child(tmp_path, state, journal, ticks, fault=None):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    env.pop("TRIVY_TPU_FAULTS", None)
+    if fault:
+        env["TRIVY_TPU_FAULTS"] = fault
+    script = tmp_path / "child.py"
+    script.write_text(CRASH_CHILD)
+    return subprocess.run(
+        [sys.executable, str(script), str(state), str(journal),
+         str(ticks)],
+        env=env, capture_output=True, timeout=120)
+
+
+def test_sigkill_mid_action_replay_converges(tmp_path):
+    """Satellite: SIGKILL the controller subprocess at the action
+    boundary (intent durably on disk, act not yet performed); restart
+    replays the journal, applies no duplicate action, and converges
+    the fleet to the same state as an uninterrupted oracle run."""
+    # oracle: uninterrupted run over the same synthetic fleet
+    oracle_state = tmp_path / "oracle-state.json"
+    proc = _run_child(tmp_path, oracle_state,
+                      tmp_path / "oracle-actions.jsonl", ticks=2)
+    assert proc.returncode == 0, proc.stderr.decode()
+    oracle = json.loads(oracle_state.read_text())
+
+    # crashed run: the injected kill fires between intent and act
+    state = tmp_path / "state.json"
+    journal = tmp_path / "actions.jsonl"
+    proc = _run_child(tmp_path, state, journal, ticks=2,
+                      fault="fleet.controller:kill@1")
+    assert proc.returncode == -9, proc.stderr.decode()  # SIGKILLed
+    crashed = json.loads(state.read_text())
+    assert crashed["spawns"] == 0            # died before acting
+    pending = [r for r in ctrl.ActionJournal.open(str(journal)).records()
+               if r.get("phase") == "intent"]
+    assert len(pending) == 1                 # the intent survived
+
+    # restart without the fault: replay converges, no duplicates
+    proc = _run_child(tmp_path, state, journal, ticks=2)
+    assert proc.returncode == 0, proc.stderr.decode()
+    final = json.loads(state.read_text())
+    assert final == oracle                   # same replicas, 1 spawn
+    j = ctrl.ActionJournal.open(str(journal))
+    recs = j.records()
+    j.close()
+    intents = [r for r in recs if r.get("phase") == "intent"
+               and r.get("action") == "scale_up"]
+    assert len(intents) == 1                 # no duplicate action
+    assert not [r for r in recs if r.get("phase") == "intent"
+                and not any(a.get("id") == r["id"]
+                            and a.get("phase") == "applied"
+                            for a in recs)]
+
+
+# ================================================================ CLI
+
+
+def test_fleet_control_cli_dry_run_ticks(tmp_path, capsys):
+    """`trivy-tpu fleet control URL --dry-run --ticks 2` runs the
+    loop against a live replica and journals without acting."""
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.cli.main import main as cli_main
+    from trivy_tpu.db.model import Advisory
+    from trivy_tpu.db.store import AdvisoryDB, Metadata
+    from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.rpc.server import Server
+
+    db = AdvisoryDB()
+    db.put_advisory("npm::GitHub Security Advisory Npm", "pkg0",
+                    Advisory(vulnerability_id="CVE-2026-0001",
+                             fixed_version="2.0.0",
+                             vulnerable_versions=["<2.0.0"]))
+    db.meta = Metadata(updated_at="2026-01-01")
+    srv = Server(MatchEngine(db, use_device=False), MemoryCache(),
+                 host="localhost", port=0)
+    srv.start()
+    actions = str(tmp_path / "actions.jsonl")
+    journal = str(tmp_path / "ops.jsonl")
+    try:
+        rc = cli_main(["--quiet", "fleet", "control", srv.address,
+                       "--actions", actions, "--journal", journal,
+                       "--interval", "1ms", "--ticks", "2",
+                       "--dry-run"])
+        assert rc == 0
+    finally:
+        srv.shutdown()
+        slo.uninstall_journal()
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines) == 2 and all(r["enabled"] for r in lines)
+    # the action journal exists and holds nothing un-sealed
+    j = ctrl.ActionJournal.open(actions)
+    assert j.pending() == []
+    j.close()

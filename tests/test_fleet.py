@@ -778,3 +778,83 @@ def test_fleet_status_cli_shape(two_servers):
     assert all("endpoint" in s and "status" in s for s in status)
     dead = fleet_status(["http://127.0.0.1:1"])
     assert dead[0]["ready"] is False
+
+
+def test_probe_delay_decorrelated_jitter():
+    """Satellite: the health prober's next-delay is decorrelated
+    jitter — bounded by [interval/2, 2*interval], growth capped at 3x
+    the previous delay, and independently seeded per EndpointSet so a
+    fleet restarted in the same instant desynchronizes."""
+    es = EndpointSet(["http://127.0.0.1:1"], hedge_s=0,
+                     health_interval_s=0)  # no prober thread
+    try:
+        es._health_interval_s = 4.0
+        lo, cap = 2.0, 8.0
+        prev = 4.0
+        for _ in range(200):
+            prev = es._next_probe_delay(prev)
+            assert lo <= prev <= cap
+        # growth bound: from a tiny previous delay the next one can
+        # reach at most 3x (clamped below by interval/2)
+        for _ in range(200):
+            d = es._next_probe_delay(0.1)
+            assert lo <= d <= min(lo * 3.0, cap)
+    finally:
+        es.close()
+    # decorrelation: two sets built identically must not share an RNG
+    a = EndpointSet(["http://127.0.0.1:1"], hedge_s=0,
+                    health_interval_s=0)
+    b = EndpointSet(["http://127.0.0.1:1"], hedge_s=0,
+                    health_interval_s=0)
+    try:
+        a._health_interval_s = b._health_interval_s = 4.0
+        seq_a = [a._next_probe_delay(4.0) for _ in range(8)]
+        seq_b = [b._next_probe_delay(4.0) for _ in range(8)]
+        assert seq_a != seq_b
+    finally:
+        a.close()
+        b.close()
+
+
+def test_fleet_drain_endpoint(tmp_path):
+    """POST /fleet/drain (the controller's drain_replace actuator
+    path): flips the replica to draining, reports in-flight count,
+    and the replica then refuses new scans."""
+    srv = Server(MatchEngine(mk_db(), use_device=False), MemoryCache(),
+                 host="localhost", port=0, token="s3cret")
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            srv.address + "/fleet/drain",
+            data=json.dumps({"timeout_s": 5}).encode(),
+            headers={"Content-Type": "application/json",
+                     "Trivy-Token": "s3cret"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc == {"draining": True, "inflight": 0}
+        assert srv.service.draining
+        ok, why = srv.service.ready()
+        assert not ok and why == "draining"
+    finally:
+        srv.shutdown()
+
+
+def test_fleet_reresolve_endpoint_no_mesh(tmp_path):
+    """POST /fleet/reresolve on a single-chip engine (no serving
+    mesh) reports the no-op instead of erroring — the controller
+    treats it as 'nothing to re-resolve'."""
+    srv = Server(MatchEngine(mk_db(), use_device=False), MemoryCache(),
+                 host="localhost", port=0)
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            srv.address + "/fleet/reresolve", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc == {"reresolved": False, "mesh": None}
+        # the replica keeps serving after the no-op
+        ok, _why = srv.service.ready()
+        assert ok
+    finally:
+        srv.shutdown()

@@ -394,6 +394,50 @@ class TestOpsEventLog:
         assert ev["seq"] > past[-1]["seq"]
         slo.uninstall_journal()
 
+    def test_journal_tail_survives_compaction_rotation(self, tmp_path):
+        """Satellite: `fleet events --follow` (JournalTail) survives a
+        journal compaction — the atomic rewrite swaps the inode under
+        the tail, which resumes from the sealed replay point (its seq
+        cursor) with no duplicates and no misses."""
+        path = str(tmp_path / "events.jsonl")
+        assert slo.install_journal(path) == []
+        for i in range(6):
+            slo.emit_event("hedge", outcome="won", n=i)
+        tail = slo.JournalTail(path, since=0)
+        try:
+            first = tail.poll()
+            assert [e["n"] for e in first] == list(range(6))
+            ino_before = os.stat(path).st_ino
+            # compact underneath the tail: atomic rewrite, new inode,
+            # file shrinks below the tail's parse offset
+            slo.uninstall_journal()
+            log, _past = slo.OpsEventLog.open(path)
+            kept = log.compact(keep_last=2)
+            log.close()
+            assert [e["n"] for e in kept] == [4, 5]
+            assert os.stat(path).st_ino != ino_before
+            # already-delivered survivors are NOT re-delivered
+            assert tail.poll() == []
+            # a reinstalled bus resumes the sequence past the rewrite
+            past = slo.install_journal(path)
+            assert [e["n"] for e in past] == [4, 5]
+            slo.emit_event("hedge", outcome="lost", n=6)
+            slo.emit_event("hedge", outcome="lost", n=7)
+            after = tail.poll()
+            assert [e["n"] for e in after] == [6, 7]
+            seqs = [e["seq"] for e in first + after]
+            assert seqs == sorted(set(seqs))  # monotone, no dupes
+        finally:
+            tail.close()
+            slo.uninstall_journal()
+        # a fresh follower started after the rotation sees only the
+        # sealed journal: survivors plus the post-compaction appends
+        fresh = slo.JournalTail(path, since=0)
+        try:
+            assert [e["n"] for e in fresh.poll()] == [4, 5, 6, 7]
+        finally:
+            fresh.close()
+
     def test_burn_rate_fires_and_clears_journaled_across_restart(
             self, tmp_path, two_servers):
         """Acceptance: a burn-rate alert fires as a journaled event

@@ -143,6 +143,41 @@ KNOBS: tuple[Knob, ...] = (
          "Latency SLI threshold in milliseconds: a successful request "
          "slower than this counts against the SLO budget (unset = "
          "availability-only SLO)."),
+    # --- fleet controller (docs/fleet.md "Self-driving fleet")
+    Knob("TRIVY_TPU_CONTROLLER", "1", "fleet", True,
+         "Fleet controller kill switch: 0 makes every tick observe "
+         "and decide nothing — exactly the pre-controller fleet."),
+    Knob("TRIVY_TPU_CONTROLLER_MIN_REPLICAS", "1", "fleet", False,
+         "Autoscaler cost floor: the controller never drains the "
+         "fleet below this many replicas, however calm the load."),
+    Knob("TRIVY_TPU_CONTROLLER_MAX_REPLICAS", "4", "fleet", False,
+         "Autoscaler ceiling: the controller never spawns past this "
+         "many replicas, however hot the load."),
+    Knob("TRIVY_TPU_CONTROLLER_SCALE_UP_LOAD", "4", "fleet", False,
+         "Offered load per ready replica above which the controller "
+         "spawns one replica (subject to the ceiling and cooldown)."),
+    Knob("TRIVY_TPU_CONTROLLER_SCALE_DOWN_LOAD", "1", "fleet", False,
+         "Offered load per ready replica below which a tick counts "
+         "as calm toward the scale-down hysteresis window."),
+    Knob("TRIVY_TPU_CONTROLLER_HOLDS", "3", "fleet", False,
+         "Scale-down hysteresis: consecutive calm ticks required "
+         "before one replica is drained (any non-calm tick resets "
+         "the streak — one quiet minute never shrinks the fleet)."),
+    Knob("TRIVY_TPU_CONTROLLER_COOLDOWN_S", "30", "fleet", False,
+         "Per-action-kind cooldown seconds between controller "
+         "actions (damps oscillation: scale/drain/re-resolve each "
+         "rate-limited independently)."),
+    Knob("TRIVY_TPU_CONTROLLER_UNHEALTHY_TICKS", "3", "fleet", False,
+         "Consecutive failed-probe ticks before a replica is "
+         "drained, retired, and replaced (drain_replace)."),
+    Knob("TRIVY_TPU_CONTROLLER_DEGRADED_TICKS", "3", "fleet", False,
+         "Consecutive ticks a replica must report degraded mesh "
+         "hosts before the controller tells it to re-resolve its "
+         "topology over the survivors (mesh_reresolve)."),
+    Knob("TRIVY_TPU_CONTROLLER_HEDGE_SKEW", "4", "fleet", False,
+         "p99/p50 probe-latency skew at which the controller raises "
+         "the hedge budget; below half this, the budget returns to "
+         "the configured baseline (hedge_tune)."),
     # --- RPC
     Knob("TRIVY_TPU_RPC_GZIP_MIN", "8192", "rpc", False,
          "Minimum body size in bytes before the negotiated gzip wire "

@@ -72,6 +72,7 @@ class Project:
         self.declared_knobs = self._extract_knobs()
         self.declared_span_taxonomy = self._extract_span_taxonomy()
         self.declared_event_kinds = self._extract_event_kinds()
+        self.declared_action_kinds = self._extract_action_kinds()
 
     def _collect(self) -> None:
         pkg = os.path.join(self.root, "trivy_tpu")
@@ -218,6 +219,24 @@ class Project:
             return list(slo.EVENTS)
         except ImportError:
             return None
+
+    def _extract_action_kinds(self):
+        """Fleet-controller action registry from the LINTED tree's
+        fleet/controller.py ACTIONS table.  ``None`` means the tree
+        has no controller — the event-kind rule then skips its action
+        checks entirely (NO import fallback: a seeded mini-tree
+        without a controller must keep the pre-controller rule
+        behavior, and tests override the attribute to opt in)."""
+        value = self._registry_assign(
+            "trivy_tpu/fleet/controller.py", "ACTIONS")
+        if value is not None:
+            try:
+                return [(k, d) for k, d in ast.literal_eval(value)]
+            except (ValueError, TypeError):
+                pass
+        if self.file("trivy_tpu/fleet/controller.py") is not None:
+            return []  # present but unparsable: the rule flags it
+        return None
 
     @staticmethod
     def _real_fault_sites():
@@ -1015,20 +1034,29 @@ class EventKindRule(Rule):
         "the single source of truth.")
 
     EMIT_FNS = {"emit_event"}
+    # controller action kinds surface at two literal-first-arg sites:
+    # the emit funnel (emit_action) and the decision constructor
+    # (_Decision) — either one anchors "some code produces this kind"
+    ACTION_EMIT_FNS = {"emit_action"}
+    ACTION_SITE_FNS = {"emit_action", "_Decision"}
     SLO_PY = "trivy_tpu/fleet/slo.py"
+    CONTROLLER_PY = "trivy_tpu/fleet/controller.py"
     DOC = "docs/fleet.md"
-    # catalog rows: | `kind` | description |  (the event catalog is the
-    # only docs/fleet.md table whose first cell is a backticked
-    # lowercase identifier)
+    # catalog rows: | `kind` | description |  (the event + controller-
+    # action catalogs are the only docs/fleet.md tables whose first
+    # cell is a backticked lowercase identifier)
     DOC_ROW_RX = re.compile(r"^\|\s*`([a-z][a-z0-9_]*)`\s*\|", re.M)
 
-    def _emitted(self, project: Project):
+    def _calls(self, project: Project, fns: set):
+        """(literal-kind-or-None, (path, line)) per call of ``fns`` —
+        literal first arguments deduped to their first site, computed
+        ones yielded per site."""
         used: dict[str, tuple[str, int]] = {}
         for pf in project.files():
             consts = _module_consts(pf.tree)
             for node in ast.walk(pf.tree):
                 if not (isinstance(node, ast.Call)
-                        and _func_tail(node.func) in self.EMIT_FNS
+                        and _func_tail(node.func) in fns
                         and node.args):
                     continue
                 kind = _const_str(node.args[0])
@@ -1040,6 +1068,9 @@ class EventKindRule(Rule):
                     yield None, (pf.relpath, node.lineno)
         for kind, where in used.items():
             yield kind, where
+
+    def _emitted(self, project: Project):
+        yield from self._calls(project, self.EMIT_FNS)
 
     def check(self, project: Project):
         declared_pairs = project.declared_event_kinds
@@ -1073,6 +1104,59 @@ class EventKindRule(Rule):
                 self.id, self.SLO_PY, 1,
                 f"fleet event kind {kind!r} declared in EVENTS but "
                 "no code emits it")
+        # ---- controller actions (docs/fleet.md "Self-driving fleet")
+        action_pairs = getattr(project, "declared_action_kinds", None)
+        actions: set = set()
+        if action_pairs is not None:
+            if not action_pairs:
+                yield Finding(
+                    self.id, self.CONTROLLER_PY, 1,
+                    "fleet.controller.ACTIONS is missing or empty — "
+                    "the controller action vocabulary must be "
+                    "exported as structured data")
+            actions = {k for k, _ in action_pairs}
+            for kind in sorted(actions & declared):
+                yield Finding(
+                    self.id, self.CONTROLLER_PY, 1,
+                    f"kind {kind!r} declared in BOTH fleet.slo.EVENTS "
+                    "and fleet.controller.ACTIONS — the vocabularies "
+                    "must stay disjoint (actions ride inside "
+                    "controller_action events)")
+            action_sites: dict[str, tuple[str, int]] = {}
+            for kind, (path, line) in self._calls(
+                    project, self.ACTION_EMIT_FNS):
+                if kind is None:
+                    yield Finding(
+                        self.id, path, line,
+                        "emit_action() with a computed kind — action "
+                        "kinds must be literal so the registry/docs "
+                        "coherence is checkable (suppress with the "
+                        "contract if intentional)")
+                    continue
+                action_sites.setdefault(kind, (path, line))
+                if kind not in actions:
+                    yield Finding(
+                        self.id, path, line,
+                        f"controller action kind {kind!r} emitted "
+                        "here but not declared in "
+                        "fleet.controller.ACTIONS")
+            for kind, (path, line) in self._calls(
+                    project, self.ACTION_SITE_FNS - self.ACTION_EMIT_FNS):
+                if kind is None:
+                    continue  # reconstruction sites may be computed
+                action_sites.setdefault(kind, (path, line))
+                if kind not in actions:
+                    yield Finding(
+                        self.id, path, line,
+                        f"controller action kind {kind!r} emitted "
+                        "here but not declared in "
+                        "fleet.controller.ACTIONS")
+            for kind in sorted(actions - set(action_sites)):
+                yield Finding(
+                    self.id, self.CONTROLLER_PY, 1,
+                    f"controller action kind {kind!r} declared in "
+                    "ACTIONS but no code emits it")
+        # ---- the docs/fleet.md catalogs (events + actions)
         doc = project.doc_text(self.DOC)
         if doc is None:
             yield Finding(self.id, self.DOC, 1,
@@ -1086,11 +1170,24 @@ class EventKindRule(Rule):
                     self.id, self.DOC, 1,
                     f"declared fleet event kind {kind!r} absent from "
                     "the docs/fleet.md event catalog")
-        for kind in sorted(doc_kinds - declared):
-            yield Finding(
-                self.id, self.DOC, 1,
-                f"docs/fleet.md catalogs event kind {kind!r} but "
-                "fleet.slo.EVENTS does not declare it")
+        for kind in sorted(actions):
+            if kind not in doc_kinds:
+                yield Finding(
+                    self.id, self.DOC, 1,
+                    f"declared controller action kind {kind!r} absent "
+                    "from the docs/fleet.md action catalog")
+        for kind in sorted(doc_kinds - declared - actions):
+            if action_pairs is None:
+                yield Finding(
+                    self.id, self.DOC, 1,
+                    f"docs/fleet.md catalogs event kind {kind!r} but "
+                    "fleet.slo.EVENTS does not declare it")
+            else:
+                yield Finding(
+                    self.id, self.DOC, 1,
+                    f"docs/fleet.md catalogs kind {kind!r} but "
+                    "neither fleet.slo.EVENTS nor "
+                    "fleet.controller.ACTIONS declares it")
 
 
 # ----------------------------------------------------------- the driver

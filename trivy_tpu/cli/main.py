@@ -495,6 +495,47 @@ def build_parser() -> argparse.ArgumentParser:
                      help="durable ops event journal path")
     pfv.add_argument("--interval", default="5s",
                      help="monitor tick period (go-style duration)")
+    pfc = flsub.add_parser(
+        "control", help="run the self-driving fleet controller: an "
+        "SLO-driven remediation/autoscaling loop — scale against "
+        "offered load under a cost floor, drain-and-replace unhealthy "
+        "replicas, re-resolve degraded mesh topology, tune the hedge "
+        "budget; every decision journaled and replayed idempotently "
+        "(docs/fleet.md 'Self-driving fleet')", allow_abbrev=False)
+    _add_global_flags(pfc)
+    pfc.add_argument("endpoints", help="comma-separated replica URLs")
+    pfc.add_argument("--token", default=None, help="server auth token")
+    pfc.add_argument("--actions", default=None, metavar="PATH",
+                     help="controller action journal (intent/applied "
+                          "records; replayed idempotently across "
+                          "controller crashes). Default: observe-only "
+                          "decisions are still emitted but not "
+                          "durably journaled")
+    pfc.add_argument("--journal", default=None, metavar="PATH",
+                     help="durable fleet ops event journal every "
+                          "controller_action event appends to")
+    pfc.add_argument("--interval", default="5s",
+                     help="control-loop tick period (go-style "
+                          "duration)")
+    pfc.add_argument("--ticks", type=int, default=None, metavar="N",
+                     help="stop after N control passes (default: run "
+                          "until interrupted)")
+    pfc.add_argument("--dry-run", action="store_true",
+                     help="journal and emit every decision without "
+                          "acting on the fleet (the rehearsal "
+                          "contract: nothing changes but the journal)")
+    pfc.add_argument("--spawn-cmd", default=None, metavar="CMD",
+                     help="shell command that starts one replica and "
+                          "prints its URL on the last stdout line "
+                          "(how scale_up/drain_replace reach your "
+                          "process supervisor); without it the "
+                          "controller cannot add replicas")
+    pfc.add_argument("--min-replicas", type=int, default=None,
+                     help="autoscaler cost floor (default "
+                          "TRIVY_TPU_CONTROLLER_MIN_REPLICAS or 1)")
+    pfc.add_argument("--max-replicas", type=int, default=None,
+                     help="autoscaler ceiling (default "
+                          "TRIVY_TPU_CONTROLLER_MAX_REPLICAS or 4)")
 
     p = sub.add_parser(
         "profile", help="fetch a live server's bottleneck attribution "

@@ -1048,7 +1048,7 @@ def run_fleet_admin(args) -> int:
     cmd = getattr(args, "fleet_command", None)
     if cmd is None:
         raise FatalError("fleet: choose a subcommand (status, rollout, "
-                         "metrics, profile, events, serve)")
+                         "metrics, profile, events, serve, control)")
     token = getattr(args, "token", None)
     if cmd == "events":
         return _run_fleet_events(args)
@@ -1081,6 +1081,8 @@ def run_fleet_admin(args) -> int:
                                      flight=getattr(args, "flight", None))
     if cmd == "serve":
         return _run_fleet_serve(args, endpoints, token)
+    if cmd == "control":
+        return _run_fleet_control(args, endpoints, token)
     if cmd != "rollout":
         raise FatalError(f"fleet: unknown subcommand {cmd!r}")
     if getattr(args, "journal", None):
@@ -1112,40 +1114,90 @@ def run_fleet_admin(args) -> int:
 def _run_fleet_events(args) -> int:
     """`trivy-tpu fleet events --journal PATH [--follow]`: replay the
     durable ops event journal (torn tail tolerated) as JSON lines;
-    --follow keeps polling the file for appended records."""
+    --follow tails the file incrementally and survives compaction /
+    rotation (the tail reopens on inode change or truncation and
+    resumes from the sealed replay point — the seq cursor)."""
     import json as _json
     import time as _time
 
     from trivy_tpu.durability.appendlog import AppendLogError
-    from trivy_tpu.fleet.slo import OpsEventLog
+    from trivy_tpu.fleet.slo import JournalTail, OpsEventLog
 
+    follow = getattr(args, "follow", False)
+    since = getattr(args, "since", 0) or 0
+    if not follow:
+        # One-shot replay: the line-bounded journal reader already
+        # tolerates torn tails and corrupt records.
+        try:
+            events = OpsEventLog.read(args.journal)
+        except (AppendLogError, OSError) as e:
+            raise FatalError(f"fleet events: {e}")
+        out = sys.stdout
+        if getattr(args, "output", None):
+            # lint: allow[atomic-write] user-requested event stream (--output): append-only JSONL the user tails
+            out = open(args.output, "a", encoding="utf-8")
+        try:
+            for ev in events:
+                if int(ev.get("seq", 0)) > since:
+                    out.write(_json.dumps(ev, sort_keys=True) + "\n")
+            out.flush()
+            return 0
+        finally:
+            if out is not sys.stdout:
+                out.close()
     out = sys.stdout
     if getattr(args, "output", None):
         # lint: allow[atomic-write] user-requested event stream (--output): append-only JSONL the user tails
         out = open(args.output, "a", encoding="utf-8")
-    since = getattr(args, "since", 0) or 0
+    tail = JournalTail(args.journal, since=since)
     try:
         while True:
-            try:
-                events = OpsEventLog.read(args.journal)
-            except (AppendLogError, OSError) as e:
-                if getattr(args, "follow", False):
-                    _time.sleep(1.0)
-                    continue
-                raise FatalError(f"fleet events: {e}")
-            for ev in events:
-                if int(ev.get("seq", 0)) > since:
-                    since = max(since, int(ev.get("seq", 0)))
-                    out.write(_json.dumps(ev, sort_keys=True) + "\n")
+            for ev in tail.poll():
+                out.write(_json.dumps(ev, sort_keys=True) + "\n")
             out.flush()
-            if not getattr(args, "follow", False):
-                return 0
             _time.sleep(1.0)
     except KeyboardInterrupt:
         return 0
     finally:
+        tail.close()
         if out is not sys.stdout:
             out.close()
+
+
+def _run_fleet_control(args, endpoints: list, token: str | None) -> int:
+    """`trivy-tpu fleet control`: the blocking self-driving loop —
+    observe the fleet, decide against policy, journal, act
+    (docs/fleet.md "Self-driving fleet")."""
+    from trivy_tpu.fleet import controller as ctrl_mod
+    from trivy_tpu.fleet import slo as slo_mod
+
+    if getattr(args, "journal", None):
+        past = slo_mod.install_journal(args.journal)
+        _log.info("ops event journal installed", path=args.journal,
+                  replayed=len(past))
+    interval = _parse_duration(getattr(args, "interval", None) or "5s")
+    policy = ctrl_mod.ControllerPolicy(
+        min_replicas=getattr(args, "min_replicas", None),
+        max_replicas=getattr(args, "max_replicas", None))
+    actuator = ctrl_mod.HttpFleetActuator(
+        endpoints, token=token,
+        spawn_cmd=getattr(args, "spawn_cmd", None))
+    ctl = ctrl_mod.FleetController(
+        actuator, policy=policy,
+        journal_path=getattr(args, "actions", None),
+        dry_run=getattr(args, "dry_run", False))
+    try:
+        ctl.run(interval_s=interval,
+                max_ticks=getattr(args, "ticks", None),
+                on_tick=lambda report: print(
+                    ctrl_mod.render_report(report), flush=True))
+        return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        ctl.close()
+        if getattr(args, "journal", None):
+            slo_mod.uninstall_journal()
 
 
 def _run_fleet_serve(args, endpoints: list, token: str | None) -> int:

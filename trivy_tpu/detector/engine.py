@@ -269,6 +269,21 @@ class MatchEngine:
         coordinator's host mask). None on the single-chip path."""
         return self._mdb.health() if self._mdb is not None else None
 
+    def reresolve_mesh(self) -> bool:
+        """Re-resolve the serving mesh after sustained degradation
+        (the fleet controller's ``mesh_reresolve`` action): the local
+        mesh re-residents degraded shard slices on their devices; the
+        distributed MeshDB re-partitions over surviving hosts
+        (ops/dcn.py).  Callers must have quiesced in-flight scans
+        first (the server takes its write lock).  Returns True when
+        any topology/residency changed; single-chip engines and
+        healthy meshes no-op.  Failure leaves the degraded-but-
+        bit-exact fallback serving."""
+        mdb = self._mdb
+        if mdb is None or not hasattr(mdb, "reresolve"):
+            return False
+        return bool(mdb.reresolve())
+
     def close(self) -> None:
         """Release engine-owned serving resources.  Only the
         distributed MeshDB holds any (worker subprocesses, DCN

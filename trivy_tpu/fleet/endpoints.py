@@ -155,6 +155,9 @@ class EndpointSet:
         self._pool: futures.ThreadPoolExecutor | None = None
         self._prober: threading.Thread | None = None
         self._prober_stop = threading.Event()
+        # deliberately unseeded (unlike the retry RNG): probe jitter
+        # exists to DEcorrelate replicas, so every instance must differ
+        self._probe_rng = random.Random()
         self._skew = slo_mod.SkewDetector()
 
     # compatibility fall-through: single-connection callers keep
@@ -265,13 +268,39 @@ class EndpointSet:
             # an alive prober, not replace a stored-but-unstarted one
             t.start()
 
+    def _next_probe_delay(self, prev: float) -> float:
+        """Decorrelated jitter over the configured probe interval
+        (AWS's classic backoff shape, applied to a steady cadence):
+        the next delay is uniform in [interval/2, min(prev*3,
+        interval*2)], each replica's prober seeded independently.
+        Without it, a controller-driven fleet restart starts every
+        replica's prober in the same instant and each pass probes the
+        whole fleet simultaneously forever — a synchronized probe
+        storm every interval. Jitter decorrelates the passes within a
+        few cycles no matter how aligned they start."""
+        base = self._health_interval_s
+        lo = base / 2.0
+        hi = min(max(prev, lo) * 3.0, base * 2.0)
+        return lo + self._probe_rng.random() * max(hi - lo, 0.0)
+
     def _probe_loop(self) -> None:
         stop = self._prober_stop
-        while not stop.wait(self._health_interval_s):
+        delay = self._next_probe_delay(self._health_interval_s)
+        while not stop.wait(delay):
             try:
                 self.probe_health()
             except Exception as exc:
                 _log.warn("health probe pass failed", err=str(exc))
+            delay = self._next_probe_delay(delay)
+
+    def set_hedge_budget(self, budget: float) -> None:
+        """Retune the hedge budget at runtime (the fleet controller's
+        ``hedge_tune`` action). Clamped to [0, 1]; the spent-budget
+        accounting carries over so a raise takes effect immediately
+        and a cut throttles new hedges without cancelling in-flight
+        ones."""
+        with self._lock:
+            self._hedge_budget = min(max(float(budget), 0.0), 1.0)
 
     # ---------------------------------------------------------- routing
 

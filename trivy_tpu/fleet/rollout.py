@@ -141,6 +141,12 @@ def _post_json(url: str, token: str | None = None,
                 "error": raw.decode("utf-8", "replace")[:200]}
 
 
+#: Public alias: the fleet controller drives the same /fleet/* control
+#: surface (drain, reresolve) the rollout state machine does, through
+#: one transport helper.
+post_json = _post_json
+
+
 def _replay_probe(endpoint: str, probe: dict,
                   token: str | None) -> tuple[int, bytes]:
     """Replay one captured scan request, returning the raw response

@@ -37,6 +37,7 @@ gives them one spine:
 
 from __future__ import annotations
 
+import json
 import os
 
 from trivy_tpu.analysis.witness import make_lock
@@ -75,6 +76,10 @@ EVENTS: tuple[tuple[str, str], ...] = (
      "coordinated rollout (serving=<generation>)"),
     ("slo_burn", "a multi-window burn-rate alert changed state "
      "(state=firing/resolved) over the fleet SLIs"),
+    ("controller_action", "the fleet controller decided (and, unless "
+     "dry-run/dropped, performed) one action from the "
+     "fleet.controller.ACTIONS vocabulary (action=<kind>, "
+     "outcome=applied/dry_run/reconciled/dropped)"),
 )
 
 KINDS = frozenset(k for k, _ in EVENTS)
@@ -236,12 +241,117 @@ class OpsEventLog:
     def append(self, doc: dict) -> None:
         self._log.append(doc)
 
+    def compact(self, keep_last: int = 512) -> list[dict]:
+        """Rotate the journal in place: atomically rewrite it as
+        header + the newest ``keep_last`` events (a crash mid-compact
+        leaves the previous journal intact). Returns the kept events.
+        Followers detect the rewrite (new inode / shrunk size) and
+        resume from the sealed replay point — :class:`JournalTail`."""
+        past = self.read(self.path)
+        keep = past[-keep_last:] if keep_last >= 0 else past
+        self._log.rewrite(keep)
+        return keep
+
     def close(self) -> None:
         self._log.close()
 
     @property
     def path(self) -> str:
         return self._log.path
+
+
+class JournalTail:
+    """Incremental, rotation-proof follower for an ops journal — what
+    ``trivy-tpu fleet events --follow`` runs on.
+
+    Each :meth:`poll` parses only the bytes appended since the last
+    one (no O(file) re-replay per second) and returns the events whose
+    ``seq`` is beyond the last one delivered. When the journal is
+    compacted or rotated underneath the tail — the file shrinks below
+    the parse offset, or the path resolves to a new inode after an
+    atomic rewrite — the stale fd is dropped, the sealed journal is
+    replayed from its start, and delivery resumes from the sealed
+    replay point: the ``seq`` cursor, which survives rotation because
+    the bus sequence is monotone across compactions. A torn tail (a
+    partially-appended record) is left buffered until the writer
+    completes it, never delivered as garbage."""
+
+    def __init__(self, path: str, since: int = 0):
+        self._path = path
+        self._fd = None
+        self._ino = -1
+        self._offset = 0
+        self._buf = b""
+        self.last_seq = int(since)
+
+    def _drop_fd(self) -> None:
+        if self._fd is not None:
+            try:
+                self._fd.close()
+            except OSError:
+                pass
+        self._fd = None
+        self._ino = -1
+        self._offset = 0
+        self._buf = b""
+
+    def _ensure_fd(self) -> bool:
+        """(Re)open the journal when absent, rotated (new inode), or
+        truncated (compaction rewrote it shorter than our offset)."""
+        try:
+            st = os.stat(self._path)
+        except OSError:
+            self._drop_fd()
+            return False
+        if self._fd is not None and st.st_ino == self._ino \
+                and st.st_size >= self._offset:
+            return True
+        rotated = self._fd is not None
+        self._drop_fd()
+        try:
+            self._fd = open(self._path, "rb")
+            self._ino = os.fstat(self._fd.fileno()).st_ino
+        except OSError:
+            self._drop_fd()
+            return False
+        if rotated:
+            _log.debug("ops journal rotated; resuming from the "
+                       "sealed replay point", path=self._path,
+                       since=self.last_seq)
+        return True
+
+    def poll(self) -> list[dict]:
+        """New events (``seq`` beyond the last delivered), oldest
+        first. Empty when nothing new, the journal is missing, or only
+        a torn tail arrived."""
+        if not self._ensure_fd():
+            return []
+        self._fd.seek(self._offset)
+        chunk = self._fd.read()
+        self._offset += len(chunk)
+        self._buf += chunk
+        complete, nl, rest = self._buf.rpartition(b"\n")
+        if not nl:
+            return []  # torn tail only; wait for the writer
+        self._buf = rest
+        out = []
+        for line in complete.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue  # mid-file rot: replay-equivalent skip
+            if not isinstance(doc, dict) or doc.get("kind") == "header":
+                continue
+            seq = int(doc.get("seq", 0))
+            if seq > self.last_seq:
+                self.last_seq = max(self.last_seq, seq)
+                out.append(doc)
+        return out
+
+    def close(self) -> None:
+        self._drop_fd()
 
 
 # --------------------------------------------------------- SLO engine

@@ -563,6 +563,20 @@ def stitch_endpoints(endpoints: list, token: str | None = None,
     return stitch_flight(docs, trace_id=trace_id)
 
 
+def probe_quantiles(latencies: list) -> dict:
+    """p50/p99 over a list of probe latencies (seconds) — the skew
+    signal the fleet controller tunes the hedge budget from.  Returns
+    an empty dict when there are fewer than 3 samples (a quantile over
+    1-2 probes is noise, not signal)."""
+    lats = sorted(float(x) for x in latencies if x is not None)
+    if len(lats) < 3:
+        return {}
+    p50 = lats[len(lats) // 2]
+    p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+    return {"p50_s": p50, "p99_s": p99,
+            "skew": p99 / max(p50, 1e-9)}
+
+
 # -------------------------------------------------------- fleet monitor
 
 

@@ -106,6 +106,7 @@ SPAN_STRUCTURAL = {
     "watch.rescore",
     "delta.rematch",
     "fleet.rollout",
+    "fleet.control",
 }
 
 # dynamic span families (f-string names) -> lane, matched by prefix

@@ -503,6 +503,13 @@ MESH_SHARD_DEGRADATIONS = REGISTRY.counter(
     "exhausted or the shard's device was lost (zero finding diff; the "
     "healthy shards keep serving on-device)",
     labels=("shard",))
+MESH_RERESOLVES = REGISTRY.counter(
+    "trivy_tpu_mesh_reresolves_total",
+    "Explicit control-plane mesh recoveries (the fleet controller's "
+    "mesh_reresolve action): scope=shard re-residented degraded local "
+    "shard slices on their devices, scope=host re-partitioned the "
+    "distributed MeshDB over surviving DCN hosts",
+    labels=("scope",))
 DCN_HOST_DISPATCH_SECONDS = REGISTRY.histogram(
     "trivy_tpu_dcn_host_dispatch_seconds",
     "Per-remote-host dispatch+collect wall seconds of the distributed "
@@ -666,6 +673,23 @@ FLEET_ROLLOUT_STAGE_SECONDS = REGISTRY.histogram(
     "rescore, rollback) — the sum is the fleet's refresh window, vs "
     "the reference's full-fleet quiesce",
     labels=("stage",))
+CONTROLLER_TICKS = REGISTRY.counter(
+    "trivy_tpu_fleet_controller_ticks_total",
+    "Fleet-controller control passes (observe -> reconcile -> decide "
+    "-> act), including passes that decided nothing — liveness signal "
+    "for the self-driving loop (docs/fleet.md 'Self-driving fleet')")
+CONTROLLER_ACTIONS = REGISTRY.counter(
+    "trivy_tpu_fleet_controller_actions_total",
+    "Fleet-controller actions by kind (the fleet.controller.ACTIONS "
+    "vocabulary) and outcome (applied, dry_run, reconciled, dropped, "
+    "failed) — each also journaled and emitted as a "
+    "controller_action ops event",
+    labels=("kind", "outcome"))
+CONTROLLER_REPLICAS = REGISTRY.gauge(
+    "trivy_tpu_fleet_controller_replicas",
+    "Replica count the fleet controller observed on its latest pass — "
+    "the autoscaler's actual, to compare against the min/max policy "
+    "bounds")
 ATTRIB_LANE_SECONDS = REGISTRY.counter(
     "trivy_tpu_attrib_lane_seconds_total",
     "Resource-lane attribution seconds accumulated from completed "

@@ -789,6 +789,97 @@ class HostMeshDB:
                           host=host.idx, attempt=attempt, err=str(exc))
                 fut = None  # re-send on the next pass
 
+    # ----------------------------------------------------------- reresolve
+
+    def reresolve(self) -> bool:
+        """Re-resolve the cross-host topology over the SURVIVING hosts
+        (the fleet controller's ``mesh_reresolve`` action).  Host
+        degradation is deliberately one-way during serving — the
+        coordinator's host mask answers bit-identically but burns
+        coordinator CPU per batch — so recovery is this explicit
+        control-plane decision: re-partition the advisory table into
+        ``(1 + survivors) * db_local`` global shards, rebuild the
+        coordinator's local grid, and re-hello every surviving worker
+        into a fresh session (the old session keeps answering any
+        in-flight batch until it is evicted; callers quiesce via the
+        service write lock before committing).  Nothing mutates until
+        every survivor acknowledged its new slice, so a failed
+        re-resolve leaves the old topology serving — degradation never
+        gets worse by trying.  Returns True when the topology changed
+        (or, with no degraded hosts, when the local mesh restored a
+        degraded shard)."""
+        from trivy_tpu.obs import metrics as obs_metrics
+        from trivy_tpu.ops import match as m
+        from trivy_tpu.ops import mesh as mesh_ops
+
+        with self._lock:
+            dead_idx = set(self.degraded_hosts)
+        if not dead_idx:
+            # no host loss: shard-level recovery on the local slice
+            return self._local.reresolve()
+        survivors = [h for h in self.hosts if h.idx not in dead_idx]
+        dead = [h for h in self.hosts if h.idx in dead_idx]
+        n_hosts = 1 + len(survivors)
+        dp, db_local = self.n_data, self.db_local
+        n_db = n_hosts * db_local
+        timeout = dcn_timeout_s()
+        session = uuid.uuid4().hex
+        h1s, tables, shard_len, shard_base = m.host_shards(
+            self.cdb, n_db)
+        grid = _build_grid(dp, db_local, h1s[:db_local],
+                           tables[:db_local], shard_len, self.window,
+                           side="coordinator")
+        local = mesh_ops.MeshDB(
+            cdb=self.cdb, grid=grid, n_data=dp, n_db=db_local,
+            window=self.window, shard_len=shard_len,
+            shard_base=shard_base)
+        hello = {
+            "op": "hello", "session": session, "hosts": n_hosts,
+            "n_db": n_db, "db_local": db_local, "dp": dp,
+            "n_rows": int(self.cdb.n_rows),
+            "window": int(self.window), "window_req": None,
+            "shard_len": shard_len, "shard_base": shard_base,
+            "digest": None, "db_path": None, "db_meta": None,
+        }
+        # re-slicing changed every shard's row range, so slices are
+        # always pushed (the host-slice cache keys the OLD topology)
+        for new_idx, host in enumerate(survivors, start=1):
+            reply, _ = host.request(
+                dict(hello, host_index=new_idx)).result(timeout)
+            if reply.get("need_slice"):
+                lo = new_idx * db_local
+                reply, _ = host.request(
+                    {"op": "load", "session": session},
+                    arrays={"h1s": h1s[lo: lo + db_local],
+                            "tables": tables[lo: lo + db_local]},
+                ).result(timeout)
+            if not reply.get("ok"):
+                raise HostFault(
+                    f"host {host.idx} refused the re-resolved slice: "
+                    f"{reply.get('error', '?')}")
+        with self._lock:
+            for new_idx, host in enumerate(survivors, start=1):
+                host.idx = new_idx
+                host.info = dict(host.info, session=session,
+                                 source="push")
+            self.hosts = survivors
+            self.n_hosts = n_hosts
+            self.n_db = n_db
+            self._local = local
+            self.shard_len = shard_len
+            self.shard_base = shard_base
+            self._session = session
+            self.degraded_hosts = set()
+        for h in dead:
+            h.close()
+        obs_metrics.MESH_SHAPE.set(n_hosts, axis="hosts")
+        obs_metrics.MESH_SHAPE.set(n_db, axis="db")
+        obs_metrics.MESH_RERESOLVES.inc(scope="host")
+        _log.info("cross-host mesh re-resolved over surviving hosts",
+                  hosts=n_hosts, db=n_db, dropped=sorted(dead_idx),
+                  shard_rows=shard_len)
+        return True
+
     # -------------------------------------------------------------- health
 
     def health(self) -> dict:

@@ -479,6 +479,57 @@ class MeshDB:
             obs_metrics.MESH_SHARD_DISPATCH_SECONDS.observe(
                 time.perf_counter() - t0, shard=str(d))
 
+    # ----------------------------------------------------------- reresolve
+
+    def reresolve(self) -> bool:
+        """Clear sticky shard degradation by re-residenting every
+        degraded shard's advisory slice on its device (the fleet
+        controller's ``mesh_reresolve`` action — degradation is
+        deliberately one-way during serving so a flapping device
+        cannot oscillate bits on and off silicon; recovery is an
+        explicit control-plane decision).  Returns True when any
+        shard was restored; a healthy mesh is a no-op.  A slice that
+        fails to re-resident leaves its shard degraded — the host
+        oracle keeps the finding set byte-identical either way."""
+        import functools
+
+        import jax
+
+        from trivy_tpu.obs import metrics as obs_metrics
+        from trivy_tpu.ops import match as m
+
+        with self._lock:
+            degraded = sorted(self.degraded)
+        if not degraded:
+            return False
+        # the same deterministic device layout from_compiled committed
+        # to (crawl_mesh takes the first dp*db local devices in order)
+        devices = np.asarray(
+            jax.devices()[: self.n_data * self.n_db]).reshape(
+                self.n_data, self.n_db)
+        h1s, tables, shard_len, _base = m.host_shards(self.cdb, self.n_db)
+        restored = []
+        for d in degraded:
+            try:
+                for g in range(self.n_data):
+                    put = functools.partial(jax.device_put,
+                                            device=devices[g, d])
+                    self.grid[g][d] = m.DeviceDB(
+                        h1=put(h1s[d]), table=put(tables[d]),
+                        n_rows=shard_len, window=self.cdb.window)
+            except Exception as exc:
+                _log.warn("shard re-resolve failed; staying on the "
+                          "host oracle", shard=d, err=str(exc))
+                continue
+            restored.append(d)
+        if restored:
+            with self._lock:
+                self.degraded.difference_update(restored)
+            obs_metrics.MESH_RERESOLVES.inc(scope="shard")
+            _log.info("mesh shards re-resolved onto devices",
+                      shards=restored)
+        return bool(restored)
+
     # -------------------------------------------------------------- health
 
     def health(self) -> dict:

@@ -84,6 +84,7 @@ SITES: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("secret.device", ("drop", "delay", "error", "device-lost")),
     ("fleet.endpoint", ("drop", "timeout", "delay", "error")),
     ("fleet.rollout", ("delay", "error", "kill")),
+    ("fleet.controller", ("drop", "delay", "error", "kill")),
     ("analysis.fetch", ("drop", "delay", "error", "kill")),
     ("fleet.scan", ("kill",)),
     ("journal.append", ("kill", "torn-write", "bitflip")),

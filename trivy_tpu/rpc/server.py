@@ -474,6 +474,34 @@ class ScanService:
         self.monitor.on_promote(old_digest, db, new_digest)
         return {"rescored": True}
 
+    def reresolve_mesh(self) -> dict:
+        """Re-resolve the serving-mesh topology after sustained
+        degradation (the fleet controller's ``mesh_reresolve`` action
+        via POST /fleet/reresolve): quiesce in-flight scans under the
+        write lock, then let the engine re-resident degraded shards /
+        re-partition over surviving DCN hosts (MatchEngine.
+        reresolve_mesh).  Serialized against hot swaps by the reload
+        lock.  A failed re-resolve keeps the degraded-but-bit-exact
+        fallback serving and reports the error instead of raising."""
+        with self._reload_lock:
+            engine = self.engine
+            fn = getattr(engine, "reresolve_mesh", None)
+            if not callable(fn):
+                return {"reresolved": False,
+                        "reason": "engine has no serving mesh"}
+            self.lock.acquire_write()  # quiesce in-flight scans
+            try:
+                changed = bool(fn())
+            except Exception as exc:
+                _log.warn("mesh re-resolve failed; serving topology "
+                          "unchanged", err=str(exc))
+                return {"reresolved": False, "error": str(exc),
+                        "mesh": engine.shard_health()}
+            finally:
+                self.lock.release_write()
+            return {"reresolved": changed,
+                    "mesh": engine.shard_health()}
+
     def begin_scan(self) -> None:
         """Admission control: refused while draining (503 + Retry-After
         so a rolling restart's clients go elsewhere); otherwise counts
@@ -1028,6 +1056,14 @@ def _make_handler(service: ScanService, token: str | None,
             - ``rescore`` — trigger the parked delta re-score (the
               controller calls this per monitor-enabled replica, once
               the whole fleet serves the new generation).
+            - ``drain`` — stop admitting scans and wait for in-flight
+              ones (the fleet controller's drain-and-replace / scale-
+              down path; same semantics as the SIGTERM drain). Body:
+              {"timeout_s": float}; replies with how many scans were
+              still running at the deadline.
+            - ``reresolve`` — re-resolve the serving-mesh topology
+              over surviving shards/hosts after sustained degradation
+              (ScanService.reresolve_mesh).
             """
             if method == "reload":
                 doc = json.loads(body) if body else {}
@@ -1043,6 +1079,17 @@ def _make_handler(service: ScanService, token: str | None,
                             json.dumps(
                                 service.trigger_pending_rescore()
                             ).encode())
+            elif method == "drain":
+                doc = json.loads(body) if body else {}
+                timeout_s = float(doc.get("timeout_s", 30.0))
+                service.start_drain()
+                left = service.await_drained(timeout_s)
+                self._reply(200, json.dumps({
+                    "draining": True, "inflight": left,
+                }).encode())
+            elif method == "reresolve":
+                self._reply(200, json.dumps(
+                    service.reresolve_mesh()).encode())
             else:
                 self._error(404, f"unknown fleet method {method}")
 
