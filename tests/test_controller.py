@@ -71,7 +71,8 @@ class FakeActuator:
                      "probe_s": self.probe.get(u, 0.01)}
                     for u in self._urls]
         return {"statuses": statuses,
-                "offered_load": float(self.load),
+                "offered_load": (float(self.load)
+                                 if self.load is not None else None),
                 "replicas": list(self._urls)}
 
     def spawn_replica(self):
@@ -282,6 +283,119 @@ class TestPolicy:
         assert events[0]["outcome"] == "applied"
 
 
+# ======================================================== load signal
+
+
+class TestLoadSignal:
+    """Offered load is a REAL signal or nothing: summed /readyz
+    in-flight counts or an operator load command — never a proxy
+    derived from replica health, which reads 'load 0' on a healthy
+    fleet and would drain it to the floor one cooldown at a time."""
+
+    def test_http_observe_sums_replica_inflight(self, monkeypatch):
+        docs = {"http://r0": {"ready": True, "inflight": 2},
+                "http://r1": {"ready": True, "inflight": 3}}
+        monkeypatch.setattr(ctrl, "readyz_doc",
+                            lambda url, token=None: docs[url])
+        a = ctrl.HttpFleetActuator(["http://r0", "http://r1"])
+        obs = a.observe()
+        assert obs["offered_load"] == 5.0
+        assert all(s["ready"] for s in obs["statuses"])
+
+    def test_http_observe_without_signal_is_none_not_zero(
+            self, monkeypatch):
+        # replicas predating the inflight field: no signal, not "idle"
+        monkeypatch.setattr(ctrl, "readyz_doc",
+                            lambda url, token=None: {"ready": True})
+        a = ctrl.HttpFleetActuator(["http://r0", "http://r1"])
+        assert a.observe()["offered_load"] is None
+        # every probe unreachable: same — down is not idle
+        monkeypatch.setattr(ctrl, "readyz_doc",
+                            lambda url, token=None: None)
+        assert a.observe()["offered_load"] is None
+
+    def test_http_load_cmd_wins_and_fails_to_none(self, monkeypatch):
+        monkeypatch.setattr(
+            ctrl, "readyz_doc",
+            lambda url, token=None: {"ready": True, "inflight": 9})
+        a = ctrl.HttpFleetActuator(["http://r0"], load_cmd="echo 7.5")
+        assert a.observe()["offered_load"] == 7.5
+        bad = ctrl.HttpFleetActuator(["http://r0"], load_cmd="exit 3")
+        assert bad.observe()["offered_load"] is None
+
+    def test_no_load_signal_never_scales_down_a_healthy_fleet(self):
+        """The high-severity regression: a healthy live fleet with no
+        genuine load signal must HOLD its replica count."""
+        act = FakeActuator(urls=("http://r0", "http://r1",
+                                 "http://r2"), load=None)
+        c = mk_controller(act)
+        for _ in range(8):
+            report = c.tick()
+            assert report["actions"] == []
+        assert len(act.urls) == 3
+        assert act.calls == []
+
+    def test_no_load_signal_still_restores_the_floor(self):
+        act = FakeActuator(load=None)
+        c = mk_controller(act, min_replicas=2)
+        report = c.tick()
+        assert [a["action"] for a in report["actions"]] == ["scale_up"]
+        assert report["actions"][0]["reason"] == "below_min_replicas"
+        assert len(act.urls) == 2
+
+    def test_local_actuator_without_load_fn_reports_none(self):
+        a = ctrl.LocalFleetActuator(lambda: None)
+        assert a.observe()["offered_load"] is None
+
+
+# =========================================================== actuators
+
+
+class _FakeServer:
+    def __init__(self, url):
+        self.address = url
+
+    def drain(self, timeout_s):
+        pass
+
+    def shutdown(self):
+        pass
+
+
+class TestActuatorHardening:
+    def test_spawn_timeout_is_an_actuator_error(self, monkeypatch):
+        def boom(*a, **k):
+            raise subprocess.TimeoutExpired(cmd="spawn", timeout=300.0)
+        monkeypatch.setattr(ctrl.subprocess, "run", boom)
+        a = ctrl.HttpFleetActuator(["http://r0"], spawn_cmd="spawn")
+        with pytest.raises(ctrl.ActuatorError):
+            a.spawn_replica()
+
+    def test_spawn_oserror_is_an_actuator_error(self, monkeypatch):
+        def boom(*a, **k):
+            raise OSError("exec failed")
+        monkeypatch.setattr(ctrl.subprocess, "run", boom)
+        a = ctrl.HttpFleetActuator(["http://r0"], spawn_cmd="spawn")
+        with pytest.raises(ctrl.ActuatorError):
+            a.spawn_replica()
+
+    def test_retiring_the_last_replica_clears_the_endpoint_set(self):
+        from trivy_tpu.fleet.endpoints import EndpointSet
+
+        es = EndpointSet(["http://127.0.0.1:1"], hedge_s=0,
+                         health_interval_s=0)
+        try:
+            a = ctrl.LocalFleetActuator(
+                lambda: _FakeServer("http://127.0.0.1:2"),
+                endpoint_set=es)
+            a.adopt(_FakeServer("http://127.0.0.1:1"))
+            a.retire_replica("http://127.0.0.1:1")
+            # the set must not keep routing to the retired URL
+            assert es._live() == []
+        finally:
+            es.close()
+
+
 # ====================================================== action journal
 
 
@@ -421,6 +535,10 @@ class TestControllerFaultSite:
         c.close()
 
     def test_error_aborts_then_reconciles_not_twice(self, tmp_path):
+        """A mid-run failed action is resolved on the very NEXT tick
+        (reconcile runs every tick, not just after a restart): the
+        pending intent re-fires exactly once under its own id, and no
+        fresh duplicate intent is ever journaled on top of it."""
         faults.install_spec("fleet.controller:error@1")
         act = FakeActuator(load=9.0)
         c = mk_controller(act, tmp_path=tmp_path)
@@ -429,11 +547,32 @@ class TestControllerFaultSite:
         assert act.calls == []               # aborted before the act
         assert len(c.journal.pending()) == 1
         faults.reset()
-        act.load = 2.0                       # neutral: no NEW decision
-        c._reconciled_start = False          # a fresh start would
-        c.tick()                             # replay the journal
+        report = c.tick()                    # SAME controller, mid-run
         assert len(acted(act, "spawn")) == 1  # re-fired exactly once
         assert c.journal.pending() == []
+        # the reconcile suppressed fresh decisions, so the still-high
+        # load could not journal a duplicate scale_up intent
+        assert report["actions"] == []
+        intents = [r for r in c.journal.records()
+                   if r.get("phase") == "intent"]
+        assert len(intents) == 1
+        c.close()
+
+    def test_persistent_error_degrades_to_observe_only(self, tmp_path):
+        """With the fault permanently installed, every tick re-fires
+        the one pending intent, fails, and stays observe-only — the
+        journal never accumulates duplicate intents and the actuator
+        is never touched."""
+        faults.install_spec("fleet.controller:error")
+        act = FakeActuator(load=9.0)
+        c = mk_controller(act, tmp_path=tmp_path)
+        for _ in range(4):
+            c.tick()
+        assert act.calls == []
+        intents = [r for r in c.journal.records()
+                   if r.get("phase") == "intent"]
+        assert len(intents) == 1
+        assert len(c.journal.pending()) == 1
         c.close()
 
     def test_kill_crashes_with_the_intent_durable(self, tmp_path):

@@ -269,6 +269,7 @@ def test_readyz_text_golden_and_json(two_servers):
     assert doc["ready"] is True
     assert doc["status"] == svc.ready()[1]  # no drift between bodies
     assert doc["draining"] is False
+    assert doc["inflight"] == 0  # the controller's real load signal
     assert doc["generation"] is None  # no generation-managed DB root
     assert doc["monitor"] is False
 
@@ -782,18 +783,26 @@ def test_fleet_status_cli_shape(two_servers):
 
 def test_probe_delay_decorrelated_jitter():
     """Satellite: the health prober's next-delay is decorrelated
-    jitter — bounded by [interval/2, 2*interval], growth capped at 3x
+    jitter — bounded by [interval/2, 1.5*interval] so the MEAN cadence
+    stays the configured interval (jitter spreads probes, it must not
+    silently slow unhealthy-streak detection), growth capped at 3x
     the previous delay, and independently seeded per EndpointSet so a
     fleet restarted in the same instant desynchronizes."""
     es = EndpointSet(["http://127.0.0.1:1"], hedge_s=0,
                      health_interval_s=0)  # no prober thread
     try:
         es._health_interval_s = 4.0
-        lo, cap = 2.0, 8.0
+        lo, cap = 2.0, 6.0
         prev = 4.0
-        for _ in range(200):
+        draws = []
+        for _ in range(400):
             prev = es._next_probe_delay(prev)
+            draws.append(prev)
             assert lo <= prev <= cap
+        # centered on the configured interval: the effective cadence
+        # is the one that was asked for, not ~25% slower
+        mean = sum(draws) / len(draws)
+        assert abs(mean - 4.0) < 0.25
         # growth bound: from a tiny previous delay the next one can
         # reach at most 3x (clamped below by interval/2)
         for _ in range(200):

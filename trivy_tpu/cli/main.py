@@ -530,6 +530,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "(how scale_up/drain_replace reach your "
                           "process supervisor); without it the "
                           "controller cannot add replicas")
+    pfc.add_argument("--load-cmd", default=None, metavar="CMD",
+                     help="shell command printing the fleet's offered "
+                          "load (a number) on its last stdout line; "
+                          "default: sum of the in-flight scan counts "
+                          "replicas report on /readyz. With neither "
+                          "signal the controller never scales on "
+                          "load")
     pfc.add_argument("--min-replicas", type=int, default=None,
                      help="autoscaler cost floor (default "
                           "TRIVY_TPU_CONTROLLER_MIN_REPLICAS or 1)")

@@ -1181,7 +1181,8 @@ def _run_fleet_control(args, endpoints: list, token: str | None) -> int:
         max_replicas=getattr(args, "max_replicas", None))
     actuator = ctrl_mod.HttpFleetActuator(
         endpoints, token=token,
-        spawn_cmd=getattr(args, "spawn_cmd", None))
+        spawn_cmd=getattr(args, "spawn_cmd", None),
+        load_cmd=getattr(args, "load_cmd", None))
     ctl = ctrl_mod.FleetController(
         actuator, policy=policy,
         journal_path=getattr(args, "actions", None),

@@ -26,9 +26,10 @@ journaled policy:
 Every decision is an **action** from the closed :data:`ACTIONS`
 vocabulary.  An action is journaled twice in the controller's own
 append log (``durability/appendlog``): an ``intent`` record *before*
-acting and an ``applied`` record after.  Crash replay is idempotent:
-an intent without its ``applied`` record is *reconciled* against the
-live fleet first — if the intended state already holds, the action is
+acting and an ``applied`` record after.  Replay is idempotent: every
+tick, an intent without its ``applied`` record — a crash leftover or
+the previous tick's failed action — is *reconciled* against the live
+fleet first — if the intended state already holds, the action is
 marked ``reconciled`` and never re-fired; otherwise it is re-fired
 exactly once.  Each action is also emitted onto the fleet ops event
 bus as a ``controller_action`` event, so one journal replay tells the
@@ -329,7 +330,10 @@ class LocalFleetActuator:
         self._factory = factory
         self._servers: dict[str, object] = {}
         self._es = endpoint_set
-        self._load_fn = load_fn or (lambda: 0.0)
+        # no load_fn = no load signal (offered_load None): the
+        # controller then never scales on load, same contract as the
+        # HTTP actuator without a signal
+        self._load_fn = load_fn
         self._token = token
         self._drain_timeout_s = drain_timeout_s
 
@@ -346,7 +350,10 @@ class LocalFleetActuator:
         return url
 
     def _sync_endpoints(self) -> None:
-        if self._es is not None and self._servers:
+        # an empty server map still syncs: retiring the LAST replica
+        # must retire its endpoint too, not leave the set routing to
+        # a dead URL
+        if self._es is not None:
             self._es.set_endpoints(list(self._servers))
 
     # -- observation --------------------------------------------------
@@ -363,8 +370,9 @@ class LocalFleetActuator:
                 "mesh": doc.get("mesh") if doc else None,
                 "probe_s": probe_s,
             })
-        return {"statuses": statuses,
-                "offered_load": float(self._load_fn()),
+        load = (float(self._load_fn())
+                if self._load_fn is not None else None)
+        return {"statuses": statuses, "offered_load": load,
                 "replicas": list(self._servers)}
 
     # -- actions ------------------------------------------------------
@@ -426,26 +434,70 @@ class HttpFleetActuator:
     replica's URL (how the controller reaches whatever supervisor
     actually owns processes — systemd, k8s, a lab script).  Hedge
     tuning is advisory here: the budget lives in the scan *clients*,
-    so the emitted action carries the recommendation."""
+    so the emitted action carries the recommendation.
+
+    Offered load is a **real** signal or nothing: an operator-provided
+    ``load_cmd`` (stdout's last line is a number) wins; otherwise the
+    in-flight scan counts the replicas report in their ``/readyz``
+    JSON (``inflight``) are summed.  With neither available the
+    observation carries ``offered_load=None`` and the controller
+    refuses to scale on load — a proxy like "how many replicas look
+    down" is *not* load, and scaling down on it would drain a healthy
+    idle-looking fleet."""
 
     def __init__(self, urls: list[str], token: str | None = None,
                  spawn_cmd: str | None = None,
+                 load_cmd: str | None = None,
                  drain_timeout_s: float = 30.0):
         self._urls = [u.rstrip("/") for u in urls]
         self._token = token
         self._spawn_cmd = spawn_cmd
+        self._load_cmd = load_cmd
         self._drain_timeout_s = drain_timeout_s
 
     @property
     def urls(self) -> list[str]:
         return list(self._urls)
 
+    def _command_load(self) -> float | None:
+        """Run the operator's load command; its stdout's last
+        non-empty line must be a number.  Any failure means "no load
+        signal this tick" (None), never a fabricated zero."""
+        try:
+            proc = subprocess.run(
+                self._load_cmd, shell=True, capture_output=True,
+                text=True, timeout=60.0)
+        except (subprocess.TimeoutExpired, OSError) as exc:
+            _log.warn("load command failed; no load signal this tick",
+                      err=str(exc))
+            return None
+        if proc.returncode != 0:
+            _log.warn("load command failed; no load signal this tick",
+                      rc=proc.returncode,
+                      stderr=proc.stderr.strip()[:200])
+            return None
+        lines = [ln.strip() for ln in proc.stdout.splitlines()
+                 if ln.strip()]
+        try:
+            return float(lines[-1]) if lines else None
+        except ValueError:
+            _log.warn("load command printed no number on its last "
+                      "stdout line; no load signal this tick",
+                      line=lines[-1][:80])
+            return None
+
     def observe(self) -> dict:
         statuses = []
+        inflight: list[float] = []
         for url in self._urls:
             t0 = time.monotonic()
             doc = readyz_doc(url, token=self._token)
             probe_s = time.monotonic() - t0
+            if doc and doc.get("inflight") is not None:
+                try:
+                    inflight.append(float(doc["inflight"]))
+                except (TypeError, ValueError):
+                    pass
             statuses.append({
                 "endpoint": url,
                 "ready": bool(doc.get("ready")) if doc else False,
@@ -453,7 +505,12 @@ class HttpFleetActuator:
                 "mesh": doc.get("mesh") if doc else None,
                 "probe_s": probe_s,
             })
-        load = sum(1.0 for s in statuses if not s["ready"])
+        if self._load_cmd:
+            load = self._command_load()
+        elif inflight:
+            load = sum(inflight)
+        else:
+            load = None  # no genuine signal: never scale on a proxy
         return {"statuses": statuses, "offered_load": load,
                 "replicas": list(self._urls)}
 
@@ -462,9 +519,16 @@ class HttpFleetActuator:
             raise ActuatorError(
                 "no --spawn-cmd configured: the controller cannot "
                 "create replicas on this fleet")
-        proc = subprocess.run(
-            self._spawn_cmd, shell=True, capture_output=True,
-            text=True, timeout=300.0)
+        try:
+            proc = subprocess.run(
+                self._spawn_cmd, shell=True, capture_output=True,
+                text=True, timeout=300.0)
+        except (subprocess.TimeoutExpired, OSError) as exc:
+            # a hung or unlaunchable spawn command must degrade the
+            # loop to observe-only (tick catches ActuatorError), not
+            # kill it
+            raise ActuatorError(
+                f"spawn command did not complete: {exc}") from exc
         if proc.returncode != 0:
             raise ActuatorError(
                 f"spawn command failed (rc {proc.returncode}): "
@@ -546,7 +610,6 @@ class FleetController:
         self._degraded: dict[str, int] = {}
         self._hedge_budget = fleet_mod.hedge_budget()
         self._hedge_baseline = self._hedge_budget
-        self._reconciled_start = False
         self.ticks = 0
 
     # ----------------------------------------------------- fault site
@@ -612,13 +675,18 @@ class FleetController:
 
     # ----------------------------------------------------- reconcile
     def _reconcile(self, obs: dict) -> list[dict]:
-        """First tick after a (crashed) restart: every intent without
-        an applied record is checked against the live fleet.  Holds
-        already → ``reconciled`` (never re-fired); otherwise re-fired
-        exactly once under the same journaled id."""
-        if self.journal is None or self._reconciled_start:
+        """Every tick, before deciding: every intent without an
+        applied record — a crashed restart's leftovers *or* the
+        previous tick's failed action — is checked against the live
+        fleet.  Holds already → ``reconciled`` (never re-fired);
+        otherwise re-fired exactly once under the same journaled id.
+        Running this each tick (not just at start) means a mid-run
+        failed intent is resolved while the observation is still
+        fresh, instead of lingering unsealed until an arbitrarily
+        later restart re-fires it against a fleet the policy has
+        legitimately moved on."""
+        if self.journal is None:
             return []
-        self._reconciled_start = True
         done = []
         for rec in self.journal.pending():
             d = self._rebuild_decision(rec, obs)
@@ -636,7 +704,15 @@ class FleetController:
                 done.append({"action": rec["action"],
                              "outcome": "reconciled", **d.fields})
             else:
-                done.append(self._execute(d, aid=rec["id"]))
+                try:
+                    done.append(self._execute(d, aid=rec["id"]))
+                except ActuatorError as exc:
+                    # still pending; the next tick reconciles again
+                    _log.warn("re-fired intent failed; still pending",
+                              action=rec["action"], err=str(exc))
+                    done.append({"action": rec["action"],
+                                 "outcome": "failed",
+                                 "error": str(exc), **d.fields})
         return done
 
     def _rebuild_decision(self, rec: dict, obs: dict):
@@ -749,7 +825,9 @@ class FleetController:
 
         # -- autoscale under the cost floor ---------------------------
         ready_n = sum(1 for s in statuses if s.get("ready"))
-        per_replica = obs["offered_load"] / max(ready_n, 1)
+        load = obs.get("offered_load")
+        per_replica = (load / max(ready_n, 1)
+                       if load is not None else None)
         if n < pol.min_replicas:
             # below the floor — the operator raised it, or a replica
             # died outside a drain: restore it regardless of load
@@ -763,6 +841,13 @@ class FleetController:
                     {"want": want, "reason": "below_min_replicas"},
                     self._apply_scale_up,
                     holds_fn=lambda o, w=want: len(o["replicas"]) >= w))
+        elif per_replica is None:
+            # no genuine load signal this tick (actuator without a
+            # load source, or its load command failed): hold the
+            # replica count — scaling on a proxy would retire healthy
+            # replicas.  The floor restore above, drain-and-replace,
+            # mesh re-resolve and hedge tuning all still run.
+            self._calm_ticks = 0
         elif per_replica > pol.scale_up_load:
             self._calm_ticks = 0
             if n < pol.max_replicas and self._cooled("scale_up") \

@@ -272,7 +272,11 @@ class EndpointSet:
         """Decorrelated jitter over the configured probe interval
         (AWS's classic backoff shape, applied to a steady cadence):
         the next delay is uniform in [interval/2, min(prev*3,
-        interval*2)], each replica's prober seeded independently.
+        interval*1.5)], each replica's prober seeded independently.
+        The window is centered on the configured interval so the MEAN
+        cadence is exactly `_health_interval_s` — jitter spreads the
+        probes, it must not silently slow probe cadence (and with it
+        unhealthy-streak detection) below what was configured.
         Without it, a controller-driven fleet restart starts every
         replica's prober in the same instant and each pass probes the
         whole fleet simultaneously forever — a synchronized probe
@@ -280,7 +284,7 @@ class EndpointSet:
         few cycles no matter how aligned they start."""
         base = self._health_interval_s
         lo = base / 2.0
-        hi = min(max(prev, lo) * 3.0, base * 2.0)
+        hi = min(max(prev, lo) * 3.0, base * 1.5)
         return lo + self._probe_rng.random() * max(hi - lo, 0.0)
 
     def _probe_loop(self) -> None:

@@ -434,6 +434,9 @@ class ScanService:
             "ready": ok,
             "status": why,
             "draining": self.draining,
+            # in-flight scan count: the real load signal the fleet
+            # controller's autoscaler sums across replicas
+            "inflight": self._inflight,
             "serving_last_good": self.db_degraded,
             "generation": self.generation_name(),
             "monitor": self.monitor is not None,
