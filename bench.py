@@ -2439,6 +2439,94 @@ def _run_supervised(device_status: str) -> int:
     return rc
 
 
+def bench_chaos() -> dict:
+    """Chaos-campaign rung (docs/resilience.md "Chaos campaigns"):
+    a full seeded campaign — multi-fault schedules against every live
+    mini-system scenario, five invariant oracles per episode, the
+    deterministic coverage sweep behind it — followed by a
+    deliberately seeded invariant violation that must auto-shrink to
+    a <=2-rule replayable repro.  Exit-gated on
+    chaos_diff_vs_oracle=0, every-oracle-green, coverage=1.0 and the
+    shrink bound.  Written to BENCH_chaos.json."""
+    from trivy_tpu.chaos import campaign, shrink
+    from trivy_tpu.resilience import faults
+
+    seed = int(os.environ.get("TRIVY_TPU_CHAOS_SEED", "0"))
+    episodes = int(os.environ.get("TRIVY_TPU_CHAOS_EPISODES", "50"))
+    budget_s = float(os.environ.get("TRIVY_TPU_CHAOS_BUDGET_S", "30"))
+    t0 = time.time()
+    rep = campaign.run_campaign(seed=seed, n_episodes=episodes,
+                                budget_s=budget_s)
+    campaign_s = time.time() - t0
+    diff_failures = sum(
+        1 for r in rep.results
+        if any(f.startswith(("zero-diff", "durable-convergence"))
+               for f in r.failures))
+    detail = {
+        "seed": seed,
+        "episodes": len(rep.results),
+        "seeded_episodes": episodes,
+        "campaign_s": round(campaign_s, 3),
+        "episodes_per_s": round(len(rep.results) / campaign_s, 3)
+        if campaign_s else 0.0,
+        "coverage": rep.coverage,
+        "uncovered": sorted(f"{s}:{a}" for s, a in rep.uncovered),
+        "excluded_scenarios": dict(rep.excluded),
+        "failing_episodes": len(rep.failures),
+        "chaos_diff_vs_oracle": diff_failures,
+        "repros": [r.to_dict() for r in rep.repros],
+    }
+
+    # the shrinker must reduce a deliberately seeded violation (one
+    # real trigger buried in noise rules that never fire) to a
+    # minimal replayable spec — strict mode, so the degraded stamp
+    # does not excuse the divergence
+    violation = ("seed=9;monitor.index:error@1+;"
+                 "monitor.rematch:delay=0.001@1;"
+                 "fleet.endpoint:timeout@1")
+
+    def failing(spec: str) -> bool:
+        res = campaign.replay(spec, "monitor", budget_s=budget_s,
+                              strict=True)
+        return not res.ok
+
+    t1 = time.time()
+    if failing(violation):
+        shrunk = shrink(violation, failing)
+        n_rules = len(faults.FaultPlan.from_spec(shrunk).rules)
+        detail["shrink"] = {
+            "seeded_spec": violation,
+            "shrunk_spec": shrunk,
+            "shrunk_rules": n_rules,
+            "shrink_s": round(time.time() - t1, 3),
+        }
+    else:
+        detail["shrink"] = {"seeded_spec": violation,
+                            "error": "seeded violation did not fail"}
+    return detail
+
+
+def chaos_gates(detail: dict) -> list[str]:
+    fails = []
+    if detail.get("chaos_diff_vs_oracle") != 0:
+        fails.append("chaos_diff_vs_oracle="
+                     f"{detail.get('chaos_diff_vs_oracle')} (want 0)")
+    if detail.get("failing_episodes") != 0:
+        fails.append(f"failing_episodes={detail.get('failing_episodes')}"
+                     " (want 0)")
+    if detail.get("coverage") != 1.0:
+        fails.append(f"coverage={detail.get('coverage')} (want 1.0)")
+    if detail.get("excluded_scenarios"):
+        fails.append("excluded_scenarios="
+                     f"{sorted(detail['excluded_scenarios'])} (want none)")
+    sh = detail.get("shrink", {})
+    if sh.get("error"):
+        fails.append(f"shrink: {sh['error']}")
+    elif sh.get("shrunk_rules", 99) > 2:
+        fails.append(f"shrunk_rules={sh.get('shrunk_rules')} (want <=2)")
+    return fails
+
+
 def _phase_json_path() -> str | None:
     """--phase-json FILE, surviving the supervised re-exec via env (the
     parent re-invokes this file without argv)."""
@@ -2496,6 +2584,34 @@ def main():
         fails = dcn_gates(detail)
         for f_ in fails:
             print(f"BENCH_STATUS=dcn_gate_failed {f_}", file=sys.stderr)
+        return 1 if (fails or lint_rc) else 0
+    if "--chaos" in sys.argv:
+        # standalone chaos-campaign rung (CPU-only): the quick way to
+        # refresh BENCH_chaos.json.  Runs the invariant-lint gate like
+        # every supervised rung.  The mesh/dcn scenarios need virtual
+        # host devices, so the XLA flag lands before the first jax
+        # import.
+        if "jax" not in sys.modules:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        lint_rc = _lint_gate()
+        detail = bench_chaos()
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_chaos.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(detail, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(detail, indent=2, sort_keys=True))
+        fails = chaos_gates(detail)
+        for f_ in fails:
+            print(f"BENCH_STATUS=chaos_gate_failed {f_}",
+                  file=sys.stderr)
         return 1 if (fails or lint_rc) else 0
     if "--selfdrive" in sys.argv:
         # standalone self-driving-fleet rung (CPU-only, no device

@@ -66,7 +66,8 @@ def fake_redis():
 # stay raw; the static with-nesting pass covers those (see the
 # witness.py docstring).
 _WITNESS_MARKERS = ("sched", "fanal", "obs", "durability", "fault",
-                    "mesh", "dcn", "monitor", "secret", "fleet")
+                    "mesh", "dcn", "monitor", "secret", "fleet",
+                    "chaos")
 
 
 @pytest.fixture(autouse=True)

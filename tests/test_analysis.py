@@ -326,6 +326,88 @@ class TestRuleFixtures:
         project.declared_event_kinds = [("good_kind", "d")]
         assert run_rule(project, "event-kind") == []
 
+    def test_chaos_coverage_fires(self, tmp_path):
+        """Seeded violations of every chaos-coverage clause: a SITES
+        pair no scenario claims, a claimed pair SITES doesn't declare,
+        a double-claimed pair, a manifest scenario with no class, a
+        scenario class missing from MANIFEST, and an undocumented
+        scenario name."""
+        scen = (
+            "MANIFEST = {\n"
+            "    'alpha': (('rpc', ('drop', 'ghost')),),\n"
+            "    'beta': (('rpc', ('drop',)),),\n"
+            "    'phantom': (('db.save', ('kill',)),),\n"
+            "}\n"
+            "class AlphaScenario:\n"
+            "    name = 'alpha'\n"
+            "class BetaScenario:\n"
+            "    name = 'beta'\n"
+            "class RogueScenario:\n"
+            "    name = 'rogue'\n")
+        project = make_project(
+            tmp_path, {"chaos/scenarios.py": scen},
+            docs={"docs/resilience.md": (
+                "# Resilience\n\n## Chaos campaigns\n\n"
+                "`alpha` and `phantom` are tabled; beta is not "
+                "backticked.\n")},
+            fault_sites=[("rpc", ("drop", "timeout")),
+                         ("db.save", ("kill",))])
+        found = run_rule(project, "chaos-coverage")
+        msgs = "\n".join(f.message for f in found)
+        assert ("fault pair rpc:timeout is declared in faults.SITES "
+                "but no chaos scenario claims it") in msgs
+        assert ("chaos manifest claims fault pair rpc:ghost that "
+                "faults.SITES does not declare") in msgs
+        assert ("fault pair rpc:drop claimed by both 'alpha' and "
+                "'beta'") in msgs
+        assert ("manifest scenario 'phantom' has no scenario class"
+                in msgs)
+        assert "scenario class 'rogue' is not in MANIFEST" in msgs
+        assert "chaos scenario 'beta' missing from the" in msgs
+
+    def test_chaos_coverage_doc_section_and_literal(self, tmp_path):
+        """The section gate and the pure-literal gate, plus: a tree
+        without a chaos package (manifest extractor -> None) is
+        skipped entirely."""
+        project = make_project(
+            tmp_path,
+            {"chaos/scenarios.py": (
+                "MANIFEST = {'alpha': (('rpc', ('drop',)),)}\n"
+                "class AlphaScenario:\n"
+                "    name = 'alpha'\n")},
+            docs={"docs/resilience.md": "# Resilience\nno section\n"},
+            fault_sites=[("rpc", ("drop",))])
+        found = run_rule(project, "chaos-coverage")
+        assert any('no "Chaos campaigns" section' in f.message
+                   for f in found)
+        # computed manifest: present but not a pure literal
+        (tmp_path / "t2").mkdir()
+        (tmp_path / "t3").mkdir()
+        project2 = make_project(
+            tmp_path / "t2",
+            {"chaos/scenarios.py": "MANIFEST = build_manifest()\n"},
+            fault_sites=[("rpc", ("drop",))])
+        found2 = run_rule(project2, "chaos-coverage")
+        assert any("missing or not a pure literal" in f.message
+                   for f in found2)
+        # no chaos package at all: pre-chaos trees stay clean
+        project3 = make_project(
+            tmp_path / "t3", {"core/x.py": "X = 1\n"},
+            fault_sites=[("rpc", ("drop",))])
+        assert run_rule(project3, "chaos-coverage") == []
+
+    def test_chaos_coverage_clean_mini_tree(self, tmp_path):
+        project = make_project(
+            tmp_path,
+            {"chaos/scenarios.py": (
+                "MANIFEST = {'alpha': (('rpc', ('drop',)),)}\n"
+                "class AlphaScenario:\n"
+                "    name = 'alpha'\n")},
+            docs={"docs/resilience.md": (
+                "## Chaos campaigns\n\n| `alpha` | rpc |\n")},
+            fault_sites=[("rpc", ("drop",))])
+        assert run_rule(project, "chaos-coverage") == []
+
     def test_action_kind_fires(self, tmp_path):
         """Seeded violations of the controller-action extension:
         emitted-not-declared, computed kind at the emit funnel,

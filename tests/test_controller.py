@@ -422,6 +422,32 @@ class TestActionJournal:
         with pytest.raises(AppendLogError):
             ctrl.ActionJournal.open(path)
 
+    def test_open_salvages_a_torn_header(self, tmp_path):
+        """The create-time header write tore (chaos composed find:
+        fleet.controller:kill x journal.append:torn-write): the
+        journal must reopen, keeping the applied-id set from the
+        complete records that followed the torn header — reset would
+        break exactly-once, a crash would wedge the controller."""
+        path = str(tmp_path / "a.jsonl")
+        j = ctrl.ActionJournal.open(path)
+        a1 = j.intent("scale_up", want=2)
+        j.applied(a1, "applied", spawned="http://new1")
+        a2 = j.intent("scale_down", want=1)
+        j.close()
+        with open(path, "rb") as fh:
+            lines = fh.read().split(b"\n")
+        lines[0] = lines[0][: len(lines[0]) // 2]  # tear the header
+        with open(path, "wb") as fh:
+            fh.write(b"\n".join(lines))
+        j2 = ctrl.ActionJournal.open(path)
+        assert [r["id"] for r in j2.pending()] == [a2]
+        assert j2.intent("scale_up", want=3) > a2
+        j2.close()
+        # the repaired file replays cleanly from here on
+        j3 = ctrl.ActionJournal.open(path)
+        assert [r["id"] for r in j3.pending()] == [a2, a2 + 1]
+        j3.close()
+
     def test_compact_keeps_pending_intents(self, tmp_path):
         path = str(tmp_path / "a.jsonl")
         j = ctrl.ActionJournal.open(path)

@@ -147,6 +147,30 @@ def test_failover_on_dropped_endpoint(two_servers):
         es.close()
 
 
+def test_draining_replica_shed_is_backpressure_not_failure(two_servers):
+    """A draining replica deliberately sheds (503 + Retry-After): that
+    is flow control, not ill health — the client must fail over but
+    record breaker SUCCESS for the shedding replica, so a rolling
+    restart never cascades into open breakers against replicas that
+    come right back."""
+    addrs = [s.address for s in two_servers]
+    two_servers[0].service.start_drain()
+    single = EndpointSet([addrs[1]], health_interval_s=0)
+    oracle = scan_bytes(single, "img1", "sha256:b1")
+    single.close()
+    es = EndpointSet(addrs, hedge_s=0, health_interval_s=0)
+    try:
+        for _ in range(6):
+            assert scan_bytes(es, "img1", "sha256:b1") == oracle
+        # round-robin really did offer the draining replica traffic...
+        assert two_servers[0].service.metrics.scans_shed_total >= 3
+        # ...yet its breaker saw only the deliberate-shed successes
+        ep0 = es._live()[0]
+        assert ep0.breaker.state == "closed"
+    finally:
+        es.close()
+
+
 def test_hedged_requests_cut_tail_latency(two_servers):
     """fleet.endpoint.0:delay makes replica 0 slow on every dispatch;
     a hedged set answers fast (the race goes to replica 1) at zero

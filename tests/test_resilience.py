@@ -130,6 +130,53 @@ def test_fault_spec_probability_is_seeded():
     assert any(hits(7)) and not all(hits(7))
 
 
+def test_fault_seed_env_fallback(monkeypatch):
+    """A spec with no seed= token draws its @pF randomness from
+    TRIVY_TPU_FAULT_SEED, so pasted probabilistic repros replay
+    deterministically without editing the spec itself."""
+    def hits(spec):
+        plan = faults.FaultPlan.from_spec(spec)
+        return [bool(plan.fire("rpc.scan")) for _ in range(32)]
+
+    monkeypatch.setenv(faults.SEED_ENV_VAR, "7")
+    assert faults.FaultPlan.from_spec("rpc:drop@p0.5").seed == 7
+    assert hits("rpc:drop@p0.5") == hits("seed=7;rpc:drop@p0.5")
+    # an explicit seed= token beats the env
+    assert faults.FaultPlan.from_spec("seed=3;rpc:drop@p0.5").seed == 3
+    monkeypatch.setenv(faults.SEED_ENV_VAR, "8")
+    assert hits("rpc:drop@p0.5") != hits("seed=7;rpc:drop@p0.5")
+    monkeypatch.setenv(faults.SEED_ENV_VAR, "not-a-seed")
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultPlan.from_spec("rpc:drop@p0.5")
+
+
+def test_rule_token_and_spec_round_trip():
+    """token()/to_spec() emit paste-ready specs: every selector form
+    recompiles to an equal plan — shrunk chaos repros depend on it."""
+    for tok in ("rpc:drop", "rpc.scan:timeout@3", "rpc:drop@2-5",
+                "engine:device-lost@4+", "rpc:error=503@1",
+                "db.save:torn-write@1-2", "rpc:delay=0.01@p0.25"):
+        plan = faults.FaultPlan.from_spec(tok)
+        assert plan.rules[0].token() == tok
+    spec = "seed=6;rpc:drop@p0.5;db.save:kill@2"
+    plan = faults.FaultPlan.from_spec(spec)
+    assert plan.to_spec() == spec
+    plan2 = faults.FaultPlan.from_spec(plan.to_spec())
+    assert [r.token() for r in plan2.rules] == \
+        [r.token() for r in plan.rules]
+    assert plan2.seed == plan.seed
+
+
+def test_rule_fired_counter_tracks_injections():
+    """`fired` counts firings (not matches): the chaos campaign's
+    coverage ledger reads it to decide which pairs were exercised."""
+    plan = faults.install_spec("rpc:drop@2")
+    for _ in range(3):
+        plan.fire("rpc.scan")
+    (rule,) = plan.rules
+    assert rule.calls == 3 and rule.fired == 1
+
+
 def test_fault_spec_errors():
     for bad in ("rpc.scan", "rpc:explode", "rpc:drop@p2", "rpc:drop@3-1",
                 "seed=x;rpc:drop"):
@@ -266,6 +313,21 @@ def test_parse_retry_after():
     assert parse_retry_after("0.5") == 0.5
     assert parse_retry_after(None) is None
     assert parse_retry_after("garbage") is None
+
+
+def test_parse_retry_after_http_date():
+    """RFC 7231 allows an HTTP-date form; proxies (and real registries)
+    emit it, so the client must honor it like delta-seconds."""
+    from datetime import datetime, timedelta, timezone
+    from email.utils import format_datetime
+
+    future = datetime.now(timezone.utc) + timedelta(seconds=30)
+    d = parse_retry_after(format_datetime(future, usegmt=True))
+    assert d is not None and 0.0 < d <= 30.0
+    past = datetime.now(timezone.utc) - timedelta(seconds=30)
+    assert parse_retry_after(format_datetime(past, usegmt=True)) == 0.0
+    # date-shaped garbage still degrades to None, not a crash
+    assert parse_retry_after("Wed, 99 Foo 2026 99:99:99 GMT") is None
 
 
 def test_deadline_budget_and_scope():

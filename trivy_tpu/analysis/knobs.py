@@ -33,6 +33,20 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TRIVY_TPU_FAULTS", "", "resilience", False,
          "Deterministic fault-injection plan (site:action@selector "
          "grammar, docs/resilience.md); validated at startup."),
+    Knob("TRIVY_TPU_FAULT_SEED", "0", "resilience", False,
+         "Default RNG seed for `@pF` probability selectors when the "
+         "fault spec carries no `seed=` token — makes probabilistic "
+         "specs replayable (chaos repros paste both knobs)."),
+    # --- chaos campaign engine (docs/resilience.md "Chaos campaigns")
+    Knob("TRIVY_TPU_CHAOS_SEED", "0", "chaos", False,
+         "Campaign seed for `trivy-tpu chaos run`: derives every "
+         "episode's fault schedule, so a campaign replays exactly."),
+    Knob("TRIVY_TPU_CHAOS_EPISODES", "50", "chaos", False,
+         "Episode count for `trivy-tpu chaos run` when --episodes is "
+         "not given."),
+    Knob("TRIVY_TPU_CHAOS_BUDGET_S", "30", "chaos", False,
+         "Per-episode liveness watchdog budget (seconds): an episode "
+         "that does not finish inside it is a liveness violation."),
     # --- scheduler (continuous batching)
     Knob("TRIVY_TPU_SCHED", "1", "sched", True,
          "Cross-request match scheduler; 0 restores the exact "

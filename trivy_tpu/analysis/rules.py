@@ -73,6 +73,7 @@ class Project:
         self.declared_span_taxonomy = self._extract_span_taxonomy()
         self.declared_event_kinds = self._extract_event_kinds()
         self.declared_action_kinds = self._extract_action_kinds()
+        self.declared_chaos_manifest = self._extract_chaos_manifest()
 
     def _collect(self) -> None:
         pkg = os.path.join(self.root, "trivy_tpu")
@@ -236,6 +237,27 @@ class Project:
                 pass
         if self.file("trivy_tpu/fleet/controller.py") is not None:
             return []  # present but unparsable: the rule flags it
+        return None
+
+    def _extract_chaos_manifest(self):
+        """Chaos scenario coverage map from the LINTED tree's
+        chaos/scenarios.py MANIFEST table.  ``None`` means the tree
+        has no chaos package — the chaos-coverage rule then skips
+        entirely (NO import fallback: a seeded mini-tree without the
+        package must keep pre-chaos rule behavior, and tests override
+        the attribute to opt in)."""
+        value = self._registry_assign(
+            "trivy_tpu/chaos/scenarios.py", "MANIFEST")
+        if value is not None:
+            try:
+                raw = ast.literal_eval(value)
+                return {name: [(site, tuple(actions))
+                               for site, actions in entries]
+                        for name, entries in raw.items()}
+            except (ValueError, TypeError):
+                pass
+        if self.file("trivy_tpu/chaos/scenarios.py") is not None:
+            return {}  # present but unparsable: the rule flags it
         return None
 
     @staticmethod
@@ -1188,6 +1210,133 @@ class EventKindRule(Rule):
                     f"docs/fleet.md catalogs kind {kind!r} but "
                     "neither fleet.slo.EVENTS nor "
                     "fleet.controller.ACTIONS declares it")
+
+
+# =================================================== 11. chaos-coverage
+
+@register
+class ChaosCoverageRule(Rule):
+    id = "chaos-coverage"
+    summary = ("chaos scenario MANIFEST ⇔ faults.SITES ⇔ "
+               "docs/resilience.md: every (site, action) pair claimed "
+               "by exactly one scenario that exists and is documented")
+    rationale = (
+        "The chaos campaign's coverage gate is only sound if the "
+        "manifest it checks against is itself sound. A fault pair no "
+        "scenario claims is a hole campaigns can never exercise — the "
+        "injection point exists but nothing drives traffic through "
+        "it; a pair claimed twice makes per-scenario sweep ownership "
+        "ambiguous; a manifest entry without a scenario class is "
+        "coverage the campaign silently skips. chaos/scenarios.py's "
+        "MANIFEST is the single source of truth and must stay an "
+        "exact partition of faults.SITES.")
+
+    SCENARIOS_PY = "trivy_tpu/chaos/scenarios.py"
+    DOC = "docs/resilience.md"
+    SECTION_RX = re.compile(r"^#+\s*Chaos campaigns\s*$", re.M)
+
+    def _manifest_line(self, project: Project) -> int:
+        node = project._registry_assign(self.SCENARIOS_PY, "MANIFEST")
+        return getattr(node, "lineno", 1)
+
+    @staticmethod
+    def _scenario_class_names(project: Project) -> set[str]:
+        """`name = "<literal>"` class attributes of scenarios.py
+        ClassDefs — the campaign's scenario registry keys."""
+        pf = project.file(ChaosCoverageRule.SCENARIOS_PY)
+        names: set[str] = set()
+        if pf is None:
+            return names
+        for node in pf.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for sub in node.body:
+                if (isinstance(sub, ast.Assign)
+                        and any(isinstance(t, ast.Name)
+                                and t.id == "name"
+                                for t in sub.targets)):
+                    val = _const_str(sub.value)
+                    if val:
+                        names.add(val)
+        return names
+
+    def check(self, project: Project):
+        manifest = getattr(project, "declared_chaos_manifest", None)
+        if manifest is None:
+            return  # tree has no chaos package
+        line = self._manifest_line(project)
+        if not manifest:
+            yield Finding(
+                self.id, self.SCENARIOS_PY, line,
+                "chaos.scenarios.MANIFEST is missing or not a pure "
+                "literal — the scenario coverage map must be exported "
+                "as structured data")
+            return
+        claimed: dict[tuple[str, str], str] = {}
+        for name in sorted(manifest):
+            for site, actions in manifest[name]:
+                for action in actions:
+                    pair = (site, action)
+                    if pair in claimed and claimed[pair] != name:
+                        yield Finding(
+                            self.id, self.SCENARIOS_PY, line,
+                            f"fault pair {site}:{action} claimed by "
+                            f"both {claimed[pair]!r} and {name!r} — "
+                            "the manifest must partition faults.SITES")
+                    claimed.setdefault(pair, name)
+        declared_pairs = project.declared_fault_sites
+        # an empty/missing SITES registry is the fault-site rule's
+        # finding, not a reason to call every claimed pair unknown
+        if declared_pairs:
+            registry = {(site, a) for site, actions in declared_pairs
+                        for a in actions}
+            for site, action in sorted(registry - set(claimed)):
+                yield Finding(
+                    self.id, self.SCENARIOS_PY, line,
+                    f"fault pair {site}:{action} is declared in "
+                    "faults.SITES but no chaos scenario claims it — "
+                    "campaigns can never cover it")
+            for site, action in sorted(set(claimed) - registry):
+                yield Finding(
+                    self.id, self.SCENARIOS_PY, line,
+                    f"chaos manifest claims fault pair {site}:{action} "
+                    "that faults.SITES does not declare")
+        class_names = self._scenario_class_names(project)
+        if project.file(self.SCENARIOS_PY) is not None:
+            for name in sorted(set(manifest) - class_names):
+                yield Finding(
+                    self.id, self.SCENARIOS_PY, line,
+                    f"manifest scenario {name!r} has no scenario "
+                    "class (no ClassDef with a literal name = "
+                    f"{name!r}) — its pairs are coverage the "
+                    "campaign silently skips")
+            for name in sorted(class_names - set(manifest)):
+                yield Finding(
+                    self.id, self.SCENARIOS_PY, line,
+                    f"scenario class {name!r} is not in MANIFEST — "
+                    "it claims no fault pairs and campaigns never "
+                    "run it")
+        doc = project.doc_text(self.DOC)
+        if doc is None:
+            return  # the fault-site rule owns the doc's existence
+        m = self.SECTION_RX.search(doc)
+        if m is None:
+            yield Finding(
+                self.id, self.DOC, 1,
+                'docs/resilience.md has no "Chaos campaigns" section '
+                "— the campaign engine must be documented")
+            return
+        section = doc[m.end():]
+        nxt = re.search(r"^#+ ", section, re.M)
+        if nxt is not None:
+            section = section[:nxt.start()]
+        for name in sorted(manifest):
+            if f"`{name}`" not in section:
+                yield Finding(
+                    self.id, self.DOC, 1,
+                    f"chaos scenario {name!r} missing from the "
+                    'docs/resilience.md "Chaos campaigns" section '
+                    "(expected backticked in the scenario table)")
 
 
 # ----------------------------------------------------------- the driver

@@ -1,0 +1,23 @@
+"""Deterministic chaos campaigns over the fault-injection registry.
+
+See docs/resilience.md "Chaos campaigns": seed-derived multi-fault
+schedules run against live mini-system scenarios, five invariant
+oracles per episode, auto-shrinking repros, and machine-checked
+(site, action) coverage of ``faults.SITES``.
+"""
+
+from trivy_tpu.chaos.campaign import (CampaignReport, ChaosError,
+                                      EpisodeResult, Repro,
+                                      full_coverage_check, replay,
+                                      run_campaign)
+from trivy_tpu.chaos.scenarios import (MANIFEST, SCENARIOS,
+                                       declared_pairs,
+                                       registry_pairs)
+from trivy_tpu.chaos.schedule import EpisodeSpec, shrink
+
+__all__ = [
+    "CampaignReport", "ChaosError", "EpisodeResult", "EpisodeSpec",
+    "MANIFEST", "Repro", "SCENARIOS", "declared_pairs",
+    "full_coverage_check", "registry_pairs", "replay",
+    "run_campaign", "shrink",
+]

@@ -666,6 +666,45 @@ def build_parser() -> argparse.ArgumentParser:
     _add_scan_flags(p)
     p.add_argument("target")
 
+    p = sub.add_parser(
+        "chaos", help="deterministic chaos campaigns over the fault "
+        'matrix (docs/resilience.md "Chaos campaigns")',
+        allow_abbrev=False)
+    _add_global_flags(p)
+    chsub = p.add_subparsers(dest="chaos_command")
+    pcr = chsub.add_parser(
+        "run", help="run a seeded multi-fault campaign with invariant "
+        "oracles and 100% (site, action) coverage", allow_abbrev=False)
+    _add_global_flags(pcr)
+    pcr.add_argument("--seed", type=int, default=None,
+                     help="campaign seed (default TRIVY_TPU_CHAOS_SEED)")
+    pcr.add_argument("--episodes", type=int, default=None,
+                     help="seeded episodes before the coverage sweep "
+                     "(default TRIVY_TPU_CHAOS_EPISODES)")
+    pcr.add_argument("--scenarios", default=None,
+                     help="comma-separated scenario names (default: all)")
+    pcr.add_argument("--budget", type=float, default=None,
+                     help="per-episode watchdog budget in seconds "
+                     "(default TRIVY_TPU_CHAOS_BUDGET_S)")
+    pcr.add_argument("--strict", action="store_true",
+                     help="degraded stamps do not excuse output "
+                     "divergence")
+    pcr.add_argument("--json", dest="report_json", default=None,
+                     metavar="PATH",
+                     help="write the campaign report as JSON")
+    pcp = chsub.add_parser(
+        "replay", help="replay one TRIVY_TPU_FAULTS spec (a shrunk "
+        "repro) against a scenario", allow_abbrev=False)
+    _add_global_flags(pcp)
+    pcp.add_argument("spec", help="TRIVY_TPU_FAULTS spec string")
+    pcp.add_argument("--scenario", required=True,
+                     help="scenario name (chaos.SCENARIOS)")
+    pcp.add_argument("--budget", type=float, default=None,
+                     help="watchdog budget in seconds")
+    pcp.add_argument("--strict", action="store_true",
+                     help="degraded stamps do not excuse output "
+                     "divergence")
+
     sub.add_parser("version", help="print version", allow_abbrev=False)
 
     # `lint` shares the analysis package's flag definitions — one
@@ -689,7 +728,7 @@ def main(argv: list[str] | None = None) -> int:
     known = {"image", "filesystem", "fs", "rootfs", "repository", "repo",
              "sbom", "vm", "kubernetes", "k8s", "convert", "server", "db",
              "clean", "config", "version", "registry", "plugin", "module",
-             "lint", "watch", "profile", "fleet"}
+             "lint", "watch", "profile", "fleet", "chaos"}
     if argv and not argv[0].startswith("-") and argv[0] not in known:
         from trivy_tpu.plugin import PluginManager
 
@@ -765,6 +804,8 @@ def main(argv: list[str] | None = None) -> int:
             return run.run_plugin(args)
         if args.command == "module":
             return run.run_module(args)
+        if args.command == "chaos":
+            return run.run_chaos(args)
     except run.FatalError as e:
         log.logger().error(str(e))
         return 1

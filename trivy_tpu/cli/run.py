@@ -1603,3 +1603,86 @@ def run_module(args) -> int:
                     print(f)
         return 0
     raise FatalError("usage: module {install|uninstall|list}")
+
+
+def run_chaos(args) -> int:
+    """`chaos run|replay` (docs/resilience.md "Chaos campaigns"):
+    seeded multi-fault schedules against live mini-system scenarios,
+    five invariant oracles per episode, machine-checked (site, action)
+    coverage, auto-shrinking repros."""
+    import json as _json
+    import sys
+
+    # the mesh/dcn scenarios need multiple host devices on CPU-only
+    # boxes; the flag only takes effect before the first jax import
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    from trivy_tpu.chaos import campaign
+
+    cmd = getattr(args, "chaos_command", None)
+    budget = getattr(args, "budget", None)
+    budget_s = budget if budget is not None else \
+        campaign.default_budget_s()
+    strict = bool(getattr(args, "strict", False))
+
+    if cmd == "replay":
+        try:
+            res = campaign.replay(args.spec, args.scenario,
+                                  budget_s=budget_s, strict=strict)
+        except campaign.ChaosError as e:
+            raise FatalError(f"chaos replay: {e}")
+        print(_json.dumps(res.to_dict(), indent=2, sort_keys=True))
+        if res.ok:
+            _log.info("replay held all invariants", spec=args.spec,
+                      scenario=args.scenario)
+            return 0
+        _log.error("replay reproduced the failure", spec=args.spec,
+                   failures=res.failures)
+        return 1
+
+    if cmd == "run":
+        seed = getattr(args, "seed", None)
+        seed = seed if seed is not None else campaign.default_seed()
+        episodes = getattr(args, "episodes", None)
+        episodes = episodes if episodes is not None else \
+            campaign.default_episodes()
+        names = None
+        if getattr(args, "scenarios", None):
+            names = [s.strip() for s in args.scenarios.split(",")
+                     if s.strip()]
+            unknown = [n for n in names
+                       if n not in campaign.SCENARIOS]
+            if unknown:
+                raise FatalError(
+                    f"chaos: unknown scenario(s) {unknown!r}; "
+                    f"known: {sorted(campaign.SCENARIOS)}")
+        try:
+            rep = campaign.run_campaign(
+                seed=seed, n_episodes=episodes, scenario_names=names,
+                budget_s=budget_s, strict=strict,
+                log=lambda m: _log.info(m))
+        except campaign.ChaosError as e:
+            raise FatalError(f"chaos run: {e}")
+        out = getattr(args, "report_json", None)
+        if out:
+            from trivy_tpu.durability.atomic import atomic_write
+
+            body = _json.dumps(rep.to_dict(), indent=2,
+                               sort_keys=True).encode()
+            atomic_write(out, body, fault_site="report.write")
+        for repro in rep.repros:
+            print(f"repro [{repro.scenario}] {repro.env_line()}",
+                  file=sys.stderr)
+        print(f"chaos: {len(rep.results)} episodes, "
+              f"{len(rep.failures)} failing, "
+              f"coverage {rep.coverage:.3f}"
+              + (f", excluded {sorted(rep.excluded)}"
+                 if rep.excluded else ""))
+        return 0 if rep.ok else 1
+
+    raise FatalError("usage: chaos {run|replay}")

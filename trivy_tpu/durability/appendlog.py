@@ -113,6 +113,41 @@ class AppendLog:
         log.records_written = len(records)
         return log, records[1:]
 
+    @classmethod
+    def salvage(cls, path: str, header: dict,
+                fault_site: str = "journal.append",
+                ) -> tuple["AppendLog", list[dict]]:
+        """Rebuild a log whose header record never durably landed (the
+        create-time append tore, so replay sees "no header").  Appends
+        made after the torn header in the original process are complete
+        line-bounded records and MUST survive — for a write-ahead
+        journal they carry the applied-id set exactly-once replay
+        depends on.  A fresh header is stamped and the repaired file is
+        published atomically, then replayed as usual."""
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise AppendLogError(f"cannot read append log {path}: {e}")
+        if raw and not raw.endswith(b"\n"):
+            raw = raw[:raw.rfind(b"\n") + 1]
+        survivors: list[dict] = []
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("kind") != "header":
+                survivors.append(rec)
+        _log.warn("salvaging headerless append log", path=path,
+                  survivors=len(survivors))
+        body = b"".join([_encode(dict(header, kind="header"))]
+                        + [_encode(r) for r in survivors])
+        atomic.atomic_write(path, body, fault_site=fault_site)
+        return cls.replay(path, fault_site)
+
     # ------------------------------------------------------------ write
 
     def append(self, rec: dict) -> None:

@@ -248,7 +248,14 @@ class ActionJournal:
         truncated, mid-file rot skipped — restoring the applied-id set
         and any pending intents."""
         if os.path.exists(path):
-            log, past = AppendLog.replay(path)
+            try:
+                log, past = AppendLog.replay(path)
+            except AppendLogError:
+                # the create-time header write itself tore: appends
+                # from the rest of that run are intact line-bounded
+                # records carrying the applied-id set — salvage them
+                # rather than crash-loop the controller on restart
+                log, past = AppendLog.salvage(path, dict(cls.HEADER))
             if log.header.get("log") != "controller-actions":
                 log.close()
                 raise AppendLogError(

@@ -56,6 +56,7 @@ from trivy_tpu.resilience.retry import (
 )
 from trivy_tpu.rpc.client import (
     DEFAULT_RETRY,
+    RPCBackpressure,
     RPCError,
     RPCUnavailable,
     _Conn,
@@ -406,7 +407,8 @@ class EndpointSet:
                   attempt_kind: str = "hedge") -> bytes:
         """One attempt on one endpoint, with breaker accounting. Only
         RPCUnavailable counts against the breaker — a deterministic
-        4xx reply proves the replica is alive and answering.
+        4xx reply proves the replica is alive and answering, and so
+        does a deliberate shed (RPCBackpressure: 503 + Retry-After).
 
         ``attempt`` (hedged or failover dispatches) tags the outgoing
         trace header with the dispatch identity so the server-side
@@ -435,6 +437,15 @@ class EndpointSet:
                     out = ep.conn.post_once(path, body)
             else:
                 out = ep.conn.post_once(path, body)
+        except RPCBackpressure:
+            # deliberate shed (503 + Retry-After from drain/overload):
+            # the replica answered coherently, so this is backpressure,
+            # not replica death — fail over without charging the
+            # breaker, or an overloaded-but-healthy fleet cascades
+            # into open breakers
+            ep.breaker.record_success()
+            self._breaker_event(ep, state_before)
+            raise
         except RPCUnavailable:
             ep.breaker.record_failure()
             self._breaker_event(ep, state_before)

@@ -21,7 +21,8 @@ Spec grammar (rules joined by ";" or ","):
               | N "-" M  fire on calls N..M inclusive
               | "p" F    fire with probability F (seeded, deterministic)
               | (none)   fire on every matching call
-    seed     := "seed=" INT   (plan-wide RNG seed for "p" selectors)
+    seed     := "seed=" INT   (plan-wide RNG seed for "p" selectors;
+                               defaults to TRIVY_TPU_FAULT_SEED, then 0)
 
 Examples:
 
@@ -62,6 +63,7 @@ from trivy_tpu.analysis.witness import make_lock
 from dataclasses import dataclass, field
 
 ENV_VAR = "TRIVY_TPU_FAULTS"
+SEED_ENV_VAR = "TRIVY_TPU_FAULT_SEED"
 
 ACTIONS = {"drop", "timeout", "delay", "error", "corrupt", "device-lost",
            "kill", "torn-write", "bitflip"}
@@ -145,6 +147,7 @@ class Rule:
     stop: int | None = None  # inclusive; None = open-ended
     prob: float | None = None
     calls: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
 
     def fires(self, n: int, rng: random.Random) -> bool:
         if self.prob is not None:
@@ -155,6 +158,24 @@ class Rule:
 
     def matches(self, site: str) -> bool:
         return site == self.site or site.startswith(self.site + ".")
+
+    def token(self) -> str:
+        """The rule back as a spec token — `from_spec(token())` rebuilds
+        an equal rule, so shrunk schedules paste straight into
+        TRIVY_TPU_FAULTS."""
+        out = f"{self.site}:{self.action}"
+        if self.param is not None:
+            p = self.param
+            out += f"={int(p)}" if p == int(p) else f"={p}"
+        if self.prob is not None:
+            out += f"@p{self.prob}"
+        elif self.start == self.stop:
+            out += f"@{self.start}"
+        elif self.stop is not None:
+            out += f"@{self.start}-{self.stop}"
+        elif self.start != 1:
+            out += f"@{self.start}+"
+        return out
 
 
 def _parse_selector(sel: str | None) -> tuple[int, int | None, float | None]:
@@ -187,13 +208,29 @@ class FaultPlan:
 
     def __init__(self, rules: list[Rule], seed: int = 0):
         self.rules = list(rules)
+        self.seed = seed
         self._rng = random.Random(seed)
         self._lock = make_lock("resilience.faults._lock")
 
+    def to_spec(self) -> str:
+        """Round-trip back to a TRIVY_TPU_FAULTS string (seed token first
+        so a pasted repro replays the same `@pF` draws)."""
+        toks = [f"seed={self.seed}"] if self.seed else []
+        toks += [r.token() for r in self.rules]
+        return ";".join(toks)
+
     @classmethod
     def from_spec(cls, spec: str) -> "FaultPlan":
+        """Compile a spec string.  When the spec carries no `seed=` token,
+        the plan-wide RNG seed for `@pF` selectors falls back to
+        TRIVY_TPU_FAULT_SEED (default 0), so probabilistic specs replay
+        deterministically without editing the spec itself."""
         rules: list[Rule] = []
-        seed = 0
+        try:
+            seed = int(os.environ.get(SEED_ENV_VAR, "0"))
+        except ValueError:
+            raise FaultSpecError(
+                f"bad {SEED_ENV_VAR}={os.environ.get(SEED_ENV_VAR)!r}")
         for tok in re.split(r"[;,]", spec):
             tok = tok.strip()
             if not tok:
@@ -235,6 +272,7 @@ class FaultPlan:
                 if r.matches(site):
                     r.calls += 1
                     if r.fires(r.calls, self._rng):
+                        r.fired += 1
                         out.append(r)
         if out:
             from trivy_tpu.obs import metrics as obs_metrics

@@ -64,6 +64,15 @@ class RPCUnavailable(RPCError):
     replica may still succeed (docs/fleet.md)."""
 
 
+class RPCBackpressure(RPCUnavailable):
+    """Retries exhausted against a replica that was deliberately
+    shedding (503 + Retry-After from drain or overload). Still an
+    RPCUnavailable — failover to another replica is the right move —
+    but the EndpointSet must NOT count it against the breaker: the
+    replica answered coherently, so an overloaded-but-healthy fleet
+    never cascades into open breakers (docs/fleet.md)."""
+
+
 class _Conn:
     def __init__(self, url: str, token: str | None = None,
                  custom_headers: dict | None = None, timeout: float = 300.0,
@@ -306,6 +315,7 @@ class _Conn:
         delays = policy.delays(self._rng)
         site = faults.rpc_site(path)
         last_err: Exception | None = None
+        shed = False  # last failure was a deliberate 503 + Retry-After
         for attempt in range(attempts):
             if deadline is not None and deadline.expired:
                 raise DeadlineExceeded(
@@ -377,6 +387,7 @@ class _Conn:
                         # encoding: forget the sticky capability and
                         # let the retry resend plain
                         self._server_gzip = False
+                        shed = False
                         last_err = RPCError(
                             f"{status} to gzip request from a server "
                             f"without gzip capability: {detail}")
@@ -384,6 +395,11 @@ class _Conn:
                         raise RPCError(f"{status}: {detail}")
                     else:
                         last_err = RPCError(f"{status}: {detail}")
+                        # 503 WITH Retry-After is the shed handshake
+                        # (drain / overload): the replica is alive and
+                        # telling us to come back later
+                        shed = (status == 503
+                                and rhdrs.get("Retry-After") is not None)
                         if status == 503 and policy.respect_retry_after:
                             retry_after = parse_retry_after(
                                 rhdrs.get("Retry-After"))
@@ -392,9 +408,11 @@ class _Conn:
             except faults.InjectedHTTPError as exc:
                 if exc.code < 500:
                     raise RPCError(f"{exc.code}: {exc}") from exc
+                shed = False
                 last_err = RPCError(f"{exc.code}: {exc}")
             except (urllib.error.URLError, http.client.HTTPException,
                     OSError, TimeoutError) as exc:
+                shed = False
                 last_err = exc
             if attempt < attempts - 1:
                 delay = next(delays)
@@ -410,6 +428,10 @@ class _Conn:
                         budget_s=deadline.budget_s)
                 obs_metrics.RETRY_ATTEMPTS.inc(method=method)
                 policy.sleep(delay)
+        if shed:
+            raise RPCBackpressure(
+                f"rpc to {self.base}{path} shed after {attempts} "
+                f"attempts: {last_err}")
         raise RPCUnavailable(
             f"rpc to {self.base}{path} failed after {attempts} "
             f"attempts: {last_err}")
