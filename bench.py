@@ -888,6 +888,214 @@ def bench_fleetobs() -> dict:
     return out
 
 
+def bench_usage() -> dict:
+    """Usage-metering rung (docs/observability.md "Usage metering"):
+    four concurrent tenant clients (distinct tokens) against a
+    2-replica set.  Exit-gated on: all four tenant hashes present in
+    /debug/usage, the lane-second conservation invariant
+    (machine-asserted by snapshot()), usage_diff_vs_oracle=0 (scan
+    responses byte-identical to a TRIVY_TPU_USAGE=0 rerun), and the
+    <2% disabled-overhead guard.  Written to BENCH_usage.json."""
+    import hashlib as _hashlib
+    import statistics
+    import threading
+
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.fleet import telemetry as _telemetry
+    from trivy_tpu.fleet.endpoints import EndpointSet
+    from trivy_tpu.obs import attrib as _attrib
+    from trivy_tpu.obs import metrics as _obs_metrics
+    from trivy_tpu.obs import usage as _usage
+    from trivy_tpu.rpc import wire as _wire
+    from trivy_tpu.rpc.server import SCAN_PATH, Server
+    from trivy_tpu.tensorize.synth import synth_queries, synth_trivy_db
+    from trivy_tpu.types.scan import ScanOptions
+
+    n_replicas = 2
+    rounds = 2
+    tokens = [f"tenant-{i}-secret" for i in range(4)]
+    db = synth_trivy_db(n_advisories=4_000)
+    engine = MatchEngine(db, use_device=False)
+    pool = [q for q in synth_queries(db, 10_000, seed=7)
+            if q.space == "npm::"]
+    cache = MemoryCache()
+    rng = random.Random(11)
+    artifacts = []
+    for i in range(6):
+        pkgs = []
+        for _ in range(120):
+            q = pool[rng.randrange(len(pool))]
+            pkgs.append({"id": f"{q.name}@{q.version}", "name": q.name,
+                         "version": q.version})
+        key = f"sha256:us{i}"
+        cache.put_blob(key, {"schema_version": 2, "applications": [{
+            "type": "npm", "file_path": f"img{i}/package-lock.json",
+            "packages": pkgs}]})
+        artifacts.append((f"img{i}", key))
+
+    def run_workload() -> tuple[list, list, list]:
+        """One 4-tenant pass -> (response sha256s, per-scan walls,
+        replica addresses probed while live for federation)."""
+        servers = [Server(engine, cache, host="localhost", port=0)
+                   for _ in range(n_replicas)]
+        for srv in servers:
+            srv.start()
+        addrs = [srv.address for srv in servers]
+        hashes: list[str] = []
+        walls: list[float] = []
+        fed_doc: list[dict] = []
+        lock = threading.Lock()
+
+        def client(tok: str) -> None:
+            es = EndpointSet(addrs, token=tok, hedge_s=0,
+                             health_interval_s=0)
+            try:
+                for _ in range(rounds):
+                    for target, key in artifacts:
+                        t0 = time.time()
+                        body = es.post(SCAN_PATH, _wire.scan_request(
+                            target, "", [key], ScanOptions()))
+                        wall = time.time() - t0
+                        digest = _hashlib.sha256(body).hexdigest()
+                        with lock:
+                            walls.append(wall)
+                            hashes.append(digest)
+            finally:
+                es.close()
+
+        try:
+            threads = [threading.Thread(target=client, args=(tok,),
+                                        name=f"usage-client-{i}")
+                       for i, tok in enumerate(tokens)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            fed_doc.append(_telemetry.federate_usage_endpoints(addrs))
+        finally:
+            for srv in servers:
+                srv.shutdown()
+        return sorted(hashes), walls, fed_doc
+
+    # metered pass: fresh registries so conservation compares exactly
+    # the work this rung generates
+    _usage.USAGE.reset()
+    _attrib.AGG.reset()
+    for m in (_obs_metrics.ATTRIB_LANE_SECONDS, _obs_metrics.TENANT_SCANS,
+              _obs_metrics.TENANT_SHEDS, _obs_metrics.TENANT_QUERIES,
+              _obs_metrics.TENANT_ROWS_MATCHED,
+              _obs_metrics.TENANT_WIRE_BYTES,
+              _obs_metrics.TENANT_LANE_SECONDS):
+        m.clear()
+    t0 = time.time()
+    hashes_metered, walls, fed_docs = run_workload()
+    workload_wall = time.time() - t0
+    scan_wall = statistics.median(walls)
+    snap = _usage.USAGE.snapshot()
+
+    expected = {_usage.tenant_id(tok) for tok in tokens}
+    present = expected & set(snap["tenants"])
+    cons = snap["conservation"]
+    fed = fed_docs[0] if fed_docs else {}
+    fed_tenants = set((fed.get("fleet") or {}).get("tenants") or {})
+
+    out: dict = {
+        "replicas": n_replicas,
+        "tenants": len(tokens),
+        "scans": len(hashes_metered),
+        "scans_per_s": round(len(hashes_metered) / workload_wall, 2),
+        "median_scan_wall_ms": round(scan_wall * 1e3, 2),
+        "tenants_present": len(present),
+        "federated_tenants_present": len(expected & fed_tenants),
+        "federation_errors": len(fed.get("errors") or {}),
+        "conservation": {
+            "tenant_lane_s": round(cons["tenant_lane_s"], 6),
+            "attrib_lane_s": round(cons["attrib_lane_s"], 6),
+            "diff_s": round(cons["diff_s"], 9),
+            "ok": cons["ok"],
+        },
+        "tenant_scans_metric": {
+            t: _obs_metrics.TENANT_SCANS.value(tenant=t)
+            for t in sorted(present)},
+    }
+
+    # oracle pass: identical workload with metering killed — scan
+    # responses must be byte-identical (metering may never change what
+    # a tenant is told, only what is remembered about the telling)
+    old = os.environ.get("TRIVY_TPU_USAGE")
+    os.environ["TRIVY_TPU_USAGE"] = "0"
+    try:
+        hashes_oracle, _walls2, _fed2 = run_workload()
+    finally:
+        if old is None:
+            os.environ.pop("TRIVY_TPU_USAGE", None)
+        else:
+            os.environ["TRIVY_TPU_USAGE"] = old
+    out["usage_diff_vs_oracle"] = sum(
+        1 for a, b in zip(hashes_metered, hashes_oracle) if a != b
+    ) + abs(len(hashes_metered) - len(hashes_oracle))
+
+    # disabled-overhead guard: with TRIVY_TPU_USAGE=0 no scope exists,
+    # so every accrual is one contextvar read — min-of-8 interleaved
+    # against an empty-body callable of identical shape, expressed per
+    # scan over the ~12 accrual sites a scan touches
+    def noop(field, amount=1.0):
+        return None
+
+    n_calls = 50_000
+
+    def timed(fn) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            fn("scans", 1.0)
+        return time.perf_counter() - t0
+
+    os.environ["TRIVY_TPU_USAGE"] = "0"
+    try:
+        timed(noop), timed(_usage.add)  # warm
+        noop_t, disabled_t = [], []
+        for i in range(8):
+            if i % 2 == 0:
+                noop_t.append(timed(noop))
+                disabled_t.append(timed(_usage.add))
+            else:
+                disabled_t.append(timed(_usage.add))
+                noop_t.append(timed(noop))
+        disabled_ns = min(disabled_t) / n_calls * 1e9
+        noop_ns = min(noop_t) / n_calls * 1e9
+    finally:
+        if old is None:
+            os.environ.pop("TRIVY_TPU_USAGE", None)
+        else:
+            os.environ["TRIVY_TPU_USAGE"] = old
+    accrual_sites_per_scan = 12
+    overhead_pct = (max(disabled_ns - noop_ns, 0.0)
+                    * accrual_sites_per_scan / (scan_wall * 1e9) * 100.0)
+    out["usage_overhead"] = {
+        "disabled_ns_per_call": round(disabled_ns, 1),
+        "noop_ns_per_call": round(noop_ns, 1),
+        "per_scan_overhead_pct": round(overhead_pct, 4),
+        "ok": overhead_pct < 2.0,
+    }
+
+    fails = []
+    if out["tenants_present"] != len(tokens):
+        fails.append(f"tenants_present={out['tenants_present']}")
+    if out["federated_tenants_present"] != len(tokens):
+        fails.append("federated_tenants_present="
+                     f"{out['federated_tenants_present']}")
+    if not out["conservation"]["ok"]:
+        fails.append(f"conservation_diff_s={out['conservation']['diff_s']}")
+    if out["usage_diff_vs_oracle"]:
+        fails.append(f"usage_diff_vs_oracle={out['usage_diff_vs_oracle']}")
+    if not out["usage_overhead"]["ok"]:
+        fails.append(f"usage_overhead_pct={overhead_pct:.3f}")
+    if fails:
+        out["error"] = "usage gate failed: " + ", ".join(fails)
+    return out
+
+
 def bench_selfdrive() -> dict:
     """Self-driving rung (docs/fleet.md "Self-driving fleet"): a
     synthetic diurnal-load day against an in-process replica fleet.
@@ -2396,6 +2604,19 @@ def _run_supervised(device_status: str) -> int:
                    and '"platform": "none"' not in proc.stdout)
         sys.stdout.write(proc.stdout)
         sys.stdout.flush()
+        for line in proc.stdout.splitlines():
+            if '"metric"' not in line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if doc.get("metric") == "vuln_match_throughput" \
+                    and doc.get("value"):
+                _history_append("main", {
+                    "vuln_match_throughput_pkg_s": doc["value"],
+                    "platform": doc.get("platform", "unknown")})
+            break
         return proc.returncode
 
     first_env: dict = {}
@@ -2539,6 +2760,167 @@ def _phase_json_path() -> str | None:
     return os.environ.get("TRIVY_TPU_BENCH_PHASE_JSON") or None
 
 
+# ------------------------------------------------- bench trajectory
+
+# rung -> (headline metric name, which direction is better). --trend
+# compares each rung's latest BENCH_history.jsonl record against the
+# previous one and fails on a >20% regression of the headline.
+_TREND_HEADLINES = {
+    "main": ("vuln_match_throughput_pkg_s", "higher"),
+    "chaos": ("episodes_per_s", "higher"),
+    "dcn": ("dcn_pkg_per_s", "higher"),
+    "fleetobs": ("scrape_merge_wall_s_median", "lower"),
+    "selfdrive": ("wall_s", "lower"),
+    "usage": ("scans_per_s", "higher"),
+}
+_TREND_TOLERANCE = 0.20
+
+
+def _history_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_history.jsonl")
+
+
+def _git_sha() -> str:
+    import subprocess
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, timeout=10).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _history_seed_records() -> list[dict]:
+    """First-run seeding: reconstruct a trajectory from the BENCH_*.json
+    reports already in the tree (r01..r05 are successive records of the
+    'main' rung; each subsystem report seeds its own rung once)."""
+    root = os.path.dirname(os.path.abspath(__file__))
+
+    def load(name):
+        path = os.path.join(root, name)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    records = []
+    for i in range(1, 10):
+        doc = load(f"BENCH_r{i:02d}.json")
+        if doc is None:
+            continue
+        value = (doc.get("parsed") or {}).get("value")
+        if value is None:
+            continue
+        records.append({"rung": "main", "seeded_from": f"BENCH_r{i:02d}",
+                        "metrics": {"vuln_match_throughput_pkg_s": value}})
+    for rung, name, picker in (
+            ("chaos", "BENCH_chaos.json",
+             lambda d: {"episodes_per_s": d.get("episodes_per_s")}),
+            ("dcn", "BENCH_dcn.json",
+             lambda d: {"dcn_pkg_per_s": d.get("dcn_pkg_per_s")}),
+            ("fleetobs", "BENCH_fleetobs.json",
+             lambda d: {"scrape_merge_wall_s_median":
+                        (d.get("federation") or {}).get(
+                            "scrape_merge_wall_s_median")}),
+            ("selfdrive", "BENCH_selfdrive.json",
+             lambda d: {"wall_s": d.get("wall_s")}),
+            ("usage", "BENCH_usage.json",
+             lambda d: {"scans_per_s": d.get("scans_per_s")}),
+    ):
+        doc = load(name)
+        if doc is None:
+            continue
+        metrics = picker(doc)
+        if any(v is None for v in metrics.values()):
+            continue
+        records.append({"rung": rung, "seeded_from": name,
+                        "metrics": metrics})
+    return records
+
+
+def _history_load() -> list[dict]:
+    path = _history_path()
+    if not os.path.exists(path):
+        return []
+    records = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # a torn tail never blocks the trend gate
+    return records
+
+
+def _history_ensure_seeded() -> None:
+    path = _history_path()
+    if os.path.exists(path):
+        return
+    records = _history_seed_records()
+    sha = _git_sha()
+    now = time.time()
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps({**rec, "git_sha": sha, "ts": now},
+                               sort_keys=True) + "\n")
+
+
+def _history_append(rung: str, metrics: dict) -> None:
+    """Append one trajectory record (seeding the file from the existing
+    BENCH_*.json reports on first use). Best-effort: a bad disk never
+    fails the rung itself."""
+    try:
+        _history_ensure_seeded()
+        rec = {"rung": rung, "metrics": metrics, "git_sha": _git_sha(),
+               "ts": time.time()}
+        with open(_history_path(), "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    except OSError as exc:
+        print(f"BENCH_STATUS=history_unwritable {exc}", file=sys.stderr)
+
+
+def _trend_main() -> int:
+    """`bench.py --trend`: nonzero when any rung's latest headline
+    metric regressed >20% vs its previous BENCH_history.jsonl record.
+    Rungs with fewer than two records pass trivially (a trajectory
+    needs two points before it can regress)."""
+    _history_ensure_seeded()
+    records = _history_load()
+    rc = 0
+    for rung, (metric, better) in sorted(_TREND_HEADLINES.items()):
+        vals = [r["metrics"][metric] for r in records
+                if r.get("rung") == rung
+                and isinstance((r.get("metrics") or {}).get(metric),
+                               (int, float))]
+        if len(vals) < 2:
+            print(f"TREND {rung}: {len(vals)} record(s), no trend yet")
+            continue
+        prev, last = float(vals[-2]), float(vals[-1])
+        if better == "higher":
+            regressed = last < prev * (1.0 - _TREND_TOLERANCE)
+        else:
+            regressed = last > prev * (1.0 + _TREND_TOLERANCE)
+        arrow = "regressed" if regressed else "ok"
+        print(f"TREND {rung}: {metric} {prev:g} -> {last:g} "
+              f"({'higher' if better == 'higher' else 'lower'} is "
+              f"better) {arrow}")
+        if regressed:
+            print(f"BENCH_STATUS=trend_regression rung={rung} "
+                  f"{metric} {prev:g} -> {last:g} (>20%)",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def _lint_gate() -> int:
     """Run the project invariant linter (trivy_tpu/analysis) before the
     measurement: a lint regression fails verification even when every
@@ -2568,6 +2950,32 @@ def main():
         return _bench_capstone_child()
     if os.environ.get("TRIVY_TPU_BENCH_DCN_CHILD"):
         return _bench_dcn_child()
+    if "--trend" in sys.argv:
+        # trajectory gate only: no measurement, no lint — compares the
+        # latest BENCH_history.jsonl record per rung to its predecessor
+        return _trend_main()
+    if "--usage" in sys.argv:
+        # standalone usage-metering rung (CPU-only, no device probe):
+        # the quick way to refresh BENCH_usage.json.  Runs the
+        # invariant-lint gate like every supervised rung.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        lint_rc = _lint_gate()
+        detail = bench_usage()
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_usage.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(detail, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(detail, indent=2, sort_keys=True))
+        if not detail.get("error"):
+            _history_append("usage",
+                            {"scans_per_s": detail["scans_per_s"]})
+        else:
+            print(f"BENCH_STATUS=usage_gate_failed {detail['error']}",
+                  file=sys.stderr)
+        return 1 if (detail.get("error") or lint_rc) else 0
     if "--dcn" in sys.argv:
         # standalone cross-host serving rung (CPU-only; the
         # coordinator + worker subprocesses force their own virtual
@@ -2584,6 +2992,9 @@ def main():
         fails = dcn_gates(detail)
         for f_ in fails:
             print(f"BENCH_STATUS=dcn_gate_failed {f_}", file=sys.stderr)
+        if not fails:
+            _history_append("dcn", {"dcn_pkg_per_s":
+                                    detail.get("dcn_pkg_per_s", 0)})
         return 1 if (fails or lint_rc) else 0
     if "--chaos" in sys.argv:
         # standalone chaos-campaign rung (CPU-only): the quick way to
@@ -2612,6 +3023,9 @@ def main():
         for f_ in fails:
             print(f"BENCH_STATUS=chaos_gate_failed {f_}",
                   file=sys.stderr)
+        if not fails:
+            _history_append("chaos", {"episodes_per_s":
+                                      detail.get("episodes_per_s", 0)})
         return 1 if (fails or lint_rc) else 0
     if "--selfdrive" in sys.argv:
         # standalone self-driving-fleet rung (CPU-only, no device
@@ -2631,6 +3045,9 @@ def main():
         if detail.get("error"):
             print(f"BENCH_STATUS=selfdrive_gate_failed "
                   f"{detail['error']}", file=sys.stderr)
+        else:
+            _history_append("selfdrive",
+                            {"wall_s": detail.get("wall_s", 0)})
         return 1 if (detail.get("error") or lint_rc) else 0
     if "--fleetobs" in sys.argv:
         # standalone federation rung (CPU-only, no device probe): the
@@ -2645,6 +3062,11 @@ def main():
             json.dump(detail, f, indent=2, sort_keys=True)
             f.write("\n")
         print(json.dumps(detail, indent=2, sort_keys=True))
+        if not detail.get("error"):
+            _history_append("fleetobs", {
+                "scrape_merge_wall_s_median":
+                    (detail.get("federation") or {}).get(
+                        "scrape_merge_wall_s_median", 0)})
         return 1 if detail.get("error") else 0
     phase_json = _phase_json_path()
     if not os.environ.get("TRIVY_TPU_BENCH_CHILD"):

@@ -488,6 +488,34 @@ class TestRuleFixtures:
             ("good_action", "d"), ("other_action", "d")]
         assert run_rule(project, "event-kind") == []
 
+    def test_usage_field_fires(self, tmp_path):
+        """Seeded violations of the usage cost-vector coherence rule:
+        emitted-not-declared, computed field name, declared-never-
+        emitted, undocumented field (the full drift matrix lives in
+        tests/test_usage.py::TestUsageFieldRule)."""
+        project = make_project(
+            tmp_path,
+            {"rpc/srv.py": (
+                "from trivy_tpu.obs import usage\n"
+                "def f(name):\n"
+                "    usage.add('scans')\n"
+                "    usage.add('mystery')\n"
+                "    usage.add(name)\n")},
+            docs={"docs/observability.md": (
+                "# Observability\n\n"
+                "## Cost-vector fields\n\n"
+                "| field | meaning |\n|---|---|\n"
+                "| `scans` | scans |\n\n"
+                "## Next\n")})
+        project.declared_usage_fields = [
+            ("scans", "d"), ("sheds", "d")]
+        found = run_rule(project, "usage-field")
+        msgs = "\n".join(f.message for f in found)
+        assert "'mystery' emitted but not declared" in msgs
+        assert "must be a string literal" in msgs
+        assert "'sheds' declared in FIELDS but no" in msgs
+        assert "'sheds' missing from the" in msgs
+
     def test_bare_except_fires(self, tmp_path):
         project = make_project(tmp_path, {
             "x/handlers.py": (
@@ -613,7 +641,7 @@ class TestKnobs:
                 "TRIVY_TPU_SECRET_PROBE", "TRIVY_TPU_MONITOR",
                 "TRIVY_TPU_ATTRIB", "TRIVY_TPU_FLEET",
                 "TRIVY_TPU_FLEET_EVENTS",
-                "TRIVY_TPU_CONTROLLER"} == names
+                "TRIVY_TPU_CONTROLLER", "TRIVY_TPU_USAGE"} == names
 
     def test_write_knobs_doc_roundtrip(self, tmp_path, capsys):
         (tmp_path / "trivy_tpu").mkdir()

@@ -217,6 +217,22 @@ KNOBS: tuple[Knob, ...] = (
          "Dedicated auth token for the server's /debug/profile and "
          "/debug/flight endpoints (grants profiling access without "
          "the scan/cache token; the scan token always works too)."),
+    Knob("TRIVY_TPU_USAGE", "", "obs", True,
+         "Per-tenant usage metering (docs/observability.md 'Usage "
+         "metering'): unset/1 = on (the server opens a cost-vector "
+         "scope per request), 0 disables scope creation entirely — "
+         "every accrual call short-circuits on one contextvar read."),
+    Knob("TRIVY_TPU_USAGE_TOP_N", "64", "obs", False,
+         "Distinct tenants tracked by the usage registry and the "
+         "trivy_tpu_tenant_* metrics before new arrivals collapse "
+         "into the 'other' bucket (cardinality bound)."),
+    Knob("TRIVY_TPU_USAGE_JOURNAL", "", "obs", False,
+         "Path of the per-interval usage journal (durability/"
+         "appendlog format: torn-tail-tolerant replay, compaction); "
+         "empty disables journaling."),
+    Knob("TRIVY_TPU_USAGE_INTERVAL_S", "60", "obs", False,
+         "Seconds between cumulative usage-journal snapshots (the "
+         "journal also syncs once at server shutdown)."),
     # --- analysis (this package)
     Knob("TRIVY_TPU_LOCK_WITNESS", "", "analysis", False,
          "1 wraps the project's named locks in the lock-order witness "

@@ -74,6 +74,7 @@ class Project:
         self.declared_event_kinds = self._extract_event_kinds()
         self.declared_action_kinds = self._extract_action_kinds()
         self.declared_chaos_manifest = self._extract_chaos_manifest()
+        self.declared_usage_fields = self._extract_usage_fields()
 
     def _collect(self) -> None:
         pkg = os.path.join(self.root, "trivy_tpu")
@@ -258,6 +259,22 @@ class Project:
                 pass
         if self.file("trivy_tpu/chaos/scenarios.py") is not None:
             return {}  # present but unparsable: the rule flags it
+        return None
+
+    def _extract_usage_fields(self):
+        """Cost-vector field catalog from the LINTED tree's
+        obs/usage.py FIELDS table.  ``None`` means the tree has no
+        usage module — the usage-field rule then skips entirely (NO
+        import fallback: seeded mini-trees without the module keep
+        pre-metering rule behavior; tests override the attribute)."""
+        value = self._registry_assign("trivy_tpu/obs/usage.py", "FIELDS")
+        if value is not None:
+            try:
+                return [(n, d) for n, d in ast.literal_eval(value)]
+            except (ValueError, TypeError):
+                pass
+        if self.file("trivy_tpu/obs/usage.py") is not None:
+            return []  # present but unparsable: the rule flags it
         return None
 
     @staticmethod
@@ -1337,6 +1354,116 @@ class ChaosCoverageRule(Rule):
                     f"chaos scenario {name!r} missing from the "
                     'docs/resilience.md "Chaos campaigns" section '
                     "(expected backticked in the scenario table)")
+
+
+# ==================================================== 12. usage-field
+
+@register
+class UsageFieldRule(Rule):
+    id = "usage-field"
+    summary = ("usage cost-vector fields: emitted ⇔ usage.FIELDS ⇔ "
+               "docs/observability.md 'Cost-vector fields' catalog")
+    rationale = (
+        "Billing-adjacent data must not drift: a usage.add() of a "
+        "field the FIELDS registry does not declare is spend nobody "
+        "can interpret, a declared field nothing emits is a catalog "
+        "entry operators will query forever and always read zero, and "
+        "an undocumented field is a number tenants see on their bill "
+        "with no definition behind it. The registry is the single "
+        "source of truth and must stay a pure literal so this rule "
+        "(and the docs) can read it without importing the tree.")
+
+    USAGE_PY = "trivy_tpu/obs/usage.py"
+    DOC = "docs/observability.md"
+    SECTION_RX = re.compile(r"^#+\s*Cost-vector fields\s*$", re.M)
+    DOC_ROW_RX = re.compile(r"^\|\s*`([a-z0-9_]+)`", re.M)
+
+    def _fields_line(self, project: Project) -> int:
+        node = project._registry_assign(self.USAGE_PY, "FIELDS")
+        return getattr(node, "lineno", 1)
+
+    @staticmethod
+    def _emitted(project: Project):
+        """(field, path, line) for every literal usage.add()/add_to()
+        call site; add_lanes() call sites anchor the ``lane_s``
+        conservation field (attrib hands a whole lane dict over, so
+        no literal field name appears there)."""
+        for pf in project.files():
+            if pf.relpath == UsageFieldRule.USAGE_PY:
+                continue  # the registry's own module
+            for node in ast.walk(pf.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "usage"):
+                    continue
+                if node.func.attr == "add" and node.args:
+                    yield (_const_str(node.args[0]), pf.relpath,
+                           node.lineno)
+                elif node.func.attr == "add_to" and len(node.args) >= 2:
+                    yield (_const_str(node.args[1]), pf.relpath,
+                           node.lineno)
+                elif node.func.attr == "add_lanes":
+                    yield ("lane_s", pf.relpath, node.lineno)
+
+    def check(self, project: Project):
+        declared_pairs = getattr(project, "declared_usage_fields", None)
+        if declared_pairs is None:
+            return  # tree has no usage module
+        line = self._fields_line(project)
+        if not declared_pairs:
+            yield Finding(
+                self.id, self.USAGE_PY, line,
+                "obs.usage.FIELDS is missing or not a pure literal — "
+                "the cost-vector catalog must be exported as "
+                "structured data")
+            return
+        declared = {n for n, _d in declared_pairs}
+        emitted: dict[str, tuple[str, int]] = {}
+        for field, path, lineno in self._emitted(project):
+            if field is None:
+                yield Finding(
+                    self.id, path, lineno,
+                    "usage field name must be a string literal — a "
+                    "computed field defeats the catalog check")
+                continue
+            emitted.setdefault(field, (path, lineno))
+            if field not in declared:
+                yield Finding(
+                    self.id, path, lineno,
+                    f"usage field {field!r} emitted but not declared "
+                    "in obs.usage.FIELDS")
+        for field in sorted(declared - set(emitted)):
+            yield Finding(
+                self.id, self.USAGE_PY, line,
+                f"usage field {field!r} declared in FIELDS but no "
+                "code emits it — operators will query it forever and "
+                "always read zero")
+        doc = project.doc_text(self.DOC)
+        if doc is None:
+            return  # the metric-name rule owns the doc's existence
+        m = self.SECTION_RX.search(doc)
+        if m is None:
+            yield Finding(
+                self.id, self.DOC, 1,
+                'docs/observability.md has no "Cost-vector fields" '
+                "section — the usage catalog must be documented")
+            return
+        section = doc[m.end():]
+        nxt = re.search(r"^#+ ", section, re.M)
+        if nxt is not None:
+            section = section[:nxt.start()]
+        doc_fields = set(self.DOC_ROW_RX.findall(section))
+        for field in sorted(declared - doc_fields):
+            yield Finding(
+                self.id, self.DOC, 1,
+                f"usage field {field!r} missing from the "
+                '"Cost-vector fields" table')
+        for field in sorted(doc_fields - declared):
+            yield Finding(
+                self.id, self.DOC, 1,
+                f'"Cost-vector fields" table documents {field!r} but '
+                "obs.usage.FIELDS does not declare it")
 
 
 # ----------------------------------------------------------- the driver

@@ -568,6 +568,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "and stitched into ONE trace (per-replica "
                         "process rows, hedge losers marked cancelled)")
 
+    p = sub.add_parser(
+        "usage", help="fetch a live server's per-tenant usage metering "
+        "(/debug/usage): cost vectors per tenant hash, fleet totals, "
+        "and the lane-second conservation check; a comma-separated URL "
+        "federates a replica set (docs/observability.md 'Usage "
+        "metering')", allow_abbrev=False)
+    _add_global_flags(p)
+    p.add_argument("server", nargs="?", default=None,
+                   help="scan server URL (e.g. http://localhost:4954); "
+                        "a comma-separated list federates the whole "
+                        "replica set; omit with --journal to read a "
+                        "usage journal file instead")
+    p.add_argument("--token", default=None,
+                   help="server auth token (or the dedicated "
+                        "TRIVY_TPU_PROFILE_TOKEN)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw usage document")
+    p.add_argument("--top", type=int, default=None, metavar="K",
+                   help="show only the K tenants with the most "
+                        "lane-seconds (default: all)")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="render the last durable snapshot from a usage "
+                        "journal (TRIVY_TPU_USAGE_JOURNAL) instead of "
+                        "querying a live server")
+
     p = sub.add_parser("db", help="advisory DB operations", allow_abbrev=False)
     _add_global_flags(p)
     dbsub = p.add_subparsers(dest="db_command")
@@ -728,7 +753,7 @@ def main(argv: list[str] | None = None) -> int:
     known = {"image", "filesystem", "fs", "rootfs", "repository", "repo",
              "sbom", "vm", "kubernetes", "k8s", "convert", "server", "db",
              "clean", "config", "version", "registry", "plugin", "module",
-             "lint", "watch", "profile", "fleet", "chaos"}
+             "lint", "watch", "profile", "usage", "fleet", "chaos"}
     if argv and not argv[0].startswith("-") and argv[0] not in known:
         from trivy_tpu.plugin import PluginManager
 
@@ -792,6 +817,8 @@ def main(argv: list[str] | None = None) -> int:
             return run.run_watch(args)
         if args.command == "profile":
             return run.run_profile(args)
+        if args.command == "usage":
+            return run.run_usage(args)
         if args.command == "fleet":
             return run.run_fleet_admin(args)
         if args.command == "db":

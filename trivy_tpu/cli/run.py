@@ -1376,6 +1376,108 @@ def run_profile(args) -> int:
     return 0
 
 
+def _render_usage_table(tenants: dict, top: int | None) -> None:
+    """Per-tenant cost-vector table, ordered by lane-seconds (the
+    field closest to 'who is spending the fleet')."""
+    rows = []
+    for tenant, rec in tenants.items():
+        f = rec.get("fields") or {}
+        lane_s = sum((rec.get("lanes") or {}).values())
+        rows.append((tenant, f, lane_s))
+    rows.sort(key=lambda r: (-r[2], r[0]))
+    if top is not None:
+        rows = rows[:max(top, 0)]
+    print(f"{'tenant':<20} {'scans':>7} {'sheds':>6} {'queries':>9} "
+          f"{'rows':>10} {'MB in':>8} {'MB out':>8} {'lane s':>9}")
+    for tenant, f, lane_s in rows:
+        print(f"{tenant:<20} {f.get('scans', 0.0):>7.0f} "
+              f"{f.get('sheds', 0.0):>6.0f} "
+              f"{f.get('queries', 0.0):>9.0f} "
+              f"{f.get('rows_matched', 0.0):>10.0f} "
+              f"{f.get('wire_bytes_in', 0.0) / 1e6:>8.3f} "
+              f"{f.get('wire_bytes_out', 0.0) / 1e6:>8.3f} "
+              f"{lane_s:>9.3f}")
+
+
+def run_usage(args) -> int:
+    """`trivy-tpu usage URL[,URL2]`: render per-tenant usage metering
+    (docs/observability.md "Usage metering") — one cost-vector row per
+    tenant hash, fleet totals, and the lane-second conservation check.
+    A comma-separated URL federates the replica set (tenant vectors
+    summed — hashes are replica-independent); `--journal PATH` renders
+    the last durable snapshot from a usage journal instead."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    top = getattr(args, "top", None)
+    journal = getattr(args, "journal", None)
+    if journal:
+        from trivy_tpu.obs import usage as usage_mod
+
+        doc = usage_mod.replay_journal(journal)
+        if getattr(args, "json", False):
+            print(_json.dumps(doc, indent=2, sort_keys=True))
+            return 0
+        print(f"usage journal: {journal}")
+        _render_usage_table(doc.get("tenants") or {}, top)
+        return 0
+    if not getattr(args, "server", None):
+        raise FatalError("usage: provide a server URL or --journal PATH")
+
+    from trivy_tpu.fleet.endpoints import split_urls
+
+    endpoints = [u if u.startswith("http") else "http://" + u
+                 for u in split_urls(args.server)]
+    token = getattr(args, "token", None) \
+        or os.environ.get("TRIVY_TPU_PROFILE_TOKEN")
+    if len(endpoints) > 1:
+        from trivy_tpu.fleet import telemetry as _telemetry
+
+        doc = _telemetry.federate_usage_endpoints(endpoints, token=token)
+        if getattr(args, "json", False):
+            print(_json.dumps(doc, indent=2, sort_keys=True))
+            return 0 if not doc.get("errors") else 1
+        fleet = doc.get("fleet") or {}
+        print(f"fleet usage ({len(endpoints)} replicas, "
+              f"{len(fleet.get('tenants') or {})} tenants)")
+        _render_usage_table(fleet.get("tenants") or {}, top)
+        cons = fleet.get("conservation") or {}
+        print(f"conservation: tenant lane-seconds "
+              f"{cons.get('tenant_lane_s', 0.0):.3f} vs attribution "
+              f"{cons.get('attrib_lane_s', 0.0):.3f} — "
+              f"{'OK' if cons.get('ok') else 'VIOLATION'}")
+        for ep, err in (doc.get("errors") or {}).items():
+            print(f"usage fetch failed: {ep}: {err}", file=sys.stderr)
+        return 0 if not doc.get("errors") and cons.get("ok", True) else 1
+
+    base = endpoints[0].rstrip("/")
+    req = urllib.request.Request(base + "/debug/usage")
+    if token:
+        req.add_header("Trivy-Token", token)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            doc = _json.loads(r.read().decode())
+    except urllib.error.URLError as e:
+        raise FatalError(f"usage fetch failed: {e}")
+    if getattr(args, "json", False):
+        print(_json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    if not doc.get("enabled", True) and not doc.get("tenants"):
+        print("usage metering disabled on this server "
+              "(TRIVY_TPU_USAGE=0) or no scans observed yet")
+        return 0
+    print(f"usage ({len(doc.get('tenants') or {})} tenants, "
+          f"top-N {doc.get('top_n', 0)})")
+    _render_usage_table(doc.get("tenants") or {}, top)
+    cons = doc.get("conservation") or {}
+    print(f"conservation: tenant lane-seconds "
+          f"{cons.get('tenant_lane_s', 0.0):.3f} vs attribution "
+          f"{cons.get('attrib_lane_s', 0.0):.3f} — "
+          f"{'OK' if cons.get('ok') else 'VIOLATION'}")
+    return 0 if cons.get("ok", True) else 1
+
+
 def run_db(args) -> int:
     from trivy_tpu.db.store import AdvisoryDB
 

@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 from trivy_tpu.db.store import AdvisoryDB
 from trivy_tpu.detector.exact import AdvisoryChecker
 from trivy_tpu.log import logger
+from trivy_tpu.obs import usage
 from trivy_tpu.resilience import faults
 from trivy_tpu.tensorize.compile import CompiledDB, compile_db, space_of_bucket
 from trivy_tpu.utils.hashing import join_key
@@ -68,6 +69,18 @@ def finding_keys(advisories, results) -> set[tuple]:
         (r.query.space, r.query.name, r.query.version,
          r.query.scheme_name, advisories[i][2].vulnerability_id)
         for r in results for i in r.adv_indices}
+
+
+def _meter_rows(results: list[MatchResult]) -> list[MatchResult]:
+    """Accrue matched device rows to the ambient usage scope (no-op for
+    the CLI's scope-less calls). Called only at detect()'s return sites
+    — submit()/match_keys funnel through detect(), and the DeviceLost
+    re-entry accrues in the inner call — so rows are never
+    double-counted."""
+    if usage.ambient() is not None:
+        usage.add("rows_matched",
+                  float(sum(len(r.adv_indices) for r in results)))
+    return results
 
 
 class MatchEngine:
@@ -430,9 +443,10 @@ class MatchEngine:
             uniq, idx_map = self.dedupe_queries(queries)
             if len(uniq) < len(queries):
                 u = self.oracle_detect(uniq)
-                return [MatchResult(q, u[idx_map[j]].adv_indices)
-                        for j, q in enumerate(queries)]
-            return self.oracle_detect(queries)
+                return _meter_rows(
+                    [MatchResult(q, u[idx_map[j]].adv_indices)
+                     for j, q in enumerate(queries)])
+            return _meter_rows(self.oracle_detect(queries))
 
         try:
             faults.check_device("engine")
@@ -452,7 +466,7 @@ class MatchEngine:
         # the RPC server's production scan path goes through detect(),
         # not detect_many(): bound the memos here too
         self._enforce_memo_bounds()
-        return out
+        return _meter_rows(out)
 
     def submit(self, query_lists: list[list[PkgQuery]]
                ) -> list[list[MatchResult]]:

@@ -52,6 +52,7 @@ import time
 from trivy_tpu.log import logger
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import tracing
+from trivy_tpu.obs import usage
 from trivy_tpu.resilience import faults
 
 _log = logger("fanal.pipeline")
@@ -330,18 +331,21 @@ def run_layer_pipeline(items: list, fetch, process,
         t0 = time.perf_counter()
         with tracing.span(FETCH_SITE, layers=1):
             payload = fetch_with_retry(lambda: fetch(items[0]))
+        usage.add("layers_fetched")
         stats["fetch_busy_s"] = time.perf_counter() - t0
         t0 = time.perf_counter()
         with tracing.span("analysis.walk", layers=1):
             process(items[0], payload)
+        usage.add("layers_analyzed")
         stats["walk_busy_s"] = time.perf_counter() - t0
     else:
         out: queue.Queue = queue.Queue(maxsize=max(depth - 1, 1))
         stop = threading.Event()
         trace_ctx = tracing.capture()
+        usage_ctx = usage.capture()
 
         def fetch_lane():
-            with tracing.adopt(trace_ctx):
+            with tracing.adopt(trace_ctx), usage.adopt(usage_ctx):
                 for item in items:
                     if stop.is_set():
                         return
@@ -353,6 +357,7 @@ def run_layer_pipeline(items: list, fetch, process,
                         stats["fetch_busy_s"] += time.perf_counter() - t0
                         _put_interruptible(out, (item, exc, True), stop)
                         return
+                    usage.add("layers_fetched")
                     stats["fetch_busy_s"] += time.perf_counter() - t0
                     if not _put_interruptible(out, (item, payload, False),
                                               stop):
@@ -388,6 +393,7 @@ def run_layer_pipeline(items: list, fetch, process,
                 t0 = time.perf_counter()
                 with tracing.span("analysis.walk"):
                     process(item, payload)
+                usage.add("layers_analyzed")
                 stats["walk_busy_s"] += time.perf_counter() - t0
         finally:
             stop.set()

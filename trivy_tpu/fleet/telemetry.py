@@ -102,6 +102,12 @@ def fetch_flight(url: str, token: str | None = None,
                            token=token, timeout=timeout))
 
 
+def fetch_usage(url: str, token: str | None = None,
+                timeout: float = 10.0) -> dict:
+    return json.loads(_get(url.rstrip("/") + "/debug/usage",
+                           token=token, timeout=timeout))
+
+
 # -------------------------------------------------------------- parsing
 
 
@@ -403,6 +409,71 @@ def federate_profiles(profiles: list) -> dict:
             "verdict": verdict,
         },
     }
+
+
+# ---------------------------------------------------- usage federation
+
+
+def federate_usage(usages: list) -> dict:
+    """``[(replica_label, /debug/usage doc), ...]`` -> the fleet usage
+    document: per-tenant cost vectors summed across replicas (tenant
+    hashes are replica-independent — the same token hashes identically
+    everywhere, so cross-replica summing is exact), per-replica
+    sub-docs, fleet totals, and a conservation roll-up that is the SUM
+    of the replica-local comparisons (each replica checks its own
+    tenant-lane-seconds against its own attribution spine; the fleet
+    view just reports whether every replica held)."""
+    tenants: dict[str, dict] = {}
+    totals: dict = {"fields": {}, "lanes": {}}
+    replicas = {}
+    tenant_lane_s = attrib_lane_s = 0.0
+    ok = True
+    for label, doc in usages:
+        replicas[label] = doc
+        for tenant, rec in (doc.get("tenants") or {}).items():
+            slot = tenants.setdefault(tenant, {"fields": {}, "lanes": {}})
+            for k, v in (rec.get("fields") or {}).items():
+                slot["fields"][k] = slot["fields"].get(k, 0.0) + v
+                totals["fields"][k] = totals["fields"].get(k, 0.0) + v
+            for k, v in (rec.get("lanes") or {}).items():
+                slot["lanes"][k] = slot["lanes"].get(k, 0.0) + v
+                totals["lanes"][k] = totals["lanes"].get(k, 0.0) + v
+        cons = doc.get("conservation") or {}
+        tenant_lane_s += cons.get("tenant_lane_s", 0.0)
+        attrib_lane_s += cons.get("attrib_lane_s", 0.0)
+        if cons and not cons.get("ok", True):
+            ok = False
+    return {
+        "replicas": replicas,
+        "fleet": {
+            "tenants": tenants,
+            "totals": totals,
+            "conservation": {
+                "tenant_lane_s": round(tenant_lane_s, 6),
+                "attrib_lane_s": round(attrib_lane_s, 6),
+                "ok": ok,
+            },
+        },
+    }
+
+
+def federate_usage_endpoints(endpoints: list, token: str | None = None,
+                             timeout: float = 10.0) -> dict:
+    """Fetch + merge every replica's /debug/usage; unreachable replicas
+    are reported in ``errors`` instead of failing the federation."""
+    usages = []
+    errors = {}
+    for ep in endpoints:
+        ep = ep.rstrip("/")
+        try:
+            usages.append((ep, fetch_usage(ep, token=token,
+                                           timeout=timeout)))
+        except FederationError as exc:
+            errors[ep] = str(exc)
+            _log.warn("usage fetch failed", endpoint=ep, err=str(exc))
+    doc = federate_usage(usages)
+    doc["errors"] = errors
+    return doc
 
 
 # ------------------------------------------------------ trace stitching
@@ -708,6 +779,10 @@ def _make_fed_handler(server: "FederationServer"):
                     if server.monitor is not None:
                         doc["slo"] = server.monitor.engine.evaluate()
                     self._reply(200, json.dumps(doc).encode())
+                elif self.path.startswith("/usage"):
+                    self._reply(200, json.dumps(federate_usage_endpoints(
+                        server.endpoints,
+                        token=server.upstream_token)).encode())
                 elif self.path.startswith("/flight"):
                     self._reply(200, json.dumps(stitch_endpoints(
                         server.endpoints,

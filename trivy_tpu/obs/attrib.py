@@ -45,6 +45,7 @@ from collections import deque
 from trivy_tpu.analysis.witness import make_lock
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import tracing
+from trivy_tpu.obs import usage
 
 # ------------------------------------------------------------ taxonomy
 
@@ -406,6 +407,12 @@ class Aggregator:
             if v > 0:
                 obs_metrics.ATTRIB_LANE_SECONDS.inc(v, lane=lane,
                                                     kind="busy")
+        # conservation hook: the same busy vector the attribution spine
+        # just counted is handed to usage metering on this thread — the
+        # one that closed the root span, where the request's tenant
+        # scope is still ambient — so per-tenant lane-seconds sum to
+        # the fleet attribution totals by construction
+        usage.add_lanes(rec["busy"])
         for lane, v in rec["crit"].items():
             if v > 0:
                 obs_metrics.ATTRIB_LANE_SECONDS.inc(v, lane=lane,

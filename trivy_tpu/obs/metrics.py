@@ -79,12 +79,15 @@ class _Metric:
     kind = "untyped"
 
     def __init__(self, registry: "Registry", name: str, help_text: str,
-                 labels: tuple[str, ...], max_series: int):
+                 labels: tuple[str, ...], max_series: int,
+                 collapse_label: tuple[str, int] | None = None):
         self.registry = registry
         self.name = name
         self.help = help_text
         self.label_names = labels
         self.max_series = max_series
+        self.collapse_label = collapse_label
+        self._collapse_seen: set[str] = set()
         self._series: dict[tuple[str, ...], object] = {}
 
     def _materialize_unlabeled(self) -> None:
@@ -101,11 +104,37 @@ class _Metric:
                 f"{sorted(self.label_names)!r}")
         return tuple(str(labels[n]) for n in self.label_names)
 
+    def _collapse(self, key: tuple[str, ...],
+                  register: bool = True) -> tuple[str, ...]:
+        """Top-N-collapse policy: once `collapse_label=(name, N)` has
+        seen N distinct values for that label, every new value is
+        rewritten to "other" instead of growing a fresh series — an
+        unbounded public label (e.g. tenant) can then never trip
+        CardinalityError. No collapse_label (the default) leaves the
+        key — and the legacy exposition bytes — untouched.
+        `register=False` applies the rewrite without admitting a new
+        value (reads must not consume top-N slots)."""
+        if self.collapse_label is None:
+            return key
+        lname, n = self.collapse_label
+        try:
+            i = self.label_names.index(lname)
+        except ValueError:
+            return key
+        v = key[i]
+        if v == "other" or v in self._collapse_seen:
+            return key
+        if len(self._collapse_seen) >= n:
+            return key[:i] + ("other",) + key[i + 1:]
+        if register:
+            self._collapse_seen.add(v)
+        return key
+
     def _slot(self, labels: dict) -> tuple[str, ...]:
         """Get-or-create the series state for a label set; returns the
         series key (the one label-validation pass per update). Caller
         holds the registry lock."""
-        key = self._key(labels)
+        key = self._collapse(self._key(labels))
         if key not in self._series:
             if len(self._series) >= self.max_series:
                 raise CardinalityError(
@@ -120,6 +149,7 @@ class _Metric:
     def clear(self) -> None:
         with self.registry._lock:
             self._series.clear()
+            self._collapse_seen.clear()
 
     # rendering -------------------------------------------------------
 
@@ -168,7 +198,8 @@ class Counter(_Metric):
 
     def value(self, **labels) -> float:
         with self.registry._lock:
-            return float(self._series.get(self._key(labels), 0.0))
+            key = self._collapse(self._key(labels), register=False)
+            return float(self._series.get(key, 0.0))
 
 
 class Gauge(_Metric):
@@ -346,13 +377,17 @@ class Registry:
 
     def counter(self, name: str, help_text: str,
                 labels: tuple[str, ...] = (),
-                max_series: int = DEFAULT_MAX_SERIES) -> Counter:
-        return self._register(Counter, name, help_text, labels, max_series)
+                max_series: int = DEFAULT_MAX_SERIES,
+                collapse_label: tuple[str, int] | None = None) -> Counter:
+        return self._register(Counter, name, help_text, labels, max_series,
+                              collapse_label=collapse_label)
 
     def gauge(self, name: str, help_text: str,
               labels: tuple[str, ...] = (),
-              max_series: int = DEFAULT_MAX_SERIES) -> Gauge:
-        return self._register(Gauge, name, help_text, labels, max_series)
+              max_series: int = DEFAULT_MAX_SERIES,
+              collapse_label: tuple[str, int] | None = None) -> Gauge:
+        return self._register(Gauge, name, help_text, labels, max_series,
+                              collapse_label=collapse_label)
 
     def histogram(self, name: str, help_text: str,
                   labels: tuple[str, ...] = (),
@@ -698,3 +733,58 @@ ATTRIB_LANE_SECONDS = REGISTRY.counter(
     "critical-path partition) — docs/observability.md "
     "'Attribution & profiling'",
     labels=("lane", "kind"))
+
+
+def _tenant_top_n() -> int:
+    """Tenant-label collapse bound (TRIVY_TPU_USAGE_TOP_N, read once
+    at import): the tenant label is attacker-controlled on a public
+    server, so every tenant metric collapses past this many distinct
+    values instead of risking CardinalityError."""
+    import os
+    try:
+        return max(1, int(os.environ.get("TRIVY_TPU_USAGE_TOP_N", "")
+                          or 64))
+    except ValueError:
+        return 64
+
+
+TENANT_SCANS = REGISTRY.counter(
+    "trivy_tpu_tenant_scans_total",
+    "Scan RPCs served to completion per tenant (tenant = 16-hex-char "
+    "SHA-256 prefix of the auth token; 'anonymous' = no token, "
+    "'other' = beyond the TRIVY_TPU_USAGE_TOP_N collapse bound) — "
+    "docs/observability.md 'Usage metering'",
+    labels=("tenant",),
+    collapse_label=("tenant", _tenant_top_n()))
+TENANT_SHEDS = REGISTRY.counter(
+    "trivy_tpu_tenant_sheds_total",
+    "Requests shed with 503 per tenant (overload, deadline expiry, "
+    "draining) — shed demand is metered so overload cannot hide a "
+    "tenant's load",
+    labels=("tenant",),
+    collapse_label=("tenant", _tenant_top_n()))
+TENANT_QUERIES = REGISTRY.counter(
+    "trivy_tpu_tenant_queries_total",
+    "Rows submitted to the match/secret schedulers per tenant",
+    labels=("tenant",),
+    collapse_label=("tenant", _tenant_top_n()))
+TENANT_ROWS_MATCHED = REGISTRY.counter(
+    "trivy_tpu_tenant_rows_matched_total",
+    "Device advisory rows matched per tenant",
+    labels=("tenant",),
+    collapse_label=("tenant", _tenant_top_n()))
+TENANT_WIRE_BYTES = REGISTRY.counter(
+    "trivy_tpu_tenant_wire_bytes_total",
+    "Bytes on the RPC wire per tenant and direction (post-gzip; the "
+    "pre-compression payload bytes live in the /debug/usage cost "
+    "vector)",
+    labels=("tenant", "direction"),
+    collapse_label=("tenant", _tenant_top_n()))
+TENANT_LANE_SECONDS = REGISTRY.counter(
+    "trivy_tpu_tenant_lane_seconds_total",
+    "Attribution-lane busy seconds per tenant — conservation "
+    "invariant: summed over tenants this equals "
+    "trivy_tpu_attrib_lane_seconds_total{kind='busy'} per lane "
+    "(machine-asserted by /debug/usage and bench.py --usage)",
+    labels=("tenant", "lane"),
+    collapse_label=("tenant", _tenant_top_n()))

@@ -37,6 +37,7 @@ from urllib.parse import urlsplit
 from trivy_tpu.log import logger
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import tracing
+from trivy_tpu.obs import usage
 from trivy_tpu.resilience import faults
 from trivy_tpu.resilience.retry import (
     DEADLINE_HEADER,
@@ -330,6 +331,11 @@ class _Conn:
             if self._server_gzip and len(body) >= wire.GZIP_MIN_BYTES:
                 send_body = wire.gzip_bytes(body)
                 hdrs["Content-Encoding"] = "gzip"
+            # client-side cost vector (no-ops without an ambient usage
+            # scope): payload bytes pre-gzip, wire bytes post-gzip,
+            # accrued per attempt — retries really do re-ship bytes
+            usage.add("bytes_out", float(len(body)))
+            usage.add("wire_bytes_out", float(len(send_body)))
             retry_after: float | None = None
             corrupt = False
             try:
@@ -370,9 +376,11 @@ class _Conn:
                         else None)
                 if rhdrs.get(wire.GZIP_CAPABLE_HEADER):
                     self._server_gzip = True
+                usage.add("wire_bytes_in", float(len(raw)))
                 if "gzip" in (rhdrs.get("Content-Encoding")
                               or "").lower():
                     raw = wire.gunzip_bytes(raw)
+                usage.add("bytes_in", float(len(raw)))
                 if status >= 300:
                     # non-2xx is an error, named by status: 3xx included
                     # (a redirecting ingress is a config problem this

@@ -30,6 +30,7 @@ import trivy_tpu
 from trivy_tpu.log import logger
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import tracing
+from trivy_tpu.obs import usage
 from trivy_tpu.resilience.retry import (
     DEADLINE_HEADER,
     Deadline,
@@ -597,6 +598,7 @@ class ScanService:
                     out.add(b)
                 else:
                     obs_metrics.LAYER_DEDUPE_HITS.inc()
+                    usage.add("layers_deduped")
         return [b for b in missing if b in out]
 
     def scan(self, target, artifact_key, blob_keys, options,
@@ -833,9 +835,11 @@ def _make_handler(service: ScanService, token: str | None,
             # plain byte-identical wire)
             accept = (self.headers.get("Accept-Encoding") or "").lower()
             encoding = None
+            usage.add("bytes_out", float(len(body)))
             if "gzip" in accept and len(body) >= wire.GZIP_MIN_BYTES:
                 body = wire.gzip_bytes(body)
                 encoding = "gzip"
+            usage.add("wire_bytes_out", float(len(body)))
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
@@ -850,6 +854,9 @@ def _make_handler(service: ScanService, token: str | None,
         def _shed(self, msg: str, retry_after: float):
             """503 + Retry-After: the reply a well-behaved client backs
             off on instead of hammering a busy server."""
+            # every shed REPLY meters the tenant exactly once — shed
+            # demand must stay visible per tenant even under overload
+            usage.add("sheds")
             self._reply(
                 503, json.dumps({"error": msg}).encode(),
                 extra_headers={"Retry-After": f"{max(retry_after, 0.0):g}"})
@@ -888,6 +895,13 @@ def _make_handler(service: ScanService, token: str | None,
                 elif self.path.startswith("/debug/flight"):
                     self._reply(200, json.dumps(
                         attrib.AGG.flight.chrome_doc()).encode())
+                elif self.path.startswith("/debug/usage"):
+                    # per-tenant cost vectors + the machine-checked
+                    # conservation totals (docs/observability.md
+                    # "Usage metering"); tenants are token hashes,
+                    # never raw tokens
+                    self._reply(200, json.dumps(
+                        usage.USAGE.snapshot()).encode())
                 else:
                     self._error(404, "not found")
                 return
@@ -959,8 +973,21 @@ def _make_handler(service: ScanService, token: str | None,
             if not self._authed():
                 self._error(401, "invalid token")
                 return
+            # usage metering scope: the whole admitted request — scan,
+            # cache, and fleet POSTs alike — accrues its cost vector to
+            # the tenant hashed from the auth token (never the raw
+            # token; no token = the anonymous bucket). The scope is
+            # ambient on this handler thread, follows worker threads
+            # via capture/adopt, and folds into the per-tenant registry
+            # on exit. TRIVY_TPU_USAGE=0 makes this a no-op.
+            with usage.scope(usage.tenant_id(
+                    self.headers.get("Trivy-Token"))):
+                self._post_metered()
+
+        def _post_metered(self):
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length)
+            usage.add("wire_bytes_in", float(len(body)))
             if "gzip" in (self.headers.get("Content-Encoding")
                           or "").lower():
                 try:
@@ -969,6 +996,7 @@ def _make_handler(service: ScanService, token: str | None,
                     # deterministic decode failure: never retried
                     self._error(400, f"bad request body: {exc}")
                     return
+            usage.add("bytes_in", float(len(body)))
             if self.path.startswith("/twirp/") and \
                     self.headers.get("X-Trivy-Tpu-Wire") != "internal":
                 # reference wire protocol (Twirp protobuf / proto3-JSON).
@@ -1046,6 +1074,7 @@ def _make_handler(service: ScanService, token: str | None,
                     _log.warn("scan shed mid-flight", err=str(exc))
                     self._shed(str(exc), 1.0)
                     return
+            usage.add("scans")
             self._reply(200, wire.scan_response(results, os_found))
 
         def _handle_fleet(self, method: str, body: bytes):
@@ -1109,9 +1138,13 @@ def _make_handler(service: ScanService, token: str | None,
                 service.layer_gate.complete(doc["diff_id"])
                 self._reply(200, b"{}")
             elif method == "MissingBlobs":
+                blob_ids = doc.get("blob_ids") or []
                 missing_artifact, missing_blobs = cache.missing_blobs(
-                    doc["artifact_id"], doc.get("blob_ids") or []
+                    doc["artifact_id"], blob_ids
                 )
+                usage.add("cache_hits",
+                          float(len(blob_ids) - len(missing_blobs)))
+                usage.add("cache_misses", float(len(missing_blobs)))
                 if missing_blobs:
                     from trivy_tpu.fanal import pipeline as _analysis
 
@@ -1225,6 +1258,9 @@ class Server:
 
             self._attrib_held = False
             attrib.release()
+        # flush a final usage-journal snapshot (no-op when
+        # TRIVY_TPU_USAGE_JOURNAL is unset)
+        usage.USAGE.journal_sync()
         self._stop.set()
         if self.service.scheduler is not None:
             # after the drain budget: the scheduler finishes whatever

@@ -64,6 +64,7 @@ import time
 from trivy_tpu.log import logger
 from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.obs import tracing
+from trivy_tpu.obs import usage
 from trivy_tpu.resilience import faults
 from trivy_tpu.resilience.retry import current_deadline
 
@@ -104,8 +105,8 @@ class _Pending:
     """One submitted request: queries, chunk cursor, result slots."""
 
     __slots__ = ("queries", "results", "next_row", "inflight", "deadline",
-                 "arrival", "seq", "trace_ctx", "error", "done",
-                 "dispatched_at")
+                 "arrival", "seq", "trace_ctx", "usage_ctx", "error",
+                 "done", "dispatched_at")
 
     def __init__(self, queries: list, deadline, seq: int):
         self.queries = queries
@@ -118,6 +119,10 @@ class _Pending:
         # captured so the batch span in the scheduler thread can attach
         # to this request's trace instead of becoming an orphaned root
         self.trace_ctx = tracing.capture()
+        # usage twin: queue-wait seconds accrue per pending request
+        # from the scheduler thread, and the batch dispatch re-adopts
+        # the lead request's tenant scope (obs/usage.py)
+        self.usage_ctx = usage.capture()
         self.error: Exception | None = None
         self.done = threading.Event()
         self.dispatched_at: float | None = None
@@ -287,9 +292,12 @@ class MatchScheduler:
         if self._m_depth is not None:
             self._m_depth.set(n)
 
-    def _observe_wait(self, seconds: float) -> None:
+    def _observe_wait(self, p: _Pending, seconds: float) -> None:
         if self._m_wait is not None:
             self._m_wait.observe(seconds)
+        # per-tenant queue-wait: accrued to the submitting request's
+        # captured scope (this runs on the scheduler thread)
+        usage.add_to(p.usage_ctx, "queue_wait_s", seconds)
 
     def _enqueue(self, queries: list) -> _Pending:
         deadline = current_deadline()
@@ -310,6 +318,9 @@ class MatchScheduler:
             self._waiting.append(p)
             self._set_depth(len(self._waiting))
             self._cond.notify_all()
+        # admitted rows count toward the submitting tenant (shed
+        # submissions surface in the sheds field instead)
+        usage.add("queries", float(len(p.queries)))
         return p
 
     def _await(self, p: _Pending) -> None:
@@ -434,7 +445,7 @@ class MatchScheduler:
                     p.inflight += 1
                     if p.dispatched_at is None:
                         p.dispatched_at = time.monotonic()
-                        self._observe_wait(p.dispatched_at - p.arrival)
+                        self._observe_wait(p, p.dispatched_at - p.arrival)
                     parts.append((p, lo, hi))
                     rows += hi - lo
                     progressed = True
@@ -500,7 +511,7 @@ class MatchScheduler:
                 p.inflight += 1
                 if p.dispatched_at is None:
                     p.dispatched_at = time.monotonic()
-                    self._observe_wait(p.dispatched_at - p.arrival)
+                    self._observe_wait(p, p.dispatched_at - p.arrival)
                 parts.append((p, lo, hi))
 
     def _dispatch(self, parts, rows: int) -> None:
@@ -515,8 +526,12 @@ class MatchScheduler:
         try:
             # the batch span adopts the oldest coalesced request's
             # captured context: batch timing stays visible inside that
-            # request's trace instead of orphaning on this thread
-            with tracing.adopt(lead.trace_ctx):
+            # request's trace instead of orphaning on this thread. The
+            # usage scope rides along, so batch-level costs (rows
+            # matched) attribute to the lead request's tenant — the
+            # same approximation the lane attribution already makes
+            with tracing.adopt(lead.trace_ctx), \
+                    usage.adopt(lead.usage_ctx):
                 with tracing.span("sched.batch", rows=rows,
                                   requests=n_req):
                     res_lists = self._engine_fn().submit(lists)

@@ -28,6 +28,7 @@ import numpy as np
 
 from trivy_tpu.log import logger
 from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import usage
 from trivy_tpu.resilience import faults
 from trivy_tpu.secret.rules import (
     BUILTIN_ALLOW_RULES,
@@ -505,6 +506,12 @@ class SecretScanner:
             raise ValueError(
                 f"use_device={use_device!r}: expected True, False or "
                 "'hybrid'")
+        if usage.ambient() is not None:
+            # metered once at the batch entry point — the streaming and
+            # hybrid paths all funnel through here, so deeper accruals
+            # would double-count
+            usage.add("secret_mb",
+                      sum(len(c) for _p, c in batch) / 1e6)
         eligible = [
             (i, path, content) for i, (path, content) in enumerate(batch)
             if not self.skip_file(path) and not self.path_allowed(path)
