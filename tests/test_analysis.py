@@ -641,7 +641,9 @@ class TestKnobs:
                 "TRIVY_TPU_SECRET_PROBE", "TRIVY_TPU_MONITOR",
                 "TRIVY_TPU_ATTRIB", "TRIVY_TPU_FLEET",
                 "TRIVY_TPU_FLEET_EVENTS",
-                "TRIVY_TPU_CONTROLLER", "TRIVY_TPU_USAGE"} == names
+                "TRIVY_TPU_CONTROLLER", "TRIVY_TPU_USAGE",
+                "TRIVY_TPU_NATIVE_SPLIT",
+                "TRIVY_TPU_VECTOR_ANALYZERS"} == names
 
     def test_write_knobs_doc_roundtrip(self, tmp_path, capsys):
         (tmp_path / "trivy_tpu").mkdir()

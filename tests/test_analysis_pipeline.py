@@ -12,6 +12,11 @@
   subprocess SIGKILL mid-analysis resumes byte-identically
 - server-side MissingBlobs gate: a second client waits on the first
   client's in-flight layer instead of re-analyzing it
+- multi-lane executor (run_layer_lanes): byte-parity vs serial at
+  1/2/4/8 lanes (incl. duplicate diffIDs and the native-splitter kill
+  switch), analysis.lane fault matrix, SIGKILL mid-walk + --resume,
+  concurrent 4-lane scans deduping exactly once, the
+  TRIVY_TPU_ANALYSIS_WORKERS knob ladder
 """
 
 from __future__ import annotations
@@ -317,16 +322,16 @@ def test_concurrent_scans_analyze_shared_layer_exactly_once(env, tmp_path):
     the leader's BlobInfo instead of re-walking the layer."""
     imgs = _mk_registry(tmp_path, 2)
     cache = FSCache(str(tmp_path / "cache"))
-    orig = ImageArtifact._inspect_layer
+    orig = ImageArtifact._analyze_members
     walked: list[str] = []
     walked_lock = threading.Lock()
 
-    def slow_inspect(self, group, img, i, diff_id, blob_id, layer=None):
+    def slow_analyze(self, group, img, i, diff_id, blob_id, members):
         with walked_lock:
             walked.append(blob_id)
         if i == 0:
             time.sleep(0.3)      # hold the base layer in flight
-        return orig(self, group, img, i, diff_id, blob_id, layer=layer)
+        return orig(self, group, img, i, diff_id, blob_id, members)
 
     base = _counters()
     errs: list[BaseException] = []
@@ -339,7 +344,7 @@ def test_concurrent_scans_analyze_shared_layer_exactly_once(env, tmp_path):
         except BaseException as e:  # surfaced below
             errs.append(e)
 
-    ImageArtifact._inspect_layer = slow_inspect
+    ImageArtifact._analyze_members = slow_analyze
     try:
         threads = [threading.Thread(target=scan, args=(p,)) for p in imgs]
         for t in threads:
@@ -347,7 +352,7 @@ def test_concurrent_scans_analyze_shared_layer_exactly_once(env, tmp_path):
         for t in threads:
             t.join(timeout=60)
     finally:
-        ImageArtifact._inspect_layer = orig
+        ImageArtifact._analyze_members = orig
     assert not errs, errs
     # base layer walked once, unique app layers once each
     assert len(walked) == 3
@@ -652,3 +657,212 @@ def test_fleet_pipeline_kill_switch_byte_identical(fleet_env, monkeypatch):
                                       str(env / "cache-serial")]))
     assert rc == 0
     assert (env / "on.json").read_bytes() == (env / "off.json").read_bytes()
+
+
+# ------------------------------------------------- multi-lane executor
+
+
+def _mk_deep_image(tmp_path, n_unique=7):
+    """One image with a shared base + n unique layers (mixed gz/plain)
+    so several walk lanes are busy at once."""
+    layers = [BASE_LAYER] + [
+        _mk_layer({f"app{k}/package-lock.json": PACKAGE_LOCK.encode(),
+                   f"app{k}/note.txt": f"n{k}".encode()},
+                  gz=(k % 2 == 0))
+        for k in range(n_unique)
+    ]
+    p = str(tmp_path / "deep.tar")
+    _mk_image_tar(p, layers)
+    return p
+
+
+@pytest.mark.parametrize("workers", [1, 2, 4, 8])
+def test_multilane_parity_vs_serial_at_lane_counts(env, tmp_path,
+                                                   monkeypatch, workers):
+    """N walk lanes produce blob docs byte-identical to the serial
+    loop — the apply step is coordinator-only and strictly ordered."""
+    p = _mk_deep_image(tmp_path)
+    monkeypatch.setenv("TRIVY_TPU_ANALYSIS_PIPELINE", "0")
+    _, sref, sblobs = _inspect(p, MemoryCache())
+    monkeypatch.delenv("TRIVY_TPU_ANALYSIS_PIPELINE")
+    art, ref, blobs = _inspect(p, MemoryCache(), parallel=workers)
+    assert ref.id == sref.id and ref.blob_ids == sref.blob_ids
+    assert json.dumps(blobs, sort_keys=True) == \
+        json.dumps(sblobs, sort_keys=True)
+    assert art.last_analysis_stats["workers"] == workers
+    assert pipeline.SINGLEFLIGHT.inflight() == 0
+    if workers > 1:
+        # per-lane occupancy gauge published for every lane
+        for k in range(min(workers, len(ref.blob_ids))):
+            assert obs_metrics.ANALYSIS_LANE_BUSY.value(lane=str(k)) >= 0.0
+
+
+def test_multilane_duplicate_diffids_match_serial_last_write(
+        env, tmp_path, monkeypatch):
+    layer = _mk_layer({"etc/os-release": OS_RELEASE.encode()})
+    p = str(tmp_path / "dup.tar")
+    _mk_image_tar(p, [layer, layer, layer])
+    monkeypatch.setenv("TRIVY_TPU_ANALYSIS_PIPELINE", "0")
+    _, sref, sblobs = _inspect(p, MemoryCache())
+    monkeypatch.delenv("TRIVY_TPU_ANALYSIS_PIPELINE")
+    _, ref, blobs = _inspect(p, MemoryCache(), parallel=4)
+    assert ref.blob_ids == sref.blob_ids
+    assert json.dumps(blobs, sort_keys=True) == \
+        json.dumps(sblobs, sort_keys=True)
+
+
+def test_native_split_kill_switch_parity(env, tmp_path, monkeypatch):
+    """TRIVY_TPU_NATIVE_SPLIT=0 (pure tarfile walk) is byte-identical
+    to the native splitter path."""
+    p = _mk_deep_image(tmp_path, n_unique=3)
+    _, ref_n, blobs_n = _inspect(p, MemoryCache(), parallel=2)
+    monkeypatch.setenv("TRIVY_TPU_NATIVE_SPLIT", "0")
+    _, ref_p, blobs_p = _inspect(p, MemoryCache(), parallel=2)
+    assert ref_n.id == ref_p.id
+    assert json.dumps(blobs_n, sort_keys=True) == \
+        json.dumps(blobs_p, sort_keys=True)
+
+
+def test_lane_faults_drop_delay_error_parity(env, tmp_path, monkeypatch):
+    """analysis.lane drop (recompute), delay and single error (one
+    retry) are all zero-diff at 4 lanes."""
+    p = _mk_deep_image(tmp_path)
+    oracle = _inspect(p, MemoryCache(), parallel=4)[2]
+    for spec in ("analysis.lane:drop@1",
+                 "analysis.lane:delay=0.01@2",
+                 "analysis.lane:error@1",
+                 "analysis.lane:drop@2;analysis.lane:error@5"):
+        faults.install_spec(spec)
+        try:
+            got = _inspect(p, MemoryCache(), parallel=4)[2]
+        finally:
+            faults.reset()
+        assert json.dumps(got, sort_keys=True) == \
+            json.dumps(oracle, sort_keys=True), spec
+        assert pipeline.SINGLEFLIGHT.inflight() == 0, spec
+
+
+def test_lane_error_twice_fails_scan_and_releases_claims(env, tmp_path):
+    p = _mk_deep_image(tmp_path, n_unique=2)
+    faults.install_spec("analysis.lane:error")    # every walk fails
+    try:
+        with pytest.raises(pipeline.AnalysisLaneError):
+            _inspect(p, MemoryCache(), parallel=3)
+    finally:
+        faults.reset()
+    assert pipeline.SINGLEFLIGHT.inflight() == 0
+    # a faultless retry succeeds
+    _inspect(p, MemoryCache(), parallel=3)
+
+
+def test_multilane_concurrent_scans_dedupe_exactly_once(env, tmp_path):
+    """Two 4-lane scans racing on a shared base layer still analyze
+    each unique layer exactly once (claims are taken before dispatch)."""
+    imgs = _mk_registry(tmp_path, 2)
+    cache = FSCache(str(tmp_path / "cache"))
+    orig = ImageArtifact._analyze_members
+    walked: list[str] = []
+    walked_lock = threading.Lock()
+
+    def slow_analyze(self, group, img, i, diff_id, blob_id, members):
+        with walked_lock:
+            walked.append(blob_id)
+        if i == 0:
+            time.sleep(0.3)
+        return orig(self, group, img, i, diff_id, blob_id, members)
+
+    base = _counters()
+    errs: list[BaseException] = []
+
+    def scan(p):
+        try:
+            _inspect(p, cache, parallel=4)
+        except BaseException as e:
+            errs.append(e)
+
+    ImageArtifact._analyze_members = slow_analyze
+    try:
+        threads = [threading.Thread(target=scan, args=(p,)) for p in imgs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        ImageArtifact._analyze_members = orig
+    assert not errs, errs
+    assert len(walked) == 3 and len(set(walked)) == 3
+    analyzed, hits, waits = _delta(base)
+    assert analyzed == 3 and hits == 1 and waits >= 1
+
+
+def test_analysis_workers_knob(env, monkeypatch):
+    assert pipeline.analysis_workers(None) == pipeline.DEFAULT_WORKERS
+    assert pipeline.analysis_workers(3) == 3
+    assert pipeline.analysis_workers(0) == 1          # clamp floor
+    assert pipeline.analysis_workers(999) == pipeline.MAX_WORKERS
+    monkeypatch.setenv("TRIVY_TPU_ANALYSIS_WORKERS", "7")
+    assert pipeline.analysis_workers(2) == 7          # env overrides
+    monkeypatch.setenv("TRIVY_TPU_ANALYSIS_WORKERS", "64")
+    assert pipeline.analysis_workers(2) == pipeline.MAX_WORKERS
+    warned: list[str] = []
+    monkeypatch.setattr(pipeline._log, "warn",
+                        lambda msg, **kw: warned.append(msg))
+    monkeypatch.setenv("TRIVY_TPU_ANALYSIS_WORKERS", "banana")
+    assert pipeline.analysis_workers(2) == 2          # warn + fall back
+    assert any("TRIVY_TPU_ANALYSIS_WORKERS" in m for m in warned)
+
+
+@pytest.mark.durability
+def test_fleet_sigkill_mid_lane_walk_resumes_byte_identical(fleet_env):
+    """SIGKILL at the analysis.lane fault site mid-walk with 4 lanes;
+    --resume replays journaled layers and the merged report is
+    byte-identical to an uninterrupted multi-lane run's."""
+    from trivy_tpu.cli.main import main
+
+    env, imgs = fleet_env
+    sub_env = dict(
+        os.environ,
+        # image 1 walks 2 layers (lane fires 1-2); the kill lands on
+        # image 2's unique layer (its base is a cache hit)
+        TRIVY_TPU_FAULTS="analysis.lane:kill@3",
+        TRIVY_TPU_FAKE_TIME="2024-01-01T00:00:00+00:00",
+        TRIVY_TPU_DETERMINISTIC_UUID="1",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + [p for p in (os.environ.get("PYTHONPATH") or "").split(
+                os.pathsep) if p]),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "trivy_tpu.cli.main"]
+        + _fleet_args(env, imgs, ["--parallel", "4",
+                                  "--journal", str(env / "j.jsonl"),
+                                  "--output", str(env / "out.json")]),
+        env=sub_env, capture_output=True, timeout=180)
+    assert proc.returncode == -9, proc.stderr.decode()   # SIGKILLed
+
+    recs = [json.loads(ln) for ln in
+            (env / "j.jsonl").read_text().splitlines()]
+    kinds = [r["kind"] for r in recs]
+    assert kinds.count("done") == 1              # image 1 durable
+    assert kinds.count("layer") == 2             # its 2 layers journaled
+
+    rc = main(_fleet_args(env, imgs, ["--parallel", "4",
+                                      "--resume", str(env / "j.jsonl"),
+                                      "--output",
+                                      str(env / "resumed.json")]))
+    assert rc == 0
+
+    from trivy_tpu.cli import run as run_mod
+    from trivy_tpu.utils import uuid as uuid_util
+
+    run_mod._ENGINE_CACHE.clear()
+    uuid_util.reset()
+    rc = main(_fleet_args(env, imgs,
+                          ["--parallel", "4",
+                           "--journal", str(env / "golden.jsonl"),
+                           "--output", str(env / "golden.json"),
+                           "--cache-dir", str(env / "cache2")]))
+    assert rc == 0
+    assert (env / "resumed.json").read_bytes() == \
+        (env / "golden.json").read_bytes()

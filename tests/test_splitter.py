@@ -1,0 +1,224 @@
+"""Native streaming gunzip+tar splitter: differential tests against
+the `tarfile` oracle.
+
+The contract under test is *asymmetric parity*: on every archive the
+native splitter accepts, its member stream must match what
+`tarfile` + `walk_layer_tar` produce byte-for-byte; on anything
+outside its strict subset (sparse, hdrcharset, truncation, corrupt
+gzip, …) it must DECLINE and hand back a replayable source so the
+pure-Python path — including its exceptions — wins. It must never be
+more permissive than `tarfile`.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import tarfile
+
+import pytest
+
+from trivy_tpu.fanal.walker import MAX_FILE_SIZE, walk_layer_tar
+from trivy_tpu.ops import splitter
+
+pytestmark = [
+    pytest.mark.fanal,
+    pytest.mark.skipif(not splitter.available(),
+                       reason="g++/zlib toolchain unavailable"),
+]
+
+
+def _mk_tar(entries, fmt=tarfile.GNU_FORMAT, gz=False) -> bytes:
+    """entries: (name, data|None, type) — data None means dir/link."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=fmt) as tf:
+        for name, data, typ in entries:
+            info = tarfile.TarInfo(name)
+            info.type = typ
+            if typ == tarfile.SYMTYPE or typ == tarfile.LNKTYPE:
+                info.linkname = "target"
+            if data is not None:
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+            else:
+                tf.addfile(info)
+    raw = buf.getvalue()
+    return gzip.compress(raw, mtime=0) if gz else raw
+
+
+def _native_members(blob: bytes):
+    members, _src = splitter.try_split(blob, MAX_FILE_SIZE)
+    return members
+
+
+def _oracle_members(blob: bytes):
+    raw = gzip.decompress(blob) if blob[:2] == b"\x1f\x8b" else blob
+    out = []
+    with tarfile.open(fileobj=io.BytesIO(raw)) as tf:
+        for m in tf:
+            data = None
+            if m.isreg() and m.size <= MAX_FILE_SIZE:
+                data = tf.extractfile(m).read()
+            out.append((m.name, m.isreg(), m.size, data))
+    return out
+
+
+def _assert_parity(blob: bytes):
+    members = _native_members(blob)
+    assert members is not None, "native declined a supported archive"
+    got = [(name, is_reg, size,
+            read() if is_reg and size <= MAX_FILE_SIZE else None)
+           for name, is_reg, size, _mode, read in members]
+    assert got == _oracle_members(blob)
+
+
+BASIC = [
+    ("etc/os-release", b"ID=alpine\n", tarfile.REGTYPE),
+    ("usr/", None, tarfile.DIRTYPE),
+    ("usr/bin/tool", b"\x7fELF" + b"\0" * 100, tarfile.REGTYPE),
+    ("a/.wh.gone", b"", tarfile.REGTYPE),
+    ("b/.wh..wh..opq", b"", tarfile.REGTYPE),
+    ("lnk", None, tarfile.SYMTYPE),
+    ("hard", None, tarfile.LNKTYPE),
+]
+
+
+@pytest.mark.parametrize("fmt", [tarfile.GNU_FORMAT, tarfile.PAX_FORMAT,
+                                 tarfile.USTAR_FORMAT])
+@pytest.mark.parametrize("gz", [False, True])
+def test_basic_formats_parity(fmt, gz):
+    _assert_parity(_mk_tar(BASIC, fmt=fmt, gz=gz))
+
+
+@pytest.mark.parametrize("fmt", [tarfile.GNU_FORMAT, tarfile.PAX_FORMAT])
+def test_long_names_parity(fmt):
+    entries = [
+        ("d" * 80 + "/" + "f" * 80 + ".txt", b"deep", tarfile.REGTYPE),
+        ("x/" * 120 + "leaf", b"leafdata", tarfile.REGTYPE),
+        ("longdir/" * 30, None, tarfile.DIRTYPE),
+    ]
+    _assert_parity(_mk_tar(entries, fmt=fmt))
+
+
+def test_ustar_prefix_split_parity():
+    # >100-char path stored via the ustar prefix field
+    entries = [("d/" * 40 + "leaf.txt", b"x", tarfile.REGTYPE)]
+    _assert_parity(_mk_tar(entries, fmt=tarfile.USTAR_FORMAT))
+
+
+def test_unicode_names_parity():
+    entries = [("café/ümläut.txt", b"data",
+                tarfile.REGTYPE)]
+    _assert_parity(_mk_tar(entries, fmt=tarfile.PAX_FORMAT))
+    _assert_parity(_mk_tar(entries, fmt=tarfile.GNU_FORMAT))
+
+
+def test_concatenated_gzip_members_parity():
+    # docker save produces single-stream gzip, but multi-member gzip
+    # is legal and gzip.decompress handles it — so must the splitter
+    raw1 = _mk_tar([("a.txt", b"a", tarfile.REGTYPE)])
+    part1 = gzip.compress(raw1[:1024], mtime=0)
+    part2 = gzip.compress(raw1[1024:], mtime=0)
+    _assert_parity(part1 + part2)
+
+
+def test_oversize_member_not_stored_but_walk_matches():
+    big = b"z" * (MAX_FILE_SIZE + 1)
+    blob = _mk_tar([("big.bin", big, tarfile.REGTYPE),
+                    ("small.txt", b"s", tarfile.REGTYPE)])
+    members = _native_members(blob)
+    got = {name: (size, read() if size <= MAX_FILE_SIZE else None)
+           for name, _r, size, _m, read in members}
+    assert got["big.bin"] == (len(big), None)     # skimmed, not stored
+    assert got["small.txt"] == (1, b"s")
+
+
+# ---------------------------------------------------------- declines
+
+
+def _declines(blob) -> bool:
+    members, src = splitter.try_split(blob, MAX_FILE_SIZE)
+    if members is not None:
+        return False
+    # the replayed source must re-read from byte zero
+    replay = src.read() if hasattr(src, "read") else src
+    orig = blob.read() if hasattr(blob, "read") else blob
+    assert replay == orig if isinstance(blob, bytes) else True
+    return True
+
+
+def test_sparse_member_declines():
+    blob = _mk_tar([("ok.txt", b"ok", tarfile.REGTYPE)])
+    # hand-build a GNU sparse header ('S') after the first member
+    hdr = bytearray(512)
+    hdr[0:6] = b"sparse"
+    hdr[124:136] = b"00000000000\0"                # size 0
+    hdr[156] = ord("S")
+    hdr[257:265] = b"ustar  \0"                    # GNU magic
+    chksum = 256 + sum(hdr) - sum(hdr[148:156])
+    hdr[148:156] = b"%06o\0 " % chksum
+    # insert at the real end of member data (512 hdr + 512 padded
+    # body) — the archive's RECORDSIZE zero-padding starts right after
+    # the terminating blocks, so appending near the blob end would land
+    # past where the splitter legitimately stops reading
+    sparse_blob = blob[:1024] + bytes(hdr) + blob[1024:]
+    assert _declines(sparse_blob)
+
+
+def test_mid_data_truncation_declines_and_tarfile_fails_too():
+    blob = _mk_tar([("f.txt", b"q" * 4096, tarfile.REGTYPE)])
+    cut = blob[: 512 + 1000]                       # inside member data
+    assert _declines(cut)
+    with pytest.raises(tarfile.ReadError):
+        _oracle_members(cut)
+
+
+def test_corrupt_gzip_declines_with_replay_intact():
+    blob = _mk_tar([("f.txt", b"ff", tarfile.REGTYPE)], gz=True)
+    bad = blob[:40] + bytes([blob[40] ^ 0xFF]) + blob[41:]
+    stream = io.BytesIO(bad)
+    members, src = splitter.try_split(stream, MAX_FILE_SIZE)
+    assert members is None
+    assert src.read() == bad                       # replayed from zero
+
+
+def test_garbage_header_declines():
+    assert _declines(b"\x01" * 2048)
+
+
+def test_pax_hdrcharset_declines():
+    # a pax record the native parser must not try to interpret
+    rec = b"hdrcharset=BINARY\n"
+    rec = (b"%d %s" % (len(rec) + 3, rec))
+    pax = bytearray(512)
+    pax[0:4] = b"pax\0"
+    pax[124:136] = b"%011o\0" % len(rec)
+    pax[156] = ord("x")
+    pax[257:265] = b"ustar\x0000"
+    chksum = 256 + sum(pax) - sum(pax[148:156])
+    pax[148:156] = b"%06o\0 " % chksum
+    body = bytes(rec) + b"\0" * (512 - len(rec))
+    tail = _mk_tar([("f.txt", b"x", tarfile.REGTYPE)])
+    assert _declines(bytes(pax) + body + tail)
+
+
+def test_walk_layer_tar_native_vs_pure_end_to_end():
+    """The walker-level contract: identical AnalysisInput streams with
+    the native splitter on and off, over bytes and unseekable
+    streams."""
+    blob = _mk_tar(BASIC, fmt=tarfile.PAX_FORMAT, gz=True)
+
+    def walk(src):
+        files, opq, wh = walk_layer_tar(src)
+        return [(f.path, f.read()) for f in files], opq, wh
+
+    native_b = walk(blob)
+    native_s = walk(io.BytesIO(blob))
+    os.environ["TRIVY_TPU_NATIVE_SPLIT"] = "0"
+    try:
+        pure_b = walk(blob)
+        pure_s = walk(io.BytesIO(blob))
+    finally:
+        del os.environ["TRIVY_TPU_NATIVE_SPLIT"]
+    assert native_b == pure_b == native_s == pure_s

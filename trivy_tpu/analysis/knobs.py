@@ -88,6 +88,18 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TRIVY_TPU_ANALYSIS_PREFETCH", "2", "fanal", False,
          "Layer-prefetch depth: compressed layers allowed in flight "
          "ahead of the analyzing thread."),
+    Knob("TRIVY_TPU_ANALYSIS_WORKERS", "5", "fanal", False,
+         "Walk-lane count for the multi-lane layer executor; "
+         "overrides --parallel, clamped to [1, 32]; malformed values "
+         "warn and fall back."),
+    Knob("TRIVY_TPU_NATIVE_SPLIT", "1", "fanal", True,
+         "Native streaming gunzip+tar splitter on the layer walk; 0 "
+         "restores the pure-Python tarfile walk (also the automatic "
+         "fallback when no toolchain is present)."),
+    Knob("TRIVY_TPU_VECTOR_ANALYZERS", "1", "fanal", True,
+         "Vectorized hot analyzers (packed-trigram license "
+         "classification, numpy yarn.lock tokenization); 0 restores "
+         "the scalar engines, which stay byte-identical either way."),
     # --- compiled-DB cache
     Knob("TRIVY_TPU_COMPILE_CACHE", "1", "tensorize", True,
          "Persistent compiled-DB tensor cache; 0 recompiles from the "

@@ -258,10 +258,14 @@ class ImageArtifact:
                                   diff_ids: list[str],
                                   blob_ids: list[str],
                                   missing_set: set[str]) -> None:
-        """Default layer path: prefetch layer N+1 while analyzing layer
-        N, with the process-wide singleflight registry ensuring a blob
-        shared by concurrent scans is analyzed exactly once
-        (docs/performance.md "Analysis pipeline & layer dedupe")."""
+        """Default layer path: one fetch lane feeds N walk lanes
+        (``--parallel`` / ``TRIVY_TPU_ANALYSIS_WORKERS``) that split and
+        analyze distinct layers concurrently, while the coordinator
+        applies every BlobInfo document strictly in layer order — so
+        results are byte-identical to the serial path at any lane
+        count. The process-wide singleflight registry still ensures a
+        blob shared by concurrent scans is analyzed exactly once
+        (docs/performance.md "Multi-lane analysis")."""
         hook = pipeline.journal_hook()
         stats = {"layers": len(blob_ids), "analyzed": 0, "deduped": 0,
                  "inflight_waits": 0, "journal_replayed": 0,
@@ -301,14 +305,27 @@ class ImageArtifact:
             i, _diff_id, _blob_id = item
             return self._layer_source(img, i)
 
-        def process(item, layer):
+        def walk(item, layer):
             i, diff_id, blob_id = item
-            self._lead_analyze(group_for(diff_id), img, i, diff_id,
-                               blob_id, slots[blob_id], hook, stats,
-                               layer=layer)
+            walked = self._split_layer(img, i, layer)
+            return pipeline.lane_with_retry(
+                lambda: self._analyze_members(group_for(diff_id), img, i,
+                                              diff_id, blob_id, walked))
 
+        def apply(item, doc):
+            _i, _diff_id, blob_id = item
+            self._apply_blob(blob_id, doc)
+            pipeline.SINGLEFLIGHT.finish(blob_id, slots[blob_id],
+                                         doc=doc, ok=True)
+            if hook is not None:
+                hook.layer_done(blob_id)
+            stats["analyzed"] += 1
+
+        workers = pipeline.analysis_workers(self.parallel)
+        stats["workers"] = workers
         try:
-            run = pipeline.run_layer_pipeline(lead, fetch, process)
+            run = pipeline.run_layer_lanes(lead, fetch, walk, apply,
+                                           workers=workers)
             stats["occupancy"] = run["occupancy"]
         finally:
             # a failed scan must release every claim it still holds or
@@ -379,17 +396,30 @@ class ImageArtifact:
             return stream(i)
         return img.layer_bytes(i)
 
-    def _inspect_layer(self, group, img, i: int, diff_id: str,
-                       blob_id: str, layer=None) -> dict:
-        _log.info("analyzing layer...", diff_id=diff_id[:19])
+    @staticmethod
+    def _split_layer(img, i: int, layer=None):
+        """Walk half, part 1: split the layer tar into members (native
+        splitter when available, tarfile otherwise). Consumes and
+        closes the stream; safe to run on any walk lane."""
         if layer is None:
             layer = img.layer_bytes(i)
         try:
-            files, opaque_dirs, whiteouts = walk_layer_tar(layer)
+            return walk_layer_tar(layer)
         finally:
             # streaming sources hand over open file objects
             if hasattr(layer, "close"):
                 layer.close()
+
+    def _analyze_members(self, group, img, i: int, diff_id: str,
+                         blob_id: str, walked) -> dict:
+        """Walk half, part 2: run the analyzers over split members and
+        build the BlobInfo document. Pure recomputation over in-memory
+        members — no stream, no cache writes — so lanes can run it
+        concurrently and the ``analysis.lane`` fault ladder can replay
+        it. (blob_id rides along as the per-layer identity for tests
+        instrumenting the walk seam.)"""
+        _log.info("analyzing layer...", diff_id=diff_id[:19])
+        files, opaque_dirs, whiteouts = walked
         result = AnalysisResult()
         post_files: dict = {}
         for inp in files:
@@ -407,9 +437,23 @@ class ImageArtifact:
         ]
         if i < len(history):
             blob.created_by = history[i].get("created_by", "")
-        doc = dataclasses.asdict(blob)
+        return dataclasses.asdict(blob)
+
+    def _apply_blob(self, blob_id: str, doc: dict) -> None:
+        """Apply half: the cache write and counter — coordinator-only
+        in the lanes path, so writes land strictly in layer order."""
         self.cache.put_blob(blob_id, doc)
         obs_metrics.LAYERS_ANALYZED.inc()
+
+    def _inspect_layer(self, group, img, i: int, diff_id: str,
+                       blob_id: str, layer=None) -> dict:
+        """Serial composition of the split/analyze/apply halves — the
+        kill-switch path and follower-promoted takeovers use this, and
+        the lanes path is golden-tested against it."""
+        walked = self._split_layer(img, i, layer)
+        doc = self._analyze_members(group, img, i, diff_id, blob_id,
+                                    walked)
+        self._apply_blob(blob_id, doc)
         return doc
 
     def _inspect_config(self, img: TarImage) -> ArtifactInfo:

@@ -69,6 +69,7 @@ MANIFEST = {
     ),
     "fleetscan": (
         ("analysis.fetch", ("drop", "delay", "error", "kill")),
+        ("analysis.lane", ("drop", "delay", "error", "kill")),
         ("fleet.scan", ("kill",)),
         ("journal.append", ("kill", "torn-write", "bitflip")),
         ("cache.write", ("kill", "torn-write", "bitflip")),

@@ -31,12 +31,31 @@ the rest):
 per-layer path byte-identically (artifact/image.py keeps the legacy loop
 verbatim behind the switch).
 
+3. **Multi-lane walk** — ``run_layer_lanes`` generalizes the pair into
+   one in-order fetch lane feeding N walk lanes (``--parallel N`` /
+   ``TRIVY_TPU_ANALYSIS_WORKERS``, reference-parity default 5 matching
+   pkg/parallel/pipeline.go). Per-layer analysis is independent, so
+   lanes split+analyze distinct layers concurrently — mostly inside
+   GIL-dropping native/numpy code (ops/splitter.py, the vectorized
+   analyzers) — while the coordinator applies every BlobInfo document
+   strictly in layer order: cache writes, singleflight publishes and
+   journal records happen exactly as the serial path would emit them,
+   so the output is byte-identical by construction at any lane count.
+   ``workers<=1`` IS the PR 6 two-stage pipeline, code path and all.
+
 Fault site ``analysis.fetch`` (resilience/faults.py grammar): ``delay``
 sleeps in the fetch lane, ``drop`` discards the fetched stream and
 refetches (a lost prefetch is recomputed — results unchanged), ``error``
 fails the fetch once and the layer is refetched from scratch (two
 consecutive injected errors fail the scan), ``kill`` crashes for the
 SIGKILL-and-resume matrix.
+
+Fault site ``analysis.lane`` mirrors the ladder at the walk stage:
+``delay`` sleeps in the lane, ``drop`` discards the analyzed document
+and recomputes it from the already-split members (results unchanged),
+``error`` fails the lane analysis once and it is recomputed (two
+consecutive injected errors fail the scan), ``kill`` crashes mid-walk
+for the SIGKILL-and-resume matrix.
 """
 
 from __future__ import annotations
@@ -58,6 +77,12 @@ from trivy_tpu.resilience import faults
 _log = logger("fanal.pipeline")
 
 FETCH_SITE = "analysis.fetch"
+LANE_SITE = "analysis.lane"
+
+#: reference parity: pkg/parallel/pipeline.go runs 5 workers by default
+DEFAULT_WORKERS = 5
+#: per-lane occupancy gauge cardinality bound (and the hard lane cap)
+MAX_WORKERS = 32
 
 # a server-side MissingBlobs claim with no PutBlob after this long is
 # presumed dead (client crashed mid-analysis) and may be re-claimed
@@ -75,6 +100,11 @@ class AnalysisFetchError(Exception):
     once before the scan fails."""
 
 
+class AnalysisLaneError(Exception):
+    """A lane analysis failed (injected or real); the document is
+    recomputed once from the split members before the scan fails."""
+
+
 def enabled() -> bool:
     """The ``TRIVY_TPU_ANALYSIS_PIPELINE`` kill switch (default on)."""
     return os.environ.get("TRIVY_TPU_ANALYSIS_PIPELINE", "1") != "0"
@@ -89,6 +119,22 @@ def prefetch_depth() -> int:
             _log.warn("bad TRIVY_TPU_ANALYSIS_PREFETCH; using default",
                       value=raw)
     return 2
+
+
+def analysis_workers(requested: int | None = None) -> int:
+    """Walk-lane count: ``TRIVY_TPU_ANALYSIS_WORKERS`` overrides the
+    caller's ``--parallel`` value; malformed values warn-and-default
+    like ``TRIVY_TPU_ANALYSIS_PREFETCH``. Clamped to [1, MAX_WORKERS]
+    (the per-lane gauge's cardinality bound)."""
+    n = requested if requested is not None else DEFAULT_WORKERS
+    raw = os.environ.get("TRIVY_TPU_ANALYSIS_WORKERS")
+    if raw:
+        try:
+            n = int(raw)
+        except ValueError:
+            _log.warn("bad TRIVY_TPU_ANALYSIS_WORKERS; using default",
+                      value=raw)
+    return max(1, min(n, MAX_WORKERS))
 
 
 # ------------------------------------------------------------ singleflight
@@ -302,6 +348,42 @@ def fetch_with_retry(fetch):
         return fetch_guarded(fetch)
 
 
+# ---------------------------------------------------------- lane stage
+
+
+def lane_guarded(work):
+    """Run ``work()`` (the analyzer pass over already-split members)
+    under the ``analysis.lane`` fault site. ``work`` must be a pure
+    recomputation — it consumes no stream — so ``drop`` discards the
+    document and recomputes it, ``error`` raises AnalysisLaneError
+    (the lane retries the analysis once), ``delay`` sleeps in the
+    lane, ``kill`` dies (SIGKILL / raise-mode)."""
+    rules = faults.fire(LANE_SITE)
+    faults.check_kill(LANE_SITE, rules=rules)
+    drop = err = False
+    for r in rules:
+        if r.action == "delay":
+            time.sleep(r.param if r.param is not None else 0.05)
+        elif r.action == "drop":
+            drop = True
+        elif r.action == "error":
+            err = True
+    if err:
+        raise AnalysisLaneError("injected analysis.lane error")
+    doc = work()
+    if drop:
+        doc = work()  # the analyzed document was lost; recompute
+    return doc
+
+
+def lane_with_retry(work):
+    try:
+        return lane_guarded(work)
+    except AnalysisLaneError as e:
+        _log.warn("lane analysis failed; recomputing once", err=str(e))
+        return lane_guarded(work)
+
+
 # ------------------------------------------------------------ pipeline
 
 
@@ -423,6 +505,173 @@ def run_layer_pipeline(items: list, fetch, process,
     stats["occupancy"] = min(
         (stats["fetch_busy_s"] + stats["walk_busy_s"]) / (2 * wall), 1.0)
     obs_metrics.ANALYSIS_PIPELINE_OCCUPANCY.set(stats["occupancy"])
+    return stats
+
+
+def run_layer_lanes(items: list, fetch, walk, apply,
+                    depth: int | None = None, workers: int = 1) -> dict:
+    """Multi-lane layer executor: one in-order fetch lane feeds
+    ``workers`` walk lanes running ``walk(item, payload) -> doc``
+    concurrently; the calling thread applies every document strictly in
+    item order via ``apply(item, doc)``.
+
+    Ordering invariant: ``apply`` — cache writes, singleflight
+    publishes, journal records, counters — runs only on the calling
+    thread and only for item k after items 0..k-1 were applied, so the
+    externally visible effects are exactly the serial sequence and the
+    output is byte-identical by construction at any lane count. Errors
+    (fetch or walk) surface at their item's position in that order.
+
+    ``workers<=1`` (or a single item) delegates to
+    :func:`run_layer_pipeline` with ``walk``+``apply`` composed — the
+    PR 6 two-stage pipeline, same code path, same spans.
+    """
+    workers = max(1, min(int(workers), MAX_WORKERS))
+    if workers <= 1 or len(items) <= 1:
+        return run_layer_pipeline(
+            items, fetch,
+            lambda item, payload: apply(item, walk(item, payload)),
+            depth=depth)
+
+    # each lane needs a layer in hand plus one in the queue to stay
+    # busy; a caller-set prefetch depth still wins when larger
+    depth = depth or max(prefetch_depth(), workers + 1)
+    n_lanes = min(workers, len(items))
+    stats = {"layers": len(items), "fetch_busy_s": 0.0,
+             "walk_busy_s": 0.0, "apply_busy_s": 0.0, "wall_s": 0.0,
+             "occupancy": 0.0, "workers": n_lanes,
+             "lane_busy_s": [0.0] * n_lanes}
+    wall0 = time.perf_counter()
+
+    dispatch: queue.Queue = queue.Queue(maxsize=max(depth - 1, n_lanes))
+    stop = threading.Event()
+    trace_ctx = tracing.capture()
+    usage_ctx = usage.capture()
+
+    cond = threading.Condition()
+    results: dict[int, tuple[object, bool]] = {}
+    active = [0]  # walks in flight, guarded by cond
+
+    def deliver(seq: int, value, is_err: bool) -> None:
+        with cond:
+            results[seq] = (value, is_err)
+            cond.notify_all()
+
+    def fetch_lane():
+        with tracing.adopt(trace_ctx), usage.adopt(usage_ctx):
+            for seq, item in enumerate(items):
+                if stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                try:
+                    with tracing.span(FETCH_SITE):
+                        payload = fetch_with_retry(lambda: fetch(item))
+                except BaseException as exc:  # lint: allow[bare-except] delivered to the coordinator at this layer's position
+                    stats["fetch_busy_s"] += time.perf_counter() - t0
+                    deliver(seq, exc, True)
+                    return
+                usage.add("layers_fetched")
+                stats["fetch_busy_s"] += time.perf_counter() - t0
+                if not _put_interruptible(dispatch, (seq, item, payload),
+                                          stop):
+                    _close_quietly(payload)  # coordinator aborted
+                    return
+
+    def walk_lane(lane_id: int):
+        with tracing.adopt(trace_ctx), usage.adopt(usage_ctx):
+            while not stop.is_set():
+                try:
+                    task = dispatch.get(timeout=1.0)
+                except queue.Empty:
+                    continue
+                if task is None:
+                    return
+                seq, item, payload = task
+                with cond:
+                    active[0] += 1
+                t0 = time.perf_counter()
+                try:
+                    with tracing.span(LANE_SITE, lane=lane_id):
+                        doc = walk(item, payload)
+                except BaseException as exc:  # lint: allow[bare-except] surfaces at this layer's position in apply order
+                    deliver(seq, exc, True)
+                else:
+                    usage.add("layers_analyzed")
+                    deliver(seq, doc, False)
+                finally:
+                    stats["lane_busy_s"][lane_id] += \
+                        time.perf_counter() - t0
+                    with cond:
+                        active[0] -= 1
+                        cond.notify_all()
+
+    fetcher = threading.Thread(target=fetch_lane, daemon=True,
+                               name="ttpu-layer-fetch")
+    lanes = [threading.Thread(target=walk_lane, args=(k,), daemon=True,
+                              name=f"ttpu-analysis-lane-{k}")
+             for k in range(n_lanes)]
+    fetcher.start()
+    for t in lanes:
+        t.start()
+
+    def wait_result(seq: int):
+        # never a bare blocking wait: lanes that died without
+        # delivering (failure outside their guarded stages) must not
+        # wedge the scan — and the singleflight claims it holds
+        with cond:
+            while seq not in results:
+                cond.wait(timeout=1.0)
+                if seq in results:
+                    break
+                if (not fetcher.is_alive() and dispatch.empty()
+                        and active[0] == 0):
+                    raise RuntimeError(
+                        "analysis lanes died without a result")
+            return results.pop(seq)
+
+    def drain():
+        with contextlib.suppress(queue.Empty):
+            while True:  # unblock a fetch stuck on put(); close orphans
+                task = dispatch.get_nowait()
+                if task is not None:
+                    _close_quietly(task[2])
+
+    try:
+        for seq, item in enumerate(items):
+            # queue_wait attribution lane: the coordinator starving on
+            # the walk lanes (fetch- or walk-bound crawls show up here)
+            with tracing.span("analysis.await_lane"):
+                value, is_err = wait_result(seq)
+            if is_err:
+                raise value
+            t0 = time.perf_counter()
+            with tracing.span("analysis.apply"):
+                apply(item, value)
+            stats["apply_busy_s"] += time.perf_counter() - t0
+    finally:
+        stop.set()
+        drain()
+        for _ in lanes:  # wake lanes parked on get() immediately
+            with contextlib.suppress(queue.Full):
+                dispatch.put_nowait(None)
+        fetcher.join(timeout=30.0)
+        for t in lanes:
+            t.join(timeout=30.0)
+        if fetcher.is_alive() or any(t.is_alive() for t in lanes):
+            _log.warn("analysis lanes still running at abort; a "
+                      "stalled fetch/walk will be abandoned")
+        drain()
+
+    wall = max(time.perf_counter() - wall0, 1e-9)
+    stats["wall_s"] = wall
+    stats["walk_busy_s"] = sum(stats["lane_busy_s"])
+    busy = (stats["fetch_busy_s"] + stats["walk_busy_s"]
+            + stats["apply_busy_s"])
+    stats["occupancy"] = min(busy / ((2 + n_lanes) * wall), 1.0)
+    obs_metrics.ANALYSIS_PIPELINE_OCCUPANCY.set(stats["occupancy"])
+    for k in range(n_lanes):
+        obs_metrics.ANALYSIS_LANE_BUSY.set(
+            min(stats["lane_busy_s"][k] / wall, 1.0), lane=str(k))
     return stats
 
 

@@ -13,10 +13,22 @@ are dropped, mirroring classifier.go:57-60.
 
 Custom corpora extend coverage: `add_license_text(name, text)` compiles
 any license body into the matcher at runtime.
+
+Two interchangeable trigram engines back `classify`: the reference
+set-of-tuples matcher, and a vectorized engine that interns corpus
+words to dense ids, packs each trigram into one int64
+(21 bits/word), and intersects sorted unique arrays with
+`np.isin` — the same crunch-lane idiom the detector uses for
+advisory screening.  Both engines produce identical confidences by
+construction (a document trigram containing any out-of-corpus word
+can never equal a corpus trigram, and the confidence denominator
+only counts corpus grams); `TRIVY_TPU_VECTOR_ANALYZERS=0` or an
+overflowing vocabulary falls back to the set engine.
 """
 
 from __future__ import annotations
 
+import os
 import re
 
 from trivy_tpu.types.artifact import LicenseFile, LicenseFinding
@@ -164,6 +176,110 @@ def _ngrams(text: str) -> set[tuple[str, ...]]:
             for i in range(len(words) - _NGRAM + 1)}
 
 
+# ------------------------------------------------- packed trigram engine
+#
+# Corpus words intern to dense ids starting at 1 (0 is the shared
+# out-of-corpus id); a trigram packs into one int64 as three 21-bit
+# fields.  Grams shorter than the trigram width (phrases under three
+# words) stay as Python tuples in a side set — they can never collide
+# with a packed value.
+
+_PACK_BITS = 21
+_PACK_MAX = (1 << _PACK_BITS) - 1
+_VOCAB: dict[str, int] = {}
+_PACKED: dict[str, tuple] = {}      # name -> (excerpt|None, [fulls])
+_pack_disabled = False
+
+
+def _vector_enabled() -> bool:
+    return (not _pack_disabled
+            and os.environ.get("TRIVY_TPU_VECTOR_ANALYZERS", "1") != "0")
+
+
+def _intern(words: list[str], grow: bool):
+    """Map words to dense ids; `grow` extends the vocabulary (corpus
+    compile) while documents map unknown words to the OOV id 0."""
+    global _pack_disabled
+    import numpy as np
+
+    if grow:
+        ids = np.empty(len(words), dtype=np.int64)
+        for i, w in enumerate(words):
+            wid = _VOCAB.get(w)
+            if wid is None:
+                wid = len(_VOCAB) + 1
+                if wid > _PACK_MAX:
+                    _pack_disabled = True
+                    return None
+                _VOCAB[w] = wid
+            ids[i] = wid
+        return ids
+    return np.fromiter((_VOCAB.get(w, 0) for w in words),
+                       dtype=np.int64, count=len(words))
+
+
+def _pack(ids):
+    import numpy as np
+
+    packed = ((ids[:-2] << (2 * _PACK_BITS))
+              | (ids[1:-1] << _PACK_BITS) | ids[2:])
+    return np.unique(packed)
+
+
+def _compile_packed(texts) -> tuple:
+    """Union of the texts' gram sets as (sorted unique packed trigram
+    array, frozenset of short grams); None while overflowed."""
+    import numpy as np
+
+    arrs, short = [], set()
+    for t in texts:
+        words = t.split()
+        if not words:
+            continue
+        if len(words) < _NGRAM:
+            short.add(tuple(words))
+        else:
+            ids = _intern(words, grow=True)
+            if ids is None:
+                return None
+            arrs.append(_pack(ids))
+    arr = (np.unique(np.concatenate(arrs)) if arrs
+           else np.empty(0, dtype=np.int64))
+    return arr, frozenset(short)
+
+
+def _packed_sets(name: str):
+    """Packed analogue of `_gram_sets` (same variants, same shapes)."""
+    compiled = _PACKED.get(name)
+    if compiled is None:
+        excerpt = _compile_packed(_FINGERPRINTS.get(name, ()))
+        fulls = [_compile_packed([t])
+                 for t in _EXTRA_VARIANTS.get(name, ())]
+        if excerpt is None or any(f is None for f in fulls):
+            return None                          # vocabulary overflow
+        if not (excerpt[0].size or excerpt[1]):
+            excerpt = None
+        compiled = (excerpt, fulls)
+        _PACKED[name] = compiled
+    return compiled
+
+
+def _packed_conf(compiled, doc_arr, doc_short) -> float:
+    """|corpus grams ∩ doc grams| / |corpus grams|, packed form."""
+    import numpy as np
+
+    arr, short = compiled
+    total = arr.size + len(short)
+    if not total:
+        return 0.0
+    hits = 0
+    if arr.size and doc_arr.size:
+        hits = int(np.isin(arr, doc_arr, assume_unique=True).sum())
+    if short and doc_short:
+        hits += len(short & doc_short)
+    return hits / total
+
+
 _GRAM_SETS: dict[str, list[set]] = {}
 
 # extra whole-text variants per license (the embedded SPDX corpus and
@@ -185,6 +301,7 @@ def _load_corpus() -> None:
         _EXTRA_VARIANTS.setdefault(name, []).append(
             _normalize_text(text))
         _GRAM_SETS.pop(name, None)
+        _PACKED.pop(name, None)
 
 
 def _gram_sets(name: str):
@@ -208,6 +325,59 @@ def add_license_text(name: str, text: str) -> None:
     _EXTRA_VARIANTS.setdefault(name, []).append(_normalize_text(text))
     _FINGERPRINTS.setdefault(name, [])
     _GRAM_SETS.pop(name, None)
+    _PACKED.pop(name, None)
+
+
+def _score_sets(norm: str) -> list[tuple[str, float, float]]:
+    """Reference engine: (name, excerpt conf, whole-text conf) per
+    license, via set-of-tuple trigram intersections."""
+    doc_grams = _ngrams(norm)
+    out = []
+    for name in sorted(set(_FINGERPRINTS) | set(_EXTRA_VARIANTS)):
+        excerpt, fulls = _gram_sets(name)
+        conf_ex = (len(excerpt & doc_grams) / len(excerpt)
+                   if excerpt else 0.0)
+        conf_full = max((len(g & doc_grams) / len(g)
+                         for g in fulls if g), default=0.0)
+        out.append((name, conf_ex, conf_full))
+    return out
+
+
+def _score_packed(norm: str) -> list[tuple[str, float, float]] | None:
+    """Vectorized engine: identical confidences to `_score_sets`, or
+    None when numpy is unavailable / the vocabulary overflowed."""
+    global _pack_disabled
+    try:
+        import numpy as np
+    except ImportError:            # pragma: no cover - numpy is baked in
+        _pack_disabled = True
+        return None
+
+    names = sorted(set(_FINGERPRINTS) | set(_EXTRA_VARIANTS))
+    compiled = []
+    for name in names:
+        c = _packed_sets(name)
+        if c is None:
+            return None                          # vocabulary overflow
+        compiled.append(c)
+
+    words = norm.split()
+    doc_short: set[tuple[str, ...]] = set()
+    if len(words) < _NGRAM:
+        doc_arr = np.empty(0, dtype=np.int64)
+        if words:
+            doc_short = {tuple(words)}
+    else:
+        doc_arr = _pack(_intern(words, grow=False))
+
+    out = []
+    for name, (excerpt, fulls) in zip(names, compiled):
+        conf_ex = (_packed_conf(excerpt, doc_arr, doc_short)
+                   if excerpt is not None else 0.0)
+        conf_full = max((_packed_conf(f, doc_arr, doc_short)
+                         for f in fulls), default=0.0)
+        out.append((name, conf_ex, conf_full))
+    return out
 
 
 def _finding(name: str, confidence: float) -> LicenseFinding:
@@ -246,15 +416,12 @@ def classify(file_path: str, content: bytes | str,
     full_conf: dict[str, float] = {}
     if norm:
         _load_corpus()
-        doc_grams = _ngrams(norm)
-        for name in set(_FINGERPRINTS) | set(_EXTRA_VARIANTS):
+        scores = (_score_packed(norm) if _vector_enabled() else None)
+        if scores is None:
+            scores = _score_sets(norm)
+        for name, conf_ex, conf_full in scores:
             if name in seen:
                 continue
-            excerpt, fulls = _gram_sets(name)
-            conf_ex = (len(excerpt & doc_grams) / len(excerpt)
-                       if excerpt else 0.0)
-            conf_full = max((len(g & doc_grams) / len(g)
-                             for g in fulls if g), default=0.0)
             conf = max(conf_ex, conf_full)
             if conf >= confidence_level:
                 seen.add(name)

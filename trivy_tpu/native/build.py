@@ -26,18 +26,24 @@ def cache_dir() -> str:
                      "native"))
 
 
-def build_library(src_path: str, lib_prefix: str) -> str | None:
-    """Compile `src_path` to a cached shared library; None on failure."""
+def build_library(src_path: str, lib_prefix: str,
+                  link_flags: tuple[str, ...] = ()) -> str | None:
+    """Compile `src_path` to a cached shared library; None on failure.
+
+    `link_flags` (e.g. ("-lz",)) participate in the cache key so the
+    same source built with different libraries does not collide.
+    """
     with open(src_path, "rb") as f:
         src = f.read()
-    digest = hashlib.sha256(src).hexdigest()[:16]
+    digest = hashlib.sha256(src + b"\0" +
+                            " ".join(link_flags).encode()).hexdigest()[:16]
     out = os.path.join(cache_dir(), f"{lib_prefix}-{digest}.so")
     if os.path.exists(out):
         return out
     os.makedirs(cache_dir(), exist_ok=True)
     tmp = tempfile.mktemp(suffix=".so", dir=cache_dir())
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src_path,
-           "-o", tmp]
+           "-o", tmp, *link_flags]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (OSError, subprocess.SubprocessError) as e:
@@ -55,10 +61,12 @@ class LazyLibrary:
     """Thread-safe once-only build+load; `configure(lib)` sets the
     ctypes signatures on first success."""
 
-    def __init__(self, src_path: str, lib_prefix: str, configure):
+    def __init__(self, src_path: str, lib_prefix: str, configure,
+                 link_flags: tuple[str, ...] = ()):
         self._src = src_path
         self._prefix = lib_prefix
         self._configure = configure
+        self._link_flags = link_flags
         self._lock = make_lock("native.build._lock")
         self._lib: ctypes.CDLL | None = None
         self._failed = False
@@ -69,7 +77,8 @@ class LazyLibrary:
         with self._lock:
             if self._lib is not None or self._failed:
                 return self._lib
-            path = build_library(self._src, self._prefix)
+            path = build_library(self._src, self._prefix,
+                                 self._link_flags)
             if path is None:
                 self._failed = True
                 return None

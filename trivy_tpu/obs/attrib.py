@@ -68,6 +68,9 @@ SPAN_LANES = {
     "analysis.fetch": "fetch_io",
     "rekor_sbom_discovery": "fetch_io",
     "analysis.walk": "host_crunch",
+    "analysis.lane": "host_crunch",
+    "analysis.split": "host_crunch",
+    "analysis.apply": "host_crunch",
     "apply_layers": "host_crunch",
     "secret_results": "host_crunch",
     "post_hooks": "host_crunch",
@@ -78,6 +81,7 @@ SPAN_LANES = {
     "sched.enqueue": "queue_wait",
     "sched.collect": "queue_wait",
     "analysis.await_fetch": "queue_wait",
+    "analysis.await_lane": "queue_wait",
     "analysis.dedupe.wait": "queue_wait",
     "sched.batch": "device_dispatch",
     "engine.dispatch": "device_dispatch",

@@ -484,6 +484,12 @@ ANALYSIS_PIPELINE_OCCUPANCY = REGISTRY.gauge(
     "Fraction of the last layer-analysis pipeline's wall-clock x lanes "
     "the fetch/walk stages were busy (1.0 = fetch of layer N+1 fully "
     "overlapped with analysis of layer N; ~0.5 = serial)")
+ANALYSIS_LANE_BUSY = REGISTRY.gauge(
+    "trivy_tpu_analysis_lane_busy",
+    "Per-lane busy fraction of the last multi-lane layer-analysis run "
+    "(lane k's walk seconds / scan wall seconds; lane counts are "
+    "clamped to 32 so the label set stays bounded)",
+    labels=("lane",), max_series=40)
 LAYERS_ANALYZED = REGISTRY.counter(
     "trivy_tpu_layers_analyzed_total",
     "Container layers actually walked+analyzed (cache misses that this "

@@ -5,6 +5,7 @@ pnpm-lock.yaml, and node_modules package.json."""
 from __future__ import annotations
 
 import json
+import os
 import re
 
 from trivy_tpu.types.artifact import Location, Package
@@ -64,11 +65,14 @@ _YARN_HEADER = re.compile(
 _YARN_VERSION = re.compile(r'^ {2}version:? "?(?P<v>[^"\s]+)"?$')
 
 
-def parse_yarn_lock(content: bytes) -> list[Package]:
+def _parse_yarn_lines(lines) -> list[Package]:
+    """State machine over (line_no, text) pairs; lines that can't
+    change the state (blank, comment, non-version body) may be
+    pre-filtered out by the caller."""
     out: dict[str, Package] = {}
     cur_name = None
     cur_line = 0
-    for i, line in enumerate(content.decode("utf-8", "replace").splitlines(), 1):
+    for i, line in lines:
         if not line or line.startswith("#"):
             continue
         if not line.startswith(" "):
@@ -84,6 +88,76 @@ def parse_yarn_lock(content: bytes) -> list[Package]:
                 out.setdefault(pkg.id, pkg)
                 cur_name = None
     return sorted(out.values(), key=lambda p: p.id)
+
+
+# ASCII control bytes that str.splitlines treats as line boundaries
+# beyond \n / \r\n / \r — their presence routes a document to the
+# scalar tokenizer so line numbering stays byte-for-byte equal
+_EXOTIC_BREAKS = (b"\x0b", b"\x0c", b"\x1c", b"\x1d", b"\x1e")
+
+_YARN_VECTOR_MIN = 4096
+
+
+def _yarn_lines_vectorized(content: bytes):
+    """Tokenize a yarn.lock with one numpy pass: find line boundaries
+    from the raw bytes, then classify each line by its first byte so
+    only header candidates and `  version` lines — the only lines that
+    can move the parser's state — are sliced and regex-matched.
+    ASCII-only (byte offsets == char offsets, and none of the unicode
+    line separators can appear); returns None when the document needs
+    the scalar path."""
+    if not content.isascii() or any(b in content for b in _EXOTIC_BREAKS):
+        return None
+    import numpy as np
+
+    buf = np.frombuffer(content, dtype=np.uint8)
+    n = buf.size
+    term = np.flatnonzero((buf == 0x0A) | (buf == 0x0D))
+    # the \n of a \r\n pair terminates nothing on its own
+    lone = ~((buf[term] == 0x0A) & (term > 0)
+             & (buf[np.maximum(term - 1, 0)] == 0x0D))
+    ends = term[lone]
+    nxt = ends + 1 + ((buf[ends] == 0x0D)
+                      & (ends + 1 < n)
+                      & (buf[np.minimum(ends + 1, n - 1)] == 0x0A))
+    starts = np.concatenate((np.zeros(1, dtype=ends.dtype), nxt))
+    if starts.size and starts[-1] >= n:        # trailing terminator:
+        starts = starts[:-1]                   # no final empty line
+    else:
+        ends = np.concatenate((ends, np.array([n], dtype=ends.dtype)))
+    if not starts.size:
+        return []
+
+    lens = ends - starts
+    first = buf[np.minimum(starts, n - 1)]
+    headers = (lens > 0) & (first != 0x23) & (first != 0x20)
+    versions = lens >= 9
+    if versions.any():
+        v = np.flatnonzero(versions)
+        probe = np.frombuffer(b"  version", dtype=np.uint8)
+        for k, ch in enumerate(probe):
+            v = v[buf[starts[v] + k] == ch]
+            if not v.size:
+                break
+        versions = np.zeros_like(versions)
+        versions[v] = True
+    keep = np.flatnonzero(headers | versions)
+    # one whole-document decode + str slices with python ints: the
+    # slice loop dominates once the boundary scan is vectorized
+    text = content.decode("ascii")
+    return [(i + 1, text[s:e])
+            for i, s, e in zip(keep.tolist(), starts[keep].tolist(),
+                               ends[keep].tolist())]
+
+
+def parse_yarn_lock(content: bytes) -> list[Package]:
+    if (len(content) >= _YARN_VECTOR_MIN
+            and os.environ.get("TRIVY_TPU_VECTOR_ANALYZERS", "1") != "0"):
+        lines = _yarn_lines_vectorized(content)
+        if lines is not None:
+            return _parse_yarn_lines(lines)
+    return _parse_yarn_lines(
+        enumerate(content.decode("utf-8", "replace").splitlines(), 1))
 
 
 def parse_pnpm_lock(content: bytes) -> list[Package]:

@@ -88,6 +88,7 @@ SITES: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("fleet.rollout", ("delay", "error", "kill")),
     ("fleet.controller", ("drop", "delay", "error", "kill")),
     ("analysis.fetch", ("drop", "delay", "error", "kill")),
+    ("analysis.lane", ("drop", "delay", "error", "kill")),
     ("fleet.scan", ("kill",)),
     ("journal.append", ("kill", "torn-write", "bitflip")),
     ("monitor.index", ("drop", "error", "kill", "torn-write", "bitflip")),
