@@ -1096,6 +1096,208 @@ def bench_usage() -> dict:
     return out
 
 
+def bench_wire() -> dict:
+    """Binary columnar wire rung (docs/performance.md "Binary columnar
+    wire"): M threaded keep-alive clients against a live server,
+    columnar vs JSON wire interleaved — images/s, p99 scan wall,
+    measured bytes-on-wire per scan (server-side usage metering), and
+    a pure decode microbench on one representative response.
+    Exit-gated on wire_diff_vs_json=0 (decoded columnar responses
+    re-encode to the JSON wire's exact bytes) plus columnar >=1.3x
+    throughput OR >=2x decode-time reduction, with the wire-bytes
+    conservation invariant green.  Written to BENCH_wire.json."""
+    import hashlib as _hashlib
+    import statistics
+    import threading
+
+    from trivy_tpu.cache.cache import MemoryCache
+    from trivy_tpu.detector.engine import MatchEngine
+    from trivy_tpu.obs import attrib as _attrib
+    from trivy_tpu.obs import metrics as _obs_metrics
+    from trivy_tpu.obs import usage as _usage
+    from trivy_tpu.rpc import columnar as _colwire
+    from trivy_tpu.rpc import wire as _wire
+    from trivy_tpu.rpc.client import RemoteDriver
+    from trivy_tpu.rpc.server import Server
+    from trivy_tpu.tensorize.synth import synth_queries, synth_trivy_db
+    from trivy_tpu.types.scan import ScanOptions
+
+    n_clients = int(os.environ.get("TRIVY_TPU_BENCH_WIRE_CLIENTS", "6"))
+    per_client = int(os.environ.get("TRIVY_TPU_BENCH_WIRE_SCANS", "8"))
+    rounds = 3
+    db = synth_trivy_db(n_advisories=6_000)
+    engine = MatchEngine(db, use_device=False)
+    pool = [q for q in synth_queries(db, 20_000, seed=23)
+            if q.space == "npm::"]
+    if not pool:
+        return {"error": "no npm queries in synthetic pool"}
+    cache = MemoryCache()
+    rng = random.Random(19)
+    artifacts = []
+    sizes = [120, 360, 900]
+    for i in range(n_clients * 2):
+        pkgs = []
+        for _ in range(sizes[i % len(sizes)]):
+            q = pool[rng.randrange(len(pool))]
+            pkgs.append({"id": f"{q.name}@{q.version}", "name": q.name,
+                         "version": q.version})
+        key = f"sha256:wire{i}"
+        cache.put_blob(key, {"schema_version": 2, "applications": [{
+            "type": "npm", "file_path": f"img{i}/package-lock.json",
+            "packages": pkgs}]})
+        artifacts.append((f"img{i}", key))
+
+    srv = Server(engine, cache, host="localhost", port=0)
+    srv.start()
+
+    def reset_meters() -> None:
+        _usage.USAGE.reset()
+        _attrib.AGG.reset()
+        _obs_metrics.ATTRIB_LANE_SECONDS.clear()
+        _obs_metrics.TENANT_LANE_SECONDS.clear()
+
+    def run_round() -> dict:
+        """One M-client pass under the CURRENT TRIVY_TPU_WIRE setting
+        -> rate, walls, re-encoded-JSON digests, wire bytes/scan."""
+        reset_meters()
+        errs: list[Exception] = []
+        walls: list[float] = []
+        hashes: list[str] = []
+        lock = threading.Lock()
+
+        def worker(ci: int):
+            try:
+                driver = RemoteDriver(srv.address)
+                for k in range(per_client):
+                    target, key = artifacts[(ci * per_client + k)
+                                            % len(artifacts)]
+                    t0 = time.time()
+                    results, os_found = driver.scan(
+                        target, "", [key], ScanOptions())
+                    wall = time.time() - t0
+                    # zero-diff oracle: whatever wire carried the
+                    # response, the DECODED objects must re-encode to
+                    # the JSON wire's exact bytes
+                    digest = _hashlib.sha256(_wire.scan_response(
+                        results, os_found)).hexdigest()
+                    with lock:
+                        walls.append(wall)
+                        hashes.append(digest)
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errs.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(ci,))
+                   for ci in range(n_clients)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+        if errs:
+            raise errs[0]
+        snap = _usage.USAGE.snapshot()
+        fields = snap["totals"]["fields"]
+        n = n_clients * per_client
+        return {
+            "rate": n / wall,
+            "walls": walls,
+            "hashes": sorted(hashes),
+            "bytes_out_per_scan": fields.get("wire_bytes_out", 0.0) / n,
+            "bytes_in_per_scan": fields.get("wire_bytes_in", 0.0) / n,
+            "conservation_ok": snap["conservation"]["ok"],
+        }
+
+    def p99_ms(walls: list[float]) -> float:
+        s = sorted(walls)
+        return round(s[min(len(s) - 1, int(0.99 * len(s)))] * 1e3, 2)
+
+    prev_wire = os.environ.get("TRIVY_TPU_WIRE")
+    try:
+        # warm both modes outside timing (jit shapes, crawl cache, and
+        # the columnar capability handshake's first-request JSON hop)
+        os.environ["TRIVY_TPU_WIRE"] = "1"
+        run_round()
+        os.environ["TRIVY_TPU_WIRE"] = "0"
+        run_round()
+        col_rounds, json_rounds = [], []
+        for _ in range(rounds):
+            os.environ["TRIVY_TPU_WIRE"] = "1"
+            col_rounds.append(run_round())
+            os.environ["TRIVY_TPU_WIRE"] = "0"
+            json_rounds.append(run_round())
+
+        # decode microbench: one representative (vuln-heavy) response
+        # encoded both ways once, then pure decode timings
+        os.environ["TRIVY_TPU_WIRE"] = "0"
+        drv = RemoteDriver(srv.address)
+        big = max(artifacts, key=lambda a: int(a[1][len("sha256:wire"):]))
+        results, os_found = drv.scan(big[0], "", [big[1]], ScanOptions())
+        drv.close()
+        json_body = _wire.scan_response(results, os_found)
+        col_body = _colwire.encode_scan_response(results, os_found)
+        n_iter = 30
+
+        def timed(fn, body) -> float:
+            fn(body)  # warm
+            t0 = time.perf_counter()
+            for _ in range(n_iter):
+                fn(body)
+            return (time.perf_counter() - t0) / n_iter
+
+        json_dec_s = timed(_wire.decode_scan_response, json_body)
+        col_dec_s = timed(_colwire.decode_scan_response, col_body)
+    finally:
+        if prev_wire is None:
+            os.environ.pop("TRIVY_TPU_WIRE", None)
+        else:
+            os.environ["TRIVY_TPU_WIRE"] = prev_wire
+        srv.shutdown()
+
+    col_med = statistics.median(r["rate"] for r in col_rounds)
+    json_med = statistics.median(r["rate"] for r in json_rounds)
+    wire_diff = sum(
+        1 for a, b in zip(col_rounds[0]["hashes"],
+                          json_rounds[0]["hashes"]) if a != b
+    ) + abs(len(col_rounds[0]["hashes"]) - len(json_rounds[0]["hashes"]))
+    out = {
+        "clients": n_clients,
+        "scans_per_client": per_client,
+        "columnar_images_per_s": round(col_med, 1),
+        "json_images_per_s": round(json_med, 1),
+        "throughput_ratio": round(col_med / json_med, 2)
+        if json_med else 0.0,
+        "columnar_p99_ms": p99_ms(
+            [w for r in col_rounds for w in r["walls"]]),
+        "json_p99_ms": p99_ms(
+            [w for r in json_rounds for w in r["walls"]]),
+        "columnar_bytes_out_per_scan": round(
+            statistics.median(r["bytes_out_per_scan"]
+                              for r in col_rounds), 1),
+        "json_bytes_out_per_scan": round(
+            statistics.median(r["bytes_out_per_scan"]
+                              for r in json_rounds), 1),
+        "decode_ms_json": round(json_dec_s * 1e3, 3),
+        "decode_ms_columnar": round(col_dec_s * 1e3, 3),
+        "decode_speedup": round(json_dec_s / col_dec_s, 2)
+        if col_dec_s else 0.0,
+        "wire_diff_vs_json": wire_diff,
+        "conservation_ok": all(
+            r["conservation_ok"] for r in col_rounds + json_rounds),
+    }
+    fails = []
+    if out["wire_diff_vs_json"]:
+        fails.append(f"wire_diff_vs_json={out['wire_diff_vs_json']}")
+    if out["throughput_ratio"] < 1.3 and out["decode_speedup"] < 2.0:
+        fails.append(f"throughput_ratio={out['throughput_ratio']}<1.3 "
+                     f"and decode_speedup={out['decode_speedup']}<2.0")
+    if not out["conservation_ok"]:
+        fails.append("conservation_ok=False")
+    if fails:
+        out["error"] = "wire gate failed: " + ", ".join(fails)
+    return out
+
+
 def bench_selfdrive() -> dict:
     """Self-driving rung (docs/fleet.md "Self-driving fleet"): a
     synthetic diurnal-load day against an in-process replica fleet.
@@ -2831,6 +3033,7 @@ _TREND_HEADLINES = {
     "fleetobs": ("scrape_merge_wall_s_median", "lower"),
     "selfdrive": ("wall_s", "lower"),
     "usage": ("scans_per_s", "higher"),
+    "wire": ("columnar_images_per_s", "higher"),
 }
 _TREND_TOLERANCE = 0.20
 
@@ -3071,6 +3274,29 @@ def main():
                             {"scans_per_s": detail["scans_per_s"]})
         else:
             print(f"BENCH_STATUS=usage_gate_failed {detail['error']}",
+                  file=sys.stderr)
+        return 1 if (detail.get("error") or lint_rc) else 0
+    if "--wire" in sys.argv:
+        # standalone binary-columnar-wire rung (CPU-only, no device
+        # probe): the quick way to refresh BENCH_wire.json.  Runs the
+        # invariant-lint gate like every supervised rung.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        lint_rc = _lint_gate()
+        detail = bench_wire()
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_wire.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(detail, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(detail, indent=2, sort_keys=True))
+        if not detail.get("error"):
+            _history_append("wire", {
+                "columnar_images_per_s":
+                    detail.get("columnar_images_per_s", 0)})
+        else:
+            print(f"BENCH_STATUS=wire_gate_failed {detail['error']}",
                   file=sys.stderr)
         return 1 if (detail.get("error") or lint_rc) else 0
     if "--dcn" in sys.argv:

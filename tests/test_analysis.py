@@ -642,7 +642,8 @@ class TestKnobs:
                 "TRIVY_TPU_ATTRIB", "TRIVY_TPU_FLEET",
                 "TRIVY_TPU_FLEET_EVENTS",
                 "TRIVY_TPU_CONTROLLER", "TRIVY_TPU_USAGE",
-                "TRIVY_TPU_NATIVE_SPLIT",
+                "TRIVY_TPU_NATIVE_SPLIT", "TRIVY_TPU_WIRE",
+                "TRIVY_TPU_QOS",
                 "TRIVY_TPU_VECTOR_ANALYZERS"} == names
 
     def test_write_knobs_doc_roundtrip(self, tmp_path, capsys):

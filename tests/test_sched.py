@@ -21,7 +21,7 @@ from trivy_tpu.resilience.retry import Deadline, deadline_scope
 from trivy_tpu.rpc import wire
 from trivy_tpu.rpc.client import RemoteCache, RemoteDriver
 from trivy_tpu.rpc.server import Overloaded, ScanService, Server
-from trivy_tpu.sched.scheduler import MatchScheduler
+from trivy_tpu.sched.scheduler import MatchScheduler, _Pending
 from trivy_tpu.types.scan import ScanOptions
 
 pytestmark = pytest.mark.sched
@@ -677,3 +677,135 @@ def test_secret_probe_env_kill_switch(monkeypatch):
 
     assert S()._hybrid_device_ok() is True
     assert sec._HYBRID_PROBE is None  # probe never ran
+
+
+# ------------------------------------------------- per-tenant QoS (DRR)
+
+
+def _drained_sched(**kw) -> MatchScheduler:
+    """A scheduler whose thread has exited: ``_compose`` can then be
+    driven synchronously against hand-built pendings, making the DRR
+    interleave a deterministic unit under test."""
+    s = MatchScheduler(lambda: None, window_ms=0, **kw)
+    with s._cond:
+        s._stopping = True
+        s._cond.notify_all()
+    s._thread.join(5)
+    assert not s._thread.is_alive()
+    s._stopping = False
+    return s
+
+
+def _pend(rows: int, seq: int, tenant: str) -> _Pending:
+    p = _Pending(list(range(rows)), None, seq)
+    p.tenant = tenant
+    return p
+
+
+def _compose_all(s: MatchScheduler, pendings) -> list[tuple]:
+    """Drain every pending through repeated _compose calls ->
+    [(seq, lo, hi)] in emission order."""
+    with s._cond:
+        s._waiting = list(pendings)
+    out = []
+    while True:
+        with s._cond:
+            if not s._waiting:
+                break
+        parts, _rows = s._compose()
+        out.extend((p.seq, lo, hi) for p, lo, hi in parts)
+    return out
+
+
+def test_qos_single_tenant_zero_diff(monkeypatch):
+    """With one tenant at weight 1 the DRR compose emits the exact
+    chunk sequence of the historical request-level round-robin — the
+    zero-diff guarantee that makes QoS safe-on-by-default."""
+    sizes = [200, 50, 130, 470, 64]
+
+    def run() -> list[tuple]:
+        s = _drained_sched(chunk_rows=64, max_rows=256, max_queue=64)
+        try:
+            return _compose_all(
+                s, [_pend(n, i + 1, "tA") for i, n in enumerate(sizes)])
+        finally:
+            s._stopping = True
+
+    with_qos = run()
+    monkeypatch.setenv("TRIVY_TPU_QOS", "0")
+    without_qos = run()
+    assert with_qos == without_qos
+    assert sum(hi - lo for _seq, lo, hi in with_qos) == sum(sizes)
+
+
+def test_qos_starvation_bound():
+    """A greedy tenant with 3 large queued requests cannot starve a
+    small interactive tenant: DRR gives the small tenant one chunk per
+    round (its fair share by TENANT, not by request count), so its two
+    chunks land in the first four emissions instead of trailing the
+    greedy tenant's backlog."""
+    mk = lambda: ([_pend(640, i + 1, "tGreedy") for i in range(3)]  # noqa: E731
+                  + [_pend(128, 4, "tSmall")])
+    s = _drained_sched(chunk_rows=64, max_rows=1 << 20, max_queue=64)
+    try:
+        qos_parts = _compose_all(s, mk())
+    finally:
+        s._stopping = True
+    small_last = max(i for i, (seq, _lo, _hi) in enumerate(qos_parts)
+                     if seq == 4)
+    assert small_last <= 3
+    # the request-level interleave (QoS off) would make the small
+    # tenant wait on one slot in four: strictly worse
+    greedy_before = sum(hi - lo for seq, lo, hi
+                        in qos_parts[:small_last] if seq != 4)
+    assert greedy_before <= 2 * 64
+
+
+def test_qos_weights_shift_share(monkeypatch):
+    """TRIVY_TPU_QOS_WEIGHTS=<tenant>=2 banks two quanta per round:
+    the weighted tenant emits two chunks (rotating across its own
+    requests) for every one of an unweighted tenant's."""
+    monkeypatch.setenv("TRIVY_TPU_QOS_WEIGHTS", "tHeavy=2")
+    s = _drained_sched(chunk_rows=64, max_rows=1 << 20, max_queue=64)
+    try:
+        parts = _compose_all(
+            s, [_pend(640, 1, "tHeavy"), _pend(640, 2, "tHeavy"),
+                _pend(128, 3, "tLight")])
+    finally:
+        s._stopping = True
+    tenants = ["H" if seq in (1, 2) else "L" for seq, _lo, _hi in parts]
+    # while both tenants have queued rows: two heavy chunks per light
+    assert tenants[:6] == ["H", "H", "L", "H", "H", "L"]
+
+
+def test_qos_tenant_queue_cap_sheds(monkeypatch):
+    """TRIVY_TPU_QOS_TENANT_QUEUE caps one tenant's waiting requests:
+    the over-cap submission sheds (Overloaded + the per-tenant sheds
+    counter) while other tenants keep their slots."""
+    from trivy_tpu.obs import usage
+
+    monkeypatch.setenv("TRIVY_TPU_QOS_TENANT_QUEUE", "2")
+    sheds0 = obs_metrics.QOS_QUEUE_SHEDS.value(tenant="tGreedy")
+    # a huge window + busy_fn > 1 holds the coalesce open so the queue
+    # stays populated while we probe the admission path
+    s = MatchScheduler(lambda: None, window_ms=60000, max_rows=1 << 30,
+                       max_queue=16, busy_fn=lambda: 2)
+    try:
+        with usage.scope("tGreedy"):
+            s.submit_async([0] * 8)
+            s.submit_async([0] * 8)
+            with pytest.raises(Overloaded):
+                s.submit_async([0] * 8)
+        with usage.scope("tOther"):
+            s.submit_async([0] * 8)  # other tenants are unaffected
+        assert obs_metrics.QOS_QUEUE_SHEDS.value(tenant="tGreedy") == \
+            sheds0 + 1
+        assert s.stats["sheds"] == 1
+    finally:
+        with s._cond:
+            for p in s._waiting:
+                p.done.set()
+            s._waiting.clear()
+            s._stopping = True
+            s._cond.notify_all()
+        s._thread.join(5)

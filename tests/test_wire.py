@@ -1,0 +1,470 @@
+"""Binary columnar RPC wire (rpc/columnar.py): frame codec + CRC
+integrity, negotiation ladder (offer / advertise / learn / unlearn),
+old-client JSON byte-identity, the rpc.wire fault ladder, and
+mixed-capability fleet routing (docs/performance.md "Binary columnar
+wire")."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import struct
+from urllib.parse import urlsplit
+
+import pytest
+
+from trivy_tpu.cache.cache import MemoryCache
+from trivy_tpu.db import Advisory, AdvisoryDB
+from trivy_tpu.db.model import VulnerabilityMeta
+from trivy_tpu.detector.engine import MatchEngine, PkgQuery
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.resilience import faults
+from trivy_tpu.resilience.retry import RetryPolicy
+from trivy_tpu.rpc import columnar as colwire
+from trivy_tpu.rpc import wire
+from trivy_tpu.rpc.client import RemoteCache, RemoteDriver, _Conn
+from trivy_tpu.rpc.server import CACHE_PREFIX, SCAN_PATH, Server
+from trivy_tpu.types.scan import ScanOptions
+
+N_PKGS = 16
+
+FAST_RETRY = RetryPolicy(attempts=3, base_s=0.005, cap_s=0.01)
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _db() -> AdvisoryDB:
+    db = AdvisoryDB()
+    for i in range(N_PKGS):
+        db.put_advisory("npm::ghsa", f"pkg{i}", Advisory(
+            vulnerability_id=f"CVE-2026-{1000 + i}",
+            vulnerable_versions=[f"<{(i % 4) + 2}.0.0"],
+            fixed_version=f"{(i % 4) + 2}.0.0",
+        ))
+        db.put_meta(VulnerabilityMeta.from_json(f"CVE-2026-{1000 + i}", {
+            "Title": f"bug {i}", "Severity": "HIGH",
+            "CweIDs": ["CWE-79", "CWE-89"],
+            "References": [f"https://example.com/{i}"],
+        }))
+    return db
+
+
+def _blob(n: int = N_PKGS) -> dict:
+    return {"schema_version": 2, "applications": [{
+        "type": "npm", "file_path": "package-lock.json",
+        "packages": [{
+            "id": f"pkg{i}@1.0.0", "name": f"pkg{i}", "version": "1.0.0",
+            "identifier": {"purl": f"pkg:npm/pkg{i}@1.0.0"},
+        } for i in range(n)]}]}
+
+
+@pytest.fixture()
+def server():
+    engine = MatchEngine(_db(), use_device=False)
+    cache = MemoryCache()
+    cache.put_blob("sha256:b1", _blob())
+    srv = Server(engine, cache, host="localhost", port=0)
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def _scan_results(srv):
+    return srv.service.scan("img1", "", ["sha256:b1"],
+                            ScanOptions(list_all_pkgs=True))
+
+
+def _raw_post(addr: str, path: str, body: bytes, headers: dict):
+    netloc = urlsplit(addr).netloc
+    c = http.client.HTTPConnection(netloc, timeout=30)
+    try:
+        c.request("POST", path, body=body, headers=headers)
+        r = c.getresponse()
+        return r.status, r.headers, r.read()
+    finally:
+        c.close()
+
+
+def _json_only(srv) -> None:
+    """Turn `srv` into a pre-columnar replica in place (a rolled-back
+    binary): no capability header, no columnar Accept, 400 on columnar
+    request bodies — the fleet-rollout rollback the unlearn ladder is
+    built for."""
+    H = srv.httpd.RequestHandlerClass
+    orig_send = H.send_header
+
+    def send_header(self, name, value):
+        if name == colwire.CAPABLE_HEADER:
+            return
+        orig_send(self, name, value)
+
+    H.send_header = send_header
+    H._accepts_columnar = lambda self: False
+    orig_post = H.do_POST
+
+    def do_POST(self):
+        ctype = self.headers.get("Content-Type") or ""
+        if ctype.startswith(colwire.CONTENT_TYPE):
+            # drain the body so the keep-alive socket stays parseable
+            self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            self._error(400, "unsupported content type")
+            return
+        orig_post(self)
+
+    H.do_POST = do_POST
+
+
+# ========================================================== frame codec
+
+
+class TestFrameCodec:
+    def test_scan_request_roundtrip(self):
+        opts = ScanOptions(list_all_pkgs=True)
+        body = colwire.encode_scan_request(
+            "img", "sha256:a", ["sha256:b", "sha256:c"], opts)
+        assert colwire.is_columnar(body)
+        target, akey, blobs, got = colwire.decode_scan_request(body)
+        assert (target, akey, blobs) == \
+            ("img", "sha256:a", ["sha256:b", "sha256:c"])
+        assert wire._jsonable(got) == wire._jsonable(opts)
+
+    def test_missing_blobs_roundtrip(self):
+        body = colwire.encode_missing_blobs("sha256:a", ["x", "y", "z"])
+        assert colwire.decode_missing_blobs(body) == \
+            ("sha256:a", ["x", "y", "z"])
+        resp = colwire.encode_missing_response(False, ["y"])
+        assert colwire.decode_missing_response(resp) == (False, ["y"])
+
+    def test_put_blob_roundtrip_exact(self):
+        blob = _blob(5)
+        # odd shapes the codec must preserve exactly: a package with
+        # extra nested keys, an app with an EMPTY package list, an app
+        # with NO packages key, unicode text
+        blob["applications"][0]["packages"][0]["licenses"] = ["MIT"]
+        blob["applications"][0]["packages"][1]["name"] = "päkg"
+        blob["applications"].append(
+            {"type": "pip", "file_path": "req.txt", "packages": []})
+        blob["applications"].append(
+            {"type": "gobinary", "file_path": "app"})
+        blob["os"] = {"family": "alpine", "name": "3.20"}
+        diff_id, got = colwire.decode_put_blob(
+            colwire.encode_put_blob("sha256:zz", blob))
+        assert diff_id == "sha256:zz"
+        assert got == blob
+
+    def test_empty_applications_list_preserved(self):
+        blob = {"schema_version": 2, "applications": []}
+        _, got = colwire.decode_put_blob(
+            colwire.encode_put_blob("d", blob))
+        assert got == blob
+        blob2 = {"schema_version": 2}
+        _, got2 = colwire.decode_put_blob(
+            colwire.encode_put_blob("d", blob2))
+        assert got2 == blob2
+
+    def test_queries_roundtrip(self):
+        qs = [PkgQuery("npm::", f"pkg{i}", f"{i}.1.0", "npm")
+              for i in range(7)]
+        got = colwire.decode_queries(colwire.encode_queries(qs))
+        assert [(q.space, q.name, q.version, q.scheme_name)
+                for q in got] == \
+            [(q.space, q.name, q.version, q.scheme_name) for q in qs]
+
+    def test_crc_mismatch_rejected(self):
+        body = colwire.encode_missing_blobs(
+            "sha256:a", [f"sha256:{i}" for i in range(40)])
+        # flip a byte inside the blob_ids frame payload (well past the
+        # env frame, well before the end frame)
+        mid = len(body) // 2
+        bad = body[:mid] + bytes([body[mid] ^ 0xFF]) + body[mid + 1:]
+        with pytest.raises(colwire.WireFormatError):
+            colwire.decode_missing_blobs(bad)
+
+    def test_truncated_stream_rejected(self):
+        body = colwire.encode_missing_blobs("sha256:a", ["x", "y"])
+        with pytest.raises(colwire.WireFormatError):
+            list(colwire.frames(body[:-4]))
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(colwire.WireFormatError):
+            list(colwire.frames(b"JUNK" + b"\x00" * 32))
+
+    def test_large_frame_deflates(self):
+        ids = [f"sha256:{'ab' * 40}{i:06d}" for i in range(200)]
+        body = colwire.encode_missing_blobs("sha256:a", ids)
+        kinds = {}
+        for header, _payload in colwire.frames(body):
+            kinds[header["k"]] = header
+        assert kinds["blob_ids"]["z"] == 1
+        assert colwire.decode_missing_blobs(body) == ("sha256:a", ids)
+
+    def test_header_length_is_le_u32(self):
+        body = colwire.encode_missing_blobs("a", [])
+        (hlen,) = struct.unpack_from("<I", body, len(colwire.MAGIC))
+        header = json.loads(
+            body[len(colwire.MAGIC) + 4:len(colwire.MAGIC) + 4 + hlen])
+        assert header["k"] == "env"
+
+
+# ===================================================== scan-response table
+
+
+class TestScanResponse:
+    def test_decode_equals_json_path(self, server):
+        results, os_found = _scan_results(server)
+        assert results and results[0].vulnerabilities
+        assert results[0].packages  # list_all_pkgs rides the table too
+        body = colwire.encode_scan_response(results, os_found)
+        got_results, got_os = colwire.decode_scan_response(body)
+        # zero-diff oracle: re-encoding the decoded objects through the
+        # JSON wire yields the JSON wire's exact bytes
+        assert wire.scan_response(got_results, got_os) == \
+            wire.scan_response(results, os_found)
+
+    def test_packages_ride_the_deflated_payload(self, server):
+        # the result's package list must travel inside the (deflated)
+        # npz payload, NOT as uncompressed frame-header JSON — that
+        # regression tripled bytes-on-wire for list_all_pkgs scans
+        results, os_found = _scan_results(server)
+        body = colwire.encode_scan_response(results, os_found)
+        for header, _payload in colwire.frames(body):
+            if header["k"] == "result":
+                assert "env" not in header
+                assert "packages" not in json.dumps(header)
+
+
+# ========================================================== negotiation
+
+
+class TestNegotiation:
+    def test_old_client_json_byte_identical(self, server):
+        """A header-less pre-columnar client keeps today's exact JSON
+        bytes: no columnar frames, no content-encoding surprises."""
+        expect = wire.scan_response(*_scan_results(server))
+        body = wire.scan_request("img1", "", ["sha256:b1"],
+                                 ScanOptions(list_all_pkgs=True))
+        status, rhdrs, raw = _raw_post(
+            server.address, SCAN_PATH, body,
+            {"Content-Type": "application/json",
+             "X-Trivy-Tpu-Wire": "internal"})
+        assert status == 200
+        assert rhdrs.get("Content-Type") == "application/json"
+        assert rhdrs.get("Content-Encoding") is None
+        assert not colwire.is_columnar(raw)
+        assert raw == expect
+
+    def test_capability_ladder_learns_then_sends_columnar(self, server):
+        conn = _Conn(server.address, retry=FAST_RETRY)
+        thunk = lambda: colwire.encode_missing_blobs(  # noqa: E731
+            "sha256:a", ["sha256:b1", "sha256:nope"])
+        body = wire.encode({"artifact_id": "sha256:a",
+                            "blob_ids": ["sha256:b1", "sha256:nope"]})
+        col_in0 = obs_metrics.WIRE_REQUESTS.value(
+            format="columnar", direction="in")
+        assert conn._server_columnar is False
+        raw = conn.post(CACHE_PREFIX + "MissingBlobs", body,
+                        columnar=thunk)
+        # request #1 went out JSON (capability not yet learned) but the
+        # RESPONSE is already columnar (the Accept offer), and the
+        # X-Trivy-Columnar advertisement taught the conn
+        assert colwire.is_columnar(raw)
+        assert colwire.decode_missing_response(raw) == \
+            (True, ["sha256:nope"])
+        assert conn._server_columnar is True
+        assert obs_metrics.WIRE_REQUESTS.value(
+            format="columnar", direction="in") == col_in0
+        # request #2 ships a columnar BODY
+        raw = conn.post(CACHE_PREFIX + "MissingBlobs", body,
+                        columnar=thunk)
+        assert colwire.decode_missing_response(raw) == \
+            (True, ["sha256:nope"])
+        assert obs_metrics.WIRE_REQUESTS.value(
+            format="columnar", direction="in") == col_in0 + 1
+
+    def test_streamed_scan_response_decodes_equal(self, server):
+        expect = wire.scan_response(*_scan_results(server))
+        driver = RemoteDriver(server.address, retry=FAST_RETRY)
+        results, os_found = driver.scan(
+            "img1", "", ["sha256:b1"], ScanOptions(list_all_pkgs=True))
+        assert wire.scan_response(results, os_found) == expect
+        driver.close()
+
+    def test_client_kill_switch(self, server, monkeypatch):
+        monkeypatch.setenv(colwire.ENV_KILL, "0")
+        conn = _Conn(server.address, retry=FAST_RETRY)
+        body = wire.encode({"artifact_id": "sha256:a", "blob_ids": []})
+        raw = conn.post(CACHE_PREFIX + "MissingBlobs", body,
+                        columnar=lambda: colwire.encode_missing_blobs(
+                            "sha256:a", []))
+        assert not colwire.is_columnar(raw)
+        assert json.loads(raw)["missing_artifact"] is True
+
+    def test_server_kill_switch_rejects_columnar(self, server,
+                                                 monkeypatch):
+        monkeypatch.setenv(colwire.ENV_KILL, "0")
+        body = colwire.encode_missing_blobs("sha256:a", [])
+        status, rhdrs, _raw = _raw_post(
+            server.address, CACHE_PREFIX + "MissingBlobs", body,
+            {"Content-Type": colwire.CONTENT_TYPE,
+             "X-Trivy-Tpu-Wire": "internal"})
+        # the 400 goes out WITHOUT the capability header: that pair is
+        # what drives a columnar client's unlearn after a rollback
+        assert status == 400
+        assert rhdrs.get(colwire.CAPABLE_HEADER) is None
+
+    def test_capability_unlearn_after_rollback(self, server):
+        conn = _Conn(server.address, retry=FAST_RETRY)
+        thunk = lambda: colwire.encode_missing_blobs(  # noqa: E731
+            "sha256:a", ["sha256:b1"])
+        body = wire.encode({"artifact_id": "sha256:a",
+                            "blob_ids": ["sha256:b1"]})
+        conn.post(CACHE_PREFIX + "MissingBlobs", body, columnar=thunk)
+        assert conn._server_columnar is True
+        # the replica rolls back to a pre-columnar binary mid-session
+        _json_only(server)
+        unlearn0 = obs_metrics.WIRE_FALLBACKS.value(reason="unlearn")
+        raw = conn.post(CACHE_PREFIX + "MissingBlobs", body,
+                        columnar=thunk)
+        # the 400-without-header unlearned the capability and the
+        # granted retry resent JSON — the call still succeeds
+        assert not colwire.is_columnar(raw)
+        assert json.loads(raw)["missing_artifact"] is True
+        assert conn._server_columnar is False
+        assert obs_metrics.WIRE_FALLBACKS.value(reason="unlearn") == \
+            unlearn0 + 1
+
+
+# ===================================================== rpc.wire faults
+
+
+@pytest.mark.fault
+class TestWireFaultLadder:
+    def _learned_conn(self, server):
+        conn = _Conn(server.address, retry=FAST_RETRY)
+        body = wire.encode({"artifact_id": "sha256:a",
+                            "blob_ids": ["sha256:b1"]})
+        thunk = lambda: colwire.encode_missing_blobs(  # noqa: E731
+            "sha256:a", ["sha256:b1"])
+        conn.post(CACHE_PREFIX + "MissingBlobs", body, columnar=thunk)
+        assert conn._server_columnar is True
+        return conn, body, thunk
+
+    def test_drop_renegotiates_to_json(self, server):
+        conn, body, thunk = self._learned_conn(server)
+        drops0 = obs_metrics.WIRE_FALLBACKS.value(reason="drop")
+        faults.install_spec("rpc.wire:drop@1")
+        raw = conn.post(CACHE_PREFIX + "MissingBlobs", body,
+                        columnar=thunk)
+        assert colwire.decode_missing_response(raw) == (True, [])
+        # the retry renegotiated (JSON request), and the 2xx response's
+        # advertisement re-learned the capability
+        assert conn._server_columnar is True
+        assert obs_metrics.WIRE_FALLBACKS.value(reason="drop") == \
+            drops0 + 1
+
+    def test_error_twice_falls_back_json(self, server):
+        conn, body, thunk = self._learned_conn(server)
+        errs0 = obs_metrics.WIRE_FALLBACKS.value(reason="error")
+        faults.install_spec("rpc.wire:error@1-2")
+        raw = conn.post(CACHE_PREFIX + "MissingBlobs", body,
+                        columnar=thunk)
+        # one columnar retry was spent, the second error fell the call
+        # back to JSON for good — still a success for the caller
+        assert colwire.decode_missing_response(raw) == (True, [])
+        assert obs_metrics.WIRE_FALLBACKS.value(reason="error") == \
+            errs0 + 1
+
+    def test_corrupt_frames_rejected_then_json_resend(self, server):
+        conn, body, thunk = self._learned_conn(server)
+        cor0 = obs_metrics.WIRE_FALLBACKS.value(reason="corrupt")
+        faults.install_spec("rpc.wire:corrupt@1")
+        raw = conn.post(CACHE_PREFIX + "MissingBlobs", body,
+                        columnar=thunk)
+        # the server 400'd the mangled frames (checksum reject) while
+        # still advertising capability, so the client resent THIS call
+        # as JSON without unlearning
+        assert colwire.decode_missing_response(raw) == (True, [])
+        assert conn._server_columnar is True
+        assert obs_metrics.WIRE_FALLBACKS.value(reason="corrupt") == \
+            cor0 + 1
+
+    def test_delay_only_slows(self, server):
+        conn, body, thunk = self._learned_conn(server)
+        faults.install_spec("rpc.wire:delay=0.01@1")
+        raw = conn.post(CACHE_PREFIX + "MissingBlobs", body,
+                        columnar=thunk)
+        assert colwire.decode_missing_response(raw) == (True, [])
+
+
+# ================================================ mixed-capability fleet
+
+
+@pytest.mark.fleet
+class TestMixedFleet:
+    @pytest.fixture()
+    def fleet(self):
+        engine = MatchEngine(_db(), use_device=False)
+        cache = MemoryCache()
+        cache.put_blob("sha256:b1", _blob())
+        servers = [Server(engine, cache, host="localhost", port=0)
+                   for _ in range(3)]
+        for s in servers:
+            s.start()
+        # replica #2 never rolled forward: a JSON-only binary
+        _json_only(servers[2])
+        yield servers
+        for s in servers:
+            s.shutdown()
+
+    def test_mixed_fleet_byte_identical_with_failover(self, fleet):
+        expect = wire.scan_response(*_scan_results(fleet[0]))
+        urls = ",".join(s.address for s in fleet)
+        driver = RemoteDriver(urls, retry=FAST_RETRY)
+        try:
+            # enough scans that round-robin routing touches every
+            # replica, columnar-capable and JSON-only alike
+            for _ in range(6):
+                results, os_found = driver.scan(
+                    "img1", "", ["sha256:b1"],
+                    ScanOptions(list_all_pkgs=True))
+                assert wire.scan_response(results, os_found) == expect
+            by_url = {ep.url: ep for ep in driver.conn._live()}
+            # the JSON-only replica never advertised, so its per-
+            # replica conn never learned the capability
+            assert by_url[fleet[2].address].conn._server_columnar \
+                is False
+            # capability is learned per replica: at least one rolled-
+            # forward replica negotiated columnar
+            assert any(ep.conn._server_columnar
+                       for ep in driver.conn._live()
+                       if ep.url != fleet[2].address)
+            # failover: kill a columnar-capable replica mid-run, the
+            # survivors (including the JSON-only one) keep the exact
+            # same bytes
+            fleet[0].shutdown()
+            for _ in range(4):
+                results, os_found = driver.scan(
+                    "img1", "", ["sha256:b1"],
+                    ScanOptions(list_all_pkgs=True))
+                assert wire.scan_response(results, os_found) == expect
+        finally:
+            driver.close()
+
+    def test_mixed_fleet_cache_writes(self, fleet):
+        urls = ",".join(s.address for s in fleet)
+        cache = RemoteCache(urls, retry=FAST_RETRY)
+        try:
+            for i in range(6):
+                cache.put_blob(f"sha256:w{i}", _blob(3))
+            for i in range(6):
+                missing_artifact, missing = cache.missing_blobs(
+                    f"sha256:art{i}", [f"sha256:w{i}"])
+                assert missing_artifact is True
+                assert missing == []
+        finally:
+            cache.close()

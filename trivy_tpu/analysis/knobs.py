@@ -51,6 +51,19 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TRIVY_TPU_SCHED", "1", "sched", True,
          "Cross-request match scheduler; 0 restores the exact "
          "per-request detect path."),
+    Knob("TRIVY_TPU_QOS", "1", "sched", True,
+         "Per-tenant weighted fair-share on the coalesce queue "
+         "(deficit round-robin over chunk interleaving); 0 restores "
+         "the tenant-blind oldest-deadline-first compose."),
+    Knob("TRIVY_TPU_QOS_TENANT_QUEUE", "", "sched", False,
+         "Per-tenant queue-depth cap on the match scheduler; a "
+         "tenant over its cap is shed (503 + Retry-After) while "
+         "other tenants keep enqueueing. Unset/0 = the global "
+         "--sched-max-queue only."),
+    Knob("TRIVY_TPU_QOS_WEIGHTS", "", "sched", False,
+         "Comma list of tenant=weight fair-share weights for the "
+         "QoS compose, e.g. 'abc123=3,*=1' ('*' sets the default "
+         "weight). Unset = every tenant weight 1."),
     # --- serving mesh
     Knob("TRIVY_TPU_MESH", "", "ops", False,
          "Serving-mesh topology: 'DPxDB' (e.g. 2x4), 'auto' (sized "
@@ -208,6 +221,12 @@ KNOBS: tuple[Knob, ...] = (
     Knob("TRIVY_TPU_RPC_GZIP_MIN", "8192", "rpc", False,
          "Minimum body size in bytes before the negotiated gzip wire "
          "framing compresses a request/response."),
+    Knob("TRIVY_TPU_WIRE", "1", "rpc", True,
+         "Binary columnar RPC wire (application/x-trivy-columnar). 0 "
+         "at either end disables the negotiation: the client stops "
+         "offering, the server stops advertising and 400s columnar "
+         "bodies WITHOUT the capability header so clients unlearn "
+         "and resend JSON (docs/performance.md)."),
     # --- observability
     Knob("TRIVY_TPU_TRACE", "", "obs", False,
          "Enable span collection without the --trace flag (1/true)."),
@@ -340,6 +359,10 @@ KNOBS: tuple[Knob, ...] = (
          "Concurrent smart clients in the fleet-serving bench."),
     Knob("TRIVY_TPU_BENCH_FLEET_SCANS", "8", "bench", False,
          "Scans per client in the fleet-serving bench."),
+    Knob("TRIVY_TPU_BENCH_WIRE_CLIENTS", "6", "bench", False,
+         "Concurrent keep-alive clients in the columnar-wire bench."),
+    Knob("TRIVY_TPU_BENCH_WIRE_SCANS", "8", "bench", False,
+         "Scans per client in the columnar-wire bench."),
 )
 
 

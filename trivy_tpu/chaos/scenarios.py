@@ -40,6 +40,7 @@ MANIFEST = {
         ("rpc", ("drop", "timeout", "delay", "error", "corrupt")),
         ("rpc.scan", ("drop", "timeout", "delay", "error", "corrupt")),
         ("rpc.cache", ("drop", "timeout", "delay", "error", "corrupt")),
+        ("rpc.wire", ("drop", "delay", "error", "corrupt")),
         ("fleet.endpoint", ("drop", "timeout", "delay", "error")),
     ),
     "sched": (

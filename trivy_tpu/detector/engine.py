@@ -51,6 +51,29 @@ class PkgQuery:
             (self.space, self.name, self.version, self.scheme_name))
 
 
+def queries_from_columns(spaces: list[str], names: list[str],
+                         versions: list[str],
+                         schemes: list[str]) -> list[PkgQuery]:
+    """Bulk PkgQuery constructor for columnar ingest (rpc/columnar.py
+    ``decode_queries``): builds each query and its precomputed ``key``
+    directly from parallel string columns, skipping the per-object
+    dataclass ``__init__`` + ``__post_init__`` walk — the decoded list
+    feeds ``CompiledDB.encode_packages`` (which keys on ``q.key``)
+    with no per-dict decode in between."""
+    new = PkgQuery.__new__
+    setattr_ = object.__setattr__
+    out: list[PkgQuery] = []
+    for key in zip(spaces, names, versions, schemes):
+        q = new(PkgQuery)
+        setattr_(q, "space", key[0])
+        setattr_(q, "name", key[1])
+        setattr_(q, "version", key[2])
+        setattr_(q, "scheme_name", key[3])
+        setattr_(q, "key", key)
+        out.append(q)
+    return out
+
+
 @dataclass(slots=True)
 class MatchResult:
     query: PkgQuery

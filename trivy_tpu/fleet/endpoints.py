@@ -333,12 +333,18 @@ class EndpointSet:
 
     # ------------------------------------------------------------- post
 
-    def post(self, path: str, body: bytes) -> bytes:
+    def post(self, path: str, body: bytes, columnar=None,
+             json_only: bool = False) -> bytes:
+        # ``columnar``/``json_only`` pass through opaquely to each
+        # replica's _Conn: capability is learned PER REPLICA, so a
+        # mixed-capability fleet (mid-rollout) sends columnar only to
+        # the replicas that advertised it (docs/performance.md)
         eps = self._live()
         if len(eps) == 1 or not self._fleet_on:
             # single replica (or the fleet kill switch): the exact
             # single-server client path, including its own retry loop
-            return eps[0].conn.post(path, body)
+            return eps[0].conn.post(path, body, columnar=columnar,
+                                    json_only=json_only)
         self._ensure_prober()
         with self._lock:
             self._req_n += 1
@@ -362,7 +368,9 @@ class EndpointSet:
                     f"({self._state_note()}); last error: {last}")
             try:
                 if path in HEDGE_PATHS and self._hedge_s > 0:
-                    return self._hedged(ep, path, body, deadline)
+                    return self._hedged(ep, path, body, deadline,
+                                        columnar=columnar,
+                                        json_only=json_only)
                 # failover retries (attempt >= 1) carry their attempt
                 # identity in X-Trivy-Trace (kind "failover": the tree
                 # still counts as a scan server-side — it is the
@@ -371,7 +379,8 @@ class EndpointSet:
                 return self._dispatch(
                     ep, path, body,
                     attempt=attempt if attempt else None,
-                    attempt_kind="failover")
+                    attempt_kind="failover", columnar=columnar,
+                    json_only=json_only)
             except RPCUnavailable as exc:
                 last = exc
                 obs_metrics.FLEET_FAILOVERS.inc()
@@ -404,7 +413,8 @@ class EndpointSet:
 
     def _dispatch(self, ep: Endpoint, path: str, body: bytes,
                   attempt: int | None = None,
-                  attempt_kind: str = "hedge") -> bytes:
+                  attempt_kind: str = "hedge", columnar=None,
+                  json_only: bool = False) -> bytes:
         """One attempt on one endpoint, with breaker accounting. Only
         RPCUnavailable counts against the breaker — a deterministic
         4xx reply proves the replica is alive and answering, and so
@@ -434,9 +444,12 @@ class EndpointSet:
             if attempt is not None:
                 with tracing.attempt_scope(attempt, ep.index,
                                            kind=attempt_kind):
-                    out = ep.conn.post_once(path, body)
+                    out = ep.conn.post_once(path, body,
+                                            columnar=columnar,
+                                            json_only=json_only)
             else:
-                out = ep.conn.post_once(path, body)
+                out = ep.conn.post_once(path, body, columnar=columnar,
+                                        json_only=json_only)
         except RPCBackpressure:
             # deliberate shed (503 + Retry-After from drain/overload):
             # the replica answered coherently, so this is backpressure,
@@ -488,7 +501,8 @@ class EndpointSet:
             return True
 
     def _hedged(self, ep: Endpoint, path: str, body: bytes,
-                deadline) -> bytes:
+                deadline, columnar=None,
+                json_only: bool = False) -> bytes:
         """Dispatch on ``ep``; if no response lands within the hedge
         delay, dispatch the same request to a second replica and take
         whichever answers first. The loser is not awaited — its worker
@@ -514,7 +528,9 @@ class EndpointSet:
                                       attempt=str(attempt),
                                       endpoint=str(target.index)) as s:
                         out = self._dispatch(target, path, body,
-                                             attempt=attempt)
+                                             attempt=attempt,
+                                             columnar=columnar,
+                                             json_only=json_only)
                         if s is not None and target.index in lost:
                             s.meta["cancelled"] = "1"
                         return out

@@ -794,3 +794,34 @@ TENANT_LANE_SECONDS = REGISTRY.counter(
     "(machine-asserted by /debug/usage and bench.py --usage)",
     labels=("tenant", "lane"),
     collapse_label=("tenant", _tenant_top_n()))
+QOS_QUEUE_SHEDS = REGISTRY.counter(
+    "trivy_tpu_qos_queue_sheds_total",
+    "Scheduler submissions shed at a tenant's queue-depth cap "
+    "(TRIVY_TPU_QOS_TENANT_QUEUE) — the per-tenant slice of the "
+    "sheds cost-vector field, so a greedy tenant's rejected demand "
+    "is visible separately from global overload",
+    labels=("tenant",),
+    collapse_label=("tenant", _tenant_top_n()))
+QOS_ACTIVE_TENANTS = REGISTRY.gauge(
+    "trivy_tpu_qos_active_tenants",
+    "Distinct tenants with queued work in the last match-scheduler "
+    "batch compose (the fair-share width of the current interleave)")
+WIRE_REQUESTS = REGISTRY.counter(
+    "trivy_tpu_wire_requests_total",
+    "RPC bodies by negotiated wire format (json | columnar) and "
+    "direction (out = request sent by this client, in = request "
+    "served by this server) — docs/performance.md 'Binary columnar "
+    "wire'",
+    labels=("format", "direction"))
+WIRE_FALLBACKS = REGISTRY.counter(
+    "trivy_tpu_wire_fallbacks_total",
+    "Columnar-to-JSON fallbacks by reason (unlearn = 4xx from a "
+    "replica not advertising the capability — rollback handling; "
+    "corrupt = frame checksum reject; error = columnar wire error "
+    "after its one retry; drop = injected renegotiate)",
+    labels=("reason",))
+WIRE_FRAMES = REGISTRY.counter(
+    "trivy_tpu_wire_frames_total",
+    "Columnar frames encoded/decoded by direction (out/in); the "
+    "streaming scan response counts one frame per result table",
+    labels=("direction",))

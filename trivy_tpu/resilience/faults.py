@@ -78,6 +78,7 @@ SITES: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("rpc", ("drop", "timeout", "delay", "error", "corrupt")),
     ("rpc.scan", ("drop", "timeout", "delay", "error", "corrupt")),
     ("rpc.cache", ("drop", "timeout", "delay", "error", "corrupt")),
+    ("rpc.wire", ("drop", "delay", "error", "corrupt")),
     ("engine", ("device-lost",)),
     ("engine.device", ("drop", "delay", "device-lost")),
     ("engine.shard", ("drop", "delay", "error", "device-lost")),

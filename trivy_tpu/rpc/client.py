@@ -46,6 +46,7 @@ from trivy_tpu.resilience.retry import (
     current_deadline,
     parse_retry_after,
 )
+from trivy_tpu.rpc import columnar as colwire
 from trivy_tpu.rpc import wire
 from trivy_tpu.rpc.server import CACHE_PREFIX, SCAN_PATH
 
@@ -53,9 +54,20 @@ _log = logger("rpc.client")
 
 DEFAULT_RETRY = RetryPolicy(attempts=3, base_s=0.5, cap_s=10.0)
 
+# fault-injection site for the columnar wire negotiation itself
+# (docs/resilience.md): drop renegotiates to JSON, error costs the
+# columnar attempt (one retry, then JSON), corrupt flips bytes in the
+# outgoing frame so the server's checksum reject drives the resend
+WIRE_SITE = "rpc.wire"
+
 
 class RPCError(Exception):
     pass
+
+
+class _WireError(RPCError):
+    """Internal: an injected columnar wire error; retryable within
+    _post_attempts (never escapes it)."""
 
 
 class RPCUnavailable(RPCError):
@@ -99,6 +111,10 @@ class _Conn:
         # X-Trivy-Gzip header: only then are REQUEST bodies gzipped
         # (an old server must never see a gzip request body)
         self._server_gzip = False
+        # same ladder for the columnar wire: only after a response
+        # carries X-Trivy-Columnar are REQUEST bodies sent columnar
+        # (an old server must never see a columnar request body)
+        self._server_columnar = False
         # http_proxy/https_proxy/no_proxy targets go through urllib
         # (which implements proxy routing); keep-alive sockets are for
         # direct connections only
@@ -281,15 +297,19 @@ class _Conn:
             meta["endpoint"] = str(tag[1])
         return meta
 
-    def post(self, path: str, body: bytes) -> bytes:
+    def post(self, path: str, body: bytes, columnar=None,
+             json_only: bool = False) -> bytes:
         # one client span covers the whole retried call; the trace
         # identity rides X-Trivy-Trace so the server's handler span
         # becomes this span's child (docs/observability.md)
         method = path.rsplit("/", 1)[-1]
         with tracing.span(f"rpc.{method}", **self._span_meta(self.base)):
-            return self._post_attempts(path, method, body)
+            return self._post_attempts(path, method, body,
+                                       columnar=columnar,
+                                       json_only=json_only)
 
-    def post_once(self, path: str, body: bytes) -> bytes:
+    def post_once(self, path: str, body: bytes, columnar=None,
+                  json_only: bool = False) -> bytes:
         """Single-attempt post: the fleet EndpointSet drives its own
         failover loop ACROSS endpoints, so the per-endpoint retry loop
         collapses to one attempt (the stale-keep-alive rebuild inside
@@ -297,10 +317,13 @@ class _Conn:
         retry)."""
         method = path.rsplit("/", 1)[-1]
         with tracing.span(f"rpc.{method}", **self._span_meta(self.base)):
-            return self._post_attempts(path, method, body, attempts=1)
+            return self._post_attempts(path, method, body, attempts=1,
+                                       columnar=columnar,
+                                       json_only=json_only)
 
     def _post_attempts(self, path: str, method: str, body: bytes,
-                       attempts: int | None = None) -> bytes:
+                       attempts: int | None = None, columnar=None,
+                       json_only: bool = False) -> bytes:
         # the extended-fidelity internal encoding is marked so the server
         # can tell it apart from reference Twirp clients on the same paths
         headers = {"Content-Type": "application/json",
@@ -315,9 +338,19 @@ class _Conn:
         deadline = current_deadline()
         delays = policy.delays(self._rng)
         site = faults.rpc_site(path)
+        # columnar offer: ``columnar`` is a zero-arg thunk producing the
+        # columnar request bytes, evaluated lazily at most once — and
+        # only after this conn has learned the server speaks columnar
+        # (the X-Trivy-Columnar capability ladder, docs/performance.md)
+        offer_columnar = (columnar is not None and colwire.enabled()
+                          and not json_only)
+        col_bytes: bytes | None = None
+        col_fails = 0    # columnar attempts lost to the wire ladder
+        wire_extra = 0   # extra attempts granted for columnar->JSON
         last_err: Exception | None = None
         shed = False  # last failure was a deliberate 503 + Retry-After
-        for attempt in range(attempts):
+        attempt = 0
+        while attempt < attempts + wire_extra:
             if deadline is not None and deadline.expired:
                 raise DeadlineExceeded(
                     f"rpc to {self.base}{path}: deadline of "
@@ -327,15 +360,32 @@ class _Conn:
             hdrs = dict(headers)
             if deadline is not None:
                 hdrs[DEADLINE_HEADER] = deadline.header_value()
-            send_body = body
-            if self._server_gzip and len(body) >= wire.GZIP_MIN_BYTES:
-                send_body = wire.gzip_bytes(body)
-                hdrs["Content-Encoding"] = "gzip"
+            use_columnar = (offer_columnar and self._server_columnar
+                            and col_fails < 2)
+            if offer_columnar:
+                hdrs["Accept"] = (colwire.CONTENT_TYPE
+                                  + ", application/json")
+            if use_columnar:
+                if col_bytes is None:
+                    col_bytes = columnar()
+                # frames carry their own per-frame deflate; whole-body
+                # gzip would defeat frame-at-a-time decode
+                payload = send_body = col_bytes
+                hdrs["Content-Type"] = colwire.CONTENT_TYPE
+            else:
+                payload = send_body = body
+                if self._server_gzip and len(body) >= wire.GZIP_MIN_BYTES:
+                    send_body = wire.gzip_bytes(body)
+                    hdrs["Content-Encoding"] = "gzip"
             # client-side cost vector (no-ops without an ambient usage
-            # scope): payload bytes pre-gzip, wire bytes post-gzip,
-            # accrued per attempt — retries really do re-ship bytes
-            usage.add("bytes_out", float(len(body)))
+            # scope): payload bytes pre-compression, wire bytes as
+            # actually sent, accrued per attempt — retries really do
+            # re-ship bytes
+            usage.add("bytes_out", float(len(payload)))
             usage.add("wire_bytes_out", float(len(send_body)))
+            obs_metrics.WIRE_REQUESTS.inc(
+                format="columnar" if use_columnar else "json",
+                direction="out")
             retry_after: float | None = None
             corrupt = False
             try:
@@ -352,6 +402,36 @@ class _Conn:
                             int(rule.param or 503))
                     elif rule.action == "corrupt":
                         corrupt = True
+                if offer_columnar:
+                    for rule in faults.fire(WIRE_SITE):
+                        if rule.action == "delay":
+                            policy.sleep(rule.param or 0.0)
+                        elif rule.action == "drop" and use_columnar:
+                            # the columnar channel dropped mid-flight:
+                            # forget the capability and renegotiate —
+                            # the retry goes JSON, and the next 2xx
+                            # response re-advertises columnar
+                            self._server_columnar = False
+                            wire_extra = min(wire_extra + 1, 2)
+                            obs_metrics.WIRE_FALLBACKS.inc(reason="drop")
+                            raise urllib.error.URLError(
+                                ConnectionResetError(
+                                    "injected columnar drop"))
+                        elif rule.action == "error" and use_columnar:
+                            # one columnar retry; a second error falls
+                            # this call back to JSON for good
+                            col_fails += 1
+                            wire_extra = min(wire_extra + 1, 2)
+                            if col_fails >= 2:
+                                obs_metrics.WIRE_FALLBACKS.inc(
+                                    reason="error")
+                            raise _WireError(
+                                "injected columnar wire error")
+                        elif rule.action == "corrupt" and use_columnar:
+                            # flip bytes in the outgoing frames: the
+                            # server's checksum reject (400) drives the
+                            # JSON resend below
+                            send_body = faults.corrupt_bytes(send_body)
                 timeout = self.timeout
                 if deadline is not None:
                     # small grace past the budget: a deadline-aware
@@ -376,6 +456,8 @@ class _Conn:
                         else None)
                 if rhdrs.get(wire.GZIP_CAPABLE_HEADER):
                     self._server_gzip = True
+                if rhdrs.get(colwire.CAPABLE_HEADER):
+                    self._server_columnar = True
                 usage.add("wire_bytes_in", float(len(raw)))
                 if "gzip" in (rhdrs.get("Content-Encoding")
                               or "").lower():
@@ -399,6 +481,32 @@ class _Conn:
                         last_err = RPCError(
                             f"{status} to gzip request from a server "
                             f"without gzip capability: {detail}")
+                    elif use_columnar \
+                            and not rhdrs.get(colwire.CAPABLE_HEADER):
+                        # same unlearn for the columnar wire: ANY error
+                        # to our columnar request from a server NOT
+                        # advertising the capability is an old or
+                        # rolled-back replica choking on the encoding —
+                        # forget the sticky capability and let the
+                        # (granted) retry resend JSON
+                        self._server_columnar = False
+                        wire_extra = min(wire_extra + 1, 2)
+                        obs_metrics.WIRE_FALLBACKS.inc(reason="unlearn")
+                        shed = False
+                        last_err = RPCError(
+                            f"{status} to columnar request from a "
+                            f"server without columnar capability: "
+                            f"{detail}")
+                    elif use_columnar and status == 400:
+                        # a columnar-capable server rejected our frames
+                        # (checksum/truncation — corrupted in transit):
+                        # resend this call as JSON
+                        col_fails = 2
+                        wire_extra = min(wire_extra + 1, 2)
+                        obs_metrics.WIRE_FALLBACKS.inc(reason="corrupt")
+                        shed = False
+                        last_err = RPCError(
+                            f"400 columnar frame reject: {detail}")
                     elif status < 500:
                         raise RPCError(f"{status}: {detail}")
                     else:
@@ -413,6 +521,9 @@ class _Conn:
                                 rhdrs.get("Retry-After"))
                 else:
                     return faults.corrupt_bytes(raw) if corrupt else raw
+            except _WireError as exc:
+                shed = False
+                last_err = exc
             except faults.InjectedHTTPError as exc:
                 if exc.code < 500:
                     raise RPCError(f"{exc.code}: {exc}") from exc
@@ -422,7 +533,8 @@ class _Conn:
                     OSError, TimeoutError) as exc:
                 shed = False
                 last_err = exc
-            if attempt < attempts - 1:
+            attempt += 1
+            if attempt < attempts + wire_extra:
                 delay = next(delays)
                 if retry_after is not None:
                     # the server told us when it expects to recover;
@@ -489,7 +601,20 @@ class RemoteDriver:
 
     def scan(self, target, artifact_key, blob_keys, options):
         body = wire.scan_request(target, artifact_key, blob_keys, options)
-        raw = self.conn.post(SCAN_PATH, body)
+        raw = self.conn.post(SCAN_PATH, body, columnar=lambda:
+                             colwire.encode_scan_request(
+                                 target, artifact_key, blob_keys,
+                                 options))
+        if colwire.is_columnar(raw):
+            try:
+                return colwire.decode_scan_response(raw)
+            except colwire.WireFormatError as exc:
+                # a columnar response that fails its frame checksums
+                # (torn/corrupted in transit): refetch once as JSON
+                obs_metrics.WIRE_FALLBACKS.inc(reason="corrupt")
+                _log.warn("columnar scan response rejected; "
+                          "refetching as JSON", err=str(exc))
+                raw = self.conn.post(SCAN_PATH, body, json_only=True)
         return wire.decode_scan_response(raw)
 
     def close(self) -> None:
@@ -513,12 +638,24 @@ class RemoteCache:
     def put_blob(self, blob_id: str, blob) -> None:
         self.conn.post(CACHE_PREFIX + "PutBlob", wire.encode(
             {"diff_id": blob_id, "blob_info": blob}
-        ))
+        ), columnar=lambda: colwire.encode_put_blob(blob_id, blob))
 
     def missing_blobs(self, artifact_id: str, blob_ids: list[str]):
-        raw = self.conn.post(CACHE_PREFIX + "MissingBlobs", wire.encode(
-            {"artifact_id": artifact_id, "blob_ids": blob_ids}
-        ))
+        body = wire.encode(
+            {"artifact_id": artifact_id, "blob_ids": blob_ids})
+        raw = self.conn.post(
+            CACHE_PREFIX + "MissingBlobs", body,
+            columnar=lambda: colwire.encode_missing_blobs(
+                artifact_id, blob_ids))
+        if colwire.is_columnar(raw):
+            try:
+                return colwire.decode_missing_response(raw)
+            except colwire.WireFormatError as exc:
+                obs_metrics.WIRE_FALLBACKS.inc(reason="corrupt")
+                _log.warn("columnar MissingBlobs response rejected; "
+                          "refetching as JSON", err=str(exc))
+                raw = self.conn.post(CACHE_PREFIX + "MissingBlobs",
+                                     body, json_only=True)
         doc = json.loads(raw)
         return doc.get("missing_artifact", True), \
             doc.get("missing_blob_ids", []) or []

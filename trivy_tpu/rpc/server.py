@@ -37,6 +37,7 @@ from trivy_tpu.resilience.retry import (
     DeadlineExceeded,
     deadline_scope,
 )
+from trivy_tpu.rpc import columnar as colwire
 from trivy_tpu.rpc import wire
 from trivy_tpu.sched.scheduler import Overloaded  # noqa: F401 — re-export
 
@@ -836,7 +837,10 @@ def _make_handler(service: ScanService, token: str | None,
             accept = (self.headers.get("Accept-Encoding") or "").lower()
             encoding = None
             usage.add("bytes_out", float(len(body)))
-            if "gzip" in accept and len(body) >= wire.GZIP_MIN_BYTES:
+            if "gzip" in accept and len(body) >= wire.GZIP_MIN_BYTES \
+                    and ctype != colwire.CONTENT_TYPE:
+                # columnar bodies skip whole-body gzip: frames carry
+                # their own per-frame deflate
                 body = wire.gzip_bytes(body)
                 encoding = "gzip"
             usage.add("wire_bytes_out", float(len(body)))
@@ -844,12 +848,55 @@ def _make_handler(service: ScanService, token: str | None,
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.send_header(wire.GZIP_CAPABLE_HEADER, "1")
+            if colwire.enabled():
+                # columnar capability advertisement (absent when the
+                # kill switch is off, which is what drives a columnar
+                # client's unlearn after a rollback)
+                self.send_header(colwire.CAPABLE_HEADER, "1")
             if encoding:
                 self.send_header("Content-Encoding", encoding)
             for name, value in (extra_headers or {}).items():
                 self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
+
+        def _reply_stream(self, frames):
+            """Chunked streaming columnar reply: each frame is written
+            and flushed as its own HTTP/1.1 chunk the moment it is
+            encoded, so the client can start demuxing the first result
+            table while the server is still encoding the rest
+            (docs/performance.md "Binary columnar wire")."""
+            self.send_response(200)
+            self.send_header("Content-Type", colwire.CONTENT_TYPE)
+            self.send_header(wire.GZIP_CAPABLE_HEADER, "1")
+            self.send_header(colwire.CAPABLE_HEADER, "1")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            total = 0
+            for frame in frames:
+                total += len(frame)
+                self.wfile.write(b"%x\r\n" % len(frame))
+                self.wfile.write(frame)
+                self.wfile.write(b"\r\n")
+                self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+            # columnar bodies have no whole-body compression layer, so
+            # payload bytes == wire bytes (conservation invariant)
+            usage.add("bytes_out", float(total))
+            usage.add("wire_bytes_out", float(total))
+
+        def _accepts_columnar(self) -> bool:
+            return (colwire.enabled() and colwire.CONTENT_TYPE in
+                    (self.headers.get("Accept") or ""))
+
+        def _columnar_body(self, body: bytes) -> bool:
+            # route by the DECLARED content type too: a columnar body
+            # whose magic got mangled in transit must land in the
+            # columnar decoder's deterministic WireFormatError (-> 400
+            # frame reject), not fall through to the JSON parser
+            return ((self.headers.get("Content-Type") or "")
+                    .startswith(colwire.CONTENT_TYPE)
+                    or colwire.is_columnar(body))
 
         def _shed(self, msg: str, retry_after: float):
             """503 + Retry-After: the reply a well-behaved client backs
@@ -997,6 +1044,17 @@ def _make_handler(service: ScanService, token: str | None,
                     self._error(400, f"bad request body: {exc}")
                     return
             usage.add("bytes_in", float(len(body)))
+            is_columnar_req = (
+                (self.headers.get("Content-Type") or "")
+                .startswith(colwire.CONTENT_TYPE)
+                or colwire.is_columnar(body))
+            if is_columnar_req and not colwire.enabled():
+                # rolled back / kill-switched: the 400 goes out WITHOUT
+                # the X-Trivy-Columnar header (see _reply), which is
+                # exactly what makes the client unlearn the sticky
+                # capability and resend JSON
+                self._error(400, "columnar wire not supported")
+                return
             if self.path.startswith("/twirp/") and \
                     self.headers.get("X-Trivy-Tpu-Wire") != "internal":
                 # reference wire protocol (Twirp protobuf / proto3-JSON).
@@ -1030,8 +1088,12 @@ def _make_handler(service: ScanService, token: str | None,
                     self._handle_fleet(self.path[len("/fleet/"):], body)
                 else:
                     self._error(404, "not found")
-            except (json.JSONDecodeError, KeyError, TypeError) as exc:
+            except (json.JSONDecodeError, KeyError, TypeError,
+                    colwire.WireFormatError) as exc:
                 # malformed request: deterministic, must not be retried
+                # (a columnar frame-checksum reject lands here — the
+                # client sees 400 + the capability header and resends
+                # the same call as JSON)
                 _log.warn("bad rpc request", path=self.path, err=str(exc))
                 self._error(400, f"bad request: {exc}")
             except Exception as exc:  # twirp-style error envelope
@@ -1039,7 +1101,16 @@ def _make_handler(service: ScanService, token: str | None,
                 self._error(500, str(exc))
 
         def _handle_scan(self, body: bytes):
-            target, akey, blobs, options = wire.decode_scan_request(body)
+            if self._columnar_body(body):
+                target, akey, blobs, options = \
+                    colwire.decode_scan_request(body)
+                obs_metrics.WIRE_REQUESTS.inc(format="columnar",
+                                              direction="in")
+            else:
+                target, akey, blobs, options = \
+                    wire.decode_scan_request(body)
+                obs_metrics.WIRE_REQUESTS.inc(format="json",
+                                              direction="in")
             deadline = Deadline.from_header(
                 self.headers.get(DEADLINE_HEADER))
             # adopt the caller's trace identity (X-Trivy-Trace) so the
@@ -1075,7 +1146,11 @@ def _make_handler(service: ScanService, token: str | None,
                     self._shed(str(exc), 1.0)
                     return
             usage.add("scans")
-            self._reply(200, wire.scan_response(results, os_found))
+            if self._accepts_columnar():
+                self._reply_stream(
+                    colwire.scan_response_frames(results, os_found))
+            else:
+                self._reply(200, wire.scan_response(results, os_found))
 
         def _handle_fleet(self, method: str, body: bytes):
             """Fleet-rollout control surface (docs/fleet.md), token-
@@ -1126,7 +1201,23 @@ def _make_handler(service: ScanService, token: str | None,
                 self._error(404, f"unknown fleet method {method}")
 
         def _handle_cache(self, method: str, body: bytes):
-            doc = json.loads(body) if body else {}
+            if self._columnar_body(body):
+                obs_metrics.WIRE_REQUESTS.inc(format="columnar",
+                                              direction="in")
+                if method == "PutBlob":
+                    diff_id, blob_info = colwire.decode_put_blob(body)
+                    doc = {"diff_id": diff_id, "blob_info": blob_info}
+                elif method == "MissingBlobs":
+                    artifact_id, blob_ids = \
+                        colwire.decode_missing_blobs(body)
+                    doc = {"artifact_id": artifact_id,
+                           "blob_ids": blob_ids}
+                else:
+                    self._error(400, "columnar body not supported for "
+                                     f"cache method {method}")
+                    return
+            else:
+                doc = json.loads(body) if body else {}
             cache = service.cache
             if method == "PutArtifact":
                 cache.put_artifact(doc["artifact_id"], doc["artifact_info"])
@@ -1163,10 +1254,15 @@ def _make_handler(service: ScanService, token: str | None,
                             budget_s=(max(dl.remaining() / 2, 0.0)
                                       if dl else None),
                             holder=holder)
-                self._reply(200, json.dumps({
-                    "missing_artifact": missing_artifact,
-                    "missing_blob_ids": missing_blobs,
-                }).encode())
+                if self._accepts_columnar():
+                    self._reply(200, colwire.encode_missing_response(
+                        missing_artifact, missing_blobs),
+                        ctype=colwire.CONTENT_TYPE)
+                else:
+                    self._reply(200, json.dumps({
+                        "missing_artifact": missing_artifact,
+                        "missing_blob_ids": missing_blobs,
+                    }).encode())
             elif method == "DeleteBlobs":
                 cache.delete_blobs(doc.get("blob_ids") or [])
                 self._reply(200, b"{}")

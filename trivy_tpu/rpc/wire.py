@@ -58,6 +58,14 @@ def _jsonable(obj: Any) -> Any:
     if isinstance(obj, enum.Enum):
         return obj.value
     if isinstance(obj, dict):
+        # the ONE canonicalization site: dicts with non-string (int /
+        # float) keys serialize sorted by their JSON key rendering, so
+        # golden-byte tests never depend on insertion order at a new
+        # call site; str-keyed dicts keep insertion order and today's
+        # bytes exactly
+        if any(not isinstance(k, str) for k in obj):
+            return {k: _jsonable(v) for k, v in
+                    sorted(obj.items(), key=lambda kv: str(kv[0]))}
         return {k: _jsonable(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [_jsonable(v) for v in obj]

@@ -22,6 +22,20 @@ Guarantees:
   ``chunk_rows``-sized chunks, interleaved round-robin across waiting
   requests in oldest-deadline-first order, so one 200k-package image
   cannot starve ten 50-package images queued behind it.
+- **Per-tenant QoS.** The interleave is additionally weighted
+  fair-share across TENANTS (the PR 18 usage tenant id): a deficit
+  round-robin banks ``TRIVY_TPU_QOS_WEIGHTS`` quanta per tenant per
+  round and emits one chunk per whole quantum, so a greedy tenant's
+  crawler shares every micro-batch with interactive tenants at its
+  weight's share, not its request count's. Per-tenant queue-depth
+  caps (``TRIVY_TPU_QOS_TENANT_QUEUE``) shed a tenant that tries to
+  buy the whole queue, folded into the usual shed accounting (the
+  server replies 503 under the tenant's usage scope, so the
+  ``trivy_tpu_tenant_*`` sheds field picks it up). With a single
+  tenant (or ``TRIVY_TPU_QOS=0``) the emitted chunk sequence is
+  EXACTLY the historical request-level round-robin, and any
+  interleaving is zero-diff by the compose-determinism argument
+  above.
 - **Deadlines.** A request whose ambient ``X-Trivy-Deadline`` budget
   expires while (partly) queued is shed with ``Overloaded`` (503 +
   Retry-After upstream) and counted via ``on_shed`` — never silently
@@ -71,6 +85,9 @@ from trivy_tpu.resilience.retry import current_deadline
 _log = logger("sched")
 
 ENV_KILL = "TRIVY_TPU_SCHED"
+ENV_QOS = "TRIVY_TPU_QOS"
+ENV_QOS_TENANT_QUEUE = "TRIVY_TPU_QOS_TENANT_QUEUE"
+ENV_QOS_WEIGHTS = "TRIVY_TPU_QOS_WEIGHTS"
 
 DEFAULT_WINDOW_MS = 3.0
 DEFAULT_MAX_ROWS = 65536
@@ -86,6 +103,42 @@ def enabled() -> bool:
     """TRIVY_TPU_SCHED=0 is the kill switch: scans run the exact
     per-request ``engine.detect`` path with no scheduler thread."""
     return os.environ.get(ENV_KILL, "1") != "0"
+
+
+def qos_enabled() -> bool:
+    """TRIVY_TPU_QOS=0 restores the pure request-level round-robin
+    compose (no tenant grouping, no per-tenant queue caps)."""
+    return os.environ.get(ENV_QOS, "1") != "0"
+
+
+def _qos_weights() -> dict[str, float]:
+    """TRIVY_TPU_QOS_WEIGHTS="<tenant>=<w>,..." — fair-share weights
+    keyed by the usage tenant id (``*`` sets the default, 1.0
+    otherwise). Malformed entries are ignored (an operator typo must
+    not take the scheduler down)."""
+    out: dict[str, float] = {}
+    for part in os.environ.get(ENV_QOS_WEIGHTS, "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        tenant, _eq, w = part.partition("=")
+        try:
+            val = float(w)
+        except ValueError:
+            continue
+        if val > 0:
+            out[tenant.strip()] = val
+    return out
+
+
+def _qos_tenant_queue(max_queue: int) -> int:
+    """Per-tenant waiting-request cap; 0/unset = the global
+    ``max_queue`` (no separate per-tenant bound)."""
+    try:
+        n = int(os.environ.get(ENV_QOS_TENANT_QUEUE, "") or 0)
+    except ValueError:
+        n = 0
+    return n if n > 0 else max_queue
 
 
 class Overloaded(Exception):
@@ -106,7 +159,7 @@ class _Pending:
 
     __slots__ = ("queries", "results", "next_row", "inflight", "deadline",
                  "arrival", "seq", "trace_ctx", "usage_ctx", "error",
-                 "done", "dispatched_at")
+                 "done", "dispatched_at", "tenant")
 
     def __init__(self, queries: list, deadline, seq: int):
         self.queries = queries
@@ -123,6 +176,11 @@ class _Pending:
         # from the scheduler thread, and the batch dispatch re-adopts
         # the lead request's tenant scope (obs/usage.py)
         self.usage_ctx = usage.capture()
+        # QoS key: the submitting request's usage tenant (the hashed
+        # token the server's handler scope carries); scope-less
+        # submissions share the anonymous bucket
+        self.tenant = (self.usage_ctx.tenant
+                       if self.usage_ctx is not None else usage.ANONYMOUS)
         self.error: Exception | None = None
         self.done = threading.Event()
         self.dispatched_at: float | None = None
@@ -194,6 +252,13 @@ class MatchScheduler:
         self.max_queue = max(int(max_queue), 1)
         self.depth = max(int(depth), 1)
         self.on_shed = on_shed
+        # per-tenant QoS (read once at construction, like the CLI's
+        # sched knobs): weighted deficit round-robin across tenants in
+        # _compose, per-tenant queue-depth caps in _enqueue
+        self.qos = qos_enabled()
+        self.tenant_queue = _qos_tenant_queue(self.max_queue)
+        self.weights = _qos_weights()
+        self._deficit: dict[str, float] = {}
         self._cond = make_lock("sched.scheduler._cond",
                                threading.Condition())
         self._waiting: list[_Pending] = []
@@ -313,6 +378,20 @@ class MatchScheduler:
                     f"match scheduler overloaded "
                     f"({len(self._waiting)} requests queued)",
                     retry_after=1.0)
+            if self.qos and self.tenant_queue < self.max_queue:
+                scope = usage.ambient()
+                tenant = (scope.tenant if scope is not None
+                          else usage.ANONYMOUS)
+                depth = sum(1 for w in self._waiting
+                            if w.tenant == tenant)
+                if depth >= self.tenant_queue:
+                    self._count_shed()
+                    obs_metrics.QOS_QUEUE_SHEDS.inc(tenant=tenant)
+                    raise Overloaded(
+                        f"tenant {tenant} over its queue-depth cap "
+                        f"({depth} requests queued, cap "
+                        f"{self.tenant_queue})",
+                        retry_after=1.0)
             self._seq += 1
             p = _Pending(list(queries), deadline, self._seq)
             self._waiting.append(p)
@@ -426,29 +505,29 @@ class MatchScheduler:
                     return ([], 0)
             # fairness: oldest-deadline-first order, one chunk per
             # request per round, so a huge image shares every batch
-            # with the small ones queued beside it
+            # with the small ones queued beside it; with QoS on, the
+            # rounds are tenant-level weighted deficit round-robin
+            # instead (one chunk per banked quantum, rotating across
+            # the tenant's requests) — for a single tenant at weight 1
+            # the emitted chunk sequence is identical to the
+            # request-level interleave, so the historical compose is a
+            # special case, not a second code path to diverge
             order = sorted(self._waiting, key=_Pending.sort_key)
-            parts: list[tuple[_Pending, int, int]] = []
-            rows = 0
-            progressed = True
-            while rows < self.max_rows and progressed:
-                progressed = False
-                for p in order:
-                    if rows >= self.max_rows:
-                        break
-                    if not p.queued_rows:
-                        continue
-                    lo = p.next_row
-                    hi = min(lo + self.chunk_rows, len(p.queries),
-                             lo + (self.max_rows - rows))
-                    p.next_row = hi
-                    p.inflight += 1
-                    if p.dispatched_at is None:
-                        p.dispatched_at = time.monotonic()
-                        self._observe_wait(p, p.dispatched_at - p.arrival)
-                    parts.append((p, lo, hi))
-                    rows += hi - lo
-                    progressed = True
+            if self.qos:
+                parts, rows = self._compose_qos(order)
+            else:
+                parts = []
+                rows = 0
+                progressed = True
+                while rows < self.max_rows and progressed:
+                    progressed = False
+                    for p in order:
+                        if rows >= self.max_rows:
+                            break
+                        if not p.queued_rows:
+                            continue
+                        rows += self._cut_chunk(p, parts, rows)
+                        progressed = True
             self._mesh_fill(order, parts, rows)
             rows = sum(hi - lo for _p, lo, hi in parts)
             # fully-dispatched requests leave the queue; they complete
@@ -456,6 +535,79 @@ class MatchScheduler:
             self._waiting = [p for p in self._waiting if p.queued_rows]
             self._set_depth(len(self._waiting))
             return (parts, rows)
+
+    def _cut_chunk(self, p: _Pending, parts: list, rows: int) -> int:
+        """Cut one ``chunk_rows`` chunk from `p` into `parts` (caller
+        holds _cond); -> rows taken."""
+        lo = p.next_row
+        hi = min(lo + self.chunk_rows, len(p.queries),
+                 lo + (self.max_rows - rows))
+        p.next_row = hi
+        p.inflight += 1
+        if p.dispatched_at is None:
+            p.dispatched_at = time.monotonic()
+            self._observe_wait(p, p.dispatched_at - p.arrival)
+        parts.append((p, lo, hi))
+        return hi - lo
+
+    def _compose_qos(self, order: list[_Pending]):
+        """Weighted deficit round-robin across tenants (caller holds
+        _cond): each round banks every queued tenant's weight as
+        credit and emits one chunk per whole credit, rotating across
+        that tenant's requests in deadline order.  Deficits persist
+        across batches so fractional weights average out; an idle
+        tenant's deficit resets (no banking while unqueued) so a
+        returning tenant cannot burst past its share."""
+        groups: dict[str, list[_Pending]] = {}
+        torder: list[str] = []
+        for p in order:
+            g = groups.get(p.tenant)
+            if g is None:
+                groups[p.tenant] = g = []
+                torder.append(p.tenant)
+            g.append(p)
+        # drop stale deficits: tenants with nothing queued stop banking
+        self._deficit = {t: d for t, d in self._deficit.items()
+                         if t in groups}
+        obs_metrics.QOS_ACTIVE_TENANTS.set(len(groups))
+        default_w = self.weights.get("*", 1.0)
+        cursor = {t: 0 for t in torder}
+        parts: list[tuple[_Pending, int, int]] = []
+        rows = 0
+        progressed = True
+        while rows < self.max_rows and progressed:
+            progressed = False
+            for t in torder:
+                if rows >= self.max_rows:
+                    break
+                g = groups[t]
+                if not any(p.queued_rows for p in g):
+                    self._deficit.pop(t, None)
+                    continue
+                w = self.weights.get(t, default_w)
+                # bank one round's quantum, capped so an idle-within-
+                # batch tenant cannot accumulate unbounded credit
+                credit = min(self._deficit.get(t, 0.0) + w,
+                             max(w, 1.0))
+                while credit >= 1.0 and rows < self.max_rows:
+                    k = cursor[t]
+                    n = len(g)
+                    p = None
+                    for j in range(n):
+                        cand = g[(k + j) % n]
+                        if cand.queued_rows:
+                            p = cand
+                            cursor[t] = (k + j + 1) % n
+                            break
+                    if p is None:
+                        break
+                    credit -= 1.0
+                    rows += self._cut_chunk(p, parts, rows)
+                    progressed = True
+                self._deficit[t] = (credit
+                                    if any(p.queued_rows for p in g)
+                                    else 0.0)
+        return parts, rows
 
     def _mesh_fill(self, order, parts, rows: int) -> None:
         """Mesh-shape-aware composition (caller holds _cond): when the
